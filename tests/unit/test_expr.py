@@ -1,0 +1,164 @@
+"""Expression interpreter semantics."""
+import pytest
+
+from nebula_tpu.core import (NULL, NULL_BAD_TYPE, Binary, Case, DictContext,
+                             Edge, FunctionCall, InputProp, LabelExpr,
+                             ListComprehension, ListExpr, Literal, MapExpr,
+                             PredicateExpr, Reduce, Slice, SrcProp, Subscript,
+                             Tag, TypeCast, Unary, VarExpr, Vertex, is_null,
+                             split_conjuncts, to_text)
+from nebula_tpu.core.expr import AggExpr, AttributeExpr, EdgeProp
+
+
+def ev(e, **kw):
+    return e.eval(DictContext(**kw))
+
+
+def L(v):
+    return Literal(v)
+
+
+def test_arithmetic_tree():
+    e = Binary("+", Binary("*", L(2), L(3)), L(4))
+    assert ev(e) == 10
+    assert to_text(e) == "((2 * 3) + 4)"
+
+
+def test_relational():
+    assert ev(Binary("<", L(1), L(2))) is True
+    assert ev(Binary("==", L("a"), L("a"))) is True
+    assert is_null(ev(Binary(">", L(1), Literal(NULL))))
+
+
+def test_in_contains():
+    assert ev(Binary("IN", L(2), ListExpr([L(1), L(2)]))) is True
+    assert ev(Binary("IN", L(5), ListExpr([L(1), Literal(NULL)]))) is NULL
+    assert ev(Binary("NOT IN", L(5), ListExpr([L(1)]))) is True
+    assert ev(Binary("CONTAINS", L("hello"), L("ell"))) is True
+    assert ev(Binary("STARTS WITH", L("hello"), L("he"))) is True
+    assert ev(Binary("ENDS WITH", L("hello"), L("lo"))) is True
+    assert ev(Binary("=~", L("abc123"), L("[a-z]+\\d+"))) is True
+
+
+def test_short_circuit():
+    # rhs would raise (unknown function) but must not be evaluated
+    bad = FunctionCall("no_such_fn", [])
+    assert ev(Binary("AND", L(False), bad)) is False
+    assert ev(Binary("OR", L(True), bad)) is True
+
+
+def test_props():
+    ctx = DictContext(input_props={"x": 7},
+                      src_props={"person": {"age": 30}},
+                      edge_props={"since": 2010})
+    assert InputProp("x").eval(ctx) == 7
+    assert SrcProp("person", "age").eval(ctx) == 30
+    assert EdgeProp("knows", "since").eval(ctx) == 2010
+    assert is_null(InputProp("missing").eval(ctx))
+
+
+def test_edge_reserved_props():
+    e = Edge("a", "b", "knows", 3)
+    ctx = DictContext(edge=e)
+    assert EdgeProp("knows", "_src").eval(ctx) == "a"
+    assert EdgeProp("knows", "_dst").eval(ctx) == "b"
+    assert EdgeProp("knows", "_rank").eval(ctx) == 3
+    assert EdgeProp("knows", "_type").eval(ctx) == "knows"
+
+
+def test_subscript_slice():
+    lst = ListExpr([L(10), L(20), L(30)])
+    assert ev(Subscript(lst, L(1))) == 20
+    assert ev(Subscript(lst, L(-1))) == 30
+    assert is_null(ev(Subscript(lst, L(9))))
+    assert ev(Slice(lst, L(1), None)) == [20, 30]
+    m = MapExpr([("a", L(1))])
+    assert ev(Subscript(m, L("a"))) == 1
+
+
+def test_attribute():
+    v = Vertex("a", [Tag("person", {"name": "Ann"})])
+    ctx = DictContext(variables={"v": v})
+    assert AttributeExpr(LabelExpr("v"), "name").eval(ctx) == "Ann"
+    assert ev(AttributeExpr(MapExpr([("k", L(5))]), "k")) == 5
+
+
+def test_case():
+    e = Case([(Binary(">", InputProp("x"), L(0)), L("pos"))], L("neg"))
+    assert ev(e, input_props={"x": 3}) == "pos"
+    assert ev(e, input_props={"x": -3}) == "neg"
+    e2 = Case([(L(1), L("one")), (L(2), L("two"))], L("other"), condition=InputProp("x"))
+    assert ev(e2, input_props={"x": 2}) == "two"
+
+
+def test_list_comprehension():
+    e = ListComprehension("x", ListExpr([L(1), L(2), L(3), L(4)]),
+                          where=Binary(">", LabelExpr("x"), L(2)),
+                          mapping=Binary("*", LabelExpr("x"), L(10)))
+    assert ev(e) == [30, 40]
+
+
+def test_predicate():
+    lst = ListExpr([L(1), L(2), L(3)])
+    assert ev(PredicateExpr("all", "x", lst, Binary(">", LabelExpr("x"), L(0)))) is True
+    assert ev(PredicateExpr("any", "x", lst, Binary(">", LabelExpr("x"), L(2)))) is True
+    assert ev(PredicateExpr("none", "x", lst, Binary(">", LabelExpr("x"), L(5)))) is True
+    assert ev(PredicateExpr("single", "x", lst, Binary("==", LabelExpr("x"), L(2)))) is True
+
+
+def test_reduce():
+    e = Reduce("acc", L(0), "x", ListExpr([L(1), L(2), L(3)]),
+               Binary("+", LabelExpr("acc"), LabelExpr("x")))
+    assert ev(e) == 6
+
+
+def test_functions():
+    assert ev(FunctionCall("abs", [L(-5)])) == 5
+    assert ev(FunctionCall("upper", [L("ab")])) == "AB"
+    assert ev(FunctionCall("size", [ListExpr([L(1), L(2)])])) == 2
+    assert ev(FunctionCall("substr", [L("hello"), L(1), L(3)])) == "ell"
+    assert ev(FunctionCall("coalesce", [Literal(NULL), L(3)])) == 3
+    assert ev(FunctionCall("split", [L("a,b"), L(",")])) == ["a", "b"]
+    assert ev(FunctionCall("round", [L(2.5)])) == 3.0
+    assert ev(FunctionCall("round", [L(-2.5)])) == -3.0
+
+
+def test_cast():
+    assert ev(TypeCast("int", L("42"))) == 42
+    assert ev(TypeCast("string", L(4.0))) == "4.0"
+    assert ev(TypeCast("float", L(3))) == 3.0
+    assert ev(TypeCast("bool", L("true"))) is True
+
+
+def test_graph_functions():
+    v = Vertex("a", [Tag("person", {"name": "Ann"})])
+    e = Edge("a", "b", "knows", 0, {"w": 1})
+    ctx = DictContext(variables={"v": v, "e": e})
+    assert FunctionCall("id", [LabelExpr("v")]).eval(ctx) == "a"
+    assert FunctionCall("tags", [LabelExpr("v")]).eval(ctx) == ["person"]
+    assert FunctionCall("type", [LabelExpr("e")]).eval(ctx) == "knows"
+    assert FunctionCall("src", [LabelExpr("e")]).eval(ctx) == "a"
+    assert FunctionCall("properties", [LabelExpr("e")]).eval(ctx) == {"w": 1}
+
+
+def test_aggregate_apply():
+    a = AggExpr("sum", InputProp("x"))
+    assert a.apply([1, 2, NULL, 3]) == 6
+    assert AggExpr("count", None).apply([1, NULL]) == 2  # count(*)
+    assert AggExpr("count", InputProp("x")).apply([1, NULL]) == 1
+    assert AggExpr("avg", InputProp("x")).apply([1, 2, 3]) == 2.0
+    assert AggExpr("max", InputProp("x")).apply(["a", "c", "b"]) == "c"
+    assert AggExpr("collect", InputProp("x")).apply([1, NULL, 2]) == [1, 2]
+    assert AggExpr("sum", InputProp("x"), distinct=True).apply([1, 1, 2]) == 3
+
+
+def test_split_conjuncts():
+    e = Binary("AND", Binary("AND", L(1), L(2)), L(3))
+    assert len(split_conjuncts(e)) == 3
+
+
+def test_unary_is_null():
+    assert ev(Unary("IS_NULL", Literal(NULL))) is True
+    assert ev(Unary("IS_NOT_NULL", L(1))) is True
+    assert ev(Unary("NOT", L(False))) is True
+    assert ev(Unary("-", L(5))) == -5

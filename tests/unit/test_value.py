@@ -1,0 +1,132 @@
+"""Value-model semantics: null kinds, 3-valued logic, compare, arithmetic."""
+import math
+
+from nebula_tpu.core import (EMPTY, NULL, NULL_BAD_TYPE, NULL_DIV_BY_ZERO,
+                             NULL_OVERFLOW, DataSet, Date, DateTime, Duration,
+                             Edge, Path, Step, Tag, Time, Vertex, is_null,
+                             total_order_key, type_name)
+from nebula_tpu.core.value import (INT64_MAX, logical_and, logical_not,
+                                   logical_or, logical_xor, v_add, v_div,
+                                   v_eq, v_lt, v_mod, v_mul, v_ne, v_sub)
+
+
+def test_null_kinds_interned():
+    assert NULL is not NULL_BAD_TYPE
+    assert NULL == NULL_BAD_TYPE  # all nulls equal for dedup
+    assert hash(NULL) == hash(NULL_DIV_BY_ZERO)
+    assert repr(NULL_DIV_BY_ZERO) == "__DIV_BY_ZERO__"
+
+
+def test_arithmetic_null_propagation():
+    assert is_null(v_add(NULL, 1))
+    assert is_null(v_mul(2, NULL))
+    assert v_add(NULL_BAD_TYPE, 1) is NULL_BAD_TYPE
+
+
+def test_division():
+    assert v_div(7, 2) == 3
+    assert v_div(-7, 2) == -3  # trunc toward zero, not floor
+    assert v_div(7.0, 2) == 3.5
+    assert v_div(1, 0) is NULL_DIV_BY_ZERO
+    assert v_div(1.0, 0.0) is NULL_DIV_BY_ZERO
+    assert v_mod(7, 3) == 1
+    assert v_mod(-7, 3) == -1  # C-style remainder
+    assert v_mod(5, 0) is NULL_DIV_BY_ZERO
+
+
+def test_overflow():
+    assert v_add(INT64_MAX, 1) is NULL_OVERFLOW
+    assert v_mul(INT64_MAX, 2) is NULL_OVERFLOW
+    assert v_add(INT64_MAX, 0) == INT64_MAX
+
+
+def test_string_concat():
+    assert v_add("a", "b") == "ab"
+    assert v_add("a", 1) == "a1"
+    assert v_add(1, "a") == "1a"
+    assert v_add("x", True) == "xtrue"
+
+
+def test_list_concat():
+    assert v_add([1], [2, 3]) == [1, 2, 3]
+    assert v_add([1], 2) == [1, 2]
+    assert v_add(0, [1]) == [0, 1]
+
+
+def test_bad_type_arith():
+    assert v_sub("a", 1) is NULL_BAD_TYPE
+    assert v_mul(True, 2) is NULL_BAD_TYPE  # bool is not numeric
+
+
+def test_three_valued_logic():
+    assert logical_and(True, NULL) is NULL
+    assert logical_and(False, NULL) is False
+    assert logical_or(True, NULL) is True
+    assert logical_or(False, NULL) is NULL
+    assert logical_not(NULL) is NULL
+    assert logical_xor(True, NULL) is NULL
+    assert logical_and(True, True) is True
+
+
+def test_eq_semantics():
+    assert v_eq(1, 1.0) is True
+    assert v_eq(1, "1") is False  # cross-type == is false, not null
+    assert is_null(v_eq(NULL, 1))
+    assert is_null(v_eq(NULL, NULL))
+    assert v_ne(1, 2) is True
+    assert v_eq([1, 2], [1, 2]) is True
+    assert v_eq([1, NULL], [1, 2]) is NULL
+
+
+def test_lt_semantics():
+    assert v_lt(1, 2.5) is True
+    assert v_lt("a", "b") is True
+    assert v_lt(1, "a") is NULL_BAD_TYPE
+    assert is_null(v_lt(NULL, 1))
+    assert v_lt([1, 2], [1, 3]) is True
+    assert v_lt([1], [1, 0]) is True
+
+
+def test_total_order():
+    vals = [NULL, "b", 2, EMPTY, 1.5, "a", True]
+    s = sorted(vals, key=total_order_key)
+    assert s[0] is EMPTY
+    assert s[-1] is NULL
+    assert s[1] is True
+    assert s[2:4] == [1.5, 2]
+    assert s[4:6] == ["a", "b"]
+
+
+def test_date_time_compare():
+    assert v_lt(Date(2020, 1, 1), Date(2020, 1, 2)) is True
+    assert v_eq(Time(1, 2, 3), Time(1, 2, 3)) is True
+    assert v_lt(DateTime(2020, 1, 1), DateTime(2021, 1, 1)) is True
+
+
+def test_date_plus_duration():
+    d = v_add(Date(2020, 1, 31), Duration(months=1))
+    assert d == Date(2020, 2, 29)  # clamped to month end (leap year)
+    d2 = v_add(Date(2020, 1, 1), Duration(seconds=86400))
+    assert d2 == Date(2020, 1, 2)
+
+
+def test_vertex_edge_path():
+    v1 = Vertex("a", [Tag("person", {"name": "Ann", "age": 30})])
+    v2 = Vertex("b", [Tag("person", {"name": "Bob"})])
+    assert v1.prop("person", "age") == 30
+    assert is_null(v1.prop("person", "nope"))
+    e = Edge("a", "b", "knows", 0, {"since": 2010})
+    er = Edge("b", "a", "knows", 0, {"since": 2010}, etype=-1)
+    assert e.key() == er.key()  # direction-insensitive identity
+    p = Path(v1, [Step(v2, "knows", 0, {"since": 2010})])
+    assert p.length() == 1
+    assert [n.vid for n in p.nodes()] == ["a", "b"]
+    assert p.relationships()[0].src == "a"
+    assert not p.has_duplicate_vertices()
+
+
+def test_dataset():
+    ds = DataSet(["a", "b"], [[1, 2], [3, 4]])
+    assert ds.column("b") == [2, 4]
+    assert len(ds) == 2
+    assert type_name(ds) == "dataset"
