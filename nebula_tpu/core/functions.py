@@ -830,6 +830,23 @@ def _now(ctx, args):
     return int(_time.time())
 
 
+# ---- internal helpers used by MATCH planning -------------------------------
+
+
+@register("_hastag")
+def _hastag(ctx, args):
+    v, tag = args[0], args[1]
+    if isinstance(v, Vertex):
+        return tag in v.tag_names()
+    return False
+
+
+@register("_exists")
+def _exists(ctx, args):
+    v = args[0]
+    return not is_null(v) and not is_empty(v)
+
+
 @register("duration")
 def _duration(ctx, args):
     v = args[0]

@@ -1,0 +1,6 @@
+"""Execution layer: executors, scheduler, engine, host algorithms."""
+from .context import (ExecutionContext, QueryContext, ResultSet, RowContext,
+                      row_dict)
+from .engine import QueryEngine, Session, quick_engine
+from .executors import EXECUTORS, ExecError, executor, run_node
+from .scheduler import ProfileStats, Scheduler

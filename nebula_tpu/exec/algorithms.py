@@ -1,0 +1,254 @@
+"""Host graph algorithms: FIND PATH (shortest/all/noloop) + GET SUBGRAPH.
+
+Analog of the reference's algo executors (BFSShortestPathExecutor /
+AllPathsExecutor / SubgraphExecutor; reference: src/graph/executor/algo
+[UNVERIFIED — empty mount, SURVEY §0]).  These are the CPU oracles; the
+device variants (parent-array BFS over sharded CSR) live in nebula_tpu.tpu.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+from ..core.expr import DictContext, Expr, to_bool3
+from ..core.value import DataSet, Edge, Path, Step, Vertex, hashable_key, is_null
+from .context import ExecutionContext, QueryContext, RowContext
+
+
+def _vids_from(a, key_vids, key_ref, ectx: ExecutionContext) -> List[Any]:
+    out: List[Any] = []
+    if a.get(key_ref):
+        ref = a[key_ref]
+        ds = None
+        if ref.startswith("$"):
+            var = ref[1:].split(".")[0]
+            ds = ectx.get_result(f"${var}")
+            ref = ref.split(".")[1]
+        else:
+            # piped input: stored under the plan's input var by the scheduler
+            ds = ectx.get_result(a.get("__input_var", ""))
+        if ds is None or not ds.column_names:
+            return []
+        ci = ds.col_index(ref)
+        out = [r[ci] for r in ds.rows]
+    else:
+        for ve in a.get(key_vids) or []:
+            out.append(ve.eval(DictContext()) if isinstance(ve, Expr) else ve)
+    uniq, seen = [], set()
+    for v in out:
+        if isinstance(v, Vertex):
+            v = v.vid
+        if is_null(v):
+            continue
+        k = hashable_key(v)
+        if k not in seen:
+            seen.add(k)
+            uniq.append(v)
+    return uniq
+
+
+def _neighbors(qctx: QueryContext, space: str, vid: Any, etypes: List[str],
+               direction: str, etype_ids: Dict[str, int],
+               edge_filter: Optional[Expr]):
+    for (s, et, rank, other, props, sd) in qctx.store.get_neighbors(
+            space, [vid], etypes, direction):
+        e = Edge(s, other, et, rank, dict(props),
+                 etype=etype_ids[et] if sd > 0 else -etype_ids[et])
+        if edge_filter is not None:
+            rc = RowContext(qctx, space, {"_src": s, "_edge": e, "_dst": other})
+            if to_bool3(edge_filter.eval(rc)) is not True:
+                continue
+        yield e, other
+
+
+def find_path_host(node, qctx: QueryContext, ectx: ExecutionContext) -> DataSet:
+    a = node.args
+    space = a["space"]
+    etypes = a["edge_types"]
+    etype_ids = {e: qctx.store.catalog.get_edge(space, e).edge_type for e in etypes}
+    direction = a["direction"]
+    upto = a["upto"]
+    kind = a["kind"]
+    filt = a.get("filter")
+    if node.input_vars:
+        a = dict(a)
+        a["__input_var"] = node.input_vars[0]
+    srcs = _vids_from(a, "src_vids", "src_ref", ectx)
+    dsts = _vids_from(a, "dst_vids", "dst_ref", ectx)
+    dst_set = {hashable_key(d) for d in dsts}
+
+    col = node.col_names[0]
+    rows: List[List[Any]] = []
+
+    def mk_vertex(vid):
+        if a.get("with_prop"):
+            v = qctx.build_vertex(space, vid)
+            return v if v is not None else Vertex(vid)
+        return Vertex(vid)
+
+    def path_of(vid_chain: List[Any], edge_chain: List[Edge]) -> Path:
+        p = Path(mk_vertex(vid_chain[0]))
+        for v, e in zip(vid_chain[1:], edge_chain):
+            p.steps.append(Step(mk_vertex(v), e.name, e.ranking, e.props, e.etype))
+        return p
+
+    if kind == "shortest":
+        # level-synchronous BFS per source with multi-parent tracking —
+        # yields ALL shortest paths per (src, dst) pair.
+        for s in srcs:
+            parents: Dict[Any, List[Tuple[Any, Edge]]] = {}
+            depth: Dict[Any, int] = {hashable_key(s): 0}
+            frontier = [s]
+            found_at: Dict[Any, int] = {}
+            for level in range(1, upto + 1):
+                nxt: List[Any] = []
+                nxt_seen: Set = set()
+                for u in frontier:
+                    for e, w in _neighbors(qctx, space, u, etypes, direction,
+                                           etype_ids, filt):
+                        kw = hashable_key(w)
+                        if kw in depth and depth[kw] < level:
+                            continue
+                        if kw not in depth:
+                            depth[kw] = level
+                        if depth[kw] == level:
+                            parents.setdefault(kw, []).append((u, e))
+                            if kw not in nxt_seen:
+                                nxt_seen.add(kw)
+                                nxt.append(w)
+                        if kw in dst_set and kw not in found_at:
+                            found_at[kw] = level
+                frontier = nxt
+                if not frontier:
+                    break
+
+            def all_paths_to(vid, kv) -> List[Tuple[List[Any], List[Edge]]]:
+                if depth.get(kv, -1) == 0:
+                    return [([vid], [])]
+                out = []
+                for (u, e) in parents.get(kv, []):
+                    for (vc, ec) in all_paths_to(u, hashable_key(u)):
+                        out.append((vc + [vid], ec + [e]))
+                return out
+
+            for d in dsts:
+                kd = hashable_key(d)
+                if hashable_key(s) == kd:
+                    continue
+                if kd in found_at:
+                    for (vc, ec) in all_paths_to(d, kd):
+                        rows.append([path_of(vc, ec)])
+    else:
+        noloop = kind == "noloop"
+        for s in srcs:
+            stack: List[Tuple[Any, List[Any], List[Edge], Set]] = [
+                (s, [s], [], set())]
+            while stack:
+                cur, vchain, echain, eseen = stack.pop()
+                if len(echain) >= upto:
+                    continue
+                for e, w in _neighbors(qctx, space, cur, etypes, direction,
+                                       etype_ids, filt):
+                    ek = e.key()
+                    if ek in eseen:
+                        continue
+                    if noloop and any(hashable_key(w) == hashable_key(v)
+                                      for v in vchain):
+                        continue
+                    nvc, nec = vchain + [w], echain + [e]
+                    if hashable_key(w) in dst_set:
+                        rows.append([path_of(nvc, nec)])
+                    stack.append((w, nvc, nec, eseen | {ek}))
+    rows.sort(key=lambda r: (r[0].length(),
+                             [str(v.vid) for v in r[0].nodes()]))
+    return DataSet([col], rows)
+
+
+def subgraph_host(node, qctx: QueryContext, ectx: ExecutionContext) -> DataSet:
+    a = node.args
+    space = a["space"]
+    cat = qctx.store.catalog
+    if node.input_vars:
+        a = dict(a)
+        a["__input_var"] = node.input_vars[0]
+    starts = _vids_from(a, "vids", "src_ref", ectx)
+    steps = a["steps"]
+    filt = a.get("filter")
+
+    specs: List[Tuple[str, str]] = []   # (etype, direction)
+    for e in a.get("out_edges") or []:
+        specs.append((e, "out"))
+    for e in a.get("in_edges") or []:
+        specs.append((e, "in"))
+    for e in a.get("both_edges") or []:
+        specs.append((e, "both"))
+    etype_ids = {e: cat.get_edge(space, e).edge_type for e, _ in specs}
+
+    def mk_vertex(vid):
+        if a.get("with_prop"):
+            v = qctx.build_vertex(space, vid)
+            return v if v is not None else Vertex(vid)
+        return Vertex(vid)
+
+    visited: Set = {hashable_key(s) for s in starts}
+    frontier = list(starts)
+    level_vertices: List[List[Any]] = [[mk_vertex(s) for s in starts]]
+    level_edges: List[List[Edge]] = []
+    seen_edges: Set = set()
+
+    for step in range(steps):
+        nxt, nxt_seen = [], set()
+        edges_here: List[Edge] = []
+        for u in frontier:
+            for et, d in specs:
+                for e, w in _neighbors(qctx, space, u, [et], d,
+                                       {et: etype_ids[et]}, filt):
+                    if e.key() in seen_edges:
+                        continue
+                    seen_edges.add(e.key())
+                    edges_here.append(e)
+                    kw = hashable_key(w)
+                    if kw not in visited:
+                        visited.add(kw)
+                        if kw not in nxt_seen:
+                            nxt_seen.add(kw)
+                            nxt.append(w)
+        level_edges.append(edges_here)
+        frontier = nxt
+        level_vertices.append([mk_vertex(v) for v in nxt])
+        if not frontier:
+            break
+
+    # final round: edges among the last-level vertices (reference behavior:
+    # the subgraph includes edges between step-N vertices)
+    edges_final: List[Edge] = []
+    last_set = {hashable_key(v) for lvl in level_vertices for v in
+                [x.vid for x in lvl]}
+    for u in frontier:
+        for et, d in specs:
+            for e, w in _neighbors(qctx, space, u, [et], d,
+                                   {et: etype_ids[et]}, filt):
+                if e.key() in seen_edges:
+                    continue
+                if hashable_key(w) in last_set:
+                    seen_edges.add(e.key())
+                    edges_final.append(e)
+    if edges_final:
+        if len(level_edges) >= steps:
+            level_edges.append(edges_final)
+        else:
+            level_edges[-1].extend(edges_final)
+
+    yield_spec = a.get("yield") or ["vertices", "edges"]
+    cols = node.col_names
+    rows = []
+    n_levels = max(len(level_vertices), len(level_edges))
+    for i in range(n_levels):
+        vs = level_vertices[i] if i < len(level_vertices) else []
+        es = level_edges[i] if i < len(level_edges) else []
+        if not vs and not es:
+            continue
+        row = []
+        for spec in yield_spec:
+            row.append(vs if spec == "vertices" else es)
+        rows.append(row)
+    return DataSet(list(cols), rows)

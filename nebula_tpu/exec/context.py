@@ -1,0 +1,203 @@
+"""Query/Execution contexts + row evaluation contexts.
+
+Analog of the reference's QueryContext / ExecutionContext / Iterator
+hierarchy (reference: src/graph/context [UNVERIFIED — empty mount,
+SURVEY §0]).  Results are named, versioned DataSets; row contexts adapt a
+row of a given shape (GO row, MATCH row, FETCH row) to the ExprContext
+protocol.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+from ..core.expr import ExprContext, get_attribute
+from ..core.value import (NULL, NULL_BAD_TYPE, NULL_UNKNOWN_PROP, DataSet,
+                          Edge, Tag, Vertex, is_null)
+from ..graphstore.store import GraphStore
+
+
+class QueryContext:
+    """Per-engine context: store + catalog access, limits, metrics."""
+
+    def __init__(self, store: GraphStore, params: Optional[Dict[str, Any]] = None):
+        self.store = store
+        self.params = params or {}
+        self.max_match_hops = int(self.params.get("max_match_hops", 12))
+        self.tpu_runtime = None     # set by nebula_tpu.tpu when pinned
+
+    @property
+    def catalog(self):
+        return self.store.catalog
+
+    def build_vertex(self, space: str, vid: Any,
+                     tags: Optional[List[str]] = None) -> Optional[Vertex]:
+        tv = self.store.get_vertex(space, vid)
+        if tv is None:
+            return None
+        out = []
+        for t, props in sorted(tv.items()):
+            if tags and t not in tags:
+                continue
+            out.append(Tag(t, props))
+        if tags and not out:
+            return None
+        return Vertex(vid, out)
+
+
+class ExecutionContext:
+    """var name → list of DataSet versions (latest last)."""
+
+    def __init__(self):
+        self.results: Dict[str, List[DataSet]] = {}
+        self.values: Dict[str, Any] = {}
+
+    def set_result(self, var: str, ds: DataSet):
+        self.results.setdefault(var, []).append(ds)
+
+    def get_result(self, var: str) -> DataSet:
+        lst = self.results.get(var)
+        if not lst:
+            return DataSet()
+        return lst[-1]
+
+    def has(self, var: str) -> bool:
+        return var in self.results
+
+
+class RowContext(ExprContext):
+    """Adapts one result row to expression evaluation.
+
+    row: dict col_name → value.  Conventions:
+      _src/_edge/_dst cols (GO rows) enable $^ / edge / $$ resolution with
+      vertex props looked up lazily from the store.
+    """
+
+    __slots__ = ("qctx", "space", "row", "extra_vars")
+
+    def __init__(self, qctx: Optional[QueryContext], space: Optional[str],
+                 row: Dict[str, Any], extra_vars: Optional[Dict[str, Any]] = None):
+        self.qctx = qctx
+        self.space = space
+        self.row = row
+        self.extra_vars = extra_vars or {}
+
+    def get_input_prop(self, name):
+        if name in self.row:
+            return self.row[name]
+        return NULL_UNKNOWN_PROP
+
+    def get_var(self, name):
+        if name in self.row:
+            return self.row[name]
+        if name in self.extra_vars:
+            return self.extra_vars[name]
+        return NULL_UNKNOWN_PROP
+
+    def get_var_prop(self, var, name):
+        v = self.get_var(var)
+        if not is_null(v):
+            return get_attribute(v, name)
+        return NULL_UNKNOWN_PROP
+
+    def _vertex_props(self, vid, tag):
+        if self.qctx is None or self.space is None or vid is None:
+            return {}
+        tv = self.qctx.store.get_vertex(self.space, vid)
+        if tv is None:
+            return {}
+        return tv.get(tag, {})
+
+    def get_src_prop(self, tag, name):
+        src = self.row.get("_src")
+        if isinstance(src, Vertex):
+            return src.prop(tag, name)
+        props = self._vertex_props(src, tag)
+        return props.get(name, NULL_UNKNOWN_PROP)
+
+    def get_dst_prop(self, tag, name):
+        dst = self.row.get("_dst")
+        if isinstance(dst, Vertex):
+            return dst.prop(tag, name)
+        props = self._vertex_props(dst, tag)
+        return props.get(name, NULL_UNKNOWN_PROP)
+
+    def get_edge_prop(self, edge, name):
+        e = self.row.get("_edge")
+        if isinstance(e, Edge):
+            if name == "_src":
+                return e.src if e.etype >= 0 else e.dst
+            if name == "_dst":
+                return e.dst if e.etype >= 0 else e.src
+            if name == "_rank":
+                return e.ranking
+            if name == "_type":
+                return e.name
+            return e.props.get(name, NULL_UNKNOWN_PROP)
+        return NULL_UNKNOWN_PROP
+
+    def get_vertex(self, which=""):
+        if which == "$$":
+            dst = self.row.get("_dst")
+            if isinstance(dst, Vertex):
+                return dst
+            if dst is not None and self.qctx is not None and self.space:
+                v = self.qctx.build_vertex(self.space, dst)
+                return v if v is not None else Vertex(dst)
+            return NULL_BAD_TYPE
+        if which in ("$^", ""):
+            src = self.row.get("_src")
+            if isinstance(src, Vertex):
+                return src
+            if src is not None and self.qctx is not None and self.space:
+                v = self.qctx.build_vertex(self.space, src)
+                return v if v is not None else Vertex(src)
+        # FETCH rows: a single vertex value column
+        v = self.row.get("vertices_")
+        if isinstance(v, Vertex):
+            return v
+        v = self.row.get("_matched")
+        if isinstance(v, Vertex):
+            return v
+        return NULL_BAD_TYPE
+
+    def get_edge(self):
+        e = self.row.get("_edge")
+        if isinstance(e, Edge):
+            return e
+        e = self.row.get("edges_")
+        if isinstance(e, Edge):
+            return e
+        e = self.row.get("_matched")
+        if isinstance(e, Edge):
+            return e
+        return NULL_BAD_TYPE
+
+
+def row_dict(ds: DataSet, row: List[Any]) -> Dict[str, Any]:
+    return dict(zip(ds.column_names, row))
+
+
+class ResultSet:
+    """What a statement returns to the client."""
+
+    __slots__ = ("data", "space", "latency_us", "plan_desc", "error", "comment")
+
+    def __init__(self, data: Optional[DataSet] = None, space: Optional[str] = None,
+                 latency_us: int = 0, plan_desc: Optional[str] = None,
+                 error: Optional[str] = None, comment: str = ""):
+        self.data = data if data is not None else DataSet()
+        self.space = space
+        self.latency_us = latency_us
+        self.plan_desc = plan_desc
+        self.error = error
+        self.comment = comment
+
+    @property
+    def ok(self) -> bool:
+        return self.error is None
+
+    def __repr__(self):
+        if self.error:
+            return f"ERROR: {self.error}"
+        return repr(self.data)

@@ -1,0 +1,120 @@
+"""QueryEngine + Session: the statement lifecycle.
+
+Analog of the reference's QueryInstance (parse → validate → plan →
+optimize → schedule → respond; reference: src/graph/service
+[UNVERIFIED — empty mount, SURVEY §0]), in-process form.  The cluster
+graphd (nebula_tpu.cluster.graph) wraps this with auth/RPC/session
+registry.
+"""
+from __future__ import annotations
+
+import itertools
+import time
+from typing import Any, Dict, Optional
+
+from ..core.value import DataSet
+from ..graphstore.store import GraphStore
+from ..query import ast as A
+from ..query.optimizer import optimize
+from ..query.parser import ParseError, parse
+from ..query.planner import PlannerContext, QueryError, plan_statement
+from .context import ExecutionContext, QueryContext, ResultSet
+from .scheduler import ProfileStats, Scheduler
+
+_session_ids = itertools.count(1)
+
+
+class Session:
+    def __init__(self, user: str = "root"):
+        self.id = next(_session_ids)
+        self.user = user
+        self.space: Optional[str] = None
+        self.ectx = ExecutionContext()       # persists $var results
+        self.var_cols: Dict[str, list] = {}
+        self.created = time.time()
+        self.last_used = self.created
+        self.queries: Dict[int, str] = {}
+
+
+class QueryEngine:
+    """parse → plan → optimize → schedule, one call."""
+
+    def __init__(self, store: Optional[GraphStore] = None,
+                 params: Optional[Dict[str, Any]] = None,
+                 enable_optimizer: bool = True):
+        self.store = store if store is not None else GraphStore()
+        self.qctx = QueryContext(self.store, params)
+        self.scheduler = Scheduler(self.qctx)
+        self.enable_optimizer = enable_optimizer
+        self.slow_query_us = int((params or {}).get("slow_query_threshold_us",
+                                                    500_000))
+        self.slow_log: list = []
+
+    def new_session(self, user: str = "root") -> Session:
+        return Session(user)
+
+    def execute(self, session: Session, text: str,
+                params: Optional[Dict[str, Any]] = None) -> ResultSet:
+        t0 = time.perf_counter()
+        session.last_used = time.time()
+        try:
+            stmt = parse(text)
+        except ParseError as ex:
+            return ResultSet(error=f"SyntaxError: {ex}")
+
+        profile_stats: Optional[ProfileStats] = None
+        explain_only = False
+        if isinstance(stmt, A.ExplainSentence):
+            if stmt.profile:
+                profile_stats = ProfileStats()
+            else:
+                explain_only = True
+            inner = stmt.stmt
+        else:
+            inner = stmt
+
+        try:
+            pctx = PlannerContext(self.qctx, session.space)
+            pctx.var_cols.update(session.var_cols)
+            from ..query.planner import _plan
+            root = _plan(pctx, inner)
+            from ..query.plan import ExecutionPlan
+            plan = ExecutionPlan(root, pctx.space)
+            plan = optimize(plan, enable=self.enable_optimizer)
+        except QueryError as ex:
+            return ResultSet(error=f"SemanticError: {ex}")
+
+        if explain_only:
+            us = int((time.perf_counter() - t0) * 1e6)
+            return ResultSet(DataSet(["plan"], [[plan.describe()]]),
+                             space=plan.space, latency_us=us,
+                             plan_desc=plan.describe())
+        # Per-statement ExecutionContext seeded with the session's $vars —
+        # intermediates die with the statement; only $var results persist.
+        stmt_ectx = ExecutionContext()
+        stmt_ectx.results.update({k: v for k, v in session.ectx.results.items()
+                                  if k.startswith("$")})
+        try:
+            data = self.scheduler.run(plan, stmt_ectx, profile_stats)
+        except Exception as ex:  # noqa: BLE001 — runtime errors go to client
+            return ResultSet(error=f"ExecutionError: {ex}", space=plan.space)
+        session.ectx.results.update({k: v for k, v in stmt_ectx.results.items()
+                                     if k.startswith("$")})
+
+        session.space = plan.space
+        session.var_cols.update(pctx.var_cols)
+        us = int((time.perf_counter() - t0) * 1e6)
+        if us > self.slow_query_us:
+            self.slow_log.append({"stmt": text, "latency_us": us,
+                                  "ts": time.time()})
+        plan_desc = None
+        if profile_stats is not None:
+            plan_desc = profile_stats.describe(plan)
+            data = DataSet(["plan"], [[plan_desc]])
+        return ResultSet(data, space=plan.space, latency_us=us,
+                         plan_desc=plan_desc)
+
+
+def quick_engine() -> "tuple[QueryEngine, Session]":
+    eng = QueryEngine()
+    return eng, eng.new_session()

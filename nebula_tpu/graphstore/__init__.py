@@ -1,0 +1,8 @@
+"""Host storage plane: schema catalog, partitioned store, CSR snapshots."""
+from .schema import (Catalog, EdgeSchema, IndexDesc, PropDef, PropType,
+                     SchemaError, SchemaVersion, SpaceDesc, TagSchema,
+                     apply_defaults, check_type)
+from .store import GraphStore, Partition, SpaceData, StoreError, stable_vid_hash
+from .csr import (CODE_NULL, INT_NULL, CsrBlock, CsrSnapshot, StringPool,
+                  TagTable, build_snapshot, encode_prop,
+                  expand_frontier_host, neighbors_of)

@@ -1,0 +1,367 @@
+"""Schema catalog: spaces, tags, edge types — versioned, like the
+reference's meta schema processors (reference: src/meta/processors/schema/
++ src/common/meta [UNVERIFIED — empty mount, SURVEY §0]).
+
+A Space is the top container (graph + partition count + vid type).  Tags and
+edge types carry typed, defaultable, nullable, TTL-able property columns and
+are versioned: altering a schema appends a new version; rows remember the
+version they were written with.
+"""
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Any, Dict, List, Optional
+
+from ..core.value import (NULL, Date, DateTime, Duration, Time, is_null)
+
+
+class PropType(Enum):
+    BOOL = "bool"
+    INT64 = "int64"
+    INT32 = "int32"
+    INT16 = "int16"
+    INT8 = "int8"
+    FLOAT = "float"
+    DOUBLE = "double"
+    STRING = "string"
+    FIXED_STRING = "fixed_string"
+    TIMESTAMP = "timestamp"
+    DATE = "date"
+    TIME = "time"
+    DATETIME = "datetime"
+    DURATION = "duration"
+    GEOGRAPHY = "geography"
+
+    @classmethod
+    def parse(cls, s: str) -> "PropType":
+        s = s.strip().lower()
+        alias = {"int": "int64", "integer": "int64", "str": "string"}
+        s = alias.get(s, s)
+        if s.startswith("fixed_string"):
+            return cls.FIXED_STRING
+        return cls(s)
+
+
+_INT_TYPES = (PropType.INT64, PropType.INT32, PropType.INT16, PropType.INT8,
+              PropType.TIMESTAMP)
+_FLOAT_TYPES = (PropType.FLOAT, PropType.DOUBLE)
+
+
+def check_type(t: PropType, v: Any) -> bool:
+    if is_null(v):
+        return True
+    if t == PropType.BOOL:
+        return isinstance(v, bool)
+    if t in _INT_TYPES:
+        return isinstance(v, int) and not isinstance(v, bool)
+    if t in _FLOAT_TYPES:
+        return isinstance(v, (int, float)) and not isinstance(v, bool)
+    if t in (PropType.STRING, PropType.FIXED_STRING):
+        return isinstance(v, str)
+    if t == PropType.DATE:
+        return isinstance(v, Date)
+    if t == PropType.TIME:
+        return isinstance(v, Time)
+    if t == PropType.DATETIME:
+        return isinstance(v, DateTime)
+    if t == PropType.DURATION:
+        return isinstance(v, Duration)
+    return True
+
+
+def coerce(t: PropType, v: Any) -> Any:
+    """Insert-time coercion (int→float for double columns)."""
+    if is_null(v):
+        return v
+    if t in _FLOAT_TYPES and isinstance(v, int) and not isinstance(v, bool):
+        return float(v)
+    return v
+
+
+@dataclass
+class PropDef:
+    name: str
+    ptype: PropType
+    nullable: bool = True
+    default: Any = None          # None = no default
+    has_default: bool = False
+    fixed_len: int = 0           # FIXED_STRING length
+    comment: str = ""
+
+    def to_dict(self):
+        return {"name": self.name, "type": self.ptype.value,
+                "nullable": self.nullable, "default": self.default,
+                "has_default": self.has_default, "fixed_len": self.fixed_len}
+
+
+@dataclass
+class SchemaVersion:
+    version: int
+    props: List[PropDef]
+    ttl_col: str = ""
+    ttl_duration: int = 0
+
+    def prop(self, name: str) -> Optional[PropDef]:
+        for p in self.props:
+            if p.name == name:
+                return p
+        return None
+
+    def prop_names(self) -> List[str]:
+        return [p.name for p in self.props]
+
+
+@dataclass
+class TagSchema:
+    name: str
+    tag_id: int
+    versions: List[SchemaVersion] = field(default_factory=list)
+
+    @property
+    def latest(self) -> SchemaVersion:
+        return self.versions[-1]
+
+
+@dataclass
+class EdgeSchema:
+    name: str
+    edge_type: int               # positive id; -id is the reversed direction
+    versions: List[SchemaVersion] = field(default_factory=list)
+
+    @property
+    def latest(self) -> SchemaVersion:
+        return self.versions[-1]
+
+
+class SchemaError(Exception):
+    pass
+
+
+@dataclass
+class SpaceDesc:
+    name: str
+    space_id: int
+    partition_num: int = 8
+    replica_factor: int = 1
+    vid_type: str = "FIXED_STRING(32)"  # or "INT64"
+    comment: str = ""
+
+    def vid_is_int(self) -> bool:
+        return self.vid_type.strip().upper().startswith("INT")
+
+
+class Catalog:
+    """Space/tag/edge catalog — the metad schema plane, single-process form.
+
+    The cluster metad (nebula_tpu.cluster.meta) wraps this with Raft +
+    heartbeat distribution; executors always read through this interface.
+    """
+
+    def __init__(self):
+        self.spaces: Dict[str, SpaceDesc] = {}
+        self._tags: Dict[int, Dict[str, TagSchema]] = {}      # space_id →
+        self._edges: Dict[int, Dict[str, EdgeSchema]] = {}
+        self._indexes: Dict[int, Dict[str, "IndexDesc"]] = {}
+        self._next_space = 1
+        self._next_schema_id: Dict[int, int] = {}
+        self.version = 0   # bumped on every DDL; clients use it for cache TTL
+
+    # -- spaces --
+    def create_space(self, name: str, partition_num=8, replica_factor=1,
+                     vid_type="FIXED_STRING(32)", if_not_exists=False) -> SpaceDesc:
+        if name in self.spaces:
+            if if_not_exists:
+                return self.spaces[name]
+            raise SchemaError(f"space `{name}' already exists")
+        sp = SpaceDesc(name, self._next_space, partition_num, replica_factor, vid_type)
+        self._next_space += 1
+        self.spaces[name] = sp
+        self._tags[sp.space_id] = {}
+        self._edges[sp.space_id] = {}
+        self._indexes[sp.space_id] = {}
+        self._next_schema_id[sp.space_id] = 2  # 1 reserved
+        self.version += 1
+        return sp
+
+    def drop_space(self, name: str, if_exists=False) -> Optional[SpaceDesc]:
+        sp = self.spaces.pop(name, None)
+        if sp is None:
+            if if_exists:
+                return None
+            raise SchemaError(f"space `{name}' not found")
+        self._tags.pop(sp.space_id, None)
+        self._edges.pop(sp.space_id, None)
+        self._indexes.pop(sp.space_id, None)
+        self.version += 1
+        return sp
+
+    def get_space(self, name: str) -> SpaceDesc:
+        sp = self.spaces.get(name)
+        if sp is None:
+            raise SchemaError(f"space `{name}' not found")
+        return sp
+
+    # -- tags / edges --
+    def _alloc_id(self, space_id: int) -> int:
+        i = self._next_schema_id[space_id]
+        self._next_schema_id[space_id] = i + 1
+        return i
+
+    def create_tag(self, space: str, name: str, props: List[PropDef],
+                   if_not_exists=False, ttl_col="", ttl_duration=0) -> TagSchema:
+        sp = self.get_space(space)
+        tags = self._tags[sp.space_id]
+        if name in tags:
+            if if_not_exists:
+                return tags[name]
+            raise SchemaError(f"tag `{name}' already exists")
+        if name in self._edges[sp.space_id]:
+            raise SchemaError(f"`{name}' conflicts with an edge type")
+        t = TagSchema(name, self._alloc_id(sp.space_id),
+                      [SchemaVersion(0, props, ttl_col, ttl_duration)])
+        tags[name] = t
+        self.version += 1
+        return t
+
+    def create_edge(self, space: str, name: str, props: List[PropDef],
+                    if_not_exists=False, ttl_col="", ttl_duration=0) -> EdgeSchema:
+        sp = self.get_space(space)
+        edges = self._edges[sp.space_id]
+        if name in edges:
+            if if_not_exists:
+                return edges[name]
+            raise SchemaError(f"edge `{name}' already exists")
+        if name in self._tags[sp.space_id]:
+            raise SchemaError(f"`{name}' conflicts with a tag")
+        e = EdgeSchema(name, self._alloc_id(sp.space_id),
+                       [SchemaVersion(0, props, ttl_col, ttl_duration)])
+        edges[name] = e
+        self.version += 1
+        return e
+
+    def alter_tag(self, space: str, name: str, props: List[PropDef],
+                  ttl_col=None, ttl_duration=None) -> TagSchema:
+        t = self.get_tag(space, name)
+        last = t.latest
+        t.versions.append(SchemaVersion(
+            last.version + 1, props,
+            last.ttl_col if ttl_col is None else ttl_col,
+            last.ttl_duration if ttl_duration is None else ttl_duration))
+        self.version += 1
+        return t
+
+    def alter_edge(self, space: str, name: str, props: List[PropDef],
+                   ttl_col=None, ttl_duration=None) -> EdgeSchema:
+        e = self.get_edge(space, name)
+        last = e.latest
+        e.versions.append(SchemaVersion(
+            last.version + 1, props,
+            last.ttl_col if ttl_col is None else ttl_col,
+            last.ttl_duration if ttl_duration is None else ttl_duration))
+        self.version += 1
+        return e
+
+    def drop_tag(self, space: str, name: str, if_exists=False):
+        sp = self.get_space(space)
+        if self._tags[sp.space_id].pop(name, None) is None and not if_exists:
+            raise SchemaError(f"tag `{name}' not found")
+        self.version += 1
+
+    def drop_edge(self, space: str, name: str, if_exists=False):
+        sp = self.get_space(space)
+        if self._edges[sp.space_id].pop(name, None) is None and not if_exists:
+            raise SchemaError(f"edge `{name}' not found")
+        self.version += 1
+
+    def get_tag(self, space: str, name: str) -> TagSchema:
+        sp = self.get_space(space)
+        t = self._tags[sp.space_id].get(name)
+        if t is None:
+            raise SchemaError(f"tag `{name}' not found in space `{space}'")
+        return t
+
+    def get_edge(self, space: str, name: str) -> EdgeSchema:
+        sp = self.get_space(space)
+        e = self._edges[sp.space_id].get(name)
+        if e is None:
+            raise SchemaError(f"edge `{name}' not found in space `{space}'")
+        return e
+
+    def tags(self, space: str) -> List[TagSchema]:
+        return list(self._tags[self.get_space(space).space_id].values())
+
+    def edges(self, space: str) -> List[EdgeSchema]:
+        return list(self._edges[self.get_space(space).space_id].values())
+
+    def edge_by_type(self, space: str, etype: int) -> EdgeSchema:
+        for e in self.edges(space):
+            if e.edge_type == abs(etype):
+                return e
+        raise SchemaError(f"edge type {etype} not found")
+
+    # -- secondary indexes --
+    def create_index(self, space: str, index_name: str, schema_name: str,
+                     fields: List[str], is_edge: bool, if_not_exists=False) -> "IndexDesc":
+        sp = self.get_space(space)
+        idxs = self._indexes[sp.space_id]
+        if index_name in idxs:
+            if if_not_exists:
+                return idxs[index_name]
+            raise SchemaError(f"index `{index_name}' already exists")
+        # validate target schema + fields exist
+        schema = (self.get_edge(space, schema_name) if is_edge
+                  else self.get_tag(space, schema_name))
+        for f in fields:
+            if schema.latest.prop(f) is None:
+                raise SchemaError(f"prop `{f}' not in `{schema_name}'")
+        d = IndexDesc(index_name, schema_name, list(fields), is_edge)
+        idxs[index_name] = d
+        self.version += 1
+        return d
+
+    def drop_index(self, space: str, index_name: str, if_exists=False):
+        sp = self.get_space(space)
+        if self._indexes[sp.space_id].pop(index_name, None) is None and not if_exists:
+            raise SchemaError(f"index `{index_name}' not found")
+        self.version += 1
+
+    def indexes(self, space: str) -> List["IndexDesc"]:
+        return list(self._indexes[self.get_space(space).space_id].values())
+
+    def indexes_for(self, space: str, schema_name: str, is_edge: bool) -> List["IndexDesc"]:
+        return [d for d in self.indexes(space)
+                if d.schema_name == schema_name and d.is_edge == is_edge]
+
+
+@dataclass
+class IndexDesc:
+    name: str
+    schema_name: str
+    fields: List[str]
+    is_edge: bool
+
+
+def apply_defaults(sv: SchemaVersion, props: Dict[str, Any],
+                   insert_names: Optional[List[str]] = None) -> Dict[str, Any]:
+    """Fill defaults / validate nullability for an insert row."""
+    out: Dict[str, Any] = {}
+    for p in sv.props:
+        if p.name in props:
+            v = coerce(p.ptype, props[p.name])
+            if not check_type(p.ptype, v):
+                raise SchemaError(
+                    f"prop `{p.name}' expects {p.ptype.value}, got {type(v).__name__}")
+            out[p.name] = v
+        elif p.has_default:
+            out[p.name] = coerce(p.ptype, p.default)
+        elif p.nullable:
+            out[p.name] = NULL
+        else:
+            raise SchemaError(f"prop `{p.name}' is NOT NULL and has no default")
+    if insert_names:
+        for n in insert_names:
+            if sv.prop(n) is None:
+                raise SchemaError(f"unknown prop `{n}'")
+    return out

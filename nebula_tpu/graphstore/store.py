@@ -1,0 +1,330 @@
+"""Partitioned host graph store — the storaged data plane, in-process form.
+
+Redesign of the reference's storage stack (NebulaStore/RocksEngine +
+query/mutate processors; reference: src/kvstore + src/storage [UNVERIFIED —
+empty mount, SURVEY §0]) for the TPU-first architecture:
+
+  * The graph is hash-partitioned by VID into ``partition_num`` parts
+    (reference: part map in metad + NebulaKeyUtils key prefixes).
+  * Each part keeps vertices and both edge directions in host dicts — the
+    mutable, source-of-truth plane (the RocksDB analog; pluggable to a
+    persistent KV in cluster mode).
+  * Every vid gets a *dense id* encoding its partition: the i-th vid of
+    part p gets ``dense = i * P + p`` so ``owner(dense) == dense % P`` is a
+    single cheap op on device — this replaces the reference's
+    hash-route-to-leader logic with arithmetic the TPU can do inline.
+  * Mutations bump an epoch; device CSR snapshots are epoch-tagged derived
+    data (see csr.py) — the serving copy the hot path reads.
+
+Edge identity follows the reference: (src, edge_type, rank, dst); an edge is
+written to the src part (out-direction) and dst part (in-direction), the
+TOSS chain-write analog (single-process: both writes in one call).
+"""
+from __future__ import annotations
+
+import hashlib
+import threading
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+from ..core.value import NULL, is_null
+from .schema import (Catalog, EdgeSchema, PropDef, SchemaError, SpaceDesc,
+                     TagSchema, apply_defaults)
+
+
+def stable_vid_hash(vid: Any) -> int:
+    """Process-independent hash used for partitioning (NOT Python hash())."""
+    if isinstance(vid, int):
+        return vid & 0x7FFFFFFFFFFFFFFF
+    if isinstance(vid, str):
+        return int.from_bytes(hashlib.md5(vid.encode()).digest()[:8], "little") & 0x7FFFFFFFFFFFFFFF
+    raise TypeError(f"unsupported vid type {type(vid).__name__}")
+
+
+class Partition:
+    """One shard: vertices + out/in adjacency, dict-backed."""
+
+    __slots__ = ("part_id", "vertices", "out_edges", "in_edges")
+
+    def __init__(self, part_id: int):
+        self.part_id = part_id
+        # vid → {tag_name: (schema_version, {prop: value})}
+        self.vertices: Dict[Any, Dict[str, Tuple[int, Dict[str, Any]]]] = {}
+        # src_vid → {etype_name: {(rank, dst): {prop: value}}}
+        self.out_edges: Dict[Any, Dict[str, Dict[Tuple[int, Any], Dict[str, Any]]]] = {}
+        # dst_vid → {etype_name: {(rank, src): {prop: value}}}
+        self.in_edges: Dict[Any, Dict[str, Dict[Tuple[int, Any], Dict[str, Any]]]] = {}
+
+    def edge_count(self) -> int:
+        return sum(len(m) for per in self.out_edges.values() for m in per.values())
+
+
+class SpaceData:
+    """All partitions + vid dictionary of one space."""
+
+    def __init__(self, desc: SpaceDesc):
+        self.desc = desc
+        self.parts = [Partition(p) for p in range(desc.partition_num)]
+        self.vid_to_dense: Dict[Any, int] = {}
+        self.dense_to_vid: List[Any] = []
+        self.part_counts = [0] * desc.partition_num
+        self.epoch = 0
+        self.lock = threading.RLock()
+
+    @property
+    def num_parts(self) -> int:
+        return self.desc.partition_num
+
+    def part_of(self, vid: Any) -> int:
+        return stable_vid_hash(vid) % self.num_parts
+
+    def dense_id(self, vid: Any, create: bool = False) -> int:
+        d = self.vid_to_dense.get(vid)
+        if d is not None:
+            return d
+        if not create:
+            return -1
+        p = self.part_of(vid)
+        d = self.part_counts[p] * self.num_parts + p
+        self.part_counts[p] += 1
+        self.vid_to_dense[vid] = d
+        # dense ids are not contiguous globally; keep a map-backed list
+        need = d + 1 - len(self.dense_to_vid)
+        if need > 0:
+            self.dense_to_vid.extend([None] * need)
+        self.dense_to_vid[d] = vid
+        return d
+
+    def vid_of_dense(self, dense: int) -> Any:
+        if 0 <= dense < len(self.dense_to_vid):
+            return self.dense_to_vid[dense]
+        return None
+
+
+class StoreError(Exception):
+    pass
+
+
+class GraphStore:
+    """The single-process storage service: catalog + all spaces' data.
+
+    Mirrors the operation set of storage.thrift (getNeighbors, getProps,
+    scanVertex/scanEdge, addVertices/addEdges, delete/update) — SURVEY §2
+    row 12/13 — as Python methods; the cluster storaged wraps this per-host.
+    """
+
+    def __init__(self, catalog: Optional[Catalog] = None):
+        self.catalog = catalog or Catalog()
+        self.data: Dict[int, SpaceData] = {}
+
+    # ---- space lifecycle ----
+    def create_space(self, name: str, **kw) -> SpaceDesc:
+        sp = self.catalog.create_space(name, **kw)
+        if sp.space_id not in self.data:
+            self.data[sp.space_id] = SpaceData(sp)
+        return sp
+
+    def drop_space(self, name: str, if_exists=False):
+        sp = self.catalog.drop_space(name, if_exists=if_exists)
+        if sp is not None:
+            self.data.pop(sp.space_id, None)
+
+    def space(self, name: str) -> SpaceData:
+        sp = self.catalog.get_space(name)
+        sd = self.data.get(sp.space_id)
+        if sd is None:
+            sd = self.data[sp.space_id] = SpaceData(sp)
+        return sd
+
+    # ---- mutate ----
+    def insert_vertex(self, space: str, vid: Any, tag: str,
+                      props: Dict[str, Any], insert_names: Optional[List[str]] = None):
+        sd = self.space(space)
+        ts = self.catalog.get_tag(space, tag)
+        sv = ts.latest
+        row = apply_defaults(sv, props, insert_names)
+        with sd.lock:
+            p = sd.parts[sd.part_of(vid)]
+            sd.dense_id(vid, create=True)
+            p.vertices.setdefault(vid, {})[tag] = (sv.version, row)
+            sd.epoch += 1
+
+    def insert_edge(self, space: str, src: Any, etype: str, dst: Any,
+                    rank: int, props: Dict[str, Any],
+                    insert_names: Optional[List[str]] = None):
+        sd = self.space(space)
+        es = self.catalog.get_edge(space, etype)
+        sv = es.latest
+        row = apply_defaults(sv, props, insert_names)
+        with sd.lock:
+            sd.dense_id(src, create=True)
+            sd.dense_id(dst, create=True)
+            # out-edge on src part, in-edge on dst part (TOSS chain analog)
+            po = sd.parts[sd.part_of(src)]
+            po.out_edges.setdefault(src, {}).setdefault(etype, {})[(rank, dst)] = row
+            pi = sd.parts[sd.part_of(dst)]
+            pi.in_edges.setdefault(dst, {}).setdefault(etype, {})[(rank, src)] = row
+            sd.epoch += 1
+
+    def delete_vertex(self, space: str, vid: Any, with_edges: bool = True):
+        sd = self.space(space)
+        with sd.lock:
+            p = sd.parts[sd.part_of(vid)]
+            p.vertices.pop(vid, None)
+            if with_edges:
+                out = p.out_edges.pop(vid, {})
+                for etype, em in out.items():
+                    for (rank, dst) in list(em):
+                        pd = sd.parts[sd.part_of(dst)]
+                        pd.in_edges.get(dst, {}).get(etype, {}).pop((rank, vid), None)
+                inn = p.in_edges.pop(vid, {})
+                for etype, em in inn.items():
+                    for (rank, src) in list(em):
+                        ps = sd.parts[sd.part_of(src)]
+                        ps.out_edges.get(src, {}).get(etype, {}).pop((rank, vid), None)
+            sd.epoch += 1
+
+    def delete_tag(self, space: str, vid: Any, tags: List[str]):
+        sd = self.space(space)
+        with sd.lock:
+            p = sd.parts[sd.part_of(vid)]
+            tv = p.vertices.get(vid)
+            if tv:
+                for t in tags:
+                    tv.pop(t, None)
+                if not tv:
+                    p.vertices.pop(vid, None)
+            sd.epoch += 1
+
+    def delete_edge(self, space: str, src: Any, etype: str, dst: Any, rank: int):
+        sd = self.space(space)
+        with sd.lock:
+            ps = sd.parts[sd.part_of(src)]
+            ps.out_edges.get(src, {}).get(etype, {}).pop((rank, dst), None)
+            pd = sd.parts[sd.part_of(dst)]
+            pd.in_edges.get(dst, {}).get(etype, {}).pop((rank, src), None)
+            sd.epoch += 1
+
+    def update_vertex(self, space: str, vid: Any, tag: str,
+                      updates: Dict[str, Any]) -> bool:
+        sd = self.space(space)
+        with sd.lock:
+            p = sd.parts[sd.part_of(vid)]
+            tv = p.vertices.get(vid, {}).get(tag)
+            if tv is None:
+                return False
+            ver, row = tv
+            sv = self.catalog.get_tag(space, tag).latest
+            for k, v in updates.items():
+                if sv.prop(k) is None:
+                    raise SchemaError(f"unknown prop `{k}'")
+                row[k] = v
+            sd.epoch += 1
+            return True
+
+    def update_edge(self, space: str, src: Any, etype: str, dst: Any,
+                    rank: int, updates: Dict[str, Any]) -> bool:
+        sd = self.space(space)
+        with sd.lock:
+            ps = sd.parts[sd.part_of(src)]
+            row = ps.out_edges.get(src, {}).get(etype, {}).get((rank, dst))
+            if row is None:
+                return False
+            sv = self.catalog.get_edge(space, etype).latest
+            for k, v in updates.items():
+                if sv.prop(k) is None:
+                    raise SchemaError(f"unknown prop `{k}'")
+                row[k] = v
+            pd = sd.parts[sd.part_of(dst)]
+            irow = pd.in_edges.get(dst, {}).get(etype, {}).get((rank, src))
+            if irow is not None:
+                irow.update({k: row[k] for k in updates})
+            sd.epoch += 1
+            return True
+
+    # ---- read: point / scan ----
+    def get_vertex(self, space: str, vid: Any) -> Optional[Dict[str, Dict[str, Any]]]:
+        """vid → {tag: props} or None."""
+        sd = self.space(space)
+        tv = sd.parts[sd.part_of(vid)].vertices.get(vid)
+        if tv is None:
+            return None
+        return {t: dict(row) for t, (_, row) in tv.items()}
+
+    def get_edge(self, space: str, src: Any, etype: str, dst: Any,
+                 rank: int = 0) -> Optional[Dict[str, Any]]:
+        sd = self.space(space)
+        row = sd.parts[sd.part_of(src)].out_edges.get(src, {}).get(etype, {}) \
+            .get((rank, dst))
+        return dict(row) if row is not None else None
+
+    def scan_vertices(self, space: str, tag: Optional[str] = None,
+                      parts: Optional[Iterable[int]] = None):
+        """Yields (vid, tag, props)."""
+        sd = self.space(space)
+        part_ids = range(sd.num_parts) if parts is None else parts
+        for pid in part_ids:
+            for vid, tv in sd.parts[pid].vertices.items():
+                for t, (_, row) in tv.items():
+                    if tag is None or t == tag:
+                        yield vid, t, row
+
+    def scan_edges(self, space: str, etype: Optional[str] = None,
+                   parts: Optional[Iterable[int]] = None):
+        """Yields (src, etype, rank, dst, props) from the out-plane."""
+        sd = self.space(space)
+        part_ids = range(sd.num_parts) if parts is None else parts
+        for pid in part_ids:
+            for src, per in sd.parts[pid].out_edges.items():
+                for et, em in per.items():
+                    if etype is not None and et != etype:
+                        continue
+                    for (rank, dst), row in em.items():
+                        yield src, et, rank, dst, row
+
+    # ---- read: getNeighbors (the hot-path op, host oracle form) ----
+    def get_neighbors(self, space: str, vids: List[Any],
+                      edge_types: Optional[List[str]] = None,
+                      direction: str = "out"):
+        """Yields (src, etype_name, rank, dst, props, signed_dir).
+
+        signed_dir is +1 for out-edges, -1 for in-edges (matching the
+        reference's negative-EdgeType convention for reversed traversal).
+        Row order is deterministic: input vid order, then etype name, then
+        (rank, neighbor) — the CSR sort order (csr.py) matches this.
+        """
+        sd = self.space(space)
+        etypes = edge_types
+        if etypes is None:
+            etypes = sorted(e.name for e in self.catalog.edges(space))
+        for vid in vids:
+            p = sd.parts[sd.part_of(vid)]
+            if direction in ("out", "both"):
+                per = p.out_edges.get(vid, {})
+                for et in etypes:
+                    em = per.get(et)
+                    if em:
+                        for (rank, dst) in sorted(em, key=_nbr_key):
+                            yield vid, et, rank, dst, em[(rank, dst)], 1
+            if direction in ("in", "both"):
+                per = p.in_edges.get(vid, {})
+                for et in etypes:
+                    em = per.get(et)
+                    if em:
+                        for (rank, src) in sorted(em, key=_nbr_key):
+                            yield vid, et, rank, src, em[(rank, src)], -1
+
+    def stats(self, space: str) -> Dict[str, Any]:
+        sd = self.space(space)
+        return {
+            "space": space,
+            "partition_num": sd.num_parts,
+            "vertices": sum(len(p.vertices) for p in sd.parts),
+            "edges": sum(p.edge_count() for p in sd.parts),
+            "epoch": sd.epoch,
+            "per_part_edges": [p.edge_count() for p in sd.parts],
+        }
+
+
+def _nbr_key(k: Tuple[int, Any]):
+    rank, other = k
+    return (rank, str(other))

@@ -1,0 +1,5 @@
+"""Query layer: tokenizer, parser, AST, plan, planner, optimizer."""
+from .parser import ParseError, parse
+from .plan import ExecutionPlan, PlanNode, transform_plan, walk_plan
+from .planner import PlannerContext, QueryError, plan_statement
+from .optimizer import RULES, optimize, register_rule

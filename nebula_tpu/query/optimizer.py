@@ -1,0 +1,228 @@
+"""Rule-based optimizer over the plan DAG.
+
+Analog of the reference's memo-based RBO (reference: src/graph/optimizer,
+~50 rules [UNVERIFIED — empty mount, SURVEY §0]).  Python plans are small
+trees, so instead of an OptGroup memo we run bottom-up rewrite rules to a
+fixpoint.  The rule set mirrors the reference's pushdown family; the TPU
+fusion rule (`TpuTraverseRule`) registers itself from nebula_tpu.tpu at
+import time — a new rule here is exactly where the TPU rewrite plugs in.
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Optional
+
+from ..core.expr import (Binary, Expr, InputProp, join_conjuncts,
+                         split_conjuncts, walk)
+from .plan import ExecutionPlan, PlanNode, transform_plan
+
+Rule = Callable[[PlanNode], Optional[PlanNode]]
+
+RULES: List[Rule] = []
+
+
+def register_rule(fn: Rule) -> Rule:
+    RULES.append(fn)
+    return fn
+
+
+def optimize(plan: ExecutionPlan, enable: bool = True) -> ExecutionPlan:
+    if not enable:
+        return plan
+    # When a rule replaces a node with one of its children, any by-name
+    # reference to the removed node's output_var (e.g. Argument.from_var)
+    # must be re-pointed at the survivor.
+    var_alias = {}
+    for _ in range(8):  # fixpoint with a safety bound
+        changed = [False]
+
+        def apply_once(node: PlanNode) -> Optional[PlanNode]:
+            for rule in RULES:
+                r = rule(node)
+                if r is not None:
+                    changed[0] = True
+                    if r.output_var != node.output_var:
+                        var_alias[node.output_var] = r.output_var
+                    return r
+            return None
+
+        plan.root = transform_plan(plan.root, apply_once)
+        if not changed[0]:
+            break
+    if var_alias:
+        def resolve(v):
+            seen = set()
+            while v in var_alias and v not in seen:
+                seen.add(v)
+                v = var_alias[v]
+            return v
+        from .plan import walk_plan
+        for n in walk_plan(plan.root):
+            if "from_var" in n.args:
+                n.args["from_var"] = resolve(n.args["from_var"])
+            n.input_vars = [resolve(v) for v in n.input_vars]
+    return plan
+
+
+# ---------------------------------------------------------------------------
+# Rules (reference analogs noted per rule)
+# ---------------------------------------------------------------------------
+
+
+def _refs_only(e: Expr, kinds: tuple) -> bool:
+    leaf_kinds = ("literal", "list", "set", "map") + kinds
+    for x in walk(e):
+        if x.kind in ("src_prop", "edge_prop", "dst_prop", "input_prop",
+                      "var", "var_prop", "label", "label_tag_prop",
+                      "vertex", "edge", "attribute"):
+            if x.kind not in kinds:
+                return False
+    return True
+
+
+@register_rule
+def push_filter_down_expand(node: PlanNode) -> Optional[PlanNode]:
+    """Filter(ExpandAll) → ExpandAll{edge_filter} for conjuncts that only
+    touch edge props / src props (reference: PushFilterDownGetNbrsRule)."""
+    if node.kind != "Filter" or not node.deps or node.dep().kind != "ExpandAll":
+        return None
+    exp = node.dep()
+    cond = node.args.get("condition")
+    if cond is None:
+        return None
+    pushable, rest = [], []
+    for c in split_conjuncts(cond):
+        if _refs_only(c, ("edge_prop", "src_prop")):
+            pushable.append(c)
+        else:
+            rest.append(c)
+    if not pushable:
+        return None
+    prev = exp.args.get("edge_filter")
+    allp = ([prev] if prev is not None else []) + pushable
+    exp.args["edge_filter"] = join_conjuncts(allp)
+    if rest:
+        node.args["condition"] = join_conjuncts(rest)
+        return None  # keep the (reduced) filter
+    return exp  # filter fully absorbed
+
+
+@register_rule
+def push_filter_down_traverse(node: PlanNode) -> Optional[PlanNode]:
+    """Filter(AppendVertices(Traverse)) edge-only conjuncts → Traverse
+    (reference: PushFilterDownTraverseRule)."""
+    if node.kind != "Filter" or not node.deps:
+        return None
+    av = node.dep()
+    if av.kind != "AppendVertices" or not av.deps or av.dep().kind != "Traverse":
+        return None
+    tv = av.dep()
+    cond = node.args.get("condition")
+    if cond is None:
+        return None
+    edge_alias = tv.args.get("edge_alias")
+    if tv.args.get("min_hop") != 1 or tv.args.get("max_hop") != 1:
+        return None
+    pushable, rest = [], []
+    for c in split_conjuncts(cond):
+        refs = [x for x in walk(c)
+                if x.kind in ("label", "label_tag_prop", "attribute",
+                              "input_prop", "var", "var_prop")]
+        names = set()
+        for r in refs:
+            if r.kind == "label":
+                names.add(r.name)
+            elif r.kind == "label_tag_prop":
+                names.add(r.var)
+            elif r.kind == "attribute":
+                o = r.obj
+                while o.kind == "attribute":
+                    o = o.obj
+                if o.kind == "label":
+                    names.add(o.name)
+                else:
+                    names.add("__other__")
+            else:
+                names.add("__other__")
+        if names and names <= {edge_alias}:
+            pushable.append(c)
+        else:
+            rest.append(c)
+    if not pushable:
+        return None
+    prev = tv.args.get("edge_filter")
+    tv.args["edge_filter"] = join_conjuncts(
+        ([prev] if prev is not None else []) + pushable)
+    tv.args["edge_filter_alias"] = edge_alias
+    if rest:
+        node.args["condition"] = join_conjuncts(rest)
+        return None
+    return av
+
+
+@register_rule
+def push_limit_down_expand(node: PlanNode) -> Optional[PlanNode]:
+    """Limit(ExpandAll) → ExpandAll{limit} (reference: PushLimitDownGetNeighborsRule)."""
+    if node.kind != "Limit" or not node.deps or node.dep().kind != "ExpandAll":
+        return None
+    exp = node.dep()
+    if node.args.get("offset"):
+        return None
+    cnt = node.args.get("count", -1)
+    if cnt is None or cnt < 0:
+        return None
+    if exp.args.get("limit") is not None:
+        return None
+    exp.args["limit"] = cnt
+    return None  # keep Limit for exactness; Expand just over-produces less
+
+
+@register_rule
+def collapse_project(node: PlanNode) -> Optional[PlanNode]:
+    """Project(Project(x)) where the outer only renames InputProp columns
+    (reference: CollapseProjectRule)."""
+    if node.kind != "Project" or not node.deps or node.dep().kind != "Project":
+        return None
+    inner = node.dep()
+    if node.args.get("go_row") or node.args.get("match_row") or \
+       inner.args.get("go_row") or inner.args.get("match_row"):
+        return None
+    inner_map = {n: e for e, n in inner.args.get("columns", [])}
+    new_cols = []
+    for e, n in node.args.get("columns", []):
+        if isinstance(e, InputProp) and e.name in inner_map:
+            new_cols.append((inner_map[e.name], n))
+        else:
+            return None
+    node.args["columns"] = new_cols
+    node.deps = list(inner.deps)
+    node.input_vars = [d.output_var for d in node.deps]
+    return node
+
+
+@register_rule
+def merge_sort_limit_to_topn(node: PlanNode) -> Optional[PlanNode]:
+    """Limit(Sort(x)) → TopN (reference: TopNRule)."""
+    if node.kind != "Limit" or not node.deps or node.dep().kind != "Sort":
+        return None
+    srt = node.dep()
+    cnt = node.args.get("count", -1)
+    if cnt is None or cnt < 0:
+        return None
+    return PlanNode("TopN", deps=list(srt.deps),
+                    col_names=list(node.col_names),
+                    args={"factors": srt.args["factors"],
+                          "offset": node.args.get("offset", 0),
+                          "count": cnt,
+                          "match_row": srt.args.get("match_row", False)})
+
+
+@register_rule
+def dedup_before_expand(node: PlanNode) -> Optional[PlanNode]:
+    """ExpandAll fed by a Project of dsts without Dedup gains dedup_src
+    (reference: the GetDstBySrc dedup optimization)."""
+    if node.kind != "ExpandAll" or not node.deps:
+        return None
+    d = node.dep()
+    if d.kind == "Dedup":
+        node.args["dedup_input"] = True
+    return None

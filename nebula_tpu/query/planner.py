@@ -1,0 +1,1066 @@
+"""Statement → ExecutionPlan: validation + planning.
+
+Folds the reference's validator layer (per-sentence semantic checks +
+symbol/type deduction; reference: src/graph/validator [UNVERIFIED]) and
+planner layer (GoPlanner/MatchPlanner/...; reference: src/graph/planner
+[UNVERIFIED]) into one pass per statement: semantic validation happens
+while the plan is built, against the live catalog.
+
+Plan shapes (golden-plan tests pin these):
+
+  GO n STEPS FROM x OVER e WHERE f YIELD c:
+      Project ← Filter? ← ExpandAll ← [Dedup ← Project ← ExpandAll]×(n-1) ← Start
+  GO m TO n: Union-ALL of the per-step branches sharing the frontier chain.
+  MATCH (a)-[e]->(b):
+      Project ← Filter? ← AppendVertices ← Traverse×k ← <seed> ← Start
+  LOOKUP:  Project ← Filter? ← IndexScan
+  FETCH:   Project ← GetVertices | GetEdges
+  FIND PATH / GET SUBGRAPH: one algo node.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..core.expr import (AggExpr, AttributeExpr, Binary, DictContext, Expr,
+                         FunctionCall, InputProp, LabelExpr, LabelTagProp,
+                         Literal, Unary, VarExpr, VarProp, EdgeProp,
+                         VertexExpr, EdgeExpr, has_aggregate, rewrite,
+                         split_conjuncts, to_text, walk)
+from ..graphstore.schema import SchemaError
+from . import ast as A
+from .plan import ExecutionPlan, PlanNode
+
+
+class QueryError(Exception):
+    pass
+
+
+class PlannerContext:
+    """Carries catalog access + pipe/variable column bindings."""
+
+    def __init__(self, qctx, space: Optional[str]):
+        self.qctx = qctx              # QueryContext (exec/context.py)
+        self.space = space
+        self.input_node: Optional[PlanNode] = None
+        self.input_cols: List[str] = []
+        self.var_cols: Dict[str, List[str]] = {}   # $var → col names
+        self.var_nodes: Dict[str, PlanNode] = {}
+
+    @property
+    def catalog(self):
+        return self.qctx.catalog
+
+    def need_space(self) -> str:
+        if not self.space:
+            raise QueryError("no space selected (USE <space> first)")
+        return self.space
+
+
+def plan_statement(qctx, stmt: A.Sentence, space: Optional[str]) -> ExecutionPlan:
+    pctx = PlannerContext(qctx, space)
+    root = _plan(pctx, stmt)
+    return ExecutionPlan(root, pctx.space)
+
+
+# ---------------------------------------------------------------------------
+
+
+def _plan(pctx: PlannerContext, stmt: A.Sentence) -> PlanNode:
+    h = _DISPATCH.get(type(stmt))
+    if h is None:
+        raise QueryError(f"unsupported statement {type(stmt).__name__}")
+    return h(pctx, stmt)
+
+
+def _start(pctx) -> PlanNode:
+    return PlanNode("Start")
+
+
+def _col_name(col: A.YieldColumn) -> str:
+    return col.alias if col.alias else to_text(col.expr)
+
+
+# ---- composition ----------------------------------------------------------
+
+
+def _plan_seq(pctx, s: A.SeqSentence) -> PlanNode:
+    nodes = [_plan(pctx, x) for x in s.stmts]
+    # sequence: each depends on the previous for ordering; result = last
+    for i in range(1, len(nodes)):
+        seq = PlanNode("Sequence", deps=[nodes[i - 1], nodes[i]],
+                       col_names=nodes[i].col_names)
+        nodes[i] = seq
+    return nodes[-1]
+
+
+def _plan_pipe(pctx, s: A.PipedSentence) -> PlanNode:
+    left = _plan(pctx, s.left)
+    saved_node, saved_cols = pctx.input_node, pctx.input_cols
+    pctx.input_node, pctx.input_cols = left, list(left.col_names)
+    right = _plan(pctx, s.right)
+    pctx.input_node, pctx.input_cols = saved_node, saved_cols
+    return right
+
+
+def _plan_assign(pctx, s: A.AssignSentence) -> PlanNode:
+    node = _plan(pctx, s.stmt)
+    pctx.var_cols[s.var] = list(node.col_names)
+    pctx.var_nodes[s.var] = node
+    # register in qctx for cross-statement $var reads inside one submit
+    alias = PlanNode("SetVariable", deps=[node], col_names=node.col_names,
+                     args={"var": s.var, "source": node.output_var})
+    return alias
+
+
+def _plan_setop(pctx, s: A.SetOpSentence) -> PlanNode:
+    left = _plan(pctx, s.left)
+    saved_node, saved_cols = pctx.input_node, pctx.input_cols
+    right = _plan(pctx, s.right)
+    pctx.input_node, pctx.input_cols = saved_node, saved_cols
+    if len(left.col_names) != len(right.col_names):
+        raise QueryError("set operation branches have different column counts")
+    kind = {"UNION": "Union", "UNION ALL": "Union", "INTERSECT": "Intersect",
+            "MINUS": "Minus"}[s.op]
+    node = PlanNode(kind, deps=[left, right], col_names=list(left.col_names),
+                    args={"distinct": s.op == "UNION"})
+    return node
+
+
+def _plan_explain(pctx, s: A.ExplainSentence) -> PlanNode:
+    inner = _plan(pctx, s.stmt)
+    return PlanNode("Explain", deps=[inner], col_names=["plan"],
+                    args={"profile": s.profile, "fmt": s.fmt})
+
+
+# ---- expression rewriting -------------------------------------------------
+
+
+def _rewrite_go_expr(pctx, e: Expr, edge_names: List[str]) -> Expr:
+    """knows.since → EdgeProp; validate prop refs against schemas."""
+    space = pctx.need_space()
+    cat = pctx.catalog
+
+    def fn(x: Expr):
+        if isinstance(x, AttributeExpr) and isinstance(x.obj, LabelExpr):
+            name = x.obj.name
+            if name in edge_names:
+                _check_edge_prop(cat, space, name, x.attr)
+                return EdgeProp(name, x.attr)
+        return None
+
+    e = rewrite(e, fn)
+    for x in walk(e):
+        if isinstance(x, (type(e),)):
+            pass
+        if x.kind == "src_prop" or x.kind == "dst_prop":
+            _check_tag_prop(cat, space, x.tag, x.name)
+        if x.kind == "edge_prop":
+            if x.edge not in edge_names and x.edge != "*":
+                _check_edge_prop(cat, space, x.edge, x.name)
+        if x.kind == "input_prop" and pctx.input_cols and x.name not in pctx.input_cols:
+            raise QueryError(f"unknown input column `$-.{x.name}'"
+                             f" (have {pctx.input_cols})")
+        if x.kind == "var_prop":
+            cols = pctx.var_cols.get(x.var)
+            if cols is not None and x.name not in cols:
+                raise QueryError(f"unknown column `${x.var}.{x.name}'")
+    return e
+
+
+def _check_edge_prop(cat, space, edge, prop):
+    if prop in ("_src", "_dst", "_rank", "_type"):
+        return
+    try:
+        es = cat.get_edge(space, edge)
+    except SchemaError as ex:
+        raise QueryError(str(ex)) from None
+    if es.latest.prop(prop) is None:
+        raise QueryError(f"edge `{edge}' has no property `{prop}'")
+
+
+def _check_tag_prop(cat, space, tag, prop):
+    try:
+        ts = cat.get_tag(space, tag)
+    except SchemaError as ex:
+        raise QueryError(str(ex)) from None
+    if ts.latest.prop(prop) is None:
+        raise QueryError(f"tag `{tag}' has no property `{prop}'")
+
+
+def _rewrite_match_expr(e: Expr, aliases: Dict[str, str]) -> Expr:
+    """v.tag.prop → LabelTagProp for known aliases."""
+    def fn(x: Expr):
+        if (isinstance(x, AttributeExpr) and isinstance(x.obj, AttributeExpr)
+                and isinstance(x.obj.obj, LabelExpr)
+                and x.obj.obj.name in aliases):
+            return LabelTagProp(x.obj.obj.name, x.obj.attr, x.attr)
+        return None
+    return rewrite(e, fn)
+
+
+# ---- GO -------------------------------------------------------------------
+
+
+def _resolve_from(pctx, fc: A.FromClause) -> Tuple[Any, Optional[str]]:
+    """Returns (vid_exprs|None, input_ref_col|None)."""
+    if fc.ref is not None:
+        if isinstance(fc.ref, InputProp):
+            if pctx.input_cols and fc.ref.name not in pctx.input_cols:
+                raise QueryError(f"unknown input column `$-.{fc.ref.name}'")
+            return None, fc.ref.name
+        if isinstance(fc.ref, VarProp):
+            cols = pctx.var_cols.get(fc.ref.var)
+            if cols is not None and fc.ref.name not in cols:
+                raise QueryError(f"unknown column `${fc.ref.var}.{fc.ref.name}'")
+            return None, f"${fc.ref.var}.{fc.ref.name}"
+        raise QueryError("FROM clause reference must be $-.col or $var.col")
+    return fc.vids, None
+
+
+_GO_DEFAULT_YIELD = None  # built lazily
+
+
+def _go_default_yield() -> A.YieldClause:
+    return A.YieldClause([A.YieldColumn(
+        FunctionCall("dst", [EdgeExpr()]), "dst")])
+
+
+def _plan_go(pctx, s: A.GoSentence) -> PlanNode:
+    space = pctx.need_space()
+    cat = pctx.catalog
+    edges = s.over.edges
+    if s.over.is_all:
+        edges = sorted(e.name for e in cat.edges(space))
+    else:
+        for e in edges:
+            try:
+                cat.get_edge(space, e)
+            except SchemaError as ex:
+                raise QueryError(str(ex)) from None
+
+    yld = s.yield_ or _go_default_yield()
+    where_expr = None
+    if s.where:
+        where_expr = _rewrite_go_expr(pctx, s.where.filter, edges)
+    ycols = [A.YieldColumn(_rewrite_go_expr(pctx, c.expr, edges), c.alias)
+             for c in yld.columns]
+    col_names = [_col_name(c) for c in ycols]
+
+    vids, ref_col = _resolve_from(pctx, s.from_)
+    uses_input = ref_col is not None or any(
+        x.kind == "input_prop" for c in ycols for x in walk(c.expr)) or (
+        where_expr is not None and any(x.kind == "input_prop" for x in walk(where_expr)))
+
+    if ref_col is not None and ref_col.startswith("$"):
+        var = ref_col[1:].split(".")[0]
+        src_node = _var_input_node(pctx, var)
+        input_cols = pctx.var_cols.get(var, [])
+        src_col = ref_col.split(".")[1]
+    elif ref_col is not None:
+        src_node = pctx.input_node
+        input_cols = pctx.input_cols
+        src_col = ref_col
+    else:
+        src_node = None
+        input_cols = []
+        src_col = None
+
+    start: PlanNode
+    if src_node is not None:
+        start = src_node
+    else:
+        start = PlanNode("Start", col_names=["_vid"],
+                         args={"vids": vids})
+
+    m, n = s.steps.m, s.steps.n
+    if n < m or n < 0 or m < 0:
+        raise QueryError(f"invalid step range {m} TO {n}")
+    if n == 0:
+        return PlanNode("Project", deps=[start], col_names=col_names,
+                        args={"columns": [], "empty": True})
+
+    carry = list(input_cols) if uses_input and src_node is not None else []
+
+    def expand(dep: PlanNode, step_src_col: Optional[str], first: bool) -> PlanNode:
+        return PlanNode("ExpandAll", deps=[dep], args={
+            "space": space, "edge_types": list(edges),
+            "direction": s.over.direction,
+            "src_col": step_src_col,          # None → use literal vids
+            "vids": vids if first and src_node is None else None,
+            "edge_filter": None, "limit": None,
+            "sample": None, "carry": list(carry),
+        }, col_names=(carry + ["_src", "_edge", "_dst"]))
+
+    # frontier chain: F1 = expand(start); Fk = expand(dedup(project_dst(Fk-1)))
+    branches: List[PlanNode] = []
+    cur = start
+    cur_src_col = src_col
+    for step in range(1, n + 1):
+        first = step == 1
+        exp = expand(cur, cur_src_col, first)
+        if m <= step:
+            branch = exp
+            if where_expr is not None:
+                branch = PlanNode("Filter", deps=[branch],
+                                  col_names=list(branch.col_names),
+                                  args={"condition": where_expr})
+            proj = PlanNode("Project", deps=[branch], col_names=col_names,
+                            args={"columns": [(c.expr, nm) for c, nm in
+                                              zip(ycols, col_names)],
+                                  "go_row": True})
+            branches.append(proj)
+        if step < n:
+            if carry:
+                # keep full rows: next step expands from _dst, carrying cols
+                nxt_cols = carry + ["_dst"]
+                nxt = PlanNode("Project", deps=[exp], col_names=nxt_cols,
+                               args={"columns":
+                                     [(InputProp(c), c) for c in carry]
+                                     + [(InputProp("_dst"), "_dst")],
+                                     "go_row": False})
+                cur, cur_src_col = nxt, "_dst"
+            else:
+                nxt = PlanNode("Project", deps=[exp], col_names=["_vid"],
+                               args={"columns": [(InputProp("_dst"), "_vid")],
+                                     "go_row": False})
+                ddp = PlanNode("Dedup", deps=[nxt], col_names=["_vid"])
+                cur, cur_src_col = ddp, "_vid"
+
+    out = branches[0]
+    for b in branches[1:]:
+        out = PlanNode("Union", deps=[out, b], col_names=col_names,
+                       args={"distinct": False})
+    if yld.distinct:
+        out = PlanNode("Dedup", deps=[out], col_names=col_names)
+    if s.truncate is not None:
+        counts = s.truncate.counts
+        out = PlanNode("Sample" if s.truncate.is_sample else "Limit",
+                       deps=[out], col_names=col_names,
+                       args={"count": counts[-1] if counts else 0, "offset": 0})
+    return out
+
+
+# ---- YIELD / pipe segments ------------------------------------------------
+
+
+def _var_input_node(pctx, var: str) -> PlanNode:
+    """A node that reads a $var result saved earlier in the session."""
+    node = pctx.var_nodes.get(var)
+    if node is not None:
+        return node
+    cols = pctx.var_cols.get(var, [])
+    n = PlanNode("VarInput", col_names=list(cols), args={"var": var})
+    n.output_var = f"${var}"
+    return n
+
+
+def _plan_yield(pctx, s: A.YieldSentence) -> PlanNode:
+    dep = pctx.input_node or PlanNode("Start", col_names=[])
+    cols = s.yield_.columns
+    # $var.col references: bind the variable's dataset as the input
+    var_refs = {x.var for c in cols for x in walk(c.expr) if x.kind == "var_prop"}
+    if s.where is not None:
+        var_refs |= {x.var for x in walk(s.where.filter) if x.kind == "var_prop"}
+    where_filter = s.where.filter if s.where is not None else None
+    from_var = bool(var_refs)
+    if from_var:
+        # bind the $var's dataset as the input and read cols via $-.
+        if len(var_refs) > 1:
+            raise QueryError("YIELD over multiple $variables is unsupported")
+        var = var_refs.pop()
+        dep = _var_input_node(pctx, var)
+
+        def _v2i(x):
+            if isinstance(x, VarProp) and x.var == var:
+                return InputProp(x.name)
+            return None
+        cols = [A.YieldColumn(rewrite(c.expr, _v2i),
+                              c.alias or to_text(c.expr)) for c in cols]
+        if where_filter is not None:
+            where_filter = rewrite(where_filter, _v2i)
+    exprs = []
+    for c in cols:
+        e = c.expr
+        for x in walk(e):
+            if x.kind == "input_prop" and pctx.input_cols and not from_var \
+                    and x.name not in pctx.input_cols:
+                raise QueryError(f"unknown input column `$-.{x.name}'")
+        exprs.append(e)
+    names = [_col_name(c) for c in cols]
+    out = dep
+    if where_filter is not None:
+        out = PlanNode("Filter", deps=[out], col_names=list(out.col_names),
+                       args={"condition": where_filter})
+    if any(has_aggregate(e) for e in exprs):
+        out = _plan_aggregate(out, list(zip(exprs, names)), group_keys=None)
+    else:
+        out = PlanNode("Project", deps=[out], col_names=names,
+                       args={"columns": list(zip(exprs, names))})
+    if s.yield_.distinct:
+        out = PlanNode("Dedup", deps=[out], col_names=names)
+    return out
+
+
+def _plan_aggregate(dep: PlanNode, cols: List[Tuple[Expr, str]],
+                    group_keys: Optional[List[Expr]]) -> PlanNode:
+    keys = group_keys
+    if keys is None:
+        keys = [e for e, _ in cols if not has_aggregate(e)]
+    return PlanNode("Aggregate", deps=[dep],
+                    col_names=[n for _, n in cols],
+                    args={"group_keys": keys, "columns": cols})
+
+
+def _plan_group_by(pctx, s: A.GroupBySentence) -> PlanNode:
+    dep = pctx.input_node
+    if dep is None:
+        raise QueryError("GROUP BY requires piped input")
+    cols = [(c.expr, _col_name(c)) for c in s.yield_.columns]
+    return _plan_aggregate(dep, cols, s.keys)
+
+
+def _plan_order_by(pctx, s: A.OrderBySentence) -> PlanNode:
+    dep = pctx.input_node
+    if dep is None:
+        raise QueryError("ORDER BY requires piped input")
+    return PlanNode("Sort", deps=[dep], col_names=list(dep.col_names),
+                    args={"factors": [(f.expr, f.ascending) for f in s.factors]})
+
+
+def _plan_limit(pctx, s: A.LimitSentence) -> PlanNode:
+    dep = pctx.input_node
+    if dep is None:
+        raise QueryError("LIMIT requires piped input")
+    return PlanNode("Limit", deps=[dep], col_names=list(dep.col_names),
+                    args={"offset": s.offset, "count": s.count})
+
+
+def _plan_sample(pctx, s: A.SampleSentence) -> PlanNode:
+    dep = pctx.input_node
+    if dep is None:
+        raise QueryError("SAMPLE requires piped input")
+    return PlanNode("Sample", deps=[dep], col_names=list(dep.col_names),
+                    args={"count": s.count})
+
+
+# ---- FETCH / LOOKUP -------------------------------------------------------
+
+
+def _plan_fetch_vertices(pctx, s: A.FetchVerticesSentence) -> PlanNode:
+    space = pctx.need_space()
+    cat = pctx.catalog
+    tags = s.tags
+    for t in tags:
+        try:
+            cat.get_tag(space, t)
+        except SchemaError as ex:
+            raise QueryError(str(ex)) from None
+    vids, ref_col = _resolve_from(pctx, s.vids)
+    dep = pctx.input_node if ref_col else PlanNode("Start")
+    gv = PlanNode("GetVertices", deps=[dep] if dep else [],
+                  col_names=["vertices_"],
+                  args={"space": space, "tags": tags, "vids": vids,
+                        "src_col": ref_col})
+    yld = s.yield_
+    if yld is None:
+        yld = A.YieldClause([A.YieldColumn(VertexExpr("vertex"), "vertices_")])
+    ycols = [(c.expr, _col_name(c)) for c in yld.columns]
+    names = [n for _, n in ycols]
+    out = PlanNode("Project", deps=[gv], col_names=names,
+                   args={"columns": ycols, "fetch_row": True})
+    if yld.distinct:
+        out = PlanNode("Dedup", deps=[out], col_names=names)
+    return out
+
+
+def _plan_fetch_edges(pctx, s: A.FetchEdgesSentence) -> PlanNode:
+    space = pctx.need_space()
+    try:
+        pctx.catalog.get_edge(space, s.etype)
+    except SchemaError as ex:
+        raise QueryError(str(ex)) from None
+    keys = [(_const_eval(k.src), _const_eval(k.dst), k.rank) for k in s.keys]
+    ge = PlanNode("GetEdges", deps=[], col_names=["edges_"],
+                  args={"space": space, "etype": s.etype, "keys": keys})
+    yld = s.yield_
+    if yld is None:
+        yld = A.YieldClause([A.YieldColumn(EdgeExpr(), "edges_")])
+    ycols = [(_rewrite_go_expr(pctx, c.expr, [s.etype]), _col_name(c))
+             for c in yld.columns]
+    names = [n for _, n in ycols]
+    out = PlanNode("Project", deps=[ge], col_names=names,
+                   args={"columns": ycols, "fetch_row": True})
+    if yld.distinct:
+        out = PlanNode("Dedup", deps=[out], col_names=names)
+    return out
+
+
+def _plan_lookup(pctx, s: A.LookupSentence) -> PlanNode:
+    space = pctx.need_space()
+    cat = pctx.catalog
+    is_edge = False
+    try:
+        cat.get_tag(space, s.schema_name)
+    except SchemaError:
+        try:
+            cat.get_edge(space, s.schema_name)
+            is_edge = True
+        except SchemaError:
+            raise QueryError(
+                f"`{s.schema_name}' is neither tag nor edge in `{space}'") from None
+    filt = None
+    if s.where is not None:
+        aliases = {s.schema_name: s.schema_name}
+        filt = _rewrite_match_expr(s.where.filter, aliases)
+        filt = _rewrite_go_expr(pctx, filt, [s.schema_name]) if is_edge else filt
+    scan = PlanNode("IndexScan", deps=[],
+                    col_names=["_matched"],
+                    args={"space": space, "schema": s.schema_name,
+                          "is_edge": is_edge, "filter": filt})
+    yld = s.yield_
+    if yld is None:
+        default = (FunctionCall("id", [VertexExpr("vertex")]) if not is_edge
+                   else EdgeExpr())
+        yld = A.YieldClause([A.YieldColumn(default, "_matched")])
+    ycols = []
+    for c in yld.columns:
+        e = _rewrite_match_expr(c.expr, {s.schema_name: s.schema_name})
+        if is_edge:
+            e = _rewrite_go_expr(pctx, e, [s.schema_name])
+        ycols.append((e, _col_name(c)))
+    names = [n for _, n in ycols]
+    out = PlanNode("Project", deps=[scan], col_names=names,
+                   args={"columns": ycols, "lookup_row": True,
+                         "schema": s.schema_name, "is_edge": is_edge})
+    if yld.distinct:
+        out = PlanNode("Dedup", deps=[out], col_names=names)
+    return out
+
+
+# ---- MATCH ----------------------------------------------------------------
+
+
+def _plan_match(pctx, s: A.MatchSentence) -> PlanNode:
+    space = pctx.need_space()
+    current: Optional[PlanNode] = pctx.input_node
+    aliases: Dict[str, str] = {}
+    if current is not None:
+        for c in current.col_names:
+            aliases[c] = "input"
+
+    for clause in s.clauses:
+        if isinstance(clause, A.MatchClauseAst):
+            current = _plan_match_clause(pctx, clause, current, aliases)
+        elif isinstance(clause, A.UnwindClauseAst):
+            e = _rewrite_match_expr(clause.expr, aliases)
+            cols = (list(current.col_names) if current else []) + [clause.alias]
+            current = PlanNode("Unwind", deps=[current] if current else [],
+                               col_names=cols,
+                               args={"expr": e, "alias": clause.alias})
+            aliases[clause.alias] = "value"
+        elif isinstance(clause, A.WithClauseAst):
+            current = _plan_projection(pctx, current, clause.columns,
+                                       clause.distinct, clause.where,
+                                       clause.order_by, clause.skip,
+                                       clause.limit, aliases)
+            aliases = {c: "value" for c in current.col_names}
+        else:
+            raise QueryError(f"unsupported MATCH clause {type(clause).__name__}")
+
+    ret = s.return_
+    cols = ret.columns
+    if cols is None:
+        cols = [A.YieldColumn(LabelExpr(a), a) for a in aliases
+                if not a.startswith("_")]
+        if not cols:
+            raise QueryError("RETURN * with nothing to return")
+    return _plan_projection(pctx, current, cols, ret.distinct, None,
+                            ret.order_by, ret.skip, ret.limit, aliases)
+
+
+def _plan_projection(pctx, dep: Optional[PlanNode], cols: List[A.YieldColumn],
+                     distinct: bool, where: Optional[Expr],
+                     order_by, skip: int, limit: int,
+                     aliases: Dict[str, str]) -> PlanNode:
+    if dep is None:
+        dep = PlanNode("Start")
+    out = dep
+    ycols = [(_rewrite_match_expr(c.expr, aliases), _col_name(c)) for c in cols]
+    names = [n for _, n in ycols]
+    if any(has_aggregate(e) for e, _ in ycols):
+        out = _plan_aggregate(out, ycols, None)
+        out.args["match_row"] = True
+    else:
+        out = PlanNode("Project", deps=[out], col_names=names,
+                       args={"columns": ycols, "match_row": True})
+    if where is not None:
+        # WITH ... WHERE filters the PROJECTED columns (openCypher)
+        w = _rewrite_match_expr(where, {n: "value" for n in names})
+        out = PlanNode("Filter", deps=[out], col_names=names,
+                       args={"condition": w, "match_row": True})
+    if distinct:
+        out = PlanNode("Dedup", deps=[out], col_names=names)
+    if order_by:
+        factors = [( _rewrite_match_expr(f.expr, {n: "value" for n in names}),
+                     f.ascending) for f in order_by]
+        out = PlanNode("Sort", deps=[out], col_names=names,
+                       args={"factors": factors, "match_row": True})
+    if skip or (limit is not None and limit >= 0):
+        out = PlanNode("Limit", deps=[out], col_names=names,
+                       args={"offset": skip, "count": limit if limit >= 0 else -1})
+    return out
+
+
+def _plan_match_clause(pctx, mc: A.MatchClauseAst, current: Optional[PlanNode],
+                       aliases: Dict[str, str]) -> PlanNode:
+    pat_nodes = []
+    for pat in mc.patterns:
+        pat_nodes.append(_plan_pattern(pctx, pat, mc.where, aliases, current))
+    node = pat_nodes[0]
+    for other in pat_nodes[1:]:
+        shared = [c for c in node.col_names if c in other.col_names]
+        if shared:
+            node = PlanNode("HashInnerJoin", deps=[node, other],
+                            col_names=node.col_names + [c for c in other.col_names
+                                                        if c not in node.col_names],
+                            args={"keys": shared})
+        else:
+            node = PlanNode("CrossJoin", deps=[node, other],
+                            col_names=node.col_names + other.col_names)
+    if current is not None:
+        shared = [c for c in current.col_names if c in node.col_names]
+        join_kind = "HashLeftJoin" if mc.optional else "HashInnerJoin"
+        if shared:
+            node = PlanNode(join_kind, deps=[current, node],
+                            col_names=current.col_names
+                            + [c for c in node.col_names if c not in current.col_names],
+                            args={"keys": shared})
+        else:
+            if mc.optional:
+                raise QueryError("OPTIONAL MATCH without shared aliases unsupported")
+            node = PlanNode("CrossJoin", deps=[current, node],
+                            col_names=current.col_names + node.col_names)
+    if mc.where is not None:
+        w = _rewrite_match_expr(mc.where, aliases)
+        node = PlanNode("Filter", deps=[node], col_names=list(node.col_names),
+                        args={"condition": w, "match_row": True})
+    return node
+
+
+def _anon_names():
+    import itertools as _it
+    for i in _it.count():
+        yield f"__anon_{i}"
+
+
+def _plan_pattern(pctx, pat: A.PathPattern, where: Optional[Expr],
+                  aliases: Dict[str, str], current: Optional[PlanNode]) -> PlanNode:
+    space = pctx.need_space()
+    cat = pctx.catalog
+    anon = _anon_names()
+    for np in pat.nodes:
+        if np.alias is None:
+            np.alias = next(anon)
+    for ep in pat.edges:
+        if ep.alias is None:
+            ep.alias = next(anon)
+        for t in ep.types:
+            try:
+                cat.get_edge(space, t)
+            except SchemaError as ex:
+                raise QueryError(str(ex)) from None
+    for np in pat.nodes:
+        for lbl, _ in np.labels:
+            try:
+                cat.get_tag(space, lbl)
+            except SchemaError as ex:
+                raise QueryError(str(ex)) from None
+
+    # ---- choose seed node: id(x)==lit / id(x) IN [...] in WHERE, bound
+    # alias from a previous clause, else labeled node, else first node.
+    seed_idx, seed_vids = _choose_seed(pat, where, aliases, current)
+
+    if seed_idx == len(pat.nodes) - 1 and len(pat.nodes) > 1:
+        _reverse_pattern(pat)
+        seed_idx = 0
+    elif seed_idx != 0 and seed_idx != len(pat.nodes) - 1:
+        seed_idx = 0
+        seed_vids = None
+
+    seed = pat.nodes[seed_idx]
+    bound = seed.alias in aliases and aliases[seed.alias] == "vertex"
+    if bound and current is not None:
+        dep = PlanNode("Argument", deps=[], col_names=[seed.alias],
+                       args={"from_var": current.output_var, "col": seed.alias})
+    elif seed_vids is not None:
+        dep = PlanNode("GetVertices", deps=[], col_names=[seed.alias],
+                       args={"space": space, "tags": [], "vids": seed_vids,
+                             "src_col": None, "as_col": seed.alias})
+    else:
+        tag = seed.labels[0][0] if seed.labels else None
+        dep = PlanNode("ScanVertices", deps=[], col_names=[seed.alias],
+                       args={"space": space, "tag": tag, "as_col": seed.alias})
+    node_filter = _node_pred(seed)
+    if node_filter is not None:
+        dep = PlanNode("Filter", deps=[dep], col_names=list(dep.col_names),
+                       args={"condition": node_filter, "match_row": True})
+    aliases[seed.alias] = "vertex"
+
+    cur = dep
+    for i, ep in enumerate(pat.edges):
+        dst = pat.nodes[i + 1]
+        etypes = ep.types or sorted(e.name for e in cat.edges(space))
+        edge_filter = _edge_pred(ep)
+        cols = list(cur.col_names) + [ep.alias, dst.alias]
+        cur = PlanNode("Traverse", deps=[cur], col_names=cols, args={
+            "space": space, "src_col": pat.nodes[i].alias,
+            "edge_alias": ep.alias, "dst_alias": dst.alias,
+            "edge_types": etypes, "direction": ep.direction,
+            "min_hop": ep.min_hop, "max_hop": ep.max_hop,
+            "edge_filter": edge_filter,
+        })
+        aliases[ep.alias] = "edge_list" if ep.max_hop != 1 or ep.min_hop != 1 else "edge"
+        aliases[dst.alias] = "vertex"
+        dst_filter = _node_pred(dst)
+        av_labels = [l for l, _ in dst.labels]
+        cur = PlanNode("AppendVertices", deps=[cur], col_names=list(cur.col_names),
+                       args={"space": space, "col": dst.alias,
+                             "labels": av_labels, "filter": dst_filter})
+    if not pat.edges:
+        # single-node pattern: ensure label presence already filtered
+        if seed.labels and seed_vids is not None:
+            lbl_conds = [FunctionCall("_hastag",
+                                      [LabelExpr(seed.alias), Literal(l)])
+                         for l, _ in seed.labels]
+            cond = lbl_conds[0]
+            for c in lbl_conds[1:]:
+                cond = Binary("AND", cond, c)
+            cur = PlanNode("Filter", deps=[cur], col_names=list(cur.col_names),
+                           args={"condition": cond, "match_row": True})
+    if pat.alias is not None:
+        # named path column
+        cols = list(cur.col_names) + [pat.alias]
+        cur = PlanNode("BuildPath", deps=[cur], col_names=cols, args={
+            "alias": pat.alias,
+            "nodes": [n.alias for n in pat.nodes],
+            "edges": [e.alias for e in pat.edges],
+        })
+        aliases[pat.alias] = "path"
+    return cur
+
+
+def _node_pred(np: A.NodePattern) -> Optional[Expr]:
+    conds: List[Expr] = []
+    for lbl, lprops in np.labels:
+        conds.append(FunctionCall("_hastag", [LabelExpr(np.alias), Literal(lbl)]))
+        if lprops:
+            for k, v in lprops.items():
+                conds.append(Binary("==", LabelTagProp(np.alias, lbl, k), v))
+    if np.props:
+        for k, v in np.props.items():
+            conds.append(Binary("==",
+                                AttributeExpr(LabelExpr(np.alias), k), v))
+    if not conds:
+        return None
+    out = conds[0]
+    for c in conds[1:]:
+        out = Binary("AND", out, c)
+    return out
+
+
+def _edge_pred(ep: A.EdgePattern) -> Optional[Expr]:
+    if not ep.props:
+        return None
+    conds = [Binary("==", AttributeExpr(LabelExpr("__edge__"), k), v)
+             for k, v in ep.props.items()]
+    out = conds[0]
+    for c in conds[1:]:
+        out = Binary("AND", out, c)
+    return out
+
+
+def _choose_seed(pat, where, aliases, current):
+    """Find id(x)==lit / id(x) IN [..] for a pattern node, or a bound alias."""
+    node_aliases = [n.alias for n in pat.nodes]
+    if current is not None:
+        for i, a in enumerate(node_aliases):
+            if a in aliases and aliases[a] == "vertex":
+                return i, None
+    if where is not None:
+        for conj in split_conjuncts(where):
+            if isinstance(conj, Binary) and conj.op in ("==", "IN"):
+                for lhs, rhs in ((conj.lhs, conj.rhs), (conj.rhs, conj.lhs)):
+                    if (isinstance(lhs, FunctionCall) and lhs.name == "id"
+                            and len(lhs.args) == 1
+                            and isinstance(lhs.args[0], LabelExpr)
+                            and lhs.args[0].name in node_aliases
+                            and _is_const(rhs)):
+                        idx = node_aliases.index(lhs.args[0].name)
+                        v = rhs.eval(DictContext())
+                        vids = v if isinstance(v, list) else [v]
+                        return idx, [Literal(x) for x in vids]
+    for i, n in enumerate(pat.nodes):
+        if n.labels or n.props:
+            return i, None
+    return 0, None
+
+
+def _is_const(e: Expr) -> bool:
+    return all(x.kind in ("literal", "list", "map", "set") for x in walk(e))
+
+
+def _reverse_pattern(pat: A.PathPattern):
+    pat.nodes.reverse()
+    pat.edges.reverse()
+    for ep in pat.edges:
+        if ep.direction == "out":
+            ep.direction = "in"
+        elif ep.direction == "in":
+            ep.direction = "out"
+
+
+# ---- FIND PATH / SUBGRAPH -------------------------------------------------
+
+
+def _plan_find_path(pctx, s: A.FindPathSentence) -> PlanNode:
+    space = pctx.need_space()
+    edges = s.over.edges
+    if s.over.is_all:
+        edges = sorted(e.name for e in pctx.catalog.edges(space))
+    src_vids, src_ref = _resolve_from(pctx, s.from_)
+    dst_vids, dst_ref = _resolve_from(pctx, s.to)
+    deps = [pctx.input_node] if (src_ref or dst_ref) and pctx.input_node else []
+    where_expr = None
+    if s.where is not None:
+        where_expr = _rewrite_go_expr(pctx, s.where.filter, edges)
+    col = "path"
+    if s.yield_ is not None and s.yield_.columns:
+        col = _col_name(s.yield_.columns[0])
+    return PlanNode("FindPath", deps=deps, col_names=[col], args={
+        "space": space, "kind": s.kind, "edge_types": edges,
+        "direction": s.over.direction,
+        "src_vids": src_vids, "src_ref": src_ref,
+        "dst_vids": dst_vids, "dst_ref": dst_ref,
+        "upto": s.upto, "with_prop": s.with_prop, "filter": where_expr,
+    })
+
+
+def _plan_subgraph(pctx, s: A.SubgraphSentence) -> PlanNode:
+    space = pctx.need_space()
+    cat = pctx.catalog
+    all_names = sorted(e.name for e in cat.edges(space))
+    in_e, out_e, both_e = s.in_edges, s.out_edges, s.both_edges
+    if s.all_edges or not (in_e or out_e or both_e):
+        both_e = all_names
+    vids, ref = _resolve_from(pctx, s.from_)
+    names = ["_vertices", "_edges"]
+    if s.yield_ is not None:
+        names = [_col_name(c) for c in s.yield_.columns]
+    where_expr = None
+    if s.where is not None:
+        where_expr = _rewrite_go_expr(pctx, s.where.filter, all_names)
+    deps = [pctx.input_node] if ref and pctx.input_node else []
+    yield_spec = []
+    if s.yield_ is not None:
+        for c in s.yield_.columns:
+            t = to_text(c.expr).lower()
+            yield_spec.append("vertices" if "vertices" in t else "edges")
+    else:
+        yield_spec = ["vertices", "edges"]
+    return PlanNode("Subgraph", deps=deps, col_names=names, args={
+        "space": space, "steps": s.steps, "vids": vids, "src_ref": ref,
+        "in_edges": in_e, "out_edges": out_e, "both_edges": both_e,
+        "with_prop": s.with_prop, "filter": where_expr, "yield": yield_spec,
+    })
+
+
+# ---- DML ------------------------------------------------------------------
+
+
+def _const_eval(e: Expr) -> Any:
+    return e.eval(DictContext())
+
+
+def _plan_insert_vertices(pctx, s: A.InsertVerticesSentence) -> PlanNode:
+    space = pctx.need_space()
+    try:
+        ts = pctx.catalog.get_tag(space, s.tag)
+    except SchemaError as ex:
+        raise QueryError(str(ex)) from None
+    for n in s.prop_names:
+        if ts.latest.prop(n) is None:
+            raise QueryError(f"tag `{s.tag}' has no property `{n}'")
+    rows = []
+    for r in s.rows:
+        if len(r.values) != len(s.prop_names):
+            raise QueryError("value count does not match prop count")
+        rows.append((_const_eval(r.vid),
+                     {n: _const_eval(v) for n, v in zip(s.prop_names, r.values)}))
+    return PlanNode("InsertVertices", col_names=[], args={
+        "space": space, "tag": s.tag, "rows": rows,
+        "prop_names": s.prop_names, "if_not_exists": s.if_not_exists})
+
+
+def _plan_insert_edges(pctx, s: A.InsertEdgesSentence) -> PlanNode:
+    space = pctx.need_space()
+    try:
+        es = pctx.catalog.get_edge(space, s.etype)
+    except SchemaError as ex:
+        raise QueryError(str(ex)) from None
+    for n in s.prop_names:
+        if es.latest.prop(n) is None:
+            raise QueryError(f"edge `{s.etype}' has no property `{n}'")
+    rows = []
+    for r in s.rows:
+        if len(r.values) != len(s.prop_names):
+            raise QueryError("value count does not match prop count")
+        rows.append((_const_eval(r.src), _const_eval(r.dst), r.rank,
+                     {n: _const_eval(v) for n, v in zip(s.prop_names, r.values)}))
+    return PlanNode("InsertEdges", col_names=[], args={
+        "space": space, "etype": s.etype, "rows": rows,
+        "prop_names": s.prop_names, "if_not_exists": s.if_not_exists})
+
+
+def _plan_delete_vertices(pctx, s: A.DeleteVerticesSentence) -> PlanNode:
+    space = pctx.need_space()
+    vids, ref = _resolve_from(pctx, s.vids)
+    deps = [pctx.input_node] if ref and pctx.input_node else []
+    return PlanNode("DeleteVertices", deps=deps, col_names=[], args={
+        "space": space, "vids": vids, "src_ref": ref, "with_edge": s.with_edge})
+
+
+def _plan_delete_edges(pctx, s: A.DeleteEdgesSentence) -> PlanNode:
+    space = pctx.need_space()
+    keys = [(_const_eval(k.src), _const_eval(k.dst), k.rank) for k in s.keys]
+    deps = []
+    ref = None
+    if s.ref is not None:
+        deps = [pctx.input_node] if pctx.input_node else []
+        ref = tuple(s.ref)
+    return PlanNode("DeleteEdges", deps=deps, col_names=[], args={
+        "space": space, "etype": s.etype, "keys": keys, "ref": ref})
+
+
+def _plan_delete_tags(pctx, s: A.DeleteTagsSentence) -> PlanNode:
+    space = pctx.need_space()
+    vids, ref = _resolve_from(pctx, s.vids)
+    return PlanNode("DeleteTags", col_names=[], args={
+        "space": space, "tags": s.tags, "vids": vids, "src_ref": ref})
+
+
+def _plan_update(pctx, s: A.UpdateSentence) -> PlanNode:
+    space = pctx.need_space()
+    cat = pctx.catalog
+    try:
+        schema = (cat.get_edge(space, s.schema_name) if s.is_edge
+                  else cat.get_tag(space, s.schema_name))
+    except SchemaError as ex:
+        raise QueryError(str(ex)) from None
+    for name, _ in s.sets:
+        if schema.latest.prop(name) is None:
+            raise QueryError(f"no property `{name}' on `{s.schema_name}'")
+    args: Dict[str, Any] = {
+        "space": space, "is_edge": s.is_edge, "schema": s.schema_name,
+        "sets": s.sets, "when": s.when, "insertable": s.insertable,
+        "yield": [(c.expr, _col_name(c)) for c in (s.yield_.columns if s.yield_ else [])],
+    }
+    if s.is_edge:
+        k = s.edge_key
+        args["edge_key"] = (_const_eval(k.src), _const_eval(k.dst), k.rank)
+    else:
+        args["vid"] = _const_eval(s.vid)
+    cols = [n for _, n in args["yield"]]
+    return PlanNode("Update", col_names=cols, args=args)
+
+
+# ---- DDL / admin ----------------------------------------------------------
+
+
+def _admin(node_kind: str, cols: List[str] = None, **args) -> PlanNode:
+    return PlanNode(node_kind, col_names=cols or [], args=args)
+
+
+def _plan_use(pctx, s: A.UseSentence) -> PlanNode:
+    try:
+        pctx.catalog.get_space(s.space)
+    except SchemaError as ex:
+        raise QueryError(str(ex)) from None
+    pctx.space = s.space
+    return _admin("SwitchSpace", space=s.space)
+
+
+_DISPATCH = {}
+
+
+def _register_dispatch():
+    _DISPATCH.update({
+        A.SeqSentence: _plan_seq,
+        A.PipedSentence: _plan_pipe,
+        A.AssignSentence: _plan_assign,
+        A.SetOpSentence: _plan_setop,
+        A.ExplainSentence: _plan_explain,
+        A.GoSentence: _plan_go,
+        A.YieldSentence: _plan_yield,
+        A.GroupBySentence: _plan_group_by,
+        A.OrderBySentence: _plan_order_by,
+        A.LimitSentence: _plan_limit,
+        A.SampleSentence: _plan_sample,
+        A.FetchVerticesSentence: _plan_fetch_vertices,
+        A.FetchEdgesSentence: _plan_fetch_edges,
+        A.LookupSentence: _plan_lookup,
+        A.MatchSentence: _plan_match,
+        A.FindPathSentence: _plan_find_path,
+        A.SubgraphSentence: _plan_subgraph,
+        A.InsertVerticesSentence: _plan_insert_vertices,
+        A.InsertEdgesSentence: _plan_insert_edges,
+        A.DeleteVerticesSentence: _plan_delete_vertices,
+        A.DeleteEdgesSentence: _plan_delete_edges,
+        A.DeleteTagsSentence: _plan_delete_tags,
+        A.UpdateSentence: _plan_update,
+        A.UseSentence: _plan_use,
+        A.CreateSpaceSentence: lambda p, s: _admin(
+            "CreateSpace", name=s.name, if_not_exists=s.if_not_exists,
+            partition_num=s.partition_num, replica_factor=s.replica_factor,
+            vid_type=s.vid_type),
+        A.DropSpaceSentence: lambda p, s: _admin(
+            "DropSpace", name=s.name, if_exists=s.if_exists),
+        A.CreateSchemaSentence: lambda p, s: _admin(
+            "CreateSchema", is_edge=s.is_edge, name=s.name,
+            props=s.props, if_not_exists=s.if_not_exists,
+            ttl_duration=s.ttl_duration, ttl_col=s.ttl_col,
+            space=p.need_space()),
+        A.AlterSchemaSentence: lambda p, s: _admin(
+            "AlterSchema", is_edge=s.is_edge, name=s.name, adds=s.adds,
+            drops=s.drops, changes=s.changes, ttl_duration=s.ttl_duration,
+            ttl_col=s.ttl_col, space=p.need_space()),
+        A.DropSchemaSentence: lambda p, s: _admin(
+            "DropSchema", is_edge=s.is_edge, name=s.name,
+            if_exists=s.if_exists, space=p.need_space()),
+        A.DescribeSentence: lambda p, s: _admin(
+            "Describe", cols=["Field", "Type", "Null", "Default"],
+            kind=s.kind, name=s.name,
+            space=p.space if s.kind != "space" else None),
+        A.ShowSentence: lambda p, s: _admin(
+            "Show", cols=["Name"], kind=s.kind, extra=s.extra, space=p.space),
+        A.CreateIndexSentence: lambda p, s: _admin(
+            "CreateIndex", is_edge=s.is_edge, index_name=s.index_name,
+            schema_name=s.schema_name, fields=s.fields,
+            if_not_exists=s.if_not_exists, space=p.need_space()),
+        A.DropIndexSentence: lambda p, s: _admin(
+            "DropIndex", is_edge=s.is_edge, index_name=s.index_name,
+            if_exists=s.if_exists, space=p.need_space()),
+        A.RebuildIndexSentence: lambda p, s: _admin(
+            "RebuildIndex", is_edge=s.is_edge, index_name=s.index_name,
+            space=p.need_space()),
+        A.SubmitJobSentence: lambda p, s: _admin(
+            "SubmitJob", cols=["New Job Id"], job=s.job, space=p.space),
+        A.ShowJobsSentence: lambda p, s: _admin(
+            "ShowJobs", cols=["Job Id", "Command", "Status"], job_id=s.job_id),
+        A.CreateSnapshotSentence: lambda p, s: _admin("CreateSnapshot"),
+        A.DropSnapshotSentence: lambda p, s: _admin("DropSnapshot", name=s.name),
+        A.KillQuerySentence: lambda p, s: _admin(
+            "KillQuery", session_id=s.session_id, plan_id=s.plan_id),
+    })
+
+
+_register_dispatch()

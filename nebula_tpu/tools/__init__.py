@@ -1,0 +1,1 @@
+"""Tools: console, csr-dump, db-dump analogs."""

@@ -1,0 +1,105 @@
+"""Interactive nGQL console — the nebula-console analog.
+
+Usage:
+    python -m nebula_tpu.tools.console            # REPL
+    python -m nebula_tpu.tools.console -e 'STMT'  # one-shot
+    python -m nebula_tpu.tools.console -f file.ngql
+    python -m nebula_tpu.tools.console --addr host:port   # cluster graphd
+
+Without --addr it runs an in-process engine (single-process mode).
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+from ..core.value import value_to_string
+from ..exec.engine import QueryEngine, Session
+
+
+def format_result(r) -> str:
+    if not r.ok:
+        return f"[ERROR] {r.error}"
+    ds = r.data
+    if not ds.column_names:
+        return f"Execution succeeded (time spent {r.latency_us}us)"
+    widths = [len(c) for c in ds.column_names]
+    srows = []
+    for row in ds.rows:
+        sr = [value_to_string(c) for c in row]
+        for i, s in enumerate(sr):
+            widths[i] = max(widths[i], min(len(s), 60))
+        srows.append(sr)
+    sep = "+" + "+".join("-" * (w + 2) for w in widths) + "+"
+    out = [sep,
+           "|" + "|".join(f" {c:<{widths[i]}} " for i, c in
+                          enumerate(ds.column_names)) + "|",
+           sep]
+    for sr in srows:
+        out.append("|" + "|".join(
+            f" {s[:60]:<{widths[i]}} " for i, s in enumerate(sr)) + "|")
+    out.append(sep)
+    out.append(f"Got {len(ds.rows)} rows (time spent {r.latency_us}us)")
+    return "\n".join(out)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="nebula-tpu-console")
+    ap.add_argument("-e", "--execute", help="run one statement and exit")
+    ap.add_argument("-f", "--file", help="run statements from a file")
+    ap.add_argument("--addr", help="connect to a cluster graphd host:port")
+    ap.add_argument("--user", default="root")
+    ap.add_argument("--password", default="nebula")
+    args = ap.parse_args(argv)
+
+    if args.addr:
+        from ..cluster.client import GraphClient
+        host, port = args.addr.rsplit(":", 1)
+        client = GraphClient(host, int(port))
+        client.authenticate(args.user, args.password)
+        execute = client.execute
+    else:
+        eng = QueryEngine()
+        sess = eng.new_session(args.user)
+        execute = lambda text: eng.execute(sess, text)  # noqa: E731
+
+    def run_one(text: str) -> int:
+        text = text.strip()
+        if not text:
+            return 0
+        r = execute(text)
+        print(format_result(r))
+        return 0 if r.ok else 1
+
+    if args.execute:
+        return run_one(args.execute)
+    if args.file:
+        with open(args.file) as f:
+            buf = f.read()
+        rc = 0
+        for stmt in buf.split(";"):
+            if stmt.strip():
+                rc |= run_one(stmt)
+        return rc
+
+    print("Welcome to nebula-tpu console. Type `:quit' to exit.")
+    buf = ""
+    while True:
+        try:
+            prompt = "nebula-tpu> " if not buf else "          -> "
+            line = input(prompt)
+        except (EOFError, KeyboardInterrupt):
+            print()
+            break
+        if line.strip() in (":quit", ":exit", "quit", "exit"):
+            break
+        buf += line + "\n"
+        if ";" in line or not line.endswith("\\"):
+            run_one(buf)
+            buf = ""
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

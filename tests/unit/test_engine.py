@@ -1,0 +1,299 @@
+"""End-to-end query engine tests — the minimum E2E slice and beyond."""
+import pytest
+
+from nebula_tpu.core import NULL, Path, Vertex, is_null
+from nebula_tpu.exec import QueryEngine
+
+
+@pytest.fixture()
+def eng():
+    e = QueryEngine()
+    s = e.new_session()
+
+    def run(q):
+        r = e.execute(s, q)
+        assert r.ok, f"{q} -> {r.error}"
+        return r
+
+    run('CREATE SPACE test (partition_num=4, vid_type=FIXED_STRING(20))')
+    run('USE test')
+    run('CREATE TAG person(name string, age int64)')
+    run('CREATE TAG city(pop int64)')
+    run('CREATE EDGE knows(since int64, weight double)')
+    run('CREATE EDGE likes(level int64)')
+    run('INSERT VERTEX person(name, age) VALUES '
+        '"a":("Ann",30), "b":("Bob",25), "c":("Cat",41), "d":("Dan",19), "e":("Eve",33)')
+    run('INSERT EDGE knows(since, weight) VALUES '
+        '"a"->"b":(2010,1.0), "a"->"c":(2012,0.5), "b"->"c":(2015,2.0), '
+        '"c"->"d":(2018,1.5), "d"->"e":(2020,3.0), "e"->"a":(2021,0.1)')
+    run('INSERT EDGE likes(level) VALUES "a"->"d":(5), "b"->"a":(3)')
+    e._run = run
+    e._sess = s
+    return e
+
+
+def rows(eng, q):
+    return eng._run(q).data.rows
+
+
+def test_go_one_step(eng):
+    assert rows(eng, 'GO FROM "a" OVER knows YIELD dst(edge) AS d') == [["b"], ["c"]]
+
+
+def test_go_default_yield(eng):
+    assert rows(eng, 'GO FROM "a" OVER knows') == [["b"], ["c"]]
+
+
+def test_go_reversely(eng):
+    assert rows(eng, 'GO FROM "a" OVER knows REVERSELY YIELD src(edge) AS s') == [["e"]]
+    # dst(edge) under REVERSELY is the stored dst, i.e. "a" itself
+    assert rows(eng, 'GO FROM "a" OVER knows REVERSELY YIELD dst(edge)') == [["a"]]
+
+
+def test_go_bidirect(eng):
+    got = sorted(r[0] for r in rows(
+        eng, 'GO FROM "a" OVER knows BIDIRECT YIELD '
+             'CASE WHEN dst(edge)=="a" THEN src(edge) ELSE dst(edge) END AS other'))
+    assert got == ["b", "c", "e"]
+
+
+def test_go_over_star(eng):
+    got = sorted(r[0] for r in rows(eng, 'GO FROM "a" OVER * YIELD dst(edge) AS d'))
+    assert got == ["b", "c", "d"]
+
+
+def test_go_multi_step_with_filter(eng):
+    got = rows(eng, 'GO 2 STEPS FROM "a" OVER knows '
+                    'WHERE knows.since > 2012 AND $$.person.age > 20 '
+                    'YIELD dst(edge) AS d, $^.person.name AS src_name')
+    assert got == [["c", "Bob"]]
+
+
+def test_go_m_to_n(eng):
+    got = sorted((r[0], r[1]) for r in rows(
+        eng, 'GO 1 TO 2 STEPS FROM "a" OVER knows YIELD dst(edge) AS d, knows.since AS y'))
+    assert got == [("b", 2010), ("c", 2012), ("c", 2015), ("d", 2018)]
+
+
+def test_go_src_dst_props(eng):
+    got = rows(eng, 'GO FROM "b" OVER knows YIELD $^.person.age AS sa, '
+                    '$$.person.age AS da, knows.weight AS w')
+    assert got == [[25, 41, 2.0]]
+
+
+def test_go_pipe_group_order_limit(eng):
+    got = rows(eng, 'GO 1 TO 3 STEPS FROM "a" OVER knows YIELD dst(edge) AS d '
+                    '| GROUP BY $-.d YIELD $-.d AS d, count(*) AS c '
+                    '| ORDER BY $-.c DESC, $-.d | LIMIT 2')
+    assert got == [["c", 2], ["d", 2]]
+
+
+def test_go_from_pipe_input(eng):
+    got = rows(eng, 'GO FROM "a" OVER knows YIELD dst(edge) AS d '
+                    '| GO FROM $-.d OVER knows YIELD $-.d AS via, dst(edge) AS d2')
+    assert sorted(map(tuple, got)) == [("b", "c"), ("c", "d")]
+
+
+def test_assignment_var(eng):
+    eng._run('$v = GO FROM "a" OVER knows YIELD dst(edge) AS d')
+    got = rows(eng, 'GO FROM $v.d OVER knows YIELD dst(edge) AS d2')
+    assert sorted(r[0] for r in got) == ["c", "d"]
+    assert rows(eng, 'YIELD $v.d AS d') == [["b"], ["c"]]
+
+
+def test_go_distinct(eng):
+    got = rows(eng, 'GO 2 STEPS FROM "a","b" OVER knows YIELD DISTINCT dst(edge) AS d')
+    assert sorted(r[0] for r in got) == ["c", "d"]
+
+
+def test_go_zero_neighbors(eng):
+    eng._run('INSERT VERTEX person(name, age) VALUES "z":("Zoe", 50)')
+    assert rows(eng, 'GO FROM "z" OVER knows') == []
+
+
+def test_yield_standalone(eng):
+    assert rows(eng, 'YIELD 1 + 2 AS x, "hi" AS s') == [[3, "hi"]]
+    assert rows(eng, 'YIELD 1/0 AS d')[0][0].kind.value == "__DIV_BY_ZERO__"
+
+
+def test_match_basic(eng):
+    got = rows(eng, 'MATCH (v:person)-[e:knows]->(v2) WHERE v.person.age > 30 '
+                    'RETURN v2.person.name AS n, e.since AS y ORDER BY n')
+    assert got == [["Ann", 2021], ["Dan", 2018]]
+
+
+def test_match_id_seed(eng):
+    got = rows(eng, 'MATCH (a)-[e:knows]->(b) WHERE id(a) == "a" '
+                    'RETURN b.person.name AS n ORDER BY n')
+    assert got == [["Bob"], ["Cat"]]
+
+
+def test_match_varlen(eng):
+    got = rows(eng, 'MATCH p = (a)-[e:knows*1..2]->(b) WHERE id(a) == "a" '
+                    'RETURN b.person.name AS n, length(p) AS l ORDER BY l, n')
+    assert got == [["Bob", 1], ["Cat", 1], ["Cat", 2], ["Dan", 2]]
+
+
+def test_match_incoming(eng):
+    got = rows(eng, 'MATCH (a)<-[e:knows]-(b) WHERE id(a) == "c" '
+                    'RETURN b.person.name AS n ORDER BY n')
+    assert got == [["Ann"], ["Bob"]]
+
+
+def test_match_both_direction(eng):
+    got = rows(eng, 'MATCH (a)-[e:knows]-(b) WHERE id(a) == "a" '
+                    'RETURN b.person.name AS n ORDER BY n')
+    assert got == [["Bob"], ["Cat"], ["Eve"]]
+
+
+def test_match_props_pattern(eng):
+    got = rows(eng, 'MATCH (v:person{name:"Ann"})-[e:knows]->(b) '
+                    'RETURN b.person.name AS n ORDER BY n')
+    assert got == [["Bob"], ["Cat"]]
+
+
+def test_match_return_aggregate(eng):
+    got = rows(eng, 'MATCH (v:person)-[e:knows]->(b) '
+                    'RETURN v.person.name AS n, count(*) AS c ORDER BY n')
+    assert got == [["Ann", 2], ["Bob", 1], ["Cat", 1], ["Dan", 1], ["Eve", 1]]
+
+
+def test_match_with_unwind(eng):
+    got = rows(eng, 'MATCH (v:person) WITH v.person.age AS age WHERE age > 30 '
+                    'RETURN age ORDER BY age')
+    assert got == [[33], [41]]
+    got2 = rows(eng, 'UNWIND [1,2,3] AS x RETURN x * 10 AS y')
+    assert got2 == [[10], [20], [30]]
+
+
+def test_match_optional(eng):
+    got = rows(eng, 'MATCH (v:person{name:"Eve"}) '
+                    'OPTIONAL MATCH (v)-[e:likes]->(o) RETURN v.person.name, o')
+    assert len(got) == 1 and is_null(got[0][1])
+
+
+def test_match_named_path(eng):
+    got = rows(eng, 'MATCH p = (a)-[:knows]->(b) WHERE id(a) == "a" '
+                    'RETURN nodes(p)[0] AS s ORDER BY id(s) LIMIT 1')
+    assert isinstance(got[0][0], Vertex)
+    assert got[0][0].vid == "a"
+
+
+def test_find_shortest_path(eng):
+    got = rows(eng, 'FIND SHORTEST PATH FROM "a" TO "e" OVER knows YIELD path AS p')
+    assert len(got) == 1
+    p = got[0][0]
+    assert isinstance(p, Path)
+    assert [v.vid for v in p.nodes()] == ["a", "c", "d", "e"]
+
+
+def test_find_all_path(eng):
+    got = rows(eng, 'FIND ALL PATH FROM "a" TO "c" OVER knows UPTO 3 STEPS YIELD path AS p')
+    lens = sorted(r[0].length() for r in got)
+    assert lens == [1, 2]   # a->c and a->b->c
+
+
+def test_find_noloop_path(eng):
+    got = rows(eng, 'FIND NOLOOP PATH FROM "a" TO "a" OVER knows UPTO 6 STEPS YIELD path AS p')
+    assert got == []  # loop back to self excluded
+
+
+def test_subgraph(eng):
+    r = eng._run('GET SUBGRAPH 2 STEPS FROM "a" OUT knows YIELD VERTICES AS v, EDGES AS e')
+    assert len(r.data.rows) >= 2
+    all_vids = sorted({v.vid for row in r.data.rows for v in row[0]})
+    assert all_vids == ["a", "b", "c", "d"]
+
+
+def test_lookup(eng):
+    got = rows(eng, 'LOOKUP ON person WHERE person.age > 30 '
+                    'YIELD id(vertex) AS id, person.name AS name')
+    assert sorted(map(tuple, got)) == [("c", "Cat"), ("e", "Eve")]
+    got2 = rows(eng, 'LOOKUP ON knows WHERE knows.since >= 2018 YIELD src(edge) AS s')
+    assert sorted(r[0] for r in got2) == ["c", "d", "e"]
+
+
+def test_fetch(eng):
+    got = rows(eng, 'FETCH PROP ON person "a" YIELD properties(vertex).name AS n, '
+                    'properties(vertex).age AS a')
+    assert got == [["Ann", 30]]
+    got2 = rows(eng, 'FETCH PROP ON knows "a"->"b" YIELD properties(edge).since AS y')
+    assert got2 == [[2010]]
+
+
+def test_update_and_fetch(eng):
+    eng._run('UPDATE VERTEX ON person "a" SET age = age + 1')
+    assert rows(eng, 'FETCH PROP ON person "a" YIELD properties(vertex).age AS a') == [[31]]
+    eng._run('UPDATE EDGE ON knows "a"->"b" SET since = 2011')
+    assert rows(eng, 'FETCH PROP ON knows "a"->"b" YIELD properties(edge).since') == [[2011]]
+
+
+def test_upsert_creates(eng):
+    eng._run('UPSERT VERTEX ON city "sf" SET pop = 800000')
+    got = rows(eng, 'FETCH PROP ON city "sf" YIELD properties(vertex).pop AS p')
+    assert got == [[800000]]
+
+
+def test_delete(eng):
+    eng._run('INSERT VERTEX person(name, age) VALUES "tmp":("Tmp", 1)')
+    eng._run('INSERT EDGE knows(since, weight) VALUES "tmp"->"a":(2000, 0.0)')
+    eng._run('DELETE VERTEX "tmp" WITH EDGE')
+    assert rows(eng, 'GO FROM "a" OVER knows REVERSELY YIELD src(edge) AS s') == [["e"]]
+    eng._run('DELETE EDGE likes "b"->"a"')
+    assert rows(eng, 'GO FROM "b" OVER likes') == []
+
+
+def test_union_intersect_minus(eng):
+    got = rows(eng, 'GO FROM "a" OVER knows YIELD dst(edge) AS d '
+                    'UNION GO FROM "b" OVER knows YIELD dst(edge) AS d')
+    assert sorted(r[0] for r in got) == ["b", "c"]
+    got2 = rows(eng, 'GO FROM "a" OVER knows YIELD dst(edge) AS d '
+                     'INTERSECT GO FROM "b" OVER knows YIELD dst(edge) AS d')
+    assert got2 == [["c"]]
+    got3 = rows(eng, 'GO FROM "a" OVER knows YIELD dst(edge) AS d '
+                     'MINUS GO FROM "b" OVER knows YIELD dst(edge) AS d')
+    assert got3 == [["b"]]
+
+
+def test_show_describe(eng):
+    assert ["test"] in rows(eng, 'SHOW SPACES')
+    assert sorted(r[0] for r in rows(eng, 'SHOW TAGS')) == ["city", "person"]
+    assert sorted(r[0] for r in rows(eng, 'SHOW EDGES')) == ["knows", "likes"]
+    d = rows(eng, 'DESCRIBE TAG person')
+    assert d[0][:2] == ["name", "string"]
+
+
+def test_explain_and_profile(eng):
+    r = eng._run('EXPLAIN GO FROM "a" OVER knows')
+    assert "ExpandAll" in r.data.rows[0][0]
+    r2 = eng._run('PROFILE GO FROM "a" OVER knows')
+    assert "rows=" in r2.data.rows[0][0]
+
+
+def test_index_ddl_and_jobs(eng):
+    eng._run('CREATE TAG INDEX idx_age ON person(age)')
+    assert ["idx_age", "person", ["age"]] in rows(eng, 'SHOW TAG INDEXES')
+    r = eng._run('SUBMIT JOB STATS')
+    jid = r.data.rows[0][0]
+    jobs = rows(eng, 'SHOW JOBS')
+    assert any(j[0] == jid and j[2] == "FINISHED" for j in jobs)
+
+
+def test_errors_are_reported(eng):
+    r = eng.execute(eng._sess, 'GO FROM "a" OVER nosuchedge')
+    assert not r.ok and "nosuchedge" in r.error
+    r2 = eng.execute(eng._sess, 'GOGO 1')
+    assert not r2.ok and "SyntaxError" in r2.error
+    r3 = eng.execute(eng._sess, 'GO FROM "a" OVER knows WHERE knows.nope > 1')
+    assert not r3.ok and "nope" in r3.error
+
+
+def test_aggregate_empty_group(eng):
+    assert rows(eng, 'GO FROM "zzz" OVER knows YIELD dst(edge) AS d '
+                     '| GROUP BY 1 YIELD count(*) AS c') == []
+    got = rows(eng, 'MATCH (v:person{name:"NoOne"}) RETURN count(*) AS c')
+    assert got == [[0]]
+
+
+def test_case_insensitive_keywords(eng):
+    assert rows(eng, 'go from "a" over knows yield dst(edge) as d') == [["b"], ["c"]]

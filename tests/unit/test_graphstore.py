@@ -1,0 +1,186 @@
+"""Graph store + schema + CSR snapshot tests."""
+import numpy as np
+import pytest
+
+from nebula_tpu.core import NULL, is_null
+from nebula_tpu.graphstore import (Catalog, GraphStore, PropDef, PropType,
+                                   SchemaError, build_snapshot,
+                                   expand_frontier_host, neighbors_of,
+                                   stable_vid_hash)
+
+
+def mk_store(parts=4):
+    st = GraphStore()
+    st.create_space("test", partition_num=parts, vid_type="FIXED_STRING(32)")
+    st.catalog.create_tag("test", "person", [
+        PropDef("name", PropType.STRING),
+        PropDef("age", PropType.INT64),
+    ])
+    st.catalog.create_edge("test", "knows", [
+        PropDef("since", PropType.INT64),
+        PropDef("weight", PropType.DOUBLE),
+    ])
+    return st
+
+
+def seed(st):
+    people = [("a", "Ann", 30), ("b", "Bob", 25), ("c", "Cat", 41),
+              ("d", "Dan", 19), ("e", "Eve", 33)]
+    for vid, name, age in people:
+        st.insert_vertex("test", vid, "person", {"name": name, "age": age})
+    edges = [("a", "b", 2010, 1.0), ("a", "c", 2012, 0.5), ("b", "c", 2015, 2.0),
+             ("c", "d", 2018, 1.5), ("d", "e", 2020, 3.0), ("e", "a", 2021, 0.1)]
+    for s, d, y, w in edges:
+        st.insert_edge("test", s, "knows", d, 0, {"since": y, "weight": w})
+    return st
+
+
+def test_schema_ddl():
+    c = Catalog()
+    c.create_space("s1", partition_num=2)
+    c.create_tag("s1", "t", [PropDef("x", PropType.INT64)])
+    with pytest.raises(SchemaError):
+        c.create_tag("s1", "t", [])
+    c.create_tag("s1", "t", [], if_not_exists=True)
+    with pytest.raises(SchemaError):
+        c.create_edge("s1", "t", [])  # name conflict with tag
+    c.alter_tag("s1", "t", [PropDef("x", PropType.INT64), PropDef("y", PropType.STRING)])
+    assert c.get_tag("s1", "t").latest.version == 1
+    assert len(c.get_tag("s1", "t").versions) == 2
+    c.create_index("s1", "idx_x", "t", ["x"], is_edge=False)
+    with pytest.raises(SchemaError):
+        c.create_index("s1", "bad", "t", ["nope"], is_edge=False)
+
+
+def test_defaults_and_nullability():
+    st = GraphStore()
+    st.create_space("s", partition_num=2)
+    st.catalog.create_tag("s", "t", [
+        PropDef("a", PropType.INT64, nullable=False, default=7, has_default=True),
+        PropDef("b", PropType.STRING, nullable=True),
+        PropDef("c", PropType.INT64, nullable=False),
+    ])
+    with pytest.raises(SchemaError):
+        st.insert_vertex("s", "v1", "t", {})  # c not null, no default
+    st.insert_vertex("s", "v1", "t", {"c": 1})
+    row = st.get_vertex("s", "v1")["t"]
+    assert row["a"] == 7 and is_null(row["b"]) and row["c"] == 1
+    with pytest.raises(SchemaError):
+        st.insert_vertex("s", "v2", "t", {"c": "wrong type"})
+
+
+def test_insert_and_get_neighbors():
+    st = seed(mk_store())
+    out = list(st.get_neighbors("test", ["a"], ["knows"], "out"))
+    assert [(r[0], r[3]) for r in out] == [("a", "b"), ("a", "c")]
+    assert out[0][4]["since"] == 2010
+    inn = list(st.get_neighbors("test", ["c"], ["knows"], "in"))
+    assert sorted((r[3]) for r in inn) == ["a", "b"]
+    assert all(r[5] == -1 for r in inn)
+    both = list(st.get_neighbors("test", ["c"], None, "both"))
+    assert len(both) == 3  # out: d; in: a, b
+
+
+def test_delete_vertex_cascades():
+    st = seed(mk_store())
+    st.delete_vertex("test", "c")
+    assert st.get_vertex("test", "c") is None
+    assert list(st.get_neighbors("test", ["a"], ["knows"], "out")) == [
+        ("a", "knows", 0, "b", {"since": 2010, "weight": 1.0}, 1)]
+    assert list(st.get_neighbors("test", ["d"], ["knows"], "in")) == []
+
+
+def test_update():
+    st = seed(mk_store())
+    assert st.update_vertex("test", "a", "person", {"age": 31})
+    assert st.get_vertex("test", "a")["person"]["age"] == 31
+    assert st.update_edge("test", "a", "knows", "b", 0, {"since": 1999})
+    assert st.get_edge("test", "a", "knows", "b")["since"] == 1999
+    # in-plane mirror also updated
+    inn = list(st.get_neighbors("test", ["b"], ["knows"], "in"))
+    assert inn[0][4]["since"] == 1999
+    assert not st.update_edge("test", "x", "knows", "y", 0, {"since": 1})
+
+
+def test_dense_ids_encode_partition():
+    st = seed(mk_store(parts=4))
+    sd = st.space("test")
+    for vid, d in sd.vid_to_dense.items():
+        assert d % 4 == sd.part_of(vid)
+        assert sd.dense_to_vid[d] == vid
+
+
+def test_stable_hash():
+    assert stable_vid_hash("abc") == stable_vid_hash("abc")
+    assert stable_vid_hash(42) == 42
+
+
+def test_csr_snapshot_matches_store():
+    st = seed(mk_store(parts=4))
+    snap = build_snapshot(st, "test")
+    sd = st.space("test")
+    blk = snap.block("knows", "out")
+    assert blk.total_edges() == 6
+    # every vertex's CSR neighbors == store's get_neighbors dsts
+    for vid, dense in sd.vid_to_dense.items():
+        want = [sd.vid_to_dense[r[3]]
+                for r in st.get_neighbors("test", [vid], ["knows"], "out")]
+        got = list(neighbors_of(snap, blk, dense))
+        assert got == want, (vid, got, want)
+    # reversed block
+    blk_in = snap.block("knows", "in")
+    for vid, dense in sd.vid_to_dense.items():
+        want = sorted(sd.vid_to_dense[r[3]]
+                      for r in st.get_neighbors("test", [vid], ["knows"], "in"))
+        got = sorted(neighbors_of(snap, blk_in, dense))
+        assert got == want
+
+
+def test_csr_props_and_strings():
+    st = seed(mk_store(parts=2))
+    st.insert_vertex("test", "f", "person", {"name": "Fox", "age": NULL})
+    snap = build_snapshot(st, "test")
+    tt = snap.tags["person"]
+    sd = st.space("test")
+    d = sd.vid_to_dense["f"]
+    p, li = snap.owner(d), snap.local(d)
+    assert tt.present[p, li]
+    from nebula_tpu.graphstore import INT_NULL
+    assert tt.props["age"][p, li] == INT_NULL  # null sentinel
+    code = tt.props["name"][p, li]
+    assert snap.pool.decode(int(code)) == "Fox"
+    assert snap.pool.lookup("Fox") == code
+    assert snap.pool.lookup("NotThere") == -2
+    # edge prop column
+    blk = snap.block("knows", "out")
+    a = sd.vid_to_dense["a"]
+    pa, la = snap.owner(a), snap.local(a)
+    lo = int(blk.indptr[pa, la])
+    assert blk.props["since"][pa, lo] == 2010
+    assert blk.props["weight"][pa, lo] == 1.0
+
+
+def test_expand_frontier_host():
+    st = seed(mk_store(parts=4))
+    snap = build_snapshot(st, "test")
+    sd = st.space("test")
+    blk = snap.block("knows", "out")
+    f0 = np.array([sd.vid_to_dense["a"]], np.int32)
+    f1 = expand_frontier_host(snap, blk, f0)
+    assert sorted(sd.dense_to_vid[d] for d in f1) == ["b", "c"]
+    f2 = expand_frontier_host(snap, blk, f1)
+    assert sorted(sd.dense_to_vid[d] for d in f2) == ["c", "d"]
+
+
+def test_epoch_bumps():
+    st = mk_store()
+    e0 = st.space("test").epoch
+    st.insert_vertex("test", "z", "person", {"name": "Z", "age": 1})
+    assert st.space("test").epoch > e0
+
+
+def test_scan():
+    st = seed(mk_store())
+    assert len(list(st.scan_vertices("test"))) == 5
+    assert len(list(st.scan_edges("test", "knows"))) == 6
+    assert len(list(st.scan_vertices("test", tag="person"))) == 5

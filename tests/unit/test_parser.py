@@ -1,0 +1,256 @@
+"""Parser tests: statement → AST round-trips + error cases."""
+import pytest
+
+from nebula_tpu.core.expr import (AggExpr, AttributeExpr, Binary, InputProp,
+                                  LabelExpr, Literal, SrcProp, to_text)
+from nebula_tpu.query import ast as A
+from nebula_tpu.query.parser import ParseError, parse
+
+
+def test_go_basic():
+    s = parse('GO FROM "a" OVER knows')
+    assert isinstance(s, A.GoSentence)
+    assert s.steps.m == 1 and s.steps.n == 1
+    assert s.over.edges == ["knows"]
+    assert s.from_.vids[0].value == "a"
+
+
+def test_go_full():
+    s = parse('GO 2 TO 4 STEPS FROM "a","b" OVER knows, likes REVERSELY '
+              'WHERE knows.since > 2010 YIELD DISTINCT dst(edge) AS d, $$.person.age')
+    assert s.steps.m == 2 and s.steps.n == 4
+    assert s.over.direction == "in"
+    assert s.over.edges == ["knows", "likes"]
+    assert len(s.from_.vids) == 2
+    assert s.yield_.distinct
+    assert s.yield_.columns[0].alias == "d"
+    assert to_text(s.where.filter) == "(knows.since > 2010)"
+
+
+def test_go_over_star_pipe():
+    s = parse('GO FROM "a" OVER * YIELD dst(edge) AS d | GO FROM $-.d OVER knows')
+    assert isinstance(s, A.PipedSentence)
+    assert s.left.over.is_all
+    assert s.right.from_.ref is not None
+    assert isinstance(s.right.from_.ref, InputProp)
+
+
+def test_assignment_and_seq():
+    s = parse('$var = GO FROM "a" OVER e YIELD dst(edge) AS d; YIELD $var.d')
+    assert isinstance(s, A.SeqSentence)
+    assert isinstance(s.stmts[0], A.AssignSentence)
+    assert s.stmts[0].var == "var"
+
+
+def test_ddl_space():
+    s = parse("CREATE SPACE IF NOT EXISTS s1 (partition_num=4, replica_factor=1, "
+              "vid_type=FIXED_STRING(20))")
+    assert isinstance(s, A.CreateSpaceSentence)
+    assert s.if_not_exists and s.partition_num == 4
+    assert s.vid_type == "FIXED_STRING(20)"
+    s2 = parse("DROP SPACE IF EXISTS s1")
+    assert s2.if_exists
+
+
+def test_ddl_tag():
+    s = parse('CREATE TAG person(name string, age int64 NOT NULL DEFAULT 18, '
+              'score double NULL)')
+    assert isinstance(s, A.CreateSchemaSentence)
+    assert not s.is_edge
+    assert [p.name for p in s.props] == ["name", "age", "score"]
+    assert s.props[1].nullable is False
+    assert s.props[1].default.value == 18
+
+
+def test_ddl_edge_and_index():
+    s = parse("CREATE EDGE knows(since int64)")
+    assert s.is_edge
+    s2 = parse("CREATE TAG INDEX idx_name ON person(name)")
+    assert isinstance(s2, A.CreateIndexSentence)
+    assert s2.fields == ["name"]
+    s3 = parse("REBUILD TAG INDEX idx_name")
+    assert isinstance(s3, A.RebuildIndexSentence)
+
+
+def test_alter():
+    s = parse("ALTER TAG person ADD (city string), DROP (score)")
+    assert s.adds[0].name == "city"
+    assert s.drops == ["score"]
+
+
+def test_insert_vertex():
+    s = parse('INSERT VERTEX person(name, age) VALUES "a":("Ann", 30), "b":("Bob", 25)')
+    assert isinstance(s, A.InsertVerticesSentence)
+    assert len(s.rows) == 2
+    assert s.rows[0].vid.value == "a"
+    assert s.rows[1].values[1].value == 25
+
+
+def test_insert_edge():
+    s = parse('INSERT EDGE knows(since) VALUES "a"->"b"@3:(2010)')
+    assert isinstance(s, A.InsertEdgesSentence)
+    assert s.rows[0].rank == 3
+
+
+def test_update_upsert():
+    s = parse('UPDATE VERTEX ON person "a" SET age = age + 1 WHEN age > 10 YIELD name')
+    assert isinstance(s, A.UpdateSentence)
+    assert not s.insertable and s.when is not None
+    s2 = parse('UPSERT EDGE ON knows "a"->"b" SET since = 2020')
+    assert s2.insertable and s2.edge_key.rank == 0
+
+
+def test_delete():
+    s = parse('DELETE VERTEX "a", "b" WITH EDGE')
+    assert isinstance(s, A.DeleteVerticesSentence) and s.with_edge
+    s2 = parse('DELETE EDGE knows "a"->"b"@0, "b"->"c"')
+    assert len(s2.keys) == 2
+    s3 = parse('DELETE TAG person FROM "a"')
+    assert s3.tags == ["person"]
+
+
+def test_fetch():
+    s = parse('FETCH PROP ON person "a", "b" YIELD properties(vertex)')
+    assert isinstance(s, A.FetchVerticesSentence)
+    assert s.tags == ["person"]
+    s2 = parse('FETCH PROP ON * "a"')
+    assert s2.tags == []
+    s3 = parse('FETCH PROP ON knows "a"->"b" YIELD properties(edge)')
+    assert isinstance(s3, A.FetchEdgesSentence)
+
+
+def test_lookup():
+    s = parse('LOOKUP ON person WHERE person.age > 20 YIELD id(vertex) AS id')
+    assert isinstance(s, A.LookupSentence)
+    assert s.schema_name == "person"
+
+
+def test_match_basic():
+    s = parse('MATCH (v:person{name:"Ann"})-[e:knows]->(v2) RETURN v2.person.age AS age')
+    assert isinstance(s, A.MatchSentence)
+    mc = s.clauses[0]
+    assert isinstance(mc, A.MatchClauseAst)
+    pat = mc.patterns[0]
+    assert len(pat.nodes) == 2 and len(pat.edges) == 1
+    assert pat.nodes[0].labels[0][0] == "person"
+    assert pat.edges[0].types == ["knows"]
+    assert pat.edges[0].direction == "out"
+
+
+def test_match_varlen_and_direction():
+    s = parse("MATCH p = (a)-[e:knows*1..3]->(b) WHERE id(a) == \"x\" "
+              "RETURN p ORDER BY id(b) SKIP 1 LIMIT 5")
+    pat = s.clauses[0].patterns[0]
+    assert pat.alias == "p"
+    assert pat.edges[0].min_hop == 1 and pat.edges[0].max_hop == 3
+    assert s.return_.skip == 1 and s.return_.limit == 5
+    s2 = parse("MATCH (a)<-[:knows]-(b) RETURN b")
+    assert s2.clauses[0].patterns[0].edges[0].direction == "in"
+    s3 = parse("MATCH (a)-[]-(b) RETURN b")
+    assert s3.clauses[0].patterns[0].edges[0].direction == "both"
+
+
+def test_match_with_unwind():
+    s = parse("MATCH (v:person) WITH v.person.age AS age WHERE age > 10 "
+              "UNWIND [1,2,3] AS x RETURN age, x")
+    kinds = [type(c).__name__ for c in s.clauses]
+    assert kinds == ["MatchClauseAst", "WithClauseAst", "UnwindClauseAst"]
+
+
+def test_find_path():
+    s = parse('FIND SHORTEST PATH FROM "a" TO "b" OVER * UPTO 4 STEPS YIELD path AS p')
+    assert isinstance(s, A.FindPathSentence)
+    assert s.kind == "shortest" and s.upto == 4
+    s2 = parse('FIND ALL PATH WITH PROP FROM "a" TO "b","c" OVER knows')
+    assert s2.kind == "all" and s2.with_prop
+
+
+def test_subgraph():
+    s = parse('GET SUBGRAPH WITH PROP 2 STEPS FROM "a" BOTH knows '
+              'YIELD VERTICES AS nodes, EDGES AS relationships')
+    assert isinstance(s, A.SubgraphSentence)
+    assert s.steps == 2 and s.both_edges == ["knows"]
+
+
+def test_yield_group_order_limit():
+    s = parse('GO FROM "a" OVER e YIELD dst(edge) AS d, 1 AS one '
+              '| GROUP BY $-.d YIELD $-.d, count(*) AS c '
+              '| ORDER BY $-.c DESC | LIMIT 3, 10')
+    seg = s
+    assert isinstance(seg, A.PipedSentence)
+    assert isinstance(seg.right, A.LimitSentence)
+    assert seg.right.offset == 3 and seg.right.count == 10
+    ob = seg.left.right
+    assert isinstance(ob, A.OrderBySentence)
+    assert not ob.factors[0].ascending
+    gb = seg.left.left.right
+    assert isinstance(gb, A.GroupBySentence)
+    assert isinstance(gb.yield_.columns[1].expr, AggExpr)
+
+
+def test_union():
+    s = parse('GO FROM "a" OVER e UNION ALL GO FROM "b" OVER e')
+    assert isinstance(s, A.SetOpSentence)
+    assert s.op == "UNION ALL"
+
+
+def test_explain_profile():
+    s = parse('EXPLAIN GO FROM "a" OVER e')
+    assert isinstance(s, A.ExplainSentence) and not s.profile
+    s2 = parse('PROFILE GO FROM "a" OVER e')
+    assert s2.profile
+
+
+def test_show_describe():
+    assert parse("SHOW SPACES").kind == "spaces"
+    assert parse("SHOW TAGS").kind == "tags"
+    assert parse("SHOW HOSTS").kind == "hosts"
+    d = parse("DESCRIBE TAG person")
+    assert d.kind == "tag" and d.name == "person"
+
+
+def test_use():
+    assert parse("USE nba").space == "nba"
+
+
+def test_expr_precedence():
+    s = parse("YIELD 1 + 2 * 3 == 7 AND NOT false AS x")
+    e = s.yield_.columns[0].expr
+    assert e.eval.__self__ is not None
+    from nebula_tpu.core.expr import DictContext
+    assert e.eval(DictContext()) is True
+
+
+def test_complex_exprs():
+    from nebula_tpu.core.expr import DictContext
+    s = parse('YIELD [x IN range(1,5) WHERE x % 2 == 0 | x * 10] AS l, '
+              'CASE WHEN 1 > 2 THEN "a" ELSE "b" END AS c, '
+              'reduce(acc = 0, x IN [1,2,3] | acc + x) AS r')
+    ctx = DictContext()
+    cols = s.yield_.columns
+    assert cols[0].expr.eval(ctx) == [20, 40]
+    assert cols[1].expr.eval(ctx) == "b"
+    assert cols[2].expr.eval(ctx) == 6
+
+
+def test_errors():
+    with pytest.raises(ParseError):
+        parse("GO FROM")
+    with pytest.raises(ParseError):
+        parse("FROB 1")
+    with pytest.raises(ParseError):
+        parse('MATCH (a)-[e]->(b)')  # no RETURN
+    with pytest.raises(ParseError):
+        parse('GO FROM "a" OVER e YIELD')
+
+
+def test_backquote_and_comments():
+    s = parse('GO FROM "a" OVER `order` /* hi */ YIELD dst(edge) # trailing')
+    assert s.over.edges == ["order"]
+
+
+def test_src_dst_prop():
+    s = parse('GO FROM "a" OVER e WHERE $^.person.age > $$.person.age')
+    f = s.where.filter
+    assert isinstance(f.lhs, SrcProp)
+    assert to_text(f) == "($^.person.age > $$.person.age)"

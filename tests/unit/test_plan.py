@@ -1,0 +1,124 @@
+"""Golden-plan tests: plan shapes + optimizer rules.
+
+Mirrors the reference's validator/optimizer test pattern of asserting on
+node-kind sequences (SURVEY §4).
+"""
+import pytest
+
+from nebula_tpu.exec import QueryEngine
+from nebula_tpu.query.optimizer import optimize
+from nebula_tpu.query.parser import parse
+from nebula_tpu.query.plan import ExecutionPlan
+from nebula_tpu.query.planner import PlannerContext, _plan
+
+
+@pytest.fixture()
+def eng():
+    e = QueryEngine()
+    s = e.new_session()
+    for q in ['CREATE SPACE t (partition_num=2)', 'USE t',
+              'CREATE TAG person(name string, age int64)',
+              'CREATE EDGE knows(since int64)']:
+        r = e.execute(s, q)
+        assert r.ok, r.error
+    e._sess = s
+    return e
+
+
+def plan_of(eng, text, opt=True):
+    pctx = PlannerContext(eng.qctx, "t")
+    root = _plan(pctx, parse(text))
+    p = ExecutionPlan(root, pctx.space)
+    return optimize(p, enable=opt)
+
+
+def test_go_plan_shape(eng):
+    p = plan_of(eng, 'GO FROM "a" OVER knows YIELD dst(edge) AS d', opt=False)
+    assert p.root.kind_tree() == ["Project", "ExpandAll", "Start"]
+
+
+def test_go_two_step_plan(eng):
+    p = plan_of(eng, 'GO 2 STEPS FROM "a" OVER knows', opt=False)
+    assert p.root.kind_tree() == [
+        "Project", "ExpandAll", "Dedup", "Project", "ExpandAll", "Start"]
+
+
+def test_go_m_to_n_union(eng):
+    p = plan_of(eng, 'GO 1 TO 2 STEPS FROM "a" OVER knows', opt=False)
+    kinds = p.root.kind_tree()
+    assert kinds[0] == "Union"
+    assert kinds.count("ExpandAll") == 3  # shared frontier chain + 2 branches... (1st reused)
+
+
+def test_filter_pushdown_into_expand(eng):
+    p = plan_of(eng, 'GO FROM "a" OVER knows WHERE knows.since > 5 YIELD dst(edge)')
+    kinds = p.root.kind_tree()
+    assert "Filter" not in kinds          # fully absorbed
+    exp = p.root
+    while exp.kind != "ExpandAll":
+        exp = exp.dep()
+    assert exp.args["edge_filter"] is not None
+
+
+def test_filter_partial_pushdown(eng):
+    p = plan_of(eng, 'GO FROM "a" OVER knows '
+                     'WHERE knows.since > 5 AND $$.person.age > 10 YIELD dst(edge)')
+    kinds = p.root.kind_tree()
+    assert "Filter" in kinds              # dst-prop conjunct stays
+    exp = p.root
+    while exp.kind != "ExpandAll":
+        exp = exp.dep()
+    assert "since" in str(exp.args["edge_filter"])
+    f = p.root
+    while f.kind != "Filter":
+        f = f.dep()
+    assert "age" in str(f.args["condition"])
+
+
+def test_topn_fusion(eng):
+    p = plan_of(eng, 'GO FROM "a" OVER knows YIELD dst(edge) AS d '
+                     '| ORDER BY $-.d | LIMIT 3')
+    assert p.root.kind == "TopN"
+    assert p.root.args["count"] == 3
+
+
+def test_match_plan_shape(eng):
+    p = plan_of(eng, 'MATCH (v:person)-[e:knows]->(b) RETURN b', opt=False)
+    kinds = p.root.kind_tree()
+    assert kinds == ["Project", "AppendVertices", "Traverse", "Filter",
+                     "ScanVertices"]
+
+
+def test_match_edge_filter_pushdown(eng):
+    p = plan_of(eng, 'MATCH (v:person)-[e:knows]->(b) WHERE e.since > 3 RETURN b')
+    # the e.since conjunct must reach the Traverse node
+    tv = None
+    for k in p.root.kind_tree():
+        pass
+    node = p.root
+    stack = [node]
+    while stack:
+        n = stack.pop()
+        if n.kind == "Traverse":
+            tv = n
+        stack.extend(n.deps)
+    assert tv is not None and tv.args.get("edge_filter") is not None
+
+
+def test_match_seed_by_id(eng):
+    p = plan_of(eng, 'MATCH (a)-[e:knows]->(b) WHERE id(a) == "x" RETURN b',
+                opt=False)
+    kinds = p.root.kind_tree()
+    assert "GetVertices" in kinds and "ScanVertices" not in kinds
+
+
+def test_lookup_plan(eng):
+    p = plan_of(eng, 'LOOKUP ON person WHERE person.age > 1 YIELD id(vertex)',
+                opt=False)
+    assert p.root.kind_tree() == ["Project", "IndexScan"]
+
+
+def test_explain_output_contains_args(eng):
+    p = plan_of(eng, 'GO FROM "a" OVER knows WHERE knows.since > 5')
+    desc = p.describe()
+    assert "ExpandAll" in desc and "knows" in desc
