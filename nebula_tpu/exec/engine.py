@@ -41,9 +41,11 @@ class QueryEngine:
 
     def __init__(self, store: Optional[GraphStore] = None,
                  params: Optional[Dict[str, Any]] = None,
-                 enable_optimizer: bool = True):
+                 enable_optimizer: bool = True,
+                 tpu_runtime=None):
         self.store = store if store is not None else GraphStore()
         self.qctx = QueryContext(self.store, params)
+        self.qctx.tpu_runtime = tpu_runtime
         self.scheduler = Scheduler(self.qctx)
         self.enable_optimizer = enable_optimizer
         self.slow_query_us = int((params or {}).get("slow_query_threshold_us",
@@ -80,7 +82,8 @@ class QueryEngine:
             root = _plan(pctx, inner)
             from ..query.plan import ExecutionPlan
             plan = ExecutionPlan(root, pctx.space)
-            plan = optimize(plan, enable=self.enable_optimizer)
+            plan = optimize(plan, enable=self.enable_optimizer,
+                            tpu=self.qctx.tpu_runtime is not None)
         except QueryError as ex:
             return ResultSet(error=f"SemanticError: {ex}")
 

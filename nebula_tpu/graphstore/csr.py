@@ -84,12 +84,50 @@ def encode_prop(pt: PropType, v: Any, pool: StringPool) -> Any:
     if pt == PropType.DATE:
         return v.days_since_epoch()
     if pt == PropType.DATETIME:
-        return v.to_timestamp()
+        # epoch-microseconds computed from calendar fields: lossless AND
+        # monotonic across the epoch (to_timestamp() truncates toward zero,
+        # which mis-encodes pre-1970 values)
+        import datetime as _dt
+        delta = (_dt.datetime(v.year, v.month, v.day, v.hour, v.minute,
+                              v.sec, v.microsec, tzinfo=_dt.timezone.utc)
+                 - _dt.datetime(1970, 1, 1, tzinfo=_dt.timezone.utc))
+        return ((delta.days * 86400 + delta.seconds) * 1_000_000
+                + delta.microseconds)
     if pt == PropType.TIME:
         return ((v.hour * 60 + v.minute) * 60 + v.sec) * 1_000_000 + v.microsec
     if pt in (PropType.FLOAT, PropType.DOUBLE):
         return float(v)
     return int(v)
+
+
+def decode_prop(pt: PropType, raw: Any, pool: StringPool) -> Any:
+    """Exact inverse of encode_prop (sentinels → NULL)."""
+    import datetime as _dt
+
+    from ..core.value import NULL
+    if pt in (PropType.FLOAT, PropType.DOUBLE):
+        f = float(raw)
+        return NULL if np.isnan(f) else f
+    r = int(raw)
+    if r == INT_NULL:
+        return NULL
+    if pt in (PropType.STRING, PropType.FIXED_STRING):
+        s = pool.decode(r)
+        return NULL if s is None else s
+    if pt == PropType.BOOL:
+        return bool(r)
+    if pt == PropType.DATE:
+        d = _dt.date(1970, 1, 1) + _dt.timedelta(days=r)
+        return Date(d.year, d.month, d.day)
+    if pt == PropType.DATETIME:
+        ts, us = divmod(r, 1_000_000)
+        d = _dt.datetime.fromtimestamp(ts, _dt.timezone.utc)
+        return DateTime(d.year, d.month, d.day, d.hour, d.minute, d.second, us)
+    if pt == PropType.TIME:
+        us = r % 1_000_000
+        sec = r // 1_000_000
+        return Time(sec // 3600, (sec // 60) % 60, sec % 60, us)
+    return r
 
 
 @dataclass

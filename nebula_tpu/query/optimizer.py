@@ -13,11 +13,16 @@ from typing import Callable, List, Optional
 
 from ..core.expr import (Binary, Expr, InputProp, join_conjuncts,
                          split_conjuncts, walk)
-from .plan import ExecutionPlan, PlanNode, transform_plan
+from .plan import ExecutionPlan, PlanNode, transform_plan, walk_plan
 
 Rule = Callable[[PlanNode], Optional[PlanNode]]
 
 RULES: List[Rule] = []
+
+# TPU fusion rule factories: each is called per-pass with a {node_id:
+# parent_count} map and returns a Rule.  Populated by nebula_tpu.tpu
+# (kept here so query/ has no jax dependency).
+TPU_RULES: List = []
 
 
 def register_rule(fn: Rule) -> Rule:
@@ -25,7 +30,8 @@ def register_rule(fn: Rule) -> Rule:
     return fn
 
 
-def optimize(plan: ExecutionPlan, enable: bool = True) -> ExecutionPlan:
+def optimize(plan: ExecutionPlan, enable: bool = True,
+             tpu: bool = False) -> ExecutionPlan:
     if not enable:
         return plan
     # When a rule replaces a node with one of its children, any by-name
@@ -48,6 +54,36 @@ def optimize(plan: ExecutionPlan, enable: bool = True) -> ExecutionPlan:
         plan.root = transform_plan(plan.root, apply_once)
         if not changed[0]:
             break
+    if tpu and TPU_RULES:
+        # Fusion pass after pushdowns.  TOP-down (outermost node first) so a
+        # whole N-step frontier chain fuses as one unit — bottom-up would
+        # fuse the 1-step chain head and break the outer match.  Rules get
+        # parent counts to refuse fusing chains other branches reference.
+        uses: dict = {}
+        for n in walk_plan(plan.root):
+            for d in n.deps:
+                uses[d.id] = uses.get(d.id, 0) + 1
+        rules = [factory(uses) for factory in TPU_RULES]
+        memo: dict = {}
+
+        def rec(node: PlanNode) -> PlanNode:
+            if node.id in memo:
+                return memo[node.id]
+            for rule in rules:
+                r = rule(node)
+                if r is not None:
+                    if r.output_var != node.output_var:
+                        var_alias[node.output_var] = r.output_var
+                    memo[node.id] = r
+                    return r
+            memo[node.id] = node        # pre-seed: cycles impossible in DAG
+            new_deps = [rec(d) for d in node.deps]
+            if new_deps != node.deps:
+                node.deps = new_deps
+                node.input_vars = [d.output_var for d in new_deps]
+            return node
+
+        plan.root = rec(plan.root)
     if var_alias:
         def resolve(v):
             seen = set()
@@ -55,7 +91,6 @@ def optimize(plan: ExecutionPlan, enable: bool = True) -> ExecutionPlan:
                 seen.add(v)
                 v = var_alias[v]
             return v
-        from .plan import walk_plan
         for n in walk_plan(plan.root):
             if "from_var" in n.args:
                 n.args["from_var"] = resolve(n.args["from_var"])

@@ -1,0 +1,124 @@
+"""Mesh construction + pinning a CsrSnapshot into device HBM.
+
+The partition axis of every snapshot array (axis 0, length P) is sharded
+over the `'part'` mesh axis; each device holds exactly its partition's
+adjacency + property columns — the device analog of the reference's
+one-RocksDB-engine-per-data-path partition ownership (reference:
+src/kvstore/NebulaStore [UNVERIFIED — empty mount, SURVEY §0]).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from ..graphstore.csr import CsrSnapshot, StringPool
+from ..graphstore.schema import PropType
+
+
+class TpuUnavailable(Exception):
+    """The device plane cannot serve this space/config; callers fall back
+    to the host execution path."""
+
+
+def make_mesh(n_devices: Optional[int] = None, devices=None) -> Mesh:
+    """A 1-D 'part' mesh: one graph partition per device slot."""
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is None:
+        n_devices = len(devices)
+    if n_devices > len(devices):
+        raise ValueError(f"need {n_devices} devices, have {len(devices)}")
+    return Mesh(np.asarray(devices[:n_devices]), ("part",))
+
+
+@dataclass
+class DeviceBlock:
+    """One (edge type, direction) CSR block resident on the mesh."""
+    etype: str
+    direction: str
+    indptr: Any                       # (P, Vmax+1) i32, sharded on axis 0
+    nbr: Any                          # (P, Emax)   i32
+    rank: Any                         # (P, Emax)   i32
+    props: Dict[str, Any] = field(default_factory=dict)   # (P, Emax)
+    prop_types: Dict[str, PropType] = field(default_factory=dict)
+
+
+@dataclass
+class DeviceTag:
+    tag: str
+    present: Any                      # (P, Vmax) bool
+    props: Dict[str, Any] = field(default_factory=dict)   # (P, Vmax)
+    prop_types: Dict[str, PropType] = field(default_factory=dict)
+
+
+@dataclass
+class DeviceSnapshot:
+    """Epoch-tagged device-resident copy of one space."""
+    space: str
+    epoch: int
+    num_parts: int
+    vmax: int
+    mesh: Mesh
+    num_vertices: Any                 # (P,) i32
+    blocks: Dict[Tuple[str, str], DeviceBlock] = field(default_factory=dict)
+    tags: Dict[str, DeviceTag] = field(default_factory=dict)
+    pool: StringPool = field(default_factory=StringPool)
+    host: Optional[CsrSnapshot] = None   # kept for vid decode / oracle
+
+    def block(self, etype: str, direction: str = "out") -> DeviceBlock:
+        return self.blocks[(etype, direction)]
+
+    def hbm_bytes(self) -> int:
+        total = self.num_vertices.nbytes
+        for b in self.blocks.values():
+            total += b.indptr.nbytes + b.nbr.nbytes + b.rank.nbytes
+            total += sum(a.nbytes for a in b.props.values())
+        for t in self.tags.values():
+            total += t.present.nbytes + sum(a.nbytes for a in t.props.values())
+        return total
+
+
+def pin_snapshot(snap: CsrSnapshot, mesh: Mesh) -> DeviceSnapshot:
+    """device_put every snapshot array, sharded over the 'part' axis.
+
+    The snapshot's partition count must equal the mesh size — the 1:1
+    partition↔chip contract (SURVEY §2b, partition parallelism row).
+    """
+    P = mesh.shape["part"]
+    if P == 1:
+        # single-chip mode: every partition resident on the one device;
+        # the local (vmap) kernel runs the same program without ICI
+        dev0 = mesh.devices.reshape(-1)[0]
+
+        def put(a: np.ndarray):
+            return jax.device_put(a, dev0)
+    elif snap.num_parts == P:
+        part0 = NamedSharding(mesh, PartitionSpec("part"))
+
+        def put(a: np.ndarray):
+            return jax.device_put(a, part0)
+    else:
+        raise TpuUnavailable(
+            f"snapshot has {snap.num_parts} parts but mesh has {P} devices; "
+            f"create the space with partition_num == mesh size to pin it")
+
+    dev = DeviceSnapshot(space=snap.space, epoch=snap.epoch,
+                         num_parts=snap.num_parts, vmax=snap.vmax, mesh=mesh,
+                         num_vertices=put(snap.num_vertices),
+                         pool=snap.pool, host=snap)
+    for key, b in snap.blocks.items():
+        dev.blocks[key] = DeviceBlock(
+            etype=b.etype, direction=b.direction,
+            indptr=put(b.indptr), nbr=put(b.nbr), rank=put(b.rank),
+            props={k: put(v) for k, v in b.props.items()},
+            prop_types=dict(b.prop_types))
+    for name, t in snap.tags.items():
+        dev.tags[name] = DeviceTag(
+            tag=name, present=put(t.present),
+            props={k: put(v) for k, v in t.props.items()},
+            prop_types=dict(t.prop_types))
+    return dev

@@ -1,0 +1,353 @@
+"""Compile nGQL predicate subtrees to vectorized jnp mask functions.
+
+The reference evaluates pushed-down edge filters row-at-a-time inside
+storaged's scan loop (StorageExpressionContext; reference:
+src/storage/exec [UNVERIFIED — empty mount, SURVEY §0]).  Here the same
+predicate becomes ONE jnp expression over whole property columns — the
+north-star "vectorized property-predicate mask" — with the host
+interpreter's exact semantics:
+
+  * three-valued logic: every compiled term is a (value, is_null) pair;
+    Kleene AND/OR, null-propagating arithmetic & comparisons;
+  * division / modulo by zero → null (NullKind collapses to "drop row"
+    under a WHERE, which is all a mask needs);
+  * strings are dict codes (int64): ==, !=, IN compile; ordering /
+    CONTAINS / regex on strings do NOT (structural `compilable()` check
+    refuses fusion, the row stays on the host path);
+  * NULL sentinels: INT64_MIN in int/string columns, NaN in floats.
+
+`compilable(expr, etypes)` is the static gate the optimizer rule uses;
+`compile_predicate(expr, block, pool)` produces the mask fn used inside
+the hop kernel.  Columns arrive as a dict: reserved keys `_rank` plus one
+key per edge property name.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Sequence, Set, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import expr as E
+from ..core.value import NullValue
+from ..graphstore.csr import INT_NULL, StringPool
+from ..graphstore.schema import PropType
+
+
+class CannotCompile(Exception):
+    pass
+
+
+_NUMERIC = ("int", "float")
+_CMP_OPS = ("==", "!=", "<", "<=", ">", ">=")
+_ARITH_OPS = ("+", "-", "*", "/", "%")
+_LOGIC_OPS = ("AND", "OR", "XOR")
+
+
+def _is_string_type(pt: PropType) -> bool:
+    return pt in (PropType.STRING, PropType.FIXED_STRING)
+
+
+def _kind_of(pt: PropType) -> str:
+    if pt in (PropType.FLOAT, PropType.DOUBLE):
+        return "float"
+    if _is_string_type(pt):
+        return "str"
+    if pt == PropType.BOOL:
+        return "bool"
+    # Temporal kinds stay distinct: the host engine returns BAD_TYPE for
+    # e.g. DateTime < int, so the device must not compare their raw int
+    # encodings against numeric literals (same-kind compares are fine —
+    # the encodings are order-isomorphic).
+    if pt == PropType.DATE:
+        return "date"
+    if pt == PropType.TIME:
+        return "time"
+    if pt == PropType.DATETIME:
+        return "datetime"
+    if pt == PropType.DURATION:
+        return "duration"
+    return "int"        # ints + TIMESTAMP (host value is a plain int)
+
+
+# ---------------------------------------------------------------------------
+# Static compilability gate (no pool / schema values needed)
+# ---------------------------------------------------------------------------
+
+
+def compilable(e: E.Expr, etypes: Sequence[str]) -> bool:
+    """True iff `compile_predicate` will succeed for this expr against a
+    single-block hop over one of `etypes`.  Conservative."""
+    try:
+        _check(e, set(etypes))
+        return True
+    except CannotCompile:
+        return False
+
+
+def _edge_prop_ref(e: E.Expr):
+    """Normalize the three spellings of an edge-property reference:
+    EdgeProp (validator-canonical), AttributeExpr(LabelExpr) (raw parse of
+    `knows.w`), rank(edge).  Returns (edge_name_or_None, prop) or None."""
+    if isinstance(e, E.EdgeProp):
+        return (e.edge, e.name)
+    if isinstance(e, E.AttributeExpr) and isinstance(e.obj, E.LabelExpr):
+        return (e.obj.name, e.attr)
+    if (isinstance(e, E.FunctionCall) and e.name.lower() == "rank"
+            and len(e.args) == 1 and isinstance(e.args[0], E.EdgeExpr)):
+        return (None, "_rank")
+    return None
+
+
+def _check(e: E.Expr, etypes: Set[str]):
+    if isinstance(e, E.Literal):
+        v = e.value
+        if v is None or isinstance(v, (bool, int, float, str, NullValue)):
+            return
+        raise CannotCompile(f"literal {type(v)}")
+    ref = _edge_prop_ref(e)
+    if ref is not None:
+        edge, name = ref
+        if name in ("_src", "_dst", "_type"):
+            raise CannotCompile("edge reserved prop beyond _rank")
+        if name != "_rank" and len(etypes) != 1:
+            raise CannotCompile("prop predicate over multiple edge types")
+        if name != "_rank" and edge not in etypes:
+            raise CannotCompile(f"predicate on non-traversed edge {edge}")
+        return
+    if isinstance(e, E.Unary):
+        if e.op in ("NOT", "-", "+", "IS_NULL", "IS_NOT_NULL"):
+            _check(e.operand, etypes)
+            return
+        raise CannotCompile(f"unary {e.op}")
+    if isinstance(e, E.Binary):
+        if e.op in _LOGIC_OPS + _CMP_OPS + _ARITH_OPS:
+            _check(e.lhs, etypes)
+            _check(e.rhs, etypes)
+            return
+        if e.op in ("IN", "NOT IN"):
+            _check(e.lhs, etypes)
+            if not isinstance(e.rhs, (E.ListExpr, E.SetExpr)):
+                raise CannotCompile("IN rhs must be a literal list")
+            for item in e.rhs.items:
+                if not isinstance(item, E.Literal):
+                    raise CannotCompile("IN item not literal")
+            return
+        raise CannotCompile(f"binary {e.op}")
+    raise CannotCompile(f"expr kind {e.kind}")
+
+
+# ---------------------------------------------------------------------------
+# Compilation — terms are (value_array, null_mask, kind)
+# ---------------------------------------------------------------------------
+
+Term = Tuple[Any, Any, str]             # (val, isnull, kind)
+MaskFn = Callable[[Dict[str, Any]], Any]
+
+
+def compile_predicate(e: E.Expr, prop_types: Dict[str, PropType],
+                      pool: StringPool) -> Tuple[MaskFn, List[str]]:
+    """Returns (mask_fn, needed_columns).  mask_fn(cols) -> bool array:
+    True where the predicate evaluates to (non-null) true."""
+    needed: Set[str] = set()
+
+    def build(x: E.Expr) -> Callable[[Dict[str, Any]], Term]:
+        if isinstance(x, E.Literal):
+            return _lit(x.value, pool)
+        ref = _edge_prop_ref(x)
+        if ref is not None:
+            _, pname = ref
+            if pname == "_rank":
+                needed.add("_rank")
+                return lambda c: (c["_rank"],
+                                  jnp.zeros(c["_rank"].shape, bool), "int")
+            pt = prop_types.get(pname)
+            if pt is None:
+                raise CannotCompile(f"unknown edge prop {pname}")
+            kind = _kind_of(pt)
+            name = pname
+            needed.add(name)
+            if kind == "float":
+                return lambda c: (c[name], jnp.isnan(c[name]), "float")
+            if kind == "bool":
+                return lambda c: (c[name] != 0, c[name] == INT_NULL, "bool")
+            return lambda c: (c[name], c[name] == INT_NULL, kind)
+        if isinstance(x, E.Unary):
+            return _unary(x.op, build(x.operand))
+        if isinstance(x, E.Binary):
+            if x.op in ("IN", "NOT IN"):
+                return _in_list(build(x.lhs),
+                                [it.value for it in x.rhs.items],
+                                pool, negate=x.op == "NOT IN")
+            return _binary(x.op, build(x.lhs), build(x.rhs))
+        raise CannotCompile(f"expr kind {x.kind}")
+
+    term = build(e)
+
+    def mask_fn(cols: Dict[str, Any]):
+        val, isnull, kind = term(cols)
+        if kind != "bool":
+            # non-bool WHERE result: host to_bool3 yields null → drop row
+            return jnp.zeros(val.shape, bool)
+        return jnp.logical_and(val, jnp.logical_not(isnull))
+
+    return mask_fn, sorted(needed)
+
+
+def _lit(v: Any, pool: StringPool) -> Callable[[Dict[str, Any]], Term]:
+    if v is None or isinstance(v, NullValue):
+        return lambda c: (jnp.zeros((), jnp.int64), jnp.ones((), bool), "int")
+    if isinstance(v, bool):
+        return lambda c: (jnp.asarray(v), jnp.zeros((), bool), "bool")
+    if isinstance(v, int):
+        if not (-(1 << 63) <= v < (1 << 63)):
+            # host compares arbitrary-precision ints; fall back
+            raise CannotCompile("int literal outside int64")
+        return lambda c: (jnp.asarray(v, jnp.int64), jnp.zeros((), bool), "int")
+    if isinstance(v, float):
+        return lambda c: (jnp.asarray(v, jnp.float64),
+                          jnp.zeros((), bool), "float")
+    if isinstance(v, str):
+        code = pool.lookup(v)       # -2 when absent: equals nothing non-null
+        return lambda c: (jnp.asarray(code, jnp.int64),
+                          jnp.zeros((), bool), "str")
+    raise CannotCompile(f"literal {type(v)}")
+
+
+def _unary(op: str, f) -> Callable[[Dict[str, Any]], Term]:
+    def g(c):
+        v, n, k = f(c)
+        if op == "IS_NULL":
+            return (n, jnp.zeros(jnp.shape(n), bool), "bool")
+        if op == "IS_NOT_NULL":
+            return (jnp.logical_not(n), jnp.zeros(jnp.shape(n), bool), "bool")
+        if op == "NOT":
+            if k != "bool":
+                raise CannotCompile("NOT on non-bool")
+            return (jnp.logical_not(v), n, "bool")
+        if op == "-":
+            if k not in _NUMERIC:
+                raise CannotCompile("negate non-numeric")
+            return (-v, n, k)
+        if op == "+":
+            if k not in _NUMERIC:
+                raise CannotCompile("+x non-numeric")
+            return (v, n, k)
+        raise CannotCompile(f"unary {op}")
+    return g
+
+
+def _coerce_pair(av, ak, bv, bk):
+    """Numeric promotion for mixed int/float operands."""
+    if ak == bk:
+        return av, bv, ak
+    if set((ak, bk)) == {"int", "float"}:
+        return (av.astype(jnp.float64) if ak == "int" else av,
+                bv.astype(jnp.float64) if bk == "int" else bv, "float")
+    raise CannotCompile(f"type mix {ak}/{bk}")
+
+
+def _binary(op: str, fa, fb) -> Callable[[Dict[str, Any]], Term]:
+    def g(c):
+        av, an, ak = fa(c)
+        bv, bn, bk = fb(c)
+        if op in _LOGIC_OPS:
+            if ak != "bool" or bk != "bool":
+                raise CannotCompile("logic on non-bool")
+            if op == "AND":
+                is_false = (~an & ~av) | (~bn & ~bv)
+                val = ~is_false
+                null = ~is_false & (an | bn)
+                return (val & ~null, null, "bool")
+            if op == "OR":
+                is_true = (~an & av) | (~bn & bv)
+                null = ~is_true & (an | bn)
+                return (is_true, null, "bool")
+            # XOR
+            return (jnp.logical_xor(av, bv), an | bn, "bool")
+        if op in _CMP_OPS:
+            null = an | bn
+            if "str" in (ak, bk) or "bool" in (ak, bk):
+                if ak != bk:
+                    raise CannotCompile(f"compare {ak} vs {bk}")
+                if op not in ("==", "!="):
+                    raise CannotCompile(f"ordering on {ak}")
+                val = (av == bv) if op == "==" else (av != bv)
+                return (val, null, "bool")
+            a2, b2, _ = _coerce_pair(av, ak, bv, bk)
+            val = {"==": a2 == b2, "!=": a2 != b2, "<": a2 < b2,
+                   "<=": a2 <= b2, ">": a2 > b2, ">=": a2 >= b2}[op]
+            return (val, null, "bool")
+        if op in _ARITH_OPS:
+            if ak not in _NUMERIC or bk not in _NUMERIC:
+                raise CannotCompile(f"arith on {ak}/{bk}")
+            a2, b2, k = _coerce_pair(av, ak, bv, bk)
+            null = an | bn
+            if op == "+":
+                return (a2 + b2, null, k)
+            if op == "-":
+                return (a2 - b2, null, k)
+            if op == "*":
+                return (a2 * b2, null, k)
+            if op == "/":
+                null = null | (b2 == 0)
+                safe = jnp.where(b2 == 0, jnp.ones((), b2.dtype), b2)
+                if k == "int":
+                    # host semantics: truncation toward zero
+                    q = jnp.abs(a2) // jnp.abs(safe)
+                    sign = jnp.where((a2 >= 0) == (safe >= 0), 1, -1)
+                    return (q * sign, null, "int")
+                return (a2 / safe, null, "float")
+            # %
+            null = null | (b2 == 0)
+            safe = jnp.where(b2 == 0, jnp.ones((), b2.dtype), b2)
+            if k == "int":
+                # host v_mod: sign follows the dividend (C fmod style)
+                r = jnp.abs(a2) % jnp.abs(safe)
+                return (jnp.where(a2 >= 0, r, -r), null, "int")
+            return (jnp.where(jnp.signbit(a2),
+                              -(jnp.abs(a2) % jnp.abs(safe)),
+                              jnp.abs(a2) % jnp.abs(safe)), null, "float")
+        raise CannotCompile(f"binary {op}")
+    return g
+
+
+def _in_list(fa, items: List[Any], pool: StringPool,
+             negate: bool) -> Callable[[Dict[str, Any]], Term]:
+    def g(c):
+        av, an, ak = fa(c)
+        any_true = jnp.zeros(jnp.shape(av), bool)
+        any_null = jnp.zeros(jnp.shape(av), bool)
+        for it in items:
+            if it is None or isinstance(it, NullValue):
+                any_null = jnp.ones(jnp.shape(av), bool)
+                continue
+            # type-mismatched items yield NULL from v_eq on the host (not
+            # False), so anything not exactly comparable must fall back
+            if isinstance(it, bool):
+                if ak != "bool":
+                    raise CannotCompile("IN bool item vs non-bool")
+                any_true = any_true | (av == it)
+            elif isinstance(it, int):
+                if ak not in _NUMERIC or not (-(1 << 63) <= it < (1 << 63)):
+                    raise CannotCompile("IN int item vs non-numeric")
+                if ak == "int":
+                    any_true = any_true | (av == it)
+                else:
+                    any_true = any_true | (av == float(it))
+            elif isinstance(it, float):
+                if ak not in _NUMERIC:
+                    raise CannotCompile("IN float item vs non-numeric")
+                any_true = any_true | (av.astype(jnp.float64) == it)
+            elif isinstance(it, str):
+                if ak != "str":
+                    raise CannotCompile("IN str item vs non-string")
+                any_true = any_true | (av == pool.lookup(it))
+            else:
+                raise CannotCompile(f"IN item {type(it)}")
+        val = any_true
+        null = an | (~any_true & any_null)
+        if negate:
+            return (~val & ~null, null, "bool")
+        return (val & ~null, null, "bool")
+    return g

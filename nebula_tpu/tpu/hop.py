@@ -1,0 +1,292 @@
+"""The sharded multi-hop traversal kernel.
+
+One `shard_map` program runs the WHOLE N-step GO expansion on device:
+per hop, each chip expands its shard of the frontier through its local
+CSR block(s) (a vectorized segment gather — the MXU/VPU replacement for
+the reference's per-vid RocksDB prefix loops in GetNeighborsProcessor),
+applies the compiled predicate mask, dedups via sort-unique, hash-routes
+destinations to their owning chips, and re-shards the frontier with ONE
+`lax.all_to_all` over ICI — replacing the reference's per-hop
+storage.thrift fan-out (StorageClient::getNeighbors; reference:
+src/clients/storage, src/storage/query [UNVERIFIED — empty mount,
+SURVEY §0]).
+
+Static-shape policy (SURVEY §7 hard-part #1): frontier capacity F and
+per-block edge budget EB are power-of-two buckets chosen by the runtime;
+every kernel output carries per-part overflow flags, and the runtime
+re-runs with doubled buckets on overflow (inputs are never consumed, so
+the retry is exact).
+
+Frontier representation between hops: (P, F) int32 dense vertex ids,
+-1 padded, each row owned by (and resident on) its chip; dense id
+encodes ownership as dense % P — the vid-hash partition map.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec
+
+MAXI = np.iinfo(np.int32).max
+
+
+def _sorted_unique(vals):
+    """vals: (N,) int32 with -1 invalid → (u, count): u has the unique
+    valid values somewhere (others MAXI), count = #unique."""
+    key = jnp.where(vals >= 0, vals, MAXI).astype(jnp.int32)
+    s = jnp.sort(key)
+    first = jnp.concatenate([jnp.ones((1,), bool), s[1:] != s[:-1]])
+    first = first & (s != MAXI)
+    u = jnp.where(first, s, MAXI)
+    return u, jnp.sum(first, dtype=jnp.int32)
+
+
+def _route(u, P: int, cap: int):
+    """Bucket unique candidates by owner part (owner = v % P).
+
+    u: (N,) int32 values or MAXI.  Returns:
+      out   (P, cap) int32  — row d = candidates destined for part d
+      sendc (P,)     int32  — valid count per destination
+      ovf   ()       bool   — some destination bucket overflowed
+    """
+    ok = u != MAXI
+    owner = jnp.where(ok, u % P, P).astype(jnp.int32)
+    perm = jnp.argsort(owner, stable=True)
+    so = owner[perm]
+    sv = u[perm]
+    counts = jnp.zeros((P + 1,), jnp.int32).at[so].add(1)
+    starts = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(counts[:-1])])
+    pos = jnp.arange(so.shape[0], dtype=jnp.int32) - starts[so]
+    out = jnp.full((P, cap), -1, jnp.int32)
+    out = out.at[so, pos].set(sv, mode="drop")
+    sendc = jnp.minimum(counts[:P], cap)
+    ovf = jnp.any(counts[:P] > cap)
+    return out, sendc, ovf
+
+
+def _merge_frontier(recv, F: int):
+    """recv: (P, cap) candidates received from every chip → next frontier
+    (F,) sorted ascending, -1 padded, + count + overflow."""
+    u, cnt = _sorted_unique(recv.reshape(-1))
+    nf = jnp.sort(u)[:F]
+    nf = jnp.where(nf != MAXI, nf, -1)
+    return nf, jnp.minimum(cnt, F), cnt > F
+
+
+def _expand_block(indptr, nbr, rank, fr, F: int, EB: int, P: int):
+    """Vectorized CSR expansion of one block for one shard's frontier.
+
+    Returns per-edge-slot arrays of length EB:
+      src (frontier dense id), dst, rk, eidx (index into the block's edge
+      arrays — the host uses it to decode properties), ve (slot valid),
+    plus (total, ovf): true expansion size and overflow flag.
+    """
+    valid = fr >= 0
+    lf = jnp.where(valid, fr // P, 0)
+    deg = jnp.where(valid, indptr[lf + 1] - indptr[lf], 0)
+    ends = jnp.cumsum(deg)
+    total = ends[-1]
+    j = jnp.arange(EB, dtype=jnp.int32)
+    row = jnp.searchsorted(ends, j, side="right").astype(jnp.int32)
+    row = jnp.minimum(row, F - 1)
+    starts = ends - deg
+    eidx = indptr[lf[row]] + (j - starts[row])
+    ve = j < jnp.minimum(total, EB)
+    eidx = jnp.where(ve, eidx, 0).astype(jnp.int32)
+    dst = jnp.where(ve, nbr[eidx], -1)
+    src = jnp.where(ve, fr[row], -1)
+    rk = jnp.where(ve, rank[eidx], 0)
+    return src, dst, rk, eidx, ve, total, total > EB
+
+
+def build_traverse_fn(mesh, P: int, F: int, EB: int, steps: int,
+                      n_blocks: int,
+                      pred: Optional[Callable[[Dict[str, Any]], Any]] = None,
+                      pred_cols: Sequence[str] = (),
+                      capture: bool = True):
+    """Compile the N-step traversal program for one bucket configuration.
+
+    blocks_data (runtime arg): tuple of n_blocks dicts with keys
+      indptr (P, V+1), nbr (P, E), rank (P, E), props {name: (P, E)}
+    where props holds ONLY the columns the predicate needs (property
+    decode for result rows happens on host via captured eidx).
+
+    Returns jitted fn(blocks_data, frontier) -> dict with:
+      frontier (P, F), fcount (P,): next frontier after the LAST hop
+        (mid-hop frontiers never leave the device)
+      hop_edges (P, steps): pre-filter expansion size per hop per part
+      ovf_expand / ovf_route / ovf_frontier (P,) bool
+      cap (if capture): dict of (P, n_blocks, EB) arrays
+        src, dst, rank, eidx, keep — the final hop's edge set
+    """
+
+    def kernel(blocks_data, frontier):
+        fr = frontier[0]                       # (F,)
+        hop_edges: List[Any] = []
+        ovf_e = jnp.zeros((), bool)
+        ovf_r = jnp.zeros((), bool)
+        ovf_f = jnp.zeros((), bool)
+        cap_out = None
+        fcount = jnp.zeros((), jnp.int32)
+
+        for hop in range(steps):
+            last = hop == steps - 1
+            cands = []
+            edges_this_hop = jnp.zeros((), jnp.int32)
+            caps = {"src": [], "dst": [], "rank": [], "eidx": [], "keep": []}
+            for bi in range(n_blocks):
+                b = blocks_data[bi]
+                src, dst, rk, eidx, ve, total, ovf = _expand_block(
+                    b["indptr"][0], b["nbr"][0], b["rank"][0], fr, F, EB, P)
+                ovf_e = ovf_e | ovf
+                edges_this_hop = edges_this_hop + total
+                if last and pred is not None:
+                    cols = {"_rank": rk}
+                    for name in pred_cols:
+                        if name != "_rank":
+                            cols[name] = b["props"][name][0][eidx]
+                    keep = pred(cols) & ve
+                else:
+                    keep = ve
+                if last and capture:
+                    caps["src"].append(src)
+                    caps["dst"].append(jnp.where(keep, dst, -1))
+                    caps["rank"].append(rk)
+                    caps["eidx"].append(eidx)
+                    caps["keep"].append(keep)
+                if not last:
+                    cands.append(jnp.where(keep, dst, -1))
+            hop_edges.append(edges_this_hop)
+
+            if last:
+                if capture:
+                    cap_out = {k: jnp.stack(v)[None] for k, v in caps.items()}
+                # the post-final frontier is not needed for GO; report empty
+                fr = jnp.full((F,), -1, jnp.int32)
+                fcount = jnp.zeros((), jnp.int32)
+            else:
+                cand = jnp.concatenate(cands) if len(cands) > 1 else cands[0]
+                u, _ = _sorted_unique(cand)
+                out, sendc, ovf = _route(u, P, F)
+                ovf_r = ovf_r | ovf
+                recv = jax.lax.all_to_all(out, "part", 0, 0, tiled=False)
+                recv = recv.reshape(P, F)
+                fr, fcount, ovf = _merge_frontier(recv, F)
+                ovf_f = ovf_f | ovf
+
+        res = {
+            "frontier": fr[None],
+            "fcount": fcount[None],
+            "hop_edges": jnp.stack(hop_edges)[None],
+            "ovf_expand": ovf_e[None],
+            "ovf_route": ovf_r[None],
+            "ovf_frontier": ovf_f[None],
+        }
+        if capture:
+            res["cap"] = cap_out
+        return res
+
+    spec = PartitionSpec("part")
+    smapped = jax.shard_map(kernel, mesh=mesh,
+                            in_specs=(spec, spec), out_specs=spec)
+    return jax.jit(smapped)
+
+
+def build_traverse_fn_local(P: int, F: int, EB: int, steps: int,
+                            n_blocks: int,
+                            pred: Optional[Callable[[Dict[str, Any]], Any]] = None,
+                            pred_cols: Sequence[str] = (),
+                            capture: bool = True):
+    """Single-chip variant: all P partitions resident on one device, the
+    per-part kernel vmapped over the part axis, and the frontier exchange
+    a plain transpose (the degenerate all_to_all).  This is the program
+    that runs on one real chip (the bench config) — identical semantics
+    to the sharded build, no ICI.
+    """
+
+    def one_part_expand(block, fr, want_pred):
+        src, dst, rk, eidx, ve, total, ovf = _expand_block(
+            block["indptr"], block["nbr"], block["rank"], fr, F, EB, P)
+        if want_pred:
+            cols = {"_rank": rk}
+            for name in pred_cols:
+                if name != "_rank":
+                    cols[name] = block["props"][name][eidx]
+            keep = pred(cols) & ve
+        else:
+            keep = ve
+        return src, dst, rk, eidx, ve, keep, total, ovf
+
+    def fn(blocks_data, frontier):
+        fr = frontier                      # (P, F)
+        hop_edges = []
+        ovf_e = jnp.zeros((P,), bool)
+        ovf_r = jnp.zeros((P,), bool)
+        ovf_f = jnp.zeros((P,), bool)
+        cap_out = None
+        fcount = jnp.zeros((P,), jnp.int32)
+
+        for hop in range(steps):
+            last = hop == steps - 1
+            cands = []
+            edges = jnp.zeros((P,), jnp.int32)
+            caps = {"src": [], "dst": [], "rank": [], "eidx": [], "keep": []}
+            for bi in range(n_blocks):
+                b = blocks_data[bi]
+                want_pred = last and pred is not None
+                src, dst, rk, eidx, ve, keep, total, ovf = jax.vmap(
+                    lambda ip, nb, rkk, prp, f: one_part_expand(
+                        {"indptr": ip, "nbr": nb, "rank": rkk, "props": prp},
+                        f, want_pred)
+                )(b["indptr"], b["nbr"], b["rank"], b["props"], fr)
+                ovf_e = ovf_e | ovf
+                edges = edges + total
+                if last and capture:
+                    caps["src"].append(src)
+                    caps["dst"].append(jnp.where(keep, dst, -1))
+                    caps["rank"].append(rk)
+                    caps["eidx"].append(eidx)
+                    caps["keep"].append(keep)
+                if not last:
+                    cands.append(jnp.where(keep, dst, -1))
+            hop_edges.append(edges)
+
+            if last:
+                if capture:
+                    # (P, nb, EB)
+                    cap_out = {k: jnp.stack(v, axis=1)
+                               for k, v in caps.items()}
+                fr = jnp.full((P, F), -1, jnp.int32)
+                fcount = jnp.zeros((P,), jnp.int32)
+            else:
+                cand = (jnp.concatenate(cands, axis=1)
+                        if len(cands) > 1 else cands[0])    # (P, nb*EB)
+
+                def route_one(c):
+                    u, _ = _sorted_unique(c)
+                    return _route(u, P, F)
+                outs, sendc, ovr = jax.vmap(route_one)(cand)
+                ovf_r = ovf_r | ovr
+                recv = outs.transpose(1, 0, 2)              # dest-major
+                fr, fcount, ovr2 = jax.vmap(
+                    lambda r: _merge_frontier(r, F))(recv)
+                ovf_f = ovf_f | ovr2
+
+        res = {
+            "frontier": fr,
+            "fcount": fcount,
+            "hop_edges": jnp.stack(hop_edges, axis=1),      # (P, steps)
+            "ovf_expand": ovf_e,
+            "ovf_route": ovf_r,
+            "ovf_frontier": ovf_f,
+        }
+        if capture:
+            res["cap"] = cap_out
+        return res
+
+    return jax.jit(fn)

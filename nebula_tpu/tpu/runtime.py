@@ -1,0 +1,255 @@
+"""TpuRuntime: snapshot pinning lifecycle + traversal dispatch.
+
+Owns the mesh, the per-space DeviceSnapshots (epoch-checked against the
+host store: a write bumps the space epoch, the next traversal re-pins —
+the serve-epoch-N-while-building-N+1 model of SURVEY §7 hard-part #6 in
+its simplest correct form), the jit cache keyed by bucket configuration,
+and the power-of-two escalation loop around the hop kernel.
+
+The host materialization contract: the device returns (src, dst, rank,
+eidx, keep) per block; property decode happens on host straight out of
+the numpy CsrSnapshot columns at eidx — properties cross HBM only when
+a predicate needs them.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ..core import expr as E
+from ..core.value import Edge
+from ..graphstore.csr import build_snapshot, decode_prop
+from ..graphstore.store import GraphStore
+from .device import DeviceSnapshot, TpuUnavailable, make_mesh, pin_snapshot
+from .exprjit import CannotCompile, compile_predicate
+from .hop import build_traverse_fn, build_traverse_fn_local
+
+
+def _pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p <<= 1
+    return p
+
+
+class TraverseStats:
+    __slots__ = ("hop_edges", "result_edges", "f_cap", "e_cap",
+                 "retries", "device_s", "steps")
+
+    def __init__(self):
+        self.hop_edges: List[int] = []
+        self.result_edges = 0
+        self.f_cap = 0
+        self.e_cap = 0
+        self.retries = 0
+        self.device_s = 0.0
+        self.steps = 0
+
+    def edges_traversed(self) -> int:
+        return int(sum(self.hop_edges))
+
+
+class TpuRuntime:
+    """One per process; holds the mesh and all pinned spaces."""
+
+    def __init__(self, mesh=None, n_devices: Optional[int] = None):
+        self.mesh = mesh if mesh is not None else make_mesh(n_devices)
+        self.mesh_size = self.mesh.shape["part"]
+        self.local_mode = self.mesh_size == 1
+        self.snapshots: Dict[str, DeviceSnapshot] = {}
+        self._fns: Dict[Tuple, Any] = {}
+        self.max_retries = 10
+        self.init_f = 256
+        self.init_eb = 2048
+        self.max_cap = 1 << 24          # escalation sanity bound
+
+    # -- pinning ----------------------------------------------------------
+
+    def pin(self, store: GraphStore, space: str,
+            force: bool = False) -> DeviceSnapshot:
+        sd = store.space(space)
+        cur = self.snapshots.get(space)
+        if cur is not None and not force and cur.epoch == sd.epoch:
+            return cur
+        snap = build_snapshot(store, space)
+        dev = pin_snapshot(snap, self.mesh)
+        self.snapshots[space] = dev
+        # stale-epoch jitted fns are keyed by epoch; drop them
+        self._fns = {k: v for k, v in self._fns.items()
+                     if not (k[0] == space and k[1] != dev.epoch)}
+        return dev
+
+    def unpin(self, space: str):
+        self.snapshots.pop(space, None)
+        self._fns = {k: v for k, v in self._fns.items() if k[0] != space}
+
+    def hbm_bytes(self) -> int:
+        return sum(s.hbm_bytes() for s in self.snapshots.values())
+
+    # -- traversal --------------------------------------------------------
+
+    def _initial_frontier(self, dev: DeviceSnapshot, dense_ids: Sequence[int],
+                          F: int) -> Optional[np.ndarray]:
+        P = dev.num_parts
+        byp: List[List[int]] = [[] for _ in range(P)]
+        for d in sorted(set(int(x) for x in dense_ids if x >= 0)):
+            byp[d % P].append(d)
+        if max((len(b) for b in byp), default=0) > F:
+            return None
+        fr = np.full((P, F), -1, np.int32)
+        for p in range(P):
+            fr[p, :len(byp[p])] = byp[p]
+        return fr
+
+    def _blocks_for(self, dev: DeviceSnapshot, etypes: Sequence[str],
+                    direction: str):
+        keys = []
+        for et in etypes:
+            if direction in ("out", "both"):
+                keys.append((et, "out"))
+            if direction in ("in", "both"):
+                keys.append((et, "in"))
+        return keys
+
+    def traverse(self, store: GraphStore, space: str, vids: Sequence[Any],
+                 etypes: Sequence[str], direction: str, steps: int,
+                 edge_filter: Optional[E.Expr] = None,
+                 capture: bool = True
+                 ) -> Tuple[List[Tuple[Any, Optional[Edge], Any]], TraverseStats]:
+        """Run an N-step GO expansion fully on device.
+
+        Returns (rows, stats); rows are (src_vid, Edge, dst_vid) for every
+        final-hop edge passing the predicate.  Raises CannotCompile if the
+        filter does not vectorize (caller falls back to the host path).
+        """
+        dev = self.pin(store, space)
+        sd = store.space(space)
+        stats = TraverseStats()
+        stats.steps = steps
+
+        block_keys = self._blocks_for(dev, etypes, direction)
+        pred = None
+        pred_cols: List[str] = []
+        pred_key = None
+        if edge_filter is not None:
+            # single-etype constraint is enforced by the optimizer rule
+            bl = dev.blocks[block_keys[0]]
+            pred, pred_cols = compile_predicate(
+                edge_filter, bl.prop_types, dev.pool)
+            pred_key = E.to_text(edge_filter) if hasattr(E, "to_text") else repr(edge_filter)
+
+        dense = [sd.dense_id(v) for v in vids]
+        dense = [d for d in dense if d >= 0]
+        if not dense:
+            return [], stats
+
+        P = dev.num_parts
+        cnt = [0] * P
+        for d in set(dense):
+            cnt[d % P] += 1
+        per_part_max = max(cnt)
+
+        F = max(self.init_f, _pow2(per_part_max))
+        EB = self.init_eb
+        if self.local_mode:
+            target = self.mesh.devices.reshape(-1)[0]
+        else:
+            target = NamedSharding(self.mesh, PartitionSpec("part"))
+
+        for attempt in range(self.max_retries):
+            stats.retries = attempt
+            fr_np = self._initial_frontier(dev, dense, F)
+            if fr_np is None:
+                F *= 2
+                continue
+            key = (space, dev.epoch, tuple(block_keys), steps, F, EB,
+                   pred_key, capture, tuple(pred_cols))
+            fn = self._fns.get(key)
+            if fn is None:
+                if self.local_mode:
+                    fn = build_traverse_fn_local(
+                        P, F, EB, steps, len(block_keys), pred=pred,
+                        pred_cols=pred_cols, capture=capture)
+                else:
+                    fn = build_traverse_fn(
+                        self.mesh, P, F, EB, steps, len(block_keys),
+                        pred=pred, pred_cols=pred_cols, capture=capture)
+                self._fns[key] = fn
+            blocks_data = []
+            for bk in block_keys:
+                b = dev.blocks[bk]
+                blocks_data.append({
+                    "indptr": b.indptr, "nbr": b.nbr, "rank": b.rank,
+                    "props": {n: b.props[n] for n in pred_cols
+                              if n != "_rank"},
+                })
+            frontier = jax.device_put(fr_np, target)
+            t0 = time.perf_counter()
+            res = fn(tuple(blocks_data), frontier)
+            res = jax.tree_util.tree_map(np.asarray, res)
+            stats.device_s = time.perf_counter() - t0
+
+            esc = False
+            if res["ovf_expand"].any():
+                EB = min(EB * 2, self.max_cap)
+                esc = True
+            if res["ovf_route"].any() or res["ovf_frontier"].any():
+                F = min(F * 2, self.max_cap)
+                esc = True
+            if not esc:
+                break
+        else:
+            raise RuntimeError("bucket escalation did not converge")
+
+        stats.f_cap, stats.e_cap = F, EB
+        stats.hop_edges = [int(x) for x in res["hop_edges"].sum(axis=0)]
+        if not capture:
+            return [], stats
+
+        rows = self._materialize(store, space, dev, block_keys, res["cap"])
+        stats.result_edges = len(rows)
+        return rows, stats
+
+    # -- host materialization --------------------------------------------
+
+    def _materialize(self, store: GraphStore, space: str,
+                     dev: DeviceSnapshot, block_keys, cap
+                     ) -> List[Tuple[Any, Optional[Edge], Any]]:
+        host = dev.host
+        d2v = host.dense_to_vid
+        etype_ids = {et: store.catalog.get_edge(space, et).edge_type
+                     for et, _ in block_keys}
+        rows: List[Tuple[Any, Optional[Edge], Any]] = []
+        keep = cap["keep"]                  # (P, nb, EB)
+        src = cap["src"]
+        dst = cap["dst"]
+        rank = cap["rank"]
+        eidx = cap["eidx"]
+        P = keep.shape[0]
+        for p in range(P):
+            for bi, (et, dirn) in enumerate(block_keys):
+                hb = host.blocks[(et, dirn)]
+                sel = np.nonzero(keep[p, bi])[0]
+                if sel.size == 0:
+                    continue
+                ss = src[p, bi, sel]
+                dd = dst[p, bi, sel]
+                rr = rank[p, bi, sel]
+                ee = eidx[p, bi, sel]
+                pcols = {n: hb.props[n][p, ee] for n in hb.props}
+                sign = 1 if dirn == "out" else -1
+                eid = etype_ids[et]
+                for i in range(sel.size):
+                    sv = d2v[int(ss[i])]
+                    dv = d2v[int(dd[i])]
+                    props = {n: decode_prop(hb.prop_types[n], pcols[n][i],
+                                            host.pool)
+                             for n in pcols}
+                    e = Edge(sv, dv, et, int(rr[i]), props,
+                             etype=eid if sign > 0 else -eid)
+                    rows.append((sv, e, dv))
+        return rows

@@ -1,0 +1,300 @@
+"""TPU device-plane tests: every kernel against its host oracle on the
+8-device virtual CPU mesh (conftest sets XLA_FLAGS / JAX_PLATFORMS), per
+SURVEY §4's CPU-oracle strategy."""
+import random
+
+import numpy as np
+import pytest
+
+from nebula_tpu.core.value import NULL
+from nebula_tpu.exec.engine import QueryEngine
+from nebula_tpu.graphstore.csr import build_snapshot, expand_frontier_host
+from nebula_tpu.graphstore.schema import PropDef, PropType
+from nebula_tpu.graphstore.store import GraphStore
+
+tpu = pytest.importorskip("nebula_tpu.tpu")
+from nebula_tpu.tpu import TpuRuntime, make_mesh, pin_snapshot  # noqa: E402
+from nebula_tpu.tpu.exprjit import compilable, compile_predicate  # noqa: E402
+
+P = 8
+
+
+def random_store(seed=0, n=120, avg_deg=5, spacename="g",
+                 extra_edge_type=False):
+    rng = random.Random(seed)
+    st = GraphStore()
+    st.create_space(spacename, partition_num=P, vid_type="INT64")
+    st.catalog.create_tag(spacename, "person", [
+        PropDef("age", PropType.INT64), PropDef("name", PropType.STRING)])
+    st.catalog.create_edge(spacename, "knows", [
+        PropDef("w", PropType.INT64), PropDef("f", PropType.DOUBLE),
+        PropDef("tag", PropType.STRING)])
+    if extra_edge_type:
+        st.catalog.create_edge(spacename, "likes", [
+            PropDef("w", PropType.INT64)])
+    names = ["ann", "bob", "cid", "dee"]
+    for v in range(n):
+        st.insert_vertex(spacename, v, "person",
+                         {"age": rng.randint(0, 80), "name": rng.choice(names)})
+    for v in range(n):
+        for _ in range(rng.randint(0, avg_deg * 2)):
+            d = rng.randrange(n)
+            props = {"w": rng.randint(-5, 100) if rng.random() > .1 else NULL,
+                     "f": rng.uniform(0, 1), "tag": rng.choice(names)}
+            st.insert_edge(spacename, v, "knows", d, rng.randint(0, 2), props)
+        if extra_edge_type and rng.random() > .5:
+            st.insert_edge(spacename, v, "likes", rng.randrange(n), 0,
+                           {"w": rng.randint(0, 10)})
+    return st
+
+
+def norm_edge(e):
+    """Same normalization as the src()/dst() builtins: reversed edges
+    (etype<0) report their stored orientation."""
+    if e.etype >= 0:
+        return repr([e.src, e.name, e.ranking, e.dst])
+    return repr([e.dst, e.name, e.ranking, e.src])
+
+
+def host_go(st, space, vids, etypes, direction, steps, where_text=None):
+    """Host-truth GO result as a sorted list of (src, etype, rank, dst)."""
+    eng = QueryEngine(st)
+    s = eng.new_session()
+    eng.execute(s, f"USE {space}")
+    w = f" WHERE {where_text}" if where_text else ""
+    q = (f"GO {steps} STEPS FROM {', '.join(map(str, vids))} "
+         f"OVER {', '.join(etypes)}"
+         + (" REVERSELY" if direction == "in" else
+            " BIDIRECT" if direction == "both" else "")
+         + w + " YIELD src(edge), type(edge), rank(edge), dst(edge)")
+    rs = eng.execute(s, q)
+    assert rs.error is None, f"{q} -> {rs.error}"
+    return sorted(map(repr, rs.data.rows))
+
+
+@pytest.fixture(scope="module")
+def rt():
+    return TpuRuntime(make_mesh(P))
+
+
+def test_pin_and_hbm(rt):
+    st = random_store(1)
+    dev = rt.pin(st, "g")
+    assert dev.num_parts == P
+    assert dev.hbm_bytes() > 0
+    # same epoch → cached object
+    assert rt.pin(st, "g") is dev
+    # write bumps epoch → re-pin
+    st.insert_edge("g", 0, "knows", 1, 9, {"w": 1, "f": .5, "tag": "x"})
+    dev2 = rt.pin(st, "g")
+    assert dev2 is not dev and dev2.epoch != dev.epoch
+
+
+@pytest.mark.parametrize("steps", [1, 2, 3])
+@pytest.mark.parametrize("direction", ["out", "in", "both"])
+def test_traverse_matches_host(rt, steps, direction):
+    st = random_store(2)
+    sources = [3, 17, 44]
+    rows, stats = rt.traverse(st, "g", sources, ["knows"], direction, steps)
+    got = sorted(norm_edge(e) for (_, e, _) in rows)
+    want = host_go(st, "g", sources, ["knows"], direction, steps)
+    assert got == want
+    assert stats.edges_traversed() >= len(rows)
+
+
+def test_traverse_multi_etype(rt):
+    st = random_store(3, extra_edge_type=True)
+    rows, _ = rt.traverse(st, "g", [1, 2, 3], ["knows", "likes"], "out", 2)
+    got = sorted(norm_edge(e) for (_, e, _) in rows)
+    want = host_go(st, "g", [1, 2, 3], ["knows", "likes"], "out", 2)
+    assert got == want
+
+
+def test_frontier_oracle(rt):
+    """One-hop device frontier == expand_frontier_host on the raw CSR."""
+    st = random_store(4)
+    snap = build_snapshot(st, "g")
+    blk = snap.block("knows", "out")
+    sd = st.space("g")
+    dense = [sd.dense_id(v) for v in [5, 9]]
+    want = expand_frontier_host(snap, blk, np.asarray(dense, np.int32))
+    # run a 2-step traverse and recover its intermediate frontier from the
+    # final hop's sources
+    rows, _ = rt.traverse(st, "g", [5, 9], ["knows"], "out", 2)
+    springs = sorted({sd.dense_id(e.src) for (_, e, _) in rows})
+    # sources of hop 2 ⊆ hop-1 frontier; vertices with no out-edges appear
+    # in `want` but not as hop-2 sources
+    assert set(springs) <= set(int(x) for x in want)
+
+
+@pytest.mark.parametrize("where", [
+    "knows.w > 30",
+    "knows.w >= 10 AND knows.w < 60",
+    "knows.f < 0.5 OR knows.w == 7",
+    "knows.tag == \"ann\"",
+    "knows.tag != \"bob\" AND knows.w % 2 == 0",
+    "knows.w IS NOT NULL AND knows.w * 2 + 1 > 21",
+    "knows.w IN [1, 2, 3, 40, 41, 42, 43, 44]",
+    "rank(edge) == 1",
+    "NOT (knows.w > 10)",
+    "knows.w / 3 > 5",
+])
+def test_predicate_parity(rt, where):
+    st = random_store(5)
+    from nebula_tpu.query.parser import parse
+    stmt = parse(f"GO 2 STEPS FROM 3, 17 OVER knows WHERE {where} "
+                 f"YIELD src(edge), type(edge), rank(edge), dst(edge)")
+    cond = stmt.where.filter if stmt.where else None
+    assert cond is not None
+    assert compilable(cond, ["knows"]), where
+    rows, _ = rt.traverse(st, "g", [3, 17], ["knows"], "out", 2,
+                          edge_filter=cond)
+    got = sorted(norm_edge(e) for (_, e, _) in rows)
+    want = host_go(st, "g", [3, 17], ["knows"], "out", 2, where)
+    assert got == want, where
+
+
+def test_not_compilable():
+    from nebula_tpu.query.parser import parse
+    for w in ["knows.tag CONTAINS \"a\"",
+              "knows.tag =~ \"a.*\"",
+              "id($$) == 3"]:
+        stmt = parse(f"GO FROM 1 OVER knows WHERE {w} YIELD dst(edge)")
+        assert not compilable(stmt.where.filter, ["knows"]), w
+
+
+def test_string_ordering_falls_back(rt):
+    """String ordering passes the structural gate but fails typed compile;
+    the executor must fall back to the host path with identical rows."""
+    st = random_store(5)
+    eng = QueryEngine(st, tpu_runtime=rt)
+    s = eng.new_session()
+    eng.execute(s, "USE g")
+    q = ('GO 2 STEPS FROM 3, 17 OVER knows WHERE knows.tag < "m" '
+         'YIELD src(edge), rank(edge), dst(edge)')
+    rs = eng.execute(s, q)
+    assert rs.error is None, rs.error
+    want = QueryEngine(st)
+    s2 = want.new_session()
+    want.execute(s2, "USE g")
+    rs2 = want.execute(s2, q)
+    assert sorted(map(repr, rs.data.rows)) == sorted(map(repr, rs2.data.rows))
+
+
+def test_bucket_escalation(rt):
+    """Tiny initial buckets must converge via doubling, same answer."""
+    st = random_store(6, n=200, avg_deg=8)
+    small = TpuRuntime(make_mesh(P))
+    small.init_f, small.init_eb = 2, 4
+    rows, stats = small.traverse(st, "g", [1, 2, 3, 4], ["knows"], "out", 3)
+    got = sorted(norm_edge(e) for (_, e, _) in rows)
+    want = host_go(st, "g", [1, 2, 3, 4], ["knows"], "out", 3)
+    assert got == want
+    assert stats.retries > 0
+
+
+def test_engine_fusion_end_to_end(rt):
+    """Same query, optimizer TPU rule ON vs OFF → identical row multisets,
+    and the fused plan actually contains TpuTraverse."""
+    st = random_store(7)
+    eng_cpu = QueryEngine(st)
+    eng_tpu = QueryEngine(st, tpu_runtime=rt)
+    q = ("GO 3 STEPS FROM 3, 17, 44 OVER knows WHERE knows.w > 10 "
+         "YIELD src(edge) AS s, dst(edge) AS d, knows.w AS w")
+    for eng in (eng_cpu, eng_tpu):
+        s = eng.new_session()
+        eng.execute(s, "USE g")
+        rs = eng.execute(s, q)
+        assert rs.error is None, rs.error
+        eng._last = sorted(map(repr, rs.data.rows))
+    assert eng_cpu._last == eng_tpu._last
+
+    s = eng_tpu.new_session()
+    eng_tpu.execute(s, "USE g")
+    rs = eng_tpu.execute(s, "EXPLAIN " + q)
+    assert "TpuTraverse" in rs.data.rows[0][0]
+    rs = eng_cpu.execute(eng_cpu.new_session(), "EXPLAIN " + q)
+
+
+def test_mton_and_piped_go_parity(rt):
+    """m-TO-n GO and $- piped GO may fuse sub-chains (single-use 1-step
+    heads) but must keep exact row parity with the host path."""
+    st = random_store(8)
+    qs = ["GO 1 TO 3 STEPS FROM 3 OVER knows YIELD src(edge), dst(edge)",
+          "GO FROM 3 OVER knows YIELD dst(edge) AS d "
+          "| GO FROM $-.d OVER knows YIELD $-.d, dst(edge)"]
+    for q in qs:
+        out = []
+        for tpu_rt in (None, rt):
+            eng = QueryEngine(st, tpu_runtime=tpu_rt)
+            s = eng.new_session()
+            eng.execute(s, "USE g")
+            rs = eng.execute(s, q)
+            assert rs.error is None, f"{q} -> {rs.error}"
+            out.append(sorted(map(repr, rs.data.rows)))
+        assert out[0] == out[1], q
+
+
+def test_write_invalidates_snapshot(rt):
+    st = random_store(9)
+    rows1, _ = rt.traverse(st, "g", [3], ["knows"], "out", 1)
+    st.insert_edge("g", 3, "knows", 99, 7, {"w": 50, "f": .1, "tag": "zz"})
+    rows2, _ = rt.traverse(st, "g", [3], ["knows"], "out", 1)
+    assert len(rows2) == len(rows1) + 1
+
+
+def test_single_chip_local_mode():
+    """Mesh of 1 device serves an 8-partition space via the vmap driver —
+    the real-TPU bench configuration."""
+    st = random_store(11)
+    rt1 = TpuRuntime(make_mesh(1))
+    assert rt1.local_mode
+    rows, stats = rt1.traverse(st, "g", [3, 17, 44], ["knows"], "out", 3)
+    got = sorted(norm_edge(e) for (_, e, _) in rows)
+    want = host_go(st, "g", [3, 17, 44], ["knows"], "out", 3)
+    assert got == want
+
+
+def test_temporal_and_overflow_predicates_fall_back(rt):
+    """Code-review regressions: DATETIME-vs-int compares and out-of-int64
+    literals must produce host-identical results (via fallback)."""
+    st = GraphStore()
+    st.create_space("t", partition_num=P, vid_type="INT64")
+    st.catalog.create_edge("t", "e", [PropDef("ts", PropType.DATETIME),
+                                      PropDef("w", PropType.INT64)])
+    from nebula_tpu.core.value import DateTime
+    st.insert_edge("t", 1, "e", 2, 0, {"ts": DateTime(2020, 5, 1, 12), "w": 3})
+    st.insert_edge("t", 2, "e", 3, 0, {"ts": DateTime(2021, 6, 2, 13), "w": 4})
+    for q in [
+        "GO 2 STEPS FROM 1 OVER e WHERE e.ts > 5 YIELD src(edge), dst(edge)",
+        "GO 2 STEPS FROM 1 OVER e WHERE e.w < 99999999999999999999999 "
+        "YIELD src(edge), dst(edge)",
+        "GO 2 STEPS FROM 1 OVER e WHERE e.w IN [\"x\", 3] "
+        "YIELD src(edge), dst(edge)",
+    ]:
+        out = []
+        for tr in (None, rt):
+            eng = QueryEngine(st, tpu_runtime=tr)
+            s = eng.new_session()
+            eng.execute(s, "USE t")
+            r = eng.execute(s, q)
+            assert r.error is None, (q, r.error)
+            out.append(sorted(map(repr, r.data.rows)))
+        assert out[0] == out[1], q
+
+
+def test_pre_epoch_datetime_roundtrip():
+    """Encoding must be monotonic and lossless across the 1970 epoch."""
+    from nebula_tpu.core.value import DateTime
+    from nebula_tpu.graphstore.csr import (StringPool, decode_prop,
+                                           encode_prop)
+    pool = StringPool()
+    vals = [DateTime(1944, 6, 6, 6, 30, 0, 1),
+            DateTime(1969, 12, 31, 23, 59, 59, 500000),
+            DateTime(1970, 1, 1, 0, 0, 0, 0),
+            DateTime(1970, 1, 1, 0, 0, 0, 250000),
+            DateTime(2024, 2, 29, 23, 59, 59, 999999)]
+    enc = [encode_prop(PropType.DATETIME, v, pool) for v in vals]
+    assert enc == sorted(enc)
+    for v, e in zip(vals, enc):
+        assert decode_prop(PropType.DATETIME, e, pool) == v
