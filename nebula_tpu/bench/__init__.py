@@ -1,0 +1,3 @@
+"""Benchmark harness (SURVEY §7 step 8): synthetic LDBC-SNB-shaped data
+generation + the CPU-vs-TPU measurement loop behind the repo-root bench.py."""
+from .datagen import make_social_graph  # noqa: F401

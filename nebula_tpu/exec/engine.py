@@ -63,7 +63,22 @@ class QueryEngine:
             stmt = parse(text)
         except ParseError as ex:
             return ResultSet(error=f"SyntaxError: {ex}")
+        if isinstance(stmt, A.SeqSentence):
+            # `a; b; c` executes sequentially — each statement plans only
+            # after the previous ran, so DDL/USE side effects are visible
+            # to later statements; the result is the last statement's
+            # (reference semantics for compound execute())
+            res = ResultSet()
+            for sub in stmt.stmts:
+                res = self._execute_parsed(session, sub, text,
+                                           time.perf_counter())
+                if not res.ok:
+                    return res
+            return res
+        return self._execute_parsed(session, stmt, text, t0)
 
+    def _execute_parsed(self, session: Session, stmt: A.Sentence,
+                        text: str, t0: float) -> ResultSet:
         profile_stats: Optional[ProfileStats] = None
         explain_only = False
         if isinstance(stmt, A.ExplainSentence):

@@ -464,7 +464,17 @@ def _plan_fetch_vertices(pctx, s: A.FetchVerticesSentence) -> PlanNode:
     yld = s.yield_
     if yld is None:
         yld = A.YieldClause([A.YieldColumn(VertexExpr("vertex"), "vertices_")])
-    ycols = [(c.expr, _col_name(c)) for c in yld.columns]
+    # `Person.name` in a FETCH yield is a tag-prop access on the fetched
+    # vertex (reference: TagPropertyExpression), not a variable lookup
+    tag_names = {t.name for t in cat.tags(space)}
+
+    def _tagprop(x: Expr):
+        if (isinstance(x, AttributeExpr) and isinstance(x.obj, LabelExpr)
+                and x.obj.name in tag_names):
+            return LabelTagProp("vertices_", x.obj.name, x.attr)
+        return None
+
+    ycols = [(rewrite(c.expr, _tagprop), _col_name(c)) for c in yld.columns]
     names = [n for _, n in ycols]
     out = PlanNode("Project", deps=[gv], col_names=names,
                    args={"columns": ycols, "fetch_row": True})

@@ -44,6 +44,32 @@ def format_result(r) -> str:
     return "\n".join(out)
 
 
+def split_statements(text: str) -> list:
+    """Split on top-level `;` (quote- and escape-aware, matching the
+    tokenizer's string rules) so each statement's result prints
+    separately; the engine also accepts the unsplit compound form."""
+    out, buf, q, esc = [], [], None, False
+    for ch in text:
+        if q:
+            buf.append(ch)
+            if esc:
+                esc = False
+            elif ch == "\\":
+                esc = True
+            elif ch == q:
+                q = None
+        elif ch in "'\"`":
+            q = ch
+            buf.append(ch)
+        elif ch == ";":
+            out.append("".join(buf))
+            buf = []
+        else:
+            buf.append(ch)
+    out.append("".join(buf))
+    return [s for s in (x.strip() for x in out) if s]
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(prog="nebula-tpu-console")
     ap.add_argument("-e", "--execute", help="run one statement and exit")
@@ -73,14 +99,16 @@ def main(argv=None):
         return 0 if r.ok else 1
 
     if args.execute:
-        return run_one(args.execute)
+        rc = 0
+        for stmt in split_statements(args.execute):
+            rc |= run_one(stmt)
+        return rc
     if args.file:
         with open(args.file) as f:
             buf = f.read()
         rc = 0
-        for stmt in buf.split(";"):
-            if stmt.strip():
-                rc |= run_one(stmt)
+        for stmt in split_statements(buf):
+            rc |= run_one(stmt)
         return rc
 
     print("Welcome to nebula-tpu console. Type `:quit' to exit.")
@@ -96,7 +124,8 @@ def main(argv=None):
             break
         buf += line + "\n"
         if ";" in line or not line.endswith("\\"):
-            run_one(buf)
+            for stmt in split_statements(buf):
+                run_one(stmt)
             buf = ""
     return 0
 

@@ -190,8 +190,11 @@ class TpuRuntime:
             frontier = jax.device_put(fr_np, target)
             t0 = time.perf_counter()
             res = fn(tuple(blocks_data), frontier)
-            res = jax.tree_util.tree_map(np.asarray, res)
+            jax.block_until_ready(res)
             stats.device_s = time.perf_counter() - t0
+            # one batched transfer (the axon tunnel charges ~15ms per
+            # fetch RPC; per-leaf np.asarray would pay it 6+ times)
+            res = jax.device_get(res)
 
             esc = False
             if res["ovf_expand"].any():

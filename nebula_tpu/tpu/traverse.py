@@ -137,7 +137,11 @@ def _tpu_traverse(node, qctx, ectx, space):
             qctx.last_tpu_stats = stats
             return DataSet(["_src", "_edge", "_dst"],
                            [[s, e, d] for (s, e, d) in rows])
-        except (CannotCompile, TpuUnavailable):
+        except (CannotCompile, TpuUnavailable, RuntimeError):
+            # RuntimeError covers XlaRuntimeError (e.g. HBM
+            # RESOURCE_EXHAUSTED on pin) and bucket-escalation
+            # non-convergence — all "device cannot serve this" cases;
+            # the host path below has identical semantics
             pass
     return _host_traverse(node, qctx, sp, vids)
 

@@ -221,6 +221,15 @@ def test_fetch(eng):
     assert got2 == [[2010]]
 
 
+def test_fetch_tag_prop_syntax(eng):
+    # `person.name` in a FETCH yield is a tag-prop access on the fetched
+    # vertex, not a variable lookup
+    got = rows(eng, 'FETCH PROP ON person "a" YIELD person.name, person.age')
+    assert got == [["Ann", 30]]
+    got2 = rows(eng, 'FETCH PROP ON person "a", "c" YIELD person.name AS n')
+    assert sorted(r[0] for r in got2) == ["Ann", "Cat"]
+
+
 def test_update_and_fetch(eng):
     eng._run('UPDATE VERTEX ON person "a" SET age = age + 1')
     assert rows(eng, 'FETCH PROP ON person "a" YIELD properties(vertex).age AS a') == [[31]]

@@ -213,7 +213,10 @@ def test_engine_fusion_end_to_end(rt):
     eng_tpu.execute(s, "USE g")
     rs = eng_tpu.execute(s, "EXPLAIN " + q)
     assert "TpuTraverse" in rs.data.rows[0][0]
-    rs = eng_cpu.execute(eng_cpu.new_session(), "EXPLAIN " + q)
+    s2 = eng_cpu.new_session()
+    eng_cpu.execute(s2, "USE g")
+    rs = eng_cpu.execute(s2, "EXPLAIN " + q)
+    assert "TpuTraverse" not in rs.data.rows[0][0]
 
 
 def test_mton_and_piped_go_parity(rt):
