@@ -12,8 +12,8 @@ where vs_baseline is device-path edges/sec over this framework's own
 host-executor edges/sec on the identical query (the self-measured CPU
 baseline mandated by BASELINE.md — the reference published no numbers).
 
-Env knobs: NEBULA_BENCH_PERSONS (default 20000), NEBULA_BENCH_DEGREE
-(default 25), NEBULA_BENCH_STEPS (default 3), NEBULA_BENCH_PARTS
+Env knobs: NEBULA_BENCH_PERSONS (default 50000), NEBULA_BENCH_DEGREE
+(default 30), NEBULA_BENCH_STEPS (default 3), NEBULA_BENCH_PARTS
 (default 8), NEBULA_BENCH_SEEDS (default 16).
 """
 from __future__ import annotations

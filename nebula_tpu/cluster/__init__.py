@@ -1,0 +1,10 @@
+"""Cluster plane: meta/storage/graph services, RPC, Raft consensus.
+
+The distributed deployment form of the framework (single-process mode in
+nebula_tpu.exec stays first-class for tests). Maps to the reference's
+metad/storaged/graphd split with fbthrift RPC and raftex consensus
+(reference: src/meta, src/storage, src/graph, src/kvstore/raftex
+[UNVERIFIED — empty mount, SURVEY §0]); here the control plane is a
+JSON-over-TCP RPC and the data plane is either host fan-out (CPU path)
+or the TPU mesh (tpu/ package) — per SURVEY §5's two-plane rule.
+"""

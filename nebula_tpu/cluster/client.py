@@ -1,0 +1,46 @@
+"""GraphClient — what drivers/console use to talk to a graphd.
+
+The nebula-python analog: authenticate once, then execute statements,
+receiving ResultSet-shaped replies (wire-decoded DataSet).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from ..core.wire import from_wire
+from ..exec.context import ResultSet
+from .rpc import RpcClient, RpcError
+
+
+class GraphClient:
+    def __init__(self, host: str, port: int):
+        # retries=0: a statement may be non-idempotent; re-sending after a
+        # dropped reply could execute it twice (at-least-once hazard)
+        self.rpc = RpcClient(host, port, timeout=300.0, retries=0)
+        self.session_id: Optional[int] = None
+
+    def authenticate(self, user: str = "root", password: str = "nebula"):
+        r = self.rpc.call("graph.authenticate", user=user, password=password)
+        self.session_id = r["session_id"]
+        return self.session_id
+
+    def execute(self, stmt: str) -> ResultSet:
+        if self.session_id is None:
+            raise RpcError("not authenticated")
+        r = self.rpc.call("graph.execute", session_id=self.session_id,
+                          stmt=stmt)
+        data = from_wire(r["data"]) if r["data"] is not None else None
+        return ResultSet(data=data, space=r["space"],
+                         latency_us=r["latency_us"],
+                         plan_desc=r["plan_desc"], error=r["error"])
+
+    def signout(self):
+        if self.session_id is not None:
+            self.rpc.call("graph.signout", session_id=self.session_id)
+            self.session_id = None
+
+    def close(self):
+        try:
+            self.signout()
+        finally:
+            self.rpc.close()

@@ -1,0 +1,78 @@
+"""Daemon entrypoints — `python -m nebula_tpu.cluster.daemons <role>`.
+
+The GraphDaemon/MetaDaemon/StorageDaemon analog (reference: src/daemons
+[UNVERIFIED — empty mount, SURVEY §0]): flag parsing, service wiring,
+signal-friendly foreground run.  One process per role:
+
+    python -m nebula_tpu.cluster.daemons metad    --addr 0.0.0.0:9559 \
+        --peers host1:9559,host2:9559,host3:9559 --data-dir /data/meta
+    python -m nebula_tpu.cluster.daemons storaged --addr 0.0.0.0:9779 \
+        --meta host1:9559 --data-dir /data/storage
+    python -m nebula_tpu.cluster.daemons graphd   --addr 0.0.0.0:9669 \
+        --meta host1:9559 [--tpu]
+"""
+from __future__ import annotations
+
+import argparse
+import signal
+import threading
+import time
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="nebula-tpu-daemon")
+    ap.add_argument("role", choices=["metad", "storaged", "graphd"])
+    ap.add_argument("--addr", required=True, help="host:port to listen on")
+    ap.add_argument("--peers", default="", help="metad: comma-sep peer addrs")
+    ap.add_argument("--meta", default="", help="comma-sep metad addrs")
+    ap.add_argument("--data-dir", default="./data")
+    ap.add_argument("--tpu", action="store_true",
+                    help="graphd: enable the device execution plane")
+    args = ap.parse_args(argv)
+
+    from .meta_client import MetaClient
+    from .rpc import RpcServer, serve_raft_parts
+
+    host, port = args.addr.rsplit(":", 1)
+    server = RpcServer(host, int(port))
+
+    if args.role == "metad":
+        from .meta_service import MetaService
+        peers = [p for p in args.peers.split(",") if p] or [args.addr]
+        svc = MetaService(args.addr, peers, args.data_dir, server=server)
+        serve_raft_parts(server, {"meta": svc.raft})
+    else:
+        metas = [m for m in args.meta.split(",") if m]
+        if not metas:
+            ap.error(f"{args.role} requires --meta")
+        mc = MetaClient(metas, my_addr=args.addr,
+                        role="storage" if args.role == "storaged" else "graph")
+        mc.wait_ready()
+        mc.refresh(force=True)
+        if args.role == "storaged":
+            from .storage_service import StorageService
+            svc = StorageService(args.addr, mc, args.data_dir, server=server)
+        else:
+            from .graph_service import GraphService
+            rt = None
+            if args.tpu:
+                from ..tpu.runtime import TpuRuntime
+                rt = TpuRuntime()
+            svc = GraphService(args.addr, mc, server=server, tpu_runtime=rt)
+
+    server.start()
+    svc.start()
+    print(f"nebula-tpu {args.role} serving on {server.addr}", flush=True)
+
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    signal.signal(signal.SIGINT, lambda *a: stop.set())
+    while not stop.is_set():
+        time.sleep(0.5)
+    svc.stop()
+    server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,188 @@
+"""MetaClient — cached catalog + part map + heartbeat loop.
+
+Analog of the reference's src/clients/meta MetaClient [UNVERIFIED —
+empty mount, SURVEY §0]: every process (graphd, storaged, tools) holds
+one; it finds the metad leader, keeps a versioned local replica of the
+catalog and partition map (refreshed when a heartbeat reply reports a
+newer version), and offers the meta operation set as methods.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from ..graphstore.schema import Catalog
+from .meta_service import _pk, _unpk
+from .rpc import RpcClient, RpcConnError, RpcError
+
+
+class MetaError(Exception):
+    pass
+
+
+class MetaClient:
+    def __init__(self, meta_addrs: List[str], my_addr: str = "",
+                 role: str = "client", heartbeat_interval: float = 1.0):
+        self.meta_addrs = list(meta_addrs)
+        self.my_addr = my_addr
+        self.role = role
+        self.hb_interval = heartbeat_interval
+        self.catalog = Catalog()
+        self.part_map: Dict[str, List[List[str]]] = {}
+        self.version = -1
+        self.lock = threading.RLock()
+        self._clients: Dict[str, RpcClient] = {}
+        self._leader: Optional[str] = None
+        self._hb_thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._hb_parts_fn = None          # set by storaged: () -> {space: [pid]}
+        self.on_refresh = None            # hook: called after a cache refresh
+
+    # -- leader discovery -------------------------------------------------
+
+    def _client(self, addr: str) -> RpcClient:
+        c = self._clients.get(addr)
+        if c is None:
+            c = self._clients[addr] = RpcClient.from_addr(addr, timeout=10.0,
+                                                          retries=0)
+        return c
+
+    def call(self, method: str, _retries: int = 6, **params) -> Any:
+        """Call the metad leader, following leader hints / re-probing."""
+        last = None
+        for _ in range(_retries):
+            addrs = ([self._leader] if self._leader else []) + \
+                [a for a in self.meta_addrs if a != self._leader]
+            for addr in addrs:
+                try:
+                    r = self._client(addr).call(method, **params)
+                    self._leader = addr
+                    return r
+                except RpcError as ex:
+                    last = ex
+                    msg = str(ex)
+                    if msg.startswith("not leader"):
+                        hint = msg.split("=", 1)[-1].strip()
+                        self._leader = hint or None
+                        continue
+                    raise MetaError(msg) from None
+                except RpcConnError as ex:
+                    last = ex
+                    self._leader = None
+                    continue
+            time.sleep(0.2)
+        raise MetaError(f"no metad leader reachable: {last}")
+
+    def wait_ready(self, timeout: float = 15.0):
+        dl = time.monotonic() + timeout
+        while time.monotonic() < dl:
+            try:
+                self.call("meta.ready", _retries=1)
+                return
+            except MetaError:
+                time.sleep(0.1)
+        raise MetaError("metad not ready")
+
+    # -- cache ------------------------------------------------------------
+
+    def refresh(self, force: bool = False):
+        with self.lock:
+            ver = None if force else self.version
+        r = self.call("meta.get_catalog", version=ver)
+        changed = r["catalog"] is not None
+        with self.lock:
+            if changed:
+                self.catalog = _unpk(r["catalog"])
+                self.part_map = r["part_map"]
+            self.version = r["version"]
+        if changed and self.on_refresh is not None:
+            self.on_refresh()
+
+    def heartbeat_once(self) -> Dict[str, Any]:
+        parts = self._hb_parts_fn() if self._hb_parts_fn else {}
+        r = self.call("meta.heartbeat", host=self.my_addr, role=self.role,
+                      parts=parts)
+        if r["version"] != self.version:
+            self.refresh(force=True)
+        return r
+
+    def start_heartbeat(self, parts_fn=None):
+        self._hb_parts_fn = parts_fn
+        self._stop.clear()
+
+        def loop():
+            while not self._stop.wait(self.hb_interval):
+                try:
+                    self.heartbeat_once()
+                except MetaError:
+                    pass
+        self._hb_thread = threading.Thread(target=loop, daemon=True,
+                                           name=f"meta-hb-{self.my_addr}")
+        self._hb_thread.start()
+
+    def stop_heartbeat(self):
+        self._stop.set()
+        if self._hb_thread:
+            self._hb_thread.join(timeout=2)
+
+    # -- meta ops ---------------------------------------------------------
+
+    def create_space(self, name: str, **kw):
+        r = self.call("meta.create_space", name=name, kw=kw)
+        self.refresh(force=True)
+        return r
+
+    def drop_space(self, name: str, if_exists: bool = False):
+        self.call("meta.drop_space", name=name, if_exists=if_exists)
+        self.refresh(force=True)
+
+    def ddl(self, method: str, *args, **kw):
+        """create_tag/create_edge/alter_*/drop_*/create_index/drop_index
+        with the same signatures as graphstore.schema.Catalog."""
+        cmd = {"op": "catalog", "method": method, "args": args, "kw": kw}
+        self.call("meta.ddl", cmd64=_pk(cmd))
+        self.refresh(force=True)
+
+    def parts_of(self, space: str) -> List[List[str]]:
+        with self.lock:
+            pm = self.part_map.get(space)
+        if pm is None:
+            self.refresh(force=True)
+            with self.lock:
+                pm = self.part_map.get(space)
+        if pm is None:
+            raise MetaError(f"space `{space}' not found")
+        return pm
+
+    def create_session(self, user: str, graphd: str) -> int:
+        return self.call("meta.create_session", user=user, graphd=graphd)
+
+    def remove_session(self, sid: int):
+        self.call("meta.remove_session", sid=sid)
+
+    def update_session(self, sid: int, **fields):
+        self.call("meta.update_session", sid=sid, fields=fields)
+
+    def list_sessions(self):
+        return self.call("meta.list_sessions")
+
+    def list_hosts(self):
+        return self.call("meta.list_hosts")
+
+    def get_config(self, name: Optional[str] = None):
+        return self.call("meta.get_config", **({"name": name} if name else {}))
+
+    def set_config(self, name: str, value: Any):
+        self.call("meta.set_config", name=name, value=value)
+
+    def submit_job(self, cmd: str, space: Optional[str] = None) -> int:
+        return self.call("meta.submit_job", cmd=cmd, space=space)
+
+    def list_jobs(self):
+        return self.call("meta.list_jobs")
+
+    def close(self):
+        self.stop_heartbeat()
+        for c in self._clients.values():
+            c.close()

@@ -1,0 +1,227 @@
+"""JSON-over-TCP RPC — the wire layer of the control plane.
+
+Replaces the reference's fbthrift services (graph.thrift / meta.thrift /
+storage.thrift / raftex.thrift; reference: src/interface +
+src/common/thrift [UNVERIFIED — empty mount, SURVEY §0]) with a
+dependency-free length-prefixed JSON protocol.  The OPERATION SET of
+those IDLs is preserved by the services built on top (SURVEY §2 row 6);
+only the encoding differs.  Data-plane traffic (frontier exchange) never
+rides this — it's XLA collectives (SURVEY §5, two-plane rule).
+
+Frame: u32 length | utf-8 JSON {"method": str, "params": {...}}
+Reply: u32 length | utf-8 JSON {"ok": bool, "result"|"error": ...}
+
+Values use the JSON-safe encoding of core.value (value_to_json /
+value_from_json) at the service layer.
+"""
+from __future__ import annotations
+
+import json
+import socket
+import socketserver
+import struct
+import threading
+import time
+from typing import Any, Callable, Dict, Optional
+
+_LEN = struct.Struct("<I")
+MAX_FRAME = 1 << 30
+
+
+class RpcError(Exception):
+    """Remote raised an application error."""
+
+
+class RpcConnError(Exception):
+    """Transport failure (connect/timeout/framing)."""
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise RpcConnError("connection closed")
+        buf += chunk
+    return bytes(buf)
+
+
+def _send_frame(sock: socket.socket, obj: Any):
+    data = json.dumps(obj, separators=(",", ":")).encode()
+    sock.sendall(_LEN.pack(len(data)) + data)
+
+
+def _recv_frame(sock: socket.socket) -> Any:
+    (n,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
+    if n > MAX_FRAME:
+        raise RpcConnError(f"frame too large: {n}")
+    return json.loads(_recv_exact(sock, n))
+
+
+class RpcServer:
+    """Threaded TCP server dispatching to registered handlers.
+
+    handler(params: dict) -> jsonable result; raising RpcError (or any
+    exception) returns an error reply instead of killing the connection.
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self.handlers: Dict[str, Callable[[Dict[str, Any]], Any]] = {}
+        self.hooks: list = []           # fault-injection: fn(method) -> None|Exception
+        outer = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                sock = self.request
+                sock.settimeout(300)
+                try:
+                    while True:
+                        req = _recv_frame(sock)
+                        _send_frame(sock, outer._dispatch(req))
+                except (RpcConnError, socket.timeout, OSError,
+                        json.JSONDecodeError):
+                    pass
+
+        class Server(socketserver.ThreadingTCPServer):
+            daemon_threads = True
+            allow_reuse_address = True
+
+        self._server = Server((host, port), Handler)
+        self.host, self.port = self._server.server_address
+        self._thread: Optional[threading.Thread] = None
+
+    def register(self, method: str, fn: Callable[[Dict[str, Any]], Any]):
+        self.handlers[method] = fn
+
+    def register_service(self, obj: Any, prefix: str = ""):
+        """Every public method rpc_* of obj becomes `prefix+name`."""
+        for name in dir(obj):
+            if name.startswith("rpc_"):
+                self.register(prefix + name[4:], getattr(obj, name))
+
+    def _dispatch(self, req: Any) -> Dict[str, Any]:
+        try:
+            method = req["method"]
+            params = req.get("params", {})
+            for hook in self.hooks:
+                hook(method)
+            fn = self.handlers.get(method)
+            if fn is None:
+                return {"ok": False, "error": f"unknown method `{method}'"}
+            return {"ok": True, "result": fn(params)}
+        except RpcError as ex:
+            return {"ok": False, "error": str(ex)}
+        except Exception as ex:  # noqa: BLE001 — server must not die
+            return {"ok": False, "error": f"{type(ex).__name__}: {ex}"}
+
+    @property
+    def addr(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def start(self):
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True,
+                                        name=f"rpc-{self.port}")
+        self._thread.start()
+
+    def stop(self):
+        self._server.shutdown()
+        self._server.server_close()
+
+
+class RpcClient:
+    """One connection, auto-reconnect, thread-safe (serialized calls)."""
+
+    def __init__(self, host: str, port: int, timeout: float = 30.0,
+                 retries: int = 2):
+        self.host, self.port = host, port
+        self.timeout = timeout
+        self.retries = retries
+        self._sock: Optional[socket.socket] = None
+        self._lock = threading.Lock()
+
+    @classmethod
+    def from_addr(cls, addr: str, **kw) -> "RpcClient":
+        host, port = addr.rsplit(":", 1)
+        return cls(host, int(port), **kw)
+
+    def _connect(self):
+        s = socket.create_connection((self.host, self.port),
+                                     timeout=self.timeout)
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._sock = s
+
+    def call(self, method: str, **params) -> Any:
+        last_err: Optional[Exception] = None
+        for attempt in range(self.retries + 1):
+            try:
+                with self._lock:
+                    if self._sock is None:
+                        self._connect()
+                    _send_frame(self._sock, {"method": method,
+                                             "params": params})
+                    reply = _recv_frame(self._sock)
+                if reply.get("ok"):
+                    return reply.get("result")
+                raise RpcError(reply.get("error", "unknown error"))
+            except RpcError:
+                raise
+            except (OSError, RpcConnError, json.JSONDecodeError) as ex:
+                last_err = ex
+                with self._lock:
+                    if self._sock is not None:
+                        try:
+                            self._sock.close()
+                        except OSError:
+                            pass
+                        self._sock = None
+                if attempt < self.retries:
+                    time.sleep(0.05 * (attempt + 1))
+        raise RpcConnError(f"rpc to {self.host}:{self.port} failed: {last_err}")
+
+    def close(self):
+        with self._lock:
+            if self._sock is not None:
+                try:
+                    self._sock.close()
+                except OSError:
+                    pass
+                self._sock = None
+
+
+class RpcRaftTransport:
+    """RaftTransport over RpcClient connections — raftex.thrift's role.
+
+    peer ids ARE addresses ("host:port"); raft messages dispatch to the
+    `raft` method of the peer's RpcServer, which routes to the right
+    RaftPart by group.
+    """
+
+    def __init__(self):
+        self._clients: Dict[str, RpcClient] = {}
+        self._lock = threading.Lock()
+
+    def client(self, peer: str) -> RpcClient:
+        with self._lock:
+            c = self._clients.get(peer)
+            if c is None:
+                c = self._clients[peer] = RpcClient.from_addr(
+                    peer, timeout=2.0, retries=0)
+            return c
+
+    def send(self, peer, group, method, payload):
+        try:
+            return self.client(peer).call(
+                "raft", group=group, rmethod=method, payload=payload)
+        except (RpcError, RpcConnError):
+            return None
+
+
+def serve_raft_parts(server: RpcServer, parts: Dict[str, Any]):
+    """Register the `raft` dispatch method for a dict group → RaftPart."""
+    def handler(params):
+        part = parts.get(params["group"])
+        if part is None:
+            raise RpcError(f"no raft group `{params['group']}' here")
+        return part.handle(params["rmethod"], params["payload"])
+    server.register("raft", handler)

@@ -1,0 +1,182 @@
+"""File-based write-ahead log, one per Raft part.
+
+Analog of the reference's FileBasedWal + AtomicLogBuffer (reference:
+src/kvstore/wal [UNVERIFIED — empty mount, SURVEY §0]): an append-only
+record log with (term, index, payload) entries, CRC-checked, truncatable
+from the tail (log rollback after leader change) and from the head
+(snapshot GC).
+
+Record format (little-endian):
+    u32 crc32(payload_len..payload) | u32 payload_len | u64 index |
+    u64 term | payload bytes
+"""
+from __future__ import annotations
+
+import os
+import struct
+import threading
+import zlib
+from typing import Iterator, List, Optional, Tuple
+
+_HDR = struct.Struct("<IIQQ")          # crc, len, index, term
+
+
+class WalError(Exception):
+    pass
+
+
+class Wal:
+    """Append-only (term, index, data) log with in-memory index."""
+
+    def __init__(self, path: str, sync: bool = False):
+        self.path = path
+        self.sync = sync
+        self.lock = threading.RLock()
+        self._entries: List[Tuple[int, int, int]] = []  # (index, term, offset)
+        self._first_index = 1
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._recover()
+        self._f = open(self.path, "ab")
+
+    # -- recovery ---------------------------------------------------------
+
+    def _recover(self):
+        if not os.path.exists(self.path):
+            return
+        good_end = 0
+        with open(self.path, "rb") as f:
+            data = f.read()
+        off = 0
+        while off + _HDR.size <= len(data):
+            crc, ln, idx, term = _HDR.unpack_from(data, off)
+            end = off + _HDR.size + ln
+            if end > len(data):
+                break
+            payload = data[off + _HDR.size:end]
+            calc = zlib.crc32(_HDR.pack(0, ln, idx, term)[4:] + payload)
+            if calc != crc:
+                break                   # torn tail write — truncate here
+            self._entries.append((idx, term, off))
+            good_end = end
+            off = end
+        if good_end < len(data):
+            with open(self.path, "r+b") as f:
+                f.truncate(good_end)
+        if self._entries:
+            self._first_index = self._entries[0][0]
+
+    # -- append / read ----------------------------------------------------
+
+    def append(self, index: int, term: int, data: bytes):
+        with self.lock:
+            if self._entries:
+                last = self._entries[-1][0]
+                if index != last + 1:
+                    raise WalError(
+                        f"non-contiguous append {index} after {last}")
+            else:
+                # first entry anchors the index base (e.g. the log restarts
+                # at snap_index+1 after a snapshot install)
+                self._first_index = index
+            off = self._f.tell()
+            hdr_rest = _HDR.pack(0, len(data), index, term)[4:]
+            crc = zlib.crc32(hdr_rest + data)
+            self._f.write(_HDR.pack(crc, len(data), index, term))
+            self._f.write(data)
+            self._f.flush()
+            if self.sync:
+                os.fsync(self._f.fileno())
+            self._entries.append((index, term, off))
+
+    def last_index(self) -> int:
+        with self.lock:
+            return self._entries[-1][0] if self._entries else self._first_index - 1
+
+    def last_term(self) -> int:
+        with self.lock:
+            return self._entries[-1][1] if self._entries else 0
+
+    def first_index(self) -> int:
+        return self._first_index
+
+    def term_of(self, index: int) -> Optional[int]:
+        with self.lock:
+            i = index - self._first_index
+            if 0 <= i < len(self._entries):
+                return self._entries[i][1]
+            return None
+
+    def read(self, index: int) -> Optional[Tuple[int, bytes]]:
+        """-> (term, data) or None."""
+        with self.lock:
+            i = index - self._first_index
+            if not (0 <= i < len(self._entries)):
+                return None
+            _, term, off = self._entries[i]
+        with open(self.path, "rb") as f:
+            f.seek(off)
+            hdr = f.read(_HDR.size)
+            _, ln, idx, t = _HDR.unpack(hdr)
+            return t, f.read(ln)
+
+    def read_range(self, start: int, end: int) -> Iterator[Tuple[int, int, bytes]]:
+        """Yield (index, term, data) for start <= index <= end."""
+        for idx in range(max(start, self._first_index),
+                         min(end, self.last_index()) + 1):
+            r = self.read(idx)
+            if r is None:
+                return
+            yield idx, r[0], r[1]
+
+    # -- truncation -------------------------------------------------------
+
+    def truncate_from(self, index: int):
+        """Drop entries >= index (conflicting suffix after leader change)."""
+        with self.lock:
+            i = index - self._first_index
+            if i < 0:
+                i = 0
+            if i >= len(self._entries):
+                return
+            off = self._entries[i][2]
+            self._f.close()
+            with open(self.path, "r+b") as f:
+                f.truncate(off)
+            self._f = open(self.path, "ab")
+            del self._entries[i:]
+
+    def reset(self, first_index: int):
+        """Clear the log and restart it at first_index (after a snapshot
+        install replaces all local state)."""
+        with self.lock:
+            self._f.close()
+            with open(self.path, "wb"):
+                pass
+            self._f = open(self.path, "ab")
+            self._entries = []
+            self._first_index = first_index
+
+    def compact_to(self, index: int):
+        """Drop entries <= index (after snapshot). Rewrites the file."""
+        with self.lock:
+            keep = [(i, t, o) for (i, t, o) in self._entries if i > index]
+            self._f.close()
+            tmp = self.path + ".compact"
+            with open(tmp, "wb") as out, open(self.path, "rb") as src:
+                new_entries = []
+                for idx, term, off in keep:
+                    src.seek(off)
+                    hdr = src.read(_HDR.size)
+                    _, ln, _, _ = _HDR.unpack(hdr)
+                    new_off = out.tell()
+                    out.write(hdr)
+                    out.write(src.read(ln))
+                    new_entries.append((idx, term, new_off))
+            os.replace(tmp, self.path)
+            self._entries = new_entries
+            self._first_index = index + 1 if not new_entries else new_entries[0][0]
+            self._f = open(self.path, "ab")
+
+    def close(self):
+        with self.lock:
+            self._f.close()

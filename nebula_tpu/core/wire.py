@@ -1,0 +1,113 @@
+"""Wire (de)serialization of the Value model — the thrift-struct analog.
+
+Every nGQL value maps to a JSON-safe form and back, losslessly (null
+kinds, temporal types, vertex/edge/path, sets, DataSet).  This is the
+process-boundary encoding used by cluster RPC (reference: the thrift
+serialization of src/common/datatypes [UNVERIFIED — empty mount,
+SURVEY §0]).
+
+Plain JSON scalars pass through untouched; composite/typed values become
+{"@t": tag, ...} dicts (plain maps are tagged too, so user maps whose
+keys include "@t" round-trip safely).
+"""
+from __future__ import annotations
+
+from typing import Any
+
+from .value import (DataSet, Date, DateTime, Duration, Edge, EmptyValue,
+                    NullKind, NullValue, Path, Step, Tag, Time, Vertex)
+
+
+def to_wire(v: Any) -> Any:
+    if v is None or isinstance(v, (bool, int, float, str)):
+        return v
+    if isinstance(v, NullValue):
+        return {"@t": "null", "k": v.kind.name}
+    if isinstance(v, EmptyValue):
+        return {"@t": "empty"}
+    if isinstance(v, Date):
+        return {"@t": "date", "v": [v.year, v.month, v.day]}
+    if isinstance(v, Time):
+        return {"@t": "time", "v": [v.hour, v.minute, v.sec, v.microsec]}
+    if isinstance(v, DateTime):
+        return {"@t": "datetime", "v": [v.year, v.month, v.day, v.hour,
+                                        v.minute, v.sec, v.microsec]}
+    if isinstance(v, Duration):
+        return {"@t": "duration", "v": [v.seconds, v.microseconds, v.months]}
+    if isinstance(v, Tag):
+        return {"@t": "tag", "n": v.name,
+                "p": {k: to_wire(x) for k, x in v.props.items()}}
+    if isinstance(v, Vertex):
+        return {"@t": "vertex", "vid": to_wire(v.vid),
+                "tags": [to_wire(t) for t in v.tags]}
+    if isinstance(v, Edge):
+        return {"@t": "edge", "src": to_wire(v.src), "dst": to_wire(v.dst),
+                "n": v.name, "r": v.ranking, "et": v.etype,
+                "p": {k: to_wire(x) for k, x in v.props.items()}}
+    if isinstance(v, Step):
+        return {"@t": "step", "dst": to_wire(v.dst), "n": v.name,
+                "r": v.ranking, "et": v.etype,
+                "p": {k: to_wire(x) for k, x in v.props.items()}}
+    if isinstance(v, Path):
+        return {"@t": "path", "src": to_wire(v.src),
+                "steps": [to_wire(s) for s in v.steps]}
+    if isinstance(v, DataSet):
+        return {"@t": "dataset", "cols": list(v.column_names),
+                "rows": [[to_wire(c) for c in r] for r in v.rows]}
+    if isinstance(v, list):
+        return {"@t": "list", "v": [to_wire(x) for x in v]}
+    if isinstance(v, tuple):
+        return {"@t": "list", "v": [to_wire(x) for x in v]}
+    if isinstance(v, set):
+        return {"@t": "set", "v": [to_wire(x) for x in sorted(v, key=repr)]}
+    if isinstance(v, dict):
+        return {"@t": "map", "v": {k: to_wire(x) for k, x in v.items()}}
+    raise TypeError(f"not wire-serializable: {type(v).__name__}")
+
+
+def from_wire(j: Any) -> Any:
+    if j is None or isinstance(j, (bool, int, float, str)):
+        return j
+    if isinstance(j, list):            # bare JSON list (rpc params etc.)
+        return [from_wire(x) for x in j]
+    if not isinstance(j, dict):
+        raise TypeError(f"bad wire value: {type(j).__name__}")
+    t = j.get("@t")
+    if t is None:                      # bare JSON object
+        return {k: from_wire(x) for k, x in j.items()}
+    if t == "null":
+        return NullValue(NullKind[j["k"]])
+    if t == "empty":
+        return EmptyValue()
+    if t == "date":
+        return Date(*j["v"])
+    if t == "time":
+        return Time(*j["v"])
+    if t == "datetime":
+        return DateTime(*j["v"])
+    if t == "duration":
+        return Duration(*j["v"])
+    if t == "tag":
+        return Tag(j["n"], {k: from_wire(x) for k, x in j["p"].items()})
+    if t == "vertex":
+        return Vertex(from_wire(j["vid"]), [from_wire(x) for x in j["tags"]])
+    if t == "edge":
+        return Edge(from_wire(j["src"]), from_wire(j["dst"]), j["n"],
+                    j["r"], {k: from_wire(x) for k, x in j["p"].items()},
+                    etype=j["et"])
+    if t == "step":
+        return Step(from_wire(j["dst"]), j["n"], j["r"],
+                    {k: from_wire(x) for k, x in j["p"].items()},
+                    etype=j["et"])
+    if t == "path":
+        return Path(from_wire(j["src"]), [from_wire(s) for s in j["steps"]])
+    if t == "dataset":
+        return DataSet(list(j["cols"]),
+                       [[from_wire(c) for c in r] for r in j["rows"]])
+    if t == "list":
+        return [from_wire(x) for x in j["v"]]
+    if t == "set":
+        return {from_wire(x) for x in j["v"]}
+    if t == "map":
+        return {k: from_wire(x) for k, x in j["v"].items()}
+    raise TypeError(f"unknown wire tag {t!r}")

@@ -241,6 +241,88 @@ class GraphStore:
             sd.epoch += 1
             return True
 
+    # ---- raw part-local apply (cluster write path) ----
+    # Schema defaults are resolved by the caller (graphd) before the op is
+    # proposed to the part's raft group, so replica replay is
+    # deterministic; each op touches exactly ONE part (edge writes are
+    # split into out/in halves — the TOSS chain, SURVEY §2 row 14).
+
+    def apply_vertex(self, space: str, vid: Any, tag: str, version: int,
+                     row: Dict[str, Any]):
+        sd = self.space(space)
+        with sd.lock:
+            p = sd.parts[sd.part_of(vid)]
+            sd.dense_id(vid, create=True)
+            p.vertices.setdefault(vid, {})[tag] = (version, dict(row))
+            sd.epoch += 1
+
+    def apply_edge_half(self, space: str, src: Any, etype: str, dst: Any,
+                        rank: int, row: Dict[str, Any], which: str):
+        sd = self.space(space)
+        with sd.lock:
+            if which == "out":
+                sd.dense_id(src, create=True)
+                p = sd.parts[sd.part_of(src)]
+                p.out_edges.setdefault(src, {}).setdefault(etype, {})[
+                    (rank, dst)] = dict(row)
+            else:
+                sd.dense_id(dst, create=True)
+                p = sd.parts[sd.part_of(dst)]
+                p.in_edges.setdefault(dst, {}).setdefault(etype, {})[
+                    (rank, src)] = dict(row)
+            sd.epoch += 1
+
+    def apply_delete_vertex(self, space: str, vid: Any):
+        """Remove the vertex row + its own adjacency planes (the caller
+        deletes the mirror halves on other parts)."""
+        sd = self.space(space)
+        with sd.lock:
+            p = sd.parts[sd.part_of(vid)]
+            p.vertices.pop(vid, None)
+            p.out_edges.pop(vid, None)
+            p.in_edges.pop(vid, None)
+            sd.epoch += 1
+
+    def apply_delete_edge_half(self, space: str, src: Any, etype: str,
+                               dst: Any, rank: int, which: str):
+        sd = self.space(space)
+        with sd.lock:
+            if which == "out":
+                p = sd.parts[sd.part_of(src)]
+                p.out_edges.get(src, {}).get(etype, {}).pop((rank, dst), None)
+            else:
+                p = sd.parts[sd.part_of(dst)]
+                p.in_edges.get(dst, {}).get(etype, {}).pop((rank, src), None)
+            sd.epoch += 1
+
+    def apply_update_vertex(self, space: str, vid: Any, tag: str,
+                            updates: Dict[str, Any]) -> bool:
+        sd = self.space(space)
+        with sd.lock:
+            tv = sd.parts[sd.part_of(vid)].vertices.get(vid, {}).get(tag)
+            if tv is None:
+                return False
+            tv[1].update(updates)
+            sd.epoch += 1
+            return True
+
+    def apply_update_edge_half(self, space: str, src: Any, etype: str,
+                               dst: Any, rank: int,
+                               updates: Dict[str, Any], which: str) -> bool:
+        sd = self.space(space)
+        with sd.lock:
+            if which == "out":
+                row = sd.parts[sd.part_of(src)].out_edges.get(src, {}) \
+                    .get(etype, {}).get((rank, dst))
+            else:
+                row = sd.parts[sd.part_of(dst)].in_edges.get(dst, {}) \
+                    .get(etype, {}).get((rank, src))
+            if row is None:
+                return False
+            row.update(updates)
+            sd.epoch += 1
+            return True
+
     # ---- read: point / scan ----
     def get_vertex(self, space: str, vid: Any) -> Optional[Dict[str, Dict[str, Any]]]:
         """vid → {tag: props} or None."""

@@ -206,7 +206,7 @@ class TpuRuntime:
             if not esc:
                 break
         else:
-            raise RuntimeError("bucket escalation did not converge")
+            raise TpuUnavailable("bucket escalation did not converge")
 
         stats.f_cap, stats.e_cap = F, EB
         stats.hop_edges = [int(x) for x in res["hop_edges"].sum(axis=0)]

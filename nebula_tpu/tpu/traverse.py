@@ -26,6 +26,12 @@ from ..query.plan import PlanNode, walk_plan
 from .device import TpuUnavailable
 from .exprjit import CannotCompile, compilable
 
+try:
+    import jax
+    _JAX_RT_ERRORS = (jax.errors.JaxRuntimeError,)
+except (ImportError, AttributeError):
+    _JAX_RT_ERRORS = ()
+
 # ---------------------------------------------------------------------------
 # Fusion rule
 # ---------------------------------------------------------------------------
@@ -137,12 +143,13 @@ def _tpu_traverse(node, qctx, ectx, space):
             qctx.last_tpu_stats = stats
             return DataSet(["_src", "_edge", "_dst"],
                            [[s, e, d] for (s, e, d) in rows])
-        except (CannotCompile, TpuUnavailable, RuntimeError):
-            # RuntimeError covers XlaRuntimeError (e.g. HBM
-            # RESOURCE_EXHAUSTED on pin) and bucket-escalation
-            # non-convergence — all "device cannot serve this" cases;
-            # the host path below has identical semantics
-            pass
+        except (CannotCompile, TpuUnavailable) + _JAX_RT_ERRORS as ex:
+            # JaxRuntimeError covers device-capacity failures (e.g. HBM
+            # RESOURCE_EXHAUSTED on pin); escalation non-convergence
+            # raises TpuUnavailable.  The host path below has identical
+            # semantics; the fallback cause is recorded for PROFILE/debug
+            # rather than silently swallowed.
+            qctx.last_tpu_fallback = f"{type(ex).__name__}: {ex}"
     return _host_traverse(node, qctx, sp, vids)
 
 
