@@ -205,6 +205,38 @@ class DistributedStore:
             for row in per_vid.get(repr(vid), []):
                 yield row
 
+    def index_scan(self, space: str, index_name: str, eq_prefix: List[Any],
+                   range_hint=None, parts: Optional[List[int]] = None):
+        from ..graphstore.index import _Sentinel
+        rng = None
+        if range_hint is not None:
+            # open bounds ride as JSON null — a real bound can't be None
+            # (null predicates are rejected at hint extraction)
+            lo, hi, li, hi_inc = range_hint
+            lo = None if isinstance(lo, _Sentinel) else to_wire(lo)
+            hi = None if isinstance(hi, _Sentinel) else to_wire(hi)
+            rng = [lo, hi, li, hi_inc]
+        pids = list(parts) if parts is not None else self.sc.all_parts(space)
+        out: List[Any] = []
+        for pid, ents in self.sc.fanout(
+                space, {p: {"index": index_name, "eq": to_wire(eq_prefix),
+                            "range": rng} for p in pids},
+                "storage.index_scan"):
+            for e in ents:
+                v = from_wire(e)
+                out.append(tuple(v) if isinstance(v, list) else v)
+        return out
+
+    def rebuild_index(self, space: str, index_name: str,
+                      parts: Optional[List[int]] = None) -> int:
+        pids = list(parts) if parts is not None else self.sc.all_parts(space)
+        total = 0
+        for pid, n in self.sc.fanout(
+                space, {p: {"index": index_name} for p in pids},
+                "storage.rebuild_index"):
+            total += n
+        return total
+
     def stats(self, space: str) -> Dict[str, Any]:
         pids = self.sc.all_parts(space)
         per = dict(self.sc.fanout(space, {p: {} for p in pids},

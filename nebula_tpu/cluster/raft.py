@@ -122,6 +122,11 @@ class RaftPart:
         self.snap_index = 0
         self.snap_term = 0
         self._load_meta()
+        if self.snap_index and self.wal.last_index() < self.snap_index:
+            # snapshot compaction emptied the WAL before this restart —
+            # re-anchor it past the snapshot or a new leadership here
+            # would append at index 1 and never commit
+            self.wal.reset(self.snap_index + 1)
 
         self.state = FOLLOWER
         self.leader_id: Optional[str] = None

@@ -128,6 +128,8 @@ class StorageService:
                                       updates, which)
         elif op == "del_tag":
             st.delete_tag(space, cmd[1], cmd[2])
+        elif op == "rebuild_index":
+            st.rebuild_index(space, cmd[1], parts=[cmd[2]])
         else:
             raise ValueError(f"unknown storage op {op!r}")
 
@@ -217,6 +219,32 @@ class StorageService:
             out.append([to_wire(src), et, rank, to_wire(dst),
                         {k: to_wire(v) for k, v in row.items()}])
         return out
+
+    def rpc_index_scan(self, p):
+        self._leader_part(p["space"], p["part"])
+        rng = p.get("range")
+        if rng is not None:
+            from ..graphstore.index import MAX, MIN
+            lo, hi, li, hi_inc = rng
+            lo = MIN if lo is None else from_wire(lo)
+            hi = MAX if hi is None else from_wire(hi)
+            rng = (lo, hi, li, hi_inc)
+        ents = self.store.index_scan(p["space"], p["index"],
+                                     from_wire(p["eq"]), rng,
+                                     parts=[p["part"]])
+        return [to_wire(list(e) if isinstance(e, tuple) else e)
+                for e in ents]
+
+    def rpc_rebuild_index(self, p):
+        # rebuild rides the part's raft log so replicas backfill too —
+        # followers must serve identical index state after failover
+        part = self._leader_part(p["space"], p["part"])
+        data = pickle.dumps(("rebuild_index", p["index"], p["part"]))
+        if part.propose(data) is None:
+            raise RpcError("part_leader_changed: rebuild not committed")
+        sd = self.store.space(p["space"])
+        idx = sd.index_data.get(p["index"])
+        return len(idx.parts[p["part"]]) if idx is not None else 0
 
     def rpc_part_stats(self, p):
         sd = self.store.space(p["space"])

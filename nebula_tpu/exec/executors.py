@@ -234,6 +234,8 @@ def _index_scan(node, qctx, ectx, space):
     sp = a["space"]
     schema = a["schema"]
     filt = a.get("filter")
+    if a.get("index"):
+        return _index_scan_indexed(node, qctx, sp, schema, filt, a)
     rows = []
     if a["is_edge"]:
         etype_id = qctx.store.catalog.get_edge(sp, schema).edge_type
@@ -255,6 +257,45 @@ def _index_scan(node, qctx, ectx, space):
             v = qctx.build_vertex(sp, vid)
             if filt is not None:
                 rc = RowContext(qctx, sp, {"_matched": v}, extra_vars={schema: v})
+                if to_bool3(filt.eval(rc)) is not True:
+                    continue
+            rows.append([v])
+        rows.sort(key=lambda r: total_order_key(r[0].vid))
+    return DataSet([node.col_names[0]], rows)
+
+
+def _index_scan_indexed(node, qctx, sp, schema, filt, a):
+    """LOOKUP via secondary index: prefix/range scan → entity fetch →
+    residual filter (SURVEY §2 row 15)."""
+    entities = qctx.store.index_scan(sp, a["index"], a.get("eq") or [],
+                                     a.get("range"))
+    rows = []
+    if a["is_edge"]:
+        etype_id = qctx.store.catalog.get_edge(sp, schema).edge_type
+        for (src, rank, dst) in entities:
+            props = qctx.store.get_edge(sp, src, schema, dst, rank)
+            if props is None:
+                continue
+            e = Edge(src, dst, schema, rank, dict(props), etype_id)
+            if filt is not None:
+                rc = RowContext(qctx, sp, {"_matched": e, "_edge": e},
+                                extra_vars={schema: e})
+                if to_bool3(filt.eval(rc)) is not True:
+                    continue
+            rows.append([e])
+        rows.sort(key=lambda r: total_order_key(r[0].key()))
+    else:
+        seen = set()
+        for vid in entities:
+            if vid in seen:
+                continue
+            seen.add(vid)
+            v = qctx.build_vertex(sp, vid)
+            if v is None:
+                continue
+            if filt is not None:
+                rc = RowContext(qctx, sp, {"_matched": v},
+                                extra_vars={schema: v})
                 if to_bool3(filt.eval(rc)) is not True:
                     continue
             rows.append([v])
@@ -927,7 +968,11 @@ def _drop_index(node, qctx, ectx, space):
 
 @executor("RebuildIndex")
 def _rebuild_index(node, qctx, ectx, space):
-    return DataSet(["New Job Id"], [[0]])
+    a = node.args
+    from .jobs import job_manager
+    job = job_manager().submit(qctx, f"rebuild index {a['index_name']}",
+                               a["space"])
+    return DataSet(["New Job Id"], [[job.job_id]])
 
 
 @executor("Describe")

@@ -60,6 +60,11 @@ class JobManager:
             return {}
         if command == "ingest":
             return {}
+        if command.startswith("rebuild index "):
+            if not space:
+                raise ValueError("rebuild index job needs a space")
+            name = command[len("rebuild index "):]
+            return {"entries": qctx.store.rebuild_index(space, name)}
         raise ValueError(f"unknown job `{command}'")
 
 

@@ -1,0 +1,124 @@
+"""Secondary indexes — sorted per-part key lists with prefix/range scan.
+
+Analog of the reference's index kv records + IndexScanNode family
+(reference: src/storage/index + index keys in src/codec [UNVERIFIED —
+empty mount, SURVEY §0]).  An index over (f1..fn) keeps, per partition,
+a sorted list of (normalized key tuple, entity) where entity is the vid
+(tag index) or (src, rank, dst) (edge index).  Scans take an equality
+prefix plus an optional range on the next column — exactly the column-
+hint shape the reference's optimizer extracts from LOOKUP predicates.
+
+Semantics match the reference: CREATE INDEX starts empty and indexes
+only subsequent writes; REBUILD INDEX backfills existing rows.
+"""
+from __future__ import annotations
+
+import bisect
+import threading
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+from ..core.value import total_order_key
+
+
+class _Sentinel:
+    """MIN sorts below everything, MAX above (via reflected compares:
+    tuple elements fall back to these __gt__/__lt__ when their own
+    __lt__ returns NotImplemented)."""
+
+    __slots__ = ("lo",)
+
+    def __init__(self, lo: bool):
+        self.lo = lo
+
+    def __lt__(self, o):
+        return self.lo
+
+    def __gt__(self, o):
+        return not self.lo
+
+    def __repr__(self):
+        return "-inf" if self.lo else "+inf"
+
+
+MIN, MAX = _Sentinel(True), _Sentinel(False)
+
+
+def norm(v: Any):
+    """Index column normalization: total order incl. NULL-last."""
+    if isinstance(v, _Sentinel):
+        return v
+    return total_order_key(v)
+
+
+class IndexData:
+    """One index's entries across the parts of a space.
+
+    Stored items are (key_norm_tuple, entity_norm, entity); list order is
+    (key, entity_norm).  Probes are 1-tuples (partial_key,) so tuple
+    comparison gives prefix-range semantics directly.
+    """
+
+    __slots__ = ("name", "fields", "is_edge", "index_id", "parts", "lock")
+
+    def __init__(self, name: str, fields: List[str], is_edge: bool,
+                 num_parts: int, index_id: int = 0):
+        self.name = name
+        self.fields = list(fields)
+        self.is_edge = is_edge
+        self.index_id = index_id
+        self.parts: List[List[Tuple]] = [[] for _ in range(num_parts)]
+        self.lock = threading.RLock()
+
+    def key_of(self, row: Dict[str, Any]) -> Tuple:
+        return tuple(norm(row.get(f)) for f in self.fields)
+
+    def add(self, part: int, row: Dict[str, Any], entity: Any):
+        k = self.key_of(row)
+        en = norm(entity)
+        with self.lock:
+            lst = self.parts[part]
+            i = bisect.bisect_left(lst, (k, en))
+            if i < len(lst) and lst[i][0] == k and lst[i][1] == en:
+                lst[i] = (k, en, entity)   # idempotent overwrite
+            else:
+                lst.insert(i, (k, en, entity))
+
+    def remove(self, part: int, row: Dict[str, Any], entity: Any):
+        k = self.key_of(row)
+        en = norm(entity)
+        with self.lock:
+            lst = self.parts[part]
+            i = bisect.bisect_left(lst, (k, en))
+            if i < len(lst) and lst[i][0] == k and lst[i][1] == en:
+                del lst[i]
+
+    def clear(self):
+        with self.lock:
+            for lst in self.parts:
+                lst.clear()
+
+    def count(self) -> int:
+        with self.lock:
+            return sum(len(p) for p in self.parts)
+
+    def scan(self, part: int, eq_prefix: List[Any],
+             range_hint: Optional[Tuple[Any, Any, bool, bool]] = None
+             ) -> List[Any]:
+        """Entities with key[:k] == eq_prefix, optionally key[k] in the
+        (lo, hi, lo_incl, hi_incl) range.  MIN/MAX mark open ends."""
+        pre = tuple(norm(v) for v in eq_prefix)
+        if range_hint is None:
+            lo_probe = (pre,)
+            hi_probe = (pre + (MAX,),)
+        else:
+            lo, hi, lo_inc, hi_inc = range_hint
+            lo_n, hi_n = norm(lo), norm(hi)
+            lo_probe = ((pre + (lo_n,)),) if lo_inc \
+                else ((pre + (lo_n, MAX)),)
+            hi_probe = ((pre + (hi_n, MAX)),) if hi_inc \
+                else ((pre + (hi_n,)),)
+        with self.lock:
+            lst = self.parts[part]
+            i = bisect.bisect_left(lst, lo_probe)
+            j = bisect.bisect_left(lst, hi_probe)
+            return [lst[t][2] for t in range(i, j)]

@@ -316,7 +316,8 @@ class Catalog:
         for f in fields:
             if schema.latest.prop(f) is None:
                 raise SchemaError(f"prop `{f}' not in `{schema_name}'")
-        d = IndexDesc(index_name, schema_name, list(fields), is_edge)
+        d = IndexDesc(index_name, schema_name, list(fields), is_edge,
+                      index_id=self._alloc_id(sp.space_id))
         idxs[index_name] = d
         self.version += 1
         return d
@@ -341,6 +342,9 @@ class IndexDesc:
     schema_name: str
     fields: List[str]
     is_edge: bool
+    # unique per creation: DROP + re-CREATE with the same name/fields must
+    # NOT resurrect the old entries (the store compares this id)
+    index_id: int = 0
 
 
 def apply_defaults(sv: SchemaVersion, props: Dict[str, Any],

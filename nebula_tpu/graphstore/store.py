@@ -69,6 +69,7 @@ class SpaceData:
         self.part_counts = [0] * desc.partition_num
         self.epoch = 0
         self.lock = threading.RLock()
+        self.index_data: Dict[str, Any] = {}   # index name → IndexData
 
     @property
     def num_parts(self) -> int:
@@ -135,6 +136,97 @@ class GraphStore:
             sd = self.data[sp.space_id] = SpaceData(sp)
         return sd
 
+    # ---- secondary index maintenance (SURVEY §2 row 15) ----
+    # Hooks called from every write path (rich and raw-apply) so cluster
+    # replicas maintain identical index state; CREATE INDEX starts empty
+    # (reference semantics) — rebuild_index() backfills.
+
+    def _index_list(self, sd: SpaceData, space: str, schema: str,
+                    is_edge: bool):
+        from .index import IndexData
+        descs = self.catalog.indexes_for(space, schema, is_edge)
+        out = []
+        for d in descs:
+            idx = sd.index_data.get(d.name)
+            if idx is None or idx.fields != d.fields or \
+                    idx.index_id != d.index_id:
+                # new creation (possibly after a DROP of a same-named
+                # index) — starts empty, never resurrects old entries
+                idx = sd.index_data[d.name] = IndexData(
+                    d.name, d.fields, d.is_edge, sd.num_parts, d.index_id)
+            out.append(idx)
+        return out
+
+    def _index_vertex(self, sd, space, vid, tag, old_row, new_row):
+        part = sd.part_of(vid)
+        for idx in self._index_list(sd, space, tag, False):
+            if old_row is not None:
+                idx.remove(part, old_row, vid)
+            if new_row is not None:
+                idx.add(part, new_row, vid)
+
+    def _index_edge(self, sd, space, src, etype, dst, rank, old_row,
+                    new_row):
+        part = sd.part_of(src)
+        ent = (src, rank, dst)
+        for idx in self._index_list(sd, space, etype, True):
+            if old_row is not None:
+                idx.remove(part, old_row, ent)
+            if new_row is not None:
+                idx.add(part, new_row, ent)
+
+    def rebuild_index(self, space: str, index_name: str,
+                      parts: Optional[List[int]] = None) -> int:
+        """Clear + backfill one index from the base data. Returns entry
+        count (this process's parts)."""
+        sd = self.space(space)
+        descs = {d.name: d for d in self.catalog.indexes(space)}
+        d = descs.get(index_name)
+        if d is None:
+            raise StoreError(f"index `{index_name}' not found")
+        from .index import IndexData
+        idx = sd.index_data.get(index_name)
+        if idx is None or idx.fields != d.fields or \
+                idx.index_id != d.index_id:
+            idx = sd.index_data[index_name] = IndexData(
+                d.name, d.fields, d.is_edge, sd.num_parts, d.index_id)
+        with sd.lock:
+            part_ids = list(parts) if parts is not None \
+                else list(range(sd.num_parts))
+            for pid in part_ids:
+                idx.parts[pid].clear()
+                p = sd.parts[pid]
+                if d.is_edge:
+                    for src, per in p.out_edges.items():
+                        em = per.get(d.schema_name)
+                        if em:
+                            for (rank, dst), row in em.items():
+                                idx.add(pid, row, (src, rank, dst))
+                else:
+                    for vid, tv in p.vertices.items():
+                        if d.schema_name in tv:
+                            idx.add(pid, tv[d.schema_name][1], vid)
+            return sum(len(idx.parts[pid]) for pid in part_ids)
+
+    def index_scan(self, space: str, index_name: str, eq_prefix: List[Any],
+                   range_hint=None,
+                   parts: Optional[List[int]] = None) -> List[Any]:
+        """Entities (vids or (src, rank, dst)) matching the hints, in
+        index order per part."""
+        sd = self.space(space)
+        idx = sd.index_data.get(index_name)
+        d = next((x for x in self.catalog.indexes(space)
+                  if x.name == index_name), None)
+        if idx is None or d is None or idx.fields != d.fields or \
+                idx.index_id != d.index_id:
+            return []               # dropped/recreated → stale data is dead
+        part_ids = list(parts) if parts is not None \
+            else list(range(sd.num_parts))
+        out: List[Any] = []
+        for pid in part_ids:
+            out.extend(idx.scan(pid, eq_prefix, range_hint))
+        return out
+
     # ---- mutate ----
     def insert_vertex(self, space: str, vid: Any, tag: str,
                       props: Dict[str, Any], insert_names: Optional[List[str]] = None):
@@ -145,7 +237,10 @@ class GraphStore:
         with sd.lock:
             p = sd.parts[sd.part_of(vid)]
             sd.dense_id(vid, create=True)
+            old = p.vertices.get(vid, {}).get(tag)
             p.vertices.setdefault(vid, {})[tag] = (sv.version, row)
+            self._index_vertex(sd, space, vid, tag,
+                               old[1] if old else None, row)
             sd.epoch += 1
 
     def insert_edge(self, space: str, src: Any, etype: str, dst: Any,
@@ -160,27 +255,38 @@ class GraphStore:
             sd.dense_id(dst, create=True)
             # out-edge on src part, in-edge on dst part (TOSS chain analog)
             po = sd.parts[sd.part_of(src)]
+            old = po.out_edges.get(src, {}).get(etype, {}).get((rank, dst))
             po.out_edges.setdefault(src, {}).setdefault(etype, {})[(rank, dst)] = row
             pi = sd.parts[sd.part_of(dst)]
             pi.in_edges.setdefault(dst, {}).setdefault(etype, {})[(rank, src)] = row
+            self._index_edge(sd, space, src, etype, dst, rank, old, row)
             sd.epoch += 1
 
     def delete_vertex(self, space: str, vid: Any, with_edges: bool = True):
         sd = self.space(space)
         with sd.lock:
             p = sd.parts[sd.part_of(vid)]
-            p.vertices.pop(vid, None)
+            tv = p.vertices.pop(vid, None)
+            if tv:
+                for t, (_, row) in tv.items():
+                    self._index_vertex(sd, space, vid, t, row, None)
             if with_edges:
                 out = p.out_edges.pop(vid, {})
                 for etype, em in out.items():
-                    for (rank, dst) in list(em):
+                    for (rank, dst), row in list(em.items()):
                         pd = sd.parts[sd.part_of(dst)]
                         pd.in_edges.get(dst, {}).get(etype, {}).pop((rank, vid), None)
+                        self._index_edge(sd, space, vid, etype, dst, rank,
+                                         row, None)
                 inn = p.in_edges.pop(vid, {})
                 for etype, em in inn.items():
                     for (rank, src) in list(em):
                         ps = sd.parts[sd.part_of(src)]
-                        ps.out_edges.get(src, {}).get(etype, {}).pop((rank, vid), None)
+                        row = ps.out_edges.get(src, {}).get(etype, {}) \
+                            .pop((rank, vid), None)
+                        if row is not None:
+                            self._index_edge(sd, space, src, etype, vid,
+                                             rank, row, None)
             sd.epoch += 1
 
     def delete_tag(self, space: str, vid: Any, tags: List[str]):
@@ -190,7 +296,9 @@ class GraphStore:
             tv = p.vertices.get(vid)
             if tv:
                 for t in tags:
-                    tv.pop(t, None)
+                    old = tv.pop(t, None)
+                    if old is not None:
+                        self._index_vertex(sd, space, vid, t, old[1], None)
                 if not tv:
                     p.vertices.pop(vid, None)
             sd.epoch += 1
@@ -199,9 +307,11 @@ class GraphStore:
         sd = self.space(space)
         with sd.lock:
             ps = sd.parts[sd.part_of(src)]
-            ps.out_edges.get(src, {}).get(etype, {}).pop((rank, dst), None)
+            old = ps.out_edges.get(src, {}).get(etype, {}).pop((rank, dst), None)
             pd = sd.parts[sd.part_of(dst)]
             pd.in_edges.get(dst, {}).get(etype, {}).pop((rank, src), None)
+            if old is not None:
+                self._index_edge(sd, space, src, etype, dst, rank, old, None)
             sd.epoch += 1
 
     def update_vertex(self, space: str, vid: Any, tag: str,
@@ -214,10 +324,12 @@ class GraphStore:
                 return False
             ver, row = tv
             sv = self.catalog.get_tag(space, tag).latest
-            for k, v in updates.items():
+            for k in updates:       # validate BEFORE mutating anything
                 if sv.prop(k) is None:
                     raise SchemaError(f"unknown prop `{k}'")
-                row[k] = v
+            old = dict(row)
+            row.update(updates)
+            self._index_vertex(sd, space, vid, tag, old, row)
             sd.epoch += 1
             return True
 
@@ -230,10 +342,12 @@ class GraphStore:
             if row is None:
                 return False
             sv = self.catalog.get_edge(space, etype).latest
-            for k, v in updates.items():
+            for k in updates:       # validate BEFORE mutating anything
                 if sv.prop(k) is None:
                     raise SchemaError(f"unknown prop `{k}'")
-                row[k] = v
+            old = dict(row)
+            row.update(updates)
+            self._index_edge(sd, space, src, etype, dst, rank, old, row)
             pd = sd.parts[sd.part_of(dst)]
             irow = pd.in_edges.get(dst, {}).get(etype, {}).get((rank, src))
             if irow is not None:
@@ -253,7 +367,10 @@ class GraphStore:
         with sd.lock:
             p = sd.parts[sd.part_of(vid)]
             sd.dense_id(vid, create=True)
+            old = p.vertices.get(vid, {}).get(tag)
             p.vertices.setdefault(vid, {})[tag] = (version, dict(row))
+            self._index_vertex(sd, space, vid, tag,
+                               old[1] if old else None, row)
             sd.epoch += 1
 
     def apply_edge_half(self, space: str, src: Any, etype: str, dst: Any,
@@ -263,8 +380,10 @@ class GraphStore:
             if which == "out":
                 sd.dense_id(src, create=True)
                 p = sd.parts[sd.part_of(src)]
+                old = p.out_edges.get(src, {}).get(etype, {}).get((rank, dst))
                 p.out_edges.setdefault(src, {}).setdefault(etype, {})[
                     (rank, dst)] = dict(row)
+                self._index_edge(sd, space, src, etype, dst, rank, old, row)
             else:
                 sd.dense_id(dst, create=True)
                 p = sd.parts[sd.part_of(dst)]
@@ -278,8 +397,16 @@ class GraphStore:
         sd = self.space(space)
         with sd.lock:
             p = sd.parts[sd.part_of(vid)]
-            p.vertices.pop(vid, None)
-            p.out_edges.pop(vid, None)
+            tv = p.vertices.pop(vid, None)
+            if tv:
+                for t, (_, row) in tv.items():
+                    self._index_vertex(sd, space, vid, t, row, None)
+            out = p.out_edges.pop(vid, None)
+            if out:
+                for etype, em in out.items():
+                    for (rank, dst), row in em.items():
+                        self._index_edge(sd, space, vid, etype, dst, rank,
+                                         row, None)
             p.in_edges.pop(vid, None)
             sd.epoch += 1
 
@@ -289,7 +416,11 @@ class GraphStore:
         with sd.lock:
             if which == "out":
                 p = sd.parts[sd.part_of(src)]
-                p.out_edges.get(src, {}).get(etype, {}).pop((rank, dst), None)
+                old = p.out_edges.get(src, {}).get(etype, {}) \
+                    .pop((rank, dst), None)
+                if old is not None:
+                    self._index_edge(sd, space, src, etype, dst, rank,
+                                     old, None)
             else:
                 p = sd.parts[sd.part_of(dst)]
                 p.in_edges.get(dst, {}).get(etype, {}).pop((rank, src), None)
@@ -302,7 +433,9 @@ class GraphStore:
             tv = sd.parts[sd.part_of(vid)].vertices.get(vid, {}).get(tag)
             if tv is None:
                 return False
+            old = dict(tv[1])
             tv[1].update(updates)
+            self._index_vertex(sd, space, vid, tag, old, tv[1])
             sd.epoch += 1
             return True
 
@@ -319,7 +452,10 @@ class GraphStore:
                     .get(etype, {}).get((rank, src))
             if row is None:
                 return False
+            old = dict(row)
             row.update(updates)
+            if which == "out":
+                self._index_edge(sd, space, src, etype, dst, rank, old, row)
             sd.epoch += 1
             return True
 

@@ -24,8 +24,9 @@ from typing import Any, Dict, List, Optional, Tuple
 from ..core.expr import (AggExpr, AttributeExpr, Binary, DictContext, Expr,
                          FunctionCall, InputProp, LabelExpr, LabelTagProp,
                          Literal, Unary, VarExpr, VarProp, EdgeProp,
-                         VertexExpr, EdgeExpr, has_aggregate, rewrite,
-                         split_conjuncts, to_text, walk)
+                         VertexExpr, EdgeExpr, has_aggregate,
+                         join_conjuncts, rewrite, split_conjuncts, to_text,
+                         walk)
 from ..graphstore.schema import SchemaError
 from . import ast as A
 from .plan import ExecutionPlan, PlanNode
@@ -505,6 +506,107 @@ def _plan_fetch_edges(pctx, s: A.FetchEdgesSentence) -> PlanNode:
     return out
 
 
+_REV_OP = {"<": ">", "<=": ">=", ">": "<", ">=": "<="}
+
+
+def _lookup_field_cond(c: Expr, schema: str, is_edge: bool):
+    """Conjunct of shape <schema>.<field> OP <const> (either order) →
+    (field, op, value); else None."""
+    if not isinstance(c, Binary) or c.op not in ("==", "<", "<=", ">", ">="):
+        return None
+
+    def field_of(x):
+        if is_edge and isinstance(x, EdgeProp) and x.edge == schema \
+                and not x.name.startswith("_"):
+            return x.name
+        if not is_edge and isinstance(x, AttributeExpr) \
+                and isinstance(x.obj, LabelExpr) and x.obj.name == schema:
+            return x.attr
+        return None
+
+    for lhs, rhs, op in ((c.lhs, c.rhs, c.op),
+                         (c.rhs, c.lhs, _REV_OP.get(c.op, c.op))):
+        f = field_of(lhs)
+        if f is None:
+            continue
+        try:
+            v = _const_eval(rhs)
+        except Exception:  # noqa: BLE001 — non-constant operand
+            return None
+        from ..core.value import is_null
+        if is_null(v) and not isinstance(v, bool):
+            return None
+        return (f, op, v)
+    return None
+
+
+def _choose_index(pctx, space: str, schema: str, is_edge: bool,
+                  filt: Optional[Expr]):
+    """Pick the best index + column hints for a LOOKUP predicate.
+
+    Reference analog: the optimizer's predicate→IndexColumnHint
+    extraction (OptimizerUtils; SURVEY §2 rows 15/22).  Returns
+    (index_name, eq_values, range_hint, residual_filter).
+    """
+    from ..graphstore.index import MAX, MIN
+    indexes = pctx.catalog.indexes_for(space, schema, is_edge)
+    if not indexes:
+        kind = "edge" if is_edge else "tag"
+        raise QueryError(
+            f"no valid index found on {kind} `{schema}' "
+            f"(LOOKUP requires one; CREATE {kind.upper()} INDEX first)")
+    if filt is None:
+        return indexes[0].name, [], None, None
+    conjs = split_conjuncts(filt)
+    conds: Dict[str, list] = {}
+    for i, c in enumerate(conjs):
+        m = _lookup_field_cond(c, schema, is_edge)
+        if m is not None:
+            conds.setdefault(m[0], []).append((m[1], m[2], i))
+    best = None
+    for d in indexes:
+        used: set = set()
+        eq = []
+        for f in d.fields:
+            hit = next(((v, i) for (op, v, i) in conds.get(f, [])
+                        if op == "=="), None)
+            if hit is None:
+                break
+            eq.append(hit[0])
+            used.add(hit[1])
+        rng = None
+        if len(eq) < len(d.fields):
+            from ..graphstore.index import norm
+            nf = d.fields[len(eq)]
+            lo, hi, lo_inc, hi_inc = MIN, MAX, True, True
+            found = False
+            for (op, v, i) in conds.get(nf, []):
+                if op in (">", ">="):
+                    inc = op == ">="
+                    # keep the TIGHTEST lower bound (ties: exclusive wins)
+                    if isinstance(lo, type(MIN)) or norm(v) > norm(lo) or \
+                            (norm(v) == norm(lo) and not inc):
+                        lo, lo_inc = v, inc
+                    used.add(i)
+                    found = True
+                elif op in ("<", "<="):
+                    inc = op == "<="
+                    if isinstance(hi, type(MAX)) or norm(v) < norm(hi) or \
+                            (norm(v) == norm(hi) and not inc):
+                        hi, hi_inc = v, inc
+                    used.add(i)
+                    found = True
+            if found:
+                rng = (lo, hi, lo_inc, hi_inc)
+        score = (len(eq), 1 if rng else 0)
+        if best is None or score > best[0]:
+            best = (score, d.name, eq, rng, used)
+    _, name, eq, rng, used = best
+    residual = join_conjuncts(
+        [c for i, c in enumerate(conjs) if i not in used])
+    return name, eq, rng, residual
+
+
 def _plan_lookup(pctx, s: A.LookupSentence) -> PlanNode:
     space = pctx.need_space()
     cat = pctx.catalog
@@ -523,10 +625,13 @@ def _plan_lookup(pctx, s: A.LookupSentence) -> PlanNode:
         aliases = {s.schema_name: s.schema_name}
         filt = _rewrite_match_expr(s.where.filter, aliases)
         filt = _rewrite_go_expr(pctx, filt, [s.schema_name]) if is_edge else filt
+    index_name, eq, rng, residual = _choose_index(
+        pctx, space, s.schema_name, is_edge, filt)
     scan = PlanNode("IndexScan", deps=[],
                     col_names=["_matched"],
                     args={"space": space, "schema": s.schema_name,
-                          "is_edge": is_edge, "filter": filt})
+                          "is_edge": is_edge, "filter": residual,
+                          "index": index_name, "eq": eq, "range": rng})
     yld = s.yield_
     if yld is None:
         default = (FunctionCall("id", [VertexExpr("vertex")]) if not is_edge

@@ -28,6 +28,7 @@ def conn(cluster):
     run("USE cs")
     run("CREATE TAG Person(name string, age int)")
     run("CREATE EDGE KNOWS(w int)")
+    run("CREATE TAG INDEX i_person_age ON Person(age)")
     run('INSERT VERTEX Person(name, age) VALUES '
         '1:("ann",30), 2:("bob",25), 3:("cid",41), 4:("dee",19)')
     run("INSERT EDGE KNOWS(w) VALUES 1->2:(5), 2->3:(50), 3->4:(9), "

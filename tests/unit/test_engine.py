@@ -21,6 +21,8 @@ def eng():
     run('CREATE TAG city(pop int64)')
     run('CREATE EDGE knows(since int64, weight double)')
     run('CREATE EDGE likes(level int64)')
+    run('CREATE TAG INDEX i_person_age ON person(age)')
+    run('CREATE EDGE INDEX i_knows_since ON knows(since)')
     run('INSERT VERTEX person(name, age) VALUES '
         '"a":("Ann",30), "b":("Bob",25), "c":("Cat",41), "d":("Dan",19), "e":("Eve",33)')
     run('INSERT EDGE knows(since, weight) VALUES '

@@ -18,7 +18,8 @@ def eng():
     s = e.new_session()
     for q in ['CREATE SPACE t (partition_num=2)', 'USE t',
               'CREATE TAG person(name string, age int64)',
-              'CREATE EDGE knows(since int64)']:
+              'CREATE EDGE knows(since int64)',
+              'CREATE TAG INDEX i_age ON person(age)']:
         r = e.execute(s, q)
         assert r.ok, r.error
     e._sess = s
