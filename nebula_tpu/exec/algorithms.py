@@ -60,6 +60,33 @@ def _neighbors(qctx: QueryContext, space: str, vid: Any, etypes: List[str],
         yield e, other
 
 
+def make_vertex_fn(qctx: QueryContext, space: str, with_prop: bool):
+    """Path-endpoint vertex builder — SHARED with the device path
+    (tpu/paths.py) so host/device rows stay byte-identical."""
+    def mk_vertex(vid):
+        if with_prop:
+            v = qctx.build_vertex(space, vid)
+            return v if v is not None else Vertex(vid)
+        return Vertex(vid)
+    return mk_vertex
+
+
+def make_path_fn(mk_vertex):
+    def path_of(vchain: List[Any], echain: List[Edge]) -> Path:
+        p = Path(mk_vertex(vchain[0]))
+        for v, e in zip(vchain[1:], echain):
+            p.steps.append(Step(mk_vertex(v), e.name, e.ranking, e.props,
+                                e.etype))
+        return p
+    return path_of
+
+
+def sort_path_rows(rows: List[List[Any]]):
+    """Canonical FIND PATH result order (row-parity contract)."""
+    rows.sort(key=lambda r: (r[0].length(),
+                             [str(v.vid) for v in r[0].nodes()]))
+
+
 def find_path_host(node, qctx: QueryContext, ectx: ExecutionContext) -> DataSet:
     a = node.args
     space = a["space"]
@@ -78,18 +105,8 @@ def find_path_host(node, qctx: QueryContext, ectx: ExecutionContext) -> DataSet:
 
     col = node.col_names[0]
     rows: List[List[Any]] = []
-
-    def mk_vertex(vid):
-        if a.get("with_prop"):
-            v = qctx.build_vertex(space, vid)
-            return v if v is not None else Vertex(vid)
-        return Vertex(vid)
-
-    def path_of(vid_chain: List[Any], edge_chain: List[Edge]) -> Path:
-        p = Path(mk_vertex(vid_chain[0]))
-        for v, e in zip(vid_chain[1:], edge_chain):
-            p.steps.append(Step(mk_vertex(v), e.name, e.ranking, e.props, e.etype))
-        return p
+    mk_vertex = make_vertex_fn(qctx, space, bool(a.get("with_prop")))
+    path_of = make_path_fn(mk_vertex)
 
     if kind == "shortest":
         # level-synchronous BFS per source with multi-parent tracking —
@@ -158,8 +175,7 @@ def find_path_host(node, qctx: QueryContext, ectx: ExecutionContext) -> DataSet:
                     if hashable_key(w) in dst_set:
                         rows.append([path_of(nvc, nec)])
                     stack.append((w, nvc, nec, eseen | {ek}))
-    rows.sort(key=lambda r: (r[0].length(),
-                             [str(v.vid) for v in r[0].nodes()]))
+    sort_path_rows(rows)
     return DataSet([col], rows)
 
 

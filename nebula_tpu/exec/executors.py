@@ -746,6 +746,19 @@ def _resolve_vid_list(a, key_vids, key_ref, ectx) -> List[Any]:
 @executor("FindPath")
 def _find_path(node, qctx, ectx, space):
     from .algorithms import find_path_host
+    rt = getattr(qctx, "tpu_runtime", None)
+    a = node.args
+    if rt is not None and a["kind"] == "shortest" \
+            and a.get("filter") is None:
+        from ..tpu.device import TpuUnavailable
+        from ..tpu.paths import find_shortest_device
+        from ..tpu.traverse import _JAX_RT_ERRORS
+        try:
+            return find_shortest_device(node, qctx, ectx)
+        except (TpuUnavailable,) + _JAX_RT_ERRORS as ex:
+            # device can't serve this space/config; host has identical
+            # semantics — record the cause rather than swallow it
+            qctx.last_tpu_fallback = f"{type(ex).__name__}: {ex}"
     return find_path_host(node, qctx, ectx)
 
 

@@ -1,0 +1,139 @@
+"""Device BFS — the FIND SHORTEST PATH kernel.
+
+Level-synchronous BFS over the sharded CSR: each chip expands its shard
+of the frontier, routes candidates to their owning chips
+(`lax.all_to_all` over ICI), and keeps only first-visits recorded in a
+per-chip dist array (the visited bitmap of SURVEY §5, sharded by vid
+ownership).  The kernel returns the dist array; the host reconstructs
+ALL shortest paths by walking predecessors (dist[u] == dist[v]-1)
+backwards — identical path sets to the host oracle's multi-parent BFS
+(exec/algorithms.py), which is the parity contract.
+
+Reference analog: BFSShortestPathExecutor's per-hop storage fan-out +
+host hash-set frontiers (src/graph/executor/algo [UNVERIFIED — empty
+mount, SURVEY §0]), replaced by on-device expansion.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec
+
+from .hop import MAXI, _expand_block, _merge_frontier, _route, _sorted_unique
+
+
+def _visit_new(dist, fr, level: int, P: int):
+    """Mark frontier vertices (dense ids, -1 pad) with `level` where
+    unvisited; return (dist, filtered frontier of first-visits)."""
+    valid = fr >= 0
+    loc = jnp.where(valid, fr // P, 0)
+    seen = dist[loc] >= 0
+    first = valid & ~seen
+    dist = dist.at[jnp.where(first, loc, dist.shape[0])].set(
+        level, mode="drop")
+    nf = jnp.where(first, fr, -1)
+    # compact: sort pushes -1-as-MAXI to the tail
+    key = jnp.where(nf >= 0, nf, MAXI)
+    nf = jnp.sort(key)
+    nf = jnp.where(nf != MAXI, nf, -1)
+    return dist, nf
+
+
+def build_bfs_fn(mesh, P: int, F: int, EB: int, max_steps: int,
+                 n_blocks: int, vmax: int):
+    """Sharded BFS program: (blocks_data, frontier) →
+    {dist (P, Vmax), ovf_* flags, hop_edges (P, steps)}."""
+
+    def kernel(blocks_data, frontier):
+        fr = frontier[0]
+        dist = jnp.full((vmax,), -1, jnp.int32)
+        ovf_e = jnp.zeros((), bool)
+        ovf_r = jnp.zeros((), bool)
+        ovf_f = jnp.zeros((), bool)
+        hop_edges = []
+
+        # level 0: sources are visited at distance 0
+        dist, fr = _visit_new(dist, fr, 0, P)
+
+        for level in range(1, max_steps + 1):
+            cands = []
+            edges = jnp.zeros((), jnp.int32)
+            for bi in range(n_blocks):
+                b = blocks_data[bi]
+                src, dst, rk, eidx, ve, total, ovf = _expand_block(
+                    b["indptr"][0], b["nbr"][0], b["rank"][0], fr, F, EB, P)
+                ovf_e = ovf_e | ovf
+                edges = edges + total
+                cands.append(jnp.where(ve, dst, -1))
+            hop_edges.append(edges)
+            cand = jnp.concatenate(cands) if len(cands) > 1 else cands[0]
+            u, _ = _sorted_unique(cand)
+            out, sendc, ovf = _route(u, P, F)
+            ovf_r = ovf_r | ovf
+            recv = jax.lax.all_to_all(out, "part", 0, 0, tiled=False)
+            recv = recv.reshape(P, F)
+            fr, fcount, ovf2 = _merge_frontier(recv, F)
+            ovf_f = ovf_f | ovf2
+            dist, fr = _visit_new(dist, fr, level, P)
+
+        return {"dist": dist[None], "hop_edges": jnp.stack(hop_edges)[None],
+                "ovf_expand": ovf_e[None], "ovf_route": ovf_r[None],
+                "ovf_frontier": ovf_f[None]}
+
+    spec = PartitionSpec("part")
+    smapped = jax.shard_map(kernel, mesh=mesh,
+                            in_specs=(spec, spec), out_specs=spec)
+    return jax.jit(smapped)
+
+
+def build_bfs_fn_local(P: int, F: int, EB: int, max_steps: int,
+                       n_blocks: int, vmax: int):
+    """Single-chip variant (vmap over parts, transpose as all_to_all)."""
+
+    def fn(blocks_data, frontier):
+        fr = frontier                  # (P, F)
+        dist = jnp.full((P, vmax), -1, jnp.int32)
+        ovf_e = jnp.zeros((P,), bool)
+        ovf_r = jnp.zeros((P,), bool)
+        ovf_f = jnp.zeros((P,), bool)
+        hop_edges = []
+
+        dist, fr = jax.vmap(
+            lambda d, f: _visit_new(d, f, 0, P))(dist, fr)
+
+        for level in range(1, max_steps + 1):
+            cands = []
+            edges = jnp.zeros((P,), jnp.int32)
+            for bi in range(n_blocks):
+                b = blocks_data[bi]
+                src, dst, rk, eidx, ve, total, ovf = jax.vmap(
+                    lambda ip, nb, rkk, f: _expand_block(
+                        ip, nb, rkk, f, F, EB, P)
+                )(b["indptr"], b["nbr"], b["rank"], fr)
+                ovf_e = ovf_e | ovf
+                edges = edges + total
+                cands.append(jnp.where(ve, dst, -1))
+            hop_edges.append(edges)
+            cand = (jnp.concatenate(cands, axis=1)
+                    if len(cands) > 1 else cands[0])
+
+            def route_one(c):
+                u, _ = _sorted_unique(c)
+                return _route(u, P, F)
+            outs, sendc, ovr = jax.vmap(route_one)(cand)
+            ovf_r = ovf_r | ovr
+            recv = outs.transpose(1, 0, 2)
+            fr, fcount, ovr2 = jax.vmap(
+                lambda r: _merge_frontier(r, F))(recv)
+            ovf_f = ovf_f | ovr2
+            dist, fr = jax.vmap(
+                lambda d, f, lv=level: _visit_new(d, f, lv, P))(dist, fr)
+
+        return {"dist": dist, "hop_edges": jnp.stack(hop_edges, axis=1),
+                "ovf_expand": ovf_e, "ovf_route": ovf_r,
+                "ovf_frontier": ovf_f}
+
+    return jax.jit(fn)

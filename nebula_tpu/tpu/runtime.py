@@ -115,6 +115,59 @@ class TpuRuntime:
                 keys.append((et, "in"))
         return keys
 
+    def _escalate(self, dev: DeviceSnapshot, dense: Sequence[int],
+                  key_fn, build_fn, inputs_fn, stats: "TraverseStats"):
+        """Shared power-of-two bucket escalation driver for all device
+        programs (traverse, bfs): initial frontier layout, jit cache,
+        one batched fetch, overflow-driven retry (SURVEY §7 hard-part #1).
+
+        key_fn(F, EB) → jit-cache key; build_fn(F, EB) → jitted program
+        fn(*inputs, frontier); inputs_fn(F, EB) → tuple of extra inputs.
+        """
+        P = dev.num_parts
+        cnt = [0] * P
+        for d in set(dense):
+            cnt[d % P] += 1
+        F = max(self.init_f, _pow2(max(cnt)))
+        EB = self.init_eb
+        if self.local_mode:
+            target = self.mesh.devices.reshape(-1)[0]
+        else:
+            target = NamedSharding(self.mesh, PartitionSpec("part"))
+
+        for attempt in range(self.max_retries):
+            stats.retries = attempt
+            fr_np = self._initial_frontier(dev, dense, F)
+            if fr_np is None:
+                F *= 2
+                continue
+            key = key_fn(F, EB)
+            fn = self._fns.get(key)
+            if fn is None:
+                fn = self._fns[key] = build_fn(F, EB)
+            frontier = jax.device_put(fr_np, target)
+            t0 = time.perf_counter()
+            res = fn(*inputs_fn(F, EB), frontier)
+            jax.block_until_ready(res)
+            stats.device_s = time.perf_counter() - t0
+            # one batched transfer (the axon tunnel charges ~15ms per
+            # fetch RPC; per-leaf np.asarray would pay it repeatedly)
+            res = jax.device_get(res)
+
+            esc = False
+            if res["ovf_expand"].any():
+                EB = min(EB * 2, self.max_cap)
+                esc = True
+            if res["ovf_route"].any() or res["ovf_frontier"].any():
+                F = min(F * 2, self.max_cap)
+                esc = True
+            if not esc:
+                stats.f_cap, stats.e_cap = F, EB
+                stats.hop_edges = [int(x)
+                                   for x in res["hop_edges"].sum(axis=0)]
+                return res
+        raise TpuUnavailable("bucket escalation did not converge")
+
     def traverse(self, store: GraphStore, space: str, vids: Sequence[Any],
                  etypes: Sequence[str], direction: str, steps: int,
                  edge_filter: Optional[E.Expr] = None,
@@ -148,74 +201,81 @@ class TpuRuntime:
             return [], stats
 
         P = dev.num_parts
-        cnt = [0] * P
-        for d in set(dense):
-            cnt[d % P] += 1
-        per_part_max = max(cnt)
+        blocks_data = tuple(
+            {"indptr": dev.blocks[bk].indptr, "nbr": dev.blocks[bk].nbr,
+             "rank": dev.blocks[bk].rank,
+             "props": {n: dev.blocks[bk].props[n] for n in pred_cols
+                       if n != "_rank"}}
+            for bk in block_keys)
 
-        F = max(self.init_f, _pow2(per_part_max))
-        EB = self.init_eb
-        if self.local_mode:
-            target = self.mesh.devices.reshape(-1)[0]
-        else:
-            target = NamedSharding(self.mesh, PartitionSpec("part"))
+        def build(F, EB):
+            if self.local_mode:
+                return build_traverse_fn_local(
+                    P, F, EB, steps, len(block_keys), pred=pred,
+                    pred_cols=pred_cols, capture=capture)
+            return build_traverse_fn(
+                self.mesh, P, F, EB, steps, len(block_keys),
+                pred=pred, pred_cols=pred_cols, capture=capture)
 
-        for attempt in range(self.max_retries):
-            stats.retries = attempt
-            fr_np = self._initial_frontier(dev, dense, F)
-            if fr_np is None:
-                F *= 2
-                continue
-            key = (space, dev.epoch, tuple(block_keys), steps, F, EB,
-                   pred_key, capture, tuple(pred_cols))
-            fn = self._fns.get(key)
-            if fn is None:
-                if self.local_mode:
-                    fn = build_traverse_fn_local(
-                        P, F, EB, steps, len(block_keys), pred=pred,
-                        pred_cols=pred_cols, capture=capture)
-                else:
-                    fn = build_traverse_fn(
-                        self.mesh, P, F, EB, steps, len(block_keys),
-                        pred=pred, pred_cols=pred_cols, capture=capture)
-                self._fns[key] = fn
-            blocks_data = []
-            for bk in block_keys:
-                b = dev.blocks[bk]
-                blocks_data.append({
-                    "indptr": b.indptr, "nbr": b.nbr, "rank": b.rank,
-                    "props": {n: b.props[n] for n in pred_cols
-                              if n != "_rank"},
-                })
-            frontier = jax.device_put(fr_np, target)
-            t0 = time.perf_counter()
-            res = fn(tuple(blocks_data), frontier)
-            jax.block_until_ready(res)
-            stats.device_s = time.perf_counter() - t0
-            # one batched transfer (the axon tunnel charges ~15ms per
-            # fetch RPC; per-leaf np.asarray would pay it 6+ times)
-            res = jax.device_get(res)
-
-            esc = False
-            if res["ovf_expand"].any():
-                EB = min(EB * 2, self.max_cap)
-                esc = True
-            if res["ovf_route"].any() or res["ovf_frontier"].any():
-                F = min(F * 2, self.max_cap)
-                esc = True
-            if not esc:
-                break
-        else:
-            raise TpuUnavailable("bucket escalation did not converge")
-
-        stats.f_cap, stats.e_cap = F, EB
-        stats.hop_edges = [int(x) for x in res["hop_edges"].sum(axis=0)]
+        res = self._escalate(
+            dev, dense,
+            key_fn=lambda F, EB: (space, dev.epoch, tuple(block_keys),
+                                  steps, F, EB, pred_key, capture,
+                                  tuple(pred_cols)),
+            build_fn=build,
+            inputs_fn=lambda F, EB: (blocks_data,),
+            stats=stats)
         if not capture:
             return [], stats
 
         rows = self._materialize(store, space, dev, block_keys, res["cap"])
         stats.result_edges = len(rows)
         return rows, stats
+
+    # -- BFS (FIND SHORTEST PATH device plane) ---------------------------
+
+    def bfs(self, store: GraphStore, space: str, srcs: Sequence[Any],
+            etypes: Sequence[str], direction: str, max_steps: int
+            ) -> Tuple[np.ndarray, "TraverseStats"]:
+        """Level-synchronous device BFS from `srcs`.
+
+        Returns (dist, stats): dist is (P, Vmax) int32 of BFS depths
+        (-1 unreached); the caller reconstructs paths on host (parity
+        with the host oracle's multi-parent BFS).
+        """
+        from .bfs import build_bfs_fn, build_bfs_fn_local
+        dev = self.pin(store, space)
+        sd = store.space(space)
+        stats = TraverseStats()
+        stats.steps = max_steps
+
+        block_keys = self._blocks_for(dev, etypes, direction)
+        dense = [sd.dense_id(v) for v in srcs]
+        dense = [d for d in dense if d >= 0]
+        if not dense:
+            return np.full((dev.num_parts, dev.vmax), -1, np.int32), stats
+
+        P = dev.num_parts
+        blocks_data = tuple(
+            {"indptr": dev.blocks[bk].indptr, "nbr": dev.blocks[bk].nbr,
+             "rank": dev.blocks[bk].rank}
+            for bk in block_keys)
+
+        def build(F, EB):
+            if self.local_mode:
+                return build_bfs_fn_local(P, F, EB, max_steps,
+                                          len(block_keys), dev.vmax)
+            return build_bfs_fn(self.mesh, P, F, EB, max_steps,
+                                len(block_keys), dev.vmax)
+
+        res = self._escalate(
+            dev, dense,
+            key_fn=lambda F, EB: (space, dev.epoch, "bfs",
+                                  tuple(block_keys), max_steps, F, EB),
+            build_fn=build,
+            inputs_fn=lambda F, EB: (blocks_data,),
+            stats=stats)
+        return res["dist"], stats
 
     # -- host materialization --------------------------------------------
 

@@ -193,6 +193,44 @@ def test_bucket_escalation(rt):
     assert stats.retries > 0
 
 
+@pytest.mark.parametrize("direction", ["", " REVERSELY", " BIDIRECT"])
+def test_find_shortest_path_device_parity(rt, direction):
+    """Device BFS + host reconstruction must yield the exact path rows of
+    the host multi-parent BFS, for every direction."""
+    st = random_store(11, n=80, avg_deg=4)
+    eng_tpu = QueryEngine(st, tpu_runtime=rt)
+    eng_cpu = QueryEngine(st)
+    pairs = [(1, 40), (3, 9), (17, 2), (5, 77)]
+    for (a, b) in pairs:
+        q = (f"FIND SHORTEST PATH FROM {a} TO {b} OVER knows{direction} "
+             f"UPTO 5 STEPS YIELD path AS p")
+        got = {}
+        for eng in (eng_tpu, eng_cpu):
+            s = eng.new_session()
+            eng.execute(s, "USE g")
+            rs = eng.execute(s, q)
+            assert rs.error is None, (q, rs.error)
+            got[id(eng)] = sorted(map(repr, rs.data.rows))
+        assert got[id(eng_tpu)] == got[id(eng_cpu)], q
+    # the device plane actually served — no silent host fallback
+    assert getattr(eng_tpu.qctx, "last_tpu_fallback", None) is None
+
+
+def test_find_shortest_multi_src_dst_device_parity(rt):
+    st = random_store(12, n=60, avg_deg=4)
+    q = ("FIND SHORTEST PATH FROM 1, 2, 3 TO 30, 31 OVER knows "
+         "UPTO 4 STEPS YIELD path AS p")
+    res = {}
+    for tpu_on in (True, False):
+        eng = QueryEngine(st, tpu_runtime=rt if tpu_on else None)
+        s = eng.new_session()
+        eng.execute(s, "USE g")
+        rs = eng.execute(s, q)
+        assert rs.error is None, rs.error
+        res[tpu_on] = sorted(map(repr, rs.data.rows))
+    assert res[True] == res[False]
+
+
 def test_engine_fusion_end_to_end(rt):
     """Same query, optimizer TPU rule ON vs OFF → identical row multisets,
     and the fused plan actually contains TpuTraverse."""
