@@ -248,58 +248,58 @@ def build_snapshot(store: GraphStore, space: str,
 def _build_block(sd: SpaceData, etype: str, direction: str,
                  sv: SchemaVersion, pool: StringPool, vmax: int,
                  want_props: Optional[List[str]]) -> CsrBlock:
+    """COO collection (one pass over the plane dicts) + the native
+    COO→padded-CSR kernel (nebula_tpu.native; NumPy fallback inside) —
+    sort order (local, rank, dst per _nbr_key) matches get_neighbors."""
+    from ..native.kernels import build_coo_csr, dst_sort_key
     P = sd.num_parts
     plane_attr = "out_edges" if direction == "out" else "in_edges"
     prop_defs = [p for p in sv.props
                  if want_props is None or p.name in want_props]
 
-    per_part_rows: List[List[Tuple[int, int, Dict[str, Any]]]] = []
-    per_part_indptr: List[np.ndarray] = []
-    emax = 1
+    src_dense: List[int] = []
+    dst_dense: List[int] = []
+    ranks: List[int] = []
+    dst_vids: List[Any] = []
+    rows: List[Dict[str, Any]] = []
     for p in range(P):
-        part = sd.parts[p]
-        plane = getattr(part, plane_attr)
-        indptr = np.zeros(vmax + 1, np.int32)
-        rows: List[Tuple[int, int, Dict[str, Any]]] = []
-        for li in range(sd.part_counts[p]):
-            vid = sd.dense_to_vid[li * P + p]
-            em = plane.get(vid, {}).get(etype)
-            if em:
-                for (rank, other) in sorted(em, key=_nbr_key):
-                    od = sd.vid_to_dense.get(other, -1)
-                    rows.append((od, rank, em[(rank, other)]))
-            indptr[li + 1] = len(rows)
-        indptr[sd.part_counts[p] + 1:] = len(rows)
-        per_part_rows.append(rows)
-        per_part_indptr.append(indptr)
-        emax = max(emax, len(rows))
+        plane = getattr(sd.parts[p], plane_attr)
+        for vid, per in plane.items():
+            em = per.get(etype)
+            if not em:
+                continue
+            sdense = sd.vid_to_dense[vid]
+            for (rk, other), row in em.items():
+                src_dense.append(sdense)
+                dst_dense.append(sd.vid_to_dense.get(other, -1))
+                ranks.append(rk)
+                dst_vids.append(other)
+                rows.append(row)
 
-    nbr = np.full((P, emax), -1, np.int32)
-    rank = np.zeros((P, emax), np.int32)
+    indptr, nbr, rank, perm, emax = build_coo_csr(
+        np.asarray(src_dense, np.int64), np.asarray(dst_dense, np.int64),
+        np.asarray(ranks, np.int64), dst_sort_key(dst_vids), P, vmax)
+
     props: Dict[str, np.ndarray] = {}
     ptypes: Dict[str, PropType] = {}
+    valid = perm >= 0
+    safe_perm = np.where(valid, perm, 0)
     for pd in prop_defs:
         dt = _col_dtype(pd.ptype)
         fill = np.nan if dt == np.float64 else INT_NULL
-        props[pd.name] = np.full((P, emax), fill, dt)
+        if rows:
+            coo = np.fromiter(
+                (fill if (v := row.get(pd.name)) is None
+                 else encode_prop(pd.ptype, v, pool) for row in rows),
+                dtype=dt, count=len(rows))
+            col = np.where(valid, coo[safe_perm], fill).astype(dt)
+        else:
+            col = np.full((P, emax), fill, dt)
+        props[pd.name] = col
         ptypes[pd.name] = pd.ptype
 
-    for p in range(P):
-        rows = per_part_rows[p]
-        for i, (od, rk, row) in enumerate(rows):
-            nbr[p, i] = od
-            rank[p, i] = rk
-        for pd in prop_defs:
-            col = props[pd.name]
-            for i, (_, _, row) in enumerate(rows):
-                v = row.get(pd.name)
-                if v is None:
-                    continue
-                enc = encode_prop(pd.ptype, v, pool)
-                col[p, i] = enc
-
     return CsrBlock(etype=etype, direction=direction,
-                    indptr=np.stack(per_part_indptr), nbr=nbr, rank=rank,
+                    indptr=indptr, nbr=nbr, rank=rank,
                     props=props, prop_types=ptypes)
 
 

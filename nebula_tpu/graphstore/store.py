@@ -544,5 +544,11 @@ class GraphStore:
 
 
 def _nbr_key(k: Tuple[int, Any]):
+    """Neighbor iteration order within one (vid, etype): rank, then
+    neighbor — numerically for INT64 vid spaces, lexicographically for
+    string spaces.  get_neighbors and the CSR builder both use this key;
+    it IS the host/device row-order contract."""
     rank, other = k
-    return (rank, str(other))
+    if isinstance(other, int):
+        return (rank, 0, other, "")
+    return (rank, 1, 0, str(other))

@@ -1,0 +1,160 @@
+"""Native (C++) kernel tests: every entry point against its Python/NumPy
+fallback — the native path must be a pure speedup, never a semantic
+change."""
+import ctypes
+import random
+
+import numpy as np
+import pytest
+
+from nebula_tpu.native import available, get_lib
+from nebula_tpu.native.kernels import (build_coo_csr, csv_ingest,
+                                       dst_sort_key, fnv1a)
+
+pytestmark = pytest.mark.skipif(not available(),
+                                reason="native lib unavailable (no g++?)")
+
+
+def random_coo(seed, n=500, P=8, nverts=64):
+    rng = random.Random(seed)
+    src = np.asarray([rng.randrange(nverts) for _ in range(n)], np.int64)
+    dst = np.asarray([rng.randrange(nverts) for _ in range(n)], np.int64)
+    rank = np.asarray([rng.randrange(3) for _ in range(n)], np.int64)
+    vmax = (nverts + P - 1) // P
+    return src, dst, rank, vmax
+
+
+def numpy_reference(src, dst, rank, key, P, vmax):
+    """Force the fallback by simulating lib absence via direct call of
+    the fallback branch (build_coo_csr falls back only when the native
+    call fails, so re-implement the reference ordering here)."""
+    n = len(src)
+    part = src % P
+    local = src // P
+    order = np.lexsort((np.arange(n), key, rank, local, part))
+    counts = np.bincount(part, minlength=P)
+    emax = max(1, int(counts.max()))
+    indptr = np.zeros((P, vmax + 1), np.int32)
+    nbr = np.full((P, emax), -1, np.int32)
+    rk = np.zeros((P, emax), np.int32)
+    perm = np.full((P, emax), -1, np.int64)
+    pos = np.zeros(P, np.int64)
+    for k in order:
+        p = int(part[k])
+        s = int(pos[p])
+        pos[p] += 1
+        perm[p, s] = k
+        nbr[p, s] = dst[k]
+        rk[p, s] = rank[k]
+        indptr[p, local[k] + 1] += 1
+    np.cumsum(indptr, axis=1, out=indptr)
+    return indptr, nbr, rk, perm, emax
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_build_csr_matches_reference(seed):
+    src, dst, rank, vmax = random_coo(seed)
+    key = dst.copy()
+    got = build_coo_csr(src, dst, rank, key, 8, vmax)
+    want = numpy_reference(src, dst, rank, key, 8, vmax)
+    for g, w in zip(got, want):
+        np.testing.assert_array_equal(g, w)
+
+
+def test_build_csr_empty():
+    indptr, nbr, rk, perm, emax = build_coo_csr(
+        np.zeros(0, np.int64), np.zeros(0, np.int64),
+        np.zeros(0, np.int64), np.zeros(0, np.int64), 4, 5)
+    assert indptr.shape == (4, 6) and emax == 1
+
+
+def test_dst_sort_key_strings():
+    key = dst_sort_key(["bob", "ann", "bob", "cid"])
+    assert key.tolist() == [1, 0, 1, 2]
+
+
+def test_csv_ingest(tmp_path):
+    f = tmp_path / "edges.csv"
+    f.write_text("src,dst,w,city\n1,2,0.5,sf\n3,4,1.25,nyc\n5,6,-2.0,sf\n")
+    cols = csv_ingest(str(f), ["int", "int", "float", "strhash"])
+    assert cols is not None
+    assert cols[0].tolist() == [1, 3, 5]
+    assert cols[1].tolist() == [2, 4, 6]
+    assert cols[2].tolist() == [0.5, 1.25, -2.0]
+    assert cols[3][0] == cols[3][2] == fnv1a("sf")
+    assert cols[3][1] == fnv1a("nyc")
+
+
+def test_row_codec_roundtrip():
+    from nebula_tpu.native.kernels import decode_row, encode_row
+    props = [("int", 42), ("double", 2.5), ("bool", True),
+             ("str", "héllo; world"), ("null", None)]
+    blob = encode_row(7, props)
+    assert blob is not None and isinstance(blob, bytes)
+    ver, got = decode_row(blob)
+    assert ver == 7
+    assert got == props
+    # malformed input → clean None, not a crash
+    assert decode_row(b"\x01") is None
+    assert decode_row(blob[:-3]) is None
+
+
+def test_csv_ingest_rejects_truncation(tmp_path):
+    f = tmp_path / "big.csv"
+    f.write_text("a\n" + "\n".join(str(i) for i in range(100)) + "\n")
+    with pytest.raises(ValueError):
+        csv_ingest(str(f), ["int"], max_rows=10)
+
+
+def test_build_csr_rejects_out_of_range():
+    lib = get_lib()
+    # a dense id whose local index exceeds vmax must fail cleanly
+    src = np.asarray([0, 8 * 100], np.int64)   # local 100 >= vmax 5
+    dst = np.zeros(2, np.int64)
+    rank = np.zeros(2, np.int64)
+    indptr = np.zeros((8, 6), np.int32)
+    nbr = np.full((8, 2), -1, np.int32)
+    rk = np.zeros((8, 2), np.int32)
+    perm = np.full((8, 2), -1, np.int64)
+    import ctypes as C
+
+    def p(a):
+        return a.ctypes.data_as(C.c_void_p)
+    got = lib.build_csr(2, 8, 5, p(src), p(dst), p(rank), p(dst), p(perm),
+                        p(indptr), p(nbr), p(rk), 2)
+    assert got == -1
+
+
+def test_snapshot_uses_native_and_matches_host_order():
+    """End-to-end: CSR built through the native kernel must match
+    get_neighbors row order exactly (the parity contract)."""
+    from nebula_tpu.graphstore.csr import build_snapshot
+    from nebula_tpu.graphstore.schema import PropDef, PropType
+    from nebula_tpu.graphstore.store import GraphStore
+    rng = random.Random(3)
+    st = GraphStore()
+    st.create_space("n", partition_num=4, vid_type="INT64")
+    st.catalog.create_edge("n", "e", [PropDef("w", PropType.INT64)])
+    st.catalog.create_tag("n", "t", [])
+    for i in range(40):
+        st.insert_vertex("n", i, "t", {})
+    for _ in range(200):
+        st.insert_edge("n", rng.randrange(40), "e", rng.randrange(40),
+                       rng.randrange(3), {"w": rng.randrange(100)})
+    snap = build_snapshot(st, "n")
+    blk = snap.block("e", "out")
+    sd = st.space("n")
+    for vid in range(40):
+        d = sd.dense_id(vid)
+        if d < 0:
+            continue
+        p, li = d % 4, d // 4
+        lo, hi = int(blk.indptr[p, li]), int(blk.indptr[p, li + 1])
+        got = [(int(blk.rank[p, i]),
+                sd.dense_to_vid[int(blk.nbr[p, i])],
+                int(blk.props["w"][p, i]))
+               for i in range(lo, hi)]
+        want = [(rank, dst, props["w"])
+                for (_, _, rank, dst, props, _) in st.get_neighbors(
+                    "n", [vid], ["e"], "out")]
+        assert got == want, vid
