@@ -28,7 +28,24 @@ def main(argv=None):
     ap.add_argument("--data-dir", default="./data")
     ap.add_argument("--tpu", action="store_true",
                     help="graphd: enable the device execution plane")
+    ap.add_argument("--ws-port", type=int, default=-1,
+                    help="HTTP admin port (/status /stats /flags); "
+                         "-1 = rpc port + 1000, 0 = disabled")
+    ap.add_argument("--local-conf", default="",
+                    help="gflags-style key=value config file")
     args = ap.parse_args(argv)
+
+    from ..utils.config import get_config
+    if args.local_conf:
+        get_config().load_file(args.local_conf)
+    import logging
+    lvl = {0: logging.INFO, 1: logging.WARNING}.get(
+        int(get_config().get("minloglevel")), logging.ERROR)
+    if int(get_config().get("v")) > 0:
+        lvl = logging.DEBUG
+    logging.basicConfig(level=lvl,
+                        format="%(asctime)s %(levelname).1s %(name)s "
+                               "%(message)s")
 
     from .meta_client import MetaClient
     from .rpc import RpcServer, serve_raft_parts
@@ -62,7 +79,14 @@ def main(argv=None):
 
     server.start()
     svc.start()
-    print(f"nebula-tpu {args.role} serving on {server.addr}", flush=True)
+    web = None
+    if args.ws_port != 0:
+        from .webservice import WebService
+        ws_port = args.ws_port if args.ws_port > 0 else int(port) + 1000
+        web = WebService(role=args.role, host=host, port=ws_port)
+        web.start()
+    print(f"nebula-tpu {args.role} serving on {server.addr}"
+          + (f" (admin http on {web.addr})" if web else ""), flush=True)
 
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *a: stop.set())
@@ -71,6 +95,8 @@ def main(argv=None):
         time.sleep(0.5)
     svc.stop()
     server.stop()
+    if web is not None:
+        web.stop()
     return 0
 
 

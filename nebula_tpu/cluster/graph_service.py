@@ -19,7 +19,6 @@ from .dstore import DistributedStore
 from .meta_client import MetaClient
 from .rpc import RpcError, RpcServer
 
-IDLE_SESSION_REAP_S = 28800.0          # 8h, the reference's default
 
 
 class GraphService:
@@ -29,12 +28,16 @@ class GraphService:
         self.meta = meta
         self.store = DistributedStore(meta)
         self.engine = QueryEngine(self.store, tpu_runtime=tpu_runtime)
+        # SHOW HOSTS / SHOW SESSIONS read live cluster state through meta
+        self.engine.qctx.cluster = meta
         self.sessions: Dict[int, Session] = {}
         self.lock = threading.RLock()
         # password auth; default open root (the reference ships
         # enable_authorize=false with root/nebula)
+        from ..utils.config import get_config
         self.users = users if users is not None else {"root": "nebula"}
-        self.auth_required = users is not None
+        self.auth_required = users is not None or bool(
+            get_config().get("enable_authorize"))
         server.register_service(self, prefix="graph.")
         self._reaper = threading.Thread(target=self._reap_idle, daemon=True)
         self._reaper_stop = threading.Event()
@@ -48,11 +51,13 @@ class GraphService:
         self.meta.stop_heartbeat()
 
     def _reap_idle(self):
+        from ..utils.config import get_config
         while not self._reaper_stop.wait(5.0):
             now = time.time()
+            idle_s = float(get_config().get("session_idle_timeout_secs"))
             with self.lock:
                 dead = [sid for sid, s in self.sessions.items()
-                        if now - s.last_used > IDLE_SESSION_REAP_S]
+                        if now - s.last_used > idle_s]
             for sid in dead:
                 self._drop_session(sid)
 

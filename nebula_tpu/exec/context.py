@@ -8,6 +8,7 @@ protocol.
 """
 from __future__ import annotations
 
+import threading
 import time
 from typing import Any, Dict, List, Optional
 
@@ -23,8 +24,30 @@ class QueryContext:
     def __init__(self, store: GraphStore, params: Optional[Dict[str, Any]] = None):
         self.store = store
         self.params = params or {}
-        self.max_match_hops = int(self.params.get("max_match_hops", 12))
+        from ..utils.config import get_config
+        self.max_match_hops = int(self.params.get(
+            "max_match_hops", get_config().get("max_match_hops")))
         self.tpu_runtime = None     # set by nebula_tpu.tpu when pinned
+        # per-thread device-plane breadcrumbs: graphd serves concurrent
+        # sessions through ONE engine/qctx, so a shared slot would
+        # cross-attribute PROFILE stats between queries
+        self._tls = threading.local()
+
+    @property
+    def last_tpu_stats(self):
+        return getattr(self._tls, "tpu_stats", None)
+
+    @last_tpu_stats.setter
+    def last_tpu_stats(self, v):
+        self._tls.tpu_stats = v
+
+    @property
+    def last_tpu_fallback(self):
+        return getattr(self._tls, "tpu_fallback", None)
+
+    @last_tpu_fallback.setter
+    def last_tpu_fallback(self, v):
+        self._tls.tpu_fallback = v
 
     @property
     def catalog(self):

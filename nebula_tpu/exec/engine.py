@@ -48,20 +48,31 @@ class QueryEngine:
         self.qctx.tpu_runtime = tpu_runtime
         self.scheduler = Scheduler(self.qctx)
         self.enable_optimizer = enable_optimizer
-        self.slow_query_us = int((params or {}).get("slow_query_threshold_us",
-                                                    500_000))
+        self._slow_override = (params or {}).get("slow_query_threshold_us")
         self.slow_log: list = []
 
     def new_session(self, user: str = "root") -> Session:
         return Session(user)
 
+    @property
+    def slow_query_us(self) -> int:
+        """Live: UPDATE CONFIGS / PUT /flags must take effect on a
+        running engine."""
+        if self._slow_override is not None:
+            return int(self._slow_override)
+        from ..utils.config import get_config
+        return int(get_config().get("slow_query_threshold_us"))
+
     def execute(self, session: Session, text: str,
                 params: Optional[Dict[str, Any]] = None) -> ResultSet:
         t0 = time.perf_counter()
         session.last_used = time.time()
+        from ..utils.stats import stats
         try:
             stmt = parse(text)
         except ParseError as ex:
+            stats().inc("num_queries")
+            stats().inc("num_query_errors")
             return ResultSet(error=f"SyntaxError: {ex}")
         if isinstance(stmt, A.SeqSentence):
             # `a; b; c` executes sequentially — each statement plans only
@@ -79,6 +90,23 @@ class QueryEngine:
 
     def _execute_parsed(self, session: Session, stmt: A.Sentence,
                         text: str, t0: float) -> ResultSet:
+        """Metrics wrapper: every statement outcome (incl. semantic and
+        execution errors) is visible in /stats."""
+        from ..utils.stats import stats
+        res = self._execute_inner(session, stmt, text, t0)
+        us = int((time.perf_counter() - t0) * 1e6)
+        stats().inc("num_queries")
+        stats().add_value("query_latency_us", us)
+        if not res.ok:
+            stats().inc("num_query_errors")
+        elif us > self.slow_query_us:
+            stats().inc("num_slow_queries")
+            self.slow_log.append({"stmt": text, "latency_us": us,
+                                  "ts": time.time()})
+        return res
+
+    def _execute_inner(self, session: Session, stmt: A.Sentence,
+                       text: str, t0: float) -> ResultSet:
         profile_stats: Optional[ProfileStats] = None
         explain_only = False
         if isinstance(stmt, A.ExplainSentence):
@@ -97,8 +125,10 @@ class QueryEngine:
             root = _plan(pctx, inner)
             from ..query.plan import ExecutionPlan
             plan = ExecutionPlan(root, pctx.space)
+            from ..utils.config import get_config
             plan = optimize(plan, enable=self.enable_optimizer,
-                            tpu=self.qctx.tpu_runtime is not None)
+                            tpu=self.qctx.tpu_runtime is not None
+                            and bool(get_config().get("tpu_enable")))
         except QueryError as ex:
             return ResultSet(error=f"SemanticError: {ex}")
 
@@ -122,9 +152,6 @@ class QueryEngine:
         session.space = plan.space
         session.var_cols.update(pctx.var_cols)
         us = int((time.perf_counter() - t0) * 1e6)
-        if us > self.slow_query_us:
-            self.slow_log.append({"stmt": text, "latency_us": us,
-                                  "ts": time.time()})
         plan_desc = None
         if profile_stats is not None:
             plan_desc = profile_stats.describe(plan)

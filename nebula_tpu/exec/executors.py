@@ -1032,6 +1032,22 @@ def _show(node, qctx, ectx, space):
                         "Columns"],
                        [[d.name, d.schema_name, d.fields] for d in idx])
     if kind == "hosts":
+        cluster = getattr(qctx, "cluster", None)
+        if cluster is not None:
+            with cluster.lock:
+                pm = dict(cluster.part_map)
+            rows = []
+            for h in cluster.list_hosts():
+                host, port = h["addr"].rsplit(":", 1)
+                leaders = sum(1 for parts in pm.values()
+                              for reps in parts if reps[:1] == [h["addr"]])
+                dist = ", ".join(f"{sp}:{len(pids)}" for sp, pids in
+                                 sorted(h["parts"].items())) or "No valid partition"
+                rows.append([host, int(port),
+                             "ONLINE" if h["alive"] else "OFFLINE",
+                             leaders, dist])
+            return DataSet(["Host", "Port", "Status", "Leader count",
+                            "Partition distribution"], rows)
         return DataSet(["Host", "Port", "Status", "Leader count",
                         "Partition distribution"],
                        [["127.0.0.1", 0, "ONLINE", 0, "in-process"]])
@@ -1052,14 +1068,25 @@ def _show(node, qctx, ectx, space):
                        [["Space", "vertices", st["vertices"]],
                         ["Space", "edges", st["edges"]]])
     if kind == "sessions":
+        cluster = getattr(qctx, "cluster", None)
+        if cluster is not None:
+            return DataSet(
+                ["SessionId", "UserName", "SpaceName", "GraphAddr"],
+                [[s["sid"], s["user"], s.get("space"), s["graphd"]]
+                 for s in cluster.list_sessions()])
         return DataSet(["SessionId", "SpaceName"], [])
     if kind == "snapshots":
-        return DataSet(["Name", "Status"], [])
+        from .jobs import list_snapshots
+        return list_snapshots()
     if kind == "queries":
         return DataSet(["SessionId", "Query", "Status"], [])
     if kind == "configs":
-        return DataSet(["Name", "Value"],
-                       [[k, str(v)] for k, v in sorted(qctx.params.items())])
+        from ..utils.config import get_config
+        rows = [["graph", k, type(v).__name__, "MUTABLE", str(v)]
+                for k, v in sorted(get_config().all_values().items())]
+        rows += [["session", k, type(v).__name__, "MUTABLE", str(v)]
+                 for k, v in sorted(qctx.params.items())]
+        return DataSet(["Module", "Name", "Type", "Mode", "Value"], rows)
     if kind == "create":
         which, name = a["extra"]
         sp = a.get("space")
@@ -1082,6 +1109,19 @@ def _show(node, qctx, ectx, space):
         return DataSet([kw.title(), f"Create {kw.title()}"],
                        [[name, f"CREATE {kw} `{name}` (" + ", ".join(parts) + ")"]])
     raise ExecError(f"unsupported SHOW {kind}")
+
+
+@executor("UpdateConfigs")
+def _update_configs(node, qctx, ectx, space):
+    from ..core.expr import DictContext
+    from ..utils.config import ConfigError, get_config
+    a = node.args
+    value = a["value"].eval(DictContext())
+    try:
+        get_config().set_dynamic(a["name"], value)
+    except ConfigError as ex:
+        raise ExecError(str(ex)) from None
+    return DataSet()
 
 
 @executor("SubmitJob")

@@ -98,6 +98,11 @@ def create_snapshot(qctx) -> DataSet:
     return DataSet(["Name"], [[name]])
 
 
+def list_snapshots() -> DataSet:
+    return DataSet(["Name", "Status", "Hosts"],
+                   [[n, "VALID", "local"] for n in sorted(_snapshots)])
+
+
 def drop_snapshot(qctx, name: str) -> DataSet:
     _snapshots.pop(name, None)
     return DataSet()

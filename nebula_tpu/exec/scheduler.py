@@ -34,6 +34,8 @@ class ProfileStats:
             extra = ""
             if st:
                 extra = f"  [rows={st['rows']} time={st['exec_us']}us]"
+                if "tpu" in st:
+                    extra += f" tpu={st['tpu']}"
             lines.append("  " * depth + f"{n.kind}#{n.id}{extra}")
             for d in n.deps:
                 visit(d, depth + 1)
@@ -64,10 +66,22 @@ class Scheduler:
         topo(plan.root)
         for node in order:
             t0 = time.perf_counter()
+            if profile is not None:
+                self.qctx.last_tpu_stats = None
             ds = run_node(node, self.qctx, ectx, plan.space)
             us = int((time.perf_counter() - t0) * 1e6)
             ectx.set_result(node.output_var, ds)
             done[node.id] = ds
             if profile is not None:
                 profile.record(node, us, len(ds.rows) if ds is not None else 0)
+                ts = getattr(self.qctx, "last_tpu_stats", None)
+                if ts is not None:
+                    # device-plane profile fields (SURVEY §5 tracing):
+                    # per-hop expansion sizes + kernel time + buckets
+                    profile.per_node[node.id]["tpu"] = {
+                        "device_s": round(ts.device_s, 6),
+                        "hop_edges": ts.hop_edges,
+                        "buckets": {"F": ts.f_cap, "EB": ts.e_cap},
+                        "retries": ts.retries,
+                    }
         return done[plan.root.id]

@@ -288,6 +288,12 @@ class DeleteTagsSentence(Sentence):
 
 
 @dataclass
+class UpdateConfigsSentence(Sentence):
+    name: str
+    value: Expr
+
+
+@dataclass
 class UpdateSentence(Sentence):
     is_edge: bool
     schema_name: str

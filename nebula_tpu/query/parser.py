@@ -624,9 +624,17 @@ class Parser:
                 break
         return A.DeleteEdgesSentence(etype, keys)
 
-    def p_update(self) -> A.UpdateSentence:
+    def p_update(self) -> A.Sentence:
         kw = self.expect_kw("UPDATE", "UPSERT").value
         insertable = kw == "UPSERT"
+        if not insertable and self.accept_kw("CONFIGS"):
+            # UPDATE CONFIGS [module:]name = value (gflags live mutation)
+            name = self.ident()
+            if self.accept(":"):
+                name = self.ident()     # module prefix ignored (one proc)
+            self.expect("=")
+            value = self.parse_expr()
+            return A.UpdateConfigsSentence(name, value)
         is_edge = self.expect_kw("VERTEX", "EDGE").value == "EDGE"
         self.expect_kw("ON")
         schema = self.ident()

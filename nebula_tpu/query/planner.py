@@ -1175,6 +1175,8 @@ def _register_dispatch():
         A.DropSnapshotSentence: lambda p, s: _admin("DropSnapshot", name=s.name),
         A.KillQuerySentence: lambda p, s: _admin(
             "KillQuery", session_id=s.session_id, plan_id=s.plan_id),
+        A.UpdateConfigsSentence: lambda p, s: _admin(
+            "UpdateConfigs", name=s.name, value=s.value),
     })
 
 

@@ -63,8 +63,9 @@ class TpuRuntime:
         self.snapshots: Dict[str, DeviceSnapshot] = {}
         self._fns: Dict[Tuple, Any] = {}
         self.max_retries = 10
-        self.init_f = 256
-        self.init_eb = 2048
+        from ..utils.config import get_config
+        self.init_f = int(get_config().get("tpu_init_frontier"))
+        self.init_eb = int(get_config().get("tpu_init_edge_budget"))
         self.max_cap = 1 << 24          # escalation sanity bound
 
     # -- pinning ----------------------------------------------------------
@@ -78,6 +79,9 @@ class TpuRuntime:
         snap = build_snapshot(store, space)
         dev = pin_snapshot(snap, self.mesh)
         self.snapshots[space] = dev
+        from ..utils.stats import stats
+        stats().inc("tpu_pins")
+        stats().gauge("tpu_hbm_bytes_pinned", float(self.hbm_bytes()))
         # stale-epoch jitted fns are keyed by epoch; drop them
         self._fns = {k: v for k, v in self._fns.items()
                      if not (k[0] == space and k[1] != dev.epoch)}
@@ -165,6 +169,11 @@ class TpuRuntime:
                 stats.f_cap, stats.e_cap = F, EB
                 stats.hop_edges = [int(x)
                                    for x in res["hop_edges"].sum(axis=0)]
+                from ..utils.stats import stats as _metrics
+                _metrics().inc("tpu_kernel_runs")
+                _metrics().inc("tpu_edges_traversed",
+                               stats.edges_traversed())
+                _metrics().add_value("tpu_kernel_s", stats.device_s)
                 return res
         raise TpuUnavailable("bucket escalation did not converge")
 

@@ -1,0 +1,3 @@
+"""Cross-cutting utilities: metrics registry, typed config."""
+from .config import Config, define_flag, get_config  # noqa: F401
+from .stats import StatsManager, stats  # noqa: F401

@@ -1,0 +1,148 @@
+"""Typed config registry — the gflags analog.
+
+Reference behavior (gflags `DEFINE_*` + etc/*.conf + meta-managed config
++ live `/flags` mutation [UNVERIFIED — empty mount, SURVEY §0]) as one
+layered registry:
+
+    defaults  <  config file (`key=value` lines, `#` comments)
+              <  environment (NEBULA_<UPPER_NAME>)
+              <  dynamic (live /flags PUT, meta config push)
+
+Flags are declared near their use via define_flag(); lookups are
+`get_config().get("name")`.  Unknown names raise — typos surface
+immediately, like gflags.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Optional
+
+
+@dataclass
+class FlagDef:
+    name: str
+    default: Any
+    ftype: type
+    help: str = ""
+    mutable: bool = True          # may /flags or meta change it live?
+
+
+class ConfigError(Exception):
+    pass
+
+
+def _parse(ftype: type, raw: str) -> Any:
+    if ftype is bool:
+        if raw.lower() in ("1", "true", "yes", "on"):
+            return True
+        if raw.lower() in ("0", "false", "no", "off"):
+            return False
+        raise ConfigError(f"bad bool {raw!r}")
+    return ftype(raw)
+
+
+class Config:
+    def __init__(self):
+        self.defs: Dict[str, FlagDef] = {}
+        self.file_layer: Dict[str, Any] = {}
+        self.dynamic_layer: Dict[str, Any] = {}
+        self.lock = threading.RLock()
+        self.listeners: list = []      # fn(name, value) on dynamic change
+
+    def define(self, name: str, default: Any, help: str = "",
+               ftype: Optional[type] = None, mutable: bool = True):
+        with self.lock:
+            if name in self.defs:
+                return                 # idempotent re-import
+            self.defs[name] = FlagDef(name, default,
+                                      ftype or type(default), help, mutable)
+
+    def load_file(self, path: str):
+        """gflags-style `key=value` lines (also accepts `--key=value`)."""
+        with open(path) as f:
+            for ln in f:
+                ln = ln.strip()
+                if not ln or ln.startswith("#"):
+                    continue
+                if ln.startswith("--"):
+                    ln = ln[2:]
+                if "=" not in ln:
+                    raise ConfigError(f"bad config line: {ln!r}")
+                k, v = ln.split("=", 1)
+                k, v = k.strip(), v.strip()
+                d = self.defs.get(k)
+                if d is None:
+                    raise ConfigError(f"unknown flag `{k}' in {path}")
+                with self.lock:
+                    self.file_layer[k] = _parse(d.ftype, v)
+
+    def get(self, name: str) -> Any:
+        d = self.defs.get(name)
+        if d is None:
+            raise ConfigError(f"unknown flag `{name}'")
+        with self.lock:
+            if name in self.dynamic_layer:
+                return self.dynamic_layer[name]
+        env = os.environ.get("NEBULA_" + name.upper())
+        if env is not None:
+            return _parse(d.ftype, env)
+        with self.lock:
+            if name in self.file_layer:
+                return self.file_layer[name]
+        return d.default
+
+    def check(self, name: str, value: Any) -> Any:
+        """Validate name + coerce value WITHOUT applying (lets callers
+        make multi-key updates atomic)."""
+        d = self.defs.get(name)
+        if d is None:
+            raise ConfigError(f"unknown flag `{name}'")
+        if not d.mutable:
+            raise ConfigError(f"flag `{name}' is not mutable at runtime")
+        if isinstance(value, str) and d.ftype is not str:
+            value = _parse(d.ftype, value)
+        return value
+
+    def set_dynamic(self, name: str, value: Any):
+        value = self.check(name, value)
+        with self.lock:
+            self.dynamic_layer[name] = value
+            listeners = list(self.listeners)
+        for fn in listeners:
+            fn(name, value)
+
+    def all_values(self) -> Dict[str, Any]:
+        return {n: self.get(n) for n in sorted(self.defs)}
+
+
+_global = Config()
+
+
+def get_config() -> Config:
+    return _global
+
+
+def define_flag(name: str, default: Any, help: str = "",
+                mutable: bool = True):
+    _global.define(name, default, help, mutable=mutable)
+    return name
+
+
+# -- core flags (mirroring the reference's .conf.default tunables) ---------
+define_flag("slow_query_threshold_us", 500_000,
+            "queries slower than this land in the slow log")
+define_flag("heartbeat_interval_secs", 1.0,
+            "meta heartbeat period for graphd/storaged")
+define_flag("session_idle_timeout_secs", 28800,
+            "idle sessions are reaped after this")
+define_flag("max_match_hops", 12, "safety cap for unbounded MATCH *")
+define_flag("minloglevel", 0, "log severity threshold")
+define_flag("v", 0, "verbose log level")
+define_flag("enable_authorize", False, "require password auth in graphd")
+define_flag("tpu_enable", True, "allow the device execution plane")
+define_flag("tpu_init_frontier", 256,
+            "initial frontier bucket (power of two)")
+define_flag("tpu_init_edge_budget", 2048,
+            "initial per-block edge budget (power of two)")

@@ -1,0 +1,110 @@
+"""StatsManager — counters + rolling histograms.
+
+Analog of the reference's src/common/stats StatsManager [UNVERIFIED —
+empty mount, SURVEY §0]: named counters (`num_queries`), value series
+with rolling windows exposing sum/count/avg/rate and p50/p95/p99
+(`query_latency_us`), served by every daemon's `/stats` endpoint.  The
+TPU build adds device gauges (HBM bytes pinned, per-hop all_to_all
+volume, kernel step time) through the same registry.
+"""
+from __future__ import annotations
+
+import bisect
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+
+class _Series:
+    """A value series over a sliding window of seconds."""
+
+    __slots__ = ("window_s", "points", "total_sum", "total_count", "lock")
+
+    def __init__(self, window_s: float = 600.0):
+        self.window_s = window_s
+        self.points: List[Tuple[float, float]] = []   # (ts, value)
+        self.total_sum = 0.0
+        self.total_count = 0
+        self.lock = threading.Lock()
+
+    def add(self, v: float):
+        now = time.monotonic()
+        with self.lock:
+            self.points.append((now, v))
+            self.total_sum += v
+            self.total_count += 1
+            self._gc(now)
+
+    def _gc(self, now: float):
+        cutoff = now - self.window_s
+        i = bisect.bisect_left(self.points, (cutoff, float("-inf")))
+        if i > 0:
+            del self.points[:i]
+
+    def snapshot(self) -> Dict[str, Any]:
+        now = time.monotonic()
+        with self.lock:
+            self._gc(now)
+            vals = sorted(v for _, v in self.points)
+            n = len(vals)
+            out = {
+                "sum": self.total_sum,
+                "count": self.total_count,
+                "rate": n / self.window_s,
+            }
+            if n:
+                out["avg"] = sum(vals) / n
+                for q in (50, 95, 99):
+                    out[f"p{q}"] = vals[min(n - 1, int(n * q / 100))]
+            return out
+
+
+class StatsManager:
+    def __init__(self):
+        self.counters: Dict[str, int] = {}
+        self.gauges: Dict[str, float] = {}
+        self.series: Dict[str, _Series] = {}
+        self.lock = threading.Lock()
+
+    def inc(self, name: str, delta: int = 1):
+        with self.lock:
+            self.counters[name] = self.counters.get(name, 0) + delta
+
+    def gauge(self, name: str, value: float):
+        with self.lock:
+            self.gauges[name] = value
+
+    def add_value(self, name: str, value: float):
+        s = self.series.get(name)
+        if s is None:
+            with self.lock:
+                s = self.series.setdefault(name, _Series())
+        s.add(value)
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self.lock:
+            out: Dict[str, Any] = dict(self.counters)
+            out.update(self.gauges)
+            series = dict(self.series)
+        for name, s in series.items():
+            for k, v in s.snapshot().items():
+                out[f"{name}.{k}"] = v
+        return out
+
+    def to_text(self) -> str:
+        snap = self.snapshot()
+        return "\n".join(f"{k}={snap[k]}" for k in sorted(snap))
+
+    def reset(self):
+        with self.lock:
+            self.counters.clear()
+            self.gauges.clear()
+            self.series.clear()
+
+
+_global = StatsManager()
+
+
+def stats() -> StatsManager:
+    """The process-wide registry (each daemon serves it at /stats)."""
+    return _global
