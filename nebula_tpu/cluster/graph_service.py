@@ -34,10 +34,8 @@ class GraphService:
         self.lock = threading.RLock()
         # password auth; default open root (the reference ships
         # enable_authorize=false with root/nebula)
-        from ..utils.config import get_config
         self.users = users if users is not None else {"root": "nebula"}
-        self.auth_required = users is not None or bool(
-            get_config().get("enable_authorize"))
+        self._users_explicit = users is not None
         server.register_service(self, prefix="graph.")
         self._reaper = threading.Thread(target=self._reap_idle, daemon=True)
         self._reaper_stop = threading.Event()
@@ -70,6 +68,14 @@ class GraphService:
             pass
 
     # -- RPC --------------------------------------------------------------
+
+    @property
+    def auth_required(self) -> bool:
+        # live: UPDATE CONFIGS enable_authorize must take effect on a
+        # running graphd, not only after restart
+        from ..utils.config import get_config
+        return self._users_explicit or bool(
+            get_config().get("enable_authorize"))
 
     def rpc_authenticate(self, p):
         user = p.get("user", "root")

@@ -95,9 +95,24 @@ class StorageService:
                         gname, self.my_addr, list(replicas), self.transport,
                         os.path.join(self.data_dir, "wal"),
                         apply_cb=self._make_apply(space_name),
-                        snapshot_cb=None, restore_cb=None)
+                        # part state IS the raft snapshot: bounds WAL
+                        # replay on restart + serves laggard catch-up
+                        snapshot_cb=self._make_snapshot(space_name, pid),
+                        restore_cb=self._make_restore(space_name, pid),
+                        snapshot_threshold=2000)
                     self.parts[key] = part
                 part.start()
+
+    def _make_snapshot(self, space_name: str, pid: int):
+        def snap() -> bytes:
+            return self.store.export_part_state(space_name, pid)
+        return snap
+
+    def _make_restore(self, space_name: str, pid: int):
+        def restore(data: bytes):
+            if data:
+                self.store.install_part_state(space_name, pid, data)
+        return restore
 
     def _make_apply(self, space_name: str):
         def apply(idx: int, data: bytes):

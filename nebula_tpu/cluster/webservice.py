@@ -51,13 +51,13 @@ class WebService:
                         {"status": "running", "role": outer.role}),
                         "application/json")
                 elif u.path == "/stats":
-                    snap = stats().snapshot()
                     if as_json:
-                        self._send(200, json.dumps(snap, default=str),
+                        self._send(200,
+                                   json.dumps(stats().snapshot(),
+                                              default=str),
                                    "application/json")
                     else:
-                        self._send(200, "\n".join(
-                            f"{k}={snap[k]}" for k in sorted(snap)))
+                        self._send(200, stats().to_text())
                 elif u.path == "/flags":
                     vals = get_config().all_values()
                     if as_json:

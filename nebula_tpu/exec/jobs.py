@@ -52,7 +52,14 @@ class JobManager:
                 raise ValueError("stats job needs a space")
             return qctx.store.stats(space)
         if command == "compact":
-            return {"compacted": True}
+            # TTL GC — the reference's compaction-filter pass
+            removed = 0
+            spaces = [space] if space else sorted(
+                qctx.store.catalog.spaces)
+            for sp in spaces:
+                if hasattr(qctx.store, "compact"):
+                    removed += qctx.store.compact(sp)
+            return {"compacted": True, "expired_removed": removed}
         if command in ("balance data", "balance leader"):
             # meaningful in cluster mode; here: recompute part distribution
             if space:
@@ -93,9 +100,28 @@ def show_jobs(node, qctx) -> DataSet:
 
 
 def create_snapshot(qctx) -> DataSet:
+    """CREATE SNAPSHOT: a durable on-disk checkpoint of every space
+    (catalog + per-part state + manifest) under the snapshot_dir flag."""
+    import os
+
+    from ..utils.config import get_config
     name = f"SNAPSHOT_{int(time.time())}_{len(_snapshots)}"
+    base = get_config().get("snapshot_dir")
+    path = os.path.join(base, name)
+    if hasattr(qctx.store, "checkpoint"):
+        qctx.store.checkpoint(path)
     _snapshots[name] = time.time()
     return DataSet(["Name"], [[name]])
+
+
+def drop_snapshot_dir(name: str):
+    import os
+    import shutil
+
+    from ..utils.config import get_config
+    path = os.path.join(get_config().get("snapshot_dir"), name)
+    if os.path.isdir(path):
+        shutil.rmtree(path)
 
 
 def list_snapshots() -> DataSet:
@@ -105,4 +131,5 @@ def list_snapshots() -> DataSet:
 
 def drop_snapshot(qctx, name: str) -> DataSet:
     _snapshots.pop(name, None)
+    drop_snapshot_dir(name)
     return DataSet()

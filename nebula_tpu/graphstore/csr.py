@@ -257,6 +257,11 @@ def _build_block(sd: SpaceData, etype: str, direction: str,
     prop_defs = [p for p in sv.props
                  if want_props is None or p.name in want_props]
 
+    import time as _time
+
+    from .store import ttl_expired
+    now = _time.time()
+    has_ttl = bool(sv.ttl_col) and sv.ttl_duration > 0
     src_dense: List[int] = []
     dst_dense: List[int] = []
     ranks: List[int] = []
@@ -270,6 +275,8 @@ def _build_block(sd: SpaceData, etype: str, direction: str,
                 continue
             sdense = sd.vid_to_dense[vid]
             for (rk, other), row in em.items():
+                if has_ttl and ttl_expired(sv, row, now):
+                    continue        # device parity with host read filter
                 src_dense.append(sdense)
                 dst_dense.append(sd.vid_to_dense.get(other, -1))
                 ranks.append(rk)
@@ -318,12 +325,18 @@ def _build_tag_table(sd: SpaceData, tag: str, sv: SchemaVersion,
         props[pd.name] = np.full((P, vmax), fill, dt)
         ptypes[pd.name] = pd.ptype
 
+    import time as _time
+
+    from .store import ttl_expired
+    now = _time.time()
     for p in range(P):
         part = sd.parts[p]
         for li in range(sd.part_counts[p]):
             vid = sd.dense_to_vid[li * P + p]
             tv = part.vertices.get(vid)
             if not tv or tag not in tv:
+                continue
+            if ttl_expired(sv, tv[tag][1], now):
                 continue
             present[p, li] = True
             _, row = tv[tag]

@@ -31,6 +31,18 @@ from .schema import (Catalog, EdgeSchema, PropDef, SchemaError, SpaceDesc,
                      TagSchema, apply_defaults)
 
 
+def ttl_expired(sv, row: Dict[str, Any], now: float) -> bool:
+    """TTL check (the reference's compaction-filter + read-filter
+    semantics): a row whose ttl_col value + ttl_duration is in the past
+    is invisible; missing/null ttl values never expire."""
+    if not sv.ttl_col or sv.ttl_duration <= 0:
+        return False
+    v = row.get(sv.ttl_col)
+    if v is None or is_null(v) or not isinstance(v, (int, float)):
+        return False
+    return v + sv.ttl_duration < now
+
+
 def stable_vid_hash(vid: Any) -> int:
     """Process-independent hash used for partitioning (NOT Python hash())."""
     if isinstance(vid, int):
@@ -459,45 +471,167 @@ class GraphStore:
             sd.epoch += 1
             return True
 
+    # ---- part state snapshot (raft snapshot + checkpoint payload) ----
+
+    def export_part_state(self, space: str, pid: int) -> bytes:
+        """Serialize one partition's full state (raft snapshot_cb /
+        checkpoint file payload).  Includes the part's slice of the
+        dense-id dictionary so replay-free restore keeps device ids
+        stable."""
+        import pickle
+        sd = self.space(space)
+        with sd.lock:
+            p = sd.parts[pid]
+            return pickle.dumps({
+                "vertices": p.vertices,
+                "out_edges": p.out_edges,
+                "in_edges": p.in_edges,
+                "part_count": sd.part_counts[pid],
+                "dense": {v: d for v, d in sd.vid_to_dense.items()
+                          if d % sd.num_parts == pid},
+            })
+
+    def install_part_state(self, space: str, pid: int, data: bytes):
+        import pickle
+        st = pickle.loads(data)
+        sd = self.space(space)
+        with sd.lock:
+            p = sd.parts[pid]
+            p.vertices = st["vertices"]
+            p.out_edges = st["out_edges"]
+            p.in_edges = st["in_edges"]
+            sd.part_counts[pid] = st["part_count"]
+            for v, d in st["dense"].items():
+                sd.vid_to_dense[v] = d
+                need = d + 1 - len(sd.dense_to_vid)
+                if need > 0:
+                    sd.dense_to_vid.extend([None] * need)
+                sd.dense_to_vid[d] = v
+            sd.epoch += 1
+        # indexes are derived state: rebuild this part's slices
+        for d in self.catalog.indexes(space):
+            self.rebuild_index(space, d.name, parts=[pid])
+
+    # ---- checkpoint / restore (CREATE SNAPSHOT; SURVEY §5) ----
+
+    def checkpoint(self, dirpath: str,
+                   spaces: Optional[List[str]] = None) -> Dict[str, Any]:
+        """Durable on-disk checkpoint: catalog + every part's state +
+        manifest.  The reference hard-links RocksDB SSTs; here part
+        states are written as files — same contract (point-in-time,
+        restorable)."""
+        import json
+        import os
+        import pickle
+        os.makedirs(dirpath, exist_ok=True)
+        names = spaces if spaces is not None else sorted(self.catalog.spaces)
+        manifest: Dict[str, Any] = {"spaces": {}}
+        with open(os.path.join(dirpath, "catalog.bin"), "wb") as f:
+            f.write(pickle.dumps(self.catalog))
+        for name in names:
+            sd = self.space(name)
+            spdir = os.path.join(dirpath, f"space_{sd.desc.space_id}")
+            os.makedirs(spdir, exist_ok=True)
+            with sd.lock:
+                for pid in range(sd.num_parts):
+                    with open(os.path.join(spdir, f"part_{pid}.bin"),
+                              "wb") as f:
+                        f.write(self.export_part_state(name, pid))
+                manifest["spaces"][name] = {
+                    "space_id": sd.desc.space_id,
+                    "partition_num": sd.num_parts,
+                    "epoch": sd.epoch,
+                }
+        with open(os.path.join(dirpath, "manifest.json"), "w") as f:
+            json.dump(manifest, f, indent=1)
+        return manifest
+
+    @classmethod
+    def from_checkpoint(cls, dirpath: str) -> "GraphStore":
+        import json
+        import os
+        import pickle
+        with open(os.path.join(dirpath, "catalog.bin"), "rb") as f:
+            catalog = pickle.loads(f.read())
+        store = cls(catalog=catalog)
+        with open(os.path.join(dirpath, "manifest.json")) as f:
+            manifest = json.load(f)
+        for name, info in manifest["spaces"].items():
+            spdir = os.path.join(dirpath, f"space_{info['space_id']}")
+            for pid in range(info["partition_num"]):
+                with open(os.path.join(spdir, f"part_{pid}.bin"),
+                          "rb") as f:
+                    store.install_part_state(name, pid, f.read())
+        return store
+
     # ---- read: point / scan ----
     def get_vertex(self, space: str, vid: Any) -> Optional[Dict[str, Dict[str, Any]]]:
-        """vid → {tag: props} or None."""
+        """vid → {tag: props} or None (TTL-expired tags invisible)."""
+        import time as _t
         sd = self.space(space)
         tv = sd.parts[sd.part_of(vid)].vertices.get(vid)
         if tv is None:
             return None
-        return {t: dict(row) for t, (_, row) in tv.items()}
+        now = _t.time()
+        out = {}
+        for t, (_, row) in tv.items():
+            try:
+                sv = self.catalog.get_tag(space, t).latest
+            except SchemaError:
+                continue            # tag dropped: its rows are invisible
+            if not ttl_expired(sv, row, now):
+                out[t] = dict(row)
+        return out if out else None
 
     def get_edge(self, space: str, src: Any, etype: str, dst: Any,
                  rank: int = 0) -> Optional[Dict[str, Any]]:
+        import time as _t
         sd = self.space(space)
         row = sd.parts[sd.part_of(src)].out_edges.get(src, {}).get(etype, {}) \
             .get((rank, dst))
-        return dict(row) if row is not None else None
+        if row is None:
+            return None
+        sv = self.catalog.get_edge(space, etype).latest
+        if ttl_expired(sv, row, _t.time()):
+            return None
+        return dict(row)
 
     def scan_vertices(self, space: str, tag: Optional[str] = None,
                       parts: Optional[Iterable[int]] = None):
         """Yields (vid, tag, props)."""
+        import time as _t
         sd = self.space(space)
         part_ids = range(sd.num_parts) if parts is None else parts
+        svs = {t.name: t.latest for t in self.catalog.tags(space)}
+        now = _t.time()
         for pid in part_ids:
             for vid, tv in sd.parts[pid].vertices.items():
                 for t, (_, row) in tv.items():
-                    if tag is None or t == tag:
+                    if t not in svs:
+                        continue    # tag dropped: rows invisible
+                    if (tag is None or t == tag) and \
+                            not ttl_expired(svs[t], row, now):
                         yield vid, t, row
 
     def scan_edges(self, space: str, etype: Optional[str] = None,
                    parts: Optional[Iterable[int]] = None):
         """Yields (src, etype, rank, dst, props) from the out-plane."""
+        import time as _t
         sd = self.space(space)
         part_ids = range(sd.num_parts) if parts is None else parts
+        svs = {e.name: e.latest for e in self.catalog.edges(space)}
+        now = _t.time()
         for pid in part_ids:
             for src, per in sd.parts[pid].out_edges.items():
                 for et, em in per.items():
                     if etype is not None and et != etype:
                         continue
+                    sv = svs.get(et)
+                    if sv is None:
+                        continue    # edge type dropped: rows invisible
                     for (rank, dst), row in em.items():
-                        yield src, et, rank, dst, row
+                        if not ttl_expired(sv, row, now):
+                            yield src, et, rank, dst, row
 
     # ---- read: getNeighbors (the hot-path op, host oracle form) ----
     def get_neighbors(self, space: str, vids: List[Any],
@@ -510,10 +644,13 @@ class GraphStore:
         Row order is deterministic: input vid order, then etype name, then
         (rank, neighbor) — the CSR sort order (csr.py) matches this.
         """
+        import time as _t
         sd = self.space(space)
         etypes = edge_types
         if etypes is None:
             etypes = sorted(e.name for e in self.catalog.edges(space))
+        svs = {et: self.catalog.get_edge(space, et).latest for et in etypes}
+        now = _t.time()
         for vid in vids:
             p = sd.parts[sd.part_of(vid)]
             if direction in ("out", "both"):
@@ -521,15 +658,58 @@ class GraphStore:
                 for et in etypes:
                     em = per.get(et)
                     if em:
+                        sv = svs[et]
                         for (rank, dst) in sorted(em, key=_nbr_key):
-                            yield vid, et, rank, dst, em[(rank, dst)], 1
+                            row = em[(rank, dst)]
+                            if not ttl_expired(sv, row, now):
+                                yield vid, et, rank, dst, row, 1
             if direction in ("in", "both"):
                 per = p.in_edges.get(vid, {})
                 for et in etypes:
                     em = per.get(et)
                     if em:
+                        sv = svs[et]
                         for (rank, src) in sorted(em, key=_nbr_key):
-                            yield vid, et, rank, src, em[(rank, src)], -1
+                            row = em[(rank, src)]
+                            if not ttl_expired(sv, row, now):
+                                yield vid, et, rank, src, row, -1
+
+    def compact(self, space: str) -> int:
+        """Physically purge TTL-expired rows (the compaction-filter GC of
+        the reference).  Returns rows removed."""
+        import time as _t
+        now = _t.time()
+        removed = 0
+        # collect first (can't mutate while scanning)
+        dead_tags: List[Tuple[Any, str]] = []
+        sd = self.space(space)
+        for t in self.catalog.tags(space):
+            sv = t.latest
+            if not sv.ttl_col:
+                continue
+            for p in sd.parts:
+                for vid, tv in p.vertices.items():
+                    if t.name in tv and ttl_expired(sv, tv[t.name][1], now):
+                        dead_tags.append((vid, t.name))
+        dead_edges: List[Tuple[Any, str, Any, int]] = []
+        for e in self.catalog.edges(space):
+            sv = e.latest
+            if not sv.ttl_col:
+                continue
+            for p in sd.parts:
+                for src, per in p.out_edges.items():
+                    em = per.get(e.name)
+                    if em:
+                        for (rank, dst), row in em.items():
+                            if ttl_expired(sv, row, now):
+                                dead_edges.append((src, e.name, dst, rank))
+        for vid, tag in dead_tags:
+            self.delete_tag(space, vid, [tag])
+            removed += 1
+        for src, et, dst, rank in dead_edges:
+            self.delete_edge(space, src, et, dst, rank)
+            removed += 1
+        return removed
 
     def stats(self, space: str) -> Dict[str, Any]:
         sd = self.space(space)

@@ -95,14 +95,27 @@ class Config:
 
     def check(self, name: str, value: Any) -> Any:
         """Validate name + coerce value WITHOUT applying (lets callers
-        make multi-key updates atomic)."""
+        make multi-key updates atomic).  Wrong-typed values are rejected
+        — a poisoned flag would break every later reader."""
         d = self.defs.get(name)
         if d is None:
             raise ConfigError(f"unknown flag `{name}'")
         if not d.mutable:
             raise ConfigError(f"flag `{name}' is not mutable at runtime")
         if isinstance(value, str) and d.ftype is not str:
-            value = _parse(d.ftype, value)
+            return _parse(d.ftype, value)
+        if d.ftype is float and isinstance(value, int) \
+                and not isinstance(value, bool):
+            return float(value)
+        if d.ftype is bool and not isinstance(value, bool):
+            raise ConfigError(f"flag `{name}' expects bool, got "
+                              f"{type(value).__name__}")
+        if d.ftype is int and isinstance(value, bool):
+            raise ConfigError(f"flag `{name}' expects int, got bool")
+        if not isinstance(value, d.ftype):
+            raise ConfigError(f"flag `{name}' expects "
+                              f"{d.ftype.__name__}, got "
+                              f"{type(value).__name__}")
         return value
 
     def set_dynamic(self, name: str, value: Any):
@@ -146,3 +159,5 @@ define_flag("tpu_init_frontier", 256,
             "initial frontier bucket (power of two)")
 define_flag("tpu_init_edge_budget", 2048,
             "initial per-block edge budget (power of two)")
+define_flag("snapshot_dir", "./nebula_snapshots",
+            "where CREATE SNAPSHOT checkpoints land")

@@ -1,0 +1,179 @@
+"""Checkpoint/restore, TTL expiry (host + device parity + compaction),
+and storaged restart from raft snapshot + WAL."""
+import time
+
+import pytest
+
+from nebula_tpu.core.value import NULL
+from nebula_tpu.exec import QueryEngine
+from nebula_tpu.graphstore.schema import PropDef, PropType
+from nebula_tpu.graphstore.store import GraphStore
+
+
+def seeded_store():
+    st = GraphStore()
+    st.create_space("p", partition_num=4, vid_type="INT64")
+    st.catalog.create_tag("p", "t", [PropDef("a", PropType.INT64)])
+    st.catalog.create_edge("p", "e", [PropDef("w", PropType.INT64)])
+    st.catalog.create_index("p", "i_a", "t", ["a"], is_edge=False)
+    for i in range(20):
+        st.insert_vertex("p", i, "t", {"a": i})
+    for i in range(19):
+        st.insert_edge("p", i, "e", i + 1, 0, {"w": i * 10})
+    return st
+
+
+def test_checkpoint_restore_roundtrip(tmp_path):
+    st = seeded_store()
+    st.checkpoint(str(tmp_path / "cp"))
+    st2 = GraphStore.from_checkpoint(str(tmp_path / "cp"))
+    assert st2.stats("p")["vertices"] == 20
+    assert st2.stats("p")["edges"] == 19
+    assert st2.get_vertex("p", 7) == {"t": {"a": 7}}
+    assert st2.get_edge("p", 3, "e", 4) == {"w": 30}
+    # dense ids survive (device-plane stability)
+    sd1, sd2 = st.space("p"), st2.space("p")
+    for v in range(20):
+        assert sd1.dense_id(v) == sd2.dense_id(v)
+    # derived index state rebuilt
+    assert st2.index_scan("p", "i_a", [7]) == [7]
+    # neighbors identical
+    a = list(st.get_neighbors("p", list(range(20)), ["e"], "both"))
+    b = list(st2.get_neighbors("p", list(range(20)), ["e"], "both"))
+    assert a == b
+
+
+def test_checkpoint_via_statement(tmp_path):
+    from nebula_tpu.utils.config import get_config
+    get_config().set_dynamic("snapshot_dir", str(tmp_path / "snaps"))
+    try:
+        eng = QueryEngine(seeded_store())
+        s = eng.new_session()
+        eng.execute(s, "USE p")
+        r = eng.execute(s, "CREATE SNAPSHOT")
+        assert r.ok, r.error
+        name = r.data.rows[0][0]
+        assert (tmp_path / "snaps" / name / "manifest.json").exists()
+        r = eng.execute(s, "SHOW SNAPSHOTS")
+        assert any(row[0] == name for row in r.data.rows)
+        st2 = GraphStore.from_checkpoint(str(tmp_path / "snaps" / name))
+        assert st2.stats("p")["edges"] == 19
+        r = eng.execute(s, f"DROP SNAPSHOT {name}")
+        assert r.ok, r.error
+        assert not (tmp_path / "snaps" / name).exists()
+    finally:
+        get_config().dynamic_layer.pop("snapshot_dir", None)
+
+
+def ttl_store():
+    st = GraphStore()
+    st.create_space("tt", partition_num=2, vid_type="INT64")
+    st.catalog.create_tag("tt", "t", [PropDef("ts", PropType.INT64)],
+                          ttl_col="ts", ttl_duration=100)
+    st.catalog.create_edge("tt", "e", [PropDef("ts", PropType.INT64)],
+                           ttl_col="ts", ttl_duration=100)
+    now = int(time.time())
+    st.insert_vertex("tt", 1, "t", {"ts": now})           # fresh
+    st.insert_vertex("tt", 2, "t", {"ts": now - 1000})    # expired
+    st.insert_vertex("tt", 3, "t", {"ts": NULL})          # never expires
+    st.insert_edge("tt", 1, "e", 2, 0, {"ts": now})
+    st.insert_edge("tt", 1, "e", 3, 0, {"ts": now - 1000})
+    return st
+
+
+def test_ttl_read_filtering():
+    st = ttl_store()
+    assert st.get_vertex("tt", 1) is not None
+    assert st.get_vertex("tt", 2) is None          # expired → invisible
+    assert st.get_vertex("tt", 3) is not None      # null ttl col
+    nbrs = [(dst) for (_, _, _, dst, _, _) in
+            st.get_neighbors("tt", [1], ["e"], "out")]
+    assert nbrs == [2]
+    assert st.get_edge("tt", 1, "e", 3) is None
+    assert sorted(v for v, _, _ in st.scan_vertices("tt")) == [1, 3]
+
+
+def test_ttl_device_parity():
+    """The CSR snapshot must exclude expired rows like host reads do."""
+    from nebula_tpu.graphstore.csr import build_snapshot
+    st = ttl_store()
+    snap = build_snapshot(st, "tt")
+    blk = snap.block("e", "out")
+    assert blk.total_edges() == 1
+    tt = snap.tags["t"]
+    assert int(tt.present.sum()) == 2
+
+
+def test_ttl_compact_purges():
+    st = ttl_store()
+    removed = st.compact("tt")
+    assert removed == 2                            # 1 vertex tag + 1 edge
+    sd = st.space("tt")
+    raw_vertices = sum(len(p.vertices) for p in sd.parts)
+    assert raw_vertices == 2                       # vid 2 physically gone
+
+
+def test_compact_job_statement():
+    eng = QueryEngine(ttl_store())
+    s = eng.new_session()
+    eng.execute(s, "USE tt")
+    r = eng.execute(s, "SUBMIT JOB COMPACT")
+    assert r.ok, r.error
+    assert eng.execute(s, "FETCH PROP ON t 2 YIELD t.ts").data.rows == []
+
+
+def test_dropped_schema_rows_invisible_not_crashing():
+    st = GraphStore()
+    st.create_space("dx", partition_num=2, vid_type="INT64")
+    st.catalog.create_tag("dx", "t", [PropDef("a", PropType.INT64)])
+    st.catalog.create_tag("dx", "u", [PropDef("b", PropType.INT64)])
+    st.catalog.create_edge("dx", "e", [])
+    st.insert_vertex("dx", 1, "t", {"a": 1})
+    st.insert_vertex("dx", 1, "u", {"b": 2})
+    st.insert_edge("dx", 1, "e", 2, 0, {})
+    st.catalog.drop_tag("dx", "t")
+    st.catalog.drop_edge("dx", "e")
+    # remaining tag still readable; dropped tag/edge rows invisible
+    assert st.get_vertex("dx", 1) == {"u": {"b": 2}}
+    assert list(st.scan_vertices("dx")) == [(1, "u", {"b": 2})]
+    assert list(st.scan_edges("dx")) == []
+
+
+def test_config_rejects_wrong_typed_values():
+    from nebula_tpu.utils.config import ConfigError, get_config
+    with pytest.raises(ConfigError):
+        get_config().set_dynamic("slow_query_threshold_us", [1, 2])
+    with pytest.raises(ConfigError):
+        get_config().set_dynamic("enable_authorize", 3)
+
+
+def test_storaged_restart_restores_from_wal(tmp_path):
+    """Kill a storaged process-state; a fresh service over the same WAL
+    dir must recover the part data (snapshot + replay)."""
+    from nebula_tpu.cluster.launcher import LocalCluster
+    c = LocalCluster(n_meta=1, n_storage=1, n_graph=1,
+                     data_dir=str(tmp_path))
+    try:
+        cl = c.client()
+        assert cl.execute(
+            "CREATE SPACE rs(partition_num=2, vid_type=INT64)").error is None
+        c.reconcile_storage()
+        for q in ["USE rs", "CREATE TAG t(a int)",
+                  "INSERT VERTEX t(a) VALUES 1:(11), 2:(22), 3:(33)"]:
+            assert cl.execute(q).error is None
+        ss = c.storageds[0]
+        # simulate process death + restart: stop raft parts, wipe the
+        # in-memory store, recreate parts from the same WAL dirs
+        with ss.parts_lock:
+            for p in ss.parts.values():
+                p.stop()
+            ss.parts.clear()
+        from nebula_tpu.graphstore.store import GraphStore
+        ss.store = GraphStore(catalog=ss.meta.catalog)
+        ss.reconcile_parts()
+        time.sleep(1.0)                  # re-election + replay
+        rs = cl.execute("FETCH PROP ON t 2 YIELD t.a")
+        assert rs.error is None and rs.data.rows == [[22]], \
+            (rs.error, rs.data.rows)
+    finally:
+        c.stop()
