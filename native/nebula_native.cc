@@ -30,8 +30,9 @@ extern "C" {
 //       side resolves hashes to pool codes), 3 = skip.
 // Values land column-major into caller-allocated buffers (int64/double
 // per column, capacity max_rows).  Returns rows parsed, -1 on I/O
-// error, or -2 if the file holds more than max_rows rows (no partial
-// success — truncation must be explicit, not silent).
+// error, -2 if the file holds more than max_rows rows, or -3 on a
+// malformed record (short row, or an int/float field that does not
+// parse) — a bulk loader must fail loudly, never silently skip/zero.
 // ---------------------------------------------------------------------------
 
 static inline uint64_t fnv1a(const char* s, size_t n) {
@@ -54,6 +55,7 @@ long long csv_ingest(const char* path, char delim, int skip_header,
     line.reserve(4096);
     long long row = 0;
     bool first = true;
+    bool malformed = false;
     int c;
     std::string cur;
     std::vector<std::string> fields;
@@ -67,13 +69,32 @@ long long csv_ingest(const char* path, char delim, int skip_header,
             return true;
         }
         first = false;
-        if ((int)fields.size() < n_cols) { fields.clear(); return true; }
+        if ((int)fields.size() < n_cols) {
+            malformed = true;          // short record
+            fields.clear();
+            return false;
+        }
         if (row >= max_rows) { fields.clear(); return false; }
         for (int i = 0; i < n_cols; i++) {
             const std::string& s = fields[i];
+            char* end = nullptr;
             switch (col_types[i]) {
-                case 0: int_cols[i][row] = std::strtoll(s.c_str(), nullptr, 10); break;
-                case 1: dbl_cols[i][row] = std::strtod(s.c_str(), nullptr); break;
+                case 0:
+                    int_cols[i][row] = std::strtoll(s.c_str(), &end, 10);
+                    if (end == s.c_str() || *end != '\0') {
+                        malformed = true;
+                        fields.clear();
+                        return false;
+                    }
+                    break;
+                case 1:
+                    dbl_cols[i][row] = std::strtod(s.c_str(), &end);
+                    if (end == s.c_str() || *end != '\0') {
+                        malformed = true;
+                        fields.clear();
+                        return false;
+                    }
+                    break;
                 case 2: int_cols[i][row] = (int64_t)fnv1a(s.data(), s.size()); break;
                 default: break;
             }
@@ -102,6 +123,7 @@ long long csv_ingest(const char* path, char delim, int skip_header,
     }
     if (keep) flush_line();
     std::fclose(f);
+    if (malformed) return -3;
     if (!keep) return -2;          // max_rows exceeded
     return row;
 }

@@ -118,6 +118,9 @@ def csv_ingest(path: str, col_types: List[str], delim: str = ",",
                        n_cols, ctypes_kinds, max_rows, iptrs, dptrs)
     if n == -2:
         raise ValueError(f"{path}: more rows than max_rows={max_rows}")
+    if n == -3:
+        raise ValueError(f"{path}: malformed record (short row or "
+                         f"unparseable int/float field)")
     if n < 0:
         return None
     out: List[Optional[np.ndarray]] = []

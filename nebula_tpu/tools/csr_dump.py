@@ -1,0 +1,57 @@
+"""csr-dump — inspect the device snapshot layout of a space.
+
+Shows what would be pinned into HBM: per-(edge type, direction) block
+shapes, per-part edge counts, property columns, padding overhead, and
+total bytes — the capacity-planning view of the device plane.
+
+    python -m nebula_tpu.tools.csr_dump <checkpoint_dir> --space NAME
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def human(n: float) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if n < 1024:
+            return f"{n:.1f}{unit}"
+        n /= 1024
+    return f"{n:.1f}TiB"
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="nebula-tpu-csr-dump")
+    ap.add_argument("checkpoint", help="checkpoint directory")
+    ap.add_argument("--space", required=True)
+    args = ap.parse_args(argv)
+
+    from ..graphstore.csr import build_snapshot
+    from ..graphstore.store import GraphStore
+    store = GraphStore.from_checkpoint(args.checkpoint)
+    snap = build_snapshot(store, args.space)
+    print(f"space `{args.space}': epoch={snap.epoch} "
+          f"parts={snap.num_parts} vmax={snap.vmax} "
+          f"total={human(snap.hbm_bytes())}")
+    for (et, dirn), b in sorted(snap.blocks.items()):
+        per_part = [b.edges_of_part(p) for p in range(b.num_parts)]
+        emax = b.nbr.shape[1]
+        used = sum(per_part)
+        pad = b.num_parts * emax - used
+        nbytes = b.indptr.nbytes + b.nbr.nbytes + b.rank.nbytes + \
+            sum(a.nbytes for a in b.props.values())
+        print(f"  block ({et}, {dirn}): edges={used} emax={emax} "
+              f"pad={pad} ({human(nbytes)})")
+        print(f"    per-part: {per_part}")
+        for name, a in sorted(b.props.items()):
+            print(f"    prop {name}: {a.dtype} {human(a.nbytes)}")
+    for name, t in sorted(snap.tags.items()):
+        nbytes = t.present.nbytes + sum(a.nbytes for a in t.props.values())
+        print(f"  tag table {name}: present={int(t.present.sum())} "
+              f"({human(nbytes)})")
+    print(f"string pool: {len(snap.pool)} entries")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,64 @@
+"""db-dump — decode and print the contents of a checkpoint directory.
+
+The reference's db_dump decodes RocksDB SSTs/keys (src/tools/db-dump
+[UNVERIFIED — empty mount, SURVEY §0]); ours decodes the on-disk
+checkpoint format written by CREATE SNAPSHOT / GraphStore.checkpoint.
+
+    python -m nebula_tpu.tools.db_dump <checkpoint_dir> \
+        [--space NAME] [--mode stat|vertex|edge] [--limit N]
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="nebula-tpu-db-dump")
+    ap.add_argument("checkpoint", help="checkpoint directory")
+    ap.add_argument("--space", default=None)
+    ap.add_argument("--mode", choices=["stat", "vertex", "edge"],
+                    default="stat")
+    ap.add_argument("--limit", type=int, default=20)
+    args = ap.parse_args(argv)
+
+    from ..graphstore.store import GraphStore
+    store = GraphStore.from_checkpoint(args.checkpoint)
+    spaces = [args.space] if args.space else sorted(store.catalog.spaces)
+    for name in spaces:
+        st = store.stats(name)
+        print(f"space `{name}': parts={st['partition_num']} "
+              f"vertices={st['vertices']} edges={st['edges']} "
+              f"epoch={st['epoch']}")
+        if args.mode == "stat":
+            print(f"  per-part edges: {st['per_part_edges']}")
+            for t in store.catalog.tags(name):
+                print(f"  tag {t.name}: "
+                      f"{[p.name for p in t.latest.props]}")
+            for e in store.catalog.edges(name):
+                print(f"  edge {e.name}: "
+                      f"{[p.name for p in e.latest.props]}")
+            for d in store.catalog.indexes(name):
+                kind = "edge" if d.is_edge else "tag"
+                print(f"  {kind} index {d.name} ON "
+                      f"{d.schema_name}{tuple(d.fields)}")
+        elif args.mode == "vertex":
+            _dump(store.scan_vertices(name),
+                  lambda r: f"  {r[0]!r} :{r[1]} {r[2]}", args.limit)
+        else:
+            _dump(store.scan_edges(name),
+                  lambda r: f"  {r[0]!r} -[:{r[1]}@{r[2]}]-> {r[3]!r} "
+                            f"{r[4]}", args.limit)
+    return 0
+
+
+def _dump(rows, fmt, limit: int):
+    for n, r in enumerate(rows):
+        if n >= limit:
+            print(f"  ... (limit {limit})")
+            return
+        print(fmt(r))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,149 @@
+"""ldbc-import — bulk CSV loader (the nebula-importer analog, in-tree
+per SURVEY §2 row 31 because the benchmarks need it).
+
+Loads vertex and edge CSVs into a space, using the native csv_ingest
+parser when available (falling back to csv.reader), and optionally
+writes a checkpoint for later restore.
+
+    python -m nebula_tpu.tools.ldbc_import --space snb \
+        --vid-type INT64 --parts 8 \
+        --vertices Person:person.csv:id,firstName:string,age:int \
+        --edges KNOWS:knows.csv:src,dst,since:int \
+        [--checkpoint DIR] [--delimiter '|']
+
+Spec grammar:  TAG:file:idcol[,prop:type...]   (vertices)
+               ETYPE:file:srccol,dstcol[,prop:type...]  (edges)
+Types: int, float, string.  Column order must match the file.
+"""
+from __future__ import annotations
+
+import argparse
+import csv
+import sys
+import time
+from typing import List, Tuple
+
+from ..graphstore.schema import PropDef, PropType
+from ..graphstore.store import GraphStore
+
+_PT = {"int": PropType.INT64, "float": PropType.DOUBLE,
+       "string": PropType.STRING}
+
+
+def _conv(t: str, raw: str):
+    return int(raw) if t == "int" else float(raw) if t == "float" else raw
+
+
+def _parse_props(parts: List[str]) -> List[Tuple[str, str]]:
+    out = []
+    for p in parts:
+        if ":" not in p:
+            raise SystemExit(f"bad prop spec `{p}' (want name:type)")
+        n, t = p.split(":", 1)
+        if t not in _PT:
+            raise SystemExit(f"bad prop type `{t}' in `{p}'")
+        out.append((n, t))
+    return out
+
+
+def _read_rows(path: str, delim: str):
+    with open(path, newline="") as f:
+        r = csv.reader(f, delimiter=delim)
+        header = next(r, None)
+        yield from r
+
+
+def import_vertices(store: GraphStore, space: str, spec: str, delim: str,
+                    vid_is_int: bool) -> int:
+    tag, path, cols = spec.split(":", 2)
+    colspecs = cols.split(",")
+    props = _parse_props(colspecs[1:])
+    store.catalog.create_tag(space, tag,
+                             [PropDef(n, _PT[t]) for n, t in props],
+                             if_not_exists=True)
+    n = 0
+    for row in _read_rows(path, delim):
+        vid = int(row[0]) if vid_is_int else row[0]
+        pv = {name: _conv(t, row[i])
+              for i, (name, t) in enumerate(props, start=1)}
+        store.insert_vertex(space, vid, tag, pv)
+        n += 1
+    return n
+
+
+def import_edges(store: GraphStore, space: str, spec: str, delim: str,
+                 vid_is_int: bool) -> int:
+    etype, path, cols = spec.split(":", 2)
+    colspecs = cols.split(",")
+    props = _parse_props(colspecs[2:])
+    store.catalog.create_edge(space, etype,
+                              [PropDef(n, _PT[t]) for n, t in props],
+                              if_not_exists=True)
+    n = 0
+    if vid_is_int and all(t in ("int", "float") for _, t in props):
+        # native fast path: typed columns straight off the parser
+        from ..native.kernels import csv_ingest
+        types = ["int", "int"] + [t for _, t in props]
+        got = csv_ingest(path, types, delim=delim)
+        if got is not None:
+            srcs, dsts = got[0], got[1]
+            pcols = got[2:]
+            for i in range(len(srcs)):
+                pv = {name: (int(pcols[j][i]) if t == "int"
+                             else float(pcols[j][i]))
+                      for j, (name, t) in enumerate(props)}
+                store.insert_edge(space, int(srcs[i]), etype,
+                                  int(dsts[i]), 0, pv)
+            return len(srcs)
+    for row in _read_rows(path, delim):
+        src = int(row[0]) if vid_is_int else row[0]
+        dst = int(row[1]) if vid_is_int else row[1]
+        pv = {name: _conv(t, row[i])
+              for i, (name, t) in enumerate(props, start=2)}
+        store.insert_edge(space, src, etype, dst, 0, pv)
+        n += 1
+    return n
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="nebula-tpu-ldbc-import")
+    ap.add_argument("--space", required=True)
+    ap.add_argument("--parts", type=int, default=8)
+    ap.add_argument("--vid-type", default="INT64",
+                    choices=["INT64", "FIXED_STRING(32)"])
+    ap.add_argument("--vertices", action="append", default=[],
+                    help="TAG:file:idcol[,prop:type...]")
+    ap.add_argument("--edges", action="append", default=[],
+                    help="ETYPE:file:src,dst[,prop:type...]")
+    ap.add_argument("--delimiter", default=",")
+    ap.add_argument("--checkpoint", default=None,
+                    help="write a restorable checkpoint here when done")
+    args = ap.parse_args(argv)
+
+    store = GraphStore()
+    store.create_space(args.space, partition_num=args.parts,
+                       vid_type=args.vid_type, if_not_exists=True)
+    vid_is_int = args.vid_type == "INT64"
+    t0 = time.perf_counter()
+    total_v = total_e = 0
+    for spec in args.vertices:
+        n = import_vertices(store, args.space, spec, args.delimiter,
+                            vid_is_int)
+        total_v += n
+        print(f"imported {n} vertices from {spec.split(':')[1]}")
+    for spec in args.edges:
+        n = import_edges(store, args.space, spec, args.delimiter,
+                         vid_is_int)
+        total_e += n
+        print(f"imported {n} edges from {spec.split(':')[1]}")
+    dt = time.perf_counter() - t0
+    print(f"total: {total_v} vertices, {total_e} edges in {dt:.2f}s "
+          f"({(total_v + total_e) / max(dt, 1e-9):,.0f} rows/s)")
+    if args.checkpoint:
+        store.checkpoint(args.checkpoint)
+        print(f"checkpoint written to {args.checkpoint}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

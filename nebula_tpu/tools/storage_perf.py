@@ -1,0 +1,97 @@
+"""storage-perf — storage stress tool (the reference's storage_perf).
+
+Hammers the storage op set (insert/getNeighbors/point-get mixes) against
+an in-process store or a live cluster graphd, reporting ops/sec.
+
+    python -m nebula_tpu.tools.storage_perf [--addr host:port]
+        [--vertices N] [--edges N] [--reads N] [--batch B]
+"""
+from __future__ import annotations
+
+import argparse
+import random
+import sys
+import time
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="nebula-tpu-storage-perf")
+    ap.add_argument("--addr", help="cluster graphd host:port (default: "
+                                   "in-process store)")
+    ap.add_argument("--vertices", type=int, default=10_000)
+    ap.add_argument("--edges", type=int, default=50_000)
+    ap.add_argument("--reads", type=int, default=10_000)
+    ap.add_argument("--batch", type=int, default=200)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args(argv)
+    rng = random.Random(args.seed)
+
+    if args.addr:
+        from ..cluster.client import GraphClient
+        host, port = args.addr.rsplit(":", 1)
+        cli = GraphClient(host, int(port))
+        cli.authenticate()
+
+        def run(q):
+            rs = cli.execute(q)
+            if rs.error:
+                raise RuntimeError(rs.error)
+            return rs
+    else:
+        from ..exec.engine import QueryEngine
+        eng = QueryEngine()
+        sess = eng.new_session()
+
+        def run(q):
+            rs = eng.execute(sess, q)
+            if rs.error:
+                raise RuntimeError(rs.error)
+            return rs
+
+    run("CREATE SPACE IF NOT EXISTS perf(partition_num=8, vid_type=INT64)")
+    time.sleep(0.2 if args.addr else 0)
+    run("USE perf")
+    run("CREATE TAG IF NOT EXISTS node(a int)")
+    run("CREATE EDGE IF NOT EXISTS rel(w int)")
+
+    def timed(label, n_ops, fn):
+        t0 = time.perf_counter()
+        fn()
+        dt = time.perf_counter() - t0
+        print(f"{label}: {n_ops} ops in {dt:.2f}s = {n_ops / dt:,.0f} op/s")
+
+    V, E, B = args.vertices, args.edges, args.batch
+
+    def insert_vertices():
+        for lo in range(0, V, B):
+            vals = ", ".join(f"{i}:({i})" for i in range(lo, min(lo + B, V)))
+            run(f"INSERT VERTEX node(a) VALUES {vals}")
+    timed("insert vertex", V, insert_vertices)
+
+    def insert_edges():
+        for lo in range(0, E, B):
+            vals = ", ".join(
+                f"{rng.randrange(V)}->{rng.randrange(V)}:({i})"
+                for i in range(lo, min(lo + B, E)))
+            run(f"INSERT EDGE rel(w) VALUES {vals}")
+    timed("insert edge", E, insert_edges)
+
+    read_iters = max(1, args.reads // B)
+    read_ops = read_iters * B           # report ONLY work actually done
+
+    def point_reads():
+        for _ in range(read_iters):
+            ids = ", ".join(str(rng.randrange(V)) for _ in range(B))
+            run(f"FETCH PROP ON node {ids} YIELD node.a")
+    timed("point fetch", read_ops, point_reads)
+
+    def neighbors():
+        for _ in range(read_iters):
+            ids = ", ".join(str(rng.randrange(V)) for _ in range(B))
+            run(f"GO FROM {ids} OVER rel YIELD dst(edge)")
+    timed("getNeighbors", read_ops, neighbors)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -99,6 +99,17 @@ def test_row_codec_roundtrip():
     assert decode_row(blob[:-3]) is None
 
 
+def test_csv_ingest_rejects_malformed(tmp_path):
+    short = tmp_path / "short.csv"
+    short.write_text("a,b\n1,2\n3\n")          # short row
+    with pytest.raises(ValueError):
+        csv_ingest(str(short), ["int", "int"])
+    bad = tmp_path / "bad.csv"
+    bad.write_text("a,b\n1,xyz\n")             # non-numeric int field
+    with pytest.raises(ValueError):
+        csv_ingest(str(bad), ["int", "int"])
+
+
 def test_csv_ingest_rejects_truncation(tmp_path):
     f = tmp_path / "big.csv"
     f.write_text("a\n" + "\n".join(str(i) for i in range(100)) + "\n")

@@ -1,0 +1,73 @@
+"""Tool entrypoints: ldbc_import, db_dump, csr_dump, storage_perf —
+each driven through its main() like a user would."""
+import pytest
+
+from nebula_tpu.tools import csr_dump, db_dump, ldbc_import, storage_perf
+
+
+@pytest.fixture()
+def csvs(tmp_path):
+    people = tmp_path / "person.csv"
+    people.write_text("id|name|age\n1|ann|30\n2|bob|25\n3|cid|41\n")
+    knows = tmp_path / "knows.csv"
+    knows.write_text("src|dst|since\n1|2|2010\n2|3|2015\n1|3|2012\n")
+    return people, knows
+
+
+def test_ldbc_import_and_dumps(tmp_path, csvs, capsys):
+    people, knows = csvs
+    cp = tmp_path / "cp"
+    rc = ldbc_import.main([
+        "--space", "ld", "--parts", "4", "--vid-type", "INT64",
+        "--vertices", f"Person:{people}:id,name:string,age:int",
+        "--edges", f"KNOWS:{knows}:src,dst,since:int",
+        "--delimiter", "|", "--checkpoint", str(cp)])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "3 vertices" in out and "3 edges" in out
+
+    # restored checkpoint serves queries
+    from nebula_tpu.exec import QueryEngine
+    from nebula_tpu.graphstore.store import GraphStore
+    st = GraphStore.from_checkpoint(str(cp))
+    eng = QueryEngine(st)
+    s = eng.new_session()
+    eng.execute(s, "USE ld")
+    r = eng.execute(s, "GO FROM 1 OVER KNOWS YIELD dst(edge), KNOWS.since")
+    assert r.ok and sorted(map(tuple, r.data.rows)) == [(2, 2010), (3, 2012)]
+
+    # db_dump over the checkpoint
+    assert db_dump.main([str(cp)]) == 0
+    out = capsys.readouterr().out
+    assert "vertices=3" in out and "edges=3" in out
+    assert db_dump.main([str(cp), "--mode", "edge", "--limit", "2"]) == 0
+    out = capsys.readouterr().out
+    assert "-[:KNOWS@0]->" in out
+
+    # csr_dump over the checkpoint
+    assert csr_dump.main([str(cp), "--space", "ld"]) == 0
+    out = capsys.readouterr().out
+    assert "block (KNOWS, out): edges=3" in out
+    assert "tag table Person: present=3" in out
+
+
+def test_ldbc_import_string_vids(tmp_path, capsys):
+    pf = tmp_path / "v.csv"
+    pf.write_text("id,score\na,1.5\nb,2.5\n")
+    ef = tmp_path / "e.csv"
+    ef.write_text("src,dst\na,b\n")
+    rc = ldbc_import.main([
+        "--space", "lds", "--parts", "2",
+        "--vid-type", "FIXED_STRING(32)",
+        "--vertices", f"T:{pf}:id,score:float",
+        "--edges", f"E:{ef}:src,dst"])
+    assert rc == 0
+    assert "2 vertices" in capsys.readouterr().out
+
+
+def test_storage_perf_smoke(capsys):
+    rc = storage_perf.main(["--vertices", "50", "--edges", "100",
+                            "--reads", "40", "--batch", "20"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "getNeighbors" in out and "op/s" in out
