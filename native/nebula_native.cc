@@ -12,6 +12,7 @@
 //
 // Build: g++ -O3 -shared -fPIC -std=c++17 -o libnebula_native.so nebula_native.cc
 #include <algorithm>
+#include <cerrno>
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
@@ -69,8 +70,8 @@ long long csv_ingest(const char* path, char delim, int skip_header,
             return true;
         }
         first = false;
-        if ((int)fields.size() < n_cols) {
-            malformed = true;          // short record
+        if ((int)fields.size() != n_cols) {
+            malformed = true;   // short OR over-long record (field shift)
             fields.clear();
             return false;
         }
@@ -80,16 +81,20 @@ long long csv_ingest(const char* path, char delim, int skip_header,
             char* end = nullptr;
             switch (col_types[i]) {
                 case 0:
+                    errno = 0;
                     int_cols[i][row] = std::strtoll(s.c_str(), &end, 10);
-                    if (end == s.c_str() || *end != '\0') {
+                    if (end == s.c_str() || *end != '\0' ||
+                        errno == ERANGE) {   // reject silent clamping too
                         malformed = true;
                         fields.clear();
                         return false;
                     }
                     break;
                 case 1:
+                    errno = 0;
                     dbl_cols[i][row] = std::strtod(s.c_str(), &end);
-                    if (end == s.c_str() || *end != '\0') {
+                    if (end == s.c_str() || *end != '\0' ||
+                        errno == ERANGE) {
                         malformed = true;
                         fields.clear();
                         return false;

@@ -229,6 +229,12 @@ def collapse_project(node: PlanNode) -> Optional[PlanNode]:
         else:
             return None
     node.args["columns"] = new_cols
+    # the substituted expressions came from the inner project — they need
+    # its evaluation context (lookup_row/fetch_row resolve Tag.prop etc.
+    # against the scanned entity, not plain input columns)
+    for flag in ("lookup_row", "fetch_row", "schema", "is_edge"):
+        if flag in inner.args:
+            node.args[flag] = inner.args[flag]
     node.deps = list(inner.deps)
     node.input_vars = [d.output_var for d in node.deps]
     return node

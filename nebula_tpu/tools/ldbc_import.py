@@ -46,23 +46,44 @@ def _parse_props(parts: List[str]) -> List[Tuple[str, str]]:
     return out
 
 
-def _read_rows(path: str, delim: str):
+def _read_rows(path: str, delim: str, header: bool):
     with open(path, newline="") as f:
         r = csv.reader(f, delimiter=delim)
-        header = next(r, None)
+        if header:
+            next(r, None)
         yield from r
 
 
+def _native_columns(path: str, delim: str, header: bool, n_keys: int,
+                    props) -> Optional[list]:
+    """Typed columns via the native parser when every column is numeric;
+    None → caller uses the csv.reader path."""
+    if not all(t in ("int", "float") for _, t in props):
+        return None
+    from ..native.kernels import csv_ingest
+    types = ["int"] * n_keys + [t for _, t in props]
+    return csv_ingest(path, types, delim=delim, skip_header=header)
+
+
 def import_vertices(store: GraphStore, space: str, spec: str, delim: str,
-                    vid_is_int: bool) -> int:
+                    vid_is_int: bool, header: bool) -> int:
     tag, path, cols = spec.split(":", 2)
     colspecs = cols.split(",")
     props = _parse_props(colspecs[1:])
     store.catalog.create_tag(space, tag,
                              [PropDef(n, _PT[t]) for n, t in props],
                              if_not_exists=True)
+    if vid_is_int:
+        got = _native_columns(path, delim, header, 1, props)
+        if got is not None:
+            vids, pcols = got[0], got[1:]
+            for i in range(len(vids)):
+                pv = {name: _conv(t, pcols[j][i])
+                      for j, (name, t) in enumerate(props)}
+                store.insert_vertex(space, int(vids[i]), tag, pv)
+            return len(vids)
     n = 0
-    for row in _read_rows(path, delim):
+    for row in _read_rows(path, delim, header):
         vid = int(row[0]) if vid_is_int else row[0]
         pv = {name: _conv(t, row[i])
               for i, (name, t) in enumerate(props, start=1)}
@@ -72,30 +93,26 @@ def import_vertices(store: GraphStore, space: str, spec: str, delim: str,
 
 
 def import_edges(store: GraphStore, space: str, spec: str, delim: str,
-                 vid_is_int: bool) -> int:
+                 vid_is_int: bool, header: bool) -> int:
     etype, path, cols = spec.split(":", 2)
     colspecs = cols.split(",")
     props = _parse_props(colspecs[2:])
     store.catalog.create_edge(space, etype,
                               [PropDef(n, _PT[t]) for n, t in props],
                               if_not_exists=True)
-    n = 0
-    if vid_is_int and all(t in ("int", "float") for _, t in props):
-        # native fast path: typed columns straight off the parser
-        from ..native.kernels import csv_ingest
-        types = ["int", "int"] + [t for _, t in props]
-        got = csv_ingest(path, types, delim=delim)
+    if vid_is_int:
+        got = _native_columns(path, delim, header, 2, props)
         if got is not None:
             srcs, dsts = got[0], got[1]
             pcols = got[2:]
             for i in range(len(srcs)):
-                pv = {name: (int(pcols[j][i]) if t == "int"
-                             else float(pcols[j][i]))
+                pv = {name: _conv(t, pcols[j][i])
                       for j, (name, t) in enumerate(props)}
                 store.insert_edge(space, int(srcs[i]), etype,
                                   int(dsts[i]), 0, pv)
             return len(srcs)
-    for row in _read_rows(path, delim):
+    n = 0
+    for row in _read_rows(path, delim, header):
         src = int(row[0]) if vid_is_int else row[0]
         dst = int(row[1]) if vid_is_int else row[1]
         pv = {name: _conv(t, row[i])

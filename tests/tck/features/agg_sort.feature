@@ -1,0 +1,58 @@
+Feature: Aggregation, ordering, dedup
+
+  Background:
+    Given having executed:
+      """
+      CREATE SPACE ag(partition_num=4, vid_type=INT64);
+      USE ag;
+      CREATE TAG item(cat string, price int);
+      CREATE TAG INDEX i_price ON item(price);
+      CREATE EDGE rel();
+      INSERT VERTEX item(cat, price) VALUES 1:("a", 10), 2:("a", 20), 3:("b", 30), 4:("b", 40), 5:("b", 50)
+      """
+
+  Scenario: aggregates over piped rows
+    When executing query:
+      """
+      LOOKUP ON item WHERE item.price > 0 YIELD item.cat AS cat, item.price AS p | GROUP BY $-.cat YIELD $-.cat AS cat, count(*) AS n, sum($-.p) AS s, avg($-.p) AS a, max($-.p) AS mx, min($-.p) AS mn | ORDER BY $-.cat
+      """
+    Then the result should be, in order:
+      | cat | n | s   | a    | mx | mn |
+      | "a" | 2 | 30  | 15.0 | 20 | 10 |
+      | "b" | 3 | 120 | 40.0 | 50 | 30 |
+
+  Scenario: distinct
+    When executing query:
+      """
+      LOOKUP ON item WHERE item.price > 0 YIELD item.cat AS cat | YIELD DISTINCT $-.cat AS c | ORDER BY $-.c
+      """
+    Then the result should be, in order:
+      | c   |
+      | "a" |
+      | "b" |
+
+  Scenario: order by desc with limit
+    When executing query:
+      """
+      LOOKUP ON item WHERE item.price > 0 YIELD item.price AS p | ORDER BY $-.p DESC | LIMIT 2
+      """
+    Then the result should be, in order:
+      | p  |
+      | 50 |
+      | 40 |
+
+  Scenario: count distinct
+    When executing query:
+      """
+      LOOKUP ON item WHERE item.price > 0 YIELD item.cat AS cat | YIELD count(DISTINCT $-.cat) AS c
+      """
+    Then the result should be, in order:
+      | c |
+      | 2 |
+
+  Scenario: lookup on schema without any index errors
+    When executing query:
+      """
+      LOOKUP ON rel WHERE rel.x > 0 YIELD src(edge)
+      """
+    Then a SemanticError should be raised

@@ -1,0 +1,100 @@
+Feature: Expressions and null semantics
+
+  Scenario: arithmetic and precedence
+    When executing query:
+      """
+      YIELD 2 + 3 * 4 AS a, (2 + 3) * 4 AS b, 7 / 2 AS c, 7 % 3 AS d, 2.5 * 2 AS e
+      """
+    Then the result should be, in order:
+      | a  | b  | c | d | e   |
+      | 14 | 20 | 3 | 1 | 5.0 |
+
+  Scenario: three-valued logic
+    When executing query:
+      """
+      YIELD NULL AND false AS a, NULL AND true AS b, NULL OR true AS c, NULL OR false AS d, NOT NULL AS e
+      """
+    Then the result should be, in order:
+      | a     | b    | c    | d    | e    |
+      | false | NULL | true | NULL | NULL |
+
+  Scenario: null propagation in arithmetic and comparison
+    When executing query:
+      """
+      YIELD 1 + NULL AS a, NULL == NULL AS b, NULL != 1 AS c, 1 < NULL AS d
+      """
+    Then the result should be, in order:
+      | a    | b    | c    | d    |
+      | NULL | NULL | NULL | NULL |
+
+  Scenario: division by zero is an error value
+    When executing query:
+      """
+      YIELD 1 / 0 AS a
+      """
+    Then the result should be, in order:
+      | a              |
+      | __DIV_BY_ZERO__ |
+
+  Scenario: string predicates
+    When executing query:
+      """
+      YIELD "hello" CONTAINS "ell" AS a, "hello" STARTS WITH "he" AS b, "hello" ENDS WITH "lo" AS c, "hello" =~ "h.*o" AS d
+      """
+    Then the result should be, in order:
+      | a    | b    | c    | d    |
+      | true | true | true | true |
+
+  Scenario: IN and list functions
+    When executing query:
+      """
+      YIELD 2 IN [1, 2, 3] AS a, size([1, 2, 3]) AS b, head([7, 8]) AS c, last([7, 8]) AS d
+      """
+    Then the result should be, in order:
+      | a    | b | c | d |
+      | true | 3 | 7 | 8 |
+
+  Scenario: CASE expression
+    When executing query:
+      """
+      YIELD CASE WHEN 1 > 2 THEN "x" WHEN 2 > 1 THEN "y" ELSE "z" END AS a, CASE 3 WHEN 2 THEN "two" WHEN 3 THEN "three" END AS b
+      """
+    Then the result should be, in order:
+      | a   | b       |
+      | "y" | "three" |
+
+  Scenario: string functions
+    When executing query:
+      """
+      YIELD upper("ab") AS a, lower("AB") AS b, substr("hello", 1, 3) AS c, length("abc") AS d, trim("  x ") AS e
+      """
+    Then the result should be, in order:
+      | a    | b    | c     | d | e   |
+      | "AB" | "ab" | "ell" | 3 | "x" |
+
+  Scenario: math and type functions
+    When executing query:
+      """
+      YIELD abs(-3) AS a, floor(2.7) AS b, ceil(2.1) AS c, round(2.5) AS d, sqrt(9) AS e, pow(2, 10) AS f, toInteger("42") AS g, toFloat("1.5") AS h, toString(7) AS i
+      """
+    Then the result should be, in order:
+      | a | b   | c   | d   | e   | f    | g  | h   | i   |
+      | 3 | 2.0 | 3.0 | 3.0 | 3.0 | 1024 | 42 | 1.5 | "7" |
+
+  Scenario: list comprehension and reduce
+    When executing query:
+      """
+      YIELD [x IN [1, 2, 3, 4] WHERE x % 2 == 0 | x * 10] AS a, reduce(acc = 0, x IN [1, 2, 3] | acc + x) AS b
+      """
+    Then the result should be, in order:
+      | a        | b |
+      | [20, 40] | 6 |
+
+  Scenario: coalesce and conditionals
+    When executing query:
+      """
+      YIELD coalesce(NULL, 5) AS a, coalesce(NULL, NULL) AS b
+      """
+    Then the result should be, in order:
+      | a | b    |
+      | 5 | NULL |

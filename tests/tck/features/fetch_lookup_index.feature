@@ -1,0 +1,94 @@
+Feature: FETCH, LOOKUP, and index semantics
+
+  Background:
+    Given having executed:
+      """
+      CREATE SPACE fl(partition_num=4, vid_type=INT64);
+      USE fl;
+      CREATE TAG city(name string, pop int);
+      CREATE EDGE road(len int);
+      CREATE TAG INDEX i_pop ON city(pop);
+      CREATE EDGE INDEX i_len ON road(len);
+      INSERT VERTEX city(name, pop) VALUES 1:("sf", 800), 2:("la", 4000), 3:("ny", 8000);
+      INSERT EDGE road(len) VALUES 1->2:(380), 2->3:(2800), 1->3:(2900)
+      """
+
+  Scenario: fetch vertex props
+    When executing query:
+      """
+      FETCH PROP ON city 2 YIELD city.name AS n, city.pop AS p
+      """
+    Then the result should be, in any order:
+      | n    | p    |
+      | "la" | 4000 |
+
+  Scenario: fetch missing vertex is empty
+    When executing query:
+      """
+      FETCH PROP ON city 99 YIELD city.name
+      """
+    Then the result should be empty
+
+  Scenario: fetch edge props
+    When executing query:
+      """
+      FETCH PROP ON road 1->2 YIELD properties(edge).len AS l
+      """
+    Then the result should be, in any order:
+      | l   |
+      | 380 |
+
+  Scenario: lookup range scan
+    When executing query:
+      """
+      LOOKUP ON city WHERE city.pop >= 800 AND city.pop < 8000 YIELD id(vertex) AS id, city.name AS n
+      """
+    Then the result should be, in any order:
+      | id | n    |
+      | 1  | "sf" |
+      | 2  | "la" |
+
+  Scenario: lookup on edge index
+    When executing query:
+      """
+      LOOKUP ON road WHERE road.len > 1000 YIELD src(edge) AS s, dst(edge) AS d
+      """
+    Then the result should be, in any order:
+      | s | d |
+      | 2 | 3 |
+      | 1 | 3 |
+
+  Scenario: lookup without any index errors
+    When executing query:
+      """
+      LOOKUP ON road2 WHERE road2.x > 0
+      """
+    Then a SemanticError should be raised
+
+  Scenario: update then fetch sees new value
+    When executing query:
+      """
+      UPDATE VERTEX ON city 1 SET pop = 900;
+      FETCH PROP ON city 1 YIELD city.pop AS p
+      """
+    Then the result should be, in any order:
+      | p   |
+      | 900 |
+
+  Scenario: updated value visible through the index
+    When executing query:
+      """
+      UPDATE VERTEX ON city 1 SET pop = 7777;
+      LOOKUP ON city WHERE city.pop == 7777 YIELD id(vertex) AS id
+      """
+    Then the result should be, in any order:
+      | id |
+      | 1  |
+
+  Scenario: delete removes from traversal and index
+    When executing query:
+      """
+      DELETE VERTEX 3 WITH EDGE;
+      LOOKUP ON city WHERE city.pop >= 8000 YIELD id(vertex)
+      """
+    Then the result should be empty

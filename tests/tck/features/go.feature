@@ -1,0 +1,89 @@
+Feature: GO traversal
+
+  Background:
+    Given having executed:
+      """
+      CREATE SPACE gg(partition_num=8, vid_type=FIXED_STRING(20));
+      USE gg;
+      CREATE TAG person(name string, age int);
+      CREATE EDGE knows(since int, w double);
+      CREATE EDGE likes(level int);
+      INSERT VERTEX person(name, age) VALUES "a":("Ann", 30), "b":("Bob", 25), "c":("Cat", 41), "d":("Dan", 19), "e":("Eve", 33);
+      INSERT EDGE knows(since, w) VALUES "a"->"b":(2010, 1.0), "a"->"c":(2012, 0.5), "b"->"c":(2015, 2.0), "c"->"d":(2018, 1.5), "d"->"e":(2020, 3.0), "e"->"a":(2021, 0.1);
+      INSERT EDGE likes(level) VALUES "a"->"d":(5), "b"->"a":(3)
+      """
+
+  Scenario: one step
+    When executing query:
+      """
+      GO FROM "a" OVER knows YIELD dst(edge) AS d
+      """
+    Then the result should be, in any order:
+      | d   |
+      | "b" |
+      | "c" |
+
+  Scenario: two steps with edge and dst filters
+    When executing query:
+      """
+      GO 2 STEPS FROM "a" OVER knows WHERE knows.since > 2012 AND $$.person.age > 20 YIELD dst(edge) AS d, $^.person.name AS src_name
+      """
+    Then the result should be, in any order:
+      | d   | src_name |
+      | "c" | "Bob"    |
+
+  Scenario: reversely
+    When executing query:
+      """
+      GO FROM "a" OVER knows REVERSELY YIELD src(edge) AS s
+      """
+    Then the result should be, in any order:
+      | s   |
+      | "e" |
+
+  Scenario: over star
+    When executing query:
+      """
+      GO FROM "a" OVER * YIELD dst(edge) AS d
+      """
+    Then the result should be, in any order:
+      | d   |
+      | "b" |
+      | "c" |
+      | "d" |
+
+  Scenario: m to n steps
+    When executing query:
+      """
+      GO 1 TO 2 STEPS FROM "a" OVER knows YIELD dst(edge) AS d, knows.since AS y
+      """
+    Then the result should be, in any order:
+      | d   | y    |
+      | "b" | 2010 |
+      | "c" | 2012 |
+      | "c" | 2015 |
+      | "d" | 2018 |
+
+  Scenario: pipe into second hop
+    When executing query:
+      """
+      GO FROM "a" OVER knows YIELD dst(edge) AS d | GO FROM $-.d OVER knows YIELD $-.d AS via, dst(edge) AS d2
+      """
+    Then the result should be, in any order:
+      | via | d2  |
+      | "b" | "c" |
+      | "c" | "d" |
+
+  Scenario: unknown edge type errors
+    When executing query:
+      """
+      GO FROM "a" OVER nosuch
+      """
+    Then a SemanticError should be raised
+
+  Scenario: no results is empty not error
+    When executing query:
+      """
+      GO FROM "zzz" OVER knows
+      """
+    Then the result should be empty

@@ -1,0 +1,75 @@
+Feature: MATCH patterns
+
+  Background:
+    Given having executed:
+      """
+      CREATE SPACE mm(partition_num=4, vid_type=FIXED_STRING(20));
+      USE mm;
+      CREATE TAG person(name string, age int);
+      CREATE EDGE knows(since int);
+      INSERT VERTEX person(name, age) VALUES "a":("Ann", 30), "b":("Bob", 25), "c":("Cat", 41), "d":("Dan", 19);
+      INSERT EDGE knows(since) VALUES "a"->"b":(2010), "b"->"c":(2015), "c"->"d":(2018), "a"->"c":(2012)
+      """
+
+  Scenario: node scan with label filter
+    When executing query:
+      """
+      MATCH (v:person) WHERE v.person.age > 28 RETURN v.person.name AS n ORDER BY n
+      """
+    Then the result should be, in order:
+      | n     |
+      | "Ann" |
+      | "Cat" |
+
+  Scenario: one hop pattern with edge filter
+    When executing query:
+      """
+      MATCH (a:person)-[e:knows]->(b) WHERE e.since >= 2012 RETURN a.person.name AS s, b.person.name AS d
+      """
+    Then the result should be, in any order:
+      | s     | d     |
+      | "Bob" | "Cat" |
+      | "Cat" | "Dan" |
+      | "Ann" | "Cat" |
+
+  Scenario: variable length path
+    When executing query:
+      """
+      MATCH (a:person)-[e:knows*1..2]->(b) WHERE id(a) == "a" RETURN id(b) AS d
+      """
+    Then the result should be, in any order:
+      | d   |
+      | "b" |
+      | "c" |
+      | "c" |
+      | "d" |
+
+  Scenario: aggregation with grouping
+    When executing query:
+      """
+      MATCH (a:person)-[e:knows]->(b) RETURN a.person.name AS s, count(*) AS c ORDER BY s
+      """
+    Then the result should be, in order:
+      | s     | c |
+      | "Ann" | 2 |
+      | "Bob" | 1 |
+      | "Cat" | 1 |
+
+  Scenario: limit and skip
+    When executing query:
+      """
+      MATCH (v:person) RETURN v.person.name AS n ORDER BY n SKIP 1 LIMIT 2
+      """
+    Then the result should be, in order:
+      | n     |
+      | "Bob" |
+      | "Cat" |
+
+  Scenario: optional-style missing property is null
+    When executing query:
+      """
+      MATCH (v:person) WHERE v.person.name == "Ann" RETURN v.person.nosuch AS x
+      """
+    Then the result should be, in order:
+      | x               |
+      | __UNKNOWN_PROP__ |

@@ -1,0 +1,66 @@
+Feature: FIND PATH and GET SUBGRAPH
+
+  Background:
+    Given having executed:
+      """
+      CREATE SPACE ps(partition_num=4, vid_type=FIXED_STRING(20));
+      USE ps;
+      CREATE TAG node();
+      CREATE EDGE e(w int);
+      INSERT VERTEX node() VALUES "a":(), "b":(), "c":(), "d":(), "e":();
+      INSERT EDGE e(w) VALUES "a"->"b":(1), "b"->"c":(1), "a"->"c":(1), "c"->"d":(1), "d"->"e":(1)
+      """
+
+  Scenario: shortest path
+    When executing query:
+      """
+      FIND SHORTEST PATH FROM "a" TO "d" OVER e YIELD path AS p
+      """
+    Then the result should be, in order:
+      | p                                       |
+      | ("a")-[:e@0]->("c")-[:e@0]->("d")       |
+
+  Scenario: all shortest paths are returned
+    When executing query:
+      """
+      FIND SHORTEST PATH FROM "a" TO "c" OVER e YIELD path AS p
+      """
+    Then the result should be, in order:
+      | p                   |
+      | ("a")-[:e@0]->("c") |
+
+  Scenario: all paths
+    When executing query:
+      """
+      FIND ALL PATH FROM "a" TO "c" OVER e UPTO 3 STEPS YIELD path AS p
+      """
+    Then the result should be, in any order:
+      | p                                 |
+      | ("a")-[:e@0]->("c")               |
+      | ("a")-[:e@0]->("b")-[:e@0]->("c") |
+
+  Scenario: unreachable target is empty
+    When executing query:
+      """
+      FIND SHORTEST PATH FROM "e" TO "a" OVER e YIELD path AS p
+      """
+    Then the result should be empty
+
+  Scenario: shortest path reversely
+    When executing query:
+      """
+      FIND SHORTEST PATH FROM "d" TO "a" OVER e REVERSELY YIELD path AS p
+      """
+    Then the result should be, in order:
+      | p                                  |
+      | ("d")<-[:e@0]-("c")<-[:e@0]-("a")  |
+
+  Scenario: get subgraph step vertices
+    When executing query:
+      """
+      GET SUBGRAPH 1 STEPS FROM "a" YIELD vertices AS nodes
+      """
+    Then the result should be, in any order:
+      | nodes                  |
+      | [("a")]                |
+      | [("b"), ("c")]         |

@@ -1,0 +1,46 @@
+"""Conformance gate: every feature scenario runs on the host engine AND
+on a device-enabled engine over the 8-device virtual mesh — identical
+assertions (SURVEY §4: 'TCK green with TPU rule ON = the parity gate')."""
+import glob
+import os
+
+import pytest
+
+from .runner import parse_feature, run_scenario
+
+_DIR = os.path.join(os.path.dirname(__file__), "features")
+
+
+def _scenarios():
+    out = []
+    for path in sorted(glob.glob(os.path.join(_DIR, "*.feature"))):
+        with open(path) as f:
+            out.extend(parse_feature(f.read()))
+    return out
+
+
+_SCN = _scenarios()
+_rt = None
+
+
+def _get_rt():
+    global _rt
+    if _rt is None:
+        from nebula_tpu.tpu import TpuRuntime, make_mesh
+        _rt = TpuRuntime(make_mesh(8))
+    return _rt
+
+
+@pytest.mark.parametrize(
+    "scn", _SCN, ids=[f"{s.feature}::{s.name}".replace(" ", "_")
+                      for s in _SCN])
+@pytest.mark.parametrize("mode", ["host", "tpu"])
+def test_scenario(scn, mode):
+    from nebula_tpu.exec.engine import QueryEngine
+
+    def make_engine():
+        rt = _get_rt() if mode == "tpu" else None
+        eng = QueryEngine(tpu_runtime=rt)
+        return eng, eng.new_session()
+
+    run_scenario(scn, make_engine)
