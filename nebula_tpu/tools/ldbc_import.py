@@ -21,7 +21,7 @@ import argparse
 import csv
 import sys
 import time
-from typing import List, Tuple
+from typing import List, Optional, Tuple
 
 from ..graphstore.schema import PropDef, PropType
 from ..graphstore.store import GraphStore
@@ -133,6 +133,9 @@ def main(argv=None):
     ap.add_argument("--edges", action="append", default=[],
                     help="ETYPE:file:src,dst[,prop:type...]")
     ap.add_argument("--delimiter", default=",")
+    ap.add_argument("--header", dest="header", action="store_true",
+                    default=True, help="first CSV row is a header (default)")
+    ap.add_argument("--no-header", dest="header", action="store_false")
     ap.add_argument("--checkpoint", default=None,
                     help="write a restorable checkpoint here when done")
     args = ap.parse_args(argv)
@@ -145,12 +148,12 @@ def main(argv=None):
     total_v = total_e = 0
     for spec in args.vertices:
         n = import_vertices(store, args.space, spec, args.delimiter,
-                            vid_is_int)
+                            vid_is_int, args.header)
         total_v += n
         print(f"imported {n} vertices from {spec.split(':')[1]}")
     for spec in args.edges:
         n = import_edges(store, args.space, spec, args.delimiter,
-                         vid_is_int)
+                         vid_is_int, args.header)
         total_e += n
         print(f"imported {n} edges from {spec.split(':')[1]}")
     dt = time.perf_counter() - t0

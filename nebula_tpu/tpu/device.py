@@ -26,11 +26,25 @@ class TpuUnavailable(Exception):
 
 def make_mesh(n_devices: Optional[int] = None, devices=None) -> Mesh:
     """A 1-D 'part' mesh: one graph partition per device slot."""
+    explicit = devices is not None
     if devices is None:
         devices = jax.devices()
     if n_devices is None:
         n_devices = len(devices)
-    if n_devices > len(devices):
+    if n_devices > len(devices) and not explicit:
+        # 1-chip host asked for an N-way mesh: the CPU platform may carry
+        # virtual devices (--xla_force_host_platform_device_count)
+        try:
+            cpu = jax.devices("cpu")
+        except RuntimeError:
+            cpu = []
+        if len(cpu) >= n_devices:
+            devices = cpu
+        else:
+            raise ValueError(
+                f"need {n_devices} devices, have {len(devices)} "
+                f"(and {len(cpu)} cpu)")
+    elif n_devices > len(devices):
         raise ValueError(f"need {n_devices} devices, have {len(devices)}")
     return Mesh(np.asarray(devices[:n_devices]), ("part",))
 
