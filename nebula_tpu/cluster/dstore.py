@@ -79,16 +79,35 @@ class DistributedStore:
         pid = self.sc.part_of(space, vid)
         self._write(space, pid, ("vertex", vid, tag, sv.version, row))
 
+    def _chain_write(self, space: str, src: Any, dst: Any,
+                     out_cmd: tuple, in_cmd: list):
+        """TOSS chain with resume bookkeeping: the out-half part logs the
+        in-half it owes before anything is applied; if this graphd dies
+        mid-chain, the out-half leader's resume loop re-drives the
+        in-half (storage_service._resume_chains).  In-half apply is
+        idempotent, so the happy path completing the chain itself races
+        safely with the janitor."""
+        import time as _t
+        import uuid
+        cid = uuid.uuid4().hex
+        src_pid = self.sc.part_of(space, src)
+        dst_pid = self.sc.part_of(space, dst)
+        # mark + out-half ride ONE raft entry: the journal must never
+        # commit without the out-half it promises to mirror
+        mark = ["chain_mark", src_pid, cid, dst_pid, in_cmd, _t.time()]
+        self._write(space, src_pid, ("batch", [mark, list(out_cmd)]))
+        self._write(space, dst_pid, tuple(in_cmd))
+        self._write(space, src_pid, ("chain_done", src_pid, cid))
+
     def insert_edge(self, space: str, src: Any, etype: str, dst: Any,
                     rank: int, props: Dict[str, Any],
                     insert_names: Optional[List[str]] = None):
         es = self.catalog.get_edge(space, etype)
         row = apply_defaults(es.latest, props, insert_names)
         # TOSS chain: out-half first (source of truth), then in-half
-        self._write(space, self.sc.part_of(space, src),
-                    ("edge_half", src, etype, dst, rank, row, "out"))
-        self._write(space, self.sc.part_of(space, dst),
-                    ("edge_half", src, etype, dst, rank, row, "in"))
+        self._chain_write(space, src, dst,
+                          ("edge_half", src, etype, dst, rank, row, "out"),
+                          ["edge_half", src, etype, dst, rank, row, "in"])
 
     def delete_vertex(self, space: str, vid: Any, with_edges: bool = True):
         if with_edges:
@@ -110,10 +129,9 @@ class DistributedStore:
 
     def delete_edge(self, space: str, src: Any, etype: str, dst: Any,
                     rank: int):
-        self._write(space, self.sc.part_of(space, src),
-                    ("del_edge_half", src, etype, dst, rank, "out"))
-        self._write(space, self.sc.part_of(space, dst),
-                    ("del_edge_half", src, etype, dst, rank, "in"))
+        self._chain_write(space, src, dst,
+                          ("del_edge_half", src, etype, dst, rank, "out"),
+                          ["del_edge_half", src, etype, dst, rank, "in"])
 
     def update_vertex(self, space: str, vid: Any, tag: str,
                       updates: Dict[str, Any]) -> bool:
@@ -136,10 +154,10 @@ class DistributedStore:
                 raise SchemaError(f"unknown prop `{k}'")
         if self.get_edge(space, src, etype, dst, rank) is None:
             return False
-        self._write(space, self.sc.part_of(space, src),
-                    ("upd_edge_half", src, etype, dst, rank, updates, "out"))
-        self._write(space, self.sc.part_of(space, dst),
-                    ("upd_edge_half", src, etype, dst, rank, updates, "in"))
+        self._chain_write(
+            space, src, dst,
+            ("upd_edge_half", src, etype, dst, rank, updates, "out"),
+            ["upd_edge_half", src, etype, dst, rank, updates, "in"])
         return True
 
     # ---- read ----

@@ -8,22 +8,24 @@ part map doubles as the CHIP PLACEMENT map (partition → mesh slot) that
 the device plane pins from (SURVEY §2 row 17).
 
 State mutations ride a Raft group over the metad peers ("meta" group).
-Commands are pickled dicts (internal trusted channel between replicas of
-the same deployment).  Every non-deterministic input (host placement,
-timestamps) is resolved by the leader BEFORE propose and embedded in the
-command, so replica replay is deterministic.
+Commands, snapshots, and client-supplied DDL blobs are JSON wire
+payloads (graphstore/schema_wire.py) — never pickle: anything that can
+reach an RPC port could otherwise execute arbitrary code on unpickle.
+Every non-deterministic input (host placement, timestamps) is resolved
+by the leader BEFORE propose and embedded in the command, so replica
+replay is deterministic.
 
 Liveness (ActiveHostsMan) is deliberately NOT replicated: each metad
 tracks heartbeat arrival times in memory, like the reference.
 """
 from __future__ import annotations
 
-import base64
-import pickle
+import json
 import threading
 import time
 from typing import Any, Dict, List, Optional
 
+from ..graphstore import schema_wire
 from ..graphstore.schema import Catalog, SchemaError
 from .raft import RaftPart, RaftTransport
 from .rpc import RpcError, RpcServer
@@ -37,11 +39,12 @@ _CATALOG_METHODS = frozenset({
 
 
 def _pk(obj) -> str:
-    return base64.b64encode(pickle.dumps(obj)).decode()
+    """JSON-encode a schema/command payload for an RPC string field."""
+    return json.dumps(schema_wire.to_jso(obj), separators=(",", ":"))
 
 
 def _unpk(s: str):
-    return pickle.loads(base64.b64decode(s))
+    return schema_wire.from_jso(json.loads(s))
 
 
 class MetaState:
@@ -59,10 +62,10 @@ class MetaState:
         self.version = 0
 
     def snapshot(self) -> bytes:
-        return pickle.dumps(self.__dict__)
+        return schema_wire.dumps(dict(self.__dict__))
 
     def restore(self, data: bytes):
-        self.__dict__.update(pickle.loads(data))
+        self.__dict__.update(schema_wire.loads(data))
 
     def apply(self, cmd: Dict[str, Any]):
         op = cmd["op"]
@@ -154,7 +157,7 @@ class MetaService:
     # -- raft plumbing ----------------------------------------------------
 
     def _apply(self, idx: int, data: bytes):
-        cmd = pickle.loads(data)
+        cmd = schema_wire.loads(data)
         with self.state_lock:
             try:
                 self._apply_result[idx] = ("ok", self.state.apply(cmd))
@@ -181,7 +184,7 @@ class MetaService:
     def _propose(self, cmd: Dict[str, Any]):
         if not self.raft.is_leader():
             raise RpcError(f"not leader; leader={self.raft.leader_id or ''}")
-        idx = self.raft.propose(pickle.dumps(cmd))
+        idx = self.raft.propose(schema_wire.dumps(cmd))
         if idx is None:
             # lost leadership mid-propose — redirect like any follower
             raise RpcError(f"not leader; leader={self.raft.leader_id or ''}")
@@ -247,14 +250,15 @@ class MetaService:
                               "if_exists": p.get("if_exists", False)})
 
     def rpc_ddl(self, p):
-        """DDL: {"cmd64": pickled {"op":"catalog","method":...,args,kw}}."""
+        """DDL: {"cmd64": wire-JSON {"op":"catalog","method":...,args,kw}}."""
         cmd = _unpk(p["cmd64"])
-        if cmd.get("op") != "catalog" or \
+        if not isinstance(cmd, dict) or cmd.get("op") != "catalog" or \
                 cmd.get("method") not in _CATALOG_METHODS:
-            raise RpcError(f"bad ddl command {cmd.get('method')!r}")
+            raise RpcError(f"bad ddl command {cmd.get('method') if isinstance(cmd, dict) else cmd!r}")
         # pre-validate on the leader for a clean error before consensus
+        # (wire round-trip = deep copy of the catalog)
         with self.state_lock:
-            probe = pickle.loads(pickle.dumps(self.state.catalog))
+            probe = schema_wire.from_jso(schema_wire.to_jso(self.state.catalog))
         try:
             getattr(probe, cmd["method"])(*cmd.get("args", ()),
                                           **cmd.get("kw", {}))

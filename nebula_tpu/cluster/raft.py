@@ -137,6 +137,11 @@ class RaftPart:
 
         self.lock = threading.RLock()
         self.commit_cv = threading.Condition(self.lock)
+        self._repl_cv = threading.Condition(self.lock)
+        self._repl_threads: Dict[str, threading.Thread] = {}
+        self._last_ack: Dict[str, float] = {}   # peer → send time of the
+        #   last request that got a reply (lease freshness is measured
+        #   from SEND: the follower's no-vote promise starts no later)
         # serializes apply_cb across the three callers (run loop, propose,
         # append_entries handler) so entries apply in commit order and a
         # propose's result is recorded before propose returns
@@ -232,25 +237,42 @@ class RaftPart:
             term = self.current_term
             lli, llt = self._last_log()
             self._reset_election_deadline()
-        votes = 1
-        for p in self.peers:
+        # ask all peers concurrently: one unreachable peer (transport
+        # timeout ≫ election timeout) must not stall the votes of the
+        # healthy majority; leadership is taken as soon as a quorum grants
+        votes = [1]
+        votes_mu = threading.Lock()
+
+        def ask(p):
             r = self.transport.send(p, self.group, "request_vote", {
-                "_from": self.node_id, "term": term, "candidate": self.node_id,
+                "_from": self.node_id, "term": term,
+                "candidate": self.node_id,
                 "last_log_index": lli, "last_log_term": llt})
             if r is None:
-                continue
+                return
             with self.lock:
                 if r["term"] > self.current_term:
                     self._step_down(r["term"])
                     return
                 if self.state != CANDIDATE or self.current_term != term:
                     return
-            if r.get("granted"):
-                votes += 1
-        with self.lock:
-            if (self.state == CANDIDATE and self.current_term == term
-                    and votes * 2 > len(self.peers) + 1):
-                self._become_leader()
+                if r.get("granted"):
+                    with votes_mu:
+                        votes[0] += 1
+                        n = votes[0]
+                    if n * 2 > len(self.peers) + 1:
+                        self._become_leader()
+
+        threads = [threading.Thread(target=ask, args=(p,), daemon=True,
+                                    name=f"raft-vote-{self.node_id}")
+                   for p in self.peers]
+        for t in threads:
+            t.start()
+        # wait only as long as an election round is allowed to take;
+        # laggard replies are still tallied by their threads afterwards
+        deadline = time.monotonic() + self.eto[0]
+        for t in threads:
+            t.join(timeout=max(0.0, deadline - time.monotonic()))
 
     def _become_leader(self):
         self.state = LEADER
@@ -284,14 +306,39 @@ class RaftPart:
     # -- replication ------------------------------------------------------
 
     def _replicate_all(self):
+        """Kick the per-peer replicator threads.
+
+        A slow or dead peer (transport timeout ≫ heartbeat interval) must
+        never delay heartbeats to healthy followers — each peer has its
+        own persistent replicator thread (no per-tick thread churn), and
+        requests to a stuck peer can't stack up: the loop serializes
+        sends per peer.
+        """
         with self.lock:
             if self.state != LEADER:
                 return
             self._last_hb = time.monotonic()
-            peers = list(self.peers)
-        for p in peers:
-            self._replicate_one(p)
+            for p in self.peers:
+                t = self._repl_threads.get(p)
+                if t is None or not t.is_alive():
+                    t = threading.Thread(
+                        target=self._peer_loop, args=(p,), daemon=True,
+                        name=f"raft-repl-{self.node_id}-{p}")
+                    self._repl_threads[p] = t
+                    t.start()
+            self._repl_cv.notify_all()
         self._advance_commit()
+
+    def _peer_loop(self, peer: str):
+        """Persistent replicator for one follower; exits on step-down."""
+        while True:
+            with self.lock:
+                if not self.alive or self.state != LEADER:
+                    return
+            self._replicate_one(peer)
+            self._advance_commit()
+            with self._repl_cv:
+                self._repl_cv.wait(self.hb)
 
     def _replicate_one(self, peer: str):
         with self.lock:
@@ -310,6 +357,7 @@ class RaftPart:
             entries = [(i, t, _b64(d)) for (i, t, d)
                        in self.wal.read_range(nxt, nxt + 63)]
             commit = self.commit_index
+        t_send = time.monotonic()
         r = self.transport.send(peer, self.group, "append_entries", {
             "_from": self.node_id, "term": term, "leader": self.node_id,
             "prev_index": prev_idx, "prev_term": prev_term,
@@ -317,6 +365,7 @@ class RaftPart:
         if r is None:
             return
         with self.lock:
+            self._last_ack[peer] = t_send
             if r["term"] > self.current_term:
                 self._step_down(r["term"])
                 return
@@ -402,6 +451,25 @@ class RaftPart:
     def is_leader(self) -> bool:
         with self.lock:
             return self.alive and self.state == LEADER
+
+    def has_lease(self) -> bool:
+        """Heartbeat-majority leader lease for linearizable-ish reads.
+
+        A deposed leader on the minority side of a partition keeps
+        believing it leads until it learns the higher term; serving reads
+        only while a majority acked within the minimum election timeout
+        bounds that stale window: no new leader can have been elected
+        during an interval in which this leader held a quorum's
+        heartbeat acks."""
+        with self.lock:
+            if not (self.alive and self.state == LEADER):
+                return False
+            if not self.peers:
+                return True
+            horizon = time.monotonic() - self.eto[0]
+            acked = sum(1 for p in self.peers
+                        if self._last_ack.get(p, 0.0) >= horizon)
+            return (acked + 1) * 2 > len(self.peers) + 1
 
     def propose(self, data: bytes, timeout: float = 5.0) -> Optional[int]:
         """Append + replicate + wait for commit.  Returns the entry's log

@@ -14,11 +14,31 @@ command stream of a part replays deterministically on its replicas.
 from __future__ import annotations
 
 import os
-import pickle
 import threading
 from typing import Any, Dict, List, Optional, Tuple
 
+from ..core import wire
 from ..core.wire import from_wire, to_wire
+
+_STORAGE_OPS = frozenset({
+    "vertex", "edge_half", "del_vertex", "del_edge_half", "upd_vertex",
+    "upd_edge_half", "del_tag", "rebuild_index", "chain_mark",
+    "chain_done", "batch"})
+
+
+def _validate_cmd(cmd) -> tuple:
+    """Decode-check a client write command BEFORE it reaches consensus —
+    a malformed entry must be rejected at the RPC boundary, never
+    committed where replay would poison every replica's apply loop."""
+    decoded = tuple(from_wire(cmd))
+    if not decoded or decoded[0] not in _STORAGE_OPS:
+        raise RpcError(f"unknown storage op {decoded[0] if decoded else None!r}")
+    if decoded[0] == "batch":
+        for sub in decoded[1]:
+            sub = tuple(sub)
+            if not sub or sub[0] not in _STORAGE_OPS or sub[0] == "batch":
+                raise RpcError(f"bad batch sub-op {sub[:1]!r}")
+    return decoded
 from ..graphstore.store import GraphStore
 from .meta_client import MetaClient
 from .raft import RaftPart
@@ -34,6 +54,8 @@ class StorageService:
         self.store = GraphStore(catalog=meta.catalog)
         self.parts: Dict[Tuple[int, int], RaftPart] = {}   # (space_id, pid)
         self.parts_lock = threading.RLock()
+        self._resume_alive = False
+        self._resume_thread: Optional[threading.Thread] = None
         self.transport = RpcRaftTransport()
         self.server = server
         server.register_service(self, prefix="storage.")
@@ -116,14 +138,28 @@ class StorageService:
 
     def _make_apply(self, space_name: str):
         def apply(idx: int, data: bytes):
-            cmd = pickle.loads(data)
-            self._apply_cmd(space_name, cmd)
+            # entries are wire-JSON (peers can inject raft traffic; an
+            # unpickler here would be remote code execution).  A bad
+            # entry is skipped, never allowed to kill the raft thread:
+            # it would re-crash on every restart replay otherwise.
+            try:
+                cmd = tuple(wire.loads(data))
+                self._apply_cmd(space_name, cmd)
+            except Exception:            # noqa: BLE001
+                from ..utils.stats import stats
+                stats().inc("storage_apply_errors")
         return apply
 
     def _apply_cmd(self, space: str, cmd: Tuple):
         op = cmd[0]
         st = self.store
-        if op == "vertex":
+        if op == "batch":
+            # one raft entry, several ops: TOSS chain_mark + out-half
+            # must commit atomically or the journal could promise an
+            # in-half whose out-half never landed
+            for sub in cmd[1]:
+                self._apply_cmd(space, tuple(sub))
+        elif op == "vertex":
             _, vid, tag, ver, row = cmd
             st.apply_vertex(space, vid, tag, ver, row)
         elif op == "edge_half":
@@ -145,21 +181,76 @@ class StorageService:
             st.delete_tag(space, cmd[1], cmd[2])
         elif op == "rebuild_index":
             st.rebuild_index(space, cmd[1], parts=[cmd[2]])
+        elif op == "chain_mark":
+            _, pid, cid, in_pid, in_cmd, ts = cmd
+            st.apply_chain_mark(space, pid, cid,
+                                {"part": in_pid, "cmd": list(in_cmd),
+                                 "ts": ts})
+        elif op == "chain_done":
+            st.apply_chain_done(space, cmd[1], cmd[2])
         else:
             raise ValueError(f"unknown storage op {op!r}")
 
     def start(self):
         self.meta.start_heartbeat(parts_fn=self.owned_parts)
+        self._resume_alive = True
+        self._resume_thread = threading.Thread(
+            target=self._chain_resume_loop, daemon=True,
+            name=f"toss-resume-{self.my_addr}")
+        self._resume_thread.start()
 
     def stop(self):
+        self._resume_alive = False
         self.meta.stop_heartbeat()
         with self.parts_lock:
             for p in self.parts.values():
                 p.stop()
 
+    # -- TOSS chain resume (SURVEY §2 row 14) ----------------------------
+
+    CHAIN_GRACE_S = 2.0      # graphd normally finishes the chain itself
+
+    def _chain_resume_loop(self):
+        import time as _t
+        while self._resume_alive:
+            _t.sleep(0.5)
+            try:
+                self._resume_chains()
+            except Exception:    # noqa: BLE001 — keep the janitor alive
+                pass
+
+    def _resume_chains(self):
+        """Finish TOSS chains whose graphd died between the two halves:
+        the out-half part leader re-drives the recorded in-half to the
+        dst part, then retires the journal entry through its own log."""
+        import time as _t
+        from .storage_client import StorageClient
+        with self.parts_lock:
+            items = list(self.parts.items())
+        now = _t.time()
+        sc = None
+        for (sid, pid), part in items:
+            if not part.is_leader():
+                continue
+            space = next((n for n, sp in self.meta.catalog.spaces.items()
+                          if sp.space_id == sid), None)
+            if space is None:
+                continue
+            for cid, entry in self.store.pending_chains(space, pid).items():
+                if now - entry.get("ts", 0.0) < self.CHAIN_GRACE_S:
+                    continue
+                if sc is None:
+                    sc = StorageClient(self.meta)
+                # in-half apply is idempotent (same row overwrite), so
+                # re-driving a chain the graphd actually finished is safe
+                sc._call_part(space, entry["part"], "storage.write",
+                              {"cmds": [to_wire(list(entry["cmd"]))]})
+                part.propose(wire.dumps(("chain_done", pid, cid)))
+
     # -- helpers ----------------------------------------------------------
 
-    def _leader_part(self, space: str, pid: int) -> RaftPart:
+    def _leader_part(self, space: str, pid: int,
+                     lease: bool = True) -> RaftPart:
         sp = self.meta.catalog.spaces.get(space)
         if sp is None:
             self.meta.refresh(force=True)
@@ -174,16 +265,24 @@ class StorageService:
             raise RpcError(f"part {pid} of `{space}' not hosted here")
         if not part.is_leader():
             raise RpcError(f"part_leader_changed: {part.leader_id or ''}")
+        if lease and not part.has_lease():
+            # deposed-but-unaware leader (minority side of a partition)
+            # must not serve stale reads; client retries elsewhere
+            # (writes skip this: propose itself fails safely without quorum)
+            raise RpcError(f"part_leader_changed: {part.leader_id or ''}")
         return part
 
     # -- write RPCs: {"space", "part", "cmds": [wire-encoded tuples]} -----
 
     def rpc_write(self, p):
         space, pid = p["space"], p["part"]
-        part = self._leader_part(space, pid)
+        part = self._leader_part(space, pid, lease=False)
         for cmd in p["cmds"]:
-            data = pickle.dumps(tuple(from_wire(cmd)))
-            if part.propose(data) is None:
+            # cmds arrive wire-encoded; decode-validate BEFORE propose
+            # (a malformed command must fail here, not poison the log),
+            # then the raft entry stores the canonical wire form
+            decoded = _validate_cmd(cmd)
+            if part.propose(wire.dumps(decoded)) is None:
                 raise RpcError("part_leader_changed: write not committed")
         return len(p["cmds"])
 
@@ -254,7 +353,7 @@ class StorageService:
         # rebuild rides the part's raft log so replicas backfill too —
         # followers must serve identical index state after failover
         part = self._leader_part(p["space"], p["part"])
-        data = pickle.dumps(("rebuild_index", p["index"], p["part"]))
+        data = wire.dumps(("rebuild_index", p["index"], p["part"]))
         if part.propose(data) is None:
             raise RpcError("part_leader_changed: rebuild not committed")
         sd = self.store.space(p["space"])
@@ -279,7 +378,6 @@ class StorageService:
 
 
 def _pk_part(part, sd):
-    import base64
     payload = {
         "part_id": part.part_id,
         "vertices": part.vertices,
@@ -289,4 +387,4 @@ def _pk_part(part, sd):
         "vid_to_dense": {v: d for v, d in sd.vid_to_dense.items()
                          if d % sd.num_parts == part.part_id},
     }
-    return base64.b64encode(pickle.dumps(payload)).decode()
+    return to_wire(payload)

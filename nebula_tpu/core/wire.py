@@ -12,6 +12,7 @@ keys include "@t" round-trip safely).
 """
 from __future__ import annotations
 
+import json
 from typing import Any
 
 from .value import (DataSet, Date, DateTime, Duration, Edge, EmptyValue,
@@ -61,7 +62,12 @@ def to_wire(v: Any) -> Any:
     if isinstance(v, set):
         return {"@t": "set", "v": [to_wire(x) for x in sorted(v, key=repr)]}
     if isinstance(v, dict):
-        return {"@t": "map", "v": {k: to_wire(x) for k, x in v.items()}}
+        if all(isinstance(k, str) for k in v):
+            return {"@t": "map", "v": {k: to_wire(x) for k, x in v.items()}}
+        # non-string keys (int vids, (rank,dst) tuples): JSON objects
+        # would silently coerce them to strings, so ship pairs instead
+        return {"@t": "kvmap",
+                "v": [[to_wire(k), to_wire(x)] for k, x in v.items()]}
     raise TypeError(f"not wire-serializable: {type(v).__name__}")
 
 
@@ -110,4 +116,21 @@ def from_wire(j: Any) -> Any:
         return {from_wire(x) for x in j["v"]}
     if t == "map":
         return {k: from_wire(x) for k, x in j["v"].items()}
+    if t == "kvmap":
+        out = {}
+        for kj, xj in j["v"]:
+            k = from_wire(kj)
+            if isinstance(k, list):      # tuple keys decode as lists
+                k = tuple(k)
+            out[k] = from_wire(xj)
+        return out
     raise TypeError(f"unknown wire tag {t!r}")
+
+
+def dumps(v: Any) -> bytes:
+    """Wire-encode + JSON-serialize (raft entries, snapshots, files)."""
+    return json.dumps(to_wire(v), separators=(",", ":")).encode()
+
+
+def loads(data: bytes) -> Any:
+    return from_wire(json.loads(data.decode()))

@@ -55,7 +55,8 @@ def stable_vid_hash(vid: Any) -> int:
 class Partition:
     """One shard: vertices + out/in adjacency, dict-backed."""
 
-    __slots__ = ("part_id", "vertices", "out_edges", "in_edges")
+    __slots__ = ("part_id", "vertices", "out_edges", "in_edges",
+                 "pending_chains")
 
     def __init__(self, part_id: int):
         self.part_id = part_id
@@ -65,6 +66,10 @@ class Partition:
         self.out_edges: Dict[Any, Dict[str, Dict[Tuple[int, Any], Dict[str, Any]]]] = {}
         # dst_vid → {etype_name: {(rank, src): {prop: value}}}
         self.in_edges: Dict[Any, Dict[str, Dict[Tuple[int, Any], Dict[str, Any]]]] = {}
+        # TOSS resume journal: chain_id → {"cmd": [in-half cmd], "ts": t}
+        # (the out-half part remembers the in-half it owes the dst part
+        # until the chain is confirmed — SURVEY §2 row 14)
+        self.pending_chains: Dict[str, Dict[str, Any]] = {}
 
     def edge_count(self) -> int:
         return sum(len(m) for per in self.out_edges.values() for m in per.values())
@@ -471,35 +476,58 @@ class GraphStore:
             sd.epoch += 1
             return True
 
+    def apply_chain_mark(self, space: str, pid: int, chain_id: str,
+                         entry: Dict[str, Any]):
+        """Record the in-half a TOSS chain still owes (replicated with
+        the out-half's part so a graphd crash between the two halves is
+        recoverable by the part leader's resume loop).  entry:
+        {"part": dst_pid, "cmd": [in-half cmd], "ts": float}."""
+        sd = self.space(space)
+        with sd.lock:
+            sd.parts[pid].pending_chains[chain_id] = dict(entry)
+
+    def apply_chain_done(self, space: str, pid: int, chain_id: str):
+        sd = self.space(space)
+        with sd.lock:
+            sd.parts[pid].pending_chains.pop(chain_id, None)
+
+    def pending_chains(self, space: str, pid: int) -> Dict[str, Dict[str, Any]]:
+        sd = self.space(space)
+        with sd.lock:
+            return dict(sd.parts[pid].pending_chains)
+
     # ---- part state snapshot (raft snapshot + checkpoint payload) ----
 
     def export_part_state(self, space: str, pid: int) -> bytes:
         """Serialize one partition's full state (raft snapshot_cb /
         checkpoint file payload).  Includes the part's slice of the
         dense-id dictionary so replay-free restore keeps device ids
-        stable."""
-        import pickle
+        stable.  Wire-JSON encoded: the payload crosses RPC as a raft
+        snapshot, so it must never be pickle."""
+        from ..core import wire
         sd = self.space(space)
         with sd.lock:
             p = sd.parts[pid]
-            return pickle.dumps({
+            return wire.dumps({
                 "vertices": p.vertices,
                 "out_edges": p.out_edges,
                 "in_edges": p.in_edges,
                 "part_count": sd.part_counts[pid],
                 "dense": {v: d for v, d in sd.vid_to_dense.items()
                           if d % sd.num_parts == pid},
+                "chains": p.pending_chains,
             })
 
     def install_part_state(self, space: str, pid: int, data: bytes):
-        import pickle
-        st = pickle.loads(data)
+        from ..core import wire
+        st = wire.loads(data)
         sd = self.space(space)
         with sd.lock:
             p = sd.parts[pid]
             p.vertices = st["vertices"]
             p.out_edges = st["out_edges"]
             p.in_edges = st["in_edges"]
+            p.pending_chains = st.get("chains", {})
             sd.part_counts[pid] = st["part_count"]
             for v, d in st["dense"].items():
                 sd.vid_to_dense[v] = d
@@ -522,12 +550,13 @@ class GraphStore:
         restorable)."""
         import json
         import os
-        import pickle
+
+        from . import schema_wire
         os.makedirs(dirpath, exist_ok=True)
         names = spaces if spaces is not None else sorted(self.catalog.spaces)
         manifest: Dict[str, Any] = {"spaces": {}}
         with open(os.path.join(dirpath, "catalog.bin"), "wb") as f:
-            f.write(pickle.dumps(self.catalog))
+            f.write(schema_wire.dumps(self.catalog))
         for name in names:
             sd = self.space(name)
             spdir = os.path.join(dirpath, f"space_{sd.desc.space_id}")
@@ -550,9 +579,10 @@ class GraphStore:
     def from_checkpoint(cls, dirpath: str) -> "GraphStore":
         import json
         import os
-        import pickle
+
+        from . import schema_wire
         with open(os.path.join(dirpath, "catalog.bin"), "rb") as f:
-            catalog = pickle.loads(f.read())
+            catalog = schema_wire.loads(f.read())
         store = cls(catalog=catalog)
         with open(os.path.join(dirpath, "manifest.json")) as f:
             manifest = json.load(f)

@@ -38,7 +38,8 @@ def _pow2(n: int) -> int:
 
 class TraverseStats:
     __slots__ = ("hop_edges", "result_edges", "f_cap", "e_cap",
-                 "retries", "device_s", "steps")
+                 "retries", "device_s", "steps",
+                 "pin_s", "put_s", "fetch_s", "mat_s", "total_s")
 
     def __init__(self):
         self.hop_edges: List[int] = []
@@ -48,6 +49,12 @@ class TraverseStats:
         self.retries = 0
         self.device_s = 0.0
         self.steps = 0
+        # per-phase wall time (PROFILE device-plane fields)
+        self.pin_s = 0.0
+        self.put_s = 0.0
+        self.fetch_s = 0.0
+        self.mat_s = 0.0
+        self.total_s = 0.0
 
     def edges_traversed(self) -> int:
         return int(sum(self.hop_edges))
@@ -149,14 +156,18 @@ class TpuRuntime:
             fn = self._fns.get(key)
             if fn is None:
                 fn = self._fns[key] = build_fn(F, EB)
+            tp = time.perf_counter()
             frontier = jax.device_put(fr_np, target)
             t0 = time.perf_counter()
+            stats.put_s = t0 - tp
             res = fn(*inputs_fn(F, EB), frontier)
             jax.block_until_ready(res)
-            stats.device_s = time.perf_counter() - t0
+            t1 = time.perf_counter()
+            stats.device_s = t1 - t0
             # one batched transfer (the axon tunnel charges ~15ms per
             # fetch RPC; per-leaf np.asarray would pay it repeatedly)
             res = jax.device_get(res)
+            stats.fetch_s = time.perf_counter() - t1
 
             esc = False
             if res["ovf_expand"].any():
@@ -188,10 +199,12 @@ class TpuRuntime:
         final-hop edge passing the predicate.  Raises CannotCompile if the
         filter does not vectorize (caller falls back to the host path).
         """
+        t_start = time.perf_counter()
         dev = self.pin(store, space)
         sd = store.space(space)
         stats = TraverseStats()
         stats.steps = steps
+        stats.pin_s = time.perf_counter() - t_start
 
         block_keys = self._blocks_for(dev, etypes, direction)
         pred = None
@@ -235,10 +248,14 @@ class TpuRuntime:
             inputs_fn=lambda F, EB: (blocks_data,),
             stats=stats)
         if not capture:
+            stats.total_s = time.perf_counter() - t_start
             return [], stats
 
+        t_mat = time.perf_counter()
         rows = self._materialize(store, space, dev, block_keys, res["cap"])
+        stats.mat_s = time.perf_counter() - t_mat
         stats.result_edges = len(rows)
+        stats.total_s = time.perf_counter() - t_start
         return rows, stats
 
     # -- BFS (FIND SHORTEST PATH device plane) ---------------------------

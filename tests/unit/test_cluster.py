@@ -96,3 +96,92 @@ def test_cluster_second_client_shares_state(cluster):
     rs = c2.execute("GO FROM 2 OVER KNOWS YIELD dst(edge)")
     assert rs.data.rows == [[3]]
     c2.close()
+
+
+def test_toss_chain_resume(cluster, conn):
+    """A graphd that dies between the two TOSS halves leaves a journal
+    entry on the out-half part; the part leader's resume loop re-drives
+    the in-half so the reverse plane converges."""
+    import time
+
+    from nebula_tpu.core.wire import to_wire
+
+    # simulate the orphaned chain: propose chain_mark + out-half to the
+    # src part directly (what dstore does first), then DON'T send the
+    # in-half or the chain_done — exactly the crash window.
+    from nebula_tpu.cluster.storage_client import StorageClient
+    sc = StorageClient(cluster.meta_clients[0])
+    row = {"w": 99}
+    src, dst = 2, 4
+    src_pid = sc.part_of("cs", src)
+    dst_pid = sc.part_of("cs", dst)
+    cmd = ("batch", [
+        ["chain_mark", src_pid, "orphan-1", dst_pid,
+         ["edge_half", src, "KNOWS", dst, 0, row, "in"], time.time() - 10],
+        ["edge_half", src, "KNOWS", dst, 0, row, "out"],
+    ])
+    sc._call_part("cs", src_pid, "storage.write",
+                  {"cmds": [to_wire(list(cmd))]})
+
+    # out-plane sees the edge immediately; in-plane only after resume
+    rs = conn("GO FROM 2 OVER KNOWS YIELD dst(edge), KNOWS.w")
+    assert [4, 99] in rs.data.rows
+
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        rs = conn("GO FROM 4 OVER KNOWS REVERSELY YIELD src(edge), KNOWS.w")
+        if [2, 99] in rs.data.rows:
+            break
+        time.sleep(0.3)
+    assert [2, 99] in rs.data.rows, "resume loop never drove the in-half"
+
+    # journal entry retired on every replica of the src part
+    def journals():
+        out = []
+        for ss in cluster.storageds:
+            sid = ss.meta.catalog.get_space("cs").space_id
+            if (sid, src_pid) in ss.parts:
+                out.append(ss.store.pending_chains("cs", src_pid))
+        return out
+
+    deadline = time.time() + 8
+    while time.time() < deadline and \
+            any("orphan-1" in d for d in journals()):
+        time.sleep(0.2)
+    assert all("orphan-1" not in d for d in journals()), journals()
+
+
+def test_leader_lease_blocks_minority_reads(tmp_path):
+    """A deposed leader that lost quorum contact must refuse reads."""
+    import time
+
+    from nebula_tpu.cluster.raft import LoopbackTransport, RaftPart
+
+    tr = LoopbackTransport()
+    nodes = {}
+    for nid in ("a", "b", "c"):
+        nodes[nid] = RaftPart("lease", nid, ["a", "b", "c"], tr,
+                              str(tmp_path / nid), apply_cb=lambda i, d: None,
+                              wal_sync=False)
+    for n in nodes.values():
+        n.start()
+    deadline = time.time() + 5
+    leader = None
+    while time.time() < deadline and leader is None:
+        leader = next((n for n in nodes.values() if n.is_leader()), None)
+        time.sleep(0.05)
+    assert leader is not None
+    # settled leader with quorum heartbeats → lease held
+    time.sleep(0.3)
+    assert leader.has_lease()
+    # cut the leader off from both followers: lease must lapse even
+    # while it still believes it is leader
+    others = [n for n in nodes.values() if n is not leader]
+    tr.partition(leader.node_id, others[0].node_id)
+    tr.partition(leader.node_id, others[1].node_id)
+    deadline = time.time() + 5
+    while time.time() < deadline and leader.has_lease():
+        time.sleep(0.05)
+    assert not leader.has_lease()
+    for n in nodes.values():
+        n.stop()
