@@ -263,16 +263,13 @@ class RaftPart:
                     if n * 2 > len(self.peers) + 1:
                         self._become_leader()
 
-        threads = [threading.Thread(target=ask, args=(p,), daemon=True,
-                                    name=f"raft-vote-{self.node_id}")
-                   for p in self.peers]
-        for t in threads:
-            t.start()
-        # wait only as long as an election round is allowed to take;
-        # laggard replies are still tallied by their threads afterwards
-        deadline = time.monotonic() + self.eto[0]
-        for t in threads:
-            t.join(timeout=max(0.0, deadline - time.monotonic()))
+        # fire-and-forget: the ask threads tally votes and take
+        # leadership themselves on quorum; joining here would stall the
+        # run loop (and the new leader's first heartbeats) behind the
+        # slowest/deadest peer's transport timeout
+        for p in self.peers:
+            threading.Thread(target=ask, args=(p,), daemon=True,
+                             name=f"raft-vote-{self.node_id}").start()
 
     def _become_leader(self):
         self.state = LEADER
@@ -338,6 +335,13 @@ class RaftPart:
             self._replicate_one(peer)
             self._advance_commit()
             with self._repl_cv:
+                # a propose() notify that landed while we were mid-send
+                # must not cost a full heartbeat of commit latency: skip
+                # the wait whenever unreplicated entries are pending
+                if self.alive and self.state == LEADER and \
+                        self.next_index.get(peer, 1 << 62) <= \
+                        self.wal.last_index():
+                    continue
                 self._repl_cv.wait(self.hb)
 
     def _replicate_one(self, peer: str):

@@ -19,6 +19,10 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from ..core import wire
 from ..core.wire import from_wire, to_wire
+from ..graphstore.store import GraphStore
+from .meta_client import MetaClient
+from .raft import RaftPart
+from .rpc import RpcError, RpcRaftTransport, RpcServer
 
 _STORAGE_OPS = frozenset({
     "vertex", "edge_half", "del_vertex", "del_edge_half", "upd_vertex",
@@ -39,10 +43,6 @@ def _validate_cmd(cmd) -> tuple:
             if not sub or sub[0] not in _STORAGE_OPS or sub[0] == "batch":
                 raise RpcError(f"bad batch sub-op {sub[:1]!r}")
     return decoded
-from ..graphstore.store import GraphStore
-from .meta_client import MetaClient
-from .raft import RaftPart
-from .rpc import RpcError, RpcRaftTransport, RpcServer
 
 
 class StorageService:
@@ -56,6 +56,10 @@ class StorageService:
         self.parts_lock = threading.RLock()
         self._resume_alive = False
         self._resume_thread: Optional[threading.Thread] = None
+        # (group, idx) → error string for entries whose apply failed;
+        # checked by rpc_write so a client is never acked for a write
+        # that did not actually land
+        self._apply_errors: Dict[Tuple[str, int], str] = {}
         self.transport = RpcRaftTransport()
         self.server = server
         server.register_service(self, prefix="storage.")
@@ -116,7 +120,7 @@ class StorageService:
                     part = RaftPart(
                         gname, self.my_addr, list(replicas), self.transport,
                         os.path.join(self.data_dir, "wal"),
-                        apply_cb=self._make_apply(space_name),
+                        apply_cb=self._make_apply(space_name, gname),
                         # part state IS the raft snapshot: bounds WAL
                         # replay on restart + serves laggard catch-up
                         snapshot_cb=self._make_snapshot(space_name, pid),
@@ -136,18 +140,25 @@ class StorageService:
                 self.store.install_part_state(space_name, pid, data)
         return restore
 
-    def _make_apply(self, space_name: str):
+    def _make_apply(self, space_name: str, group: str):
         def apply(idx: int, data: bytes):
             # entries are wire-JSON (peers can inject raft traffic; an
             # unpickler here would be remote code execution).  A bad
-            # entry is skipped, never allowed to kill the raft thread:
-            # it would re-crash on every restart replay otherwise.
+            # entry must never kill the raft thread (it would re-crash
+            # on every restart replay); the failure is recorded so the
+            # leader's rpc_write can refuse to ack it.  Commands are
+            # deterministic, so replicas fail identically — no
+            # divergence from skipping.
             try:
                 cmd = tuple(wire.loads(data))
                 self._apply_cmd(space_name, cmd)
-            except Exception:            # noqa: BLE001
+            except Exception as ex:      # noqa: BLE001
                 from ..utils.stats import stats
                 stats().inc("storage_apply_errors")
+                self._apply_errors[(group, idx)] = str(ex)
+                if len(self._apply_errors) > 4096:
+                    for k in sorted(self._apply_errors)[:2048]:
+                        self._apply_errors.pop(k, None)
         return apply
 
     def _apply_cmd(self, space: str, cmd: Tuple):
@@ -282,8 +293,12 @@ class StorageService:
             # (a malformed command must fail here, not poison the log),
             # then the raft entry stores the canonical wire form
             decoded = _validate_cmd(cmd)
-            if part.propose(wire.dumps(decoded)) is None:
+            idx = part.propose(wire.dumps(decoded))
+            if idx is None:
                 raise RpcError("part_leader_changed: write not committed")
+            err = self._apply_errors.pop((part.group, idx), None)
+            if err is not None:
+                raise RpcError(f"write apply failed: {err}")
         return len(p["cmds"])
 
     # -- read RPCs (leader reads) ----------------------------------------

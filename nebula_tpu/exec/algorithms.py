@@ -156,6 +156,8 @@ def find_path_host(node, qctx: QueryContext, ectx: ExecutionContext) -> DataSet:
                         rows.append([path_of(vc, ec)])
     else:
         noloop = kind == "noloop"
+        tracker = getattr(ectx, "tracker", None)
+        pending = 0
         for s in srcs:
             stack: List[Tuple[Any, List[Any], List[Edge], Set]] = [
                 (s, [s], [], set())]
@@ -175,6 +177,14 @@ def find_path_host(node, qctx: QueryContext, ectx: ExecutionContext) -> DataSet:
                     if hashable_key(w) in dst_set:
                         rows.append([path_of(nvc, nec)])
                     stack.append((w, nvc, nec, eseen | {ek}))
+                    # ALL PATHS is the worst allocator in the engine:
+                    # charge the search state as it grows, not after
+                    pending += 96 * (len(nvc) + len(eseen))
+                    if tracker is not None and pending > (1 << 20):
+                        tracker.charge(pending)
+                        pending = 0
+        if tracker is not None and pending:
+            tracker.charge(pending)
     sort_path_rows(rows)
     return DataSet([col], rows)
 

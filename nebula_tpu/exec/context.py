@@ -69,13 +69,24 @@ class QueryContext:
 
 
 class ExecutionContext:
-    """var name → list of DataSet versions (latest last)."""
+    """var name → list of DataSet versions (latest last).
 
-    def __init__(self):
+    Carries the query's MemoryTracker: every stored result charges the
+    budget, and exploding executors (variable-length Traverse, path
+    search) charge mid-loop so they die before allocating, not after.
+    """
+
+    def __init__(self, tracker=None):
         self.results: Dict[str, List[DataSet]] = {}
         self.values: Dict[str, Any] = {}
+        if tracker is None:
+            from ..utils.memtracker import MemoryTracker
+            tracker = MemoryTracker()
+        self.tracker = tracker
 
     def set_result(self, var: str, ds: DataSet):
+        if self.tracker is not None and ds is not None:
+            self.tracker.charge_rows(ds.rows)
         self.results.setdefault(var, []).append(ds)
 
     def get_result(self, var: str) -> DataSet:

@@ -174,6 +174,9 @@ def _scan_vertices(node, qctx, ectx, space):
         if v is not None:
             rows.append([v])
     rows.sort(key=lambda r: total_order_key(r[0].vid))
+    lim = a.get("limit")
+    if lim is not None:
+        rows = rows[:lim]       # bound planted by push_limit_down_scan
     return DataSet([col], rows)
 
 
@@ -261,6 +264,9 @@ def _index_scan(node, qctx, ectx, space):
                     continue
             rows.append([v])
         rows.sort(key=lambda r: total_order_key(r[0].vid))
+    lim = a.get("limit")
+    if lim is not None:
+        rows = rows[:lim]       # planted by push_limit_down_index_scan
     return DataSet([node.col_names[0]], rows)
 
 
@@ -300,6 +306,9 @@ def _index_scan_indexed(node, qctx, sp, schema, filt, a):
                     continue
             rows.append([v])
         rows.sort(key=lambda r: total_order_key(r[0].vid))
+    lim = a.get("limit")
+    if lim is not None:
+        rows = rows[:lim]       # planted by push_limit_down_index_scan
     return DataSet([node.col_names[0]], rows)
 
 
@@ -331,6 +340,12 @@ def _traverse(node, qctx, ectx, space):
                         extra_vars={filter_alias: e, "__edge__": e})
         return to_bool3(edge_filter.eval(rc)) is True
 
+    # variable-length expansion explodes (path lists + per-path edge
+    # sets); charge the memory tracker mid-loop so a runaway MATCH is
+    # killed before it OOMs the process (SURVEY §2 row 5)
+    tracker = getattr(ectx, "tracker", None)
+    pending = 0
+
     for r in ds.rows:
         sv = r[ci]
         svid = sv.vid if isinstance(sv, Vertex) else sv
@@ -358,8 +373,15 @@ def _traverse(node, qctx, ectx, space):
                     ev = npath if var_len else npath[0]
                     rows.append(list(r) + [list(ev) if var_len else ev,
                                            Vertex(other)])
+                    pending += 128 + 96 * len(npath)
                 if len(npath) < max_hop:
                     stack.append((other, npath, eseen | {ek}))
+                    pending += 96 * (len(npath) + len(eseen))
+                if tracker is not None and pending > (1 << 20):
+                    tracker.charge(pending)
+                    pending = 0
+    if tracker is not None and pending:
+        tracker.charge(pending)
     return DataSet(out_cols, rows)
 
 

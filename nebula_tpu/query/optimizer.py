@@ -267,3 +267,252 @@ def dedup_before_expand(node: PlanNode) -> Optional[PlanNode]:
     if d.kind == "Dedup":
         node.args["dedup_input"] = True
     return None
+
+
+def _col_refs(e: Expr) -> Optional[set]:
+    """Column names a predicate reads, or None if it touches anything
+    that is not a plain column reference (then it can't be re-homed)."""
+    names = set()
+    for x in walk(e):
+        if x.kind in ("input_prop", "var"):
+            names.add(x.name)
+        elif x.kind == "label":
+            names.add(x.name)
+        elif x.kind == "var_prop":
+            names.add(x.var)
+        elif x.kind == "label_tag_prop":
+            names.add(x.var)
+        elif x.kind in ("src_prop", "edge_prop", "dst_prop", "vertex",
+                        "edge"):
+            return None
+    return names
+
+
+@register_rule
+def merge_adjacent_filters(node: PlanNode) -> Optional[PlanNode]:
+    """Filter(Filter(x)) → Filter(x) with the conjunction (reference:
+    CombineFilterRule)."""
+    if node.kind != "Filter" or not node.deps or node.dep().kind != "Filter":
+        return None
+    inner = node.dep()
+    a, b = node.args.get("condition"), inner.args.get("condition")
+    if a is None or b is None:
+        return None
+    if node.args.get("match_row") != inner.args.get("match_row"):
+        return None
+    node.args["condition"] = join_conjuncts([b, a])
+    node.deps = list(inner.deps)
+    node.input_vars = [d.output_var for d in node.deps]
+    return node
+
+
+@register_rule
+def eliminate_true_filter(node: PlanNode) -> Optional[PlanNode]:
+    """Filter(cond=true) → child (reference: the constant-fold/remove
+    family)."""
+    if node.kind != "Filter" or not node.deps:
+        return None
+    cond = node.args.get("condition")
+    if cond is not None and cond.kind == "literal" and cond.value is True:
+        return node.dep()
+    return None
+
+
+@register_rule
+def merge_adjacent_limits(node: PlanNode) -> Optional[PlanNode]:
+    """Limit(Limit(x)) → one Limit (reference: MergeGetNbrsAndDedupRule
+    sibling cleanups).  rows[o2:o2+c2][o1:o1+c1] = rows[o1+o2 : ...]."""
+    if node.kind != "Limit" or not node.deps or node.dep().kind != "Limit":
+        return None
+    inner = node.dep()
+    o1, c1 = node.args.get("offset", 0) or 0, node.args.get("count", -1)
+    o2, c2 = inner.args.get("offset", 0) or 0, inner.args.get("count", -1)
+    if c2 is None or c2 < 0:
+        cnt = c1
+    else:
+        avail = max(0, c2 - o1)
+        cnt = avail if c1 is None or c1 < 0 else min(c1, avail)
+    node.args["offset"] = o1 + o2
+    node.args["count"] = cnt
+    node.deps = list(inner.deps)
+    node.input_vars = [d.output_var for d in node.deps]
+    return node
+
+
+@register_rule
+def collapse_dedup(node: PlanNode) -> Optional[PlanNode]:
+    """Dedup(Dedup(x)) → Dedup(x)."""
+    if node.kind != "Dedup" or not node.deps or node.dep().kind != "Dedup":
+        return None
+    return node.dep()
+
+
+@register_rule
+def push_filter_through_dedup(node: PlanNode) -> Optional[PlanNode]:
+    """Filter(Dedup(x)) → Dedup(Filter(x)) — row-wise filters commute
+    with dedup, and filtering first shrinks the dedup set (reference:
+    PushFilterDownNode family)."""
+    if node.kind != "Filter" or not node.deps or node.dep().kind != "Dedup":
+        return None
+    dd = node.dep()
+    if len(dd.deps) != 1:
+        return None
+    node.deps = list(dd.deps)
+    node.input_vars = [d.output_var for d in node.deps]
+    dd.deps = [node]
+    dd.input_vars = [node.output_var]
+    return dd
+
+
+@register_rule
+def push_limit_down_project(node: PlanNode) -> Optional[PlanNode]:
+    """Limit(Project(x)) → Project(Limit(x)) — Project is 1:1, so limit
+    first and evaluate fewer rows (reference: PushLimitDownProjectRule)."""
+    if node.kind != "Limit" or not node.deps or node.dep().kind != "Project":
+        return None
+    pj = node.dep()
+    if len(pj.deps) != 1:
+        return None
+    # constant-YIELD projects synthesize one row from column-less empty
+    # input; moving the limit below them would bypass it (LIMIT 0 bug)
+    if not pj.dep(0).col_names:
+        return None
+    cnt = node.args.get("count", -1)
+    if cnt == 0:
+        return None
+    node.deps = list(pj.deps)
+    node.input_vars = [d.output_var for d in node.deps]
+    node.col_names = list(pj.dep(0).col_names) if pj.deps else node.col_names
+    pj.deps = [node]
+    pj.input_vars = [node.output_var]
+    return pj
+
+
+@register_rule
+def push_limit_down_scan(node: PlanNode) -> Optional[PlanNode]:
+    """Limit(ScanVertices/ScanEdges) plants a scan stop bound (reference:
+    PushLimitDownScanVerticesRule)."""
+    if node.kind != "Limit" or not node.deps:
+        return None
+    sc = node.dep()
+    if sc.kind not in ("ScanVertices", "ScanEdges"):
+        return None
+    cnt = node.args.get("count", -1)
+    if cnt is None or cnt < 0 or sc.args.get("limit") is not None:
+        return None
+    sc.args["limit"] = (node.args.get("offset", 0) or 0) + cnt
+    return None     # Limit stays for exactness
+
+
+@register_rule
+def push_limit_down_index_scan(node: PlanNode) -> Optional[PlanNode]:
+    """Limit(IndexScan) / Limit(Project(IndexScan)) plants a scan bound
+    (reference: PushLimitDownIndexScanRule); the scan counts rows AFTER
+    its residual filter, so the bound is exact."""
+    if node.kind != "Limit" or not node.deps:
+        return None
+    cnt = node.args.get("count", -1)
+    if cnt is None or cnt < 0:
+        return None
+    target = node.dep()
+    if target.kind == "Project" and target.deps:
+        target = target.dep()
+    if target.kind != "IndexScan" or target.args.get("limit") is not None:
+        return None
+    target.args["limit"] = (node.args.get("offset", 0) or 0) + cnt
+    return None
+
+
+@register_rule
+def push_filter_down_append_vertices(node: PlanNode) -> Optional[PlanNode]:
+    """Filter(AppendVertices) conjuncts that only touch the appended
+    vertex alias merge into the node's own filter (reference:
+    PushFilterDownAppendVerticesRule)."""
+    if node.kind != "Filter" or not node.deps:
+        return None
+    av = node.dep()
+    if av.kind != "AppendVertices":
+        return None
+    alias = av.args.get("col")
+    cond = node.args.get("condition")
+    if cond is None or not alias:
+        return None
+    pushable, rest = [], []
+    for c in split_conjuncts(cond):
+        refs = _col_refs(c)
+        if refs is not None and refs and refs <= {alias}:
+            pushable.append(c)
+        else:
+            rest.append(c)
+    if not pushable:
+        return None
+    prev = av.args.get("filter")
+    av.args["filter"] = join_conjuncts(
+        ([prev] if prev is not None else []) + pushable)
+    if rest:
+        node.args["condition"] = join_conjuncts(rest)
+        return None
+    return av
+
+
+@register_rule
+def push_filter_into_join_sides(node: PlanNode) -> Optional[PlanNode]:
+    """Filter(HashInnerJoin/CrossJoin) conjuncts that read only one
+    side's columns move below the join (reference:
+    PushFilterDownInnerJoinRule)."""
+    if node.kind != "Filter" or not node.deps:
+        return None
+    jn = node.dep()
+    if jn.kind not in ("HashInnerJoin", "CrossJoin") or len(jn.deps) != 2:
+        return None
+    cond = node.args.get("condition")
+    if cond is None:
+        return None
+    sides = [set(jn.dep(0).col_names), set(jn.dep(1).col_names)]
+    moved = {0: [], 1: []}
+    rest = []
+    for c in split_conjuncts(cond):
+        refs = _col_refs(c)
+        if refs is None or not refs:
+            rest.append(c)
+        elif refs <= sides[0]:
+            moved[0].append(c)
+        elif refs <= sides[1]:
+            moved[1].append(c)
+        else:
+            rest.append(c)
+    if not moved[0] and not moved[1]:
+        return None
+    match_row = node.args.get("match_row", False)
+    for i in (0, 1):
+        if moved[i]:
+            child = jn.dep(i)
+            f = PlanNode("Filter", deps=[child],
+                         col_names=list(child.col_names),
+                         args={"condition": join_conjuncts(moved[i]),
+                               "match_row": match_row})
+            jn.deps[i] = f
+    jn.input_vars = [d.output_var for d in jn.deps]
+    if rest:
+        node.args["condition"] = join_conjuncts(rest)
+        return None
+    return jn
+
+
+@register_rule
+def eliminate_noop_project(node: PlanNode) -> Optional[PlanNode]:
+    """Project that only re-emits its input columns unchanged and in
+    order → child (reference: RemoveNoopProjectRule)."""
+    if node.kind != "Project" or len(node.deps) != 1:
+        return None
+    if any(node.args.get(f) for f in
+           ("go_row", "match_row", "lookup_row", "fetch_row")):
+        return None
+    child = node.dep()
+    cols = node.args.get("columns", [])
+    if [n for _, n in cols] != list(child.col_names):
+        return None
+    for e, n in cols:
+        if not (isinstance(e, InputProp) and e.name == n):
+            return None
+    return child

@@ -84,6 +84,19 @@ class TpuRuntime:
         if cur is not None and not force and cur.epoch == sd.epoch:
             return cur
         snap = build_snapshot(store, space)
+        # HBM budget (SURVEY §2 row 5: device memory is the scarce
+        # resource): refuse to pin past the limit; caller falls back to
+        # the host path instead of OOMing the chip
+        from ..utils.memtracker import get_config as _gc  # flag is defined there
+        limit = int(_gc().get("tpu_hbm_limit_bytes"))
+        if limit:
+            est = snap.hbm_bytes()
+            others = sum(s.hbm_bytes() for sp_, s in self.snapshots.items()
+                         if sp_ != space)
+            if est + others > limit:
+                raise TpuUnavailable(
+                    f"snapshot needs {est:,}B HBM; {others:,}B already "
+                    f"pinned, limit {limit:,} (flag tpu_hbm_limit_bytes)")
         dev = pin_snapshot(snap, self.mesh)
         self.snapshots[space] = dev
         from ..utils.stats import stats

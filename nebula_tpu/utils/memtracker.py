@@ -1,0 +1,77 @@
+"""Per-query memory accounting with kill-on-exceed.
+
+Analog of the reference's MemoryTracker (reference: src/common/memory
+[UNVERIFIED — empty mount, SURVEY §0], SURVEY §2 row 5): every executor
+output and every loop that can explode (variable-length MATCH, path
+search) charges its allocations against the query's budget; exceeding
+it raises MemoryExceeded, which the engine surfaces as a clean
+ExecutionError instead of letting one runaway query OOM the process.
+
+The device plane has its own scarce resource: TpuRuntime checks pinned
+HBM bytes against `tpu_hbm_limit_bytes` before pinning a snapshot.
+"""
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+from .config import define_flag, get_config
+
+define_flag("query_memory_limit_bytes", 1 << 30,
+            "per-query intermediate-result budget; 0 disables tracking")
+define_flag("tpu_hbm_limit_bytes", 12_000_000_000,
+            "max bytes of CSR snapshots pinned to device HBM")
+
+
+class MemoryExceeded(Exception):
+    def __init__(self, used: int, limit: int):
+        super().__init__(
+            f"query memory exceeded: used≈{used:,} bytes, "
+            f"limit {limit:,} (flag query_memory_limit_bytes)")
+        self.used = used
+        self.limit = limit
+
+
+def approx_row_bytes(row: List[Any]) -> int:
+    """Cheap per-row estimate: container overhead + per-cell cost."""
+    total = 64
+    for c in row:
+        if isinstance(c, str):
+            total += 56 + len(c)
+        elif isinstance(c, (list, tuple, set)):
+            total += 64 + 48 * len(c)
+        else:
+            total += 48
+    return total
+
+
+def approx_dataset_bytes(rows: List[List[Any]]) -> int:
+    """Sampled estimate: first rows price the rest (rows of one node
+    output are shape-homogeneous)."""
+    n = len(rows)
+    if n == 0:
+        return 64
+    k = min(n, 32)
+    sampled = sum(approx_row_bytes(rows[i]) for i in range(k))
+    return 64 + (sampled * n) // k
+
+
+class MemoryTracker:
+    """One per query execution.  charge() is cumulative: intermediates
+    are versioned and kept for $vars/PROFILE, so releases are rare and
+    conservatively ignored."""
+
+    __slots__ = ("limit", "used")
+
+    def __init__(self, limit: Optional[int] = None):
+        if limit is None:
+            limit = int(get_config().get("query_memory_limit_bytes"))
+        self.limit = limit
+        self.used = 0
+
+    def charge(self, nbytes: int):
+        self.used += int(nbytes)
+        if self.limit and self.used > self.limit:
+            raise MemoryExceeded(self.used, self.limit)
+
+    def charge_rows(self, rows: List[List[Any]]):
+        self.charge(approx_dataset_bytes(rows))

@@ -1,0 +1,55 @@
+"""Memory tracker: per-query budget + kill-on-exceed (SURVEY §2 row 5)."""
+import pytest
+
+from nebula_tpu.exec.engine import QueryEngine
+from nebula_tpu.graphstore.store import GraphStore
+from nebula_tpu.utils.config import get_config
+from nebula_tpu.utils.memtracker import MemoryExceeded, MemoryTracker
+
+
+def _dense_graph(n=40):
+    store = GraphStore()
+    store.create_space("mt", partition_num=2, vid_type="INT64")
+    store.catalog.create_tag("mt", "P", [])
+    store.catalog.create_edge("mt", "E", [])
+    for i in range(n):
+        store.insert_vertex("mt", i, "P", {})
+    # complete-ish digraph: variable-length MATCH explodes combinatorially
+    for i in range(n):
+        for j in range(n):
+            if i != j:
+                store.insert_edge("mt", i, "E", j, 0, {})
+    return store
+
+
+def test_tracker_charges_and_raises():
+    tr = MemoryTracker(limit=1000)
+    tr.charge(500)
+    with pytest.raises(MemoryExceeded):
+        tr.charge(600)
+
+
+def test_runaway_match_killed_cleanly():
+    store = _dense_graph(40)
+    eng = QueryEngine(store)
+    s = eng.new_session()
+    eng.execute(s, "USE mt")
+    cfg = get_config()
+    old = cfg.get("query_memory_limit_bytes")
+    cfg.set_dynamic("query_memory_limit_bytes", 2_000_000)
+    try:
+        rs = eng.execute(s, "MATCH (a:P)-[e:E*1..6]->(b) RETURN count(*)")
+        assert rs.error is not None
+        assert "memory exceeded" in rs.error
+    finally:
+        cfg.set_dynamic("query_memory_limit_bytes", old)
+
+
+def test_normal_query_unaffected():
+    store = _dense_graph(10)
+    eng = QueryEngine(store)
+    s = eng.new_session()
+    eng.execute(s, "USE mt")
+    rs = eng.execute(s, "GO FROM 1 OVER E YIELD dst(edge)")
+    assert rs.error is None
+    assert len(rs.data.rows) == 9

@@ -123,3 +123,142 @@ def test_explain_output_contains_args(eng):
     p = plan_of(eng, 'GO FROM "a" OVER knows WHERE knows.since > 5')
     desc = p.describe()
     assert "ExpandAll" in desc and "knows" in desc
+
+
+# ---- round-2 optimizer rule family (golden shapes) ------------------------
+
+
+def test_merge_adjacent_filters(eng):
+    # MATCH ... WHERE lands one Filter; wrap another via $var? Simplest:
+    # construct directly — Filter(Filter(x)) collapses to one node.
+    from nebula_tpu.core.expr import Binary, InputProp, Literal
+    from nebula_tpu.query.plan import PlanNode
+    base = PlanNode("Start")
+    f1 = PlanNode("Filter", deps=[base], col_names=["a"],
+                  args={"condition": Binary(">", InputProp("a"), Literal(1))})
+    f2 = PlanNode("Filter", deps=[f1], col_names=["a"],
+                  args={"condition": Binary("<", InputProp("a"), Literal(9))})
+    p = optimize(ExecutionPlan(f2, "t"))
+    assert p.root.kind_tree() == ["Filter", "Start"]
+    from nebula_tpu.core.expr import to_text
+    assert "AND" in to_text(p.root.args["condition"])
+
+
+def test_eliminate_true_filter(eng):
+    from nebula_tpu.core.expr import Literal
+    from nebula_tpu.query.plan import PlanNode
+    base = PlanNode("Start")
+    f = PlanNode("Filter", deps=[base], col_names=[],
+                 args={"condition": Literal(True)})
+    p = optimize(ExecutionPlan(f, "t"))
+    assert p.root.kind_tree() == ["Start"]
+
+
+def test_merge_adjacent_limits(eng):
+    from nebula_tpu.query.plan import PlanNode
+    base = PlanNode("Start", col_names=["x"])
+    l1 = PlanNode("Limit", deps=[base], col_names=["x"],
+                  args={"offset": 2, "count": 10})
+    l2 = PlanNode("Limit", deps=[l1], col_names=["x"],
+                  args={"offset": 3, "count": 4})
+    p = optimize(ExecutionPlan(l2, "t"))
+    assert p.root.kind_tree() == ["Limit", "Start"]
+    assert p.root.args["offset"] == 5
+    assert p.root.args["count"] == 4
+
+
+def test_limit_semantics_after_merge(eng):
+    """rows[2:12][3:7] == rows[5:9] — the merged bound is equivalent."""
+    rows = list(range(20))
+    assert rows[2:12][3:7] == rows[5:9]
+
+
+def test_push_filter_through_dedup(eng):
+    from nebula_tpu.core.expr import Binary, InputProp, Literal
+    from nebula_tpu.query.plan import PlanNode
+    base = PlanNode("Start", col_names=["a"])
+    dd = PlanNode("Dedup", deps=[base], col_names=["a"])
+    f = PlanNode("Filter", deps=[dd], col_names=["a"],
+                 args={"condition": Binary(">", InputProp("a"), Literal(1))})
+    p = optimize(ExecutionPlan(f, "t"))
+    assert p.root.kind_tree() == ["Dedup", "Filter", "Start"]
+
+
+def test_push_limit_down_project(eng):
+    from nebula_tpu.core.expr import InputProp
+    from nebula_tpu.query.plan import PlanNode
+    base = PlanNode("Start", col_names=["a"])
+    pj = PlanNode("Project", deps=[base], col_names=["b"],
+                  args={"columns": [(InputProp("a"), "b")]})
+    lm = PlanNode("Limit", deps=[pj], col_names=["b"],
+                  args={"offset": 0, "count": 5})
+    p = optimize(ExecutionPlan(lm, "t"))
+    assert p.root.kind_tree() == ["Project", "Limit", "Start"]
+
+
+def test_push_limit_down_index_scan(eng):
+    p = plan_of(eng, "LOOKUP ON person WHERE person.age > 3 "
+                     "YIELD person.name")
+    from nebula_tpu.query.plan import PlanNode
+    assert "IndexScan" in p.root.kind_tree()
+    root = PlanNode("Limit", deps=[p.root], col_names=p.root.col_names,
+                    args={"offset": 0, "count": 4})
+    p2 = optimize(ExecutionPlan(root, "t"))
+    # the bound landed on the IndexScan through the Project
+    node = p2.root
+    while node.kind != "IndexScan":
+        node = node.dep()
+    assert node.args.get("limit") == 4
+
+
+def test_push_filter_into_join_sides(eng):
+    from nebula_tpu.core.expr import Binary, InputProp, Literal, join_conjuncts
+    from nebula_tpu.query.plan import PlanNode
+    l = PlanNode("Start", col_names=["a"])
+    r = PlanNode("Start", col_names=["b"])
+    jn = PlanNode("HashInnerJoin", deps=[l, r], col_names=["a", "b"],
+                  args={"hash_keys": [], "probe_keys": []})
+    cond = join_conjuncts([
+        Binary(">", InputProp("a"), Literal(1)),
+        Binary("<", InputProp("b"), Literal(9)),
+    ])
+    f = PlanNode("Filter", deps=[jn], col_names=["a", "b"],
+                 args={"condition": cond})
+    p = optimize(ExecutionPlan(f, "t"))
+    kinds = p.root.kind_tree()
+    assert kinds == ["HashInnerJoin", "Filter", "Start", "Filter", "Start"]
+
+
+def test_eliminate_noop_project(eng):
+    from nebula_tpu.core.expr import InputProp
+    from nebula_tpu.query.plan import PlanNode
+    base = PlanNode("Start", col_names=["a", "b"])
+    pj = PlanNode("Project", deps=[base], col_names=["a", "b"],
+                  args={"columns": [(InputProp("a"), "a"),
+                                    (InputProp("b"), "b")]})
+    p = optimize(ExecutionPlan(pj, "t"))
+    assert p.root.kind_tree() == ["Start"]
+
+
+def test_push_limit_down_scan_plants_bound(eng):
+    from nebula_tpu.query.plan import PlanNode
+    sc = PlanNode("ScanVertices", col_names=["v"],
+                  args={"space": "t", "tag": None})
+    lm = PlanNode("Limit", deps=[sc], col_names=["v"],
+                  args={"offset": 1, "count": 3})
+    p = optimize(ExecutionPlan(lm, "t"))
+    assert p.root.kind == "Limit"
+    assert p.root.dep().args.get("limit") == 4
+
+
+def test_push_filter_down_append_vertices(eng):
+    p = plan_of(eng, "MATCH (a:person)-[e:knows]->(b) "
+                     "WHERE b.person.age > 3 RETURN b")
+    # the b-only conjunct must land on the AppendVertices node
+    node = p.root
+    found = None
+    from nebula_tpu.query.plan import walk_plan
+    for n in walk_plan(p.root):
+        if n.kind == "AppendVertices" and n.args.get("filter") is not None:
+            found = n
+    assert found is not None
