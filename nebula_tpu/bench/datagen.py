@@ -76,3 +76,162 @@ def pick_seeds(store: GraphStore, space: str, k: int,
                 if len(seeds) >= k:
                     return seeds
     return seeds
+
+
+# ---------------------------------------------------------------------------
+# Array-native generation for north-star-scale graphs (tens of millions
+# of edges).  The dict store can't hold SF100-shaped data in RAM, and
+# the benchmark needs the CSR itself, so this path builds the
+# CsrSnapshot directly from numpy arrays — same layout as
+# graphstore.csr.build_snapshot (dense = vid, owner = vid % P).
+# ---------------------------------------------------------------------------
+
+
+def make_social_arrays(n_persons: int, avg_degree: int, seed: int = 7,
+                       hot_frac: float = 0.15):
+    """Edge arrays with the same distribution as make_social_graph."""
+    rng = np.random.default_rng(seed)
+    n_edges = n_persons * avg_degree
+    src = rng.integers(0, n_persons, n_edges, dtype=np.int64)
+    dst = rng.integers(0, n_persons, n_edges, dtype=np.int64)
+    hot = rng.random(n_edges) < hot_frac
+    dst[hot] = (rng.zipf(1.6, int(hot.sum())) - 1) % n_persons
+    keep = src != dst
+    src, dst = src[keep], dst[keep]
+    n_edges = src.size
+    return {
+        "n": n_persons,
+        "src": src,
+        "dst": dst,
+        "w": rng.integers(0, 100, n_edges, dtype=np.int64),
+        "f": rng.random(n_edges),
+        "city": rng.integers(0, len(_NAMES), n_edges, dtype=np.int64),
+    }
+
+
+def _coo_to_padded_csr(owner, local, nbr_dense, vmax, P):
+    """Vectorized COO → (P, ...) padded CSR.  Inputs must already be
+    sorted by (owner, local, tiebreak).  Returns (indptr, nbr, order
+    positions within part)."""
+    counts = np.bincount(owner, minlength=P)
+    emax = max(int(counts.max()), 1)
+    row_id = owner * (vmax) + np.minimum(local, vmax - 1)
+    per_vertex = np.bincount(row_id, minlength=P * vmax).reshape(P, vmax)
+    indptr = np.zeros((P, vmax + 1), np.int64)
+    np.cumsum(per_vertex, axis=1, out=indptr[:, 1:])
+    starts = np.zeros(P + 1, np.int64)
+    np.cumsum(counts, out=starts[1:])
+    pos = np.arange(owner.size, dtype=np.int64) - starts[owner]
+    nbr = np.full((P, emax), -1, np.int32)
+    nbr[owner, pos] = nbr_dense.astype(np.int32)
+    return indptr.astype(np.int32), nbr, pos, emax
+
+
+def snapshot_from_arrays(arrs, parts: int = 8, space: str = "snb"):
+    """Build a CsrSnapshot (out + in KNOWS blocks, w/f/city props)
+    directly from edge arrays — the bulk-ingest path for benchmark-scale
+    graphs."""
+    from ..graphstore.csr import CsrSnapshot, StringPool
+    from ..graphstore.csr import CsrBlock
+    from ..graphstore.schema import PropType
+
+    n, P = int(arrs["n"]), parts
+    src, dst = arrs["src"], arrs["dst"]
+    pool = StringPool()
+    city_codes = np.asarray([pool.encode(s) for s in _NAMES],
+                            np.int64)[arrs["city"]]
+    counts = np.bincount(np.arange(n, dtype=np.int64) % P, minlength=P)
+    vmax = max(int(counts.max()), 1)
+    snap = CsrSnapshot(space=space, epoch=0, num_parts=P, vmax=vmax,
+                       num_vertices=counts.astype(np.int32),
+                       pool=pool,
+                       dense_to_vid=list(range(n)))
+
+    for direction in ("out", "in"):
+        a, b = (src, dst) if direction == "out" else (dst, src)
+        owner = a % P
+        local = a // P
+        order = np.lexsort((b, local, owner))
+        ow, lo, nb = owner[order], local[order], b[order]
+        indptr, nbr, pos, emax = _coo_to_padded_csr(ow, lo, nb, vmax, P)
+        rank = np.zeros_like(nbr)
+        props = {}
+        for name, col, pt in (("w", arrs["w"], PropType.INT64),
+                              ("f", arrs["f"], PropType.DOUBLE),
+                              ("city", city_codes, PropType.STRING)):
+            dt = np.float64 if pt == PropType.DOUBLE else np.int64
+            padded = np.full((P, emax),
+                             np.nan if dt == np.float64 else -2, dt)
+            padded[ow, pos] = col[order].astype(dt)
+            props[name] = padded
+        snap.blocks[("KNOWS", direction)] = CsrBlock(
+            etype="KNOWS", direction=direction, indptr=indptr, nbr=nbr,
+            rank=rank, props=props,
+            prop_types={"w": PropType.INT64, "f": PropType.DOUBLE,
+                        "city": PropType.STRING})
+    return snap
+
+
+def host_csr_traverse(snap, seeds, steps: int, w_gt=None):
+    """Vectorized numpy host baseline over the same CSR: per hop, gather
+    neighbor ranges with repeat, dedup with np.unique.  This is the
+    strongest honest CPU single-core baseline available here (a C++
+    row-at-a-time engine does strictly more work per edge).
+
+    Returns (edges_traversed, final_kept_edge_count).
+    """
+    P = snap.num_parts
+    blk = snap.block("KNOWS", "out")
+    frontier = np.unique(np.asarray(seeds, np.int64))
+    total = 0
+    for hop in range(steps):
+        owner = frontier % P
+        local = frontier // P
+        s = blk.indptr[owner, local].astype(np.int64)
+        e = blk.indptr[owner, local + 1].astype(np.int64)
+        deg = e - s
+        total += int(deg.sum())
+        if deg.sum() == 0:
+            return total, 0
+        rows = np.repeat(np.arange(frontier.size), deg)
+        offs = np.arange(deg.sum(), dtype=np.int64) - \
+            np.repeat(np.cumsum(deg) - deg, deg)
+        idx = s[rows] + offs
+        nxt = blk.nbr[owner[rows], idx].astype(np.int64)
+        if hop == steps - 1:
+            if w_gt is None:
+                return total, int(nxt.size)
+            w = blk.props["w"][owner[rows], idx]
+            return total, int((w > w_gt).sum())
+        frontier = np.unique(nxt)
+    return total, 0
+
+
+class SnapshotStore:
+    """Duck-typed GraphStore stand-in backed by a prebuilt CsrSnapshot —
+    just enough surface for TpuRuntime.traverse/bfs (dense_id, epoch,
+    edge-type catalog)."""
+
+    class _SD:
+        def __init__(self, n, epoch):
+            self._n = n
+            self.epoch = epoch
+
+        def dense_id(self, v):
+            v = int(v)
+            return v if 0 <= v < self._n else -1
+
+    class _Edge:
+        edge_type = 1
+
+    class _Catalog:
+        def get_edge(self, space, et):
+            return SnapshotStore._Edge()
+
+    def __init__(self, snap):
+        self.snap = snap
+        self._sd = SnapshotStore._SD(len(snap.dense_to_vid), snap.epoch)
+        self.catalog = SnapshotStore._Catalog()
+
+    def space(self, name):
+        return self._sd

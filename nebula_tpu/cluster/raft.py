@@ -332,27 +332,30 @@ class RaftPart:
             with self.lock:
                 if not self.alive or self.state != LEADER:
                     return
-            self._replicate_one(peer)
+            ok = self._replicate_one(peer)
             self._advance_commit()
             with self._repl_cv:
                 # a propose() notify that landed while we were mid-send
                 # must not cost a full heartbeat of commit latency: skip
-                # the wait whenever unreplicated entries are pending
-                if self.alive and self.state == LEADER and \
+                # the wait when unreplicated entries are pending — but
+                # ONLY if the peer answered the last send (otherwise a
+                # dead peer + pending entries = a busy-spin hammering
+                # the transport at full speed)
+                if ok and self.alive and self.state == LEADER and \
                         self.next_index.get(peer, 1 << 62) <= \
                         self.wal.last_index():
                     continue
                 self._repl_cv.wait(self.hb)
 
-    def _replicate_one(self, peer: str):
+    def _replicate_one(self, peer: str) -> bool:
+        """One append_entries round; returns True iff the peer replied."""
         with self.lock:
             if self.state != LEADER:
-                return
+                return False
             term = self.current_term
             nxt = self.next_index.get(peer, self.wal.last_index() + 1)
             if nxt <= self.snap_index:
-                self._send_snapshot(peer)
-                return
+                return self._send_snapshot(peer)
             prev_idx = nxt - 1
             if prev_idx == self.snap_index:
                 prev_term = self.snap_term
@@ -367,14 +370,14 @@ class RaftPart:
             "prev_index": prev_idx, "prev_term": prev_term,
             "entries": entries, "leader_commit": commit})
         if r is None:
-            return
+            return False
         with self.lock:
             self._last_ack[peer] = t_send
             if r["term"] > self.current_term:
                 self._step_down(r["term"])
-                return
+                return True
             if self.state != LEADER:
-                return
+                return True
             if r.get("ok"):
                 if entries:
                     self.match_index[peer] = entries[-1][0]
@@ -384,6 +387,7 @@ class RaftPart:
                 hint = r.get("hint")
                 self.next_index[peer] = max(
                     1, hint + 1 if hint is not None else nxt - 1)
+        return True
 
     def _send_snapshot(self, peer: str):
         if self.snapshot_cb is None:

@@ -390,12 +390,12 @@ def push_limit_down_project(node: PlanNode) -> Optional[PlanNode]:
 
 @register_rule
 def push_limit_down_scan(node: PlanNode) -> Optional[PlanNode]:
-    """Limit(ScanVertices/ScanEdges) plants a scan stop bound (reference:
+    """Limit(ScanVertices) plants a scan stop bound (reference:
     PushLimitDownScanVerticesRule)."""
     if node.kind != "Limit" or not node.deps:
         return None
     sc = node.dep()
-    if sc.kind not in ("ScanVertices", "ScanEdges"):
+    if sc.kind != "ScanVertices":
         return None
     cnt = node.args.get("count", -1)
     if cnt is None or cnt < 0 or sc.args.get("limit") is not None:

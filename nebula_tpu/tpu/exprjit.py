@@ -351,3 +351,71 @@ def _in_list(fa, items: List[Any], pool: StringPool,
             return (~val & ~null, null, "bool")
         return (val & ~null, null, "bool")
     return g
+
+
+# ---------------------------------------------------------------------------
+# Columnar YIELD compiler — the fused-Project output path
+# ---------------------------------------------------------------------------
+#
+# The fusion rule absorbs a GO plan's final Project(go_row) into
+# TpuTraverse when every yield column is computable straight from the
+# materialized edge columns (sv, dv, rr, props) with NO per-row Python
+# evaluation.  Semantics mirror RowContext/get_edge_prop and the
+# src/dst/rank/type/typeid builtins exactly (core/functions.py) —
+# including the etype-sign swap for reverse-direction blocks.
+
+_YIELD_FNS = frozenset({"src", "dst", "rank", "type", "typeid"})
+
+
+def yieldable(e: "E.Expr") -> bool:
+    """Can this YIELD column be evaluated columnar-side?"""
+    if e.kind == "literal":
+        return True
+    if e.kind == "edge_prop":
+        return True
+    if e.kind == "function" and e.name in _YIELD_FNS and len(e.args) == 1 \
+            and e.args[0].kind == "edge":
+        return True
+    return False
+
+
+def eval_yield_column(e: "E.Expr", b: Dict[str, Any]) -> List[Any]:
+    """Evaluate one absorbed YIELD column over a materialized block.
+
+    b: {"et", "etype" (signed), "n", "sv", "dv", "rr", "props"} from
+    TpuRuntime._block_columns.  For reverse ("in") blocks etype < 0 and
+    sv is the frontier vertex — the PHYSICAL edge is dv→sv, matching
+    Edge(sv, dv, etype=-id) built by the row materializer.
+    """
+    from ..core.value import NULL_UNKNOWN_PROP
+    n = b["n"]
+    fwd = b["etype"] >= 0
+    if e.kind == "literal":
+        return [e.value] * n
+    if e.kind == "function":
+        name = e.name
+        if name == "src":       # physical source
+            return (b["sv"] if fwd else b["dv"]).tolist()
+        if name == "dst":
+            return (b["dv"] if fwd else b["sv"]).tolist()
+        if name == "rank":
+            return b["rr"].tolist()
+        if name == "type":
+            return [b["et"]] * n
+        if name == "typeid":
+            return [b["etype"]] * n
+    if e.kind == "edge_prop":
+        pname = e.name
+        if pname == "_src":
+            return (b["sv"] if fwd else b["dv"]).tolist()
+        if pname == "_dst":
+            return (b["dv"] if fwd else b["sv"]).tolist()
+        if pname == "_rank":
+            return b["rr"].tolist()
+        if pname == "_type":
+            return [b["et"]] * n
+        col = b["props"].get(pname)
+        if col is None:
+            return [NULL_UNKNOWN_PROP] * n
+        return col
+    raise CannotCompile(f"yield not columnar: {e.kind}")

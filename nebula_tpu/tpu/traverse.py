@@ -24,7 +24,7 @@ from ..exec.executors import executor
 from ..query import optimizer as opt
 from ..query.plan import PlanNode, walk_plan
 from .device import TpuUnavailable
-from .exprjit import CannotCompile, compilable
+from .exprjit import CannotCompile, compilable, yieldable
 
 try:
     import jax
@@ -87,14 +87,27 @@ def make_tpu_rule(uses: Dict[int, int]):
     of parents in the plan DAG."""
 
     def rule(node: PlanNode) -> Optional[PlanNode]:
-        if node.kind != "ExpandAll":
+        # Preferred match: Project(go_row) over the chain — the YIELD
+        # columns are absorbed too, so materialization emits the FINAL
+        # output rows from numpy columns (no per-edge Edge objects, no
+        # per-row expression eval: the E2E fast path).
+        yields = None
+        expand = node
+        if node.kind == "Project" and node.args.get("go_row") \
+                and len(node.deps) == 1 and node.dep().kind == "ExpandAll" \
+                and uses.get(node.dep().id, 2) == 1:
+            cols = node.args.get("columns") or []
+            if cols and all(yieldable(e) for e, _ in cols):
+                yields = cols
+                expand = node.dep()
+        if expand.kind != "ExpandAll":
             return None
-        a = node.args
+        a = expand.args
         ef = a.get("edge_filter")
         etypes = a.get("edge_types") or []
         if ef is not None and not compilable(ef, etypes):
             return None
-        m = _match_frontier_chain(node, uses)
+        m = _match_frontier_chain(expand, uses)
         if m is None:
             return None
         vids, steps = m
@@ -112,8 +125,9 @@ def make_tpu_rule(uses: Dict[int, int]):
             "TpuTraverse", deps=[],
             args={"space": a["space"], "edge_types": list(etypes),
                   "direction": a["direction"], "vids": list(vids),
-                  "steps": steps, "edge_filter": ef},
-            col_names=["_src", "_edge", "_dst"])
+                  "steps": steps, "edge_filter": ef, "yields": yields},
+            col_names=(list(node.col_names) if yields is not None
+                       else ["_src", "_edge", "_dst"]))
 
     return rule
 
@@ -135,12 +149,16 @@ def _tpu_traverse(node, qctx, ectx, space):
             for v in a.get("vids") or []]
     vids = [v for v in vids if not is_null(v)]
     rt = getattr(qctx, "tpu_runtime", None)
+    yields = a.get("yields")
     if rt is not None:
         try:
             rows, stats = rt.traverse(
                 qctx.store, sp, vids, a["edge_types"], a["direction"],
-                a["steps"], edge_filter=a.get("edge_filter"))
+                a["steps"], edge_filter=a.get("edge_filter"),
+                yields=yields)
             qctx.last_tpu_stats = stats
+            if yields is not None:
+                return DataSet(list(node.col_names), rows)
             return DataSet(["_src", "_edge", "_dst"],
                            [[s, e, d] for (s, e, d) in rows])
         except (CannotCompile, TpuUnavailable) + _JAX_RT_ERRORS as ex:
@@ -184,14 +202,21 @@ def _host_traverse(node, qctx, space, vids):
                 seen2.add(k)
                 nxt.append(other)
         frontier = nxt
+    yields = a.get("yields")
     rows = []
     for (s, et, rank, other, props, sd) in store.get_neighbors(
             space, frontier, etypes, direction):
         e = _make_edge(s, other, et, rank, props, sd, etype_ids[et])
-        if ef is not None:
+        rc = None
+        if ef is not None or yields is not None:
             rc = RowContext(qctx, space,
                             {"_src": s, "_edge": e, "_dst": other})
-            if to_bool3(ef.eval(rc)) is not True:
-                continue
-        rows.append([s, e, other])
+        if ef is not None and to_bool3(ef.eval(rc)) is not True:
+            continue
+        if yields is not None:
+            rows.append([ye.eval(rc) for ye, _ in yields])
+        else:
+            rows.append([s, e, other])
+    if yields is not None:
+        return DataSet(list(node.col_names), rows)
     return DataSet(["_src", "_edge", "_dst"], rows)

@@ -339,3 +339,61 @@ def test_pre_epoch_datetime_roundtrip():
     assert enc == sorted(enc)
     for v, e in zip(vals, enc):
         assert decode_prop(PropType.DATETIME, e, pool) == v
+
+
+def test_yield_fusion_columnar_parity(rt):
+    """Project(go_row) absorbed into TpuTraverse: all yieldable column
+    shapes (src/dst/rank/type/typeid, edge props incl. strings, literal,
+    reverse direction) match the host path row-for-row."""
+    st = random_store(13)
+    qs = [
+        "GO 2 STEPS FROM 3, 17 OVER knows "
+        "YIELD src(edge) AS s, dst(edge) AS d, rank(edge) AS r, "
+        "type(edge) AS t, knows.w AS w, knows.tag AS g, 7 AS c",
+        "GO 2 STEPS FROM 3, 17 OVER knows REVERSELY "
+        "YIELD src(edge), dst(edge), knows.tag",
+        "GO 3 STEPS FROM 3 OVER knows WHERE knows.w > 20 "
+        "YIELD dst(edge), knows.w, knows.f",
+    ]
+    for q in qs:
+        out = []
+        for tpu_rt in (None, rt):
+            eng = QueryEngine(st, tpu_runtime=tpu_rt)
+            s = eng.new_session()
+            eng.execute(s, "USE g")
+            rs = eng.execute(s, q)
+            assert rs.error is None, f"{q} -> {rs.error}"
+            out.append(sorted(map(repr, rs.data.rows)))
+        assert out[0] == out[1], q
+
+    # the fused plan carries the yields (no separate Project above)
+    eng = QueryEngine(st, tpu_runtime=rt)
+    s = eng.new_session()
+    eng.execute(s, "USE g")
+    rs = eng.execute(s, "EXPLAIN " + qs[0])
+    desc = rs.data.rows[0][0]
+    assert "TpuTraverse" in desc and "yields" in desc
+    assert desc.strip().startswith("TpuTraverse"), desc
+
+
+def test_non_yieldable_keeps_project(rt):
+    """$$-prop yields can't be columnar: Project survives, the chain
+    below still fuses, and parity holds."""
+    st = random_store(14)
+    q = ("GO 2 STEPS FROM 3 OVER knows "
+         "YIELD dst(edge) AS d, $$.person.age AS a")
+    out = []
+    for tpu_rt in (None, rt):
+        eng = QueryEngine(st, tpu_runtime=tpu_rt)
+        s = eng.new_session()
+        eng.execute(s, "USE g")
+        rs = eng.execute(s, q)
+        assert rs.error is None, rs.error
+        out.append(sorted(map(repr, rs.data.rows)))
+    assert out[0] == out[1]
+    eng = QueryEngine(st, tpu_runtime=rt)
+    s = eng.new_session()
+    eng.execute(s, "USE g")
+    rs = eng.execute(s, "EXPLAIN " + q)
+    desc = rs.data.rows[0][0]
+    assert "Project" in desc and "TpuTraverse" in desc
