@@ -112,7 +112,9 @@ def _check(e: E.Expr, etypes: Set[str]):
             raise CannotCompile("edge reserved prop beyond _rank")
         if name != "_rank" and len(etypes) != 1:
             raise CannotCompile("prop predicate over multiple edge types")
-        if name != "_rank" and edge not in etypes:
+        # "__edge__" is the planner's alias for the edge being traversed
+        # (MATCH inline props, _edge_pred) — always the single etype here
+        if name != "_rank" and edge != "__edge__" and edge not in etypes:
             raise CannotCompile(f"predicate on non-traversed edge {edge}")
         return
     if isinstance(e, E.Unary):

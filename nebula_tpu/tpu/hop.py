@@ -108,7 +108,8 @@ def build_traverse_fn(mesh, P: int, F: int, EB: int, steps: int,
                       n_blocks: int,
                       pred: Optional[Callable[[Dict[str, Any]], Any]] = None,
                       pred_cols: Sequence[str] = (),
-                      capture: bool = True):
+                      capture: bool = True,
+                      capture_hops: bool = False):
     """Compile the N-step traversal program for one bucket configuration.
 
     blocks_data (runtime arg): tuple of n_blocks dicts with keys
@@ -123,6 +124,13 @@ def build_traverse_fn(mesh, P: int, F: int, EB: int, steps: int,
       ovf_expand / ovf_route / ovf_frontier (P,) bool
       cap (if capture): dict of (P, n_blocks, EB) arrays
         src, dst, rank, eidx, keep — the final hop's edge set
+
+    capture_hops=True is the MATCH mode (SURVEY §2 row 23 Traverse):
+    the predicate is applied at EVERY hop (a MATCH edge pattern's filter
+    is uniform over a variable-length expansion, unlike GO's final-step
+    WHERE) and the edge frame of every hop is captured — cap arrays gain
+    a leading hop axis, (P, steps, n_blocks, EB).  The host assembles
+    trail-semantics paths from the layered frames (runtime.py).
     """
 
     def kernel(blocks_data, frontier):
@@ -132,6 +140,7 @@ def build_traverse_fn(mesh, P: int, F: int, EB: int, steps: int,
         ovf_r = jnp.zeros((), bool)
         ovf_f = jnp.zeros((), bool)
         cap_out = None
+        hop_caps: List[Dict[str, Any]] = []
         fcount = jnp.zeros((), jnp.int32)
 
         for hop in range(steps):
@@ -145,7 +154,7 @@ def build_traverse_fn(mesh, P: int, F: int, EB: int, steps: int,
                     b["indptr"][0], b["nbr"][0], b["rank"][0], fr, F, EB, P)
                 ovf_e = ovf_e | ovf
                 edges_this_hop = edges_this_hop + total
-                if last and pred is not None:
+                if pred is not None and (last or capture_hops):
                     cols = {"_rank": rk}
                     for name in pred_cols:
                         if name != "_rank":
@@ -153,7 +162,7 @@ def build_traverse_fn(mesh, P: int, F: int, EB: int, steps: int,
                     keep = pred(cols) & ve
                 else:
                     keep = ve
-                if last and capture:
+                if capture and (last or capture_hops):
                     caps["src"].append(src)
                     caps["dst"].append(jnp.where(keep, dst, -1))
                     caps["rank"].append(rk)
@@ -162,10 +171,18 @@ def build_traverse_fn(mesh, P: int, F: int, EB: int, steps: int,
                 if not last:
                     cands.append(jnp.where(keep, dst, -1))
             hop_edges.append(edges_this_hop)
+            if capture and (last or capture_hops):
+                hop_caps.append({k: jnp.stack(v) for k, v in caps.items()})
 
             if last:
                 if capture:
-                    cap_out = {k: jnp.stack(v)[None] for k, v in caps.items()}
+                    if capture_hops:
+                        cap_out = {k: jnp.stack([hc[k] for hc in hop_caps]
+                                                )[None]
+                                   for k in hop_caps[0]}
+                    else:
+                        cap_out = {k: v[None]
+                                   for k, v in hop_caps[-1].items()}
                 # the post-final frontier is not needed for GO; report empty
                 fr = jnp.full((F,), -1, jnp.int32)
                 fcount = jnp.zeros((), jnp.int32)
@@ -201,12 +218,15 @@ def build_traverse_fn_local(P: int, F: int, EB: int, steps: int,
                             n_blocks: int,
                             pred: Optional[Callable[[Dict[str, Any]], Any]] = None,
                             pred_cols: Sequence[str] = (),
-                            capture: bool = True):
+                            capture: bool = True,
+                            capture_hops: bool = False):
     """Single-chip variant: all P partitions resident on one device, the
     per-part kernel vmapped over the part axis, and the frontier exchange
     a plain transpose (the degenerate all_to_all).  This is the program
     that runs on one real chip (the bench config) — identical semantics
-    to the sharded build, no ICI.
+    to the sharded build, no ICI.  capture_hops follows the sharded
+    contract (MATCH mode: per-hop pred + per-hop frames, cap arrays
+    (P, steps, n_blocks, EB)).
     """
 
     def one_part_expand(block, fr, want_pred):
@@ -229,6 +249,7 @@ def build_traverse_fn_local(P: int, F: int, EB: int, steps: int,
         ovf_r = jnp.zeros((P,), bool)
         ovf_f = jnp.zeros((P,), bool)
         cap_out = None
+        hop_caps = []
         fcount = jnp.zeros((P,), jnp.int32)
 
         for hop in range(steps):
@@ -238,7 +259,7 @@ def build_traverse_fn_local(P: int, F: int, EB: int, steps: int,
             caps = {"src": [], "dst": [], "rank": [], "eidx": [], "keep": []}
             for bi in range(n_blocks):
                 b = blocks_data[bi]
-                want_pred = last and pred is not None
+                want_pred = pred is not None and (last or capture_hops)
                 src, dst, rk, eidx, ve, keep, total, ovf = jax.vmap(
                     lambda ip, nb, rkk, prp, f: one_part_expand(
                         {"indptr": ip, "nbr": nb, "rank": rkk, "props": prp},
@@ -246,7 +267,7 @@ def build_traverse_fn_local(P: int, F: int, EB: int, steps: int,
                 )(b["indptr"], b["nbr"], b["rank"], b["props"], fr)
                 ovf_e = ovf_e | ovf
                 edges = edges + total
-                if last and capture:
+                if capture and (last or capture_hops):
                     caps["src"].append(src)
                     caps["dst"].append(jnp.where(keep, dst, -1))
                     caps["rank"].append(rk)
@@ -255,12 +276,20 @@ def build_traverse_fn_local(P: int, F: int, EB: int, steps: int,
                 if not last:
                     cands.append(jnp.where(keep, dst, -1))
             hop_edges.append(edges)
+            if capture and (last or capture_hops):
+                # (P, nb, EB)
+                hop_caps.append({k: jnp.stack(v, axis=1)
+                                 for k, v in caps.items()})
 
             if last:
                 if capture:
-                    # (P, nb, EB)
-                    cap_out = {k: jnp.stack(v, axis=1)
-                               for k, v in caps.items()}
+                    if capture_hops:
+                        # (P, steps, nb, EB)
+                        cap_out = {k: jnp.stack([hc[k] for hc in hop_caps],
+                                                axis=1)
+                                   for k in hop_caps[0]}
+                    else:
+                        cap_out = hop_caps[-1]
                 fr = jnp.full((P, F), -1, jnp.int32)
                 fcount = jnp.zeros((P,), jnp.int32)
             else:

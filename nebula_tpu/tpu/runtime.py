@@ -60,6 +60,52 @@ class TraverseStats:
         return int(sum(self.hop_edges))
 
 
+class HopFrame:
+    """One hop's captured edge set, indexed for path assembly.
+
+    src/dst: (n,) int64 dense vertex ids; edges: list of n Edge objects
+    (batch-decoded); adj: dense src id → (start, end) slice into the
+    src-sorted `order` index.  Within a source, edges keep CSR order
+    (per-block slot order), block-major — matching the host
+    get_neighbors iteration (etype list order, then (rank, neighbor))."""
+    __slots__ = ("src", "dst", "edges", "order", "adj", "n")
+
+    @classmethod
+    def empty(cls) -> "HopFrame":
+        f = cls()
+        f.src = np.empty((0,), np.int64)
+        f.dst = np.empty((0,), np.int64)
+        f.edges = []
+        f.order = np.empty((0,), np.int64)
+        f.adj = {}
+        f.n = 0
+        return f
+
+    @classmethod
+    def build(cls, src, dst, edges) -> "HopFrame":
+        if src is None or src.size == 0:
+            return cls.empty()
+        f = cls()
+        f.src, f.dst, f.edges = src, dst, edges
+        f.n = src.size
+        f.order = np.argsort(src, kind="stable")
+        ss = src[f.order]
+        starts = np.flatnonzero(np.concatenate(
+            [[True], ss[1:] != ss[:-1]]))
+        bounds = np.concatenate([starts, [ss.size]])
+        f.adj = {int(ss[starts[i]]): (int(bounds[i]), int(bounds[i + 1]))
+                 for i in range(starts.size)}
+        return f
+
+    def out_edges(self, dense_id: int):
+        """Indices (into src/dst/edges) of this hop's edges out of
+        dense_id, in CSR order."""
+        se = self.adj.get(dense_id)
+        if se is None:
+            return ()
+        return self.order[se[0]:se[1]]
+
+
 class TpuRuntime:
     """One per process; holds the mesh and all pinned spaces."""
 
@@ -291,6 +337,138 @@ class TpuRuntime:
         stats.result_edges = len(rows)
         stats.total_s = time.perf_counter() - t_start
         return rows, stats
+
+    # -- MATCH device plane: layered hop frames --------------------------
+
+    def traverse_hops(self, store: GraphStore, space: str,
+                      vids: Sequence[Any], etypes: Sequence[str],
+                      direction: str, max_hop: int,
+                      edge_filter: Optional[E.Expr] = None
+                      ) -> Tuple[List["HopFrame"], TraverseStats]:
+        """Device expansion for MATCH Traverse (SURVEY §2 row 23).
+
+        Runs max_hop frontier expansions on device with the compiled
+        predicate applied at EVERY hop (MATCH edge filters are uniform
+        over variable-length patterns) and captures the edge frame of
+        each hop.  Returns one HopFrame per hop: the complete set of
+        predicate-passing edges reachable at that depth, with Edge
+        objects batch-decoded from the CSR columns.  The caller (the
+        Traverse executor) assembles trail-semantics paths from the
+        layered frames on host — every pred-passing edge out of any
+        vertex reachable at depth d-1 is in frame d, so frame DFS with
+        connectivity + distinct-edge checks enumerates exactly the paths
+        the per-vertex host DFS would.
+
+        Raises CannotCompile when the filter doesn't vectorize (caller
+        may retry with edge_filter=None and re-check rows on host —
+        frames are then a superset pruned during assembly).
+        """
+        t_start = time.perf_counter()
+        dev = self.pin(store, space)
+        sd = store.space(space)
+        stats = TraverseStats()
+        stats.steps = max_hop
+        stats.pin_s = time.perf_counter() - t_start
+
+        block_keys = self._blocks_for(dev, etypes, direction)
+        pred = None
+        pred_cols: List[str] = []
+        pred_key = None
+        if edge_filter is not None:
+            bl = dev.blocks[block_keys[0]]
+            pred, pred_cols = compile_predicate(
+                edge_filter, bl.prop_types, dev.pool)
+            pred_key = E.to_text(edge_filter) if hasattr(E, "to_text") \
+                else repr(edge_filter)
+
+        dense = [sd.dense_id(v) for v in vids]
+        dense = [d for d in dense if d >= 0]
+        if not dense:
+            return [HopFrame.empty() for _ in range(max_hop)], stats
+
+        P = dev.num_parts
+        blocks_data = tuple(
+            {"indptr": dev.blocks[bk].indptr, "nbr": dev.blocks[bk].nbr,
+             "rank": dev.blocks[bk].rank,
+             "props": {n: dev.blocks[bk].props[n] for n in pred_cols
+                       if n != "_rank"}}
+            for bk in block_keys)
+
+        def build(F, EB):
+            if self.local_mode:
+                return build_traverse_fn_local(
+                    P, F, EB, max_hop, len(block_keys), pred=pred,
+                    pred_cols=pred_cols, capture=True, capture_hops=True)
+            return build_traverse_fn(
+                self.mesh, P, F, EB, max_hop, len(block_keys),
+                pred=pred, pred_cols=pred_cols, capture=True,
+                capture_hops=True)
+
+        res = self._escalate(
+            dev, dense,
+            key_fn=lambda F, EB: (space, dev.epoch, "hops",
+                                  tuple(block_keys), max_hop, F, EB,
+                                  pred_key, tuple(pred_cols)),
+            build_fn=build,
+            inputs_fn=lambda F, EB: (blocks_data,),
+            stats=stats)
+
+        t_mat = time.perf_counter()
+        frames = self._build_frames(store, space, dev, block_keys,
+                                    res["cap"], max_hop)
+        stats.mat_s = time.perf_counter() - t_mat
+        stats.result_edges = sum(f.n for f in frames)
+        stats.total_s = time.perf_counter() - t_start
+        return frames, stats
+
+    def _build_frames(self, store: GraphStore, space: str,
+                      dev: DeviceSnapshot, block_keys, cap, steps: int
+                      ) -> List["HopFrame"]:
+        """cap arrays are (P, steps, nb, EB); one HopFrame per hop."""
+        host = dev.host
+        d2v_arr = getattr(host, "_d2v_arr", None)
+        if d2v_arr is None or len(d2v_arr) != len(host.dense_to_vid):
+            d2v_arr = np.asarray(host.dense_to_vid, dtype=object)
+            host._d2v_arr = d2v_arr
+        etype_ids = {et: store.catalog.get_edge(space, et).edge_type
+                     for et, _ in block_keys}
+        frames = []
+        for h in range(steps):
+            srcs, dsts, edges = [], [], []
+            for bi, (et, dirn) in enumerate(block_keys):
+                hb = host.blocks[(et, dirn)]
+                keep = cap["keep"][:, h, bi, :]
+                # nonzero is row-major: part order, then slot order — per
+                # (part, src) the slots are contiguous ascending eidx, so
+                # the concat order below is already (src-stable) CSR order
+                sel_p, sel_j = np.nonzero(keep)
+                if sel_p.size == 0:
+                    continue
+                ss = cap["src"][sel_p, h, bi, sel_j].astype(np.int64)
+                dd = cap["dst"][sel_p, h, bi, sel_j].astype(np.int64)
+                rr = cap["rank"][sel_p, h, bi, sel_j]
+                ee = cap["eidx"][sel_p, h, bi, sel_j]
+                props = {n: decode_prop_column(
+                    hb.prop_types[n], hb.props[n][sel_p, ee], host.pool)
+                    for n in hb.props}
+                eid = etype_ids[et]
+                sgn = eid if dirn == "out" else -eid
+                sv = d2v_arr[ss]
+                dvv = d2v_arr[dd]
+                names = list(props)
+                cols = [props[n] for n in names]
+                rrl = rr.tolist()
+                edges.extend(
+                    Edge(s, d, et, rrl[i],
+                         {n: c[i] for n, c in zip(names, cols)}, etype=sgn)
+                    for i, (s, d) in enumerate(zip(sv.tolist(),
+                                                   dvv.tolist())))
+                srcs.append(ss)
+                dsts.append(dd)
+            frames.append(HopFrame.build(
+                np.concatenate(srcs) if srcs else None,
+                np.concatenate(dsts) if dsts else None, edges))
+        return frames
 
     # -- BFS (FIND SHORTEST PATH device plane) ---------------------------
 

@@ -159,5 +159,7 @@ define_flag("tpu_init_frontier", 256,
             "initial frontier bucket (power of two)")
 define_flag("tpu_init_edge_budget", 2048,
             "initial per-block edge budget (power of two)")
+define_flag("tpu_match_device", True,
+            "run MATCH Traverse expansion on the device plane")
 define_flag("snapshot_dir", "./nebula_snapshots",
             "where CREATE SNAPSHOT checkpoints land")

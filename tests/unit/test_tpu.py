@@ -397,3 +397,93 @@ def test_non_yieldable_keeps_project(rt):
     rs = eng.execute(s, "EXPLAIN " + q)
     desc = rs.data.rows[0][0]
     assert "Project" in desc and "TpuTraverse" in desc
+
+
+# ---------------------------------------------------------------------------
+# MATCH device plane (Traverse via layered hop frames)
+# ---------------------------------------------------------------------------
+
+
+MATCH_QS = [
+    # fixed 1-hop with edge alias + props
+    "MATCH (a:person)-[e:knows]->(b) WHERE id(a) IN [3, 17, 44] "
+    "RETURN id(a), e.w, rank(e), id(b)",
+    # reverse and undirected
+    "MATCH (a:person)<-[e:knows]-(b) WHERE id(a) == 7 RETURN id(b), e.w",
+    "MATCH (a:person)-[e:knows]-(b) WHERE id(a) == 7 RETURN id(b), rank(e)",
+    # variable-length: *1..3, *0..2, exact *2
+    "MATCH (a:person)-[e:knows*1..3]->(b) WHERE id(a) == 5 "
+    "RETURN id(b), size(e)",
+    "MATCH (a:person)-[e:knows*0..2]->(b) WHERE id(a) IN [3, 9] "
+    "RETURN id(a), id(b)",
+    "MATCH (a:person)-[e:knows*2]->(b) WHERE id(a) IN [1, 2] "
+    "RETURN id(b)",
+    # inline edge-prop predicate (device-compiled per-hop mask)
+    "MATCH (a:person)-[e:knows*1..2 {tag: 'ann'}]->(b) WHERE id(a) IN "
+    "[3, 17] RETURN id(b), size(e)",
+    # longer pattern: two fixed hops + node filter
+    "MATCH (a:person)-[e1:knows]->(m)-[e2:knows]->(b:person) "
+    "WHERE id(a) == 5 AND b.person.age > 30 RETURN id(m), id(b)",
+]
+
+
+@pytest.mark.parametrize("q", MATCH_QS)
+def test_match_traverse_device_parity(rt, q):
+    """MATCH Traverse runs on the device plane (layered hop frames +
+    host trail assembly) with identical result rows to the host DFS."""
+    st = random_store(21)
+    out = []
+    for tpu_rt in (None, rt):
+        eng = QueryEngine(st, tpu_runtime=tpu_rt)
+        s = eng.new_session()
+        eng.execute(s, "USE g")
+        rs = eng.execute(s, q)
+        assert rs.error is None, f"{q} -> {rs.error}"
+        out.append(sorted(map(repr, rs.data.rows)))
+    assert out[0] == out[1], q
+
+
+def test_match_device_engages(rt):
+    """The device plane actually runs (stats recorded), and the flag
+    turns it off."""
+    from nebula_tpu.utils.config import get_config
+    st = random_store(22)
+    eng = QueryEngine(st, tpu_runtime=rt)
+    s = eng.new_session()
+    eng.execute(s, "USE g")
+    q = "MATCH (a:person)-[e:knows*1..3]->(b) WHERE id(a) == 5 RETURN id(b)"
+    rs = eng.execute(s, q)
+    assert rs.error is None
+    st_stats = eng.qctx.last_tpu_stats
+    assert st_stats is not None and st_stats.steps == 3
+    assert st_stats.edges_traversed() > 0
+    want = sorted(map(repr, rs.data.rows))
+
+    get_config().set_dynamic("tpu_match_device", False)
+    try:
+        eng2 = QueryEngine(st, tpu_runtime=rt)
+        s2 = eng2.new_session()
+        eng2.execute(s2, "USE g")
+        rs2 = eng2.execute(s2, q)
+        assert eng2.qctx.last_tpu_stats is None
+        assert sorted(map(repr, rs2.data.rows)) == want
+    finally:
+        get_config().set_dynamic("tpu_match_device", True)
+
+
+def test_match_multi_etype_prop_pred_hybrid(rt):
+    """Multi-etype pattern with an inline prop predicate can't compile a
+    device mask — frames come back unfiltered and edge_ok re-checks on
+    host during assembly.  Rows must still match the pure host path."""
+    st = random_store(23, extra_edge_type=True)
+    q = ("MATCH (a:person)-[e:knows|likes*1..2 {w: 1}]->(b) "
+         "WHERE id(a) IN [1, 2, 3, 4, 5] RETURN id(b), size(e)")
+    out = []
+    for tpu_rt in (None, rt):
+        eng = QueryEngine(st, tpu_runtime=tpu_rt)
+        s = eng.new_session()
+        eng.execute(s, "USE g")
+        rs = eng.execute(s, q)
+        assert rs.error is None, rs.error
+        out.append(sorted(map(repr, rs.data.rows)))
+    assert out[0] == out[1]
