@@ -34,7 +34,17 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-REPEATS = 5
+REPEATS = int(os.environ.get("NEBULA_BENCH_REPEATS", 5))
+
+
+def _mark(msg):
+    """Progress marker on stderr (the JSON contract owns stdout) — a
+    mid-bench stall must be attributable to a phase."""
+    print(f"[bench +{time.perf_counter() - _T0:7.1f}s] {msg}",
+          file=sys.stderr, flush=True)
+
+
+_T0 = time.perf_counter()
 
 
 def _median(xs):
@@ -97,16 +107,19 @@ def main():
     configs = {}
 
     # ---- configs 1 + 2: engine E2E on the dict store (identical rows) ----
+    _mark("building small dict-store graph")
     t0 = time.perf_counter()
     store = make_social_graph(n_persons=small_n, avg_degree=degree,
                               parts=parts, space="snb")
     small_build_s = time.perf_counter() - t0
     seeds = pick_seeds(store, "snb", n_seeds, min_degree=2)
     seed_list = ", ".join(str(s) for s in seeds)
+    _mark("config 1: engine e2e GO 2 STEPS")
     configs["1_sf1_go2"] = bench_engine_config(
         "cfg1", store,
         f"GO 2 STEPS FROM {seed_list} OVER KNOWS YIELD dst(edge) AS d",
         seeds, rt)
+    _mark("config 2: engine e2e GO 3 STEPS filtered")
     configs["2_sf30_go3_filtered"] = bench_engine_config(
         "cfg2", store,
         f"GO 3 STEPS FROM {seed_list} OVER KNOWS WHERE KNOWS.w > 50 "
@@ -115,6 +128,7 @@ def main():
     rt.unpin("snb")
 
     # ---- north-star-scale array graph (configs 5 + 6) ----
+    _mark("building north-star array graph")
     t0 = time.perf_counter()
     arrs = make_social_arrays(n_persons, degree, seed=7)
     snap = snapshot_from_arrays(arrs, parts=parts, space="ns")
@@ -125,14 +139,17 @@ def main():
     skew = {"max_degree": int(deg_out.max()),
             "per_part_edges": snap.block("KNOWS", "out")
                                   .indptr[:, -1].tolist()}
+    _mark("pinning north-star snapshot to device")
     rt.pin_prebuilt(snap)
     big_seeds = np.unique(arrs["src"][:4 * n_seeds])[:n_seeds].tolist()
 
     # config 6: the north-star — 3-hop GO, E2E with final-row output
     yields = [(E.FunctionCall("dst", [E.EdgeExpr()]), "d"),
               (E.EdgeProp("KNOWS", "w"), "w")]
+    _mark("config 6: warmup traverse (compile + escalation)")
     rows, st = rt.traverse(sstore, "ns", big_seeds, ["KNOWS"], "out", 3,
                            yields=yields)   # warmup + escalation settle
+    _mark("config 6: timed repeats")
     lat, klat = [], []
     for _ in range(REPEATS):
         t0 = time.perf_counter()
@@ -141,6 +158,7 @@ def main():
         lat.append(time.perf_counter() - t0)
         klat.append(st.device_s)
     edges = st.edges_traversed()
+    _mark("config 6: host CSR baseline")
     t0 = time.perf_counter()
     cpu_total, cpu_kept = host_csr_traverse(snap, big_seeds, 3)
     cpu_s = time.perf_counter() - t0
@@ -164,6 +182,7 @@ def main():
     }
 
     # config 5: shortest-path BFS device plane
+    _mark("config 5: BFS")
     bfs_src = big_seeds[:1]
     dist, stb = rt.bfs(sstore, "ns", bfs_src, ["KNOWS"], "out", 5)
     lat = []

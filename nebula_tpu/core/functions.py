@@ -847,6 +847,27 @@ def _exists(ctx, args):
     return not is_null(v) and not is_empty(v)
 
 
+@register("_edges_distinct")
+def _edges_distinct(ctx, args):
+    """Internal: relationship-uniqueness gate the MATCH planner plants
+    when a pattern has two or more edge variables (Cypher relationship
+    isomorphism; reference: MATCH edges within one pattern never bind
+    the same edge twice).  Each arg is an Edge, a list of Edges (a
+    variable-length binding), or NULL (zero-hop) — True iff no edge key
+    appears twice across all of them."""
+    seen = set()
+    for v in args:
+        edges = v if isinstance(v, list) else ([] if is_null(v) else [v])
+        for e in edges:
+            if not isinstance(e, Edge):
+                continue
+            k = e.key()
+            if k in seen:
+                return False
+            seen.add(k)
+    return True
+
+
 @register("duration")
 def _duration(ctx, args):
     v = args[0]

@@ -158,6 +158,12 @@ class RowContext(ExprContext):
 
     def get_edge_prop(self, edge, name):
         e = self.row.get("_edge")
+        if not isinstance(e, Edge):
+            # FETCH PROP ON <edge> rows carry the edge in `edges_`
+            # (reference: YIELD knows.since over fetched edges)
+            e2 = self.row.get("edges_")
+            if isinstance(e2, Edge) and (edge is None or e2.name == edge):
+                e = e2
         if isinstance(e, Edge):
             if name == "_src":
                 return e.src if e.etype >= 0 else e.dst

@@ -372,6 +372,11 @@ def _traverse_device(node, qctx, ectx, ds, ci, sp, etypes, direction,
     host_check = edge_filter is not None and dev_pred is None
 
     tracker = getattr(ectx, "tracker", None)
+    if tracker is not None:
+        # the frames themselves are materialized Edge objects — charge
+        # them so a runaway MATCH hits the same kill-on-exceed guard as
+        # the host path (SURVEY §2 row 5)
+        tracker.charge(sum(f.n for f in frames) * 192)
     pending = 0
     rows: List[List[Any]] = []
     for r, svid in zip(ds.rows, src_of_row):

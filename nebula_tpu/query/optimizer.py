@@ -85,9 +85,15 @@ def optimize(plan: ExecutionPlan, enable: bool = True,
 
         plan.root = rec(plan.root)
     if var_alias:
+        # Only references to nodes that actually LEFT the plan may be
+        # re-pointed.  Swap rules (e.g. Limit(Project) → Project(Limit))
+        # alias the old root to the new one, but BOTH nodes survive —
+        # rewriting the new root's own input would self-loop it.
+        live = {n.output_var for n in walk_plan(plan.root)}
+
         def resolve(v):
             seen = set()
-            while v in var_alias and v not in seen:
+            while v not in live and v in var_alias and v not in seen:
                 seen.add(v)
                 v = var_alias[v]
             return v

@@ -762,17 +762,22 @@ def _plan_match_clause(pctx, mc: A.MatchClauseAst, current: Optional[PlanNode],
     return node
 
 
-def _anon_names():
-    import itertools as _it
-    for i in _it.count():
-        yield f"__anon_{i}"
+def _anon_names(pctx):
+    """Anonymous aliases must be unique across the WHOLE query: two
+    patterns in one MATCH each having an anonymous edge must not share
+    a column name, or the join between them keys on unrelated edges
+    (anonymous elements are never join keys in Cypher)."""
+    while True:
+        n = getattr(pctx, "_anon_counter", 0)
+        pctx._anon_counter = n + 1
+        yield f"__anon_{n}"
 
 
 def _plan_pattern(pctx, pat: A.PathPattern, where: Optional[Expr],
                   aliases: Dict[str, str], current: Optional[PlanNode]) -> PlanNode:
     space = pctx.need_space()
     cat = pctx.catalog
-    anon = _anon_names()
+    anon = _anon_names(pctx)
     for np in pat.nodes:
         if np.alias is None:
             np.alias = next(anon)
@@ -826,10 +831,16 @@ def _plan_pattern(pctx, pat: A.PathPattern, where: Optional[Expr],
         dst = pat.nodes[i + 1]
         etypes = ep.types or sorted(e.name for e in cat.edges(space))
         edge_filter = _edge_pred(ep)
-        cols = list(cur.col_names) + [ep.alias, dst.alias]
+        # A repeated node alias within the pattern — (a)-[e]->(a), cycles
+        # like (a)-->(b)-->(a) — is an EQUALITY constraint, not a second
+        # column: traverse into a fresh alias, filter id(fresh)==id(orig),
+        # then drop the fresh column.
+        dup = dst.alias in cur.col_names
+        use_alias = (next(anon) + "d") if dup else dst.alias
+        cols = list(cur.col_names) + [ep.alias, use_alias]
         cur = PlanNode("Traverse", deps=[cur], col_names=cols, args={
             "space": space, "src_col": pat.nodes[i].alias,
-            "edge_alias": ep.alias, "dst_alias": dst.alias,
+            "edge_alias": ep.alias, "dst_alias": use_alias,
             "edge_types": etypes, "direction": ep.direction,
             "min_hop": ep.min_hop, "max_hop": ep.max_hop,
             "edge_filter": edge_filter,
@@ -839,8 +850,27 @@ def _plan_pattern(pctx, pat: A.PathPattern, where: Optional[Expr],
         dst_filter = _node_pred(dst)
         av_labels = [l for l, _ in dst.labels]
         cur = PlanNode("AppendVertices", deps=[cur], col_names=list(cur.col_names),
-                       args={"space": space, "col": dst.alias,
+                       args={"space": space, "col": use_alias,
                              "labels": av_labels, "filter": dst_filter})
+        if dup:
+            eq = Binary("==", FunctionCall("id", [LabelExpr(use_alias)]),
+                        FunctionCall("id", [LabelExpr(dst.alias)]))
+            cur = PlanNode("Filter", deps=[cur],
+                           col_names=list(cur.col_names),
+                           args={"condition": eq, "match_row": True})
+            keep = [c for c in cur.col_names if c != use_alias]
+            cur = PlanNode("Project", deps=[cur], col_names=keep,
+                           args={"columns": [(LabelExpr(c), c)
+                                             for c in keep],
+                                 "match_row": True})
+    if len(pat.edges) >= 2:
+        # Cypher relationship isomorphism: no edge binds twice within one
+        # pattern — including cycles through the dup-alias branch above
+        # (e.g. (a)-[e1]-(b)-[e2]-(a) walking one edge out and back).
+        cond = FunctionCall("_edges_distinct",
+                            [LabelExpr(ep.alias) for ep in pat.edges])
+        cur = PlanNode("Filter", deps=[cur], col_names=list(cur.col_names),
+                       args={"condition": cond, "match_row": True})
     if not pat.edges:
         # single-node pattern: ensure label presence already filtered
         if seed.labels and seed_vids is not None:

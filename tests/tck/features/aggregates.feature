@@ -1,0 +1,156 @@
+Feature: Aggregates and grouping
+
+  Background:
+    Given having executed:
+      """
+      CREATE SPACE ag(partition_num=4, vid_type=FIXED_STRING(8));
+      USE ag;
+      CREATE TAG person(name string, age int, dept string);
+      CREATE EDGE owes(amt int);
+      INSERT VERTEX person(name, age, dept) VALUES "a":("Ann", 30, "eng"), "b":("Bob", 25, "eng"), "c":("Cat", 41, "ops"), "d":("Dan", 19, "ops"), "e":("Eve", 33, "hr");
+      INSERT EDGE owes(amt) VALUES "a"->"b":(10), "a"->"c":(20), "b"->"c":(30), "c"->"d":(5)
+      """
+
+  Scenario: count sum avg min max over piped GO
+    When executing query:
+      """
+      GO FROM "a", "b", "c" OVER owes YIELD owes.amt AS amt | YIELD count($-.amt) AS c, sum($-.amt) AS s, avg($-.amt) AS a, min($-.amt) AS mn, max($-.amt) AS mx
+      """
+    Then the result should be, in order:
+      | c | s  | a     | mn | mx |
+      | 4 | 65 | 16.25 | 5  | 30 |
+
+  Scenario: aggregates over empty input
+    When executing query:
+      """
+      GO FROM "e" OVER owes YIELD owes.amt AS amt | YIELD count($-.amt) AS c, sum($-.amt) AS s, avg($-.amt) AS a, min($-.amt) AS mn, max($-.amt) AS mx, collect($-.amt) AS l
+      """
+    Then the result should be, in order:
+      | c | s | a    | mn   | mx   | l  |
+      | 0 | 0 | NULL | NULL | NULL | [] |
+
+  Scenario: count star vs count column with nulls
+    When executing query:
+      """
+      FETCH PROP ON person "a", "b", "c" YIELD person.age AS age | YIELD count(*) AS all, count(CASE WHEN $-.age > 28 THEN $-.age END) AS some
+      """
+    Then the result should be, in order:
+      | all | some |
+      | 3   | 2    |
+
+  Scenario: group by dept
+    When executing query:
+      """
+      MATCH (v:person) RETURN v.person.dept AS dept, count(*) AS n, avg(v.person.age) AS avg_age ORDER BY dept
+      """
+    Then the result should be, in order:
+      | dept  | n | avg_age |
+      | "eng" | 2 | 27.5    |
+      | "hr"  | 1 | 33.0    |
+      | "ops" | 2 | 30.0    |
+
+  Scenario: collect and collect_set
+    When executing query:
+      """
+      GO FROM "a" OVER owes YIELD owes.amt AS amt | YIELD collect($-.amt) AS l | YIELD size($-.l) AS n
+      """
+    Then the result should be, in order:
+      | n |
+      | 2 |
+
+  Scenario: distinct aggregate
+    Given having executed:
+      """
+      INSERT EDGE owes(amt) VALUES "e"->"a":(10)
+      """
+    When executing query:
+      """
+      GO FROM "a", "e" OVER owes YIELD owes.amt AS amt | YIELD count(DISTINCT $-.amt) AS cd, count($-.amt) AS c
+      """
+    Then the result should be, in order:
+      | cd | c |
+      | 2  | 3 |
+
+  Scenario: std deviation
+    When executing query:
+      """
+      YIELD 2 AS x | YIELD std($-.x) AS s
+      """
+    Then the result should be, in order:
+      | s   |
+      | 0.0 |
+
+  Scenario: aggregate with nulls skips them
+    When executing query:
+      """
+      FETCH PROP ON person "a", "b" YIELD person.age AS age | YIELD sum(CASE WHEN $-.age > 28 THEN $-.age END) AS s, count(CASE WHEN $-.age > 28 THEN $-.age END) AS c
+      """
+    Then the result should be, in order:
+      | s  | c |
+      | 30 | 1 |
+
+  Scenario: MATCH count over empty pattern result
+    When executing query:
+      """
+      MATCH (v:person)-[e:owes]->(b) WHERE id(v) == "d" RETURN count(*) AS n
+      """
+    Then the result should be, in order:
+      | n |
+      | 0 |
+
+  Scenario: min max over strings
+    When executing query:
+      """
+      MATCH (v:person) RETURN min(v.person.name) AS mn, max(v.person.name) AS mx
+      """
+    Then the result should be, in order:
+      | mn    | mx    |
+      | "Ann" | "Eve" |
+
+  Scenario: avg is float even for ints
+    When executing query:
+      """
+      GO FROM "a" OVER owes YIELD owes.amt AS amt | YIELD avg($-.amt) AS a
+      """
+    Then the result should be, in order:
+      | a    |
+      | 15.0 |
+
+  Scenario: grouped aggregate keyed by expression
+    When executing query:
+      """
+      MATCH (v:person) RETURN v.person.age > 28 AS senior, count(*) AS n ORDER BY senior
+      """
+    Then the result should be, in order:
+      | senior | n |
+      | false  | 2 |
+      | true   | 3 |
+
+  Scenario: multiple aggregates same group
+    When executing query:
+      """
+      MATCH (a:person)-[e:owes]->(b) RETURN a.person.dept AS dept, sum(e.amt) AS s, max(e.amt) AS mx ORDER BY dept
+      """
+    Then the result should be, in order:
+      | dept  | s  | mx |
+      | "eng" | 60 | 30 |
+      | "ops" | 5  | 5  |
+
+  Scenario: count distinct on strings via pipe
+    When executing query:
+      """
+      MATCH (v:person) RETURN count(DISTINCT v.person.dept) AS d
+      """
+    Then the result should be, in order:
+      | d |
+      | 3 |
+
+  Scenario: TOP N pattern with order by and limit
+    When executing query:
+      """
+      MATCH (a:person)-[e:owes]->(b) RETURN b.person.name AS n, e.amt AS amt ORDER BY amt DESC, n LIMIT 2
+      """
+    Then the result should be, in order:
+      | n     | amt |
+      | "Cat" | 30  |
+      | "Cat" | 20  |

@@ -1,0 +1,165 @@
+Feature: DML semantics
+
+  Background:
+    Given having executed:
+      """
+      CREATE SPACE dml(partition_num=4, vid_type=INT64);
+      USE dml;
+      CREATE TAG person(name string, age int DEFAULT 18);
+      CREATE TAG badge(level int);
+      CREATE EDGE knows(since int);
+      INSERT VERTEX person(name, age) VALUES 1:("Ann", 30), 2:("Bob", 25), 3:("Cat", 41)
+      """
+
+  Scenario: insert uses column defaults
+    When executing query:
+      """
+      INSERT VERTEX person(name) VALUES 9:("Kid");
+      FETCH PROP ON person 9 YIELD person.name AS n, person.age AS a
+      """
+    Then the result should be, in order:
+      | n     | a  |
+      | "Kid" | 18 |
+
+  Scenario: insert overwrites existing vertex props
+    When executing query:
+      """
+      INSERT VERTEX person(name, age) VALUES 1:("Ann2", 31);
+      FETCH PROP ON person 1 YIELD person.name AS n, person.age AS a
+      """
+    Then the result should be, in order:
+      | n      | a  |
+      | "Ann2" | 31 |
+
+  Scenario: insert if not exists does not overwrite
+    When executing query:
+      """
+      INSERT VERTEX IF NOT EXISTS person(name, age) VALUES 1:("Zed", 99);
+      FETCH PROP ON person 1 YIELD person.name AS n
+      """
+    Then the result should be, in order:
+      | n     |
+      | "Ann" |
+
+  Scenario: a vertex may carry multiple tags
+    When executing query:
+      """
+      INSERT VERTEX badge(level) VALUES 1:(5);
+      MATCH (v:person:badge) RETURN id(v) AS i, v.badge.level AS l
+      """
+    Then the result should be, in order:
+      | i | l |
+      | 1 | 5 |
+
+  Scenario: update vertex with set expression
+    When executing query:
+      """
+      UPDATE VERTEX ON person 2 SET age = age + 10;
+      FETCH PROP ON person 2 YIELD person.age AS a
+      """
+    Then the result should be, in order:
+      | a  |
+      | 35 |
+
+  Scenario: update with when condition false leaves value
+    When executing query:
+      """
+      UPDATE VERTEX ON person 2 SET age = 99 WHEN age > 1000;
+      FETCH PROP ON person 2 YIELD person.age AS a
+      """
+    Then the result should be, in order:
+      | a  |
+      | 25 |
+
+  Scenario: update yield returns new values
+    When executing query:
+      """
+      UPDATE VERTEX ON person 3 SET age = 42 YIELD name AS n, age AS a
+      """
+    Then the result should be, in order:
+      | n     | a  |
+      | "Cat" | 42 |
+
+  Scenario: upsert inserts missing vertex
+    When executing query:
+      """
+      UPSERT VERTEX ON person 77 SET name = "New", age = 1;
+      FETCH PROP ON person 77 YIELD person.name AS n, person.age AS a
+      """
+    Then the result should be, in order:
+      | n     | a |
+      | "New" | 1 |
+
+  Scenario: update edge property
+    When executing query:
+      """
+      INSERT EDGE knows(since) VALUES 1->2:(2000);
+      UPDATE EDGE ON knows 1->2 SET since = 2024;
+      FETCH PROP ON knows 1->2 YIELD knows.since AS y
+      """
+    Then the result should be, in order:
+      | y    |
+      | 2024 |
+
+  Scenario: delete edge removes both directions
+    When executing query:
+      """
+      INSERT EDGE knows(since) VALUES 1->2:(2000);
+      DELETE EDGE knows 1->2;
+      GO FROM 1 OVER knows YIELD dst(edge) AS d
+      """
+    Then the result should be empty
+
+  Scenario: delete vertex removes incident edges
+    When executing query:
+      """
+      INSERT EDGE knows(since) VALUES 1->2:(2000), 2->3:(2005);
+      DELETE VERTEX 2 WITH EDGE;
+      GO FROM 1 OVER knows YIELD dst(edge) AS d
+      """
+    Then the result should be empty
+
+  Scenario: delete tag keeps other tags
+    When executing query:
+      """
+      INSERT VERTEX badge(level) VALUES 3:(7);
+      DELETE TAG badge FROM 3;
+      FETCH PROP ON person 3 YIELD person.name AS n
+      """
+    Then the result should be, in order:
+      | n     |
+      | "Cat" |
+
+  Scenario: insert edge with rank
+    When executing query:
+      """
+      INSERT EDGE knows(since) VALUES 1->2@7:(1999);
+      FETCH PROP ON knows 1->2@7 YIELD knows.since AS y, rank(edge) AS r
+      """
+    Then the result should be, in order:
+      | y    | r |
+      | 1999 | 7 |
+
+  Scenario: insert with wrong arity is an error
+    When executing query:
+      """
+      INSERT VERTEX person(name) VALUES 5:("X", 1)
+      """
+    Then a SemanticError should be raised
+
+  Scenario: insert wrong type is an error
+    When executing query:
+      """
+      INSERT VERTEX person(name, age) VALUES 5:(5, "x")
+      """
+    Then an ExecutionError should be raised
+
+  Scenario: delete nonexistent vertex is a no-op
+    When executing query:
+      """
+      DELETE VERTEX 424242;
+      MATCH (v:person) RETURN count(*) AS n
+      """
+    Then the result should be, in order:
+      | n |
+      | 3 |

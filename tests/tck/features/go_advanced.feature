@@ -1,0 +1,204 @@
+Feature: GO advanced forms
+
+  Background:
+    Given having executed:
+      """
+      CREATE SPACE ga(partition_num=4, vid_type=INT64);
+      USE ga;
+      CREATE TAG person(name string, age int);
+      CREATE EDGE knows(since int, w double);
+      CREATE EDGE likes(level int);
+      INSERT VERTEX person(name, age) VALUES 1:("Ann", 30), 2:("Bob", 25), 3:("Cat", 41), 4:("Dan", 19), 5:("Eve", 33);
+      INSERT EDGE knows(since, w) VALUES 1->2:(2010, 1.0), 2->3:(2015, 2.0), 3->4:(2018, 1.5), 4->5:(2020, 3.0), 5->1:(2021, 0.1), 1->3:(2012, 0.5);
+      INSERT EDGE likes(level) VALUES 1->4:(5), 2->1:(3), 3->5:(9)
+      """
+
+  Scenario: zero steps returns nothing
+    When executing query:
+      """
+      GO 0 STEPS FROM 1 OVER knows YIELD dst(edge) AS d
+      """
+    Then the result should be empty
+
+  Scenario: m to n steps accumulates all hops
+    When executing query:
+      """
+      GO 1 TO 3 STEPS FROM 1 OVER knows YIELD dst(edge) AS d, knows.since AS y
+      """
+    Then the result should be, in any order:
+      | d | y    |
+      | 2 | 2010 |
+      | 3 | 2012 |
+      | 3 | 2015 |
+      | 4 | 2018 |
+      | 4 | 2018 |
+      | 5 | 2020 |
+
+  Scenario: bidirect union of both directions
+    When executing query:
+      """
+      GO FROM 1 OVER knows BIDIRECT YIELD dst(edge) AS d
+      """
+    Then the result should be, in any order:
+      | d |
+      | 2 |
+      | 3 |
+      | 1 |
+
+  Scenario: over multiple edges with type discrimination
+    When executing query:
+      """
+      GO FROM 1 OVER knows, likes YIELD type(edge) AS t, dst(edge) AS d
+      """
+    Then the result should be, in any order:
+      | t       | d |
+      | "knows" | 2 |
+      | "knows" | 3 |
+      | "likes" | 4 |
+
+  Scenario: src and dst vertex properties
+    When executing query:
+      """
+      GO FROM 2 OVER knows YIELD $^.person.name AS s, $$.person.name AS d, $$.person.age AS da
+      """
+    Then the result should be, in order:
+      | s     | d     | da |
+      | "Bob" | "Cat" | 41 |
+
+  Scenario: where on destination property
+    When executing query:
+      """
+      GO FROM 1 OVER knows WHERE $$.person.age > 30 YIELD dst(edge) AS d
+      """
+    Then the result should be, in any order:
+      | d |
+      | 3 |
+
+  Scenario: pipe into dedup yield
+    When executing query:
+      """
+      GO 2 STEPS FROM 1, 2 OVER knows YIELD dst(edge) AS d | YIELD DISTINCT $-.d AS d
+      """
+    Then the result should be, in any order:
+      | d |
+      | 3 |
+      | 4 |
+
+  Scenario: variable assignment feeds a second GO
+    When executing query:
+      """
+      $a = GO FROM 1 OVER knows YIELD dst(edge) AS d; GO FROM $a.d OVER knows YIELD src(edge) AS s, dst(edge) AS d
+      """
+    Then the result should be, in any order:
+      | s | d |
+      | 2 | 3 |
+      | 3 | 4 |
+
+  Scenario: union of two GO results
+    When executing query:
+      """
+      GO FROM 1 OVER knows YIELD dst(edge) AS d UNION GO FROM 2 OVER knows YIELD dst(edge) AS d
+      """
+    Then the result should be, in any order:
+      | d |
+      | 2 |
+      | 3 |
+
+  Scenario: union all keeps duplicates
+    When executing query:
+      """
+      GO FROM 1 OVER knows YIELD dst(edge) AS d UNION ALL GO FROM 5 OVER knows YIELD dst(edge) AS d
+      """
+    Then the result should be, in any order:
+      | d |
+      | 2 |
+      | 3 |
+      | 1 |
+
+  Scenario: intersect of two GO results
+    When executing query:
+      """
+      GO FROM 1 OVER knows YIELD dst(edge) AS d INTERSECT GO 2 STEPS FROM 5 OVER knows YIELD dst(edge) AS d
+      """
+    Then the result should be, in any order:
+      | d |
+      | 2 |
+      | 3 |
+
+  Scenario: minus removes second set
+    When executing query:
+      """
+      GO FROM 1 OVER knows YIELD dst(edge) AS d MINUS GO FROM 2 OVER knows YIELD dst(edge) AS d
+      """
+    Then the result should be, in any order:
+      | d |
+      | 2 |
+
+  Scenario: order by with limit pipeline
+    When executing query:
+      """
+      GO FROM 1, 2, 3 OVER knows YIELD dst(edge) AS d, knows.w AS w | ORDER BY $-.w DESC | LIMIT 2
+      """
+    Then the result should be, in order:
+      | d | w   |
+      | 3 | 2.0 |
+      | 4 | 1.5 |
+
+  Scenario: group by with aggregate pipeline
+    When executing query:
+      """
+      GO FROM 1, 2, 3 OVER knows YIELD src(edge) AS s, knows.w AS w | GROUP BY $-.s YIELD $-.s AS s, sum($-.w) AS total
+      """
+    Then the result should be, in any order:
+      | s | total |
+      | 1 | 1.5   |
+      | 2 | 2.0   |
+      | 3 | 1.5   |
+
+  Scenario: reversely with edge prop
+    When executing query:
+      """
+      GO FROM 3 OVER knows REVERSELY YIELD src(edge) AS s, knows.since AS y
+      """
+    Then the result should be, in any order:
+      | s | y    |
+      | 2 | 2015 |
+      | 1 | 2012 |
+
+  Scenario: over star reversely
+    When executing query:
+      """
+      GO FROM 1 OVER * REVERSELY YIELD type(edge) AS t, src(edge) AS s
+      """
+    Then the result should be, in any order:
+      | t       | s |
+      | "knows" | 5 |
+      | "likes" | 2 |
+
+  Scenario: limit inside go sampling is deterministic count
+    When executing query:
+      """
+      GO FROM 1, 2, 3 OVER knows YIELD dst(edge) AS d | LIMIT 3
+      """
+    Then the result should be, in any order:
+      | d |
+      | 2 |
+      | 3 |
+      | 3 |
+
+  Scenario: nonexistent source vertex yields empty
+    When executing query:
+      """
+      GO FROM 999 OVER knows YIELD dst(edge) AS d
+      """
+    Then the result should be empty
+
+  Scenario: duplicate from vids keep duplicate rows
+    When executing query:
+      """
+      GO FROM 2, 2 OVER knows YIELD dst(edge) AS d
+      """
+    Then the result should be, in any order:
+      | d |
+      | 3 |
+      | 3 |

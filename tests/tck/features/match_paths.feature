@@ -1,0 +1,258 @@
+Feature: MATCH paths and pattern edge cases
+
+  Background:
+    Given having executed:
+      """
+      CREATE SPACE mp(partition_num=4, vid_type=FIXED_STRING(8));
+      USE mp;
+      CREATE TAG person(name string, age int);
+      CREATE TAG city(pop int);
+      CREATE EDGE knows(since int);
+      CREATE EDGE lives(years int);
+      INSERT VERTEX person(name, age) VALUES "a":("Ann", 30), "b":("Bob", 25), "c":("Cat", 41), "d":("Dan", 19);
+      INSERT VERTEX city(pop) VALUES "x":(100), "y":(200);
+      INSERT EDGE knows(since) VALUES "a"->"b":(2010), "b"->"c":(2015), "c"->"a":(2018), "c"->"d":(2020);
+      INSERT EDGE lives(years) VALUES "a"->"x":(3), "b"->"x":(5), "c"->"y":(1)
+      """
+
+  Scenario: named path with nodes and relationships
+    When executing query:
+      """
+      MATCH p = (a:person)-[e:knows]->(b) WHERE id(a) == "a" RETURN size(nodes(p)) AS n, size(relationships(p)) AS r
+      """
+    Then the result should be, in order:
+      | n | r |
+      | 2 | 1 |
+
+  Scenario: startnode and endnode of a path
+    When executing query:
+      """
+      MATCH p = (a:person)-[e:knows]->(b) WHERE id(a) == "a" RETURN id(startnode(p)) AS s, id(endnode(p)) AS t
+      """
+    Then the result should be, in order:
+      | s   | t   |
+      | "a" | "b" |
+
+  Scenario: variable length zero hops includes the seed
+    When executing query:
+      """
+      MATCH (a:person)-[e:knows*0..1]->(b) WHERE id(a) == "a" RETURN id(b) AS d, size(e) AS hops
+      """
+    Then the result should be, in any order:
+      | d   | hops |
+      | "a" | 0    |
+      | "b" | 1    |
+
+  Scenario: trail semantics never repeat an edge
+    When executing query:
+      """
+      MATCH (a:person)-[e:knows*1..4]->(b) WHERE id(a) == "a" RETURN id(b) AS d, size(e) AS hops
+      """
+    Then the result should be, in any order:
+      | d   | hops |
+      | "b" | 1    |
+      | "c" | 2    |
+      | "a" | 3    |
+      | "d" | 3    |
+
+  Scenario: undirected one hop sees both orientations
+    When executing query:
+      """
+      MATCH (a:person)-[e:knows]-(b) WHERE id(a) == "a" RETURN id(b) AS d
+      """
+    Then the result should be, in any order:
+      | d   |
+      | "b" |
+      | "c" |
+
+  Scenario: two-hop chained pattern with middle alias
+    When executing query:
+      """
+      MATCH (a:person)-[:knows]->(m:person)-[:knows]->(b:person) WHERE id(a) == "a" RETURN id(m) AS m, id(b) AS b
+      """
+    Then the result should be, in any order:
+      | m   | b   |
+      | "b" | "c" |
+
+  Scenario: mixed edge types in one pattern
+    When executing query:
+      """
+      MATCH (a:person)-[:knows]->(m:person)-[l:lives]->(c:city) WHERE id(a) == "a" RETURN id(m) AS m, id(c) AS c, l.years AS y
+      """
+    Then the result should be, in any order:
+      | m   | c   | y |
+      | "b" | "x" | 5 |
+
+  Scenario: OPTIONAL MATCH keeps unmatched rows with nulls
+    When executing query:
+      """
+      MATCH (a:person) WHERE id(a) IN ["a", "d"] OPTIONAL MATCH (a)-[e:knows]->(b) RETURN id(a) AS s, id(b) AS d ORDER BY s
+      """
+    Then the result should be, in order:
+      | s   | d    |
+      | "a" | "b"  |
+      | "d" | NULL |
+
+  Scenario: multiple labels on scan
+    When executing query:
+      """
+      MATCH (c:city) RETURN id(c) AS i, c.city.pop AS p ORDER BY i
+      """
+    Then the result should be, in order:
+      | i   | p   |
+      | "x" | 100 |
+      | "y" | 200 |
+
+  Scenario: node property inline filter
+    When executing query:
+      """
+      MATCH (a:person {name: "Cat"})-[e:knows]->(b) RETURN id(b) AS d
+      """
+    Then the result should be, in any order:
+      | d   |
+      | "a" |
+      | "d" |
+
+  Scenario: edge property inline filter on var-length
+    When executing query:
+      """
+      MATCH (a:person)-[e:knows*1..2 {since: 2015}]->(b) WHERE id(a) == "b" RETURN id(b) AS d, size(e) AS hops
+      """
+    Then the result should be, in any order:
+      | d   | hops |
+      | "c" | 1    |
+
+  Scenario: labels and properties functions
+    When executing query:
+      """
+      MATCH (v:city) WHERE id(v) == "x" RETURN labels(v) AS l, properties(v) AS p
+      """
+    Then the result should be, in order:
+      | l        | p          |
+      | ["city"] | {pop: 100} |
+
+  Scenario: type and rank of matched edge
+    When executing query:
+      """
+      MATCH (a:person)-[e:knows]->(b) WHERE id(a) == "a" RETURN type(e) AS t, rank(e) AS r
+      """
+    Then the result should be, in order:
+      | t       | r |
+      | "knows" | 0 |
+
+  Scenario: WITH reshapes and filters mid-query
+    When executing query:
+      """
+      MATCH (a:person)-[e:knows]->(b) WITH a, count(b) AS deg WHERE deg >= 1 RETURN id(a) AS i, deg ORDER BY i
+      """
+    Then the result should be, in order:
+      | i   | deg |
+      | "a" | 1   |
+      | "b" | 1   |
+      | "c" | 2   |
+
+  Scenario: UNWIND a literal list
+    When executing query:
+      """
+      UNWIND [1, 2, 3] AS x RETURN x * 10 AS y
+      """
+    Then the result should be, in order:
+      | y  |
+      | 10 |
+      | 20 |
+      | 30 |
+
+  Scenario: UNWIND collected results
+    When executing query:
+      """
+      MATCH (a:person)-[e:knows]->(b) WHERE id(a) == "c" WITH collect(id(b)) AS ds UNWIND ds AS d RETURN d ORDER BY d
+      """
+    Then the result should be, in order:
+      | d   |
+      | "a" |
+      | "d" |
+
+  Scenario: SKIP and LIMIT page results
+    When executing query:
+      """
+      MATCH (v:person) RETURN id(v) AS i ORDER BY i SKIP 1 LIMIT 2
+      """
+    Then the result should be, in order:
+      | i   |
+      | "b" |
+      | "c" |
+
+  Scenario: DISTINCT return
+    When executing query:
+      """
+      MATCH (a:person)-[e:knows]->(b:person)-[l:lives]->(c) RETURN DISTINCT id(c) AS i
+      """
+    Then the result should be, in any order:
+      | i   |
+      | "x" |
+      | "y" |
+
+  Scenario: pattern with no match is empty
+    When executing query:
+      """
+      MATCH (a:person)-[e:lives]->(b:person) RETURN id(a)
+      """
+    Then the result should be empty
+
+  Scenario: self loop participates once per rank
+    Given having executed:
+      """
+      INSERT EDGE knows(since) VALUES "d"->"d":(2022)
+      """
+    When executing query:
+      """
+      MATCH (a:person)-[e:knows]->(a) RETURN id(a) AS i, e.since AS y
+      """
+    Then the result should be, in any order:
+      | i   | y    |
+      | "d" | 2022 |
+
+  Scenario: parallel edges by rank are distinct results
+    Given having executed:
+      """
+      INSERT EDGE knows(since) VALUES "a"->"b"@1:(2011), "a"->"b"@2:(2012)
+      """
+    When executing query:
+      """
+      MATCH (a:person)-[e:knows]->(b) WHERE id(a) == "a" RETURN rank(e) AS r ORDER BY r
+      """
+    Then the result should be, in order:
+      | r |
+      | 0 |
+      | 1 |
+      | 2 |
+
+  Scenario: relationship uniqueness forbids walking one edge twice
+    When executing query:
+      """
+      MATCH (a:person)-[e1:knows]-(b)-[e2:knows]-(a) WHERE id(a) == "a" RETURN id(b) AS m
+      """
+    Then the result should be empty
+
+  Scenario: cycle through genuinely distinct edges is kept
+    Given having executed:
+      """
+      INSERT EDGE knows(since) VALUES "b"->"a":(99)
+      """
+    When executing query:
+      """
+      MATCH (a:person)-[e1:knows]-(b)-[e2:knows]-(a) WHERE id(a) == "a" RETURN id(b) AS m
+      """
+    Then the result should be, in any order:
+      | m   |
+      | "b" |
+      | "b" |
+
+  Scenario: two patterns joined on shared alias
+    When executing query:
+      """
+      MATCH (a:person)-[:knows]->(b), (b)-[:lives]->(c:city) WHERE id(a) == "a" RETURN id(b) AS b, id(c) AS c
+      """
+    Then the result should be, in any order:
+      | b   | c   |
+      | "b" | "x" |

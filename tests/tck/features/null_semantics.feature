@@ -1,0 +1,190 @@
+Feature: Null semantics
+
+  Scenario: null kinds display
+    When executing query:
+      """
+      YIELD NULL AS a, 1/0 AS b, 1%0 AS c
+      """
+    Then the result should be, in order:
+      | a    | b               | c               |
+      | NULL | __DIV_BY_ZERO__ | __DIV_BY_ZERO__ |
+
+  Scenario: IS NULL and IS NOT NULL
+    When executing query:
+      """
+      YIELD NULL IS NULL AS a, 1 IS NULL AS b, NULL IS NOT NULL AS c, "x" IS NOT NULL AS d
+      """
+    Then the result should be, in order:
+      | a    | b     | c     | d    |
+      | true | false | false | true |
+
+  Scenario: null in IN lists
+    When executing query:
+      """
+      YIELD 1 IN [1, NULL] AS a, 2 IN [1, NULL] AS b, NULL IN [1, 2] AS c
+      """
+    Then the result should be, in order:
+      | a    | b    | c    |
+      | true | NULL | NULL |
+
+  Scenario: null equality vs identity
+    When executing query:
+      """
+      YIELD NULL == NULL AS a, NULL != NULL AS b, NULL >= 1 AS c
+      """
+    Then the result should be, in order:
+      | a    | b    | c    |
+      | NULL | NULL | NULL |
+
+  Scenario: coalesce picks first non-null
+    When executing query:
+      """
+      YIELD coalesce(NULL, 2, 3) AS a, coalesce(NULL, NULL) AS b, coalesce("x", 1) AS c
+      """
+    Then the result should be, in order:
+      | a | b    | c   |
+      | 2 | NULL | "x" |
+
+  Scenario: null propagates through string functions
+    When executing query:
+      """
+      YIELD upper(NULL) AS a, length(NULL) AS b, substr(NULL, 1, 2) AS c
+      """
+    Then the result should be, in order:
+      | a    | b    | c    |
+      | NULL | NULL | NULL |
+
+  Scenario: null propagates through unary minus and size
+    When executing query:
+      """
+      YIELD -NULL AS a, size(NULL) AS b
+      """
+    Then the result should be, in order:
+      | a    | b    |
+      | NULL | NULL |
+
+  Scenario: XOR three-valued
+    When executing query:
+      """
+      YIELD true XOR NULL AS a, false XOR NULL AS b, true XOR false AS c, true XOR true AS d
+      """
+    Then the result should be, in order:
+      | a    | b    | c    | d     |
+      | NULL | NULL | true | false |
+
+  Scenario: WHERE null drops rows
+    Given having executed:
+      """
+      CREATE SPACE ns1(partition_num=4, vid_type=INT64);
+      USE ns1;
+      CREATE TAG t(x int);
+      INSERT VERTEX t(x) VALUES 1:(10), 2:(20), 3:(30)
+      """
+    When executing query:
+      """
+      FETCH PROP ON t 1, 2, 3 YIELD t.x AS x | YIELD $-.x AS x WHERE $-.x + NULL > 0
+      """
+    Then the result should be empty
+
+  Scenario: null ordering in ORDER BY puts nulls last ascending
+    Given having executed:
+      """
+      CREATE SPACE ns2(partition_num=4, vid_type=INT64);
+      USE ns2;
+      CREATE TAG t(x int);
+      INSERT VERTEX t(x) VALUES 1:(3), 2:(1)
+      """
+    When executing query:
+      """
+      FETCH PROP ON t 1, 2 YIELD t.x AS x | YIELD $-.x AS x, CASE WHEN $-.x > 2 THEN NULL ELSE $-.x END AS y | ORDER BY $-.y
+      """
+    Then the result should be, in order:
+      | x | y    |
+      | 1 | 1    |
+      | 3 | NULL |
+
+  Scenario: missing property yields UNKNOWN_PROP null
+    Given having executed:
+      """
+      CREATE SPACE ns3(partition_num=4, vid_type=INT64);
+      USE ns3;
+      CREATE TAG t(x int);
+      CREATE EDGE e(w int);
+      INSERT VERTEX t(x) VALUES 1:(10), 2:(20);
+      INSERT EDGE e(w) VALUES 1->2:(5)
+      """
+    When executing query:
+      """
+      MATCH (v:t) WHERE id(v) == 1 RETURN v.t.nosuch AS p
+      """
+    Then the result should be, in any order:
+      | p                |
+      | __UNKNOWN_PROP__ |
+
+  Scenario: unknown edge property in GO is a semantic error
+    Given having executed:
+      """
+      CREATE SPACE ns5(partition_num=4, vid_type=INT64);
+      USE ns5;
+      CREATE TAG t(x int);
+      CREATE EDGE e(w int);
+      INSERT VERTEX t(x) VALUES 1:(10), 2:(20);
+      INSERT EDGE e(w) VALUES 1->2:(5)
+      """
+    When executing query:
+      """
+      GO FROM 1 OVER e YIELD e.nosuch AS p
+      """
+    Then a SemanticError should be raised
+
+  Scenario: comparing mismatched types yields null not error
+    When executing query:
+      """
+      YIELD 1 < "a" AS a, true > 0 AS b
+      """
+    Then the result should be, in order:
+      | a            | b            |
+      | __BAD_TYPE__ | __BAD_TYPE__ |
+
+  Scenario: null in arithmetic chain stays null
+    When executing query:
+      """
+      YIELD (1 + NULL) * 3 AS a, abs(NULL) AS b
+      """
+    Then the result should be, in order:
+      | a    | b    |
+      | NULL | NULL |
+
+  Scenario: CASE with null condition takes else
+    When executing query:
+      """
+      YIELD CASE WHEN NULL THEN 1 ELSE 2 END AS a
+      """
+    Then the result should be, in order:
+      | a |
+      | 2 |
+
+  Scenario: list with nulls keeps them
+    When executing query:
+      """
+      YIELD size([1, NULL, 3]) AS a, head([NULL, 1]) AS b
+      """
+    Then the result should be, in order:
+      | a | b    |
+      | 3 | NULL |
+
+  Scenario: null vertex property in MATCH filter drops row
+    Given having executed:
+      """
+      CREATE SPACE ns4(partition_num=4, vid_type=INT64);
+      USE ns4;
+      CREATE TAG p(age int NULL);
+      INSERT VERTEX p(age) VALUES 1:(30), 2:(NULL)
+      """
+    When executing query:
+      """
+      MATCH (v:p) WHERE v.p.age > 10 RETURN id(v) AS i
+      """
+    Then the result should be, in any order:
+      | i |
+      | 1 |

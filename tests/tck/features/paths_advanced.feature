@@ -1,0 +1,135 @@
+Feature: Path finding and subgraph advanced
+
+  Background:
+    Given having executed:
+      """
+      CREATE SPACE pa(partition_num=4, vid_type=FIXED_STRING(8));
+      USE pa;
+      CREATE TAG spot(name string);
+      CREATE EDGE road(len int);
+      CREATE EDGE rail(speed int);
+      INSERT VERTEX spot(name) VALUES "a":("A"), "b":("B"), "c":("C"), "d":("D"), "e":("E"), "f":("F");
+      INSERT EDGE road(len) VALUES "a"->"b":(1), "b"->"c":(1), "a"->"c":(5), "c"->"d":(1), "d"->"a":(1), "b"->"e":(2);
+      INSERT EDGE rail(speed) VALUES "a"->"d":(300), "d"->"e":(200)
+      """
+
+  Scenario: shortest path length
+    When executing query:
+      """
+      FIND SHORTEST PATH FROM "a" TO "d" OVER road YIELD path AS p | YIELD length($-.p) AS l
+      """
+    Then the result should be, in order:
+      | l |
+      | 2 |
+
+  Scenario: shortest path over multiple edge types
+    When executing query:
+      """
+      FIND SHORTEST PATH FROM "a" TO "e" OVER road, rail YIELD path AS p | YIELD length($-.p) AS l
+      """
+    Then the result should be, in any order:
+      | l |
+      | 2 |
+      | 2 |
+
+  Scenario: all paths up to 3 steps
+    When executing query:
+      """
+      FIND ALL PATH FROM "a" TO "c" OVER road UPTO 3 STEPS YIELD path AS p | YIELD length($-.p) AS l | ORDER BY $-.l
+      """
+    Then the result should be, in order:
+      | l |
+      | 1 |
+      | 2 |
+
+  Scenario: noloop path excludes cycles back through start
+    When executing query:
+      """
+      FIND NOLOOP PATH FROM "a" TO "d" OVER road UPTO 5 STEPS YIELD path AS p | YIELD length($-.p) AS l | ORDER BY $-.l
+      """
+    Then the result should be, in order:
+      | l |
+      | 2 |
+      | 3 |
+
+  Scenario: path to unreachable target is empty
+    When executing query:
+      """
+      FIND SHORTEST PATH FROM "e" TO "a" OVER road YIELD path AS p
+      """
+    Then the result should be empty
+
+  Scenario: shortest path to multiple targets
+    When executing query:
+      """
+      FIND SHORTEST PATH FROM "a" TO "c", "e" OVER road YIELD path AS p | YIELD length($-.p) AS l | ORDER BY $-.l
+      """
+    Then the result should be, in order:
+      | l |
+      | 1 |
+      | 2 |
+
+  Scenario: bidirect shortest path
+    When executing query:
+      """
+      FIND SHORTEST PATH FROM "e" TO "a" OVER road BIDIRECT YIELD path AS p | YIELD length($-.p) AS l
+      """
+    Then the result should be, in order:
+      | l |
+      | 2 |
+
+  Scenario: subgraph one step vertices
+    When executing query:
+      """
+      GET SUBGRAPH 1 STEPS FROM "a" OUT road YIELD vertices AS nodes | YIELD size($-.nodes) AS n
+      """
+    Then the result should be, in order:
+      | n |
+      | 1 |
+      | 2 |
+
+  Scenario: subgraph with edges yield
+    When executing query:
+      """
+      GET SUBGRAPH 1 STEPS FROM "a" OUT road YIELD vertices AS nodes, edges AS rels | YIELD size($-.rels) AS r
+      """
+    Then the result should be, in order:
+      | r |
+      | 2 |
+      | 1 |
+
+  Scenario: subgraph both directions includes incoming
+    When executing query:
+      """
+      GET SUBGRAPH 1 STEPS FROM "a" BOTH road YIELD vertices AS nodes | YIELD size($-.nodes) AS n
+      """
+    Then the result should be, in order:
+      | n |
+      | 1 |
+      | 3 |
+
+  Scenario: subgraph zero steps is just the seed
+    When executing query:
+      """
+      GET SUBGRAPH 0 STEPS FROM "a" YIELD vertices AS nodes | YIELD size($-.nodes) AS n
+      """
+    Then the result should be, in order:
+      | n |
+      | 1 |
+
+  Scenario: path nodes and relationships functions
+    When executing query:
+      """
+      FIND SHORTEST PATH FROM "a" TO "c" OVER road YIELD path AS p | YIELD size(nodes($-.p)) AS n, size(relationships($-.p)) AS r
+      """
+    Then the result should be, in order:
+      | n | r |
+      | 2 | 1 |
+  Scenario: all path with where on edge property
+    When executing query:
+      """
+      FIND ALL PATH FROM "a" TO "c" OVER road WHERE road.len < 5 UPTO 3 STEPS YIELD path AS p | YIELD length($-.p) AS l
+      """
+    Then the result should be, in order:
+      | l |
+      | 2 |

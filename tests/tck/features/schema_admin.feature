@@ -1,0 +1,157 @@
+Feature: Schema DDL and admin statements
+
+  Background:
+    Given having executed:
+      """
+      CREATE SPACE sa(partition_num=4, vid_type=INT64);
+      USE sa;
+      CREATE TAG person(name string, age int);
+      CREATE EDGE knows(since int)
+      """
+
+  Scenario: show tags and edges
+    When executing query:
+      """
+      SHOW TAGS
+      """
+    Then the result should be, in any order:
+      | Name     |
+      | "person" |
+
+  Scenario: describe tag lists fields
+    When executing query:
+      """
+      DESCRIBE TAG person
+      """
+    Then the result should be, in any order:
+      | Field  | Type     | Null  | Default |
+      | "name" | "string" | "YES" | NULL    |
+      | "age"  | "int64"  | "YES" | NULL    |
+
+  Scenario: alter tag add column
+    When executing query:
+      """
+      ALTER TAG person ADD (city string);
+      INSERT VERTEX person(name, age, city) VALUES 1:("Ann", 30, "Oslo");
+      FETCH PROP ON person 1 YIELD person.city AS c
+      """
+    Then the result should be, in order:
+      | c      |
+      | "Oslo" |
+
+  Scenario: alter tag drop column
+    When executing query:
+      """
+      ALTER TAG person DROP (age);
+      INSERT VERTEX person(name) VALUES 2:("Bob");
+      FETCH PROP ON person 2 YIELD person.name AS n
+      """
+    Then the result should be, in order:
+      | n     |
+      | "Bob" |
+
+  Scenario: create tag if not exists is idempotent
+    When executing query:
+      """
+      CREATE TAG IF NOT EXISTS person(name string);
+      SHOW TAGS
+      """
+    Then the result should be, in any order:
+      | Name     |
+      | "person" |
+
+  Scenario: duplicate create tag errors
+    When executing query:
+      """
+      CREATE TAG person(x int)
+      """
+    Then an ExecutionError should be raised
+
+  Scenario: drop tag removes it
+    When executing query:
+      """
+      CREATE TAG tmp(x int);
+      DROP TAG tmp;
+      SHOW TAGS
+      """
+    Then the result should be, in any order:
+      | Name     |
+      | "person" |
+
+  Scenario: create and show index
+    When executing query:
+      """
+      CREATE TAG INDEX person_age ON person(age);
+      SHOW TAG INDEXES
+      """
+    Then the result should be, in any order:
+      | Index Name   | By Tag   | Columns |
+      | "person_age" | "person" | ["age"] |
+
+  Scenario: lookup via index after rebuild
+    When executing query:
+      """
+      CREATE TAG INDEX person_age2 ON person(age);
+      INSERT VERTEX person(name, age) VALUES 5:("Eve", 33), 6:("Fox", 20);
+      REBUILD TAG INDEX person_age2;
+      LOOKUP ON person WHERE person.age > 25 YIELD id(vertex) AS i
+      """
+    Then the result should be, in any order:
+      | i |
+      | 5 |
+
+  Scenario: show spaces contains the space
+    When executing query:
+      """
+      SHOW SPACES
+      """
+    Then the result should be, in any order:
+      | Name |
+      | "sa" |
+
+  Scenario: describe edge
+    When executing query:
+      """
+      DESCRIBE EDGE knows
+      """
+    Then the result should be, in any order:
+      | Field   | Type    | Null  | Default |
+      | "since" | "int64" | "YES" | NULL    |
+
+  Scenario: show create tag roundtrip
+    When executing query:
+      """
+      SHOW CREATE TAG person
+      """
+    Then the result should be, in any order:
+      | Tag      | Create Tag                                                   |
+      | "person" | "CREATE TAG `person` (`name` string NULL, `age` int64 NULL)" |
+
+  Scenario: ttl on tag expires rows
+    When executing query:
+      """
+      CREATE TAG session_t(started timestamp) TTL_DURATION = 1, TTL_COL = "started";
+      SHOW TAGS
+      """
+    Then the result should be, in any order:
+      | Name        |
+      | "person"    |
+      | "session_t" |
+
+  Scenario: unknown space errors
+    When executing query:
+      """
+      USE nosuchspace
+      """
+    Then a SemanticError should be raised
+
+  Scenario: drop space removes it
+    When executing query:
+      """
+      CREATE SPACE scratch(partition_num=2, vid_type=INT64);
+      DROP SPACE scratch;
+      SHOW SPACES
+      """
+    Then the result should be, in any order:
+      | Name |
+      | "sa" |

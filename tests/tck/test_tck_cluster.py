@@ -1,6 +1,14 @@
-"""Cluster-mode conformance: the GO feature runs scenario-by-scenario
-against a real multi-process-shaped LocalCluster (fresh cluster per
-scenario for isolation) — same assertions as the in-process modes."""
+"""Cluster-mode conformance: EVERY feature file runs against a real
+multi-process-shaped LocalCluster — same assertions as the in-process
+modes (VERDICT r1 item 9: cluster TCK must cover all features, not just
+GO).
+
+One cluster per feature file (startup is the expensive part); isolation
+between scenarios is restored by dropping every space the scenario
+created.  Spaces are created via the wrapped execute() below, which also
+triggers storage part reconciliation the way the real deployment's
+meta→storage heartbeat loop would.
+"""
 import glob
 import os
 
@@ -9,39 +17,50 @@ import pytest
 from .runner import parse_feature, run_scenario
 
 _DIR = os.path.join(os.path.dirname(__file__), "features")
-with open(os.path.join(_DIR, "go.feature")) as _f:
-    _SCN = parse_feature(_f.read())
+_FILES = sorted(glob.glob(os.path.join(_DIR, "*.feature")))
 
 
 class _ClientEngine:
     """Adapts GraphClient to the (engine, session) protocol the runner
     drives."""
 
-    def __init__(self, client):
+    def __init__(self, client, cluster):
         self.client = client
+        self.cluster = cluster
 
     def execute(self, _session, stmt):
-        return self.client.execute(stmt)
+        rs = self.client.execute(stmt)
+        if stmt.strip().upper().startswith("CREATE SPACE"):
+            self.cluster.reconcile_storage()
+        return rs
 
 
 @pytest.mark.parametrize(
-    "scn", _SCN, ids=[s.name.replace(" ", "_") for s in _SCN])
-def test_go_feature_on_cluster(scn, tmp_path):
+    "path", _FILES, ids=[os.path.basename(p).replace(".feature", "")
+                         for p in _FILES])
+def test_feature_on_cluster(path, tmp_path):
+    with open(path) as f:
+        scenarios = parse_feature(f.read())
     from nebula_tpu.cluster.launcher import LocalCluster
     c = LocalCluster(n_meta=1, n_storage=2, n_graph=1,
                      data_dir=str(tmp_path))
     try:
         client = c.client()
-
-        # cluster spaces need storage parts reconciled after CREATE SPACE;
-        # wrap execute to trigger reconcile on DDL
-        class _E(_ClientEngine):
-            def execute(self, sess, stmt):
-                rs = super().execute(sess, stmt)
-                if stmt.strip().upper().startswith("CREATE SPACE"):
-                    c.reconcile_storage()
-                return rs
-
-        run_scenario(scn, lambda: (_E(client), None))
+        eng = _ClientEngine(client, c)
+        failures = []
+        for scn in scenarios:
+            try:
+                run_scenario(scn, lambda: (eng, None))
+            except Exception as ex:     # noqa: BLE001 — aggregate, don't
+                # abort the rest of the file on a non-assert failure
+                failures.append(f"{scn.name}: {type(ex).__name__}: {ex}")
+            finally:
+                rs = client.execute("SHOW SPACES")
+                if rs.error is None:
+                    for (name,) in rs.data.rows:
+                        client.execute(f"DROP SPACE IF EXISTS {name}")
+        assert not failures, (
+            f"{len(failures)}/{len(scenarios)} scenarios failed:\n"
+            + "\n".join(failures))
     finally:
         c.stop()
