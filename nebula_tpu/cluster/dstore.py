@@ -31,7 +31,9 @@ class CatalogProxy:
 
     _MUTATORS = frozenset({
         "create_tag", "create_edge", "alter_tag", "alter_edge",
-        "drop_tag", "drop_edge", "create_index", "drop_index"})
+        "drop_tag", "drop_edge", "create_index", "drop_index",
+        "create_user", "drop_user", "alter_user", "change_password",
+        "grant_role", "revoke_role"})
 
     def __init__(self, meta: MetaClient):
         object.__setattr__(self, "_meta", meta)

@@ -69,6 +69,27 @@ class GraphService:
 
     # -- RPC --------------------------------------------------------------
 
+    def _check_password(self, user: str, pwd: str) -> bool:
+        """An EXPLICITLY injected users map (constructor arg, the test
+        harness / static-config path) wins for the accounts it names —
+        the catalog always contains a default root, which must not
+        override an operator-configured root password.  Every other
+        account is checked against the meta-replicated user catalog
+        (CREATE USER / ALTER USER), with NO static fallback — a rotated
+        password's predecessor stays dead."""
+        if self._users_explicit and user in self.users:
+            return self.users[user] == pwd
+        from ..graphstore.schema import SchemaError
+        try:
+            udesc = self.store.catalog.get_user(user)
+        except (SchemaError, KeyError):
+            udesc = None
+        except Exception:  # noqa: BLE001 — meta unreachable: fail closed
+            return False
+        if udesc is not None:
+            return udesc.check_password(pwd)
+        return self.users.get(user) == pwd
+
     @property
     def auth_required(self) -> bool:
         # live: UPDATE CONFIGS enable_authorize must take effect on a
@@ -80,7 +101,7 @@ class GraphService:
     def rpc_authenticate(self, p):
         user = p.get("user", "root")
         pwd = p.get("password", "")
-        if self.auth_required and self.users.get(user) != pwd:
+        if self.auth_required and not self._check_password(user, pwd):
             raise RpcError("Bad username/password")
         sid = self.meta.create_session(user, self.my_addr)
         sess = Session(user)

@@ -35,7 +35,9 @@ HB_EXPIRE_S = 10.0
 # catalog methods a DDL command may invoke on replicas
 _CATALOG_METHODS = frozenset({
     "create_tag", "create_edge", "alter_tag", "alter_edge",
-    "drop_tag", "drop_edge", "create_index", "drop_index"})
+    "drop_tag", "drop_edge", "create_index", "drop_index",
+    "create_user", "drop_user", "alter_user", "change_password",
+    "grant_role", "revoke_role"})
 
 
 def _pk(obj) -> str:

@@ -107,6 +107,13 @@ class QueryEngine:
 
     def _execute_inner(self, session: Session, stmt: A.Sentence,
                        text: str, t0: float) -> ResultSet:
+        from ..utils.config import get_config
+        if get_config().get("enable_authorize"):
+            from .permissions import check as _perm_check
+            msg = _perm_check(stmt, session.user, self.qctx.store.catalog,
+                              session.space)
+            if msg:
+                return ResultSet(error=f"PermissionError: {msg}")
         profile_stats: Optional[ProfileStats] = None
         explain_only = False
         if isinstance(stmt, A.ExplainSentence):

@@ -1158,6 +1158,14 @@ def _show(node, qctx, ectx, space):
         items = cat.tags(sp) if kind == "tags" else cat.edges(sp)
         return DataSet(["Name"], [[t.name] for t in
                                   sorted(items, key=lambda x: x.name)])
+    if kind == "users":
+        return DataSet(["Account"], [[n] for n in sorted(cat.users)])
+    if kind == "roles":
+        sp = a.get("extra")
+        cat.get_space(sp)
+        rows = [[n, u.roles[sp]] for n, u in sorted(cat.users.items())
+                if sp in u.roles]
+        return DataSet(["Account", "Role Type"], rows)
     if kind in ("tag_indexes", "edge_indexes"):
         sp = a.get("space")
         want_edge = kind == "edge_indexes"
@@ -1243,6 +1251,48 @@ def _show(node, qctx, ectx, space):
         return DataSet([kw.title(), f"Create {kw.title()}"],
                        [[name, f"CREATE {kw} `{name}` (" + ", ".join(parts) + ")"]])
     raise ExecError(f"unsupported SHOW {kind}")
+
+
+@executor("CreateUser")
+def _create_user(node, qctx, ectx, space):
+    a = node.args
+    qctx.catalog.create_user(a["name"], a["password"], a["if_not_exists"])
+    return DataSet()
+
+
+@executor("DropUser")
+def _drop_user(node, qctx, ectx, space):
+    a = node.args
+    qctx.catalog.drop_user(a["name"], a["if_exists"])
+    return DataSet()
+
+
+@executor("AlterUser")
+def _alter_user(node, qctx, ectx, space):
+    a = node.args
+    qctx.catalog.alter_user(a["name"], a["password"])
+    return DataSet()
+
+
+@executor("ChangePassword")
+def _change_password(node, qctx, ectx, space):
+    a = node.args
+    qctx.catalog.change_password(a["name"], a["old"], a["new"])
+    return DataSet()
+
+
+@executor("GrantRole")
+def _grant_role(node, qctx, ectx, space):
+    a = node.args
+    qctx.catalog.grant_role(a["user"], a["space"], a["role"])
+    return DataSet()
+
+
+@executor("RevokeRole")
+def _revoke_role(node, qctx, ectx, space):
+    a = node.args
+    qctx.catalog.revoke_role(a["user"], a["space"], a["role"])
+    return DataSet()
 
 
 @executor("UpdateConfigs")

@@ -59,6 +59,15 @@ class StringPool:
         (matches nothing, unlike the null sentinel -1)."""
         return self.codes.get(s, -2)
 
+    def obj_array(self) -> "np.ndarray":
+        """Cached object-dtype view of the dictionary for batched decode
+        (rebuilding it per decode call would be O(|pool|) per query)."""
+        arr = getattr(self, "_obj_arr", None)
+        if arr is None or len(arr) != len(self.strings):
+            arr = np.asarray(self.strings, dtype=object)
+            self._obj_arr = arr
+        return arr
+
     def decode(self, c: int) -> Optional[str]:
         if 0 <= c < len(self.strings):
             return self.strings[c]
@@ -108,18 +117,26 @@ def decode_prop_column(pt: PropType, raw: "np.ndarray",
     edges per query)."""
     from ..core.value import NULL
     if pt in (PropType.FLOAT, PropType.DOUBLE):
-        return [NULL if x != x else x
-                for x in raw.astype(np.float64).tolist()]
-    vals = raw.astype(np.int64).tolist()
+        a = raw.astype(np.float64)
+        if not np.isnan(a).any():       # no-null fast path: one C tolist
+            return a.tolist()
+        return [NULL if x != x else x for x in a.tolist()]
+    av = raw.astype(np.int64)
     if pt in (PropType.STRING, PropType.FIXED_STRING):
         strings = pool.strings
         ns = len(strings)
+        if av.size and ((av >= 0) & (av < ns)).all():
+            return pool.obj_array()[av].tolist()
+        vals = av.tolist()
         return [strings[r] if 0 <= r < ns else NULL for r in vals]
+    vals = av.tolist()
     if pt == PropType.BOOL:
         return [NULL if r == INT_NULL else bool(r) for r in vals]
     if pt in (PropType.DATE, PropType.DATETIME, PropType.TIME,
               PropType.DURATION):
         return [decode_prop(pt, r, pool) for r in vals]
+    if not (av == INT_NULL).any():      # no-null fast path
+        return vals
     return [NULL if r == INT_NULL else r for r in vals]
 
 

@@ -152,11 +152,40 @@ class SpaceDesc:
         return self.vid_type.strip().upper().startswith("INT")
 
 
+ROLES = ("GOD", "ADMIN", "DBA", "USER", "GUEST")
+ROLE_RANK = {r: i for i, r in enumerate(reversed(ROLES))}
+
+
+def hash_password(pw: str) -> str:
+    import hashlib
+    return hashlib.sha256(("nebula::" + pw).encode()).hexdigest()
+
+
+class UserDesc:
+    """One account: password hash + per-space role grants.  The root
+    account carries the global GOD role (space key "")."""
+    __slots__ = ("name", "pwd_hash", "roles")
+
+    def __init__(self, name: str, pwd_hash: str,
+                 roles: Optional[Dict[str, str]] = None):
+        self.name = name
+        self.pwd_hash = pwd_hash
+        self.roles = dict(roles or {})
+
+    def check_password(self, pw: str) -> bool:
+        return self.pwd_hash == hash_password(pw)
+
+
 class Catalog:
-    """Space/tag/edge catalog — the metad schema plane, single-process form.
+    """Space/tag/edge/user catalog — the metad schema plane,
+    single-process form.
 
     The cluster metad (nebula_tpu.cluster.meta) wraps this with Raft +
     heartbeat distribution; executors always read through this interface.
+    User/role management mirrors the reference's meta user plane
+    (PermissionManager's backing store; reference: src/meta processors
+    + src/graph/service/PermissionManager [UNVERIFIED — empty mount,
+    SURVEY §2 row 26]).
     """
 
     def __init__(self):
@@ -167,6 +196,76 @@ class Catalog:
         self._next_space = 1
         self._next_schema_id: Dict[int, int] = {}
         self.version = 0   # bumped on every DDL; clients use it for cache TTL
+        self.users: Dict[str, UserDesc] = {
+            "root": UserDesc("root", hash_password("nebula"), {"": "GOD"})}
+
+    # -- users / roles --
+    def create_user(self, name: str, password: str,
+                    if_not_exists=False) -> UserDesc:
+        if name in self.users:
+            if if_not_exists:
+                return self.users[name]
+            raise SchemaError(f"user `{name}' already exists")
+        u = UserDesc(name, hash_password(password))
+        self.users[name] = u
+        self.version += 1
+        return u
+
+    def drop_user(self, name: str, if_exists=False):
+        if name == "root":
+            raise SchemaError("the root user cannot be dropped")
+        if name not in self.users:
+            if if_exists:
+                return
+            raise SchemaError(f"user `{name}' not found")
+        del self.users[name]
+        self.version += 1
+
+    def get_user(self, name: str) -> UserDesc:
+        u = self.users.get(name)
+        if u is None:
+            raise SchemaError(f"user `{name}' not found")
+        return u
+
+    def alter_user(self, name: str, password: str):
+        self.get_user(name).pwd_hash = hash_password(password)
+        self.version += 1
+
+    def change_password(self, name: str, old: str, new: str):
+        u = self.get_user(name)
+        if not u.check_password(old):
+            raise SchemaError("old password mismatch")
+        u.pwd_hash = hash_password(new)
+        self.version += 1
+
+    def grant_role(self, user: str, space: str, role: str):
+        role = role.upper()
+        if role not in ROLES or role == "GOD":
+            raise SchemaError(f"role `{role}' cannot be granted")
+        self.get_space(space)
+        self.get_user(user).roles[space] = role
+        self.version += 1
+
+    def revoke_role(self, user: str, space: str, role: Optional[str] = None):
+        u = self.get_user(user)
+        cur = u.roles.get(space)
+        if cur is None:
+            raise SchemaError(
+                f"user `{user}' has no role on space `{space}'")
+        if role is not None and cur != role.upper():
+            raise SchemaError(
+                f"user `{user}' holds `{cur}' on `{space}', not "
+                f"`{role.upper()}'")
+        del u.roles[space]
+        self.version += 1
+
+    def role_of(self, user: str, space: Optional[str]) -> Optional[str]:
+        u = self.users.get(user)
+        if u is None:
+            return None
+        if u.roles.get("") == "GOD":
+            return "GOD"
+        return u.roles.get(space) if space else None
 
     # -- spaces --
     def create_space(self, name: str, partition_num=8, replica_factor=1,
@@ -194,6 +293,8 @@ class Catalog:
         self._tags.pop(sp.space_id, None)
         self._edges.pop(sp.space_id, None)
         self._indexes.pop(sp.space_id, None)
+        for u in self.users.values():
+            u.roles.pop(name, None)
         self.version += 1
         return sp
 

@@ -18,7 +18,7 @@ from typing import Any
 
 from ..core import wire
 from .schema import (Catalog, EdgeSchema, IndexDesc, PropDef, PropType,
-                     SchemaVersion, SpaceDesc, TagSchema)
+                     SchemaVersion, SpaceDesc, TagSchema, UserDesc)
 
 
 def to_jso(v: Any) -> Any:
@@ -43,8 +43,12 @@ def to_jso(v: Any) -> Any:
     if isinstance(v, IndexDesc):
         return {"@t": "indexdesc", "n": v.name, "sn": v.schema_name,
                 "f": list(v.fields), "e": v.is_edge, "id": v.index_id}
+    if isinstance(v, UserDesc):
+        return {"@t": "userdesc", "n": v.name, "p": v.pwd_hash,
+                "r": dict(v.roles)}
     if isinstance(v, Catalog):
         return {"@t": "catalog",
+                "users": {n: to_jso(u) for n, u in v.users.items()},
                 "spaces": {n: to_jso(sp) for n, sp in v.spaces.items()},
                 "tags": [[sid, {n: to_jso(t) for n, t in d.items()}]
                          for sid, d in v._tags.items()],
@@ -84,8 +88,12 @@ def from_jso(j: Any) -> Any:
         return SpaceDesc(j["n"], j["id"], j["pn"], j["rf"], j["vt"], j["c"])
     if t == "indexdesc":
         return IndexDesc(j["n"], j["sn"], list(j["f"]), j["e"], j["id"])
+    if t == "userdesc":
+        return UserDesc(j["n"], j["p"], j["r"])
     if t == "catalog":
         c = Catalog()
+        if "users" in j:        # pre-ACL snapshots keep the default root
+            c.users = {n: from_jso(u) for n, u in j["users"].items()}
         c.spaces = {n: from_jso(sp) for n, sp in j["spaces"].items()}
         c._tags = {sid: {n: from_jso(t_) for n, t_ in d.items()}
                    for sid, d in j["tags"]}

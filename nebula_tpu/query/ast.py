@@ -288,6 +288,46 @@ class DeleteTagsSentence(Sentence):
 
 
 @dataclass
+class CreateUserSentence(Sentence):
+    name: str
+    password: str
+    if_not_exists: bool = False
+
+
+@dataclass
+class DropUserSentence(Sentence):
+    name: str
+    if_exists: bool = False
+
+
+@dataclass
+class AlterUserSentence(Sentence):
+    name: str
+    password: str
+
+
+@dataclass
+class ChangePasswordSentence(Sentence):
+    name: str
+    old: str
+    new: str
+
+
+@dataclass
+class GrantRoleSentence(Sentence):
+    role: str
+    space: str
+    user: str
+
+
+@dataclass
+class RevokeRoleSentence(Sentence):
+    role: str
+    space: str
+    user: str
+
+
+@dataclass
 class UpdateConfigsSentence(Sentence):
     name: str
     value: Expr

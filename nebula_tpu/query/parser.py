@@ -92,7 +92,8 @@ class Parser:
         if t.kind == "IDENT":
             return self.next().value
         if allow_keywords and t.kind == "KEYWORD":
-            return self.next().value.lower()
+            t = self.next()
+            return t.raw or t.value.lower()
         raise ParseError(f"expected identifier, got {t.kind}({t.value!r}) at pos {t.pos}")
 
     # ---- program / composition ----
@@ -162,11 +163,40 @@ class Parser:
             "ORDER": self.p_order_by, "LIMIT": self.p_limit,
             "SAMPLE": self.p_sample, "REBUILD": self.p_rebuild,
             "SUBMIT": self.p_submit, "KILL": self.p_kill,
-            "UNWIND": self.p_match,
+            "UNWIND": self.p_match, "GRANT": self.p_grant,
+            "REVOKE": self.p_revoke, "CHANGE": self.p_change_password,
         }.get(kw)
         if fn is None:
             raise ParseError(f"unsupported statement `{kw}' at pos {t.pos}")
         return fn()
+
+    # ---- user management (reference: GRANT/REVOKE ROLE, CHANGE PASSWORD) --
+    def p_grant(self) -> A.GrantRoleSentence:
+        self.expect_kw("GRANT")
+        self.accept_kw("ROLE")
+        role = self.ident()
+        self.expect_kw("ON")
+        space = self.ident()
+        self.expect_kw("TO")
+        return A.GrantRoleSentence(role, space, self.ident())
+
+    def p_revoke(self) -> A.RevokeRoleSentence:
+        self.expect_kw("REVOKE")
+        self.accept_kw("ROLE")
+        role = self.ident()
+        self.expect_kw("ON")
+        space = self.ident()
+        self.expect_kw("FROM")
+        return A.RevokeRoleSentence(role, space, self.ident())
+
+    def p_change_password(self) -> A.ChangePasswordSentence:
+        self.expect_kw("CHANGE")
+        self.expect_kw("PASSWORD")
+        name = self.ident()
+        self.expect_kw("FROM")
+        old = self.expect("STRING").value
+        self.expect_kw("TO")
+        return A.ChangePasswordSentence(name, old, self.expect("STRING").value)
 
     # ---- GO ----
     def p_go(self) -> A.GoSentence:
@@ -359,7 +389,14 @@ class Parser:
             return A.CreateSchemaSentence(is_edge, name, props, ine, ttl_d, ttl_c, cmt)
         if self.accept_kw("SNAPSHOT"):
             return A.CreateSnapshotSentence()
-        raise ParseError("expected SPACE/TAG/EDGE/SNAPSHOT after CREATE")
+        if self.accept_kw("USER"):
+            ine = self.p_if_not_exists()
+            name = self.ident()
+            self.expect_kw("WITH")
+            self.expect_kw("PASSWORD")
+            pw = self.expect("STRING").value
+            return A.CreateUserSentence(name, pw, ine)
+        raise ParseError("expected SPACE/TAG/EDGE/SNAPSHOT/USER after CREATE")
 
     def p_if_not_exists(self) -> bool:
         if self.accept_kw("IF"):
@@ -432,10 +469,18 @@ class Parser:
             return A.DropSchemaSentence(is_edge, self.ident(), ife)
         if self.accept_kw("SNAPSHOT"):
             return A.DropSnapshotSentence(self.ident())
-        raise ParseError("expected SPACE/TAG/EDGE/SNAPSHOT after DROP")
+        if self.accept_kw("USER"):
+            ife = self.p_if_exists()
+            return A.DropUserSentence(self.ident(), ife)
+        raise ParseError("expected SPACE/TAG/EDGE/SNAPSHOT/USER after DROP")
 
-    def p_alter(self) -> A.AlterSchemaSentence:
+    def p_alter(self) -> A.Sentence:
         self.expect_kw("ALTER")
+        if self.accept_kw("USER"):
+            name = self.ident()
+            self.expect_kw("WITH")
+            self.expect_kw("PASSWORD")
+            return A.AlterUserSentence(name, self.expect("STRING").value)
         is_edge = self.expect_kw("TAG", "EDGE").value == "EDGE"
         name = self.ident()
         out = A.AlterSchemaSentence(is_edge, name)
@@ -479,9 +524,13 @@ class Parser:
                 if kw == "JOBS":
                     return A.ShowJobsSentence()
                 return A.ShowSentence(kw.lower())
-            if kw in ("TAGS", "EDGES"):
+            if kw in ("TAGS", "EDGES", "USERS"):
                 self.next()
                 return A.ShowSentence(kw.lower())
+            if kw == "ROLES":
+                self.next()
+                self.expect_kw("IN")
+                return A.ShowSentence("roles", self.ident())
             if kw in ("TAG", "EDGE"):
                 self.next()
                 if self.accept_kw("INDEXES"):
@@ -795,8 +844,9 @@ class Parser:
 
     def p_path_pattern(self) -> A.PathPattern:
         alias = None
-        if self.at("IDENT") and self.peek(1).kind == "=":
-            alias = self.next().value
+        if self.peek().kind in ("IDENT", "KEYWORD") \
+                and self.peek(1).kind == "=":
+            alias = self.ident()
             self.next()
         pat = A.PathPattern(alias=alias)
         pat.nodes.append(self.p_node_pattern())
@@ -840,8 +890,9 @@ class Parser:
         else:
             self.expect("-")
         if self.accept("["):
-            if self.at("IDENT") and self.peek(1).kind in (":", "]", "*", "{"):
-                ep.alias = self.next().value
+            if self.peek().kind in ("IDENT", "KEYWORD") \
+                    and self.peek(1).kind in (":", "]", "*", "{"):
+                ep.alias = self.ident()
             while self.accept(":"):
                 ep.types.append(self.ident())
                 while self.accept("|"):
@@ -1048,11 +1099,12 @@ class Parser:
             if t.value in ("VERTEX", "EDGE") and self.peek(1).kind != "(":
                 self.next()
                 return VertexExpr("vertex") if t.value == "VERTEX" else EdgeExpr()
-            # keyword used as function name or bare identifier
+            # keyword used as function name or bare identifier — keep the
+            # source spelling (a tag named `User`, prop named `role`)
             if self.peek(1).kind == "(":
                 return self.p_call(self.next().value.lower())
             self.next()
-            return LabelExpr(t.value.lower())
+            return LabelExpr(t.raw or t.value.lower())
         if t.kind == "$-":
             self.next()
             self.expect(".")
@@ -1167,10 +1219,13 @@ class Parser:
         self.expect("[")
         if self.accept("]"):
             return ListExpr([])
-        # lookahead: IDENT IN → comprehension
-        if (self.at("IDENT") and self.peek(1).kind == "KEYWORD"
+        # lookahead: IDENT IN → comprehension (the variable may be an
+        # unreserved keyword like `user`)
+        if (self.peek().kind in ("IDENT", "KEYWORD")
+                and self.peek().value not in ("TRUE", "FALSE", "NULL", "CASE")
+                and self.peek(1).kind == "KEYWORD"
                 and self.peek(1).value == "IN"):
-            var = self.next().value
+            var = self.ident()
             self.next()  # IN
             coll = self.parse_expr()
             where = None

@@ -742,6 +742,18 @@ def _plan_match_clause(pctx, mc: A.MatchClauseAst, current: Optional[PlanNode],
         else:
             node = PlanNode("CrossJoin", deps=[node, other],
                             col_names=node.col_names + other.col_names)
+    clause_edges = [ep.alias for pat in mc.patterns for ep in pat.edges]
+    if len(clause_edges) >= 2:
+        # Cypher relationship isomorphism scopes to the WHOLE MATCH
+        # clause: no edge binds two relationship variables across any of
+        # its comma patterns — including cycles through the dup-alias
+        # branch in _plan_pattern ((a)-[e1]-(b)-[e2]-(a) walking one
+        # edge out and back).
+        cond = FunctionCall("_edges_distinct",
+                            [LabelExpr(al) for al in clause_edges])
+        node = PlanNode("Filter", deps=[node],
+                        col_names=list(node.col_names),
+                        args={"condition": cond, "match_row": True})
     if current is not None:
         shared = [c for c in current.col_names if c in node.col_names]
         join_kind = "HashLeftJoin" if mc.optional else "HashInnerJoin"
@@ -863,14 +875,6 @@ def _plan_pattern(pctx, pat: A.PathPattern, where: Optional[Expr],
                            args={"columns": [(LabelExpr(c), c)
                                              for c in keep],
                                  "match_row": True})
-    if len(pat.edges) >= 2:
-        # Cypher relationship isomorphism: no edge binds twice within one
-        # pattern — including cycles through the dup-alias branch above
-        # (e.g. (a)-[e1]-(b)-[e2]-(a) walking one edge out and back).
-        cond = FunctionCall("_edges_distinct",
-                            [LabelExpr(ep.alias) for ep in pat.edges])
-        cur = PlanNode("Filter", deps=[cur], col_names=list(cur.col_names),
-                       args={"condition": cond, "match_row": True})
     if not pat.edges:
         # single-node pattern: ensure label presence already filtered
         if seed.labels and seed_vids is not None:
@@ -1207,6 +1211,19 @@ def _register_dispatch():
             "KillQuery", session_id=s.session_id, plan_id=s.plan_id),
         A.UpdateConfigsSentence: lambda p, s: _admin(
             "UpdateConfigs", name=s.name, value=s.value),
+        A.CreateUserSentence: lambda p, s: _admin(
+            "CreateUser", name=s.name, password=s.password,
+            if_not_exists=s.if_not_exists),
+        A.DropUserSentence: lambda p, s: _admin(
+            "DropUser", name=s.name, if_exists=s.if_exists),
+        A.AlterUserSentence: lambda p, s: _admin(
+            "AlterUser", name=s.name, password=s.password),
+        A.ChangePasswordSentence: lambda p, s: _admin(
+            "ChangePassword", name=s.name, old=s.old, new=s.new),
+        A.GrantRoleSentence: lambda p, s: _admin(
+            "GrantRole", role=s.role, space=s.space, user=s.user),
+        A.RevokeRoleSentence: lambda p, s: _admin(
+            "RevokeRole", role=s.role, space=s.space, user=s.user),
     })
 
 

@@ -29,6 +29,7 @@ KEYWORDS = {
     "BALANCE", "DATA", "LEADER", "SNAPSHOT", "SNAPSHOTS", "SESSION",
     "SESSIONS", "KILL", "QUERY", "QUERIES", "CONFIGS", "TTL_DURATION",
     "TTL_COL", "DEFAULT", "NULL", "COMMENT", "SAMPLE", "INGEST",
+    "USER", "USERS", "PASSWORD", "GRANT", "REVOKE", "ROLE", "ROLES",
     # types
     "INT", "INT64", "INT32", "INT16", "INT8", "FLOAT", "DOUBLE", "STRING",
     "FIXED_STRING", "BOOL", "TIMESTAMP", "DATE", "TIME", "DATETIME",
@@ -49,6 +50,10 @@ class Token(NamedTuple):
     kind: str         # 'KEYWORD' | 'IDENT' | 'STRING' | 'INT' | 'FLOAT' | op-text
     value: Any
     pos: int
+    raw: str = ""     # keyword tokens keep the source spelling so an
+                      # unreserved keyword used as an identifier (a tag
+                      # named `User`, a prop named `role`) round-trips
+                      # case-sensitively through Parser.ident()
 
     def __repr__(self):
         return f"{self.kind}({self.value!r})"
@@ -107,7 +112,7 @@ def tokenize(text: str) -> List[Token]:
             word = text[i:j]
             up = word.upper()
             if up in KEYWORDS:
-                toks.append(Token("KEYWORD", up, i))
+                toks.append(Token("KEYWORD", up, i, word))
             else:
                 toks.append(Token("IDENT", word, i))
             i = j

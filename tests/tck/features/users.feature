@@ -1,0 +1,115 @@
+Feature: User management
+
+  Background:
+    Given having executed:
+      """
+      CREATE SPACE ua(partition_num=2, vid_type=INT64);
+      USE ua;
+      CREATE TAG t(x int)
+      """
+
+  Scenario: create and show users
+    When executing query:
+      """
+      CREATE USER u1 WITH PASSWORD "pw1";
+      SHOW USERS
+      """
+    Then the result should be, in any order:
+      | Account |
+      | "root"  |
+      | "u1"    |
+
+  Scenario: create duplicate user errors
+    When executing query:
+      """
+      CREATE USER u2 WITH PASSWORD "x";
+      CREATE USER u2 WITH PASSWORD "y"
+      """
+    Then an ExecutionError should be raised
+
+  Scenario: if not exists is idempotent
+    When executing query:
+      """
+      CREATE USER u3 WITH PASSWORD "x";
+      CREATE USER IF NOT EXISTS u3 WITH PASSWORD "y";
+      SHOW USERS
+      """
+    Then the result should be, in any order:
+      | Account |
+      | "root"  |
+      | "u3"    |
+
+  Scenario: grant and show roles
+    When executing query:
+      """
+      CREATE USER u4 WITH PASSWORD "x";
+      GRANT ROLE DBA ON ua TO u4;
+      SHOW ROLES IN ua
+      """
+    Then the result should be, in any order:
+      | Account | Role Type |
+      | "u4"    | "DBA"     |
+
+  Scenario: regrant replaces the role
+    When executing query:
+      """
+      CREATE USER u5 WITH PASSWORD "x";
+      GRANT ROLE GUEST ON ua TO u5;
+      GRANT ROLE ADMIN ON ua TO u5;
+      SHOW ROLES IN ua
+      """
+    Then the result should be, in any order:
+      | Account | Role Type |
+      | "u5"    | "ADMIN"   |
+
+  Scenario: revoke removes the role
+    When executing query:
+      """
+      CREATE USER u6 WITH PASSWORD "x";
+      GRANT ROLE USER ON ua TO u6;
+      REVOKE ROLE USER ON ua FROM u6;
+      SHOW ROLES IN ua
+      """
+    Then the result should be empty
+
+  Scenario: grant god is refused
+    When executing query:
+      """
+      CREATE USER u7 WITH PASSWORD "x";
+      GRANT ROLE GOD ON ua TO u7
+      """
+    Then an ExecutionError should be raised
+
+  Scenario: grant on missing space errors
+    When executing query:
+      """
+      CREATE USER u8 WITH PASSWORD "x";
+      GRANT ROLE DBA ON nosuch TO u8
+      """
+    Then an ExecutionError should be raised
+
+  Scenario: drop user removes account
+    When executing query:
+      """
+      CREATE USER u9 WITH PASSWORD "x";
+      DROP USER u9;
+      SHOW USERS
+      """
+    Then the result should be, in any order:
+      | Account |
+      | "root"  |
+
+  Scenario: root cannot be dropped
+    When executing query:
+      """
+      DROP USER root
+      """
+    Then an ExecutionError should be raised
+
+  Scenario: change password verifies the old one
+    When executing query:
+      """
+      CREATE USER u10 WITH PASSWORD "first";
+      CHANGE PASSWORD u10 FROM "wrong" TO "second"
+      """
+    Then an ExecutionError should be raised

@@ -59,6 +59,11 @@ def test_feature_on_cluster(path, tmp_path):
                 if rs.error is None:
                     for (name,) in rs.data.rows:
                         client.execute(f"DROP SPACE IF EXISTS {name}")
+                rs = client.execute("SHOW USERS")
+                if rs.error is None:
+                    for (name,) in rs.data.rows:
+                        if name != "root":
+                            client.execute(f"DROP USER IF EXISTS {name}")
         assert not failures, (
             f"{len(failures)}/{len(scenarios)} scenarios failed:\n"
             + "\n".join(failures))
