@@ -1,0 +1,237 @@
+"""BALANCE DATA / BALANCE LEADER — the part-migration orchestrator.
+
+The reference runs balance as a metad job executing a plan of
+BalanceTasks (add learner → catch up → member change → remove;
+reference: src/meta/processors/job/BalancePlan+BalanceTask [UNVERIFIED —
+empty mount, SURVEY §2 row 17]).  Same protocol here, driven from the
+graphd job manager through meta + storage RPCs:
+
+  BALANCE DATA, per part:
+    phase A (add):    part map gains the new replica → storageds
+                      reconcile → the new member joins the raft group and
+                      catches up from the leader (snapshot install)
+    phase B (lead):   if the leader is being removed, transfer
+                      leadership to a surviving replica (TimeoutNow)
+    phase C (remove): part map drops the old replica → its storaged
+                      stops the raft member and releases the part state
+
+  Every map change is serialized through the metad raft group, and each
+  step adds OR removes (never both), so consecutive raft configurations
+  always share a quorum.
+
+  BALANCE LEADER: greedy leader spreading — count leaders per alive
+  host, transfer from over- to under-loaded replicas.
+"""
+from __future__ import annotations
+
+import time
+from collections import Counter
+from typing import Any, Dict, List, Optional
+
+CATCHUP_TIMEOUT_S = 30.0
+
+
+class BalanceError(Exception):
+    pass
+
+
+def _alive_storage(meta) -> List[str]:
+    return sorted(h["addr"] for h in meta.list_hosts()
+                  if h["role"] == "storage" and h["alive"])
+
+
+def _reconcile(sc, hosts: List[str]):
+    for h in hosts:
+        try:
+            sc._client(h).call("storage.reconcile")
+        except Exception:  # noqa: BLE001 — host may be mid-death
+            pass
+
+
+def _raft_info(sc, host: str, space: str, pid: int) -> Optional[Dict]:
+    try:
+        return sc._client(host).call("storage.part_raft_info",
+                                     space=space, part=pid)
+    except Exception:  # noqa: BLE001
+        return None
+
+
+def _find_leader(sc, hosts: List[str], space: str, pid: int
+                 ) -> Optional[str]:
+    for h in hosts:
+        info = _raft_info(sc, h, space, pid)
+        if info and info["is_leader"]:
+            return h
+    return None
+
+
+def _wait_caught_up(sc, host: str, leader: str, space: str, pid: int,
+                    timeout: float = CATCHUP_TIMEOUT_S):
+    """Poll the new replica until its applied index reaches the leader's
+    commit index as of entry."""
+    li = _raft_info(sc, leader, space, pid)
+    target = li["commit_index"] if li else 0
+    dl = time.monotonic() + timeout
+    while time.monotonic() < dl:
+        info = _raft_info(sc, host, space, pid)
+        if info and info["last_applied"] >= target:
+            return
+        time.sleep(0.05)
+    raise BalanceError(
+        f"replica {host} of {space}/{pid} did not catch up to {target}")
+
+
+def _transfer_leader(meta, sc, space: str, pid: int, hosts: List[str],
+                     to: str, timeout: float = 10.0) -> bool:
+    cur = _find_leader(sc, hosts, space, pid)
+    if cur == to:
+        meta.transfer_leader(space, pid, to)
+        return True
+    if cur is None:
+        return False
+    try:
+        sc._client(cur).call("storage.transfer_part_leader",
+                             space=space, part=pid, to=to)
+    except Exception:  # noqa: BLE001
+        return False
+    dl = time.monotonic() + timeout
+    while time.monotonic() < dl:
+        info = _raft_info(sc, to, space, pid)
+        if info and info["is_leader"]:
+            meta.transfer_leader(space, pid, to)
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def _spaces(meta, space: Optional[str]) -> List[str]:
+    if space:
+        return [space]
+    return sorted(n for n in meta.catalog.spaces)
+
+
+def balance_data(store, space: Optional[str] = None) -> Dict[str, Any]:
+    """Heal under-replication (dead hosts), spread parts over new hosts,
+    drop dead replicas.  Returns the executed plan."""
+    meta, sc = store.meta, store.sc
+    alive = _alive_storage(meta)
+    if not alive:
+        raise BalanceError("no alive storage hosts")
+    plan: List[Dict[str, Any]] = []
+    for sp_name in _spaces(meta, space):
+        pm = meta.parts_of(sp_name)
+        rf = min(meta.catalog.spaces[sp_name].replica_factor, len(alive))
+        load = Counter(r for reps in pm for r in reps if r in alive)
+        for h in alive:
+            load.setdefault(h, 0)
+        # target replicas per host for an even spread
+        total = len(pm) * rf
+        cap = -(-total // len(alive))       # ceil
+        for pid in range(len(pm)):
+            replicas = list(meta.parts_of(sp_name)[pid])
+            keep = [r for r in replicas if r in alive]
+            # ---- heal: fill to rf on least-loaded hosts
+            while len(keep) < rf:
+                cands = [h for h in alive if h not in keep]
+                if not cands:
+                    break
+                tgt = min(cands, key=lambda h: load[h])
+                _add_replica(meta, sc, sp_name, pid, replicas, tgt, alive)
+                keep.append(tgt)
+                replicas.append(tgt)
+                load[tgt] += 1
+                plan.append({"space": sp_name, "part": pid, "op": "add",
+                             "host": tgt})
+            # ---- migrate off overloaded hosts
+            for src in [r for r in keep if load[r] > cap]:
+                cands = [h for h in alive
+                         if h not in keep and load[h] < cap]
+                if not cands:
+                    continue
+                tgt = min(cands, key=lambda h: load[h])
+                _add_replica(meta, sc, sp_name, pid, replicas, tgt, alive)
+                replicas.append(tgt)
+                keep = [h for h in keep if h != src] + [tgt]
+                load[tgt] += 1
+                load[src] -= 1
+                plan.append({"space": sp_name, "part": pid, "op": "move",
+                             "from": src, "to": tgt})
+            # ---- remove dead + migrated-away replicas, ONE per step:
+            # the raft safety argument (update_peers) needs every pair of
+            # consecutive configurations to share a quorum, which single
+            # removals guarantee and batch removals do not
+            current = list(replicas)
+            for drop in [r for r in replicas if r not in keep]:
+                leader = _find_leader(sc, keep, sp_name, pid)
+                if leader is None:
+                    # leader is being removed (or died): hand off first
+                    if not _transfer_leader(meta, sc, sp_name, pid,
+                                            current, keep[0]):
+                        raise BalanceError(
+                            f"cannot move leadership of {sp_name}/{pid} "
+                            f"into the surviving set {keep}")
+                    leader = keep[0]
+                current = [h for h in current if h != drop]
+                ordered = [leader] + [h for h in current if h != leader]
+                meta.set_part_replicas(sp_name, pid, ordered)
+                _reconcile(sc, sorted(set(alive + [drop])))
+                current = ordered
+                plan.append({"space": sp_name, "part": pid, "op": "shrink",
+                             "dropped": drop, "replicas": ordered})
+    return {"plan": plan, "alive_hosts": alive}
+
+
+def _add_replica(meta, sc, space: str, pid: int, replicas: List[str],
+                 tgt: str, alive: List[str]):
+    meta.set_part_replicas(space, pid, list(replicas) + [tgt])
+    _reconcile(sc, alive)
+    live = [r for r in replicas if r in alive] + [tgt]
+    leader = _find_leader(sc, live, space, pid)
+    dl = time.monotonic() + CATCHUP_TIMEOUT_S
+    while leader is None and time.monotonic() < dl:
+        time.sleep(0.05)            # election in flight
+        leader = _find_leader(sc, live, space, pid)
+    if leader is None:
+        raise BalanceError(f"no leader for {space}/{pid} during add")
+    _wait_caught_up(sc, tgt, leader, space, pid)
+
+
+def balance_leader(store, space: Optional[str] = None) -> Dict[str, Any]:
+    """Spread raft leadership evenly over alive hosts."""
+    meta, sc = store.meta, store.sc
+    alive = set(_alive_storage(meta))
+    if not alive:
+        raise BalanceError("no alive storage hosts")
+    transfers: List[Dict[str, Any]] = []
+    for sp_name in _spaces(meta, space):
+        pm = meta.parts_of(sp_name)
+        lead_count: Counter = Counter()
+        leaders: Dict[int, Optional[str]] = {}
+        for pid, replicas in enumerate(pm):
+            cands = [r for r in replicas if r in alive]
+            ld = _find_leader(sc, cands, sp_name, pid)
+            leaders[pid] = ld
+            if ld:
+                lead_count[ld] += 1
+        for h in alive:
+            lead_count.setdefault(h, 0)
+        cap = -(-len(pm) // len(alive))     # ceil
+        for pid, replicas in enumerate(pm):
+            ld = leaders[pid]
+            cands = [r for r in replicas if r in alive]
+            if not cands:
+                continue
+            if ld is not None and lead_count[ld] <= cap:
+                continue
+            under = [c for c in cands if c != ld
+                     and lead_count[c] < cap]
+            if not under:
+                continue
+            tgt = min(under, key=lambda h: lead_count[h])
+            if _transfer_leader(meta, sc, sp_name, pid, cands, tgt):
+                if ld:
+                    lead_count[ld] -= 1
+                lead_count[tgt] += 1
+                transfers.append({"space": sp_name, "part": pid,
+                                  "from": ld, "to": tgt})
+    return {"transfers": transfers}

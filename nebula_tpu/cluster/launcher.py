@@ -100,6 +100,32 @@ class LocalCluster:
         c.authenticate(user, password)
         return c
 
+    def add_storaged(self) -> StorageService:
+        """Join a new storage host to the running cluster (the balance
+        test's expansion scenario)."""
+        i = len(self.storageds)
+        srv = RpcServer()
+        mc = MetaClient(self.meta_addrs, my_addr=srv.addr, role="storage",
+                        heartbeat_interval=0.2)
+        mc.wait_ready()
+        mc.refresh(force=True)
+        ss = StorageService(srv.addr, mc,
+                            os.path.join(self.data_dir, f"storage{i}"),
+                            server=srv)
+        srv.start()
+        ss.start()
+        mc.heartbeat_once()
+        self.storage_servers.append(srv)
+        self.storageds.append(ss)
+        self.meta_clients.append(mc)
+        return ss
+
+    def stop_storaged(self, i: int):
+        """Hard-stop one storage host (crash injection for balance /
+        failover tests)."""
+        self.storageds[i].stop()
+        self.storage_servers[i].stop()
+
     def reconcile_storage(self):
         """Force every storaged to (re)create raft groups for its parts —
         tests call this right after CREATE SPACE instead of waiting a

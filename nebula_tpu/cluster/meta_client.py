@@ -184,6 +184,17 @@ class MetaClient:
     def submit_job(self, cmd: str, space: Optional[str] = None) -> int:
         return self.call("meta.submit_job", cmd=cmd, space=space)
 
+    # -- balance plane (BALANCE DATA / BALANCE LEADER) --
+
+    def set_part_replicas(self, space: str, part: int, replicas):
+        self.call("meta.set_part_replicas", space=space, part=part,
+                  replicas=list(replicas))
+        self.refresh(force=True)
+
+    def transfer_leader(self, space: str, part: int, to: str):
+        self.call("meta.transfer_leader", space=space, part=part, to=to)
+        self.refresh(force=True)
+
     def list_jobs(self):
         return self.call("meta.list_jobs")
 

@@ -32,6 +32,16 @@ from .rpc import RpcError, RpcServer
 
 HB_EXPIRE_S = 10.0
 
+
+def _hb_expire_s() -> float:
+    """Liveness horizon — flag-tunable so failover tests don't wait 10s
+    of wall clock for a killed host to read as dead."""
+    try:
+        from ..utils.config import get_config
+        return float(get_config().get("host_hb_expire_secs"))
+    except Exception:  # noqa: BLE001 — config not initialized
+        return HB_EXPIRE_S
+
 # catalog methods a DDL command may invoke on replicas
 _CATALOG_METHODS = frozenset({
     "create_tag", "create_edge", "alter_tag", "alter_edge",
@@ -129,6 +139,15 @@ class MetaState:
                 replicas.remove(c["to"])
                 replicas.insert(0, c["to"])
 
+    def _ap_set_part_replicas(self, c):
+        """BALANCE DATA membership step: adopt a new replica list for one
+        part.  The orchestrator only ever proposes add-then-remove (one
+        side per step), so consecutive configurations share a quorum."""
+        pm = self.part_map.get(c["space"])
+        if pm is None or not (0 <= c["part"] < len(pm)):
+            raise RpcError(f"no part {c['space']}/{c['part']}")
+        pm[c["part"]] = list(c["replicas"])
+
 
 class MetaService:
     """One metad: raft member + RPC surface."""
@@ -219,16 +238,18 @@ class MetaService:
 
     def rpc_list_hosts(self, p):
         now = time.monotonic()
+        exp = _hb_expire_s()
         return [{"addr": a, "role": h["role"],
-                 "alive": now - h["last_hb"] < HB_EXPIRE_S,
+                 "alive": now - h["last_hb"] < exp,
                  "parts": h["parts"]}
                 for a, h in sorted(self.active_hosts.items())]
 
     def storage_hosts(self) -> List[str]:
         now = time.monotonic()
+        exp = _hb_expire_s()
         return sorted(a for a, h in self.active_hosts.items()
                       if h["role"] == "storage"
-                      and now - h["last_hb"] < HB_EXPIRE_S)
+                      and now - h["last_hb"] < exp)
 
     def rpc_create_space(self, p):
         self._require_leader()
@@ -326,3 +347,8 @@ class MetaService:
     def rpc_transfer_leader(self, p):
         return self._propose({"op": "transfer_leader", "space": p["space"],
                               "part": p["part"], "to": p["to"]})
+
+    def rpc_set_part_replicas(self, p):
+        return self._propose({"op": "set_part_replicas",
+                              "space": p["space"], "part": p["part"],
+                              "replicas": p["replicas"]})

@@ -327,10 +327,12 @@ class RaftPart:
         self._advance_commit()
 
     def _peer_loop(self, peer: str):
-        """Persistent replicator for one follower; exits on step-down."""
+        """Persistent replicator for one follower; exits on step-down or
+        when the peer leaves the configuration (update_peers)."""
         while True:
             with self.lock:
-                if not self.alive or self.state != LEADER:
+                if not self.alive or self.state != LEADER \
+                        or peer not in self.peers:
                     return
             ok = self._replicate_one(peer)
             self._advance_commit()
@@ -454,6 +456,68 @@ class RaftPart:
             self._save_meta()
             self.wal.compact_to(self.snap_index)
 
+    # -- membership / leadership (BALANCE DATA / BALANCE LEADER) ----------
+
+    def update_peers(self, replicas: List[str]):
+        """Adopt a new replica set (the balance plan's membership change;
+        reference raftex addPeer/removePeer).
+
+        Not joint consensus: the change is instantaneous on each member.
+        Safety comes from the orchestration protocol — the part map is
+        itself serialized through the metad raft group, and BALANCE
+        applies changes add-THEN-remove (never both in one step), so any
+        two consecutive configurations share a quorum."""
+        with self.lock:
+            new = [p for p in replicas if p != self.node_id]
+            if new == self.peers:
+                return
+            self.peers = new
+            if self.state == LEADER:
+                nxt = self.wal.last_index() + 1
+                for p in new:
+                    self.next_index.setdefault(p, max(1, nxt - 1))
+                    self.match_index.setdefault(p, 0)
+                for p in list(self.next_index):
+                    if p not in new:
+                        self.next_index.pop(p, None)
+                        self.match_index.pop(p, None)
+            self._repl_cv.notify_all()
+        if self.is_leader():
+            self._replicate_all()   # new follower gets snapshot/catch-up
+
+    def transfer_leadership(self, target: str) -> bool:
+        """Leader steps aside for `target` (raft §3.10 TimeoutNow): bring
+        the target fully up to date (bounded rounds — concurrent writes
+        may outrun a single 64-entry batch), tell it to start an election
+        NOW, and step down immediately.  Stepping down on send is what
+        keeps has_lease() honest: the target elects itself INSIDE the old
+        leader's lease window (TimeoutNow bypasses the election timeout
+        the lease bound is derived from), so the old leader must not
+        serve lease reads past this point."""
+        with self.lock:
+            if self.state != LEADER or target not in self.peers:
+                return False
+            term = self.current_term
+        for _ in range(64):
+            self._replicate_one(target)
+            with self.lock:
+                if self.state != LEADER or self.current_term != term:
+                    return False
+                if self.match_index.get(target, 0) >= self.wal.last_index():
+                    break
+        else:
+            return False            # target can't catch up; abort
+        r = self.transport.send(target, self.group, "timeout_now",
+                                {"_from": self.node_id, "term": term})
+        if not (r and r.get("ok")):
+            return False
+        with self.lock:
+            if self.state == LEADER and self.current_term == term:
+                self.state = FOLLOWER
+                self._last_ack.clear()
+                self._reset_election_deadline()
+        return True
+
     # -- client API -------------------------------------------------------
 
     def is_leader(self) -> bool:
@@ -514,7 +578,16 @@ class RaftPart:
             return self._on_append_entries(p)
         if method == "install_snapshot":
             return self._on_install_snapshot(p)
+        if method == "timeout_now":
+            return self._on_timeout_now(p)
         raise ValueError(f"unknown raft method {method}")
+
+    def _on_timeout_now(self, p):
+        with self.lock:
+            if p["term"] != self.current_term:
+                return {"term": self.current_term, "ok": False}
+        self._start_election()
+        return {"term": self.current_term, "ok": True}
 
     def _on_request_vote(self, p):
         with self.lock:

@@ -101,10 +101,18 @@ class StorageService:
         return out
 
     def reconcile_parts(self):
-        """Create/drop raft groups to match the meta part map."""
+        """Create/update/drop raft groups to match the meta part map.
+
+        BALANCE DATA changes the map; this reconciliation is what makes
+        the change real on each storaged: new replicas spin up a raft
+        member (and catch up via leader snapshot install), existing
+        members adopt the new peer set, and replicas no longer in the
+        map stop serving and release the part's state."""
         self.store.catalog = self.meta.catalog
         with self.meta.lock:
             pm = dict(self.meta.part_map)
+        sid_to_name = {sp.space_id: n
+                       for n, sp in self.meta.catalog.spaces.items()}
         for space_name, parts in pm.items():
             sp = self.meta.catalog.spaces.get(space_name)
             if sp is None:
@@ -114,7 +122,9 @@ class StorageService:
                     continue
                 key = (sp.space_id, pid)
                 with self.parts_lock:
-                    if key in self.parts:
+                    existing = self.parts.get(key)
+                    if existing is not None:
+                        existing.update_peers(list(replicas))
                         continue
                     gname = self._group_name(sp.space_id, pid)
                     part = RaftPart(
@@ -128,6 +138,22 @@ class StorageService:
                         snapshot_threshold=2000)
                     self.parts[key] = part
                 part.start()
+        # drop parts this host no longer replicates
+        with self.parts_lock:
+            for key in list(self.parts):
+                sid, pid = key
+                name = sid_to_name.get(sid)
+                space_parts = pm.get(name, []) if name else []
+                replicas = space_parts[pid] if pid < len(space_parts) \
+                    else None
+                if replicas is None or self.my_addr not in replicas:
+                    part = self.parts.pop(key)
+                    part.stop()
+                    if name is not None:
+                        try:
+                            self.store.clear_part(name, pid)
+                        except Exception:  # noqa: BLE001 — space dropped
+                            pass
 
     def _make_snapshot(self, space_name: str, pid: int):
         def snap() -> bytes:
@@ -381,6 +407,39 @@ class StorageService:
         part = sd.parts[pid]
         return {"vertices": len(part.vertices),
                 "edges": part.edge_count(), "epoch": sd.epoch}
+
+    def rpc_part_raft_info(self, p):
+        """Raft progress of one local part replica — the BALANCE
+        orchestrator polls this to decide a new replica has caught up
+        before removing the old one."""
+        sp = self.meta.catalog.spaces.get(p["space"])
+        part = self.parts.get((sp.space_id, p["part"])) if sp else None
+        if part is None:
+            raise RpcError(f"part {p['space']}/{p['part']} not here")
+        with part.lock:
+            return {"is_leader": part.state == "leader",
+                    "term": part.current_term,
+                    "commit_index": part.commit_index,
+                    "last_applied": part.last_applied,
+                    "last_index": part.wal.last_index(),
+                    "snap_index": part.snap_index}
+
+    def rpc_transfer_part_leader(self, p):
+        """BALANCE LEADER: step aside for the named replica."""
+        sp = self.meta.catalog.spaces.get(p["space"])
+        part = self.parts.get((sp.space_id, p["part"])) if sp else None
+        if part is None:
+            raise RpcError(f"part {p['space']}/{p['part']} not here")
+        if not part.is_leader():
+            return {"ok": False, "reason": "not leader"}
+        return {"ok": part.transfer_leadership(p["to"])}
+
+    def rpc_reconcile(self, p):
+        """Meta part-map changed (balance) — re-align local raft groups
+        now instead of waiting for the next heartbeat."""
+        self.meta.refresh(force=True)
+        self.reconcile_parts()
+        return True
 
     def rpc_export_part(self, p):
         """Bulk CSR export of one part — the north-star storage addition

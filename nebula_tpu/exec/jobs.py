@@ -61,7 +61,13 @@ class JobManager:
                     removed += qctx.store.compact(sp)
             return {"compacted": True, "expired_removed": removed}
         if command in ("balance data", "balance leader"):
-            # meaningful in cluster mode; here: recompute part distribution
+            meta = getattr(qctx.store, "meta", None)
+            if meta is not None:        # cluster: run the real plan
+                from ..cluster.balance import balance_data, balance_leader
+                if command == "balance data":
+                    return balance_data(qctx.store, space)
+                return balance_leader(qctx.store, space)
+            # standalone: one host owns every part — nothing to move
             if space:
                 return {"parts": qctx.store.stats(space)["per_part_edges"]}
             return {}

@@ -540,6 +540,29 @@ class GraphStore:
         for d in self.catalog.indexes(space):
             self.rebuild_index(space, d.name, parts=[pid])
 
+    def clear_part(self, space: str, pid: int):
+        """Release one partition's state (the replica moved away under
+        BALANCE DATA — this host no longer serves it).  The part's slice
+        of the dense-id dictionary goes too: if the part later moves
+        BACK, install_part_state installs the then-current map, and stale
+        local entries would resurrect deleted vids in export/device
+        snapshots."""
+        sd = self.space(space)
+        with sd.lock:
+            p = sd.parts[pid]
+            p.vertices = {}
+            p.out_edges = {}
+            p.in_edges = {}
+            p.pending_chains = {}
+            sd.part_counts[pid] = 0
+            for v, d in list(sd.vid_to_dense.items()):
+                if d % sd.num_parts == pid:
+                    del sd.vid_to_dense[v]
+                    sd.dense_to_vid[d] = None
+            sd.epoch += 1
+        for d in self.catalog.indexes(space):
+            self.rebuild_index(space, d.name, parts=[pid])
+
     # ---- checkpoint / restore (CREATE SNAPSHOT; SURVEY §5) ----
 
     def checkpoint(self, dirpath: str,

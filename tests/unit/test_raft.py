@@ -284,3 +284,64 @@ def test_snapshot_compaction_and_laggard_catchup(tmp_path):
         assert apps[lag_i].data()[-1] == f"s{n_entries-1}".encode()
     finally:
         stop_all(parts)
+
+
+# ---------------------------------------------------------------------------
+# membership change + leadership transfer (the BALANCE primitives)
+# ---------------------------------------------------------------------------
+
+
+def test_transfer_leadership(tmp_path):
+    tr, parts, apps = make_cluster(tmp_path)
+    try:
+        leader = wait_leader(parts)
+        assert leader.propose(b"w1")
+        target = next(p for p in parts if p is not leader)
+        assert leader.transfer_leadership(target.node_id)
+        # old leader stepped down instantly (lease honesty)
+        assert not leader.is_leader()
+        dl = time.monotonic() + 5
+        while time.monotonic() < dl and not target.is_leader():
+            time.sleep(0.01)
+        assert target.is_leader()
+        assert target.propose(b"w2")
+        wait_applied(apps, [b"w1", b"w2"])
+    finally:
+        stop_all(parts)
+
+
+def test_update_peers_add_and_remove(tmp_path):
+    """A new member joins an existing group via update_peers, catches up,
+    then an old member is removed and its replicator stops."""
+    tr, parts, apps = make_cluster(tmp_path, n=3)
+    try:
+        leader = wait_leader(parts)
+        for i in range(5):
+            assert leader.propose(f"e{i}".encode())
+        # join n3
+        app3 = Applied()
+        n3 = RaftPart("g0", "n3", ["n0", "n1", "n2", "n3"], tr,
+                      str(tmp_path / "n3"), app3.cb,
+                      election_timeout=(0.05, 0.12),
+                      heartbeat_interval=0.02)
+        n3.start()
+        for p in parts:
+            p.update_peers(["n0", "n1", "n2", "n3"])
+        wait_applied([app3], [f"e{i}".encode() for i in range(5)])
+        # remove one original follower
+        gone = next(p for p in parts if p is not leader)
+        new_set = [n for n in ("n0", "n1", "n2", "n3")
+                   if n != gone.node_id]
+        for p in parts + [n3]:
+            if p is not gone:
+                p.update_peers(new_set)
+        gone.stop()
+        # the shrunk group still commits
+        assert leader.propose(b"after")
+        live_apps = [a for p, a in zip(parts + [n3], apps + [app3])
+                     if p is not gone]
+        wait_applied(live_apps, [f"e{i}".encode() for i in range(5)]
+                     + [b"after"])
+    finally:
+        stop_all(parts)
+        n3.stop()
