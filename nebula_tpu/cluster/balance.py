@@ -68,10 +68,21 @@ def _find_leader(sc, hosts: List[str], space: str, pid: int
 def _wait_caught_up(sc, host: str, leader: str, space: str, pid: int,
                     timeout: float = CATCHUP_TIMEOUT_S):
     """Poll the new replica until its applied index reaches the leader's
-    commit index as of entry."""
-    li = _raft_info(sc, leader, space, pid)
-    target = li["commit_index"] if li else 0
+    commit index as of entry.  The leader's index MUST be known — a
+    transient RPC failure must not degrade the target to 0, or an empty
+    replica reads as caught up and the shrink phase drops the only full
+    copy."""
     dl = time.monotonic() + timeout
+    li = None
+    while li is None and time.monotonic() < dl:
+        li = _raft_info(sc, leader, space, pid)
+        if li is None:
+            time.sleep(0.05)
+    if li is None:
+        raise BalanceError(
+            f"leader {leader} of {space}/{pid} unreachable; cannot "
+            f"establish a catch-up target")
+    target = li["commit_index"]
     while time.monotonic() < dl:
         info = _raft_info(sc, host, space, pid)
         if info and info["last_applied"] >= target:
@@ -90,10 +101,12 @@ def _transfer_leader(meta, sc, space: str, pid: int, hosts: List[str],
     if cur is None:
         return False
     try:
-        sc._client(cur).call("storage.transfer_part_leader",
-                             space=space, part=pid, to=to)
+        r = sc._client(cur).call("storage.transfer_part_leader",
+                                 space=space, part=pid, to=to)
     except Exception:  # noqa: BLE001
         return False
+    if not (isinstance(r, dict) and r.get("ok")):
+        return False        # definitive refusal — don't poll the timeout
     dl = time.monotonic() + timeout
     while time.monotonic() < dl:
         info = _raft_info(sc, to, space, pid)

@@ -203,14 +203,20 @@ class DistributedStore:
 
     def get_neighbors(self, space: str, vids: List[Any],
                       edge_types: Optional[List[str]] = None,
-                      direction: str = "out"):
+                      direction: str = "out",
+                      edge_filter=None, limit_per_src: Optional[int] = None):
         """Same contract as GraphStore.get_neighbors, including row order
-        (input vid order, etype name, then (rank, neighbor))."""
+        (input vid order, etype name, then (rank, neighbor)).  A pushed
+        edge_filter / limit ships to storaged as nGQL text and executes
+        there — only surviving rows cross the RPC (SURVEY §2 row 12)."""
+        from .pushdown import filter_to_wire
+        ftext = filter_to_wire(edge_filter)
         by_part = self.sc.split_by_part(space, vids)
         results = dict(self.sc.fanout(
             space,
             {pid: {"vids": to_wire(pvids), "edge_types": edge_types,
-                   "direction": direction}
+                   "direction": direction, "filter": ftext,
+                   "limit_per_src": limit_per_src}
              for pid, pvids in by_part.items()},
             "storage.get_neighbors"))
         # merge preserving input vid order: index rows per (vid, dir)

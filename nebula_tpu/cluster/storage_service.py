@@ -138,7 +138,11 @@ class StorageService:
                         snapshot_threshold=2000)
                     self.parts[key] = part
                 part.start()
-        # drop parts this host no longer replicates
+        # drop parts this host no longer replicates — pop under the lock,
+        # stop/clear OUTSIDE it (stop joins threads for up to 2s and
+        # clear rebuilds indexes; holding parts_lock across that would
+        # stall every concurrent write/raft-route on this host)
+        dropped = []
         with self.parts_lock:
             for key in list(self.parts):
                 sid, pid = key
@@ -147,13 +151,14 @@ class StorageService:
                 replicas = space_parts[pid] if pid < len(space_parts) \
                     else None
                 if replicas is None or self.my_addr not in replicas:
-                    part = self.parts.pop(key)
-                    part.stop()
-                    if name is not None:
-                        try:
-                            self.store.clear_part(name, pid)
-                        except Exception:  # noqa: BLE001 — space dropped
-                            pass
+                    dropped.append((self.parts.pop(key), name, pid))
+        for part, name, pid in dropped:
+            part.stop()
+            if name is not None:
+                try:
+                    self.store.clear_part(name, pid)
+                except Exception:  # noqa: BLE001 — space dropped
+                    pass
 
     def _make_snapshot(self, space_name: str, pid: int):
         def snap() -> bytes:
@@ -330,12 +335,27 @@ class StorageService:
     # -- read RPCs (leader reads) ----------------------------------------
 
     def rpc_get_neighbors(self, p):
+        """The storage exec DAG's scan stage + pushed-down filter/limit
+        (SURVEY §2 row 12): a WHERE the graphd marked pushable arrives as
+        nGQL text, parses once, and drops rows BEFORE they reach the
+        wire — the candidate set never ships."""
+        from .pushdown import apply_edge_filter, filter_from_wire
         space, pid = p["space"], p["part"]
         self._leader_part(space, pid)
         vids = from_wire(p["vids"])
+        edge_filter = filter_from_wire(p.get("filter"))
+        limit = p.get("limit_per_src")
+        it = self.store.get_neighbors(
+            space, vids, p.get("edge_types"), p.get("direction", "out"))
+        if edge_filter is not None or limit is not None:
+            etypes = p.get("edge_types") or sorted(
+                e.name for e in self.store.catalog.edges(space))
+            etype_ids = {et: self.store.catalog.get_edge(space, et).edge_type
+                         for et in etypes}
+            it = apply_edge_filter(it, space, edge_filter, etype_ids,
+                                   limit, stats_prefix="storage_pushdown")
         rows = []
-        for (src, et, rank, other, props, sd) in self.store.get_neighbors(
-                space, vids, p.get("edge_types"), p.get("direction", "out")):
+        for (src, et, rank, other, props, sd) in it:
             rows.append([to_wire(src), et, rank, to_wire(other),
                          {k: to_wire(v) for k, v in props.items()}, sd])
         return rows

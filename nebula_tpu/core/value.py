@@ -431,6 +431,16 @@ def type_name(v: Any) -> str:
     return type(v).__name__
 
 
+def make_edge(src_vid, other_vid, etype_name, rank, props, signed_dir,
+              etype_id) -> "Edge":
+    """Edge as seen from a traversal row: signed_dir=+1 means the stored
+    edge is src->other; -1 is the reversed view (negative EdgeType, the
+    reference's convention).  THE single constructor for this rule —
+    graphd executors and storage-side filter eval must agree on it."""
+    return Edge(src_vid, other_vid, etype_name, rank, dict(props),
+                etype=etype_id if signed_dir > 0 else -etype_id)
+
+
 def value_to_string(v: Any) -> str:
     if isinstance(v, bool):
         return "true" if v else "false"
@@ -443,7 +453,13 @@ def value_to_string(v: Any) -> str:
             return "-inf"
         return repr(v)
     if isinstance(v, str):
-        return f'"{v}"'
+        # escaped so the text form round-trips through the tokenizer —
+        # pushed-down filters ship as nGQL text, and a raw quote or
+        # backslash would re-parse as a different (or broken) literal
+        esc = (v.replace("\\", "\\\\").replace('"', '\\"')
+               .replace("\n", "\\n").replace("\t", "\\t")
+               .replace("\r", "\\r"))
+        return f'"{esc}"'
     if isinstance(v, list):
         return "[" + ", ".join(value_to_string(x) for x in v) + "]"
     if isinstance(v, set):

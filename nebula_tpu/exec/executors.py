@@ -97,9 +97,9 @@ def _argument(node, qctx, ectx, space):
 
 
 def _make_edge(src_vid, other_vid, etype_name, rank, props, signed_dir, etype_id):
-    # signed_dir=+1: stored src→other; -1: stored other→src (reversed view)
-    return Edge(src_vid, other_vid, etype_name, rank, dict(props),
-                etype=etype_id if signed_dir > 0 else -etype_id)
+    from ..core.value import make_edge
+    return make_edge(src_vid, other_vid, etype_name, rank, props,
+                     signed_dir, etype_id)
 
 
 @executor("ExpandAll")
@@ -139,14 +139,24 @@ def _expand_all(node, qctx, ectx, space):
                 seen.add(k)
             src_rows.append(([r[j] for j in carry_idx], vid))
 
+    # storage-side pushdown (SURVEY §2 row 12): an edge-only predicate
+    # executes where the data is; graphd then skips the re-check.  The
+    # per-src limit rides along only when the filter went too (a
+    # pre-filter limit would under-produce).
+    from ..cluster.pushdown import pushable
+    pushed = edge_filter is not None and pushable(edge_filter, etypes)
+    push_filter = edge_filter if pushed else None
+    push_limit = limit if (edge_filter is None or pushed) else None
+
     out_cols = carry + ["_src", "_edge", "_dst"]
     rows: List[List[Any]] = []
     for carried, vid in src_rows:
         n_for_src = 0
         for (s, et, rank, other, props, sd) in store.get_neighbors(
-                sp, [vid], etypes, direction):
+                sp, [vid], etypes, direction,
+                edge_filter=push_filter, limit_per_src=push_limit):
             e = _make_edge(s, other, et, rank, props, sd, etype_ids[et])
-            if edge_filter is not None:
+            if edge_filter is not None and not pushed:
                 rc = RowContext(qctx, sp, {"_src": s, "_edge": e, "_dst": other,
                                            **dict(zip(carry, carried))})
                 if to_bool3(edge_filter.eval(rc)) is not True:
@@ -458,6 +468,12 @@ def _traverse(node, qctx, ectx, space):
     tracker = getattr(ectx, "tracker", None)
     pending = 0
 
+    # MATCH edge predicates apply per hop — push them into the storage
+    # scan when they reference only the edge (SURVEY §2 row 12)
+    from ..cluster.pushdown import pushable
+    ef_pushed = edge_filter is not None and pushable(edge_filter, etypes)
+    push_filter = edge_filter if ef_pushed else None
+
     for r in ds.rows:
         sv = r[ci]
         svid = sv.vid if isinstance(sv, Vertex) else sv
@@ -473,12 +489,13 @@ def _traverse(node, qctx, ectx, space):
             if depth >= max_hop:
                 continue
             for (s, et, rank, other, props, sd) in store.get_neighbors(
-                    sp, [cur], etypes, direction):
+                    sp, [cur], etypes, direction,
+                    edge_filter=push_filter):
                 e = _make_edge(s, other, et, rank, props, sd, etype_ids[et])
                 ek = e.key()
                 if ek in eseen:
                     continue
-                if not edge_ok(e, r):
+                if not ef_pushed and not edge_ok(e, r):
                     continue
                 npath = epath + [e]
                 if min_hop <= len(npath):

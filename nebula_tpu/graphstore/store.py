@@ -689,14 +689,29 @@ class GraphStore:
     # ---- read: getNeighbors (the hot-path op, host oracle form) ----
     def get_neighbors(self, space: str, vids: List[Any],
                       edge_types: Optional[List[str]] = None,
-                      direction: str = "out"):
+                      direction: str = "out",
+                      edge_filter=None, limit_per_src: Optional[int] = None):
         """Yields (src, etype_name, rank, dst, props, signed_dir).
 
         signed_dir is +1 for out-edges, -1 for in-edges (matching the
         reference's negative-EdgeType convention for reversed traversal).
         Row order is deterministic: input vid order, then etype name, then
         (rank, neighbor) — the CSR sort order (csr.py) matches this.
+
+        edge_filter / limit_per_src are the storage-side pushdown stage
+        (cluster mode runs them inside storaged; applying them here keeps
+        standalone semantics identical).
         """
+        if edge_filter is not None or limit_per_src is not None:
+            from ..cluster.pushdown import apply_edge_filter
+            etypes_f = edge_types or sorted(
+                e.name for e in self.catalog.edges(space))
+            etype_ids = {et: self.catalog.get_edge(space, et).edge_type
+                         for et in etypes_f}
+            yield from apply_edge_filter(
+                self.get_neighbors(space, vids, edge_types, direction),
+                space, edge_filter, etype_ids, limit_per_src)
+            return
         import time as _t
         sd = self.space(space)
         etypes = edge_types

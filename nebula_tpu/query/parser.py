@@ -35,6 +35,17 @@ def parse(text: str) -> A.Sentence:
     return Parser(text).parse_program()
 
 
+def parse_expression(text: str) -> Expr:
+    """Parse ONE expression (the wire format for pushed-down storage
+    filters — predicates ship as canonical nGQL text, never code)."""
+    p = Parser(text)
+    e = p.parse_expr()
+    if not p.at("EOF"):
+        t = p.peek()
+        raise ParseError(f"trailing input after expression at pos {t.pos}")
+    return e
+
+
 class Parser:
     def __init__(self, text: str):
         self.text = text

@@ -185,3 +185,82 @@ def test_leader_lease_blocks_minority_reads(tmp_path):
     assert not leader.has_lease()
     for n in nodes.values():
         n.stop()
+
+
+def test_storage_side_filter_pushdown(tmp_path):
+    """A pushable WHERE executes inside storaged: only surviving rows
+    cross the RPC (SURVEY §2 row 12; VERDICT r1 missing #7)."""
+    from nebula_tpu.cluster.launcher import LocalCluster
+    from nebula_tpu.utils.stats import stats
+    c = LocalCluster(n_meta=1, n_storage=2, n_graph=1,
+                     data_dir=str(tmp_path))
+    try:
+        client = c.client()
+        rs = client.execute(
+            "CREATE SPACE pd(partition_num=4, vid_type=INT64)")
+        assert rs.error is None, rs.error
+        c.reconcile_storage()
+        for q in ["USE pd", "CREATE TAG n(x int)", "CREATE EDGE rel(w int)"]:
+            assert client.execute(q).error is None
+        assert client.execute(
+            "INSERT VERTEX n(x) VALUES " +
+            ", ".join(f"{i}:({i})" for i in range(60))).error is None
+        assert client.execute(
+            "INSERT EDGE rel(w) VALUES " +
+            ", ".join(f"1->{i}:({i})" for i in range(2, 52))).error is None
+
+        before = stats().snapshot()
+        rs = client.execute(
+            "GO FROM 1 OVER rel WHERE rel.w >= 45 YIELD dst(edge) AS d")
+        assert rs.error is None, rs.error
+        assert sorted(r[0] for r in rs.data.rows) == list(range(45, 52))
+        after = stats().snapshot()
+        scanned = after.get("storage_pushdown_scanned", 0) \
+            - before.get("storage_pushdown_scanned", 0)
+        shipped = after.get("storage_pushdown_shipped", 0) \
+            - before.get("storage_pushdown_shipped", 0)
+        assert scanned == 50, (scanned, shipped)
+        assert shipped == 7, (scanned, shipped)
+
+        # non-pushable predicates still work (graphd-side re-check)
+        rs = client.execute(
+            "GO FROM 1 OVER rel WHERE rel.w >= 45 AND $$.n.x < 48 "
+            "YIELD dst(edge) AS d")
+        assert rs.error is None, rs.error
+        assert sorted(r[0] for r in rs.data.rows) == [45, 46, 47]
+    finally:
+        c.stop()
+
+
+def test_pushdown_string_filter_roundtrip(tmp_path):
+    """String predicates with quotes/backslashes survive the text wire
+    format of pushed-down filters."""
+    from nebula_tpu.cluster.launcher import LocalCluster
+    c = LocalCluster(n_meta=1, n_storage=1, n_graph=1,
+                     data_dir=str(tmp_path))
+    try:
+        client = c.client()
+        assert client.execute(
+            "CREATE SPACE ps(partition_num=2, vid_type=INT64)").error is None
+        c.reconcile_storage()
+        for q in ["USE ps", "CREATE TAG n(x int)",
+                  "CREATE EDGE rel(tag string)"]:
+            assert client.execute(q).error is None
+        assert client.execute(
+            "INSERT VERTEX n(x) VALUES 1:(1), 2:(2), 3:(3), 4:(4)"
+        ).error is None
+        assert client.execute(
+            'INSERT EDGE rel(tag) VALUES 1->2:("a\\"b"), 1->3:("a\\\\nb"), '
+            '1->4:("plain")').error is None
+        rs = client.execute(
+            'GO FROM 1 OVER rel WHERE rel.tag == "a\\"b" '
+            'YIELD dst(edge) AS d')
+        assert rs.error is None, rs.error
+        assert [r[0] for r in rs.data.rows] == [2]
+        rs = client.execute(
+            'GO FROM 1 OVER rel WHERE rel.tag == "a\\\\nb" '
+            'YIELD dst(edge) AS d')
+        assert rs.error is None, rs.error
+        assert [r[0] for r in rs.data.rows] == [3]
+    finally:
+        c.stop()
