@@ -879,3 +879,302 @@ def _duration(ctx, args):
         months = v.get("months", 0) + v.get("years", 0) * 12
         return Duration(int(secs), v.get("microseconds", 0), int(months))
     return NULL_BAD_TYPE
+
+
+# ---------------------------------------------------------------------------
+# Spatial functions — the ST_* family over the Geography value type
+# (reference: src/common/function geo functions backed by S2
+# [UNVERIFIED — empty mount]; simplifications documented in core/geo.py).
+# ---------------------------------------------------------------------------
+
+
+def _geo_args(args, n):
+    from .geo import Geography
+    nl = _nullprop(args)
+    if nl is not None:
+        return nl, None
+    if len(args) < n:
+        return NULL_BAD_TYPE, None
+    for a in args[:n]:
+        if not isinstance(a, Geography):
+            return NULL_BAD_TYPE, None
+    return None, args
+
+
+@register("st_point")
+def _st_point(ctx, args):
+    from .geo import Geography
+    n = _nullprop(args)
+    if n is not None:
+        return n
+    if len(args) != 2 or not _num(args[0]) or not _num(args[1]):
+        return NULL_BAD_TYPE
+    g = Geography("point", (float(args[0]), float(args[1])))
+    return g if g.is_valid() else NULL_BAD_DATA
+
+
+def _from_text(ctx, args):
+    from .geo import GeoError, from_wkt
+    n = _nullprop(args)
+    if n is not None:
+        return n
+    if not isinstance(args[0], str):
+        return NULL_BAD_TYPE
+    try:
+        return from_wkt(args[0])
+    except GeoError:
+        return NULL_BAD_DATA
+
+
+register("st_geogfromtext")(_from_text)
+register("st_pointfromtext")(_from_text)
+register("st_linestringfromtext")(_from_text)
+register("st_polygonfromtext")(_from_text)
+
+
+@register("st_astext")
+def _st_astext(ctx, args):
+    err, a = _geo_args(args, 1)
+    if a is None:
+        return err
+    return a[0].wkt()
+
+
+@register("st_x")
+def _st_x(ctx, args):
+    err, a = _geo_args(args, 1)
+    if a is None:
+        return err
+    if a[0].kind != "point":
+        return NULL_BAD_TYPE
+    return a[0].coords[0]
+
+
+@register("st_y")
+def _st_y(ctx, args):
+    err, a = _geo_args(args, 1)
+    if a is None:
+        return err
+    if a[0].kind != "point":
+        return NULL_BAD_TYPE
+    return a[0].coords[1]
+
+
+@register("st_centroid")
+def _st_centroid(ctx, args):
+    err, a = _geo_args(args, 1)
+    if a is None:
+        return err
+    return a[0].centroid()
+
+
+@register("st_isvalid")
+def _st_isvalid(ctx, args):
+    err, a = _geo_args(args, 1)
+    if a is None:
+        return err
+    return a[0].is_valid()
+
+
+@register("st_distance")
+def _st_distance(ctx, args):
+    from .geo import distance_m
+    err, a = _geo_args(args, 2)
+    if a is None:
+        return err
+    return distance_m(a[0], a[1])
+
+
+@register("st_dwithin")
+def _st_dwithin(ctx, args):
+    from .geo import distance_m
+    err, a = _geo_args(args, 2)
+    if a is None:
+        return err
+    if len(args) < 3 or not _num(args[2]):
+        return NULL_BAD_TYPE
+    return distance_m(a[0], a[1]) <= float(args[2])
+
+
+@register("st_intersects")
+def _st_intersects(ctx, args):
+    from .geo import intersects
+    err, a = _geo_args(args, 2)
+    if a is None:
+        return err
+    return intersects(a[0], a[1])
+
+
+@register("st_covers")
+def _st_covers(ctx, args):
+    from .geo import covers
+    err, a = _geo_args(args, 2)
+    if a is None:
+        return err
+    return covers(a[0], a[1])
+
+
+@register("st_coveredby")
+def _st_coveredby(ctx, args):
+    from .geo import covers
+    err, a = _geo_args(args, 2)
+    if a is None:
+        return err
+    return covers(a[1], a[0])
+
+
+@register("s2_cellidfrompoint")
+def _s2_cellid(ctx, args):
+    from .geo import cell_token
+    err, a = _geo_args(args, 1)
+    if a is None:
+        return err
+    level = args[1] if len(args) > 1 else 30
+    if not isinstance(level, int):
+        return NULL_BAD_TYPE
+    return cell_token(a[0], level)
+
+
+@register("s2_coveringcellids")
+def _s2_covering(ctx, args):
+    from .geo import Geography, cell_token
+    err, a = _geo_args(args, 1)
+    if a is None:
+        return err
+    level = args[1] if len(args) > 1 else 8
+    if not isinstance(level, int):
+        return NULL_BAD_TYPE
+    return sorted({cell_token(Geography("point", p), level)
+                   for p in a[0].points()})
+
+
+# ---------------------------------------------------------------------------
+# Remaining scalar families (bit ops, trig conversions, temporal
+# components, list/string helpers) — FunctionManager parity fill-in.
+# ---------------------------------------------------------------------------
+
+
+_math1("radians", math.radians)
+_math1("degrees", math.degrees)
+_math1("sinh", math.sinh)
+_math1("cosh", math.cosh)
+_math1("tanh", math.tanh)
+
+
+@register("udf_is_in")
+def _udf_is_in(ctx, args):
+    if not args:
+        return NULL_BAD_TYPE
+    from .value import v_eq
+    needle = args[0]
+    for x in args[1:]:
+        if v_eq(needle, x) is True:
+            return True
+    return False
+
+
+@register("cos_similarity")
+def _cos_similarity(ctx, args):
+    n = _nullprop(args)
+    if n is not None:
+        return n
+    if len(args) % 2 != 0 or not args:
+        return NULL_BAD_DATA
+    half = len(args) // 2
+    xs, ys = args[:half], args[half:]
+    if not all(_num(v) for v in xs + ys):
+        return NULL_BAD_TYPE
+    dot = sum(x * y for x, y in zip(xs, ys))
+    nx = math.sqrt(sum(x * x for x in xs))
+    ny = math.sqrt(sum(y * y for y in ys))
+    if nx == 0.0 or ny == 0.0:
+        return NULL_BAD_DATA
+    return dot / (nx * ny)
+
+
+@register("edges")
+def _edges_of_path(ctx, args):
+    v = args[0]
+    if isinstance(v, Path):
+        return FUNCTIONS["relationships"](ctx, args)
+    return NULL_BAD_TYPE
+
+
+@register("extract")
+def _extract(ctx, args):
+    """extract(string, regex) — all non-overlapping matches."""
+    import re as _re
+    n = _nullprop(args)
+    if n is not None:
+        return n
+    if len(args) != 2 or not isinstance(args[0], str) \
+            or not isinstance(args[1], str):
+        return NULL_BAD_TYPE
+    try:
+        # full matched substrings — findall would return capture-group
+        # contents (or tuples) when the regex has groups
+        return [m.group(0) for m in _re.finditer(args[1], args[0])]
+    except _re.error:
+        return NULL_BAD_DATA
+
+
+@register("json_extract")
+def _json_extract(ctx, args):
+    import json as _json
+    n = _nullprop(args)
+    if n is not None:
+        return n
+    if not isinstance(args[0], str):
+        return NULL_BAD_TYPE
+    try:
+        v = _json.loads(args[0])
+    except ValueError:
+        return NULL_BAD_DATA
+    return v if isinstance(v, dict) else NULL_BAD_DATA
+
+
+def _temporal_part(name, attr):
+    @register(name)
+    def _fn(ctx, args, _attr=attr):
+        n = _nullprop(args)
+        if n is not None:
+            return n
+        v = args[0]
+        for cls in (Date, Time, DateTime):
+            if isinstance(v, cls) and hasattr(v, _attr):
+                return getattr(v, _attr)
+        return NULL_BAD_TYPE
+    return _fn
+
+
+_temporal_part("year", "year")
+_temporal_part("month", "month")
+_temporal_part("day", "day")
+_temporal_part("hour", "hour")
+_temporal_part("minute", "minute")
+_temporal_part("second", "sec")
+_temporal_part("microsecond", "microsec")
+
+
+@register("dayofweek")
+def _dayofweek(ctx, args):
+    """1 = Sunday ... 7 = Saturday (the reference's convention)."""
+    n = _nullprop(args)
+    if n is not None:
+        return n
+    v = args[0]
+    if not isinstance(v, (Date, DateTime)):
+        return NULL_BAD_TYPE
+    d = _dt.date(v.year, v.month, v.day)
+    return (d.weekday() + 1) % 7 + 1
+
+
+@register("dayofyear")
+def _dayofyear(ctx, args):
+    n = _nullprop(args)
+    if n is not None:
+        return n
+    v = args[0]
+    if not isinstance(v, (Date, DateTime)):
+        return NULL_BAD_TYPE
+    return _dt.date(v.year, v.month, v.day).timetuple().tm_yday

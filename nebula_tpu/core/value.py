@@ -424,6 +424,17 @@ _TYPE_NAMES = [
 ]
 
 
+def _register_geo_type():
+    # late: geo.py imports nothing from here, but keep import cycles out
+    from .geo import Geography
+    _TYPE_NAMES.insert(-1, (Geography, "geography"))
+    # between duration (14) and __NULL__ (15): its own slot, nulls last
+    _KIND_ORDER.setdefault("geography", 14.5)
+
+
+
+
+
 def type_name(v: Any) -> str:
     for t, n in _TYPE_NAMES:
         if isinstance(v, t):
@@ -789,3 +800,5 @@ def hashable_key(v: Any):
         return ("__ds__", tuple(v.column_names),
                 tuple(tuple(hashable_key(c) for c in r) for r in v.rows))
     return v
+
+_register_geo_type()

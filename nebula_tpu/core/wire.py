@@ -26,6 +26,8 @@ def to_wire(v: Any) -> Any:
         return {"@t": "null", "k": v.kind.name}
     if isinstance(v, EmptyValue):
         return {"@t": "empty"}
+    if isinstance(v, Geography):
+        return {"@t": "geo", "v": v.wkt()}
     if isinstance(v, Date):
         return {"@t": "date", "v": [v.year, v.month, v.day]}
     if isinstance(v, Time):
@@ -81,6 +83,8 @@ def from_wire(j: Any) -> Any:
     t = j.get("@t")
     if t is None:                      # bare JSON object
         return {k: from_wire(x) for k, x in j.items()}
+    if t == "geo":
+        return from_wkt(j["v"])
     if t == "null":
         return NullValue(NullKind[j["k"]])
     if t == "empty":
@@ -134,3 +138,4 @@ def dumps(v: Any) -> bytes:
 
 def loads(data: bytes) -> Any:
     return from_wire(json.loads(data.decode()))
+from .geo import Geography, from_wkt

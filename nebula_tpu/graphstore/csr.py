@@ -88,6 +88,8 @@ def encode_prop(pt: PropType, v: Any, pool: StringPool) -> Any:
         return np.nan if pt in (PropType.FLOAT, PropType.DOUBLE) else INT_NULL
     if pt in (PropType.STRING, PropType.FIXED_STRING):
         return pool.encode(v)
+    if pt == PropType.GEOGRAPHY:
+        return pool.encode(v.wkt())     # dictionary-encoded WKT
     if pt == PropType.BOOL:
         return int(v)
     if pt == PropType.DATE:
@@ -133,7 +135,7 @@ def decode_prop_column(pt: PropType, raw: "np.ndarray",
     if pt == PropType.BOOL:
         return [NULL if r == INT_NULL else bool(r) for r in vals]
     if pt in (PropType.DATE, PropType.DATETIME, PropType.TIME,
-              PropType.DURATION):
+              PropType.DURATION, PropType.GEOGRAPHY):
         return [decode_prop(pt, r, pool) for r in vals]
     if not (av == INT_NULL).any():      # no-null fast path
         return vals
@@ -167,6 +169,10 @@ def decode_prop(pt: PropType, raw: Any, pool: StringPool) -> Any:
         us = r % 1_000_000
         sec = r // 1_000_000
         return Time(sec // 3600, (sec // 60) % 60, sec % 60, us)
+    if pt == PropType.GEOGRAPHY:
+        from ..core.geo import from_wkt
+        s = pool.decode(r)
+        return NULL if s is None else from_wkt(s)
     return r
 
 

@@ -68,15 +68,25 @@ def check_type(t: PropType, v: Any) -> bool:
         return isinstance(v, DateTime)
     if t == PropType.DURATION:
         return isinstance(v, Duration)
+    if t == PropType.GEOGRAPHY:
+        from ..core.geo import Geography
+        return isinstance(v, Geography)
     return True
 
 
 def coerce(t: PropType, v: Any) -> Any:
-    """Insert-time coercion (int→float for double columns)."""
+    """Insert-time coercion (int→float for double columns; WKT text for
+    geography columns)."""
     if is_null(v):
         return v
     if t in _FLOAT_TYPES and isinstance(v, int) and not isinstance(v, bool):
         return float(v)
+    if t == PropType.GEOGRAPHY and isinstance(v, str):
+        from ..core.geo import GeoError, from_wkt
+        try:
+            return from_wkt(v)
+        except GeoError:
+            return v            # check_type rejects with a clean error
     return v
 
 

@@ -436,6 +436,14 @@ class Parser:
             n = self.expect("INT").value
             self.expect(")")
             return f"FIXED_STRING({n})"
+        if t.kind == "KEYWORD" and t.value == "GEOGRAPHY":
+            self.next()
+            # GEOGRAPHY(POINT|LINESTRING|POLYGON): the shape constraint
+            # is accepted reference-compatibly (stored as geography)
+            if self.accept("("):
+                self.ident()
+                self.expect(")")
+            return "GEOGRAPHY"
         if t.kind in ("KEYWORD", "IDENT"):
             return self.next().value
         raise ParseError(f"expected type name at pos {t.pos}")

@@ -33,7 +33,7 @@ KEYWORDS = {
     # types
     "INT", "INT64", "INT32", "INT16", "INT8", "FLOAT", "DOUBLE", "STRING",
     "FIXED_STRING", "BOOL", "TIMESTAMP", "DATE", "TIME", "DATETIME",
-    "DURATION",
+    "DURATION", "GEOGRAPHY",
     # expression keywords
     "AND", "OR", "XOR", "TRUE", "FALSE", "CONTAINS", "STARTS", "ENDS",
     "IS", "CASE", "THEN", "ELSE", "END", "EMPTY",

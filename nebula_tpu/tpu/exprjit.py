@@ -67,6 +67,8 @@ def _kind_of(pt: PropType) -> str:
         return "datetime"
     if pt == PropType.DURATION:
         return "duration"
+    if pt == PropType.GEOGRAPHY:
+        return "geo"    # distinct kind: no device op compares geographies
     return "int"        # ints + TIMESTAMP (host value is a plain int)
 
 
@@ -269,10 +271,11 @@ def _binary(op: str, fa, fb) -> Callable[[Dict[str, Any]], Term]:
             return (jnp.logical_xor(av, bv), an | bn, "bool")
         if op in _CMP_OPS:
             null = an | bn
-            if "str" in (ak, bk) or "bool" in (ak, bk):
+            if "str" in (ak, bk) or "bool" in (ak, bk) or "geo" in (ak, bk):
                 if ak != bk:
                     raise CannotCompile(f"compare {ak} vs {bk}")
                 if op not in ("==", "!="):
+                    # dict codes are insertion-ordered, not value-ordered
                     raise CannotCompile(f"ordering on {ak}")
                 val = (av == bv) if op == "==" else (av != bv)
                 return (val, null, "bool")

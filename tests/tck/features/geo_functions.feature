@@ -1,0 +1,145 @@
+Feature: Geography type and spatial functions
+
+  Background:
+    Given having executed:
+      """
+      CREATE SPACE geo(partition_num=2, vid_type=INT64);
+      USE geo;
+      CREATE TAG place(name string, loc geography(point));
+      INSERT VERTEX place(name, loc) VALUES 1:("oslo", ST_Point(10.75, 59.91)), 2:("bergen", ST_GeogFromText("POINT(5.32 60.39)"))
+      """
+
+  Scenario: point construction and text roundtrip
+    When executing query:
+      """
+      YIELD ST_ASText(ST_Point(3, 8)) AS t, ST_X(ST_Point(3, 8)) AS x, ST_Y(ST_Point(3, 8)) AS y
+      """
+    Then the result should be, in order:
+      | t            | x   | y   |
+      | "POINT(3 8)" | 3.0 | 8.0 |
+
+  Scenario: wkt parsing of all shapes
+    When executing query:
+      """
+      YIELD ST_ASText(ST_GeogFromText("LINESTRING(0 0, 1 1, 2 0)")) AS l, ST_ASText(ST_GeogFromText("POLYGON((0 0, 4 0, 4 4, 0 4, 0 0))")) AS p
+      """
+    Then the result should be, in order:
+      | l                           | p                                  |
+      | "LINESTRING(0 0, 1 1, 2 0)" | "POLYGON((0 0, 4 0, 4 4, 0 4, 0 0))" |
+
+  Scenario: invalid wkt is bad data
+    When executing query:
+      """
+      YIELD ST_GeogFromText("POINT(x y)") AS g
+      """
+    Then the result should be, in order:
+      | g            |
+      | __BAD_DATA__ |
+
+  Scenario: stored geography props round trip
+    When executing query:
+      """
+      FETCH PROP ON place 1 YIELD place.name AS n, ST_ASText(place.loc) AS w
+      """
+    Then the result should be, in order:
+      | n      | w                    |
+      | "oslo" | "POINT(10.75 59.91)" |
+
+  Scenario: distance between cities is plausible
+    When executing query:
+      """
+      YIELD round(ST_Distance(ST_Point(10.75, 59.91), ST_Point(5.32, 60.39)) / 1000) AS km
+      """
+    Then the result should be, in order:
+      | km    |
+      | 305.0 |
+
+  Scenario: dwithin filters by distance
+    When executing query:
+      """
+      MATCH (p:place) WHERE ST_DWithin(p.place.loc, ST_Point(10.0, 60.0), 100000) RETURN p.place.name AS n
+      """
+    Then the result should be, in any order:
+      | n      |
+      | "oslo" |
+
+  Scenario: point in polygon intersects and covers
+    When executing query:
+      """
+      YIELD ST_Intersects(ST_GeogFromText("POLYGON((0 0, 4 0, 4 4, 0 4, 0 0))"), ST_Point(2, 2)) AS inside, ST_Covers(ST_GeogFromText("POLYGON((0 0, 4 0, 4 4, 0 4, 0 0))"), ST_Point(2, 2)) AS covers, ST_Intersects(ST_GeogFromText("POLYGON((0 0, 4 0, 4 4, 0 4, 0 0))"), ST_Point(9, 9)) AS outside
+      """
+    Then the result should be, in order:
+      | inside | covers | outside |
+      | true   | true   | false   |
+
+  Scenario: coveredby is the converse of covers
+    When executing query:
+      """
+      YIELD ST_CoveredBy(ST_Point(1, 1), ST_GeogFromText("POLYGON((0 0, 2 0, 2 2, 0 2, 0 0))")) AS c
+      """
+    Then the result should be, in order:
+      | c    |
+      | true |
+
+  Scenario: centroid of polygon
+    When executing query:
+      """
+      YIELD ST_ASText(ST_Centroid(ST_GeogFromText("POLYGON((0 0, 2 0, 2 2, 0 2, 0 0))"))) AS c
+      """
+    Then the result should be, in order:
+      | c            |
+      | "POINT(1 1)" |
+
+  Scenario: cell ids share prefixes for equal points
+    When executing query:
+      """
+      YIELD S2_CellIdFromPoint(ST_Point(3, 8)) == S2_CellIdFromPoint(ST_Point(3, 8)) AS same, S2_CellIdFromPoint(ST_Point(3, 8)) == S2_CellIdFromPoint(ST_Point(100, 8)) AS diff
+      """
+    Then the result should be, in order:
+      | same | diff  |
+      | true | false |
+
+  Scenario: geography null propagation and type errors
+    When executing query:
+      """
+      YIELD ST_Distance(NULL, ST_Point(1, 1)) AS a, ST_X(1) AS b
+      """
+    Then the result should be, in order:
+      | a    | b            |
+      | NULL | __BAD_TYPE__ |
+
+  Scenario: new scalar functions
+    When executing query:
+      """
+      UNWIND [12, 10] AS x RETURN bit_and(x) AS a, bit_or(x) AS b, bit_xor(x) AS c
+      """
+    Then the result should be, in order:
+      | a | b  | c |
+      | 8 | 14 | 6 |
+
+  Scenario: degrees radians and udf_is_in
+    When executing query:
+      """
+      YIELD round(degrees(pi()), 0) AS d, round(radians(180) - pi(), 6) AS r, udf_is_in(2, 1, 2, 3) AS e, udf_is_in("x", "a", "b") AS f
+      """
+    Then the result should be, in order:
+      | d     | r   | e    | f     |
+      | 180.0 | 0.0 | true | false |
+
+  Scenario: temporal component extraction
+    When executing query:
+      """
+      YIELD year(date("2024-03-15")) AS y, month(date("2024-03-15")) AS m, day(date("2024-03-15")) AS d, dayofweek(date("2024-03-15")) AS dw
+      """
+    Then the result should be, in order:
+      | y    | m | d  | dw |
+      | 2024 | 3 | 15 | 6  |
+
+  Scenario: extract and json_extract
+    When executing query:
+      """
+      YIELD extract("a1b22c333", "[0-9]+") AS nums, json_extract("{\"k\": 7}") AS j
+      """
+    Then the result should be, in order:
+      | nums               | j      |
+      | ["1", "22", "333"] | {k: 7} |
