@@ -40,6 +40,27 @@ class CatalogProxy:
 
     def __getattr__(self, name):
         meta = object.__getattribute__(self, "_meta")
+        if name in ("create_user", "alter_user", "change_password"):
+            # hash HERE: the metad raft WAL is a durable log and must
+            # never carry plaintext credentials
+            from ..graphstore.schema import SchemaError, hash_password
+
+            def cred(*a, _name=name, **kw):
+                if _name == "create_user":
+                    meta.ddl("create_user_hashed", a[0],
+                             hash_password(a[1]),
+                             if_not_exists=(kw.get("if_not_exists")
+                                            or (len(a) > 2 and a[2])))
+                    return
+                if _name == "change_password":
+                    u = meta.catalog.get_user(a[0])
+                    if not u.check_password(a[1]):
+                        raise SchemaError("old password mismatch")
+                    meta.ddl("set_password_hash", a[0],
+                             hash_password(a[2]))
+                    return
+                meta.ddl("set_password_hash", a[0], hash_password(a[1]))
+            return cred
         if name in CatalogProxy._MUTATORS:
             return lambda *a, **kw: meta.ddl(name, *a, **kw)
         return getattr(meta.catalog, name)

@@ -46,7 +46,7 @@ def _hb_expire_s() -> float:
 _CATALOG_METHODS = frozenset({
     "create_tag", "create_edge", "alter_tag", "alter_edge",
     "drop_tag", "drop_edge", "create_index", "drop_index",
-    "create_user", "drop_user", "alter_user", "change_password",
+    "create_user_hashed", "set_password_hash", "drop_user",
     "grant_role", "revoke_role"})
 
 

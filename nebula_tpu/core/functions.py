@@ -1094,8 +1094,12 @@ def _cos_similarity(ctx, args):
 
 @register("edges")
 def _edges_of_path(ctx, args):
-    v = args[0]
-    if isinstance(v, Path):
+    if not args:
+        return NULL_BAD_TYPE
+    n = _nullprop(args)
+    if n is not None:
+        return n
+    if isinstance(args[0], Path):
         return FUNCTIONS["relationships"](ctx, args)
     return NULL_BAD_TYPE
 

@@ -59,7 +59,12 @@ class JobManager:
             for sp in spaces:
                 if hasattr(qctx.store, "compact"):
                     removed += qctx.store.compact(sp)
-            return {"compacted": True, "expired_removed": removed}
+            out = {"compacted": True, "expired_removed": removed}
+            if getattr(qctx.store, "_engine", None) is not None:
+                # durability leg: checkpoint + journal truncation (the
+                # SST-compaction analog, SURVEY §2 row 10)
+                out["journal_compacted_to"] = qctx.store.compact_journal()
+            return out
         if command in ("balance data", "balance leader"):
             meta = getattr(qctx.store, "meta", None)
             if meta is not None:        # cluster: run the real plan

@@ -221,6 +221,23 @@ class Catalog:
         self.version += 1
         return u
 
+    def create_user_hashed(self, name: str, pwd_hash: str,
+                           if_not_exists=False) -> UserDesc:
+        """Replay/replication form: the hash IS the payload, so durable
+        logs (standalone journal, metad raft WAL) never see plaintext."""
+        if name in self.users:
+            if if_not_exists:
+                return self.users[name]
+            raise SchemaError(f"user `{name}' already exists")
+        u = UserDesc(name, pwd_hash)
+        self.users[name] = u
+        self.version += 1
+        return u
+
+    def set_password_hash(self, name: str, pwd_hash: str):
+        self.get_user(name).pwd_hash = pwd_hash
+        self.version += 1
+
     def drop_user(self, name: str, if_exists=False):
         if name == "root":
             raise SchemaError("the root user cannot be dropped")

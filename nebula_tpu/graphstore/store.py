@@ -130,21 +130,50 @@ class GraphStore:
     row 12/13 — as Python methods; the cluster storaged wraps this per-host.
     """
 
-    def __init__(self, catalog: Optional[Catalog] = None):
+    def __init__(self, catalog: Optional[Catalog] = None,
+                 data_dir: Optional[str] = None):
         self.catalog = catalog or Catalog()
         self.data: Dict[int, SpaceData] = {}
+        self._engine = None
+        if data_dir is not None:
+            # durable standalone engine (SURVEY §2 row 10): recover from
+            # checkpoint + journal, then resume journaling every mutation
+            from .engine import DurableEngine, JournalingCatalog
+            eng = DurableEngine(data_dir)
+            eng.recover_into(self)
+            self._engine = eng
+            self.catalog = JournalingCatalog(self.catalog, eng)
+
+    def _log(self, *cmd):
+        if self._engine is not None:
+            self._engine.log(cmd)
+
+    def compact_journal(self) -> int:
+        """Checkpoint + journal truncation (SUBMIT JOB COMPACT's
+        durability leg); no-op without an engine."""
+        if self._engine is None:
+            return 0
+        # checkpoint() reads through the JournalingCatalog proxy — hand
+        # it the raw catalog object for serialization
+        return self._engine.compact(self)
+
+    def close(self):
+        if self._engine is not None:
+            self._engine.close()
 
     # ---- space lifecycle ----
     def create_space(self, name: str, **kw) -> SpaceDesc:
         sp = self.catalog.create_space(name, **kw)
         if sp.space_id not in self.data:
             self.data[sp.space_id] = SpaceData(sp)
+        self._log("create_space", name, kw)
         return sp
 
     def drop_space(self, name: str, if_exists=False):
         sp = self.catalog.drop_space(name, if_exists=if_exists)
         if sp is not None:
             self.data.pop(sp.space_id, None)
+        self._log("drop_space", name)
 
     def space(self, name: str) -> SpaceData:
         sp = self.catalog.get_space(name)
@@ -201,6 +230,8 @@ class GraphStore:
         d = descs.get(index_name)
         if d is None:
             raise StoreError(f"index `{index_name}' not found")
+        if parts is None:
+            self._log("rebuild_index", space, index_name)
         from .index import IndexData
         idx = sd.index_data.get(index_name)
         if idx is None or idx.fields != d.fields or \
@@ -259,6 +290,7 @@ class GraphStore:
             self._index_vertex(sd, space, vid, tag,
                                old[1] if old else None, row)
             sd.epoch += 1
+            self._log("vertex", space, vid, tag, sv.version, row)
 
     def insert_edge(self, space: str, src: Any, etype: str, dst: Any,
                     rank: int, props: Dict[str, Any],
@@ -278,6 +310,7 @@ class GraphStore:
             pi.in_edges.setdefault(dst, {}).setdefault(etype, {})[(rank, src)] = row
             self._index_edge(sd, space, src, etype, dst, rank, old, row)
             sd.epoch += 1
+            self._log("edge_pair", space, src, etype, dst, rank, row)
 
     def delete_vertex(self, space: str, vid: Any, with_edges: bool = True):
         sd = self.space(space)
@@ -305,6 +338,7 @@ class GraphStore:
                             self._index_edge(sd, space, src, etype, vid,
                                              rank, row, None)
             sd.epoch += 1
+            self._log("del_vertex_rich", space, vid, with_edges)
 
     def delete_tag(self, space: str, vid: Any, tags: List[str]):
         sd = self.space(space)
@@ -319,6 +353,7 @@ class GraphStore:
                 if not tv:
                     p.vertices.pop(vid, None)
             sd.epoch += 1
+            self._log("del_tag", space, vid, tags)
 
     def delete_edge(self, space: str, src: Any, etype: str, dst: Any, rank: int):
         sd = self.space(space)
@@ -330,6 +365,7 @@ class GraphStore:
             if old is not None:
                 self._index_edge(sd, space, src, etype, dst, rank, old, None)
             sd.epoch += 1
+            self._log("del_edge", space, src, etype, dst, rank)
 
     def update_vertex(self, space: str, vid: Any, tag: str,
                       updates: Dict[str, Any]) -> bool:
@@ -348,6 +384,7 @@ class GraphStore:
             row.update(updates)
             self._index_vertex(sd, space, vid, tag, old, row)
             sd.epoch += 1
+            self._log("upd_vertex", space, vid, tag, updates)
             return True
 
     def update_edge(self, space: str, src: Any, etype: str, dst: Any,
@@ -370,6 +407,8 @@ class GraphStore:
             if irow is not None:
                 irow.update({k: row[k] for k in updates})
             sd.epoch += 1
+            self._log("upd_edge_pair", space, src, etype, dst, rank,
+                      updates)
             return True
 
     # ---- raw part-local apply (cluster write path) ----
@@ -578,8 +617,9 @@ class GraphStore:
         os.makedirs(dirpath, exist_ok=True)
         names = spaces if spaces is not None else sorted(self.catalog.spaces)
         manifest: Dict[str, Any] = {"spaces": {}}
+        raw_catalog = getattr(self.catalog, "_inner", self.catalog)
         with open(os.path.join(dirpath, "catalog.bin"), "wb") as f:
-            f.write(schema_wire.dumps(self.catalog))
+            f.write(schema_wire.dumps(raw_catalog))
         for name in names:
             sd = self.space(name)
             spdir = os.path.join(dirpath, f"space_{sd.desc.space_id}")

@@ -77,6 +77,9 @@ def main(argv=None):
     ap.add_argument("--addr", help="connect to a cluster graphd host:port")
     ap.add_argument("--user", default="root")
     ap.add_argument("--password", default="nebula")
+    ap.add_argument("--data-dir",
+                    help="durable standalone store (journal + checkpoint "
+                         "recovery); default is in-memory")
     args = ap.parse_args(argv)
 
     if args.addr:
@@ -86,7 +89,11 @@ def main(argv=None):
         client.authenticate(args.user, args.password)
         execute = client.execute
     else:
-        eng = QueryEngine()
+        if args.data_dir:
+            from ..graphstore.store import GraphStore
+            eng = QueryEngine(GraphStore(data_dir=args.data_dir))
+        else:
+            eng = QueryEngine()
         sess = eng.new_session(args.user)
         execute = lambda text: eng.execute(sess, text)  # noqa: E731
 

@@ -295,3 +295,29 @@ def test_keyword_aliases_in_match():
     assert rs.error is None and rs.data.rows == [[1]], rs.error
     rs = eng.execute(s, "YIELD [user IN [1, 2, 3] | user * 2] AS l")
     assert rs.error is None and rs.data.rows == [[[2, 4, 6]]], rs.error
+
+
+def test_no_plaintext_passwords_in_meta_raft_log(tmp_path):
+    """User credentials replicate through metad as hashes — the raft WAL
+    on disk must never contain the plaintext."""
+    from nebula_tpu.cluster.launcher import LocalCluster
+    import os
+    c = LocalCluster(n_meta=1, n_storage=1, n_graph=1,
+                     data_dir=str(tmp_path))
+    try:
+        client = c.client()
+        rs = client.execute('CREATE USER vault WITH PASSWORD "s3cr3tpw"')
+        assert rs.error is None, rs.error
+        rs = client.execute(
+            'CHANGE PASSWORD vault FROM "s3cr3tpw" TO "n3wpw"')
+        assert rs.error is None, rs.error
+        rs = client.execute('CHANGE PASSWORD vault FROM "wrong" TO "x"')
+        assert rs.error is not None
+        blob = b""
+        for root, _dirs, files in os.walk(str(tmp_path)):
+            for fn in files:
+                with open(os.path.join(root, fn), "rb") as f:
+                    blob += f.read()
+        assert b"s3cr3tpw" not in blob and b"n3wpw" not in blob
+    finally:
+        c.stop()

@@ -1,0 +1,159 @@
+"""Standalone persistent engine (SURVEY §2 row 10; VERDICT r1 missing
+#8): journal + checkpoint + compaction — a restarted store recovers
+everything, not just what was explicitly snapshotted."""
+import pytest
+
+from nebula_tpu.exec.engine import QueryEngine
+from nebula_tpu.graphstore.store import GraphStore
+
+
+def _populate(store):
+    eng = QueryEngine(store)
+    s = eng.new_session()
+    for q in [
+        "CREATE SPACE d(partition_num=4, vid_type=INT64)",
+        "USE d",
+        "CREATE TAG person(name string, age int)",
+        "CREATE EDGE knows(since int)",
+        "CREATE TAG INDEX by_age ON person(age)",
+        'INSERT VERTEX person(name, age) VALUES 1:("ann", 30), 2:("bob", 25), 3:("cat", 40)',
+        "INSERT EDGE knows(since) VALUES 1->2:(2010), 2->3:(2015)",
+        "REBUILD TAG INDEX by_age",
+        "UPDATE VERTEX ON person 2 SET age = 26",
+        "DELETE VERTEX 3 WITH EDGE",
+        'CREATE USER u1 WITH PASSWORD "pw"',
+    ]:
+        rs = eng.execute(s, q)
+        assert rs.error is None, (q, rs.error)
+    return eng, s
+
+
+def _verify(store, lookup_ids=(1,)):
+    eng = QueryEngine(store)
+    s = eng.new_session()
+    eng.execute(s, "USE d")
+    rs = eng.execute(s, "FETCH PROP ON person 1, 2 YIELD person.name AS n, "
+                        "person.age AS a | ORDER BY $-.a")
+    assert rs.error is None, rs.error
+    assert rs.data.rows == [["ann", 30], ["bob", 26]] or \
+        rs.data.rows == [["bob", 26], ["ann", 30]]
+    rs = eng.execute(s, "FETCH PROP ON person 3 YIELD person.name")
+    assert rs.data.rows == []
+    rs = eng.execute(s, "GO FROM 1 OVER knows YIELD dst(edge) AS dd")
+    assert [r[0] for r in rs.data.rows] == [2]
+    rs = eng.execute(s, "LOOKUP ON person WHERE person.age > 27 "
+                        "YIELD id(vertex) AS i")
+    assert sorted(r[0] for r in rs.data.rows) == sorted(lookup_ids)
+    rs = eng.execute(s, "SHOW USERS")
+    assert sorted(r[0] for r in rs.data.rows) == ["root", "u1"]
+
+
+def test_recovery_from_journal(tmp_path):
+    store = GraphStore(data_dir=str(tmp_path / "db"))
+    _populate(store)
+    store.close()
+    # reopen: everything recovered from journal alone (no compaction ran)
+    store2 = GraphStore(data_dir=str(tmp_path / "db"))
+    _verify(store2)
+    store2.close()
+
+
+def test_recovery_after_compaction(tmp_path):
+    store = GraphStore(data_dir=str(tmp_path / "db"))
+    eng, s = _populate(store)
+    rs = eng.execute(s, "SUBMIT JOB COMPACT")
+    assert rs.error is None
+    # post-compaction writes land in the fresh journal
+    rs = eng.execute(s, 'INSERT VERTEX person(name, age) VALUES 9:("zed", 50)')
+    assert rs.error is None
+    store.close()
+
+    store2 = GraphStore(data_dir=str(tmp_path / "db"))
+    _verify(store2, lookup_ids=(1, 9))
+    eng2 = QueryEngine(store2)
+    s2 = eng2.new_session()
+    eng2.execute(s2, "USE d")
+    rs = eng2.execute(s2, "FETCH PROP ON person 9 YIELD person.name AS n")
+    assert rs.data.rows == [["zed"]]
+    # journal was truncated: it holds only the post-checkpoint tail
+    assert store2._engine.journal.first_index() > 1
+    store2.close()
+
+
+def test_double_restart_idempotent(tmp_path):
+    """Journal replay is idempotent — two recoveries in a row (or a
+    mutation racing a compaction) cannot double-apply."""
+    store = GraphStore(data_dir=str(tmp_path / "db"))
+    _populate(store)
+    store.close()
+    for _ in range(2):
+        st = GraphStore(data_dir=str(tmp_path / "db"))
+        _verify(st)
+        st.close()
+
+
+def test_drop_space_recovers(tmp_path):
+    store = GraphStore(data_dir=str(tmp_path / "db"))
+    eng = QueryEngine(store)
+    s = eng.new_session()
+    eng.execute(s, "CREATE SPACE keepme(partition_num=2, vid_type=INT64)")
+    eng.execute(s, "CREATE SPACE dropme(partition_num=2, vid_type=INT64)")
+    eng.execute(s, "DROP SPACE dropme")
+    store.close()
+    store2 = GraphStore(data_dir=str(tmp_path / "db"))
+    eng2 = QueryEngine(store2)
+    s2 = eng2.new_session()
+    rs = eng2.execute(s2, "SHOW SPACES")
+    assert [r[0] for r in rs.data.rows] == ["keepme"]
+    store2.close()
+
+
+def test_memory_store_unaffected():
+    store = GraphStore()
+    assert store._engine is None
+    assert store.compact_journal() == 0
+
+
+def test_compact_crash_before_truncation(tmp_path, monkeypatch):
+    """A crash after the checkpoint swap but before journal truncation
+    must not double-apply the stale journal prefix on recovery."""
+    store = GraphStore(data_dir=str(tmp_path / "db"))
+    _populate(store)
+    # simulate the crash: compaction runs but truncation never happens
+    from nebula_tpu.cluster.wal import Wal
+    monkeypatch.setattr(Wal, "compact_to", lambda self, idx: None)
+    store.compact_journal()
+    monkeypatch.undo()
+    store.close()
+    store2 = GraphStore(data_dir=str(tmp_path / "db"))   # must not raise
+    _verify(store2)
+    store2.close()
+
+
+def test_compact_crash_between_renames(tmp_path, monkeypatch):
+    """A crash with only checkpoint.old on disk recovers from it."""
+    import os
+    store = GraphStore(data_dir=str(tmp_path / "db"))
+    _populate(store)
+    store.compact_journal()
+    store.close()
+    ck = str(tmp_path / "db" / "checkpoint")
+    os.rename(ck, ck + ".old")      # simulate dying mid-swap
+    store2 = GraphStore(data_dir=str(tmp_path / "db"))
+    _verify(store2)
+    store2.close()
+
+
+def test_no_plaintext_passwords_in_journal(tmp_path):
+    store = GraphStore(data_dir=str(tmp_path / "db"))
+    eng = QueryEngine(store)
+    s = eng.new_session()
+    eng.execute(s, 'CREATE USER sec WITH PASSWORD "hunter2"')
+    eng.execute(s, 'CHANGE PASSWORD sec FROM "hunter2" TO "hunter3"')
+    store.close()
+    raw = (tmp_path / "db" / "journal.wal").read_bytes()
+    assert b"hunter2" not in raw and b"hunter3" not in raw
+    # and the hashed form still authenticates after recovery
+    store2 = GraphStore(data_dir=str(tmp_path / "db"))
+    assert store2.catalog.get_user("sec").check_password("hunter3")
+    store2.close()
