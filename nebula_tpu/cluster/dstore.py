@@ -29,11 +29,12 @@ class CatalogProxy:
     (so `qctx.catalog.create_tag(...)` in a DDL executor works unchanged
     in cluster mode)."""
 
+    # create_user/alter_user/change_password do NOT route here — the
+    # credential branch in __getattr__ rewrites them to hashed forms
     _MUTATORS = frozenset({
         "create_tag", "create_edge", "alter_tag", "alter_edge",
         "drop_tag", "drop_edge", "create_index", "drop_index",
-        "create_user", "drop_user", "alter_user", "change_password",
-        "grant_role", "revoke_role"})
+        "drop_user", "grant_role", "revoke_role"})
 
     def __init__(self, meta: MetaClient):
         object.__setattr__(self, "_meta", meta)
@@ -43,7 +44,7 @@ class CatalogProxy:
         if name in ("create_user", "alter_user", "change_password"):
             # hash HERE: the metad raft WAL is a durable log and must
             # never carry plaintext credentials
-            from ..graphstore.schema import SchemaError, hash_password
+            from ..graphstore.schema import hash_password
 
             def cred(*a, _name=name, **kw):
                 if _name == "create_user":
@@ -53,11 +54,11 @@ class CatalogProxy:
                                             or (len(a) > 2 and a[2])))
                     return
                 if _name == "change_password":
-                    u = meta.catalog.get_user(a[0])
-                    if not u.check_password(a[1]):
-                        raise SchemaError("old password mismatch")
-                    meta.ddl("set_password_hash", a[0],
-                             hash_password(a[2]))
+                    # atomic check-and-set inside the metad state
+                    # machine (a cached-catalog check here would let a
+                    # stale credential authorize the rotation)
+                    meta.ddl("change_password_hashed", a[0],
+                             hash_password(a[1]), hash_password(a[2]))
                     return
                 meta.ddl("set_password_hash", a[0], hash_password(a[1]))
             return cred

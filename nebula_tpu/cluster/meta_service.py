@@ -46,8 +46,35 @@ def _hb_expire_s() -> float:
 _CATALOG_METHODS = frozenset({
     "create_tag", "create_edge", "alter_tag", "alter_edge",
     "drop_tag", "drop_edge", "create_index", "drop_index",
-    "create_user_hashed", "set_password_hash", "drop_user",
-    "grant_role", "revoke_role"})
+    "create_user_hashed", "set_password_hash", "change_password_hashed",
+    "drop_user", "grant_role", "revoke_role"})
+
+
+def _translate_cred_cmd(cmd):
+    """Rewrite legacy plaintext credential DDL to the hashed form —
+    applied BEFORE propose (so new raft entries never carry plaintext)
+    and again at apply time (so WAL entries written by older builds
+    still replay instead of silently dropping accounts)."""
+    from ..graphstore.schema import hash_password
+    m = cmd.get("method")
+    if m not in ("create_user", "alter_user", "change_password"):
+        return cmd
+    args = list(cmd.get("args", ()))
+    kw = dict(cmd.get("kw", {}))
+    out = dict(cmd)
+    if m == "create_user":
+        ine = kw.pop("if_not_exists", False) or             (len(args) > 2 and bool(args[2]))
+        out.update(method="create_user_hashed",
+                   args=[args[0], hash_password(args[1])],
+                   kw={"if_not_exists": ine})
+    elif m == "alter_user":
+        out.update(method="set_password_hash",
+                   args=[args[0], hash_password(args[1])], kw={})
+    else:
+        out.update(method="change_password_hashed",
+                   args=[args[0], hash_password(args[1]),
+                         hash_password(args[2])], kw={})
+    return out
 
 
 def _pk(obj) -> str:
@@ -82,6 +109,7 @@ class MetaState:
     def apply(self, cmd: Dict[str, Any]):
         op = cmd["op"]
         if op == "catalog":
+            cmd = _translate_cred_cmd(cmd)
             if cmd["method"] not in _CATALOG_METHODS:
                 raise RpcError(f"bad catalog method {cmd['method']!r}")
             out = getattr(self.catalog, cmd["method"])(
@@ -275,6 +303,8 @@ class MetaService:
     def rpc_ddl(self, p):
         """DDL: {"cmd64": wire-JSON {"op":"catalog","method":...,args,kw}}."""
         cmd = _unpk(p["cmd64"])
+        if isinstance(cmd, dict):
+            cmd = _translate_cred_cmd(cmd)
         if not isinstance(cmd, dict) or cmd.get("op") != "catalog" or \
                 cmd.get("method") not in _CATALOG_METHODS:
             raise RpcError(f"bad ddl command {cmd.get('method') if isinstance(cmd, dict) else cmd!r}")

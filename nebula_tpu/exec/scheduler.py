@@ -1,16 +1,23 @@
 """DAG scheduler: runs a plan's executors in dependency order.
 
 Analog of the reference's AsyncMsgNotifyBasedScheduler (reference:
-src/graph/scheduler [UNVERIFIED — empty mount, SURVEY §0]).  Plans here
-are in-process DAGs; we execute memoized post-order (each shared node runs
-exactly once), recording per-node timing/row stats for PROFILE.  Branches
-with independent deps can run on a thread pool; the default is sequential
-because the Python executors are CPU-bound under the GIL — the parallelism
-that matters (the device hop loop) lives inside TpuTraverse.
+src/graph/scheduler [UNVERIFIED — empty mount, SURVEY §0]).  Plans are
+in-process DAGs; each shared node runs exactly once, with per-node
+timing/row stats for PROFILE.
+
+Independent branches run CONCURRENTLY on a thread pool (ready-queue
+dispatch, the notify-based scheduler's shape) whenever the plan actually
+branches and the node work can overlap: cluster-mode executors block on
+storage RPCs (socket waits release the GIL), and device-plane nodes
+block in jax dispatch.  Chain-shaped plans and PROFILE runs use the
+sequential path (profiling attributes device stats through
+qctx.last_tpu_stats, which parallel branches would race on).  The
+`scheduler_threads` flag bounds the pool; 0 forces sequential.
 """
 from __future__ import annotations
 
 import time
+from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor, wait
 from typing import Dict, List, Optional
 
 from ..core.value import DataSet
@@ -64,7 +71,8 @@ class Scheduler:
             order.append(n)
 
         topo(plan.root)
-        for node in order:
+
+        def exec_one(node: PlanNode):
             t0 = time.perf_counter()
             if profile is not None:
                 self.qctx.last_tpu_stats = None
@@ -84,4 +92,56 @@ class Scheduler:
                         "buckets": {"F": ts.f_cap, "EB": ts.e_cap},
                         "retries": ts.retries,
                     }
+
+        threads = self._pool_size()
+        branchy = any(len(n.deps) > 1 for n in order)
+        if threads > 1 and branchy and profile is None:
+            self._run_parallel(order, exec_one, threads)
+        else:
+            for node in order:
+                exec_one(node)
         return done[plan.root.id]
+
+    @staticmethod
+    def _pool_size() -> int:
+        from ..utils.config import get_config
+        try:
+            return int(get_config().get("scheduler_threads"))
+        except Exception:  # noqa: BLE001 — config not initialized
+            return 4
+
+    @staticmethod
+    def _run_parallel(order: List[PlanNode], exec_one, threads: int):
+        """Ready-queue dispatch: a node is submitted the moment its last
+        dependency finishes; independent branches overlap."""
+        node_by_id = {n.id: n for n in order}
+        # Argument nodes read their producer BY NAME (from_var) with no
+        # DAG edge — sequential topo order satisfies it implicitly, the
+        # ready-queue must make the edge explicit or the Argument can
+        # dispatch before its variable exists
+        producer = {n.output_var: n.id for n in order}
+        dep_ids: Dict[int, set] = {}
+        for n in order:
+            ids = {d.id for d in n.deps}
+            fv = n.args.get("from_var") if n.args else None
+            if fv in producer and producer[fv] != n.id:
+                ids.add(producer[fv])
+            dep_ids[n.id] = ids
+        remaining = {n.id: len(dep_ids[n.id]) for n in order}
+        dependents: Dict[int, List[int]] = {n.id: [] for n in order}
+        for n in order:
+            for d in dep_ids[n.id]:
+                dependents[d].append(n.id)
+        ready = [n for n in order if remaining[n.id] == 0]
+        with ThreadPoolExecutor(max_workers=threads) as pool:
+            futures = {pool.submit(exec_one, n): n for n in ready}
+            while futures:
+                finished, _ = wait(futures, return_when=FIRST_COMPLETED)
+                for fut in finished:
+                    node = futures.pop(fut)
+                    fut.result()        # re-raise executor errors
+                    for did in dependents[node.id]:
+                        remaining[did] -= 1
+                        if remaining[did] == 0:
+                            futures[pool.submit(
+                                exec_one, node_by_id[did])] = node_by_id[did]

@@ -131,11 +131,22 @@ class DurableEngine:
         self._replaying = True
         try:
             first = max(self.journal.first_index(), 1, upto + 1)
+            from .schema import SchemaError
             for (idx, _term, data) in self.journal.read_range(
                     first, self.journal.last_index() + 1):
                 if idx <= upto:
                     continue
-                self._apply(store, tuple(schema_wire.loads(data)))
+                try:
+                    self._apply(store, tuple(schema_wire.loads(data)))
+                except SchemaError:
+                    # Every journaled DDL op SUCCEEDED when it was
+                    # logged; a SchemaError on replay can only mean its
+                    # effect is already present — an entry logged while
+                    # a concurrent compact() was serializing the catalog
+                    # lands in BOTH the checkpoint and the surviving
+                    # journal tail.  Skipping is the correct idempotent
+                    # resolution (data ops never raise SchemaError).
+                    pass
                 n += 1
         finally:
             self._replaying = False
@@ -209,7 +220,9 @@ class DurableEngine:
         checkpoint() (which takes sd.lock) — ABBA.  It takes engine.lock
         only for the index capture and the truncation; entries logged
         during the checkpoint keep indices > upto, stay in the journal,
-        and re-apply idempotently in order on recovery."""
+        and re-apply in order on recovery — data ops idempotently, DDL
+        ops via recover_into's SchemaError skip (the op's effect is
+        already in the checkpoint)."""
         import json
         import shutil
         with self.lock:

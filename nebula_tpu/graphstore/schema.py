@@ -238,6 +238,18 @@ class Catalog:
         self.get_user(name).pwd_hash = pwd_hash
         self.version += 1
 
+    def change_password_hashed(self, name: str, old_hash: str,
+                               new_hash: str):
+        """CHANGE PASSWORD's check-and-set, replayed INSIDE the state
+        machine: validating the old password against a client's cached
+        catalog would let a stale (already-rotated) credential authorize
+        the change."""
+        u = self.get_user(name)
+        if u.pwd_hash != old_hash:
+            raise SchemaError("old password mismatch")
+        u.pwd_hash = new_hash
+        self.version += 1
+
     def drop_user(self, name: str, if_exists=False):
         if name == "root":
             raise SchemaError("the root user cannot be dropped")

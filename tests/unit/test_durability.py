@@ -157,3 +157,20 @@ def test_no_plaintext_passwords_in_journal(tmp_path):
     store2 = GraphStore(data_dir=str(tmp_path / "db"))
     assert store2.catalog.get_user("sec").check_password("hunter3")
     store2.close()
+
+
+def test_ddl_logged_during_compaction_race_recovers(tmp_path):
+    """DDL that lands in BOTH the checkpoint and the journal tail (a
+    compact() race) must not make the store unopenable."""
+    store = GraphStore(data_dir=str(tmp_path / "db"))
+    _populate(store)
+    # simulate: DDL entry in the journal whose effect is already in the
+    # checkpoint (logged while the catalog was being serialized)
+    store._engine.log(("catalog", "create_tag", ["d", "person",
+                                                 []], {}))
+    store.compact_journal()
+    store._engine.log(("catalog", "create_edge", ["d", "knows", []], {}))
+    store.close()
+    store2 = GraphStore(data_dir=str(tmp_path / "db"))   # must not raise
+    _verify(store2)
+    store2.close()

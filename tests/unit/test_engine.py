@@ -308,3 +308,64 @@ def test_aggregate_empty_group(eng):
 
 def test_case_insensitive_keywords(eng):
     assert rows(eng, 'go from "a" over knows yield dst(edge) as d') == [["b"], ["c"]]
+
+
+# ---------------------------------------------------------------------------
+# scheduler branch concurrency (SURVEY §2 row 24; VERDICT r1 weak #8)
+# ---------------------------------------------------------------------------
+
+
+def test_scheduler_runs_branches_concurrently():
+    import time
+
+    from nebula_tpu.exec.context import ExecutionContext, QueryContext
+    from nebula_tpu.exec.executors import executor, EXECUTORS
+    from nebula_tpu.exec.scheduler import Scheduler
+    from nebula_tpu.query.plan import ExecutionPlan, PlanNode
+    from nebula_tpu.core.value import DataSet
+
+    @executor("_SlowTest")
+    def _slow(node, qctx, ectx, space):
+        time.sleep(0.15)
+        return DataSet(["x"], [[node.args["v"]]])
+
+    @executor("_JoinTest")
+    def _join(node, qctx, ectx, space):
+        from nebula_tpu.exec.executors import _input
+        a = _input(node, ectx, 0)
+        b = _input(node, ectx, 1)
+        return DataSet(["x"], a.rows + b.rows)
+
+    try:
+        left = PlanNode("_SlowTest", deps=[], args={"v": 1}, col_names=["x"])
+        right = PlanNode("_SlowTest", deps=[], args={"v": 2}, col_names=["x"])
+        root = PlanNode("_JoinTest", deps=[left, right], col_names=["x"])
+        plan = ExecutionPlan(root, None)
+        from nebula_tpu.graphstore.store import GraphStore
+        qctx = QueryContext(GraphStore())
+        t0 = time.perf_counter()
+        ds = Scheduler(qctx).run(plan, ExecutionContext())
+        wall = time.perf_counter() - t0
+        assert sorted(r[0] for r in ds.rows) == [1, 2]
+        # two 150ms branches overlapped (sequential would be >= 300ms)
+        assert wall < 0.28, wall
+    finally:
+        EXECUTORS.pop("_SlowTest", None)
+        EXECUTORS.pop("_JoinTest", None)
+
+
+def test_scheduler_sequential_when_disabled():
+    from nebula_tpu.utils.config import get_config
+
+    get_config().set_dynamic("scheduler_threads", 0)
+    try:
+        eng = QueryEngine()
+        s = eng.new_session()
+        eng.execute(s, "CREATE SPACE seq(partition_num=2, vid_type=INT64)")
+        eng.execute(s, "USE seq")
+        eng.execute(s, "CREATE TAG t(x int)")
+        eng.execute(s, "INSERT VERTEX t(x) VALUES 1:(1), 2:(2)")
+        rs = eng.execute(s, "MATCH (a:t), (b:t) RETURN id(a), id(b)")
+        assert rs.error is None and len(rs.data.rows) == 4
+    finally:
+        get_config().set_dynamic("scheduler_threads", 4)
