@@ -128,6 +128,11 @@ class QueryEngine:
         try:
             pctx = PlannerContext(self.qctx, session.space)
             pctx.var_cols.update(session.var_cols)
+            from ..query.validator import ValidationError, validate
+            try:
+                validate(inner, pctx)
+            except ValidationError as ex:
+                return ResultSet(error=f"SemanticError: {ex}")
             from ..query.planner import _plan
             root = _plan(pctx, inner)
             from ..query.plan import ExecutionPlan

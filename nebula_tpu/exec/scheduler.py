@@ -95,7 +95,11 @@ class Scheduler:
 
         threads = self._pool_size()
         branchy = any(len(n.deps) > 1 for n in order)
-        if threads > 1 and branchy and profile is None:
+        # Sequence nodes order side effects by DFS position only (no DAG
+        # edge between prev and next subtrees) — parallel dispatch would
+        # break them, so such plans stay sequential
+        has_seq = any(n.kind == "Sequence" for n in order)
+        if threads > 1 and branchy and not has_seq and profile is None:
             self._run_parallel(order, exec_one, threads)
         else:
             for node in order:

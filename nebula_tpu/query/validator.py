@@ -1,0 +1,289 @@
+"""Validator layer: semantic checks + static type deduction.
+
+The reference validates every sentence BEFORE planning — a Validator
+subclass per sentence resolves schema references and runs type deduction
+over expressions (DeduceTypeVisitor), so `YIELD 1 + "x"` is a
+SemanticError at validation, not a per-row BAD_TYPE at execution
+(reference: src/graph/validator + DeduceTypeVisitor [UNVERIFIED — empty
+mount, SURVEY §2 row 19]).  Same split here: the engine runs
+`validate(stmt, pctx)` between parse and plan; the planner's inline
+checks remain as defense in depth.
+
+Deduction is CONSERVATIVE over a small lattice: a type is reported only
+when provable from literals, schema property types, and function
+signatures; anything data-dependent deduces to UNKNOWN and is admitted
+(runtime three-valued semantics take over, exactly like the reference's
+Value::Type::__EMPTY__ escape).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from ..core import expr as E
+from ..graphstore.schema import PropType, SchemaError
+
+UNKNOWN = "unknown"
+NUMERIC = {"int", "float"}
+
+# conservative return types for builtins whose result type is fixed
+_FN_RETURNS = {
+    "abs": UNKNOWN, "floor": "float", "ceil": "float", "sqrt": "float",
+    "exp": "float", "log": "float", "log2": "float", "log10": "float",
+    "sin": "float", "cos": "float", "tan": "float", "round": "float",
+    "radians": "float", "degrees": "float",
+    "size": "int", "length": "int", "rank": "int", "typeid": "int",
+    "hash": "int", "tointeger": "int", "toint": "int",
+    "tofloat": "float", "toboolean": "bool", "tostring": "string",
+    "lower": "string", "upper": "string", "tolower": "string",
+    "toupper": "string", "trim": "string", "ltrim": "string",
+    "rtrim": "string", "substr": "string", "substring": "string",
+    "left": "string", "right": "string", "replace": "string",
+    "concat": "string", "type": "string", "md5": "string",
+    "sha1": "string", "sha256": "string",
+    "split": "list", "keys": "list", "labels": "list", "tags": "list",
+    "nodes": "list", "relationships": "list", "range": "list",
+    "st_distance": "float", "st_x": "float", "st_y": "float",
+    "st_astext": "string", "st_dwithin": "bool", "st_intersects": "bool",
+    "st_covers": "bool", "st_coveredby": "bool", "st_isvalid": "bool",
+}
+
+_PT_KIND = {
+    PropType.BOOL: "bool", PropType.FLOAT: "float",
+    PropType.DOUBLE: "float", PropType.STRING: "string",
+    PropType.FIXED_STRING: "string", PropType.DATE: "date",
+    PropType.TIME: "time", PropType.DATETIME: "datetime",
+    PropType.DURATION: "duration", PropType.GEOGRAPHY: "geography",
+}
+
+
+class ValidationError(Exception):
+    pass
+
+
+class Scope:
+    """What names mean inside the statement being validated."""
+
+    def __init__(self, pctx, edge_types=None, match_aliases=None):
+        self.pctx = pctx
+        self.edge_types = set(edge_types or ())
+        self.match_aliases = dict(match_aliases or {})
+
+
+def _lit_type(v: Any) -> str:
+    if isinstance(v, bool):
+        return "bool"
+    if isinstance(v, int):
+        return "int"
+    if isinstance(v, float):
+        return "float"
+    if isinstance(v, str):
+        return "string"
+    if v is None:
+        return UNKNOWN
+    return UNKNOWN
+
+
+def deduce(e: E.Expr, scope: Scope) -> str:
+    """Static type of `e`, or UNKNOWN when not provable."""
+    k = e.kind
+    if k == "literal":
+        return _lit_type(e.value)
+    if k in ("list", "set"):
+        for item in e.items:
+            deduce(item, scope)
+        return "list" if k == "list" else "set"
+    if k == "map":
+        for _, item in e.items:
+            deduce(item, scope)
+        return "map"
+    if k == "edge_prop":
+        return _edge_prop_type(e.edge, e.name, scope)
+    if k == "attribute":
+        # raw parse of `etype.prop` in a GO WHERE: attribute-of-label
+        # (the planner canonicalizes later; deduce from schema now)
+        if isinstance(e.obj, E.LabelExpr) and e.obj.name in scope.edge_types:
+            return _edge_prop_type(e.obj.name, e.attr, scope)
+        if isinstance(e.obj, E.Expr):
+            deduce(e.obj, scope)
+        return UNKNOWN
+    if k in ("src_prop", "dst_prop"):
+        return _tag_prop_type(e.tag, e.name, scope)
+    if k == "unary":
+        t = deduce(e.operand, scope)
+        if e.op in ("IS_NULL", "IS_NOT_NULL", "IS_EMPTY", "IS_NOT_EMPTY"):
+            return "bool"
+        if e.op == "NOT":
+            if t not in (UNKNOWN, "bool"):
+                raise ValidationError(f"NOT over {t}")
+            return "bool"
+        if e.op in ("-", "+"):
+            if t not in (UNKNOWN, "int", "float"):
+                raise ValidationError(f"unary {e.op} over {t}")
+            return t
+        return UNKNOWN
+    if k == "binary":
+        return _binary_type(e, scope)
+    if k == "function":
+        for a in e.args:
+            deduce(a, scope)
+        if e.name.lower() in ("coalesce", "head", "last"):
+            return UNKNOWN
+        return _FN_RETURNS.get(e.name.lower(), UNKNOWN)
+    if k == "aggregate":
+        if e.arg is not None:
+            deduce(e.arg, scope)
+        if e.func in ("count",):
+            return "int"
+        if e.func in ("avg", "std"):
+            return "float"
+        if e.func in ("collect", "collect_set"):
+            return "list"
+        return UNKNOWN
+    if k == "case":
+        if e.condition is not None:
+            deduce(e.condition, scope)
+        outs = set()
+        for w, t in e.whens:
+            wt = deduce(w, scope)
+            if e.condition is None and wt not in (UNKNOWN, "bool"):
+                raise ValidationError(f"CASE WHEN condition is {wt}")
+            outs.add(deduce(t, scope))
+        if e.default is not None:
+            outs.add(deduce(e.default, scope))
+        return outs.pop() if len(outs) == 1 else UNKNOWN
+    if k in ("subscript", "slice"):
+        deduce(e.obj, scope)
+        return UNKNOWN
+    if k in ("list_comprehension", "predicate", "reduce"):
+        return ("list" if k == "list_comprehension"
+                else "bool" if k == "predicate" else UNKNOWN)
+    return UNKNOWN
+
+
+def _binary_type(e, scope: Scope) -> str:
+    lt, rt = deduce(e.lhs, scope), deduce(e.rhs, scope)
+    op = e.op
+    if op in ("AND", "OR", "XOR"):
+        for t in (lt, rt):
+            if t not in (UNKNOWN, "bool"):
+                raise ValidationError(f"{op} over {t}")
+        return "bool"
+    if op in ("==", "!=", "IS", "IS NOT"):
+        return "bool"
+    if op in ("<", "<=", ">", ">="):
+        if UNKNOWN not in (lt, rt) and lt != rt \
+                and not (lt in NUMERIC and rt in NUMERIC):
+            raise ValidationError(f"comparison {lt} {op} {rt}")
+        return "bool"
+    if op in ("IN", "NOT IN", "CONTAINS", "NOT CONTAINS",
+              "STARTS WITH", "ENDS WITH", "NOT STARTS WITH",
+              "NOT ENDS WITH", "=~"):
+        return "bool"
+    if op in ("+",):
+        if UNKNOWN in (lt, rt):
+            return UNKNOWN
+        if lt == "string" and rt == "string":
+            return "string"
+        if lt in NUMERIC and rt in NUMERIC:
+            return "float" if "float" in (lt, rt) else "int"
+        if lt == "list" or rt == "list":
+            return "list"
+        if {lt, rt} & {"date", "time", "datetime", "duration"}:
+            return UNKNOWN          # temporal arithmetic: runtime rules
+        raise ValidationError(f"`+' over {lt} and {rt}")
+    if op in ("-", "*", "/", "%"):
+        for t in (lt, rt):
+            if t not in (UNKNOWN, "int", "float", "duration", "date",
+                         "time", "datetime"):
+                raise ValidationError(f"`{op}' over {t}")
+        if lt in NUMERIC and rt in NUMERIC:
+            return "float" if "float" in (lt, rt) else "int"
+        return UNKNOWN
+    return UNKNOWN
+
+
+def _edge_prop_type(edge: Optional[str], name: str, scope: Scope) -> str:
+    if name.startswith("_"):
+        return {"_rank": "int", "_type": "string"}.get(name, UNKNOWN)
+    pctx = scope.pctx
+    if not pctx.space or edge in (None, "__edge__"):
+        return UNKNOWN
+    try:
+        sv = pctx.catalog.get_edge(pctx.space, edge).latest
+    except SchemaError:
+        return UNKNOWN          # planner raises the schema error itself
+    pd = sv.prop(name)
+    if pd is None:
+        raise ValidationError(f"edge `{edge}' has no property `{name}'")
+    return _PT_KIND.get(pd.ptype, "int")
+
+
+def _tag_prop_type(tag: str, name: str, scope: Scope) -> str:
+    pctx = scope.pctx
+    if not pctx.space:
+        return UNKNOWN
+    try:
+        sv = pctx.catalog.get_tag(pctx.space, tag).latest
+    except SchemaError:
+        return UNKNOWN
+    pd = sv.prop(name)
+    if pd is None:
+        raise ValidationError(f"tag `{tag}' has no property `{name}'")
+    return _PT_KIND.get(pd.ptype, "int")
+
+
+# ---------------------------------------------------------------------------
+# sentence-level validation
+# ---------------------------------------------------------------------------
+
+
+def _exprs_of(stmt) -> list:
+    """Expressions a sentence carries, by sentence shape (yield/where)."""
+    from . import ast as A
+    out = []
+    where = getattr(stmt, "where", None)
+    if where is not None:
+        cond = getattr(where, "filter", where)
+        if isinstance(cond, E.Expr):
+            out.append(("where", cond))
+    yld = getattr(stmt, "yield_", None)
+    if yld is not None:
+        for c in getattr(yld, "columns", []) or []:
+            out.append(("yield", c.expr))
+    return out
+
+
+def validate(stmt, pctx) -> None:
+    """Type-deduce every expression the sentence carries; raise
+    ValidationError on provable type errors.  Composition sentences
+    recurse; statements the deducer has no model for pass through."""
+    from . import ast as A
+    if isinstance(stmt, A.SeqSentence):
+        for sub in stmt.stmts:
+            validate(sub, pctx)
+        return
+    if isinstance(stmt, (A.PipedSentence, A.SetOpSentence)):
+        validate(stmt.left, pctx)
+        # the right side of a pipe reads $-.cols whose types come from
+        # the left's output — deducible only to UNKNOWN; still validate
+        # its literal/schema-typed subtrees
+        validate(stmt.right, pctx)
+        return
+    if isinstance(stmt, A.ExplainSentence):
+        validate(stmt.stmt, pctx)
+        return
+    if isinstance(stmt, A.AssignSentence):
+        validate(stmt.stmt, pctx)
+        return
+
+    edge_types = ()
+    if isinstance(stmt, A.GoSentence) and stmt.over is not None:
+        edge_types = tuple(stmt.over.edges or ())
+    scope = Scope(pctx, edge_types=edge_types)
+    for (_where, ex) in _exprs_of(stmt):
+        try:
+            deduce(ex, scope)
+        except ValidationError:
+            raise
+        except Exception:  # noqa: BLE001 — deduction must never block
+            return

@@ -60,18 +60,25 @@ class MemoryTracker:
     are versioned and kept for $vars/PROFILE, so releases are rare and
     conservatively ignored."""
 
-    __slots__ = ("limit", "used")
+    __slots__ = ("limit", "used", "_mu")
 
     def __init__(self, limit: Optional[int] = None):
+        import threading
+        self._mu = threading.Lock()
         if limit is None:
             limit = int(get_config().get("query_memory_limit_bytes"))
         self.limit = limit
         self.used = 0
 
     def charge(self, nbytes: int):
-        self.used += int(nbytes)
-        if self.limit and self.used > self.limit:
-            raise MemoryExceeded(self.used, self.limit)
+        # executors charge from scheduler pool threads concurrently — an
+        # unlocked read-modify-write loses updates and under-enforces
+        # the kill switch on exactly the large parallel plans it guards
+        with self._mu:
+            self.used += int(nbytes)
+            used = self.used
+        if self.limit and used > self.limit:
+            raise MemoryExceeded(used, self.limit)
 
     def charge_rows(self, rows: List[List[Any]]):
         self.charge(approx_dataset_bytes(rows))

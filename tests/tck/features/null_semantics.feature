@@ -137,10 +137,17 @@ Feature: Null semantics
       """
     Then a SemanticError should be raised
 
-  Scenario: comparing mismatched types yields null not error
+  Scenario: literal type mismatch is rejected at validation
     When executing query:
       """
-      YIELD 1 < "a" AS a, true > 0 AS b
+      YIELD 1 < "a" AS a
+      """
+    Then a SemanticError should be raised
+
+  Scenario: dynamic type mismatch yields null at runtime
+    When executing query:
+      """
+      YIELD 1 AS x | YIELD $-.x < "a" AS a, $-.x > true AS b
       """
     Then the result should be, in order:
       | a            | b            |

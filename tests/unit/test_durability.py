@@ -164,11 +164,10 @@ def test_ddl_logged_during_compaction_race_recovers(tmp_path):
     compact() race) must not make the store unopenable."""
     store = GraphStore(data_dir=str(tmp_path / "db"))
     _populate(store)
-    # simulate: DDL entry in the journal whose effect is already in the
-    # checkpoint (logged while the catalog was being serialized)
-    store._engine.log(("catalog", "create_tag", ["d", "person",
-                                                 []], {}))
     store.compact_journal()
+    # simulate the race: a DDL entry that survives truncation (idx >
+    # upto) but whose effect is ALREADY in the checkpoint — exactly what
+    # a mutation logged while compact() serialized the catalog looks like
     store._engine.log(("catalog", "create_edge", ["d", "knows", []], {}))
     store.close()
     store2 = GraphStore(data_dir=str(tmp_path / "db"))   # must not raise

@@ -307,7 +307,12 @@ def test_temporal_and_overflow_predicates_fall_back(rt):
     st.insert_edge("t", 1, "e", 2, 0, {"ts": DateTime(2020, 5, 1, 12), "w": 3})
     st.insert_edge("t", 2, "e", 3, 0, {"ts": DateTime(2021, 6, 2, 13), "w": 4})
     for q in [
-        "GO 2 STEPS FROM 1 OVER e WHERE e.ts > 5 YIELD src(edge), dst(edge)",
+        # datetime-vs-datetime compares refuse device compilation (the
+        # encodings are order-isomorphic but the mask compiler keeps
+        # temporal kinds distinct); datetime-vs-INT is now rejected
+        # upstream by the validator's type deduction
+        'GO 2 STEPS FROM 1 OVER e WHERE e.ts > datetime("2020-12-01T00:00:00") '
+        "YIELD src(edge), dst(edge)",
         "GO 2 STEPS FROM 1 OVER e WHERE e.w < 99999999999999999999999 "
         "YIELD src(edge), dst(edge)",
         "GO 2 STEPS FROM 1 OVER e WHERE e.w IN [\"x\", 3] "

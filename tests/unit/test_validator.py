@@ -1,0 +1,74 @@
+"""Validator layer with static type deduction (SURVEY §2 row 19;
+VERDICT r1 'no separate validator layer or type deduction')."""
+import pytest
+
+from nebula_tpu.exec.engine import QueryEngine
+
+
+@pytest.fixture
+def eng():
+    e = QueryEngine()
+    s = e.new_session()
+    for q in ["CREATE SPACE v(partition_num=2, vid_type=INT64)", "USE v",
+              "CREATE TAG t(x int, name string)",
+              "CREATE EDGE e(w int, tag string)"]:
+        r = e.execute(s, q)
+        assert r.error is None, (q, r.error)
+    return e, s
+
+
+REJECTED = [
+    'YIELD 1 + "x"',
+    "YIELD NOT 5",
+    'YIELD "a" < 1',
+    "YIELD true AND 3",
+    'YIELD -"s"',
+    'YIELD ("a" + "b") * 2',
+    'YIELD CASE WHEN 3 THEN 1 END',
+    'GO FROM 1 OVER e WHERE e.w + "s" > 2 YIELD dst(edge)',
+    'GO FROM 1 OVER e WHERE e.tag < 5 YIELD dst(edge)',
+    "GO FROM 1 OVER e WHERE e.nosuch > 1 YIELD dst(edge)",
+    'GO FROM 1 OVER e YIELD e.w + "x"',
+]
+
+ACCEPTED = [
+    'YIELD 1 + 2 AS s, "a" + "b" AS c, 1 < 2.5 AS d',
+    "YIELD [1, 2] + [3] AS l",
+    'GO FROM 1 OVER e WHERE e.tag CONTAINS "x" YIELD dst(edge)',
+    "GO FROM 1 OVER e WHERE e.w > 2 AND e.w < 9 YIELD dst(edge)",
+    'YIELD CASE WHEN 1 > 2 THEN "a" ELSE "b" END AS c',
+    "YIELD size([1,2]) + 1 AS n",
+    # dynamic/unknown stays runtime-checked (three-valued semantics)
+    "YIELD coalesce(1, \"x\") AS mixed",
+    "GO FROM 1 OVER e WHERE e.w + 0.5 > 1 YIELD dst(edge) AS d",
+]
+
+
+@pytest.mark.parametrize("q", REJECTED)
+def test_type_errors_rejected_at_validation(eng, q):
+    e, s = eng
+    rs = e.execute(s, q)
+    assert rs.error is not None and "SemanticError" in rs.error, (q, rs.error)
+
+
+@pytest.mark.parametrize("q", ACCEPTED)
+def test_valid_statements_pass(eng, q):
+    e, s = eng
+    rs = e.execute(s, q)
+    assert rs.error is None, (q, rs.error)
+
+
+def test_deduce_api():
+    from nebula_tpu.query.parser import parse_expression
+    from nebula_tpu.query.validator import Scope, deduce
+
+    class _P:
+        space = None
+        catalog = None
+    sc = Scope(_P())
+    assert deduce(parse_expression("1 + 2"), sc) == "int"
+    assert deduce(parse_expression("1 + 2.0"), sc) == "float"
+    assert deduce(parse_expression('"a" + "b"'), sc) == "string"
+    assert deduce(parse_expression("1 < 2"), sc) == "bool"
+    assert deduce(parse_expression("upper(\"x\")"), sc) == "string"
+    assert deduce(parse_expression("size([1])"), sc) == "int"
