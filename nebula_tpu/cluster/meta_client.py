@@ -184,6 +184,19 @@ class MetaClient:
     def submit_job(self, cmd: str, space: Optional[str] = None) -> int:
         return self.call("meta.submit_job", cmd=cmd, space=space)
 
+    def add_hosts_to_zone(self, hosts, zone: str):
+        self.call("meta.add_hosts", hosts=list(hosts), zone=zone)
+
+    def drop_zone(self, zone: str):
+        self.call("meta.drop_zone", zone=zone)
+
+    def list_zones(self):
+        return self.call("meta.list_zones")
+
+    def allocate_ids(self, count: int = 1) -> int:
+        """Cluster-unique monotonic id range; returns the range start."""
+        return self.call("meta.allocate_ids", count=count)["start"]
+
     # -- balance plane (BALANCE DATA / BALANCE LEADER) --
 
     def set_part_replicas(self, space: str, part: int, replicas):

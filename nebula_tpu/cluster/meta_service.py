@@ -98,6 +98,13 @@ class MetaState:
         self.configs: Dict[str, Any] = {}
         self.jobs: Dict[int, Dict[str, Any]] = {}
         self.next_job = 1
+        # zone → member hosts (replica placement isolation, SURVEY §2
+        # row 17); hosts outside any zone placement-wise form singleton
+        # zones of their own
+        self.zones: Dict[str, List[str]] = {}
+        # segment ID allocator (the metad ID service): monotonically
+        # increasing, raft-replicated, never reused
+        self.next_alloc_id = 1
         self.version = 0
 
     def snapshot(self) -> bytes:
@@ -166,6 +173,25 @@ class MetaState:
             if c["to"] in replicas:
                 replicas.remove(c["to"])
                 replicas.insert(0, c["to"])
+
+    def _ap_add_zone_hosts(self, c):
+        z = self.zones.setdefault(c["zone"], [])
+        for h in c["hosts"]:
+            for other in self.zones.values():
+                if h in other:
+                    other.remove(h)
+            if h not in z:
+                z.append(h)
+
+    def _ap_drop_zone(self, c):
+        if c["zone"] not in self.zones:
+            raise RpcError(f"zone `{c['zone']}' not found")
+        self.zones.pop(c["zone"])
+
+    def _ap_allocate_ids(self, c):
+        start = self.next_alloc_id
+        self.next_alloc_id += int(c["count"])
+        return start
 
     def _ap_set_part_replicas(self, c):
         """BALANCE DATA membership step: adopt a new replica list for one
@@ -291,8 +317,33 @@ class MetaService:
             raise RpcError(f"replica_factor {replica} > {len(hosts)} hosts")
         # leader resolves placement; replicas replay it verbatim.  This
         # list IS the chip-placement map for device-pinned spaces.
-        assignment = [[hosts[(pid + r) % len(hosts)] for r in range(replica)]
-                      for pid in range(partition_num)]
+        # Zone-aware spreading: when zones exist, a part's replicas land
+        # in DISTINCT zones (unzoned hosts count as singleton zones), so
+        # a zone loss takes at most one replica of any part.
+        with self.state_lock:
+            zones = {z: [h for h in hs if h in hosts]
+                     for z, hs in self.state.zones.items()}
+        zoned = {h for hs in zones.values() for h in hs}
+        for h in hosts:
+            if h not in zoned:
+                zones[f"__host_{h}"] = [h]
+        zone_names = sorted(z for z, hs in zones.items() if hs)
+        if replica > len(zone_names):
+            # zone isolation unsatisfiable — fall back to host spreading
+            assignment = [[hosts[(pid + r) % len(hosts)]
+                           for r in range(replica)]
+                          for pid in range(partition_num)]
+        else:
+            assignment = []
+            for pid in range(partition_num):
+                reps = []
+                for r in range(replica):
+                    zn = zones[zone_names[(pid + r) % len(zone_names)]]
+                    # decorrelated intra-zone pick: pid % len(zn) would
+                    # rotate in lockstep with the zone rotation, starving
+                    # some hosts of leaders (reps[0]) entirely
+                    reps.append(zn[(pid // len(zone_names)) % len(zn)])
+                assignment.append(reps)
         return self._propose({"op": "create_space", "name": p["name"],
                               "kw": kw, "assignment": assignment})
 
@@ -377,6 +428,39 @@ class MetaService:
     def rpc_transfer_leader(self, p):
         return self._propose({"op": "transfer_leader", "space": p["space"],
                               "part": p["part"], "to": p["to"]})
+
+    def rpc_add_hosts(self, p):
+        """ADD HOSTS ... INTO ZONE z: assign hosts to a placement zone
+        (moves them out of any previous zone).  Hosts must be
+        `host:port` — a malformed entry would raft-replicate verbatim
+        and break every later SHOW ZONES."""
+        hosts = list(p["hosts"])
+        for h in hosts:
+            bad = ":" not in h
+            if not bad:
+                try:
+                    int(h.rsplit(":", 1)[1])
+                except ValueError:
+                    bad = True
+            if bad:
+                raise RpcError(f"bad host `{h}' (want host:port)")
+        return self._propose({"op": "add_zone_hosts", "zone": p["zone"],
+                              "hosts": hosts})
+
+    def rpc_drop_zone(self, p):
+        return self._propose({"op": "drop_zone", "zone": p["zone"]})
+
+    def rpc_list_zones(self, p):
+        with self.state_lock:
+            return {z: list(hs) for z, hs in self.state.zones.items()}
+
+    def rpc_allocate_ids(self, p):
+        """Segment ID allocation (the metad ID service): returns the
+        start of a [start, start+count) range unique across the cluster
+        lifetime — raft-serialized, never reused."""
+        start = self._propose({"op": "allocate_ids",
+                               "count": int(p.get("count", 1))})
+        return {"start": start, "count": int(p.get("count", 1))}
 
     def rpc_set_part_replicas(self, p):
         return self._propose({"op": "set_part_replicas",

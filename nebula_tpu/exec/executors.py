@@ -1177,6 +1177,12 @@ def _show(node, qctx, ectx, space):
                                   sorted(items, key=lambda x: x.name)])
     if kind == "users":
         return DataSet(["Account"], [[n] for n in sorted(cat.users)])
+    if kind == "zones":
+        cluster = getattr(qctx, "cluster", None)
+        zones = cluster.list_zones() if cluster is not None else {}
+        return DataSet(["Name", "Host", "Port"],
+                       [[z, h.rsplit(":", 1)[0], int(h.rsplit(":", 1)[1])]
+                        for z in sorted(zones) for h in zones[z]])
     if kind == "roles":
         sp = a.get("extra")
         cat.get_space(sp)
@@ -1268,6 +1274,25 @@ def _show(node, qctx, ectx, space):
         return DataSet([kw.title(), f"Create {kw.title()}"],
                        [[name, f"CREATE {kw} `{name}` (" + ", ".join(parts) + ")"]])
     raise ExecError(f"unsupported SHOW {kind}")
+
+
+@executor("AddHosts")
+def _add_hosts(node, qctx, ectx, space):
+    cluster = getattr(qctx, "cluster", None)
+    if cluster is None:
+        raise ExecError("ADD HOSTS ... INTO ZONE needs cluster mode "
+                        "(zones are a metad placement concept)")
+    cluster.add_hosts_to_zone(node.args["hosts"], node.args["zone"])
+    return DataSet()
+
+
+@executor("DropZone")
+def _drop_zone(node, qctx, ectx, space):
+    cluster = getattr(qctx, "cluster", None)
+    if cluster is None:
+        raise ExecError("DROP ZONE needs cluster mode")
+    cluster.drop_zone(node.args["zone"])
+    return DataSet()
 
 
 @executor("CreateUser")

@@ -25,7 +25,8 @@ from ..query import ast as A
 _GLOBAL_GOD = (
     A.CreateSpaceSentence, A.DropSpaceSentence, A.CreateUserSentence,
     A.DropUserSentence, A.AlterUserSentence, A.CreateSnapshotSentence,
-    A.DropSnapshotSentence, A.UpdateConfigsSentence)
+    A.DropSnapshotSentence, A.UpdateConfigsSentence,
+    A.AddHostsSentence, A.DropZoneSentence)
 _SPACE_ADMIN = (A.GrantRoleSentence, A.RevokeRoleSentence)
 _SPACE_DBA = (
     A.CreateSchemaSentence, A.AlterSchemaSentence, A.DropSchemaSentence,

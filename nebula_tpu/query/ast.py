@@ -288,6 +288,17 @@ class DeleteTagsSentence(Sentence):
 
 
 @dataclass
+class AddHostsSentence(Sentence):
+    hosts: list
+    zone: str
+
+
+@dataclass
+class DropZoneSentence(Sentence):
+    zone: str
+
+
+@dataclass
 class CreateUserSentence(Sentence):
     name: str
     password: str

@@ -174,12 +174,23 @@ class Parser:
             "ORDER": self.p_order_by, "LIMIT": self.p_limit,
             "SAMPLE": self.p_sample, "REBUILD": self.p_rebuild,
             "SUBMIT": self.p_submit, "KILL": self.p_kill,
-            "UNWIND": self.p_match, "GRANT": self.p_grant,
+            "UNWIND": self.p_match, "GRANT": self.p_grant, "ADD": self.p_add,
             "REVOKE": self.p_revoke, "CHANGE": self.p_change_password,
         }.get(kw)
         if fn is None:
             raise ParseError(f"unsupported statement `{kw}' at pos {t.pos}")
         return fn()
+
+    def p_add(self) -> A.AddHostsSentence:
+        """ADD HOSTS "h:p" [, ...] INTO ZONE zname — placement zones."""
+        self.expect_kw("ADD")
+        self.expect_kw("HOSTS")
+        hosts = [self.expect("STRING").value]
+        while self.accept(","):
+            hosts.append(self.expect("STRING").value)
+        self.expect_kw("INTO")
+        self.expect_kw("ZONE")
+        return A.AddHostsSentence(hosts, self.ident())
 
     # ---- user management (reference: GRANT/REVOKE ROLE, CHANGE PASSWORD) --
     def p_grant(self) -> A.GrantRoleSentence:
@@ -491,7 +502,10 @@ class Parser:
         if self.accept_kw("USER"):
             ife = self.p_if_exists()
             return A.DropUserSentence(self.ident(), ife)
-        raise ParseError("expected SPACE/TAG/EDGE/SNAPSHOT/USER after DROP")
+        if self.accept_kw("ZONE"):
+            return A.DropZoneSentence(self.ident())
+        raise ParseError(
+            "expected SPACE/TAG/EDGE/SNAPSHOT/USER/ZONE after DROP")
 
     def p_alter(self) -> A.Sentence:
         self.expect_kw("ALTER")
@@ -543,7 +557,7 @@ class Parser:
                 if kw == "JOBS":
                     return A.ShowJobsSentence()
                 return A.ShowSentence(kw.lower())
-            if kw in ("TAGS", "EDGES", "USERS"):
+            if kw in ("TAGS", "EDGES", "USERS", "ZONES"):
                 self.next()
                 return A.ShowSentence(kw.lower())
             if kw == "ROLES":

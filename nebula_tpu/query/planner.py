@@ -1211,6 +1211,10 @@ def _register_dispatch():
             "KillQuery", session_id=s.session_id, plan_id=s.plan_id),
         A.UpdateConfigsSentence: lambda p, s: _admin(
             "UpdateConfigs", name=s.name, value=s.value),
+        A.AddHostsSentence: lambda p, s: _admin(
+            "AddHosts", hosts=s.hosts, zone=s.zone),
+        A.DropZoneSentence: lambda p, s: _admin(
+            "DropZone", zone=s.zone),
         A.CreateUserSentence: lambda p, s: _admin(
             "CreateUser", name=s.name, password=s.password,
             if_not_exists=s.if_not_exists),

@@ -125,6 +125,10 @@ class TpuRuntime:
         self.local_mode = self.mesh_size == 1
         self.snapshots: Dict[str, DeviceSnapshot] = {}
         self._fns: Dict[Tuple, Any] = {}
+        # program → last converged (F, EB): repeat queries start AT the
+        # converged buckets instead of re-climbing the escalation ladder
+        # (the ladder re-runs the kernel once per rung, per query)
+        self._buckets: Dict[Tuple, Tuple[int, int]] = {}
         self.max_retries = 10
         from ..utils.config import get_config
         self.init_f = int(get_config().get("tpu_init_frontier"))
@@ -220,6 +224,10 @@ class TpuRuntime:
             cnt[d % P] += 1
         F = max(self.init_f, _pow2(max(cnt)))
         EB = self.init_eb
+        bkey = key_fn(0, 0)     # program identity, buckets excluded
+        prev = self._buckets.get(bkey)
+        if prev is not None:
+            F, EB = max(F, prev[0]), max(EB, prev[1])
         if self.local_mode:
             target = self.mesh.devices.reshape(-1)[0]
         else:
@@ -264,6 +272,9 @@ class TpuRuntime:
                 esc = True
             if not esc:
                 stats.f_cap, stats.e_cap = F, EB
+                self._buckets[bkey] = (F, EB)
+                if len(self._buckets) > 512:
+                    self._buckets.clear()
                 stats.hop_edges = [int(x)
                                    for x in res["hop_edges"].sum(axis=0)]
                 from ..utils.stats import stats as _metrics

@@ -264,3 +264,79 @@ def test_pushdown_string_filter_roundtrip(tmp_path):
         assert [r[0] for r in rs.data.rows] == [3]
     finally:
         c.stop()
+
+
+def test_zones_and_id_allocation(tmp_path):
+    """Placement zones (SURVEY §2 row 17): replicas of a part land in
+    distinct zones; metad allocates cluster-unique id segments."""
+    from nebula_tpu.cluster.launcher import LocalCluster
+    c = LocalCluster(n_meta=1, n_storage=4, n_graph=1,
+                     data_dir=str(tmp_path))
+    try:
+        client = c.client()
+        addrs = [s.addr for s in c.storage_servers]
+        rs = client.execute(
+            f'ADD HOSTS "{addrs[0]}", "{addrs[1]}" INTO ZONE east')
+        assert rs.error is None, rs.error
+        rs = client.execute(
+            f'ADD HOSTS "{addrs[2]}", "{addrs[3]}" INTO ZONE west')
+        assert rs.error is None, rs.error
+        rs = client.execute("SHOW ZONES")
+        assert rs.error is None
+        assert sorted({r[0] for r in rs.data.rows}) == ["east", "west"]
+        assert len(rs.data.rows) == 4
+
+        rs = client.execute(
+            "CREATE SPACE zoned(partition_num=6, replica_factor=2, "
+            "vid_type=INT64)")
+        assert rs.error is None, rs.error
+        meta = c.graphds[0].meta
+        meta.refresh(force=True)
+        east, west = set(addrs[:2]), set(addrs[2:])
+        for reps in meta.parts_of("zoned"):
+            zones_hit = {("east" if r in east else "west") for r in reps}
+            assert len(zones_hit) == 2, reps   # one replica per zone
+
+        # moving a host between zones removes it from the old one
+        rs = client.execute(f'ADD HOSTS "{addrs[0]}" INTO ZONE west')
+        assert rs.error is None
+        zones = meta.list_zones()
+        assert addrs[0] in zones["west"] and addrs[0] not in zones["east"]
+
+        # id allocation: monotonic, disjoint segments
+        a = meta.allocate_ids(10)
+        b = meta.allocate_ids(5)
+        c2 = meta.allocate_ids(1)
+        assert a + 10 <= b and b + 5 <= c2
+
+        rs = client.execute("DROP ZONE east")
+        assert rs.error is None
+        rs = client.execute("SHOW ZONES")
+        assert sorted({r[0] for r in rs.data.rows}) == ["west"]
+    finally:
+        c.stop()
+
+
+def test_zone_leader_spread_and_host_validation(tmp_path):
+    from nebula_tpu.cluster.launcher import LocalCluster
+    c = LocalCluster(n_meta=1, n_storage=4, n_graph=1,
+                     data_dir=str(tmp_path))
+    try:
+        client = c.client()
+        addrs = [s.addr for s in c.storage_servers]
+        client.execute(f'ADD HOSTS "{addrs[0]}", "{addrs[1]}" INTO ZONE a')
+        client.execute(f'ADD HOSTS "{addrs[2]}", "{addrs[3]}" INTO ZONE b')
+        rs = client.execute(
+            "CREATE SPACE zl(partition_num=8, replica_factor=2, "
+            "vid_type=INT64)")
+        assert rs.error is None, rs.error
+        meta = c.graphds[0].meta
+        meta.refresh(force=True)
+        leaders = {reps[0] for reps in meta.parts_of("zl")}
+        assert len(leaders) == 4, leaders   # every host leads something
+
+        rs = client.execute('ADD HOSTS "noport" INTO ZONE a')
+        assert rs.error is not None and "bad host" in rs.error
+        assert client.execute("SHOW ZONES").error is None
+    finally:
+        c.stop()
