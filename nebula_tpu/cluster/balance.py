@@ -117,6 +117,22 @@ def _transfer_leader(meta, sc, space: str, pid: int, hosts: List[str],
     return False
 
 
+def _zone_map(meta, alive: List[str]) -> Dict[str, str]:
+    """host → zone (unzoned alive hosts form singleton zones), matching
+    rpc_create_space's placement model."""
+    try:
+        zones = meta.list_zones()
+    except Exception:  # noqa: BLE001 — old metad without zones
+        zones = {}
+    out: Dict[str, str] = {}
+    for z, hs in zones.items():
+        for h in hs:
+            out[h] = z
+    for h in alive:
+        out.setdefault(h, f"__host_{h}")
+    return out
+
+
 def _spaces(meta, space: Optional[str]) -> List[str]:
     if space:
         return [space]
@@ -131,6 +147,7 @@ def balance_data(store, space: Optional[str] = None) -> Dict[str, Any]:
     if not alive:
         raise BalanceError("no alive storage hosts")
     plan: List[Dict[str, Any]] = []
+    host_zone = _zone_map(meta, alive)
     for sp_name in _spaces(meta, space):
         pm = meta.parts_of(sp_name)
         rf = min(meta.catalog.spaces[sp_name].replica_factor, len(alive))
@@ -143,9 +160,17 @@ def balance_data(store, space: Optional[str] = None) -> Dict[str, Any]:
         for pid in range(len(pm)):
             replicas = list(meta.parts_of(sp_name)[pid])
             keep = [r for r in replicas if r in alive]
-            # ---- heal: fill to rf on least-loaded hosts
+            # ---- heal: fill to rf on least-loaded hosts, preserving
+            # the one-replica-per-zone invariant CREATE SPACE set up
+            # (healing into an already-covered zone would let a single
+            # zone loss take every replica of the part); zone isolation
+            # relaxes only when no uncovered zone has a host left
             while len(keep) < rf:
-                cands = [h for h in alive if h not in keep]
+                covered = {host_zone.get(h) for h in keep}
+                cands = [h for h in alive if h not in keep
+                         and host_zone.get(h) not in covered]
+                if not cands:
+                    cands = [h for h in alive if h not in keep]
                 if not cands:
                     break
                 tgt = min(cands, key=lambda h: load[h])
@@ -155,10 +180,21 @@ def balance_data(store, space: Optional[str] = None) -> Dict[str, Any]:
                 load[tgt] += 1
                 plan.append({"space": sp_name, "part": pid, "op": "add",
                              "host": tgt})
-            # ---- migrate off overloaded hosts
+            # ---- migrate off overloaded hosts: same-zone targets
+            # first; a cross-zone move is allowed ONLY into a zone the
+            # part's other replicas don't already cover — otherwise a
+            # degraded zone's load imbalance is tolerated rather than
+            # collapsing the one-replica-per-zone invariant
             for src in [r for r in keep if load[r] > cap]:
-                cands = [h for h in alive
-                         if h not in keep and load[h] < cap]
+                same_zone = [h for h in alive
+                             if h not in keep and load[h] < cap
+                             and host_zone.get(h) == host_zone.get(src)]
+                covered_wo_src = {host_zone.get(h) for h in keep
+                                  if h != src}
+                other = [h for h in alive if h not in keep
+                         and load[h] < cap
+                         and host_zone.get(h) not in covered_wo_src]
+                cands = same_zone or other
                 if not cands:
                     continue
                 tgt = min(cands, key=lambda h: load[h])

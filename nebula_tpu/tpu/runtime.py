@@ -180,6 +180,8 @@ class TpuRuntime:
     def unpin(self, space: str):
         self.snapshots.pop(space, None)
         self._fns = {k: v for k, v in self._fns.items() if k[0] != space}
+        self._buckets = {k: v for k, v in self._buckets.items()
+                         if k[0][0] != space}
 
     def hbm_bytes(self) -> int:
         return sum(s.hbm_bytes() for s in self.snapshots.values())
@@ -224,7 +226,10 @@ class TpuRuntime:
             cnt[d % P] += 1
         F = max(self.init_f, _pow2(max(cnt)))
         EB = self.init_eb
-        bkey = key_fn(0, 0)     # program identity, buckets excluded
+        # cache key includes the frontier-size bucket: one supernode
+        # query must not permanently inflate every later small query of
+        # the same program to supernode-sized padded kernels
+        bkey = (key_fn(0, 0), _pow2(max(len(set(dense)), 1)))
         prev = self._buckets.get(bkey)
         if prev is not None:
             F, EB = max(F, prev[0]), max(EB, prev[1])

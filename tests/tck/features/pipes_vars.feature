@@ -1,0 +1,105 @@
+Feature: Pipes variables and introspection
+
+  Background:
+    Given having executed:
+      """
+      CREATE SPACE pv(partition_num=4, vid_type=INT64);
+      USE pv;
+      CREATE TAG person(name string, age int);
+      CREATE EDGE knows(w int);
+      INSERT VERTEX person(name, age) VALUES 1:("ann", 30), 2:("bob", 25), 3:("cat", 41), 4:("dan", 19);
+      INSERT EDGE knows(w) VALUES 1->2:(10), 1->3:(20), 2->3:(30), 3->4:(40)
+      """
+
+  Scenario: pipe feeds input columns
+    When executing query:
+      """
+      GO FROM 1 OVER knows YIELD dst(edge) AS d, knows.w AS w | GO FROM $-.d OVER knows YIELD $-.w AS prev_w, dst(edge) AS d2
+      """
+    Then the result should be, in any order:
+      | prev_w | d2 |
+      | 10     | 3  |
+      | 20     | 4  |
+
+  Scenario: variable assignment and reuse
+    When executing query:
+      """
+      $src = GO FROM 1 OVER knows YIELD dst(edge) AS d; GO FROM $src.d OVER knows YIELD src(edge) AS s, dst(edge) AS d
+      """
+    Then the result should be, in any order:
+      | s | d |
+      | 2 | 3 |
+      | 3 | 4 |
+
+  Scenario: unknown input column is a semantic error
+    When executing query:
+      """
+      GO FROM 1 OVER knows YIELD dst(edge) AS d | YIELD $-.nope
+      """
+    Then a SemanticError should be raised
+
+  Scenario: three stage pipeline
+    When executing query:
+      """
+      GO FROM 1, 2 OVER knows YIELD dst(edge) AS d, knows.w AS w | ORDER BY $-.w DESC | LIMIT 2
+      """
+    Then the result should be, in order:
+      | d | w  |
+      | 3 | 30 |
+      | 3 | 20 |
+
+  Scenario: sample bounds the row count
+    When executing query:
+      """
+      GO FROM 1, 2, 3 OVER knows YIELD dst(edge) AS d | SAMPLE 2 | YIELD count($-.d) AS n
+      """
+    Then the result should be, in order:
+      | n |
+      | 2 |
+
+  Scenario: fetch piped from go
+    When executing query:
+      """
+      GO FROM 1 OVER knows YIELD dst(edge) AS d | FETCH PROP ON person $-.d YIELD person.name AS n | ORDER BY $-.n
+      """
+    Then the result should be, in order:
+      | n     |
+      | "bob" |
+      | "cat" |
+
+  Scenario: intersect over piped results
+    When executing query:
+      """
+      GO FROM 1 OVER knows YIELD dst(edge) AS d INTERSECT GO FROM 2 OVER knows YIELD dst(edge) AS d
+      """
+    Then the result should be, in any order:
+      | d |
+      | 3 |
+
+  Scenario: group by pipeline with having style filter
+    When executing query:
+      """
+      GO FROM 1, 2, 3 OVER knows YIELD src(edge) AS s, knows.w AS w | GROUP BY $-.s YIELD $-.s AS s, sum($-.w) AS total | YIELD $-.s AS s, $-.total AS total WHERE $-.total > 25
+      """
+    Then the result should be, in any order:
+      | s | total |
+      | 1 | 30    |
+      | 2 | 30    |
+      | 3 | 40    |
+
+  Scenario: distinct yield over pipe
+    When executing query:
+      """
+      GO FROM 1, 2 OVER knows YIELD dst(edge) AS d | YIELD DISTINCT $-.d AS d | ORDER BY $-.d
+      """
+    Then the result should be, in order:
+      | d |
+      | 2 |
+      | 3 |
+
+  Scenario: empty pipe input yields empty
+    When executing query:
+      """
+      GO FROM 4 OVER knows YIELD dst(edge) AS d | GO FROM $-.d OVER knows YIELD dst(edge) AS d2
+      """
+    Then the result should be empty

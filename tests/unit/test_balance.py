@@ -152,3 +152,43 @@ def test_balance_leader_spreads_leadership(tmp_path):
         assert sorted(counts.values()) == [2, 2], counts
     finally:
         c.stop()
+
+
+def test_balance_heal_preserves_zone_isolation(tmp_path):
+    """Healing after a host death re-replicates into an UNCOVERED zone,
+    keeping the one-replica-per-zone invariant CREATE SPACE set up."""
+    from nebula_tpu.cluster.launcher import LocalCluster
+    c = LocalCluster(n_meta=1, n_storage=4, n_graph=1,
+                     data_dir=str(tmp_path))
+    get_config().set_dynamic("host_hb_expire_secs", 0.6)
+    try:
+        client = c.client()
+        addrs = [s.addr for s in c.storage_servers]
+        client.execute(f'ADD HOSTS "{addrs[0]}", "{addrs[1]}" INTO ZONE za')
+        client.execute(f'ADD HOSTS "{addrs[2]}", "{addrs[3]}" INTO ZONE zb')
+        rs = client.execute(
+            "CREATE SPACE zi(partition_num=4, replica_factor=2, "
+            "vid_type=INT64)")
+        assert rs.error is None, rs.error
+        c.reconcile_storage()
+
+        dead = addrs[2]
+        idx = [s.addr for s in c.storage_servers].index(dead)
+        c.stop_storaged(idx)
+        import time
+        time.sleep(0.9)
+
+        rs = client.execute("SUBMIT JOB BALANCE DATA")
+        assert rs.error is None, rs.error
+        meta = c.graphds[0].meta
+        meta.refresh(force=True)
+        za, zb = set(addrs[:2]), {addrs[3]}     # zb minus the dead host
+        for reps in meta.parts_of("zi"):
+            assert dead not in reps, reps
+            zones_hit = [("za" if r in za else "zb") for r in reps]
+            # both replicas never collapse into one zone while the other
+            # zone still has a live host
+            assert sorted(zones_hit) == ["za", "zb"], reps
+    finally:
+        get_config().set_dynamic("host_hb_expire_secs", 10.0)
+        c.stop()
