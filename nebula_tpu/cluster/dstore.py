@@ -34,6 +34,8 @@ class CatalogProxy:
     _MUTATORS = frozenset({
         "create_tag", "create_edge", "alter_tag", "alter_edge",
         "drop_tag", "drop_edge", "create_index", "drop_index",
+        "create_fulltext_index", "drop_fulltext_index",
+        "add_listener", "remove_listener",
         "drop_user", "grant_role", "revoke_role"})
 
     def __init__(self, meta: MetaClient):
@@ -284,6 +286,39 @@ class DistributedStore:
                 "storage.rebuild_index"):
             total += n
         return total
+
+    def _ft_want_id(self, space: str, index_name: str) -> int:
+        """This client's (DDL-fresh) view of the index generation —
+        shipped with the RPC so a storaged whose catalog cache predates a
+        DROP+re-CREATE refreshes instead of serving the old incarnation."""
+        d = next((x for x in self.catalog.fulltext_indexes(space)
+                  if x.name == index_name), None)
+        return d.index_id if d is not None else -1
+
+    def fulltext_search(self, space: str, index_name: str, op: str,
+                        pattern: str,
+                        parts: Optional[List[int]] = None) -> List[Any]:
+        pids = list(parts) if parts is not None else self.sc.all_parts(space)
+        want = self._ft_want_id(space, index_name)
+        out: List[Any] = []
+        for pid, ents in self.sc.fanout(
+                space, {p: {"index": index_name, "op": op,
+                            "pattern": pattern, "want_id": want}
+                        for p in pids},
+                "storage.fulltext_search"):
+            for e in ents:
+                v = from_wire(e)
+                out.append(tuple(v) if isinstance(v, list) else v)
+        return out
+
+    def rebuild_fulltext_index(self, space: str, index_name: str,
+                               parts: Optional[List[int]] = None) -> int:
+        pids = list(parts) if parts is not None else self.sc.all_parts(space)
+        want = self._ft_want_id(space, index_name)
+        return sum(n for _, n in self.sc.fanout(
+            space, {p: {"index": index_name, "want_id": want}
+                    for p in pids},
+            "storage.rebuild_fulltext"))
 
     def stats(self, space: str) -> Dict[str, Any]:
         pids = self.sc.all_parts(space)

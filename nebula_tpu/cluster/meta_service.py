@@ -46,6 +46,8 @@ def _hb_expire_s() -> float:
 _CATALOG_METHODS = frozenset({
     "create_tag", "create_edge", "alter_tag", "alter_edge",
     "drop_tag", "drop_edge", "create_index", "drop_index",
+    "create_fulltext_index", "drop_fulltext_index",
+    "add_listener", "remove_listener",
     "create_user_hashed", "set_password_hash", "change_password_hashed",
     "drop_user", "grant_role", "revoke_role"})
 

@@ -26,8 +26,8 @@ from .rpc import RpcError, RpcRaftTransport, RpcServer
 
 _STORAGE_OPS = frozenset({
     "vertex", "edge_half", "del_vertex", "del_edge_half", "upd_vertex",
-    "upd_edge_half", "del_tag", "rebuild_index", "chain_mark",
-    "chain_done", "batch"})
+    "upd_edge_half", "del_tag", "rebuild_index", "rebuild_fulltext",
+    "chain_mark", "chain_done", "batch"})
 
 
 def _validate_cmd(cmd) -> tuple:
@@ -223,6 +223,8 @@ class StorageService:
             st.delete_tag(space, cmd[1], cmd[2])
         elif op == "rebuild_index":
             st.rebuild_index(space, cmd[1], parts=[cmd[2]])
+        elif op == "rebuild_fulltext":
+            st.rebuild_fulltext_index(space, cmd[1], parts=[cmd[2]])
         elif op == "chain_mark":
             _, pid, cid, in_pid, in_cmd, ts = cmd
             st.apply_chain_mark(space, pid, cid,
@@ -420,6 +422,42 @@ class StorageService:
         sd = self.store.space(p["space"])
         idx = sd.index_data.get(p["index"])
         return len(idx.parts[p["part"]]) if idx is not None else 0
+
+    def _ft_catalog_sync(self, p):
+        """Force-refresh the catalog cache when the caller's view of the
+        index generation (want_id) is newer — a search right after
+        DROP + re-CREATE must not serve the old incarnation."""
+        want = p.get("want_id")
+        if want is None:
+            return
+        try:
+            d = next((x for x in self.store.catalog.fulltext_indexes(
+                p["space"]) if x.name == p["index"]), None)
+        except Exception:  # noqa: BLE001 — space unknown to stale cache
+            d = None
+        if d is None or d.index_id != want:
+            self.meta.refresh(force=True)
+
+    def rpc_fulltext_search(self, p):
+        """Text-search one part's slice of the full-text sink (SURVEY
+        §2 row 10 Listener; the ES-query hop of the reference)."""
+        self._leader_part(p["space"], p["part"])
+        self._ft_catalog_sync(p)
+        ents = self.store.fulltext_search(p["space"], p["index"],
+                                          p["op"], p["pattern"],
+                                          parts=[p["part"]])
+        return [to_wire(list(e) if isinstance(e, tuple) else e)
+                for e in ents]
+
+    def rpc_rebuild_fulltext(self, p):
+        part = self._leader_part(p["space"], p["part"])
+        self._ft_catalog_sync(p)
+        data = wire.dumps(("rebuild_fulltext", p["index"], p["part"]))
+        if part.propose(data) is None:
+            raise RpcError("part_leader_changed: rebuild not committed")
+        sd = self.store.space(p["space"])
+        ft = sd.ft_data.get(p["index"])
+        return len(ft.values[p["part"]]) if ft is not None else 0
 
     def rpc_part_stats(self, p):
         sd = self.store.space(p["space"])

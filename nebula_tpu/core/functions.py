@@ -1182,3 +1182,62 @@ def _dayofyear(ctx, args):
     if not isinstance(v, (Date, DateTime)):
         return NULL_BAD_TYPE
     return _dt.date(v.year, v.month, v.day).timetuple().tm_yday
+
+
+# ---- text-search predicates (SURVEY §2 row 10 Listener) -------------------
+# LOOKUP's PREFIX/WILDCARD/REGEXP/FUZZY normally plan into a
+# FulltextIndexScan; these host evaluators keep the SAME value-level
+# semantics (graphstore/fulltext.py) for every other placement — a
+# second text conjunct, OR/NOT composition, residual re-checks.
+
+def _text2(args):
+    """-> (value, pattern) or a null to propagate / NULL_BAD_TYPE."""
+    n = _nullprop(args)
+    if n is not None:
+        return n
+    if not isinstance(args[0], str) or not isinstance(args[1], str):
+        return NULL_BAD_TYPE
+    return None
+
+
+@register("prefix")
+def _fn_prefix(ctx, args):
+    bad = _text2(args)
+    if bad is not None:
+        return bad
+    return args[0].lower().startswith(args[1].lower())
+
+
+@register("wildcard")
+def _fn_wildcard(ctx, args):
+    import fnmatch as _fn
+    bad = _text2(args)
+    if bad is not None:
+        return bad
+    return _fn.fnmatch(args[0].lower(), args[1].lower())
+
+
+@register("regexp")
+def _fn_regexp(ctx, args):
+    import re as _re
+    bad = _text2(args)
+    if bad is not None:
+        return bad
+    try:
+        return _re.search(args[1], args[0]) is not None
+    except _re.error:
+        return NULL_BAD_DATA
+
+
+@register("fuzzy")
+def _fn_fuzzy(ctx, args):
+    from ..graphstore.fulltext import analyze, levenshtein_leq
+    bad = _text2(args)
+    if bad is not None:
+        return bad
+    toks = analyze(args[1])
+    if not toks:
+        return False
+    q = toks[0]
+    k = 1 if len(q) < 6 else 2
+    return any(levenshtein_leq(t, q, k) for t in analyze(args[0]))

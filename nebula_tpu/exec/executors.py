@@ -322,6 +322,54 @@ def _index_scan_indexed(node, qctx, sp, schema, filt, a):
     return DataSet([node.col_names[0]], rows)
 
 
+@executor("FulltextIndexScan")
+def _fulltext_index_scan(node, qctx, ectx, space):
+    """LOOKUP via text predicate: inverted-index search → entity fetch →
+    residual filter (reference: ES-backed LOOKUP; SURVEY §2 row 10
+    Listener + row 15)."""
+    a = node.args
+    sp = a["space"]
+    schema = a["schema"]
+    filt = a.get("filter")
+    entities = qctx.store.fulltext_search(sp, a["index"], a["op"],
+                                          a["pattern"])
+    rows = []
+    if a["is_edge"]:
+        etype_id = qctx.store.catalog.get_edge(sp, schema).edge_type
+        for (src, rank, dst) in entities:
+            props = qctx.store.get_edge(sp, src, schema, dst, rank)
+            if props is None:
+                continue
+            e = Edge(src, dst, schema, rank, dict(props), etype_id)
+            if filt is not None:
+                rc = RowContext(qctx, sp, {"_matched": e, "_edge": e},
+                                extra_vars={schema: e})
+                if to_bool3(filt.eval(rc)) is not True:
+                    continue
+            rows.append([e])
+        rows.sort(key=lambda r: total_order_key(r[0].key()))
+    else:
+        seen = set()
+        for vid in entities:
+            if vid in seen:
+                continue
+            seen.add(vid)
+            v = qctx.build_vertex(sp, vid)
+            if v is None:
+                continue
+            if filt is not None:
+                rc = RowContext(qctx, sp, {"_matched": v},
+                                extra_vars={schema: v})
+                if to_bool3(filt.eval(rc)) is not True:
+                    continue
+            rows.append([v])
+        rows.sort(key=lambda r: total_order_key(r[0].vid))
+    lim = a.get("limit")
+    if lim is not None:
+        rows = rows[:lim]       # planted by push_limit_down_index_scan
+    return DataSet([node.col_names[0]], rows)
+
+
 def _traverse_device(node, qctx, ectx, ds, ci, sp, etypes, direction,
                      min_hop, max_hop, var_len, edge_filter, edge_ok,
                      out_cols):
@@ -615,6 +663,11 @@ def _project(node, qctx, ectx, space):
     for r in src_rows:
         rd = row_dict(ds, r)
         extra = {schema_alias: rd.get("_matched")} if schema_alias else None
+        if schema_alias and a.get("is_edge"):
+            # edge LOOKUP yields reference edge props as EdgeProp exprs
+            # (rewritten by _rewrite_go_expr) — bind the matched edge
+            # where edge-prop resolution looks for it
+            rd.setdefault("_edge", rd.get("_matched"))
         rc = RowContext(qctx, space, rd, extra_vars=extra)
         rows.append([e.eval(rc) for e, _ in cols])
     return DataSet(names, rows)
@@ -1139,6 +1192,48 @@ def _rebuild_index(node, qctx, ectx, space):
     return DataSet(["New Job Id"], [[job.job_id]])
 
 
+@executor("CreateFulltextIndex")
+def _create_ft_index(node, qctx, ectx, space):
+    a = node.args
+    qctx.catalog.create_fulltext_index(
+        a["space"], a["index_name"], a["schema_name"], a["field"],
+        a["is_edge"], a["if_not_exists"])
+    return DataSet()
+
+
+@executor("DropFulltextIndex")
+def _drop_ft_index(node, qctx, ectx, space):
+    a = node.args
+    qctx.catalog.drop_fulltext_index(a["space"], a["index_name"],
+                                     a["if_exists"])
+    return DataSet()
+
+
+@executor("RebuildFulltextIndex")
+def _rebuild_ft_index(node, qctx, ectx, space):
+    a = node.args
+    from .jobs import job_manager
+    cmd = "rebuild fulltext" + (f" {a['index_name']}"
+                                if a.get("index_name") else "")
+    job = job_manager().submit(qctx, cmd, a["space"])
+    return DataSet(["New Job Id"], [[job.job_id]])
+
+
+@executor("AddListener")
+def _add_listener(node, qctx, ectx, space):
+    a = node.args
+    qctx.catalog.add_listener(a["space"], a["ltype"],
+                              ",".join(a["endpoints"]))
+    return DataSet()
+
+
+@executor("RemoveListener")
+def _remove_listener(node, qctx, ectx, space):
+    a = node.args
+    qctx.catalog.remove_listener(a["space"], a["ltype"])
+    return DataSet()
+
+
 @executor("Describe")
 def _describe(node, qctx, ectx, space):
     a = node.args
@@ -1196,6 +1291,28 @@ def _show(node, qctx, ectx, space):
         return DataSet(["Index Name", "By Tag" if not want_edge else "By Edge",
                         "Columns"],
                        [[d.name, d.schema_name, d.fields] for d in idx])
+    if kind == "fulltext_indexes":
+        sp = a.get("space")
+        if not sp:
+            raise ExecError("no space selected")
+        return DataSet(
+            ["Name", "Schema Type", "Schema Name", "Fields"],
+            [[d.name, "Edge" if d.is_edge else "Tag", d.schema_name,
+              d.fields[0]]
+             for d in sorted(cat.fulltext_indexes(sp),
+                             key=lambda x: x.name)])
+    if kind == "listener":
+        sp = a.get("space")
+        if not sp:
+            raise ExecError("no space selected")
+        lsn = getattr(qctx.store, "_ft_listener", None)
+        if lsn is not None:
+            lsn.drain()     # report settled lag, not a racing snapshot
+        rows = []
+        for ltype, ep in cat.listeners(sp):
+            st = lsn.status() if lsn is not None else {"lag": 0}
+            rows.append([0, ltype, ep, "ONLINE", st.get("lag", 0)])
+        return DataSet(["PartId", "Type", "Host", "Status", "Lag"], rows)
     if kind == "hosts":
         cluster = getattr(qctx, "cluster", None)
         if cluster is not None:

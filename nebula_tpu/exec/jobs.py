@@ -83,6 +83,16 @@ class JobManager:
                 raise ValueError("rebuild index job needs a space")
             name = command[len("rebuild index "):]
             return {"entries": qctx.store.rebuild_index(space, name)}
+        if command.startswith("rebuild fulltext"):
+            if not space:
+                raise ValueError("rebuild fulltext job needs a space")
+            name = command[len("rebuild fulltext"):].strip()
+            names = ([name] if name else
+                     [d.name for d in
+                      qctx.catalog.fulltext_indexes(space)])
+            return {"entries": sum(
+                qctx.store.rebuild_fulltext_index(space, n)
+                for n in names)}
         raise ValueError(f"unknown job `{command}'")
 
 

@@ -31,7 +31,9 @@ _SPACE_ADMIN = (A.GrantRoleSentence, A.RevokeRoleSentence)
 _SPACE_DBA = (
     A.CreateSchemaSentence, A.AlterSchemaSentence, A.DropSchemaSentence,
     A.CreateIndexSentence, A.DropIndexSentence, A.RebuildIndexSentence,
-    A.SubmitJobSentence)
+    A.CreateFulltextIndexSentence, A.DropFulltextIndexSentence,
+    A.RebuildFulltextIndexSentence, A.AddListenerSentence,
+    A.RemoveListenerSentence, A.SubmitJobSentence)
 _SPACE_WRITE = (
     A.InsertVerticesSentence, A.InsertEdgesSentence,
     A.DeleteVerticesSentence, A.DeleteEdgesSentence, A.DeleteTagsSentence,

@@ -37,6 +37,8 @@ from . import schema_wire
 CATALOG_MUTATORS = frozenset({
     "create_tag", "create_edge", "alter_tag", "alter_edge",
     "drop_tag", "drop_edge", "create_index", "drop_index",
+    "create_fulltext_index", "drop_fulltext_index",
+    "add_listener", "remove_listener",
     "create_user", "drop_user", "alter_user", "change_password",
     "grant_role", "revoke_role"})
 

@@ -203,6 +203,8 @@ class Catalog:
         self._tags: Dict[int, Dict[str, TagSchema]] = {}      # space_id →
         self._edges: Dict[int, Dict[str, EdgeSchema]] = {}
         self._indexes: Dict[int, Dict[str, "IndexDesc"]] = {}
+        self._ft_indexes: Dict[int, Dict[str, "IndexDesc"]] = {}
+        self._listeners: Dict[int, List[List[str]]] = {}  # [type, endpoint]
         self._next_space = 1
         self._next_schema_id: Dict[int, int] = {}
         self.version = 0   # bumped on every DDL; clients use it for cache TTL
@@ -319,6 +321,8 @@ class Catalog:
         self._tags[sp.space_id] = {}
         self._edges[sp.space_id] = {}
         self._indexes[sp.space_id] = {}
+        self._ft_indexes[sp.space_id] = {}
+        self._listeners[sp.space_id] = []
         self._next_schema_id[sp.space_id] = 2  # 1 reserved
         self.version += 1
         return sp
@@ -332,6 +336,8 @@ class Catalog:
         self._tags.pop(sp.space_id, None)
         self._edges.pop(sp.space_id, None)
         self._indexes.pop(sp.space_id, None)
+        self._ft_indexes.pop(sp.space_id, None)
+        self._listeners.pop(sp.space_id, None)
         for u in self.users.values():
             u.roles.pop(name, None)
         self.version += 1
@@ -475,6 +481,70 @@ class Catalog:
         return [d for d in self.indexes(space)
                 if d.schema_name == schema_name and d.is_edge == is_edge]
 
+    # -- full-text indexes + listeners (SURVEY §2 row 10 Listener; the
+    # reference's ES-backed text-search plane) --
+    def create_fulltext_index(self, space: str, index_name: str,
+                              schema_name: str, field: str, is_edge: bool,
+                              if_not_exists=False) -> "IndexDesc":
+        sp = self.get_space(space)
+        idxs = self._ft_indexes.setdefault(sp.space_id, {})
+        if index_name in idxs:
+            if if_not_exists:
+                return idxs[index_name]
+            raise SchemaError(f"fulltext index `{index_name}' already exists")
+        schema = (self.get_edge(space, schema_name) if is_edge
+                  else self.get_tag(space, schema_name))
+        p = schema.latest.prop(field)
+        if p is None:
+            raise SchemaError(f"prop `{field}' not in `{schema_name}'")
+        if p.ptype not in (PropType.STRING, PropType.FIXED_STRING):
+            raise SchemaError(
+                f"fulltext index requires a string prop; `{field}' "
+                f"is {p.ptype.value}")
+        d = IndexDesc(index_name, schema_name, [field], is_edge,
+                      index_id=self._alloc_id(sp.space_id), fulltext=True)
+        idxs[index_name] = d
+        self.version += 1
+        return d
+
+    def drop_fulltext_index(self, space: str, index_name: str,
+                            if_exists=False):
+        sp = self.get_space(space)
+        idxs = self._ft_indexes.setdefault(sp.space_id, {})
+        if idxs.pop(index_name, None) is None and not if_exists:
+            raise SchemaError(f"fulltext index `{index_name}' not found")
+        self.version += 1
+
+    def fulltext_indexes(self, space: str) -> List["IndexDesc"]:
+        sid = self.get_space(space).space_id
+        return list(self._ft_indexes.get(sid, {}).values())
+
+    def fulltext_indexes_for(self, space: str, schema_name: str,
+                             is_edge: bool) -> List["IndexDesc"]:
+        return [d for d in self.fulltext_indexes(space)
+                if d.schema_name == schema_name and d.is_edge == is_edge]
+
+    def add_listener(self, space: str, ltype: str, endpoint: str):
+        sid = self.get_space(space).space_id
+        ls = self._listeners.setdefault(sid, [])
+        if any(t == ltype for t, _ in ls):
+            raise SchemaError(f"listener {ltype} already added")
+        ls.append([ltype, endpoint])
+        self.version += 1
+
+    def remove_listener(self, space: str, ltype: str):
+        sid = self.get_space(space).space_id
+        ls = self._listeners.setdefault(sid, [])
+        keep = [x for x in ls if x[0] != ltype]
+        if len(keep) == len(ls):
+            raise SchemaError(f"no {ltype} listener on `{space}'")
+        self._listeners[sid] = keep
+        self.version += 1
+
+    def listeners(self, space: str) -> List[List[str]]:
+        return list(self._listeners.get(
+            self.get_space(space).space_id, []))
+
 
 @dataclass
 class IndexDesc:
@@ -485,6 +555,8 @@ class IndexDesc:
     # unique per creation: DROP + re-CREATE with the same name/fields must
     # NOT resurrect the old entries (the store compares this id)
     index_id: int = 0
+    # full-text (ES-listener-backed in the reference) vs secondary B-tree
+    fulltext: bool = False
 
 
 def apply_defaults(sv: SchemaVersion, props: Dict[str, Any],

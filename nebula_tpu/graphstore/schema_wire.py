@@ -42,7 +42,8 @@ def to_jso(v: Any) -> Any:
                 "vt": v.vid_type, "c": v.comment}
     if isinstance(v, IndexDesc):
         return {"@t": "indexdesc", "n": v.name, "sn": v.schema_name,
-                "f": list(v.fields), "e": v.is_edge, "id": v.index_id}
+                "f": list(v.fields), "e": v.is_edge, "id": v.index_id,
+                "ft": v.fulltext}
     if isinstance(v, UserDesc):
         return {"@t": "userdesc", "n": v.name, "p": v.pwd_hash,
                 "r": dict(v.roles)}
@@ -56,6 +57,10 @@ def to_jso(v: Any) -> Any:
                           for sid, d in v._edges.items()],
                 "indexes": [[sid, {n: to_jso(i) for n, i in d.items()}]
                             for sid, d in v._indexes.items()],
+                "ft_indexes": [[sid, {n: to_jso(i) for n, i in d.items()}]
+                               for sid, d in v._ft_indexes.items()],
+                "listeners": [[sid, [list(x) for x in ls]]
+                              for sid, ls in v._listeners.items()],
                 "next_space": v._next_space,
                 "next_schema_id": [[sid, nid] for sid, nid
                                    in v._next_schema_id.items()],
@@ -87,7 +92,8 @@ def from_jso(j: Any) -> Any:
     if t == "spacedesc":
         return SpaceDesc(j["n"], j["id"], j["pn"], j["rf"], j["vt"], j["c"])
     if t == "indexdesc":
-        return IndexDesc(j["n"], j["sn"], list(j["f"]), j["e"], j["id"])
+        return IndexDesc(j["n"], j["sn"], list(j["f"]), j["e"], j["id"],
+                         j.get("ft", False))
     if t == "userdesc":
         return UserDesc(j["n"], j["p"], j["r"])
     if t == "catalog":
@@ -101,6 +107,11 @@ def from_jso(j: Any) -> Any:
                     for sid, d in j["edges"]}
         c._indexes = {sid: {n: from_jso(i) for n, i in d.items()}
                       for sid, d in j["indexes"]}
+        # pre-fulltext snapshots carry neither key
+        c._ft_indexes = {sid: {n: from_jso(i) for n, i in d.items()}
+                         for sid, d in j.get("ft_indexes", [])}
+        c._listeners = {sid: [list(x) for x in ls]
+                        for sid, ls in j.get("listeners", [])}
         c._next_space = j["next_space"]
         c._next_schema_id = {sid: nid for sid, nid in j["next_schema_id"]}
         c.version = j["version"]

@@ -87,6 +87,7 @@ class SpaceData:
         self.epoch = 0
         self.lock = threading.RLock()
         self.index_data: Dict[str, Any] = {}   # index name → IndexData
+        self.ft_data: Dict[str, Any] = {}      # name → FulltextIndexData
 
     @property
     def num_parts(self) -> int:
@@ -135,6 +136,7 @@ class GraphStore:
         self.catalog = catalog or Catalog()
         self.data: Dict[int, SpaceData] = {}
         self._engine = None
+        self._ft_listener = None     # started on first fulltext index
         if data_dir is not None:
             # durable standalone engine (SURVEY §2 row 10): recover from
             # checkpoint + journal, then resume journaling every mutation
@@ -160,6 +162,19 @@ class GraphStore:
     def close(self):
         if self._engine is not None:
             self._engine.close()
+        if self._ft_listener is not None:
+            self._ft_listener.stop()
+            self._ft_listener = None
+
+    @property
+    def ft_listener(self):
+        """The full-text replication sink (SURVEY §2 row 10 Listener),
+        started lazily — stores with no fulltext index never pay for the
+        thread."""
+        if self._ft_listener is None:
+            from .fulltext import FulltextListener
+            self._ft_listener = FulltextListener()
+        return self._ft_listener
 
     # ---- space lifecycle ----
     def create_space(self, name: str, **kw) -> SpaceDesc:
@@ -210,6 +225,8 @@ class GraphStore:
                 idx.remove(part, old_row, vid)
             if new_row is not None:
                 idx.add(part, new_row, vid)
+        self._ft_enqueue(sd, space, tag, False, part, vid, old_row,
+                         new_row)
 
     def _index_edge(self, sd, space, src, etype, dst, rank, old_row,
                     new_row):
@@ -220,6 +237,115 @@ class GraphStore:
                 idx.remove(part, old_row, ent)
             if new_row is not None:
                 idx.add(part, new_row, ent)
+        self._ft_enqueue(sd, space, etype, True, part, ent, old_row,
+                         new_row)
+
+    # ---- full-text plane (SURVEY §2 row 10 Listener) ----
+
+    def _ft_list(self, sd: SpaceData, space: str, schema: str,
+                 is_edge: bool):
+        from .fulltext import FulltextIndexData
+        if sd.ft_data:
+            # GC incarnations the catalog no longer lists (DROP FULLTEXT
+            # INDEX must release the corpus, not strand it until a
+            # same-name re-CREATE)
+            live = {d.name: d.index_id
+                    for d in self.catalog.fulltext_indexes(space)}
+            for name in list(sd.ft_data):
+                if live.get(name) != sd.ft_data[name].index_id:
+                    del sd.ft_data[name]
+                    if self._ft_listener is not None:
+                        self._ft_listener.unregister(space, name)
+        descs = self.catalog.fulltext_indexes_for(space, schema, is_edge)
+        out = []
+        for d in descs:
+            ft = sd.ft_data.get(d.name)
+            if ft is None or ft.index_id != d.index_id:
+                ft = sd.ft_data[d.name] = FulltextIndexData(
+                    d.name, d.schema_name, d.fields[0], d.is_edge,
+                    sd.num_parts, d.index_id)
+                self.ft_listener.register(space, ft)
+            out.append(ft)
+        return out
+
+    def _ft_enqueue(self, sd, space, schema, is_edge, part, entity,
+                    old_row, new_row):
+        """Replicate one committed mutation to the text sink — enqueue
+        only; the listener thread applies (base writes never block on
+        the text index, matching the reference's one-way Listener)."""
+        for ft in self._ft_list(sd, space, schema, is_edge):
+            lsn = self.ft_listener
+            if old_row is not None:
+                lsn.enqueue("remove", space, ft.name, part, entity=entity,
+                            gen=ft.index_id)
+            if new_row is not None:
+                v = new_row.get(ft.field)
+                if isinstance(v, str):
+                    lsn.enqueue("add", space, ft.name, part, v, entity,
+                                gen=ft.index_id)
+
+    def rebuild_fulltext_index(self, space: str, index_name: str,
+                               parts: Optional[List[int]] = None) -> int:
+        """Clear + re-replicate one text index from base data."""
+        sd = self.space(space)
+        d = next((x for x in self.catalog.fulltext_indexes(space)
+                  if x.name == index_name), None)
+        if d is None:
+            raise StoreError(f"fulltext index `{index_name}' not found")
+        fts = self._ft_list(sd, space, d.schema_name, d.is_edge)
+        ft = next(x for x in fts if x.name == index_name)
+        lsn = self.ft_listener
+        if parts is not None:
+            lsn.drain()     # settle before reading values[] below
+        with sd.lock:
+            part_ids = list(parts) if parts is not None \
+                else list(range(sd.num_parts))
+            if parts is None:
+                lsn.enqueue("clear", space, index_name, gen=ft.index_id)
+            for pid in part_ids:
+                if parts is not None:
+                    with ft.lock:
+                        ents = list(ft.values[pid])
+                    for ent in ents:
+                        lsn.enqueue("remove", space, index_name, pid,
+                                    entity=ent, gen=ft.index_id)
+                p = sd.parts[pid]
+                if d.is_edge:
+                    for src, per in p.out_edges.items():
+                        em = per.get(d.schema_name)
+                        if em:
+                            for (rank, dst), row in em.items():
+                                v = row.get(d.fields[0])
+                                if isinstance(v, str):
+                                    lsn.enqueue("add", space, index_name,
+                                                pid, v, (src, rank, dst),
+                                                gen=ft.index_id)
+                else:
+                    for vid, tv in p.vertices.items():
+                        if d.schema_name in tv:
+                            v = tv[d.schema_name][1].get(d.fields[0])
+                            if isinstance(v, str):
+                                lsn.enqueue("add", space, index_name,
+                                            pid, v, vid,
+                                            gen=ft.index_id)
+        lsn.drain()
+        return sum(len(ft.values[pid]) for pid in part_ids)
+
+    def fulltext_search(self, space: str, index_name: str, op: str,
+                        pattern: str,
+                        parts: Optional[List[int]] = None) -> List[Any]:
+        """Serve a LOOKUP text predicate.  Drains the listener first —
+        read-your-writes instead of the reference's ES eventual
+        consistency (documented deviation, keeps results deterministic)."""
+        sd = self.space(space)
+        d = next((x for x in self.catalog.fulltext_indexes(space)
+                  if x.name == index_name), None)
+        if d is None:
+            raise StoreError(f"fulltext index `{index_name}' not found")
+        fts = self._ft_list(sd, space, d.schema_name, d.is_edge)
+        ft = next(x for x in fts if x.name == index_name)
+        self.ft_listener.drain()
+        return ft.search(op, pattern, parts)
 
     def rebuild_index(self, space: str, index_name: str,
                       parts: Optional[List[int]] = None) -> int:
@@ -578,6 +704,8 @@ class GraphStore:
         # indexes are derived state: rebuild this part's slices
         for d in self.catalog.indexes(space):
             self.rebuild_index(space, d.name, parts=[pid])
+        for d in self.catalog.fulltext_indexes(space):
+            self.rebuild_fulltext_index(space, d.name, parts=[pid])
 
     def clear_part(self, space: str, pid: int):
         """Release one partition's state (the replica moved away under
@@ -601,6 +729,8 @@ class GraphStore:
             sd.epoch += 1
         for d in self.catalog.indexes(space):
             self.rebuild_index(space, d.name, parts=[pid])
+        for d in self.catalog.fulltext_indexes(space):
+            self.rebuild_fulltext_index(space, d.name, parts=[pid])
 
     # ---- checkpoint / restore (CREATE SNAPSHOT; SURVEY §5) ----
 

@@ -203,6 +203,37 @@ class RebuildIndexSentence(Sentence):
 
 
 @dataclass
+class CreateFulltextIndexSentence(Sentence):
+    is_edge: bool
+    index_name: str
+    schema_name: str
+    field: str
+    if_not_exists: bool = False
+
+
+@dataclass
+class DropFulltextIndexSentence(Sentence):
+    index_name: str
+    if_exists: bool = False
+
+
+@dataclass
+class RebuildFulltextIndexSentence(Sentence):
+    index_name: Optional[str] = None     # None → all
+
+
+@dataclass
+class AddListenerSentence(Sentence):
+    ltype: str                           # ELASTICSEARCH
+    endpoints: List[str]
+
+
+@dataclass
+class RemoveListenerSentence(Sentence):
+    ltype: str
+
+
+@dataclass
 class SubmitJobSentence(Sentence):
     job: str                             # balance data | balance leader | compact | stats | ingest
 

@@ -325,6 +325,26 @@ def eliminate_true_filter(node: PlanNode) -> Optional[PlanNode]:
 
 
 @register_rule
+def eliminate_false_filter(node: PlanNode) -> Optional[PlanNode]:
+    """Filter(cond=false|null) → empty result (reference: the
+    degenerate-plan constant-fold family).  A constant-false predicate
+    can skip the whole subtree — the columns survive, the rows never
+    materialize."""
+    if node.kind != "Filter" or not node.deps:
+        return None
+    cond = node.args.get("condition")
+    if cond is None or cond.kind != "literal":
+        return None
+    from ..core.value import is_null
+    if cond.value is False or (is_null(cond.value)
+                               and not isinstance(cond.value, bool)):
+        return PlanNode("Project", deps=[],
+                        col_names=list(node.col_names),
+                        args={"empty": True})
+    return None
+
+
+@register_rule
 def merge_adjacent_limits(node: PlanNode) -> Optional[PlanNode]:
     """Limit(Limit(x)) → one Limit (reference: MergeGetNbrsAndDedupRule
     sibling cleanups).  rows[o2:o2+c2][o1:o1+c1] = rows[o1+o2 : ...]."""
@@ -423,7 +443,8 @@ def push_limit_down_index_scan(node: PlanNode) -> Optional[PlanNode]:
     target = node.dep()
     if target.kind == "Project" and target.deps:
         target = target.dep()
-    if target.kind != "IndexScan" or target.args.get("limit") is not None:
+    if target.kind not in ("IndexScan", "FulltextIndexScan") or \
+            target.args.get("limit") is not None:
         return None
     target.args["limit"] = (node.args.get("offset", 0) or 0) + cnt
     return None

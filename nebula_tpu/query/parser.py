@@ -176,14 +176,22 @@ class Parser:
             "SUBMIT": self.p_submit, "KILL": self.p_kill,
             "UNWIND": self.p_match, "GRANT": self.p_grant, "ADD": self.p_add,
             "REVOKE": self.p_revoke, "CHANGE": self.p_change_password,
+            "REMOVE": self.p_remove,
         }.get(kw)
         if fn is None:
             raise ParseError(f"unsupported statement `{kw}' at pos {t.pos}")
         return fn()
 
-    def p_add(self) -> A.AddHostsSentence:
-        """ADD HOSTS "h:p" [, ...] INTO ZONE zname — placement zones."""
+    def p_add(self) -> A.Sentence:
+        """ADD HOSTS "h:p" [, ...] INTO ZONE zname — placement zones;
+        ADD LISTENER ELASTICSEARCH "h:p" [, ...] — full-text sink."""
         self.expect_kw("ADD")
+        if self.accept_kw("LISTENER"):
+            ltype = self.expect_kw("ELASTICSEARCH").value
+            eps = [self.expect("STRING").value]
+            while self.accept(","):
+                eps.append(self.expect("STRING").value)
+            return A.AddListenerSentence(ltype, eps)
         self.expect_kw("HOSTS")
         hosts = [self.expect("STRING").value]
         while self.accept(","):
@@ -191,6 +199,11 @@ class Parser:
         self.expect_kw("INTO")
         self.expect_kw("ZONE")
         return A.AddHostsSentence(hosts, self.ident())
+
+    def p_remove(self) -> A.RemoveListenerSentence:
+        self.expect_kw("REMOVE")
+        self.expect_kw("LISTENER")
+        return A.RemoveListenerSentence(self.expect_kw("ELASTICSEARCH").value)
 
     # ---- user management (reference: GRANT/REVOKE ROLE, CHANGE PASSWORD) --
     def p_grant(self) -> A.GrantRoleSentence:
@@ -359,6 +372,19 @@ class Parser:
 
     def p_create(self) -> A.Sentence:
         self.expect_kw("CREATE")
+        if self.accept_kw("FULLTEXT"):
+            # CREATE FULLTEXT {TAG|EDGE} INDEX name ON schema(field)
+            is_edge = self.expect_kw("TAG", "EDGE").value == "EDGE"
+            self.expect_kw("INDEX")
+            ine = self.p_if_not_exists()
+            iname = self.ident()
+            self.expect_kw("ON")
+            sname = self.ident()
+            self.expect("(")
+            field = self.ident()
+            self.expect(")")
+            return A.CreateFulltextIndexSentence(is_edge, iname, sname,
+                                                 field, ine)
         if self.accept_kw("SPACE"):
             ine = self.p_if_not_exists()
             name = self.ident()
@@ -487,6 +513,10 @@ class Parser:
 
     def p_drop(self) -> A.Sentence:
         self.expect_kw("DROP")
+        if self.accept_kw("FULLTEXT"):
+            self.expect_kw("INDEX")
+            ife = self.p_if_exists()
+            return A.DropFulltextIndexSentence(self.ident(), ife)
         if self.accept_kw("SPACE"):
             ife = self.p_if_exists()
             return A.DropSpaceSentence(self.ident(), ife)
@@ -560,6 +590,13 @@ class Parser:
             if kw in ("TAGS", "EDGES", "USERS", "ZONES"):
                 self.next()
                 return A.ShowSentence(kw.lower())
+            if kw == "FULLTEXT":
+                self.next()
+                self.expect_kw("INDEXES")
+                return A.ShowSentence("fulltext_indexes")
+            if kw == "LISTENER":
+                self.next()
+                return A.ShowSentence("listener")
             if kw == "ROLES":
                 self.next()
                 self.expect_kw("IN")
@@ -583,8 +620,14 @@ class Parser:
         kind = self.expect_kw("SPACE", "TAG", "EDGE", "INDEX").value.lower()
         return A.DescribeSentence(kind, self.ident())
 
-    def p_rebuild(self) -> A.RebuildIndexSentence:
+    def p_rebuild(self) -> A.Sentence:
         self.expect_kw("REBUILD")
+        if self.accept_kw("FULLTEXT"):
+            self.expect_kw("INDEX")
+            name = None
+            if self.peek().kind in ("IDENT", "KEYWORD"):
+                name = self.ident()
+            return A.RebuildFulltextIndexSentence(name)
         is_edge = self.expect_kw("TAG", "EDGE").value == "EDGE"
         self.expect_kw("INDEX")
         return A.RebuildIndexSentence(is_edge, self.ident())

@@ -540,6 +540,35 @@ def _lookup_field_cond(c: Expr, schema: str, is_edge: bool):
     return None
 
 
+_TEXT_OPS = ("PREFIX", "WILDCARD", "REGEXP", "FUZZY")
+
+
+def _lookup_text_cond(c: Expr, schema: str, is_edge: bool):
+    """Conjunct of shape PREFIX|WILDCARD|REGEXP|FUZZY(<schema>.<field>,
+    <string const>) → (op, field, pattern); else None.  (The reference's
+    ES-backed LOOKUP text predicates.)"""
+    if not isinstance(c, FunctionCall) or c.name.upper() not in _TEXT_OPS \
+            or len(c.args) != 2:
+        return None
+    a0, a1 = c.args
+    field = None
+    if is_edge and isinstance(a0, EdgeProp) and a0.edge == schema \
+            and not a0.name.startswith("_"):
+        field = a0.name
+    elif not is_edge and isinstance(a0, AttributeExpr) \
+            and isinstance(a0.obj, LabelExpr) and a0.obj.name == schema:
+        field = a0.attr
+    if field is None:
+        return None
+    try:
+        pat = _const_eval(a1)
+    except Exception:  # noqa: BLE001 — non-constant pattern
+        return None
+    if not isinstance(pat, str):
+        return None
+    return (c.name.upper(), field, pat)
+
+
 def _choose_index(pctx, space: str, schema: str, is_edge: bool,
                   filt: Optional[Expr]):
     """Pick the best index + column hints for a LOOKUP predicate.
@@ -625,13 +654,39 @@ def _plan_lookup(pctx, s: A.LookupSentence) -> PlanNode:
         aliases = {s.schema_name: s.schema_name}
         filt = _rewrite_match_expr(s.where.filter, aliases)
         filt = _rewrite_go_expr(pctx, filt, [s.schema_name]) if is_edge else filt
-    index_name, eq, rng, residual = _choose_index(
-        pctx, space, s.schema_name, is_edge, filt)
-    scan = PlanNode("IndexScan", deps=[],
-                    col_names=["_matched"],
-                    args={"space": space, "schema": s.schema_name,
-                          "is_edge": is_edge, "filter": residual,
-                          "index": index_name, "eq": eq, "range": rng})
+    # text-search predicate → fulltext scan (reference: ES-backed LOOKUP)
+    text = None
+    if filt is not None:
+        conjs = split_conjuncts(filt)
+        for i, c in enumerate(conjs):
+            m = _lookup_text_cond(c, s.schema_name, is_edge)
+            if m is not None:
+                text = m
+                residual_t = join_conjuncts(
+                    [x for j, x in enumerate(conjs) if j != i])
+                break
+    if text is not None:
+        op, field, pat = text
+        ft = next((d for d in pctx.catalog.fulltext_indexes_for(
+            space, s.schema_name, is_edge) if d.fields[0] == field), None)
+        if ft is None:
+            raise QueryError(
+                f"no fulltext index on `{s.schema_name}.{field}' "
+                f"({op} requires one; CREATE FULLTEXT INDEX first)")
+        scan = PlanNode("FulltextIndexScan", deps=[],
+                        col_names=["_matched"],
+                        args={"space": space, "schema": s.schema_name,
+                              "is_edge": is_edge, "filter": residual_t,
+                              "index": ft.name, "op": op,
+                              "pattern": pat})
+    else:
+        index_name, eq, rng, residual = _choose_index(
+            pctx, space, s.schema_name, is_edge, filt)
+        scan = PlanNode("IndexScan", deps=[],
+                        col_names=["_matched"],
+                        args={"space": space, "schema": s.schema_name,
+                              "is_edge": is_edge, "filter": residual,
+                              "index": index_name, "eq": eq, "range": rng})
     yld = s.yield_
     if yld is None:
         default = (FunctionCall("id", [VertexExpr("vertex")]) if not is_edge
@@ -1201,6 +1256,22 @@ def _register_dispatch():
         A.RebuildIndexSentence: lambda p, s: _admin(
             "RebuildIndex", is_edge=s.is_edge, index_name=s.index_name,
             space=p.need_space()),
+        A.CreateFulltextIndexSentence: lambda p, s: _admin(
+            "CreateFulltextIndex", is_edge=s.is_edge,
+            index_name=s.index_name, schema_name=s.schema_name,
+            field=s.field, if_not_exists=s.if_not_exists,
+            space=p.need_space()),
+        A.DropFulltextIndexSentence: lambda p, s: _admin(
+            "DropFulltextIndex", index_name=s.index_name,
+            if_exists=s.if_exists, space=p.need_space()),
+        A.RebuildFulltextIndexSentence: lambda p, s: _admin(
+            "RebuildFulltextIndex", index_name=s.index_name,
+            space=p.need_space()),
+        A.AddListenerSentence: lambda p, s: _admin(
+            "AddListener", ltype=s.ltype, endpoints=s.endpoints,
+            space=p.need_space()),
+        A.RemoveListenerSentence: lambda p, s: _admin(
+            "RemoveListener", ltype=s.ltype, space=p.need_space()),
         A.SubmitJobSentence: lambda p, s: _admin(
             "SubmitJob", cols=["New Job Id"], job=s.job, space=p.space),
         A.ShowJobsSentence: lambda p, s: _admin(

@@ -30,7 +30,8 @@ KEYWORDS = {
     "SESSIONS", "KILL", "QUERY", "QUERIES", "CONFIGS", "TTL_DURATION",
     "TTL_COL", "DEFAULT", "NULL", "COMMENT", "SAMPLE", "INGEST",
     "USER", "USERS", "PASSWORD", "GRANT", "REVOKE", "ROLE", "ROLES",
-    "ZONE", "ZONES", "INTO",
+    "ZONE", "ZONES", "INTO", "FULLTEXT", "LISTENER", "ELASTICSEARCH",
+    "REMOVE",
     # types
     "INT", "INT64", "INT32", "INT16", "INT8", "FLOAT", "DOUBLE", "STRING",
     "FIXED_STRING", "BOOL", "TIMESTAMP", "DATE", "TIME", "DATETIME",

@@ -1,0 +1,138 @@
+Feature: Full-text indexes and text-search LOOKUP
+
+  Background:
+    Given having executed:
+      """
+      CREATE SPACE ftk(partition_num=4, vid_type=INT64);
+      USE ftk;
+      CREATE TAG book(title string, year int);
+      CREATE EDGE cites(note string);
+      ADD LISTENER ELASTICSEARCH "127.0.0.1:9200";
+      CREATE FULLTEXT TAG INDEX ft_title ON book(title);
+      CREATE FULLTEXT EDGE INDEX ft_note ON cites(note);
+      INSERT VERTEX book(title, year) VALUES 1:("Graph Theory", 1990), 2:("Graphs and Matrices", 2005), 3:("Linear Algebra", 1987), 4:("graphics gems", 1994);
+      INSERT EDGE cites(note) VALUES 1->3:("background"), 2->1:("builds on"), 4->3:("rendering math")
+      """
+
+  Scenario: show fulltext indexes
+    When executing query:
+      """
+      SHOW FULLTEXT INDEXES
+      """
+    Then the result should be, in any order:
+      | Name       | Schema Type | Schema Name | Fields  |
+      | "ft_title" | "Tag"       | "book"      | "title" |
+      | "ft_note"  | "Edge"      | "cites"     | "note"  |
+
+  Scenario: show listener
+    When executing query:
+      """
+      SHOW LISTENER
+      """
+    Then the result should be, in any order:
+      | PartId | Type            | Host             | Status   | Lag |
+      | 0      | "ELASTICSEARCH" | "127.0.0.1:9200" | "ONLINE" | 0   |
+
+  Scenario: prefix lookup is case-insensitive on the value
+    When executing query:
+      """
+      LOOKUP ON book WHERE PREFIX(book.title, "Graph") YIELD id(vertex) AS id, book.title AS t
+      """
+    Then the result should be, in any order:
+      | id | t                     |
+      | 1  | "Graph Theory"        |
+      | 2  | "Graphs and Matrices" |
+      | 4  | "graphics gems"       |
+
+  Scenario: wildcard lookup
+    When executing query:
+      """
+      LOOKUP ON book WHERE WILDCARD(book.title, "*alg*") YIELD book.title AS t
+      """
+    Then the result should be, in any order:
+      | t                |
+      | "Linear Algebra" |
+
+  Scenario: regexp lookup is case-sensitive
+    When executing query:
+      """
+      LOOKUP ON book WHERE REGEXP(book.title, "^Graph[s ]") YIELD book.title AS t
+      """
+    Then the result should be, in any order:
+      | t                     |
+      | "Graph Theory"        |
+      | "Graphs and Matrices" |
+
+  Scenario: fuzzy lookup tolerates a typo
+    When executing query:
+      """
+      LOOKUP ON book WHERE FUZZY(book.title, "Algebr") YIELD book.title AS t
+      """
+    Then the result should be, in any order:
+      | t                |
+      | "Linear Algebra" |
+
+  Scenario: text predicate with residual filter
+    When executing query:
+      """
+      LOOKUP ON book WHERE PREFIX(book.title, "Graph") AND book.year > 1991 YIELD book.title AS t
+      """
+    Then the result should be, in any order:
+      | t                     |
+      | "Graphs and Matrices" |
+      | "graphics gems"       |
+
+  Scenario: edge fulltext lookup yields edge props
+    When executing query:
+      """
+      LOOKUP ON cites WHERE PREFIX(cites.note, "b") YIELD src(edge) AS s, dst(edge) AS d, cites.note AS n
+      """
+    Then the result should be, in any order:
+      | s | d | n            |
+      | 1 | 3 | "background" |
+      | 2 | 1 | "builds on"  |
+
+  Scenario: dml keeps the text index fresh
+    Given having executed:
+      """
+      DELETE VERTEX 2;
+      UPDATE VERTEX ON book 4 SET title = "graph drawing"
+      """
+    When executing query:
+      """
+      LOOKUP ON book WHERE PREFIX(book.title, "graph") YIELD book.title AS t
+      """
+    Then the result should be, in any order:
+      | t               |
+      | "Graph Theory"  |
+      | "graph drawing" |
+
+  Scenario: rebuild fulltext index backfills
+    Given having executed:
+      """
+      DROP FULLTEXT INDEX ft_title;
+      CREATE FULLTEXT TAG INDEX ft_title ON book(title)
+      """
+    When executing query:
+      """
+      LOOKUP ON book WHERE PREFIX(book.title, "Graph") YIELD book.title AS t
+      """
+    Then the result should be empty
+    Given having executed:
+      """
+      REBUILD FULLTEXT INDEX ft_title
+      """
+    When executing query:
+      """
+      LOOKUP ON book WHERE PREFIX(book.title, "Linear") YIELD book.title AS t
+      """
+    Then the result should be, in any order:
+      | t                |
+      | "Linear Algebra" |
+
+  Scenario: text lookup without an index is an error
+    When executing query:
+      """
+      LOOKUP ON book WHERE PREFIX(book.year, "19") YIELD id(vertex)
+      """
+    Then a SemanticError should be raised

@@ -1,0 +1,277 @@
+"""Full-text plane tests: analyzer/edit-distance primitives, listener
+async maintenance on writes, all four text ops, rebuild, drop/resurrect
+guard, durability, and cluster-mode text LOOKUP (SURVEY §2 row 10
+Listener; reference: ES-backed LOOKUP [UNVERIFIED — empty mount])."""
+import pytest
+
+from nebula_tpu.exec import QueryEngine
+from nebula_tpu.graphstore.fulltext import (FulltextIndexData, analyze,
+                                            levenshtein_leq)
+
+
+# ---- primitives -----------------------------------------------------------
+
+def test_analyze():
+    assert analyze("Boris Diaw-2010") == ["boris", "diaw", "2010"]
+    assert analyze("") == []
+
+
+def test_levenshtein_band():
+    assert levenshtein_leq("kitten", "sitten", 1)
+    assert not levenshtein_leq("kitten", "sitting", 2)
+    assert levenshtein_leq("kitten", "sitting", 3)
+    assert not levenshtein_leq("abc", "xyz", 2)
+    assert levenshtein_leq("", "ab", 2)
+
+
+def test_index_data_ops():
+    ft = FulltextIndexData("f", "t", "name", False, 2, 1)
+    ft.add(0, "Boris Diaw", 1)
+    ft.add(1, "Bob", 2)
+    ft.add(0, "Alice", 3)
+    assert ft.search("PREFIX", "bo") == [1, 2]       # part order
+    assert ft.search("WILDCARD", "*li*") == [3]
+    assert ft.search("REGEXP", "^B.*w$") == [1]
+    assert ft.search("FUZZY", "Alise") == [3]
+    ft.remove(0, 1)
+    assert ft.search("PREFIX", "bo") == [2]
+    assert ft.count() == 2
+    with pytest.raises(ValueError):
+        ft.search("REGEXP", "(unclosed")
+
+
+# ---- engine surface -------------------------------------------------------
+
+@pytest.fixture()
+def eng():
+    e = QueryEngine()
+    s = e.new_session()
+
+    def run(q):
+        r = e.execute(s, q)
+        assert r.ok, f"{q} -> {r.error}"
+        return r
+
+    run('CREATE SPACE fts(partition_num=4, vid_type=INT64)')
+    run('USE fts')
+    run('CREATE TAG player(name string, age int64)')
+    run('CREATE EDGE follows(note string)')
+    run('ADD LISTENER ELASTICSEARCH "127.0.0.1:9200"')
+    run('CREATE FULLTEXT TAG INDEX ft_name ON player(name)')
+    run('CREATE FULLTEXT EDGE INDEX ft_note ON follows(note)')
+    run('INSERT VERTEX player(name, age) VALUES '
+        '1:("Boris Diaw", 33), 2:("Bob Marley", 40), '
+        '3:("Alice", 20), 4:("boxer", 25)')
+    run('INSERT EDGE follows(note) VALUES '
+        '1->2:("great singer"), 2->3:("old friend"), 3->4:("gym buddy")')
+    e._run = run
+    return e
+
+
+def rows(eng, q):
+    return eng._run(q).data.rows
+
+
+def names(eng, q):
+    return sorted(r[0] for r in rows(eng, q))
+
+
+def test_show_fulltext_indexes_and_listener(eng):
+    assert rows(eng, 'SHOW FULLTEXT INDEXES') == [
+        ['ft_name', 'Tag', 'player', 'name'],
+        ['ft_note', 'Edge', 'follows', 'note']]
+    ls = rows(eng, 'SHOW LISTENER')
+    assert ls[0][1] == 'ELASTICSEARCH' and ls[0][3] == 'ONLINE'
+
+
+def test_prefix_wildcard_regexp_fuzzy(eng):
+    assert names(eng, 'LOOKUP ON player WHERE PREFIX(player.name, "Bo") '
+                      'YIELD player.name AS n') \
+        == ['Bob Marley', 'Boris Diaw', 'boxer']
+    assert names(eng, 'LOOKUP ON player WHERE WILDCARD(player.name, "*li*")'
+                      ' YIELD player.name AS n') == ['Alice']
+    assert names(eng, 'LOOKUP ON player WHERE REGEXP(player.name, '
+                      '"^[AB].*e$") YIELD player.name AS n') == ['Alice']
+    assert names(eng, 'LOOKUP ON player WHERE FUZZY(player.name, "Alise") '
+                      'YIELD player.name AS n') == ['Alice']
+
+
+def test_residual_filter_and_default_yield(eng):
+    assert names(eng, 'LOOKUP ON player WHERE PREFIX(player.name, "Bo") '
+                      'AND player.age > 35 YIELD player.name AS n') \
+        == ['Bob Marley']
+    # default yield: vertex ids
+    assert sorted(r[0] for r in rows(
+        eng, 'LOOKUP ON player WHERE PREFIX(player.name, "Bo")')) \
+        == [1, 2, 4]
+
+
+def test_edge_fulltext_with_props(eng):
+    got = rows(eng, 'LOOKUP ON follows WHERE PREFIX(follows.note, "g") '
+                    'YIELD src(edge) AS s, follows.note AS n')
+    assert sorted(map(tuple, got)) == [(1, 'great singer'),
+                                       (3, 'gym buddy')]
+
+
+def test_listener_tracks_dml(eng):
+    eng._run('DELETE VERTEX 2')
+    assert names(eng, 'LOOKUP ON player WHERE PREFIX(player.name, "Bo") '
+                      'YIELD player.name AS n') == ['Boris Diaw', 'boxer']
+    eng._run('UPDATE VERTEX ON player 4 SET name = "Bobby"')
+    assert names(eng, 'LOOKUP ON player WHERE PREFIX(player.name, "Bo") '
+                      'YIELD player.name AS n') == ['Bobby', 'Boris Diaw']
+    eng._run('INSERT VERTEX player(name, age) VALUES 9:("Border", 1)')
+    assert names(eng, 'LOOKUP ON player WHERE PREFIX(player.name, "Bo") '
+                      'YIELD player.name AS n') \
+        == ['Bobby', 'Border', 'Boris Diaw']
+
+
+def test_rebuild_and_drop_guard(eng):
+    assert rows(eng, 'REBUILD FULLTEXT INDEX')[0][0] >= 0
+    assert names(eng, 'LOOKUP ON player WHERE PREFIX(player.name, "b") '
+                      'YIELD player.name AS n') \
+        == ['Bob Marley', 'Boris Diaw', 'boxer']
+    eng._run('DROP FULLTEXT INDEX ft_note')
+    s = eng.new_session()
+    eng.execute(s, 'USE fts')
+    bad = eng.execute(s, 'LOOKUP ON follows WHERE '
+                         'PREFIX(follows.note, "g") YIELD follows.note')
+    assert bad.error is not None and 'fulltext' in bad.error
+    # re-create with same name: must start EMPTY until rebuild
+    eng._run('CREATE FULLTEXT EDGE INDEX ft_note ON follows(note)')
+    assert rows(eng, 'LOOKUP ON follows WHERE PREFIX(follows.note, "g") '
+                     'YIELD follows.note AS n') == []
+    eng._run('REBUILD FULLTEXT INDEX ft_note')
+    assert len(rows(eng, 'LOOKUP ON follows WHERE '
+                         'PREFIX(follows.note, "g") '
+                         'YIELD follows.note AS n')) == 2
+
+
+def test_requires_string_prop(eng):
+    bad = None
+    s2 = eng.new_session()
+    eng.execute(s2, 'USE fts')
+    bad = eng.execute(s2, 'CREATE FULLTEXT TAG INDEX ft_age ON player(age)')
+    assert bad.error is not None and 'string' in bad.error
+
+
+def test_no_index_is_clean_error(eng):
+    s2 = eng.new_session()
+    eng.execute(s2, 'USE fts')
+    bad = eng.execute(s2, 'LOOKUP ON player WHERE '
+                          'PREFIX(player.age, "3") YIELD id(vertex)')
+    assert bad.error is not None
+
+
+def test_durable_recovery(tmp_path):
+    """DDL + data replay through the journal; text search works after
+    recovery (catalog mutators journaled via CATALOG_MUTATORS)."""
+    from nebula_tpu.graphstore.store import GraphStore
+    st = GraphStore(data_dir=str(tmp_path))
+    e = QueryEngine(st)
+    s = e.new_session()
+    for q in ['CREATE SPACE d(partition_num=2, vid_type=INT64)', 'USE d',
+              'CREATE TAG t(name string)',
+              'CREATE FULLTEXT TAG INDEX ft ON t(name)',
+              'INSERT VERTEX t(name) VALUES 1:("hello world"), 2:("help")']:
+        r = e.execute(s, q)
+        assert r.ok, f"{q} -> {r.error}"
+    st.close()
+
+    st2 = GraphStore(data_dir=str(tmp_path))
+    e2 = QueryEngine(st2)
+    s2 = e2.new_session()
+    e2.execute(s2, 'USE d')
+    r = e2.execute(s2, 'LOOKUP ON t WHERE PREFIX(t.name, "hel") '
+                       'YIELD t.name AS n')
+    assert r.ok, r.error
+    assert sorted(x[0] for x in r.data.rows) == ['hello world', 'help']
+    st2.close()
+
+
+def test_cluster_fulltext():
+    """Text LOOKUP in cluster mode: DDL via metad raft, per-part search
+    fan-out over storaged, listener on each replica."""
+    from nebula_tpu.cluster.launcher import LocalCluster
+    c = LocalCluster(n_meta=1, n_storage=2, n_graph=1)
+    try:
+        sess = c.client()
+        r = sess.execute('CREATE SPACE cf(partition_num=4, '
+                         'replica_factor=1, vid_type=INT64)')
+        assert r.error is None, r.error
+        c.reconcile_storage()
+        for q in ['USE cf',
+                  'CREATE TAG song(title string)',
+                  'CREATE FULLTEXT TAG INDEX ft_title ON song(title)',
+                  'INSERT VERTEX song(title) VALUES 1:("Hey Jude"), '
+                  '2:("Hey Ya"), 3:("Let It Be"), 4:("Yesterday")']:
+            r = sess.execute(q)
+            assert r.error is None, f"{q} -> {r.error}"
+        r = sess.execute('LOOKUP ON song WHERE PREFIX(song.title, "Hey") '
+                         'YIELD song.title AS t')
+        assert r.error is None, r.error
+        assert sorted(x[0] for x in r.data.rows) == ['Hey Jude', 'Hey Ya']
+        r = sess.execute('LOOKUP ON song WHERE FUZZY(song.title, "Yesterdy")'
+                         ' YIELD song.title AS t')
+        assert r.error is None, r.error
+        assert [x[0] for x in r.data.rows] == ['Yesterday']
+        # DML keeps replica sinks fresh
+        r = sess.execute('DELETE VERTEX 2')
+        assert r.error is None, r.error
+        r = sess.execute('LOOKUP ON song WHERE PREFIX(song.title, "Hey") '
+                         'YIELD song.title AS t')
+        assert [x[0] for x in r.data.rows] == ['Hey Jude']
+    finally:
+        c.stop()
+
+
+def test_second_text_conjunct_evaluates_as_residual(eng):
+    """Only one text predicate plans into the scan; others must still
+    evaluate (host text functions), not crash."""
+    got = names(eng, 'LOOKUP ON player WHERE PREFIX(player.name, "Bo") '
+                     'AND WILDCARD(player.name, "*diaw*") '
+                     'YIELD player.name AS n')
+    assert got == ['Boris Diaw']
+
+
+def test_concurrent_search_and_writes(eng):
+    """Listener thread mutates while query threads scan — no
+    'dictionary changed size during iteration'."""
+    import threading
+    errs = []
+
+    def writer():
+        for i in range(200):
+            eng._run(f'INSERT VERTEX player(name, age) '
+                     f'VALUES {100 + i}:("Bolt {i}", {i % 80 + 10})')
+
+    def reader():
+        try:
+            for _ in range(60):
+                rows(eng, 'LOOKUP ON player WHERE '
+                          'PREFIX(player.name, "Bo") YIELD player.name')
+                rows(eng, 'LOOKUP ON player WHERE '
+                          'FUZZY(player.name, "Bolt") YIELD player.name')
+        except Exception as ex:  # noqa: BLE001
+            errs.append(ex)
+
+    ts = [threading.Thread(target=writer)] + \
+        [threading.Thread(target=reader) for _ in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert not errs, errs
+
+
+def test_drop_releases_corpus(eng):
+    """DROP FULLTEXT INDEX must evict the store-side corpus (not strand
+    it until a same-name re-CREATE)."""
+    st = eng.qctx.store
+    sd = st.space('fts')
+    assert 'ft_note' in sd.ft_data
+    eng._run('DROP FULLTEXT INDEX ft_note')
+    # next write-path touch GCs the dropped incarnation
+    eng._run('INSERT EDGE follows(note) VALUES 7->8:("x")')
+    assert 'ft_note' not in sd.ft_data
+    assert st.ft_listener.target('fts', 'ft_note') is None

@@ -262,3 +262,29 @@ def test_push_filter_down_append_vertices(eng):
         if n.kind == "AppendVertices" and n.args.get("filter") is not None:
             found = n
     assert found is not None
+
+
+def test_eliminate_false_filter(eng):
+    q = ('GO FROM "a" OVER knows YIELD dst(edge) AS d '
+         '| YIELD $-.d AS d WHERE false')
+    p = plan_of(eng, q)
+    kinds = p.root.kind_tree()
+    assert "Filter" not in kinds
+    from nebula_tpu.query.plan import walk_plan
+    assert any(n.args.get("empty") for n in walk_plan(p.root)
+               if n.kind == "Project")
+    # and it actually runs to an empty (not errored) result
+    r = eng.execute(eng._sess, q)
+    assert r.ok and r.data.rows == [] and r.data.column_names == ["d"]
+
+
+def test_push_limit_down_fulltext_scan(eng):
+    r = eng.execute(eng._sess,
+                    'CREATE FULLTEXT TAG INDEX ft_n ON person(name)')
+    assert r.ok, r.error
+    p = plan_of(eng, 'LOOKUP ON person WHERE PREFIX(person.name, "a") '
+                     'YIELD person.name | LIMIT 2')
+    scan = p.root
+    while scan.kind != "FulltextIndexScan":
+        scan = scan.dep()
+    assert scan.args.get("limit") == 2

@@ -193,8 +193,15 @@ def test_partition_minority_cannot_commit(tmp_path):
         for o in others:
             tr.partition(leader.node_id, o.node_id)
         assert leader.propose(b"lost", timeout=0.5) is None
-        new_leader = wait_leader(others)
-        assert new_leader.propose(b"kept")
+        # Retry-against-current-leader like a real client: the first
+        # majority-side leader can be deposed by a concurrent election
+        # before the propose lands (propose contract: None -> retry).
+        deadline = time.time() + 15
+        while True:
+            new_leader = wait_leader(others)
+            if new_leader.propose(b"kept"):
+                break
+            assert time.time() < deadline, "majority never committed"
         tr.heal()
         wait_applied(apps, [b"kept"])
         # the isolated leader's uncommitted entry must be discarded
