@@ -51,7 +51,7 @@ def _median(xs):
     return statistics.median(xs)
 
 
-def bench_engine_config(name, store, query, seeds_note, rt):
+def bench_engine_config(name, store, query, seeds_note, rt, space="snb"):
     """Engine-E2E wall time, device plane OFF vs ON, identical rows."""
     from nebula_tpu.exec.engine import QueryEngine
 
@@ -60,7 +60,7 @@ def bench_engine_config(name, store, query, seeds_note, rt):
     for mode, runtime in (("cpu", None), ("tpu", rt)):
         eng = QueryEngine(store, tpu_runtime=runtime)
         s = eng.new_session()
-        eng.execute(s, "USE snb")
+        eng.execute(s, f"USE {space}")
         rs = eng.execute(s, query)          # warmup (compile + pin)
         assert rs.error is None, f"{name}: {rs.error}"
         lat = []
@@ -124,9 +124,13 @@ def _ensure_live_backend():
 
 
 def _enable_compile_cache():
-    """Persistent XLA compilation cache: bucket-escalation recompiles
-    dominate warmup on a tunneled chip (~8 min cold); cached, reruns
-    skip straight to execution."""
+    """Persistent XLA compilation cache + bucket cache: escalation
+    recompiles dominate warmup on a tunneled chip (~8 min cold); cached,
+    reruns skip straight to execution at the converged bucket sizes."""
+    os.environ.setdefault(
+        "NEBULA_BUCKET_CACHE",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     ".tpu_buckets.json"))
     try:
         import jax
         jax.config.update(
@@ -223,7 +227,7 @@ def main():
         "cfg4", tw,
         f"MATCH (a:Person)-[e:KNOWS*1..4]->(b) WHERE id(a) IN [{tw_list}] "
         f"RETURN count(*) AS paths",
-        tw_seeds, rt)
+        tw_seeds, rt, space="tw")
     rt.unpin("tw")
 
     # ---- north-star-scale array graph (configs 5 + 6) ----

@@ -137,6 +137,9 @@ class GraphStore:
         self.data: Dict[int, SpaceData] = {}
         self._engine = None
         self._ft_listener = None     # started on first fulltext index
+        self._ft_reg_lock = threading.Lock()
+        # (space_id, schema, is_edge) → (catalog_version, descs)
+        self._ft_memo: Dict[Tuple[int, str, bool], Tuple[int, list]] = {}
         if data_dir is not None:
             # durable standalone engine (SURVEY §2 row 10): recover from
             # checkpoint + journal, then resume journaling every mutation
@@ -245,27 +248,44 @@ class GraphStore:
     def _ft_list(self, sd: SpaceData, space: str, schema: str,
                  is_edge: bool):
         from .fulltext import FulltextIndexData
-        if sd.ft_data:
-            # GC incarnations the catalog no longer lists (DROP FULLTEXT
-            # INDEX must release the corpus, not strand it until a
-            # same-name re-CREATE)
-            live = {d.name: d.index_id
-                    for d in self.catalog.fulltext_indexes(space)}
-            for name in list(sd.ft_data):
-                if live.get(name) != sd.ft_data[name].index_id:
-                    del sd.ft_data[name]
-                    if self._ft_listener is not None:
-                        self._ft_listener.unregister(space, name)
-        descs = self.catalog.fulltext_indexes_for(space, schema, is_edge)
+        # per-write fast path: catalog lookups + drop-GC run only when
+        # the catalog version moved, not on every mutation
+        ver = self.catalog.version
+        mkey = (sd.desc.space_id, schema, is_edge)
+        memo = self._ft_memo.get(mkey)
+        if memo is None or memo[0] != ver:
+            with self._ft_reg_lock:
+                if sd.ft_data:
+                    # GC incarnations the catalog no longer lists (DROP
+                    # FULLTEXT INDEX must release the corpus, not strand
+                    # it until a same-name re-CREATE)
+                    live = {d.name: d.index_id
+                            for d in self.catalog.fulltext_indexes(space)}
+                    for name in list(sd.ft_data):
+                        if live.get(name) != sd.ft_data[name].index_id:
+                            del sd.ft_data[name]
+                            if self._ft_listener is not None:
+                                self._ft_listener.unregister(space, name)
+                descs = self.catalog.fulltext_indexes_for(space, schema,
+                                                          is_edge)
+            self._ft_memo[mkey] = memo = (ver, descs)
+        descs = memo[1]
+        if not descs:
+            return ()
         out = []
-        for d in descs:
-            ft = sd.ft_data.get(d.name)
-            if ft is None or ft.index_id != d.index_id:
-                ft = sd.ft_data[d.name] = FulltextIndexData(
-                    d.name, d.schema_name, d.fields[0], d.is_edge,
-                    sd.num_parts, d.index_id)
-                self.ft_listener.register(space, ft)
-            out.append(ft)
+        # registry mutation is serialized: a concurrent first touch from
+        # a search thread and a write thread must agree on ONE
+        # FulltextIndexData (a split brain here would send all listener
+        # applies to an object searches never read)
+        with self._ft_reg_lock:
+            for d in descs:
+                ft = sd.ft_data.get(d.name)
+                if ft is None or ft.index_id != d.index_id:
+                    ft = sd.ft_data[d.name] = FulltextIndexData(
+                        d.name, d.schema_name, d.fields[0], d.is_edge,
+                        sd.num_parts, d.index_id)
+                    self.ft_listener.register(space, ft)
+                out.append(ft)
         return out
 
     def _ft_enqueue(self, sd, space, schema, is_edge, part, entity,

@@ -655,29 +655,49 @@ def _plan_lookup(pctx, s: A.LookupSentence) -> PlanNode:
         filt = _rewrite_match_expr(s.where.filter, aliases)
         filt = _rewrite_go_expr(pctx, filt, [s.schema_name]) if is_edge else filt
     # text-search predicate → fulltext scan (reference: ES-backed LOOKUP)
-    text = None
+    text = ft_pick = first_unindexed = None
     if filt is not None:
         conjs = split_conjuncts(filt)
+        ft_descs = pctx.catalog.fulltext_indexes_for(
+            space, s.schema_name, is_edge)
         for i, c in enumerate(conjs):
             m = _lookup_text_cond(c, s.schema_name, is_edge)
-            if m is not None:
+            if m is None:
+                continue
+            op, field, pat = m
+            if op == "REGEXP":
+                # validate const patterns at plan time so scan-planned
+                # and residual placements fail identically
+                import re as _re
+                try:
+                    _re.compile(pat)
+                except _re.error as ex:
+                    raise QueryError(
+                        f"bad REGEXP pattern {pat!r}: {ex}") from None
+            d = next((d for d in ft_descs if d.fields[0] == field), None)
+            if d is None:
+                # another conjunct may still be indexed; the host text
+                # evaluators cover this one as a residual
+                if first_unindexed is None:
+                    first_unindexed = (op, field)
+                continue
+            if text is None:
                 text = m
+                ft_pick = d
                 residual_t = join_conjuncts(
                     [x for j, x in enumerate(conjs) if j != i])
-                break
-    if text is not None:
-        op, field, pat = text
-        ft = next((d for d in pctx.catalog.fulltext_indexes_for(
-            space, s.schema_name, is_edge) if d.fields[0] == field), None)
-        if ft is None:
+        if text is None and first_unindexed is not None:
+            op, field = first_unindexed
             raise QueryError(
                 f"no fulltext index on `{s.schema_name}.{field}' "
                 f"({op} requires one; CREATE FULLTEXT INDEX first)")
+    if text is not None:
+        op, field, pat = text
         scan = PlanNode("FulltextIndexScan", deps=[],
                         col_names=["_matched"],
                         args={"space": space, "schema": s.schema_name,
                               "is_edge": is_edge, "filter": residual_t,
-                              "index": ft.name, "op": op,
+                              "index": ft_pick.name, "op": op,
                               "pattern": pat})
     else:
         index_name, eq, rng, residual = _choose_index(

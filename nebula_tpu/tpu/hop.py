@@ -78,6 +78,26 @@ def _merge_frontier(recv, F: int):
     return nf, jnp.minimum(cnt, F), cnt > F
 
 
+def _compact_cap(src, dst, rk, eidx, keep, EB: int):
+    """Stable-partition the kept edge slots to the FRONT of each capture
+    row (cumsum scatter, O(EB)) and return the kept count.
+
+    Why: capture arrays are EB-padded and EB is sized for the worst hop
+    (millions of slots); fetching them wholesale ships mostly padding —
+    ~2 GB/query over a tunneled chip.  With kept entries compacted to a
+    prefix the host fetches only [:kmax] slices (runtime._escalate).
+    The scatter is order-preserving, so the (part, src)-contiguous
+    ascending-eidx invariant the host materializers rely on survives."""
+    pos = jnp.where(keep, jnp.cumsum(keep, dtype=jnp.int32) - 1,
+                    EB).astype(jnp.int32)
+
+    def put(a, fill):
+        return jnp.full((EB,), fill, a.dtype).at[pos].set(a, mode="drop")
+
+    return (put(src, -1), put(jnp.where(keep, dst, -1), -1), put(rk, 0),
+            put(eidx, 0), jnp.sum(keep, dtype=jnp.int32))
+
+
 def _expand_block(indptr, nbr, rank, fr, F: int, EB: int, P: int):
     """Vectorized CSR expansion of one block for one shard's frontier.
 
@@ -147,7 +167,8 @@ def build_traverse_fn(mesh, P: int, F: int, EB: int, steps: int,
             last = hop == steps - 1
             cands = []
             edges_this_hop = jnp.zeros((), jnp.int32)
-            caps = {"src": [], "dst": [], "rank": [], "eidx": [], "keep": []}
+            caps = {"src": [], "dst": [], "rank": [], "eidx": [],
+                    "kcount": []}
             for bi in range(n_blocks):
                 b = blocks_data[bi]
                 src, dst, rk, eidx, ve, total, ovf = _expand_block(
@@ -163,11 +184,13 @@ def build_traverse_fn(mesh, P: int, F: int, EB: int, steps: int,
                 else:
                     keep = ve
                 if capture and (last or capture_hops):
-                    caps["src"].append(src)
-                    caps["dst"].append(jnp.where(keep, dst, -1))
-                    caps["rank"].append(rk)
-                    caps["eidx"].append(eidx)
-                    caps["keep"].append(keep)
+                    cs, cd, cr, ce, kc = _compact_cap(src, dst, rk, eidx,
+                                                      keep, EB)
+                    caps["src"].append(cs)
+                    caps["dst"].append(cd)
+                    caps["rank"].append(cr)
+                    caps["eidx"].append(ce)
+                    caps["kcount"].append(kc)
                 if not last:
                     cands.append(jnp.where(keep, dst, -1))
             hop_edges.append(edges_this_hop)
@@ -176,13 +199,17 @@ def build_traverse_fn(mesh, P: int, F: int, EB: int, steps: int,
 
             if last:
                 if capture:
+                    arr_keys = ("src", "dst", "rank", "eidx")
                     if capture_hops:
                         cap_out = {k: jnp.stack([hc[k] for hc in hop_caps]
                                                 )[None]
-                                   for k in hop_caps[0]}
+                                   for k in arr_keys}
+                        kcount_out = jnp.stack(
+                            [hc["kcount"] for hc in hop_caps])[None]
                     else:
-                        cap_out = {k: v[None]
-                                   for k, v in hop_caps[-1].items()}
+                        cap_out = {k: hop_caps[-1][k][None]
+                                   for k in arr_keys}
+                        kcount_out = hop_caps[-1]["kcount"][None]
                 # the post-final frontier is not needed for GO; report empty
                 fr = jnp.full((F,), -1, jnp.int32)
                 fcount = jnp.zeros((), jnp.int32)
@@ -206,6 +233,7 @@ def build_traverse_fn(mesh, P: int, F: int, EB: int, steps: int,
         }
         if capture:
             res["cap"] = cap_out
+            res["kcount"] = kcount_out   # small: fetched with the meta
         return res
 
     spec = PartitionSpec("part")
@@ -256,7 +284,8 @@ def build_traverse_fn_local(P: int, F: int, EB: int, steps: int,
             last = hop == steps - 1
             cands = []
             edges = jnp.zeros((P,), jnp.int32)
-            caps = {"src": [], "dst": [], "rank": [], "eidx": [], "keep": []}
+            caps = {"src": [], "dst": [], "rank": [], "eidx": [],
+                    "kcount": []}
             for bi in range(n_blocks):
                 b = blocks_data[bi]
                 want_pred = pred is not None and (last or capture_hops)
@@ -268,28 +297,36 @@ def build_traverse_fn_local(P: int, F: int, EB: int, steps: int,
                 ovf_e = ovf_e | ovf
                 edges = edges + total
                 if capture and (last or capture_hops):
-                    caps["src"].append(src)
-                    caps["dst"].append(jnp.where(keep, dst, -1))
-                    caps["rank"].append(rk)
-                    caps["eidx"].append(eidx)
-                    caps["keep"].append(keep)
+                    cs, cd, cr, ce, kc = jax.vmap(
+                        lambda s, d, r, e, k: _compact_cap(s, d, r, e, k,
+                                                           EB)
+                    )(src, dst, rk, eidx, keep)
+                    caps["src"].append(cs)
+                    caps["dst"].append(cd)
+                    caps["rank"].append(cr)
+                    caps["eidx"].append(ce)
+                    caps["kcount"].append(kc)
                 if not last:
                     cands.append(jnp.where(keep, dst, -1))
             hop_edges.append(edges)
             if capture and (last or capture_hops):
-                # (P, nb, EB)
+                # arrays (P, nb, EB); kcount (P, nb)
                 hop_caps.append({k: jnp.stack(v, axis=1)
                                  for k, v in caps.items()})
 
             if last:
                 if capture:
+                    arr_keys = ("src", "dst", "rank", "eidx")
                     if capture_hops:
-                        # (P, steps, nb, EB)
+                        # (P, steps, nb, EB); kcount (P, steps, nb)
                         cap_out = {k: jnp.stack([hc[k] for hc in hop_caps],
                                                 axis=1)
-                                   for k in hop_caps[0]}
+                                   for k in arr_keys}
+                        kcount_out = jnp.stack(
+                            [hc["kcount"] for hc in hop_caps], axis=1)
                     else:
-                        cap_out = hop_caps[-1]
+                        cap_out = {k: hop_caps[-1][k] for k in arr_keys}
+                        kcount_out = hop_caps[-1]["kcount"]
                 fr = jnp.full((P, F), -1, jnp.int32)
                 fcount = jnp.zeros((P,), jnp.int32)
             else:
@@ -316,6 +353,7 @@ def build_traverse_fn_local(P: int, F: int, EB: int, steps: int,
         }
         if capture:
             res["cap"] = cap_out
+            res["kcount"] = kcount_out   # small: fetched with the meta
         return res
 
     return jax.jit(fn)

@@ -129,6 +129,23 @@ class TpuRuntime:
         # converged buckets instead of re-climbing the escalation ladder
         # (the ladder re-runs the kernel once per rung, per query)
         self._buckets: Dict[Tuple, Tuple[int, int]] = {}
+        # optional cross-process persistence (NEBULA_BUCKET_CACHE=path):
+        # each escalation rung is a fresh XLA compile (~100s on a
+        # tunneled chip) — a repeat bench/driver run should start at the
+        # previously converged sizes, not re-climb
+        import os as _os
+        self._buckets_path = _os.environ.get("NEBULA_BUCKET_CACHE")
+        if self._buckets_path:
+            try:
+                import ast as _ast
+                import json as _json
+                with open(self._buckets_path) as f:
+                    # keys are repr'd tuples of primitives; literal_eval
+                    # (never eval/pickle — the path is configurable)
+                    self._buckets = {_ast.literal_eval(k): tuple(v)
+                                     for k, v in _json.load(f).items()}
+            except Exception:  # noqa: BLE001 — absent/corrupt cache
+                self._buckets = {}
         self.max_retries = 10
         from ..utils.config import get_config
         self.init_f = int(get_config().get("tpu_init_frontier"))
@@ -185,6 +202,20 @@ class TpuRuntime:
 
     def hbm_bytes(self) -> int:
         return sum(s.hbm_bytes() for s in self.snapshots.values())
+
+    def _save_buckets(self):
+        if not self._buckets_path:
+            return
+        try:
+            import json as _json
+            tmp = self._buckets_path + ".tmp"
+            with open(tmp, "w") as f:
+                _json.dump({repr(k): list(v)
+                            for k, v in self._buckets.items()}, f)
+            import os as _os
+            _os.replace(tmp, self._buckets_path)
+        except Exception:  # noqa: BLE001 — cache is best-effort
+            pass
 
     # -- traversal --------------------------------------------------------
 
@@ -256,8 +287,14 @@ class TpuRuntime:
             jax.block_until_ready(res)
             t1 = time.perf_counter()
             stats.device_s = t1 - t0
-            # one batched transfer (the axon tunnel charges ~15ms per
-            # fetch RPC; per-leaf np.asarray would pay it repeatedly)
+            # two-phase fetch: capture arrays stay on device while the
+            # small meta (counters/overflow flags) comes back first; the
+            # EB-padded capture rows are then fetched as [:kmax] slices —
+            # kept entries are device-compacted to a prefix (hop.py
+            # _compact_cap), so the transfer is kept-sized, not
+            # bucket-sized (~2 GB → MBs on the north-star config)
+            cap_dev = res.pop("cap", None) if isinstance(res, dict) \
+                else None
             res = jax.device_get(res)
             stats.fetch_s = time.perf_counter() - t1
 
@@ -277,11 +314,23 @@ class TpuRuntime:
                 esc = True
             if not esc:
                 stats.f_cap, stats.e_cap = F, EB
-                self._buckets[bkey] = (F, EB)
+                if self._buckets.get(bkey) != (F, EB):
+                    self._buckets[bkey] = (F, EB)
+                    self._save_buckets()
                 if len(self._buckets) > 512:
                     self._buckets.clear()
                 stats.hop_edges = [int(x)
                                    for x in res["hop_edges"].sum(axis=0)]
+                if cap_dev is not None:
+                    tf = time.perf_counter()
+                    kc = np.asarray(res["kcount"])
+                    kmax = int(kc.max()) if kc.size else 0
+                    K = min(EB, _pow2(max(kmax, 1)))
+                    res["cap"] = {k: np.asarray(
+                        jax.device_get(v[..., :K]))
+                        for k, v in cap_dev.items()}
+                    res["cap"]["kcount"] = kc
+                    stats.fetch_s += time.perf_counter() - tf
                 from ..utils.stats import stats as _metrics
                 _metrics().inc("tpu_kernel_runs")
                 _metrics().inc("tpu_edges_traversed",
@@ -462,16 +511,19 @@ class TpuRuntime:
         d2v_arr = _d2v(host)
         etype_ids = {et: store.catalog.get_edge(space, et).edge_type
                      for et, _ in block_keys}
+        K = cap["src"].shape[-1]
+        slot = np.arange(K, dtype=np.int32)
         frames = []
         for h in range(steps):
             srcs, dsts, edges = [], [], []
             for bi, (et, dirn) in enumerate(block_keys):
                 hb = host.blocks[(et, dirn)]
-                keep = cap["keep"][:, h, bi, :]
-                # nonzero is row-major: part order, then slot order — per
-                # (part, src) the slots are contiguous ascending eidx, so
-                # the concat order below is already (src-stable) CSR order
-                sel_p, sel_j = np.nonzero(keep)
+                kc = cap["kcount"][:, h, bi]        # (P,)
+                # nonzero is row-major: part order, then slot order — the
+                # device compaction is stable, so per (part, src) the
+                # kept slots stay contiguous ascending eidx and the
+                # concat order below is already (src-stable) CSR order
+                sel_p, sel_j = np.nonzero(slot[None, :] < kc[:, None])
                 if sel_p.size == 0:
                     continue
                 ss = cap["src"][sel_p, h, bi, sel_j].astype(np.int64)
@@ -561,10 +613,14 @@ class TpuRuntime:
         d2v_arr = _d2v(host)
         etype_ids = {et: store.catalog.get_edge(space, et).edge_type
                      for et, _ in block_keys}
-        keep = cap["keep"]                  # (P, nb, EB)
+        kcount = cap["kcount"]              # (P, nb); arrays (P, nb, K)
+        K = cap["src"].shape[-1]
+        slot = np.arange(K, dtype=np.int32)
         for bi, (et, dirn) in enumerate(block_keys):
             hb = host.blocks[(et, dirn)]
-            sel_p, sel_j = np.nonzero(keep[:, bi, :])
+            # kept entries are a device-compacted prefix per part row
+            sel_p, sel_j = np.nonzero(slot[None, :]
+                                      < kcount[:, bi][:, None])
             if sel_p.size == 0:
                 continue
             ss = cap["src"][sel_p, bi, sel_j].astype(np.int64)

@@ -275,3 +275,28 @@ def test_drop_releases_corpus(eng):
     eng._run('INSERT EDGE follows(note) VALUES 7->8:("x")')
     assert 'ft_note' not in sd.ft_data
     assert st.ft_listener.target('fts', 'ft_note') is None
+
+
+def test_unindexed_text_conjunct_order_independent(eng):
+    """An indexed text conjunct plans the scan regardless of conjunct
+    order; the unindexed one evaluates residually."""
+    eng._run('CREATE TAG multi(name string, nick string)')
+    eng._run('CREATE FULLTEXT TAG INDEX ft_mname ON multi(name)')
+    eng._run('INSERT VERTEX multi(name, nick) VALUES '
+             '20:("anna", "ann"), 21:("arnold", "arny"), 22:("bo", "b")')
+    for q in ['LOOKUP ON multi WHERE PREFIX(multi.nick, "a") AND '
+              'PREFIX(multi.name, "a") YIELD multi.name AS n',
+              'LOOKUP ON multi WHERE PREFIX(multi.name, "a") AND '
+              'PREFIX(multi.nick, "a") YIELD multi.name AS n']:
+        assert names(eng, q) == ['anna', 'arnold'], q
+
+
+def test_bad_regexp_errors_in_both_placements(eng):
+    s2 = eng.new_session()
+    eng.execute(s2, 'USE fts')
+    for q in ['LOOKUP ON player WHERE REGEXP(player.name, "(") '
+              'YIELD player.name',
+              'LOOKUP ON player WHERE PREFIX(player.name, "B") AND '
+              'REGEXP(player.name, "(") YIELD player.name']:
+        bad = eng.execute(s2, q)
+        assert bad.error is not None and 'REGEXP' in bad.error, q

@@ -492,3 +492,46 @@ def test_match_multi_etype_prop_pred_hybrid(rt):
         assert rs.error is None, rs.error
         out.append(sorted(map(repr, rs.data.rows)))
     assert out[0] == out[1]
+
+
+def test_serve_while_repin_stress(rt):
+    """Systematic epoch-fencing check (SURVEY §5 race detection): query
+    threads traverse while a writer mutates the store (each write bumps
+    the epoch and forces a re-pin).  Every result must be internally
+    consistent — a traversal may serve the pre- or post-write snapshot,
+    but never a torn mix, and the final settled result must equal the
+    host oracle."""
+    import threading
+
+    st = random_store(31)
+    errs = []
+    baseline = len(rt.traverse(st, "g", [3], ["knows"], "out", 2)[0])
+
+    def writer():
+        for i in range(12):
+            st.insert_edge("g", 3, "knows", 200 + i, 0,
+                           {"w": 5, "f": .5, "tag": "zz"})
+
+    def reader():
+        try:
+            prev = baseline
+            for _ in range(10):
+                rows, _ = rt.traverse(st, "g", [3], ["knows"], "out", 2)
+                # monotone: writer only ADDS edges reachable from the
+                # seed, so a consistent snapshot can never shrink
+                assert len(rows) >= prev, (len(rows), prev)
+                prev = len(rows)
+        except Exception as ex:  # noqa: BLE001
+            errs.append(ex)
+
+    ts = [threading.Thread(target=writer)] + \
+        [threading.Thread(target=reader) for _ in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert not errs, errs
+    # settled: device result equals host oracle exactly
+    rows, _ = rt.traverse(st, "g", [3], ["knows"], "out", 2)
+    got = sorted(norm_edge(e) for (_, e, _) in rows)
+    assert got == host_go(st, "g", [3], ["knows"], "out", 2)
