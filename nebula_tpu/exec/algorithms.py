@@ -189,6 +189,163 @@ def find_path_host(node, qctx: QueryContext, ectx: ExecutionContext) -> DataSet:
     return DataSet([col], rows)
 
 
+def _subgraph_assemble(node, starts_vertices, frontier0, steps,
+                       edges_of, vertex_of, yield_spec) -> DataSet:
+    """The GET SUBGRAPH BFS replay, defined ONCE for both drivers (host
+    `_neighbors` scans and device hop frames) so their row-identity
+    contract cannot drift: frontier discovery order, cross-level
+    seen-edge dedup, the final round of edges from the last level back
+    into the visited set, and per-level row assembly.
+
+    edges_of(u, step) yields (Edge, w) with any edge filter already
+    applied; u/w are hashable node handles (vids on the host driver,
+    dense ids on the device driver); edges_of must be callable for
+    step == steps (the final round)."""
+    visited = set(frontier0)
+    frontier = list(frontier0)
+    level_vertices: List[List[Any]] = [starts_vertices]
+    level_edges: List[List[Edge]] = []
+    seen_edges: Set = set()
+
+    for step in range(steps):
+        nxt, nxt_seen, edges_here = [], set(), []
+        for u in frontier:
+            for e, w in edges_of(u, step):
+                if e.key() in seen_edges:
+                    continue
+                seen_edges.add(e.key())
+                edges_here.append(e)
+                if w not in visited:
+                    visited.add(w)
+                    if w not in nxt_seen:
+                        nxt_seen.add(w)
+                        nxt.append(w)
+        level_edges.append(edges_here)
+        frontier = nxt
+        level_vertices.append([vertex_of(w) for w in nxt])
+        if not frontier:
+            break
+
+    # final round (reference behavior): edges from the last-level
+    # vertices back into the subgraph
+    edges_final: List[Edge] = []
+    for u in frontier:
+        for e, w in edges_of(u, steps):
+            if e.key() in seen_edges:
+                continue
+            if w in visited:
+                seen_edges.add(e.key())
+                edges_final.append(e)
+    if edges_final:
+        if len(level_edges) >= steps:
+            level_edges.append(edges_final)
+        else:
+            level_edges[-1].extend(edges_final)
+
+    cols = node.col_names
+    rows = []
+    n_levels = max(len(level_vertices), len(level_edges))
+    for i in range(n_levels):
+        vs = level_vertices[i] if i < len(level_vertices) else []
+        es = level_edges[i] if i < len(level_edges) else []
+        if not vs and not es:
+            continue
+        rows.append([vs if spec == "vertices" else es
+                     for spec in yield_spec])
+    return DataSet(list(cols), rows)
+
+
+def subgraph_device(node, qctx: QueryContext,
+                    ectx: ExecutionContext) -> Optional[DataSet]:
+    """GET SUBGRAPH on the device plane (SURVEY §2 row 23 SubgraphExecutor).
+
+    One batched `traverse_hops` expansion to steps+1 captures every
+    hop's edge frame; _subgraph_assemble then replays the shared BFS
+    over the frames — per-source CSR edge order matches the host
+    get_neighbors iteration (HopFrame contract), so rows are
+    byte-identical to the host path.  Returns None to take the host
+    path (no runtime / flag off / mixed per-etype directions /
+    non-devicable store)."""
+    rt = getattr(qctx, "tpu_runtime", None)
+    if rt is None:
+        return None
+    from ..utils.config import get_config
+    if not get_config().get("tpu_match_device"):
+        return None
+    a = node.args
+    space = a["space"]
+    if node.input_vars:
+        a = dict(a)
+        a["__input_var"] = node.input_vars[0]
+    starts = _vids_from(a, "vids", "src_ref", ectx)
+    steps = a["steps"]
+    if not starts or steps < 1:
+        return None
+    filt = a.get("filter")
+
+    specs: List[Tuple[str, str]] = []
+    for e in a.get("out_edges") or []:
+        specs.append((e, "out"))
+    for e in a.get("in_edges") or []:
+        specs.append((e, "in"))
+    for e in a.get("both_edges") or []:
+        specs.append((e, "both"))
+    dirs = {d for _, d in specs}
+    if len(dirs) != 1:
+        return None          # mixed per-etype directions: host path
+    direction = dirs.pop()
+    etypes = [e for e, _ in specs]
+
+    store = qctx.store
+    try:
+        sd = store.space(space)
+        sd.dense_id
+    except AttributeError:
+        return None
+
+    from ..tpu.device import TpuUnavailable
+    from ..tpu.exprjit import CannotCompile, compilable
+    try:
+        import jax
+        _rt_errors = (jax.errors.JaxRuntimeError,)
+    except (ImportError, AttributeError):
+        _rt_errors = ()
+    dev_pred = filt if (filt is not None
+                        and compilable(filt, etypes)) else None
+    try:
+        frames, stats = rt.traverse_hops(store, space, starts, etypes,
+                                         direction, steps + 1,
+                                         edge_filter=dev_pred)
+    except (CannotCompile, TpuUnavailable) + _rt_errors as ex:
+        qctx.last_tpu_fallback = f"{type(ex).__name__}: {ex}"
+        return None
+    qctx.last_tpu_stats = stats
+    host_check = filt is not None and dev_pred is None
+
+    def edge_ok(e: Edge) -> bool:
+        if not host_check:
+            return True
+        rc = RowContext(qctx, space,
+                        {"_src": e.src, "_edge": e, "_dst": e.dst})
+        return to_bool3(filt.eval(rc)) is True
+
+    mk_vertex = make_vertex_fn(qctx, space, a.get("with_prop"))
+    dense0 = [sd.dense_id(v) for v in starts]
+
+    def edges_of(u, step):
+        fr = frames[step]
+        for idx in fr.out_edges(u):
+            e = fr.edges[idx]
+            if edge_ok(e):
+                yield e, int(fr.dst[idx])
+
+    return _subgraph_assemble(
+        node, [mk_vertex(s) for s in starts],
+        [d for d in dense0 if d >= 0], steps, edges_of,
+        lambda w: mk_vertex(sd.vid_of_dense(w)),
+        a.get("yield") or ["vertices", "edges"])
+
+
 def subgraph_host(node, qctx: QueryContext, ectx: ExecutionContext) -> DataSet:
     a = node.args
     space = a["space"]
@@ -209,72 +366,13 @@ def subgraph_host(node, qctx: QueryContext, ectx: ExecutionContext) -> DataSet:
         specs.append((e, "both"))
     etype_ids = {e: cat.get_edge(space, e).edge_type for e, _ in specs}
 
-    def mk_vertex(vid):
-        if a.get("with_prop"):
-            v = qctx.build_vertex(space, vid)
-            return v if v is not None else Vertex(vid)
-        return Vertex(vid)
+    mk_vertex = make_vertex_fn(qctx, space, a.get("with_prop"))
 
-    visited: Set = {hashable_key(s) for s in starts}
-    frontier = list(starts)
-    level_vertices: List[List[Any]] = [[mk_vertex(s) for s in starts]]
-    level_edges: List[List[Edge]] = []
-    seen_edges: Set = set()
-
-    for step in range(steps):
-        nxt, nxt_seen = [], set()
-        edges_here: List[Edge] = []
-        for u in frontier:
-            for et, d in specs:
-                for e, w in _neighbors(qctx, space, u, [et], d,
-                                       {et: etype_ids[et]}, filt):
-                    if e.key() in seen_edges:
-                        continue
-                    seen_edges.add(e.key())
-                    edges_here.append(e)
-                    kw = hashable_key(w)
-                    if kw not in visited:
-                        visited.add(kw)
-                        if kw not in nxt_seen:
-                            nxt_seen.add(kw)
-                            nxt.append(w)
-        level_edges.append(edges_here)
-        frontier = nxt
-        level_vertices.append([mk_vertex(v) for v in nxt])
-        if not frontier:
-            break
-
-    # final round: edges among the last-level vertices (reference behavior:
-    # the subgraph includes edges between step-N vertices)
-    edges_final: List[Edge] = []
-    last_set = {hashable_key(v) for lvl in level_vertices for v in
-                [x.vid for x in lvl]}
-    for u in frontier:
+    def edges_of(u, step):
         for et, d in specs:
-            for e, w in _neighbors(qctx, space, u, [et], d,
-                                   {et: etype_ids[et]}, filt):
-                if e.key() in seen_edges:
-                    continue
-                if hashable_key(w) in last_set:
-                    seen_edges.add(e.key())
-                    edges_final.append(e)
-    if edges_final:
-        if len(level_edges) >= steps:
-            level_edges.append(edges_final)
-        else:
-            level_edges[-1].extend(edges_final)
+            yield from _neighbors(qctx, space, u, [et], d,
+                                  {et: etype_ids[et]}, filt)
 
-    yield_spec = a.get("yield") or ["vertices", "edges"]
-    cols = node.col_names
-    rows = []
-    n_levels = max(len(level_vertices), len(level_edges))
-    for i in range(n_levels):
-        vs = level_vertices[i] if i < len(level_vertices) else []
-        es = level_edges[i] if i < len(level_edges) else []
-        if not vs and not es:
-            continue
-        row = []
-        for spec in yield_spec:
-            row.append(vs if spec == "vertices" else es)
-        rows.append(row)
-    return DataSet(list(cols), rows)
+    return _subgraph_assemble(
+        node, [mk_vertex(s) for s in starts], list(starts), steps,
+        edges_of, mk_vertex, a.get("yield") or ["vertices", "edges"])

@@ -968,7 +968,10 @@ def _find_path(node, qctx, ectx, space):
 
 @executor("Subgraph")
 def _subgraph(node, qctx, ectx, space):
-    from .algorithms import subgraph_host
+    from .algorithms import subgraph_device, subgraph_host
+    ds = subgraph_device(node, qctx, ectx)
+    if ds is not None:
+        return ds
     return subgraph_host(node, qctx, ectx)
 
 

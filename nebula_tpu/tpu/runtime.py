@@ -312,13 +312,22 @@ class TpuRuntime:
                 # truncated it) — jump 4x per round instead of 2x
                 F = min(F * 4, self.max_cap)
                 esc = True
+            if esc:
+                # drop the failed rung's device capture buffers BEFORE
+                # the larger rung runs — holding both nearly doubles
+                # peak HBM and can fail a retry that would converge
+                cap_dev = None
             if not esc:
                 stats.f_cap, stats.e_cap = F, EB
                 if self._buckets.get(bkey) != (F, EB):
                     self._buckets[bkey] = (F, EB)
+                    # bound by evicting oldest entries — a wholesale
+                    # clear() would also wipe the persistent cache file
+                    # on the next save, re-exposing every converged
+                    # query shape to the recompile ladder
+                    while len(self._buckets) > 512:
+                        self._buckets.pop(next(iter(self._buckets)))
                     self._save_buckets()
-                if len(self._buckets) > 512:
-                    self._buckets.clear()
                 stats.hop_edges = [int(x)
                                    for x in res["hop_edges"].sum(axis=0)]
                 if cap_dev is not None:

@@ -535,3 +535,45 @@ def test_serve_while_repin_stress(rt):
     rows, _ = rt.traverse(st, "g", [3], ["knows"], "out", 2)
     got = sorted(norm_edge(e) for (_, e, _) in rows)
     assert got == host_go(st, "g", [3], ["knows"], "out", 2)
+
+
+SUBGRAPH_QS = [
+    'GET SUBGRAPH 2 STEPS FROM 3 YIELD VERTICES AS v, EDGES AS e',
+    'GET SUBGRAPH 3 STEPS FROM 3, 17 BOTH knows YIELD VERTICES AS v, '
+    'EDGES AS e',
+    'GET SUBGRAPH 2 STEPS FROM 5 OUT knows YIELD VERTICES AS v, EDGES AS e',
+    'GET SUBGRAPH 2 STEPS FROM 5 IN knows YIELD VERTICES AS v, EDGES AS e',
+    'GET SUBGRAPH WITH PROP 2 STEPS FROM 3 OUT knows YIELD VERTICES AS v, '
+    'EDGES AS e',
+    'GET SUBGRAPH 2 STEPS FROM 3 OUT knows WHERE knows.w > 30 '
+    'YIELD VERTICES AS v, EDGES AS e',
+    'GET SUBGRAPH 1 STEPS FROM 44 YIELD EDGES AS e',
+]
+
+
+@pytest.mark.parametrize("q", SUBGRAPH_QS)
+def test_subgraph_device_parity(rt, q):
+    """GET SUBGRAPH rides the device hop-frame plane with rows
+    byte-identical (including intra-cell list order) to the host BFS."""
+    st = random_store(41)
+    out = []
+    for tpu_rt in (None, rt):
+        eng = QueryEngine(st, tpu_runtime=tpu_rt)
+        s = eng.new_session()
+        eng.execute(s, "USE g")
+        rs = eng.execute(s, q)
+        assert rs.error is None, f"{q} -> {rs.error}"
+        out.append([[repr(c) for c in row] for row in rs.data.rows])
+    assert out[0] == out[1], q
+
+
+def test_subgraph_device_engages(rt):
+    st = random_store(42)
+    eng = QueryEngine(st, tpu_runtime=rt)
+    s = eng.new_session()
+    eng.execute(s, "USE g")
+    rs = eng.execute(s, 'GET SUBGRAPH 2 STEPS FROM 3 OUT knows '
+                        'YIELD VERTICES AS v, EDGES AS e')
+    assert rs.error is None
+    assert eng.qctx.last_tpu_stats is not None
+    assert eng.qctx.last_tpu_stats.edges_traversed() > 0
