@@ -189,6 +189,19 @@ def find_path_host(node, qctx: QueryContext, ectx: ExecutionContext) -> DataSet:
     return DataSet([col], rows)
 
 
+def _subgraph_specs(a) -> List[Tuple[str, str]]:
+    """(etype, direction) pairs from the plan args — ONE decoder for
+    both subgraph drivers so they can never disagree on the edge set."""
+    specs: List[Tuple[str, str]] = []
+    for e in a.get("out_edges") or []:
+        specs.append((e, "out"))
+    for e in a.get("in_edges") or []:
+        specs.append((e, "in"))
+    for e in a.get("both_edges") or []:
+        specs.append((e, "both"))
+    return specs
+
+
 def _subgraph_assemble(node, starts_vertices, frontier0, steps,
                        edges_of, vertex_of, yield_spec) -> DataSet:
     """The GET SUBGRAPH BFS replay, defined ONCE for both drivers (host
@@ -283,13 +296,7 @@ def subgraph_device(node, qctx: QueryContext,
         return None
     filt = a.get("filter")
 
-    specs: List[Tuple[str, str]] = []
-    for e in a.get("out_edges") or []:
-        specs.append((e, "out"))
-    for e in a.get("in_edges") or []:
-        specs.append((e, "in"))
-    for e in a.get("both_edges") or []:
-        specs.append((e, "both"))
+    specs = _subgraph_specs(a)
     dirs = {d for _, d in specs}
     if len(dirs) != 1:
         return None          # mixed per-etype directions: host path
@@ -305,18 +312,14 @@ def subgraph_device(node, qctx: QueryContext,
 
     from ..tpu.device import TpuUnavailable
     from ..tpu.exprjit import CannotCompile, compilable
-    try:
-        import jax
-        _rt_errors = (jax.errors.JaxRuntimeError,)
-    except (ImportError, AttributeError):
-        _rt_errors = ()
+    from ..tpu.traverse import _JAX_RT_ERRORS
     dev_pred = filt if (filt is not None
                         and compilable(filt, etypes)) else None
     try:
         frames, stats = rt.traverse_hops(store, space, starts, etypes,
                                          direction, steps + 1,
                                          edge_filter=dev_pred)
-    except (CannotCompile, TpuUnavailable) + _rt_errors as ex:
+    except (CannotCompile, TpuUnavailable) + _JAX_RT_ERRORS as ex:
         qctx.last_tpu_fallback = f"{type(ex).__name__}: {ex}"
         return None
     qctx.last_tpu_stats = stats
@@ -357,13 +360,7 @@ def subgraph_host(node, qctx: QueryContext, ectx: ExecutionContext) -> DataSet:
     steps = a["steps"]
     filt = a.get("filter")
 
-    specs: List[Tuple[str, str]] = []   # (etype, direction)
-    for e in a.get("out_edges") or []:
-        specs.append((e, "out"))
-    for e in a.get("in_edges") or []:
-        specs.append((e, "in"))
-    for e in a.get("both_edges") or []:
-        specs.append((e, "both"))
+    specs = _subgraph_specs(a)
     etype_ids = {e: cat.get_edge(space, e).edge_type for e, _ in specs}
 
     mk_vertex = make_vertex_fn(qctx, space, a.get("with_prop"))

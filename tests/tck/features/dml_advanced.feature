@@ -1,0 +1,127 @@
+Feature: UPSERT, conditional UPDATE, OVER *, and write visibility
+
+  Background:
+    Given having executed:
+      """
+      CREATE SPACE dmla(partition_num=4, vid_type=INT64);
+      USE dmla;
+      CREATE TAG p(name string, age int DEFAULT 18);
+      CREATE EDGE knows(w int);
+      CREATE EDGE likes(v int);
+      INSERT VERTEX p(name) VALUES 1:("ann"), 2:("bob");
+      INSERT EDGE knows(w) VALUES 1->2:(5);
+      INSERT EDGE likes(v) VALUES 2->1:(9)
+      """
+
+  Scenario: over star expands every edge type
+    When executing query:
+      """
+      GO FROM 2 OVER * YIELD type(edge) AS t, dst(edge) AS d
+      """
+    Then the result should be, in any order:
+      | t       | d |
+      | "likes" | 1 |
+
+  Scenario: over star reversely sees in-edges of all types
+    When executing query:
+      """
+      GO FROM 1 OVER * REVERSELY YIELD type(edge) AS t, src(edge) AS s
+      """
+    Then the result should be, in any order:
+      | t       | s |
+      | "likes" | 2 |
+
+  Scenario: upsert vertex creates with schema defaults
+    Given having executed:
+      """
+      UPSERT VERTEX ON p 3 SET name = "cat"
+      """
+    When executing query:
+      """
+      FETCH PROP ON p 3 YIELD p.name AS n, p.age AS a
+      """
+    Then the result should be, in any order:
+      | n     | a  |
+      | "cat" | 18 |
+
+  Scenario: conditional update applies when the condition holds
+    When executing query:
+      """
+      UPDATE VERTEX ON p 1 SET age = age + 10 WHEN age == 18 YIELD name AS n, age AS a
+      """
+    Then the result should be, in any order:
+      | n     | a  |
+      | "ann" | 28 |
+
+  Scenario: conditional update skips when the condition fails
+    When executing query:
+      """
+      UPDATE VERTEX ON p 2 SET age = 99 WHEN age == 5 YIELD age AS a
+      """
+    Then the result should be empty
+
+  Scenario: upsert edge creates a dangling edge
+    Given having executed:
+      """
+      UPSERT EDGE ON knows 1->9 SET w = 1
+      """
+    When executing query:
+      """
+      FETCH PROP ON knows 1->9 YIELD knows.w AS w
+      """
+    Then the result should be, in any order:
+      | w |
+      | 1 |
+
+  Scenario: delete edge removes it from traversal immediately
+    Given having executed:
+      """
+      DELETE EDGE knows 1->2
+      """
+    When executing query:
+      """
+      GO FROM 1 OVER knows YIELD dst(edge) AS d
+      """
+    Then the result should be empty
+
+  Scenario: duplicate vid in one insert takes the last row
+    Given having executed:
+      """
+      INSERT VERTEX p(name, age) VALUES 7:("dup", 1), 7:("dup2", 2)
+      """
+    When executing query:
+      """
+      FETCH PROP ON p 7 YIELD p.name AS n, p.age AS a
+      """
+    Then the result should be, in any order:
+      | n      | a |
+      | "dup2" | 2 |
+
+  Scenario: update edge property feeds the next traversal
+    Given having executed:
+      """
+      UPDATE EDGE ON knows 1->2 SET w = w * 10
+      """
+    When executing query:
+      """
+      GO FROM 1 OVER knows YIELD knows.w AS w
+      """
+    Then the result should be, in any order:
+      | w  |
+      | 50 |
+
+  Scenario: delete vertex with edges removes both directions
+    Given having executed:
+      """
+      DELETE VERTEX 2
+      """
+    When executing query:
+      """
+      GO FROM 1 OVER knows YIELD dst(edge) AS d
+      """
+    Then the result should be empty
+    When executing query:
+      """
+      GO FROM 2 OVER likes YIELD dst(edge) AS d
+      """
+    Then the result should be empty

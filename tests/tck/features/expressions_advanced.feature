@@ -1,0 +1,192 @@
+Feature: Advanced expressions, predicates, and aggregates
+
+  Background:
+    Given having executed:
+      """
+      CREATE SPACE xa(partition_num=4, vid_type=INT64);
+      USE xa;
+      CREATE TAG p(g string, v int);
+      CREATE EDGE r(w int);
+      INSERT VERTEX p(g, v) VALUES 1:("a", 1), 2:("a", 3), 3:("b", 5), 4:("b", 5), 5:("c", 7);
+      INSERT EDGE r(w) VALUES 1->2:(10), 2->3:(20), 3->4:(30)
+      """
+
+  Scenario: predicate functions over lists
+    When executing query:
+      """
+      YIELD all(x IN [2, 4, 6] WHERE x % 2 == 0) AS a, any(x IN [] WHERE x > 0) AS b, single(x IN [1, 2, 3] WHERE x > 2) AS c, none(x IN [1, 2] WHERE x > 5) AS d
+      """
+    Then the result should be, in any order:
+      | a    | b     | c    | d    |
+      | true | false | true | true |
+
+  Scenario: predicate functions with null elements
+    When executing query:
+      """
+      YIELD all(x IN [1, null, 3] WHERE x > 0) AS a, any(x IN [null, 2] WHERE x > 1) AS b
+      """
+    Then the result should be, in any order:
+      | a    | b    |
+      | NULL | true |
+
+  Scenario: reduce folds left with seed
+    When executing query:
+      """
+      YIELD reduce(acc = 0, x IN [1, 2, 3] | acc + x) AS r, reduce(a = 1, x IN [2, 3, 4] | a * x) AS p
+      """
+    Then the result should be, in any order:
+      | r | p  |
+      | 6 | 24 |
+
+  Scenario: list slices and out-of-range subscripts
+    When executing query:
+      """
+      YIELD [1, 2, 3, 4, 5][1..3] AS sl, [1, 2, 3][-1] AS last
+      """
+    Then the result should be, in any order:
+      | sl     | last |
+      | [2, 3] | 3    |
+
+  Scenario: comprehension with filter and mapping
+    When executing query:
+      """
+      YIELD [x IN range(1, 10) WHERE x % 3 == 0 | x * x] AS sq
+      """
+    Then the result should be, in any order:
+      | sq          |
+      | [9, 36, 81] |
+
+  Scenario: generic and searched CASE
+    When executing query:
+      """
+      YIELD CASE 3 WHEN 1 THEN "one" WHEN 3 THEN "three" ELSE "other" END AS c1, CASE WHEN false THEN 1 WHEN null THEN 2 ELSE 3 END AS c2
+      """
+    Then the result should be, in any order:
+      | c1      | c2 |
+      | "three" | 3  |
+
+  Scenario: split keeps empty segments
+    When executing query:
+      """
+      YIELD split("a,b,,c", ",") AS parts, substr("hello", 1, 3) AS sub
+      """
+    Then the result should be, in any order:
+      | parts                | sub   |
+      | ["a", "b", "", "c"]  | "ell" |
+
+  Scenario: padding and case-insensitive compare
+    When executing query:
+      """
+      YIELD lpad("7", 3, "0") AS l, rpad("ab", 5, "xy") AS r, strcasecmp("AbC", "abc") AS c
+      """
+    Then the result should be, in any order:
+      | l     | r       | c |
+      | "007" | "abxyx" | 0 |
+
+  Scenario: temporal constructors
+    When executing query:
+      """
+      YIELD timestamp("2020-01-01T00:00:00") AS t, year(date("2024-02-29")) AS y, month(date("2024-02-29")) AS m
+      """
+    Then the result should be, in any order:
+      | t          | y    | m |
+      | 1577836800 | 2024 | 2 |
+
+  Scenario: grouped std and collect_set
+    When executing query:
+      """
+      MATCH (n:p) RETURN n.p.g AS g, std(n.p.v) AS sd, collect_set(n.p.v) AS cs ORDER BY g
+      """
+    Then the result should be, in order:
+      | g   | sd  | cs            |
+      | "a" | 1.0 | toSet([1, 3]) |
+      | "b" | 0.0 | toSet([5])    |
+      | "c" | 0.0 | toSet([7])    |
+
+  Scenario: bitwise aggregates
+    When executing query:
+      """
+      MATCH (n:p) RETURN bit_and(n.p.v) AS ba, bit_or(n.p.v) AS bo, bit_xor(n.p.v) AS bx
+      """
+    Then the result should be, in any order:
+      | ba | bo | bx |
+      | 1  | 7  | 5  |
+
+  Scenario: ungrouped aggregates over empty input produce one row
+    When executing query:
+      """
+      MATCH (n:p) WHERE n.p.v > 100 RETURN count(*) AS c, sum(n.p.v) AS s, collect(n.p.v) AS l
+      """
+    Then the result should be, in any order:
+      | c | s | l  |
+      | 0 | 0 | [] |
+
+  Scenario: grouped aggregates over empty input produce no rows
+    When executing query:
+      """
+      MATCH (n:p) WHERE n.p.v > 100 RETURN n.p.g AS g, count(*) AS c
+      """
+    Then the result should be empty
+
+  Scenario: count distinct and avg
+    When executing query:
+      """
+      MATCH (n:p) RETURN count(DISTINCT n.p.g) AS dg, avg(n.p.v) AS a
+      """
+    Then the result should be, in any order:
+      | dg | a   |
+      | 3  | 4.2 |
+
+  Scenario: piped min max std
+    When executing query:
+      """
+      MATCH (n:p) RETURN n.p.v AS v | YIELD min($-.v) AS mn, max($-.v) AS mx
+      """
+    Then the result should be, in any order:
+      | mn | mx |
+      | 1  | 7  |
+
+  Scenario: exists checks a property
+    When executing query:
+      """
+      MATCH (n:p) WHERE id(n) == 1 RETURN exists(n.p.v) AS hv, exists(n.p.nope) AS hn
+      """
+    Then the result should be, in any order:
+      | hv   | hn    |
+      | true | false |
+
+  Scenario: nested comprehension inside reduce
+    When executing query:
+      """
+      YIELD reduce(acc = 0, x IN [y IN [1, 2, 3, 4] WHERE y % 2 == 0] | acc + x) AS s
+      """
+    Then the result should be, in any order:
+      | s |
+      | 6 |
+
+  Scenario: IN over collected aggregate
+    When executing query:
+      """
+      MATCH (n:p) RETURN collect(n.p.v) AS vs | YIELD 5 IN $-.vs AS has5, 9 IN $-.vs AS has9
+      """
+    Then the result should be, in any order:
+      | has5 | has9  |
+      | true | false |
+
+  Scenario: string to number coercion functions
+    When executing query:
+      """
+      YIELD toInteger("42") AS i, toFloat("2.5") AS f, toBoolean("true") AS b, toInteger("nope") AS bad
+      """
+    Then the result should be, in any order:
+      | i  | f   | b    | bad  |
+      | 42 | 2.5 | true | NULL |
+
+  Scenario: edge property arithmetic through pipe
+    When executing query:
+      """
+      GO FROM 1 OVER r YIELD r.w AS w | YIELD $-.w * 2 + 1 AS x
+      """
+    Then the result should be, in any order:
+      | x  |
+      | 21 |

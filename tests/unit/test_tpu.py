@@ -548,6 +548,10 @@ SUBGRAPH_QS = [
     'GET SUBGRAPH 2 STEPS FROM 3 OUT knows WHERE knows.w > 30 '
     'YIELD VERTICES AS v, EDGES AS e',
     'GET SUBGRAPH 1 STEPS FROM 44 YIELD EDGES AS e',
+    # non-compilable predicate: frames come back unfiltered and the
+    # shared assembler's edge_ok host re-check prunes during replay
+    'GET SUBGRAPH 2 STEPS FROM 3 OUT knows WHERE knows.tag CONTAINS "a" '
+    'YIELD VERTICES AS v, EDGES AS e',
 ]
 
 
