@@ -155,38 +155,146 @@ def find_path_host(node, qctx: QueryContext, ectx: ExecutionContext) -> DataSet:
                     for (vc, ec) in all_paths_to(d, kd):
                         rows.append([path_of(vc, ec)])
     else:
-        noloop = kind == "noloop"
-        tracker = getattr(ectx, "tracker", None)
-        pending = 0
-        for s in srcs:
-            stack: List[Tuple[Any, List[Any], List[Edge], Set]] = [
-                (s, [s], [], set())]
-            while stack:
-                cur, vchain, echain, eseen = stack.pop()
-                if len(echain) >= upto:
-                    continue
-                for e, w in _neighbors(qctx, space, cur, etypes, direction,
-                                       etype_ids, filt):
-                    ek = e.key()
-                    if ek in eseen:
-                        continue
-                    if noloop and any(hashable_key(w) == hashable_key(v)
-                                      for v in vchain):
-                        continue
-                    nvc, nec = vchain + [w], echain + [e]
-                    if hashable_key(w) in dst_set:
-                        rows.append([path_of(nvc, nec)])
-                    stack.append((w, nvc, nec, eseen | {ek}))
-                    # ALL PATHS is the worst allocator in the engine:
-                    # charge the search state as it grows, not after
-                    pending += 96 * (len(nvc) + len(eseen))
-                    if tracker is not None and pending > (1 << 20):
-                        tracker.charge(pending)
-                        pending = 0
-        if tracker is not None and pending:
-            tracker.charge(pending)
+        def neighbors_of(cur, depth):
+            for e, w in _neighbors(qctx, space, cur, etypes, direction,
+                                   etype_ids, filt):
+                yield e, w, w
+
+        rows.extend(_path_dfs(
+            srcs, lambda s: s, upto, neighbors_of, dst_set,
+            kind == "noloop", path_of, getattr(ectx, "tracker", None)))
     sort_path_rows(rows)
     return DataSet([col], rows)
+
+
+def _device_frames(qctx, space: str, starts, etypes, direction: str,
+                   hops: int, filt: Optional[Expr]):
+    """Shared device-driver gate for frame-replay executors (subgraph /
+    all-paths): runtime + flag checks, dense-store probe, compilable
+    split, the batched `traverse_hops` expansion with fallback-cause
+    recording, and the host re-check closure for non-compilable
+    filters.  -> (frames, edge_ok, sd) or None (take the host path)."""
+    rt = getattr(qctx, "tpu_runtime", None)
+    if rt is None:
+        return None
+    from ..utils.config import get_config
+    if not get_config().get("tpu_match_device"):
+        return None
+    store = qctx.store
+    try:
+        sd = store.space(space)
+        sd.dense_id
+    except AttributeError:
+        return None
+    from ..tpu.device import TpuUnavailable
+    from ..tpu.exprjit import CannotCompile, compilable
+    from ..tpu.traverse import _JAX_RT_ERRORS
+    dev_pred = filt if (filt is not None
+                        and compilable(filt, etypes)) else None
+    try:
+        frames, stats = rt.traverse_hops(store, space, starts, etypes,
+                                         direction, hops,
+                                         edge_filter=dev_pred)
+    except (CannotCompile, TpuUnavailable) + _JAX_RT_ERRORS as ex:
+        qctx.last_tpu_fallback = f"{type(ex).__name__}: {ex}"
+        return None
+    qctx.last_tpu_stats = stats
+    host_check = filt is not None and dev_pred is None
+
+    def edge_ok(e: Edge) -> bool:
+        if not host_check:
+            return True
+        rc = RowContext(qctx, space,
+                        {"_src": e.src, "_edge": e, "_dst": e.dst})
+        return to_bool3(filt.eval(rc)) is True
+
+    return frames, edge_ok, sd
+
+
+def _path_dfs(srcs, src_handle, upto, neighbors_of, dst_set, noloop,
+              path_of, tracker) -> List[List[Any]]:
+    """The ALL/NOLOOP PATH DFS, defined ONCE for both drivers (host
+    `_neighbors` scans and device hop frames): stack order, per-path
+    edge dedup, NOLOOP vertex check, dst-set row emission, and memory
+    charging.  neighbors_of(handle, depth) yields (Edge, next_handle,
+    w_vid) with any edge filter already applied."""
+    rows: List[List[Any]] = []
+    pending = 0
+    for s in srcs:
+        h0 = src_handle(s)
+        if h0 is None:
+            continue
+        stack: List[Tuple[Any, List[Any], List[Edge], Set]] = [
+            (h0, [s], [], set())]
+        while stack:
+            cur, vchain, echain, eseen = stack.pop()
+            if len(echain) >= upto:
+                continue
+            for e, nh, w in neighbors_of(cur, len(echain)):
+                ek = e.key()
+                if ek in eseen:
+                    continue
+                if noloop and any(hashable_key(w) == hashable_key(v)
+                                  for v in vchain):
+                    continue
+                nvc, nec = vchain + [w], echain + [e]
+                if hashable_key(w) in dst_set:
+                    rows.append([path_of(nvc, nec)])
+                stack.append((nh, nvc, nec, eseen | {ek}))
+                # ALL PATHS is the worst allocator in the engine:
+                # charge the search state as it grows, not after
+                pending += 96 * (len(nvc) + len(eseen))
+                if tracker is not None and pending > (1 << 20):
+                    tracker.charge(pending)
+                    pending = 0
+    if tracker is not None and pending:
+        tracker.charge(pending)
+    return rows
+
+
+def find_path_device(node, qctx: QueryContext,
+                     ectx: ExecutionContext) -> Optional[DataSet]:
+    """FIND ALL/NOLOOP PATH on the device plane (SURVEY §2 row 23
+    AllPathsExecutor).
+
+    One batched `traverse_hops` to `upto` captures each depth's edge
+    frame (the device frontier keeps walk-reachable vertices: no global
+    visited set in capture mode, so frame d holds every edge a
+    depth-d walk can take); _path_dfs then replays the shared DFS over
+    the in-memory frames instead of per-vertex storage scans.  Returns
+    None to take the host path."""
+    a = node.args
+    if a["kind"] == "shortest" or a["upto"] < 1:
+        return None
+    space = a["space"]
+    if node.input_vars:
+        a = dict(a)
+        a["__input_var"] = node.input_vars[0]
+    srcs = _vids_from(a, "src_vids", "src_ref", ectx)
+    dsts = _vids_from(a, "dst_vids", "dst_ref", ectx)
+    if not srcs or not dsts:
+        return None
+    got = _device_frames(qctx, space, srcs, a["edge_types"],
+                         a["direction"], a["upto"], a.get("filter"))
+    if got is None:
+        return None
+    frames, edge_ok, sd = got
+
+    def neighbors_of(cur, depth):
+        fr = frames[depth]
+        for idx in fr.out_edges(cur):
+            e = fr.edges[idx]
+            if edge_ok(e):
+                yield e, int(fr.dst[idx]), e.dst
+
+    mk_vertex = make_vertex_fn(qctx, space, bool(a.get("with_prop")))
+    rows = _path_dfs(
+        srcs, lambda s: (sd.dense_id(s) if sd.dense_id(s) >= 0 else None),
+        a["upto"], neighbors_of, {hashable_key(d) for d in dsts},
+        a["kind"] == "noloop", make_path_fn(mk_vertex),
+        getattr(ectx, "tracker", None))
+    sort_path_rows(rows)
+    return DataSet([node.col_names[0]], rows)
 
 
 def _subgraph_specs(a) -> List[Tuple[str, str]]:
@@ -279,12 +387,6 @@ def subgraph_device(node, qctx: QueryContext,
     byte-identical to the host path.  Returns None to take the host
     path (no runtime / flag off / mixed per-etype directions /
     non-devicable store)."""
-    rt = getattr(qctx, "tpu_runtime", None)
-    if rt is None:
-        return None
-    from ..utils.config import get_config
-    if not get_config().get("tpu_match_device"):
-        return None
     a = node.args
     space = a["space"]
     if node.input_vars:
@@ -303,35 +405,11 @@ def subgraph_device(node, qctx: QueryContext,
     direction = dirs.pop()
     etypes = [e for e, _ in specs]
 
-    store = qctx.store
-    try:
-        sd = store.space(space)
-        sd.dense_id
-    except AttributeError:
+    got = _device_frames(qctx, space, starts, etypes, direction,
+                         steps + 1, filt)
+    if got is None:
         return None
-
-    from ..tpu.device import TpuUnavailable
-    from ..tpu.exprjit import CannotCompile, compilable
-    from ..tpu.traverse import _JAX_RT_ERRORS
-    dev_pred = filt if (filt is not None
-                        and compilable(filt, etypes)) else None
-    try:
-        frames, stats = rt.traverse_hops(store, space, starts, etypes,
-                                         direction, steps + 1,
-                                         edge_filter=dev_pred)
-    except (CannotCompile, TpuUnavailable) + _JAX_RT_ERRORS as ex:
-        qctx.last_tpu_fallback = f"{type(ex).__name__}: {ex}"
-        return None
-    qctx.last_tpu_stats = stats
-    host_check = filt is not None and dev_pred is None
-
-    def edge_ok(e: Edge) -> bool:
-        if not host_check:
-            return True
-        rc = RowContext(qctx, space,
-                        {"_src": e.src, "_edge": e, "_dst": e.dst})
-        return to_bool3(filt.eval(rc)) is True
-
+    frames, edge_ok, sd = got
     mk_vertex = make_vertex_fn(qctx, space, a.get("with_prop"))
     dense0 = [sd.dense_id(v) for v in starts]
 

@@ -949,7 +949,7 @@ def _resolve_vid_list(a, key_vids, key_ref, ectx) -> List[Any]:
 
 @executor("FindPath")
 def _find_path(node, qctx, ectx, space):
-    from .algorithms import find_path_host
+    from .algorithms import find_path_device, find_path_host
     rt = getattr(qctx, "tpu_runtime", None)
     a = node.args
     if rt is not None and a["kind"] == "shortest" \
@@ -963,6 +963,10 @@ def _find_path(node, qctx, ectx, space):
             # device can't serve this space/config; host has identical
             # semantics — record the cause rather than swallow it
             qctx.last_tpu_fallback = f"{type(ex).__name__}: {ex}"
+    if a["kind"] in ("all", "noloop"):
+        ds = find_path_device(node, qctx, ectx)
+        if ds is not None:
+            return ds
     return find_path_host(node, qctx, ectx)
 
 

@@ -581,3 +581,41 @@ def test_subgraph_device_engages(rt):
     assert rs.error is None
     assert eng.qctx.last_tpu_stats is not None
     assert eng.qctx.last_tpu_stats.edges_traversed() > 0
+
+
+PATH_QS = [
+    'FIND ALL PATH FROM 3 TO 44 OVER knows UPTO 3 STEPS YIELD path AS p',
+    'FIND ALL PATH FROM 3, 17 TO 44, 5 OVER knows UPTO 4 STEPS '
+    'YIELD path AS p',
+    'FIND NOLOOP PATH FROM 3 TO 44 OVER knows UPTO 4 STEPS YIELD path AS p',
+    'FIND ALL PATH WITH PROP FROM 3 TO 44 OVER knows UPTO 3 STEPS '
+    'YIELD path AS p',
+    'FIND ALL PATH FROM 3 TO 3 OVER knows UPTO 3 STEPS YIELD path AS p',
+]
+
+
+@pytest.mark.parametrize("q", PATH_QS)
+def test_find_path_device_parity(rt, q):
+    """FIND ALL/NOLOOP PATH rides the device hop-frame plane with rows
+    identical to the host DFS."""
+    st = random_store(51)
+    out = []
+    for tpu_rt in (None, rt):
+        eng = QueryEngine(st, tpu_runtime=tpu_rt)
+        s = eng.new_session()
+        eng.execute(s, "USE g")
+        rs = eng.execute(s, q)
+        assert rs.error is None, f"{q} -> {rs.error}"
+        out.append([[repr(c) for c in row] for row in rs.data.rows])
+    assert out[0] == out[1], q
+
+
+def test_find_path_device_engages(rt):
+    st = random_store(52)
+    eng = QueryEngine(st, tpu_runtime=rt)
+    s = eng.new_session()
+    eng.execute(s, "USE g")
+    rs = eng.execute(s, 'FIND ALL PATH FROM 3 TO 44 OVER knows '
+                        'UPTO 3 STEPS YIELD path AS p')
+    assert rs.error is None
+    assert eng.qctx.last_tpu_stats is not None
