@@ -74,6 +74,9 @@ class DistributedStore:
         self.meta = meta
         self.sc = sc or StorageClient(meta)
         self._catalog_proxy = CatalogProxy(meta)
+        # space → (epoch, vid_to_dense, dense_to_vid) from the last CSR
+        # export; serves _SpaceView.dense_id for the device drivers
+        self._dense_cache: Dict[str, Any] = {}
 
     @property
     def catalog(self):
@@ -320,6 +323,59 @@ class DistributedStore:
                     for p in pids},
             "storage.rebuild_fulltext"))
 
+    # ---- device plane: bulk CSR export (the north-star storage
+    # addition; SURVEY §2 row 12 + BASELINE.json) ----
+
+    def build_csr_snapshot(self, space: str):
+        """Assemble a CsrSnapshot for the WHOLE space from per-part
+        `storage.export_part` bulk exports — the cluster analog of
+        build_snapshot over a local SpaceData.  The graphd's TpuRuntime
+        pins the result; writes bump part epochs, and the runtime's
+        epoch probe triggers a re-export (epoch-based re-pin, SURVEY
+        §5).
+
+        Per-part exports are taken under each leader's lock but NOT
+        atomically across parts — the same read consistency as the
+        reference's per-partition storage reads."""
+        from ..graphstore.csr import build_snapshot
+        from ..graphstore.store import SpaceData
+
+        desc = self.catalog.get_space(space)
+        sd = SpaceData(desc)
+        # epoch BEFORE the export: a write racing the per-part fan-out
+        # bumps some leader's epoch past this value, so the runtime's
+        # next probe re-exports (stamping the post-export epoch instead
+        # would let a snapshot claim data it missed, forever)
+        epoch_before = self.stats(space)["epoch"]
+        pids = self.sc.all_parts(space)
+        for pid, payload in self.sc.fanout(
+                space, {p: {} for p in pids}, "storage.export_part"):
+            st = from_wire(payload)
+            p = sd.parts[pid]
+            p.vertices = st["vertices"]
+            p.out_edges = st["out_edges"]
+            p.in_edges = st["in_edges"]
+            sd.part_counts[pid] = st["part_count"]
+            sd.install_dense(st["dense"])
+        sd.epoch = epoch_before
+
+        class _Shim:
+            """Duck-typed store for build_snapshot: catalog + one space."""
+
+            def __init__(self, catalog, sdata):
+                self.catalog = catalog
+                self._sd = sdata
+
+            def space(self, _name):
+                return self._sd
+
+        snap = build_snapshot(_Shim(self.meta.catalog, sd), space)
+        # the space view serves dense-id lookups from this export (the
+        # device data plane's vid dictionary)
+        self._dense_cache[space] = (sd.epoch, sd.vid_to_dense,
+                                    sd.dense_to_vid)
+        return snap
+
     def stats(self, space: str) -> Dict[str, Any]:
         pids = self.sc.all_parts(space)
         per = dict(self.sc.fanout(space, {p: {} for p in pids},
@@ -352,3 +408,22 @@ class _SpaceView:
     @property
     def epoch(self) -> int:
         return self._ds.stats(self.name)["epoch"]
+
+    # -- device-plane vid dictionary (filled by build_csr_snapshot; the
+    # runtime always pins BEFORE resolving seeds, so queries see the
+    # mapping of the snapshot they execute against) --
+
+    def dense_id(self, vid: Any, create: bool = False) -> int:
+        cache = self._ds._dense_cache.get(self.name)
+        if cache is None:
+            return -1
+        return cache[1].get(vid, -1)
+
+    def vid_of_dense(self, dense: int) -> Any:
+        cache = self._ds._dense_cache.get(self.name)
+        if cache is None:
+            return None
+        d2v = cache[2]
+        if 0 <= dense < len(d2v):
+            return d2v[dense]
+        return None

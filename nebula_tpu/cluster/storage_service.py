@@ -501,22 +501,9 @@ class StorageService:
 
     def rpc_export_part(self, p):
         """Bulk CSR export of one part — the north-star storage addition
-        (the device plane pins partitions from these; BASELINE.json)."""
-        sd = self.store.space(p["space"])
+        (the device plane pins partitions from these; BASELINE.json).
+        Same payload vocabulary as the raft snapshot/checkpoint
+        (GraphStore.part_state_payload) so the formats cannot drift."""
         self._leader_part(p["space"], p["part"])
-        with sd.lock:
-            part = sd.parts[p["part"]]
-            return _pk_part(part, sd)
-
-
-def _pk_part(part, sd):
-    payload = {
-        "part_id": part.part_id,
-        "vertices": part.vertices,
-        "out_edges": part.out_edges,
-        "in_edges": part.in_edges,
-        "part_count": sd.part_counts[part.part_id],
-        "vid_to_dense": {v: d for v, d in sd.vid_to_dense.items()
-                         if d % sd.num_parts == part.part_id},
-    }
-    return to_wire(payload)
+        return to_wire(self.store.part_state_payload(p["space"],
+                                                     p["part"]))

@@ -952,16 +952,16 @@ def _find_path(node, qctx, ectx, space):
     from .algorithms import find_path_device, find_path_host
     rt = getattr(qctx, "tpu_runtime", None)
     a = node.args
-    if rt is not None and a["kind"] == "shortest" \
-            and a.get("filter") is None:
+    if rt is not None and a["kind"] == "shortest":
         from ..tpu.device import TpuUnavailable
+        from ..tpu.exprjit import CannotCompile
         from ..tpu.paths import find_shortest_device
         from ..tpu.traverse import _JAX_RT_ERRORS
         try:
             return find_shortest_device(node, qctx, ectx)
-        except (TpuUnavailable,) + _JAX_RT_ERRORS as ex:
-            # device can't serve this space/config; host has identical
-            # semantics — record the cause rather than swallow it
+        except (CannotCompile, TpuUnavailable) + _JAX_RT_ERRORS as ex:
+            # device can't serve this space/config/filter; host has
+            # identical semantics — record the cause, don't swallow it
             qctx.last_tpu_fallback = f"{type(ex).__name__}: {ex}"
     if a["kind"] in ("all", "noloop"):
         ds = find_path_device(node, qctx, ectx)

@@ -118,6 +118,16 @@ class SpaceData:
             return self.dense_to_vid[dense]
         return None
 
+    def install_dense(self, mapping: Dict[Any, int]):
+        """Merge a part's dense-id slice (part-state install / CSR
+        export assembly — one merge loop for every consumer)."""
+        for v, d in mapping.items():
+            self.vid_to_dense[v] = d
+            need = d + 1 - len(self.dense_to_vid)
+            if need > 0:
+                self.dense_to_vid.extend([None] * need)
+            self.dense_to_vid[d] = v
+
 
 class StoreError(Exception):
     pass
@@ -683,17 +693,16 @@ class GraphStore:
 
     # ---- part state snapshot (raft snapshot + checkpoint payload) ----
 
-    def export_part_state(self, space: str, pid: int) -> bytes:
-        """Serialize one partition's full state (raft snapshot_cb /
-        checkpoint file payload).  Includes the part's slice of the
-        dense-id dictionary so replay-free restore keeps device ids
-        stable.  Wire-JSON encoded: the payload crosses RPC as a raft
-        snapshot, so it must never be pickle."""
-        from ..core import wire
+    def part_state_payload(self, space: str, pid: int) -> Dict[str, Any]:
+        """One partition's full state as a plain dict — THE part-state
+        vocabulary, shared by the raft snapshot/checkpoint encoder
+        (export_part_state) and the device-plane bulk CSR export RPC
+        (storage_service.rpc_export_part): a field added here reaches
+        both, so the formats cannot drift."""
         sd = self.space(space)
         with sd.lock:
             p = sd.parts[pid]
-            return wire.dumps({
+            return {
                 "vertices": p.vertices,
                 "out_edges": p.out_edges,
                 "in_edges": p.in_edges,
@@ -701,7 +710,16 @@ class GraphStore:
                 "dense": {v: d for v, d in sd.vid_to_dense.items()
                           if d % sd.num_parts == pid},
                 "chains": p.pending_chains,
-            })
+            }
+
+    def export_part_state(self, space: str, pid: int) -> bytes:
+        """Serialize one partition's full state (raft snapshot_cb /
+        checkpoint file payload).  Includes the part's slice of the
+        dense-id dictionary so replay-free restore keeps device ids
+        stable.  Wire-JSON encoded: the payload crosses RPC as a raft
+        snapshot, so it must never be pickle."""
+        from ..core import wire
+        return wire.dumps(self.part_state_payload(space, pid))
 
     def install_part_state(self, space: str, pid: int, data: bytes):
         from ..core import wire
@@ -714,12 +732,7 @@ class GraphStore:
             p.in_edges = st["in_edges"]
             p.pending_chains = st.get("chains", {})
             sd.part_counts[pid] = st["part_count"]
-            for v, d in st["dense"].items():
-                sd.vid_to_dense[v] = d
-                need = d + 1 - len(sd.dense_to_vid)
-                if need > 0:
-                    sd.dense_to_vid.extend([None] * need)
-                sd.dense_to_vid[d] = v
+            sd.install_dense(st["dense"])
             sd.epoch += 1
         # indexes are derived state: rebuild this part's slices
         for d in self.catalog.indexes(space):

@@ -43,9 +43,13 @@ def _visit_new(dist, fr, level: int, P: int):
 
 
 def build_bfs_fn(mesh, P: int, F: int, EB: int, max_steps: int,
-                 n_blocks: int, vmax: int):
+                 n_blocks: int, vmax: int, pred=None, pred_cols=()):
     """Sharded BFS program: (blocks_data, frontier) →
-    {dist (P, Vmax), ovf_* flags, hop_edges (P, steps)}."""
+    {dist (P, Vmax), ovf_* flags, hop_edges (P, steps)}.
+
+    pred/pred_cols: optional compiled edge predicate (exprjit) — a
+    filtered FIND SHORTEST PATH only traverses mask-passing edges,
+    matching the host oracle's per-expansion filter."""
 
     def kernel(blocks_data, frontier):
         fr = frontier[0]
@@ -67,7 +71,15 @@ def build_bfs_fn(mesh, P: int, F: int, EB: int, max_steps: int,
                     b["indptr"][0], b["nbr"][0], b["rank"][0], fr, F, EB, P)
                 ovf_e = ovf_e | ovf
                 edges = edges + total
-                cands.append(jnp.where(ve, dst, -1))
+                if pred is not None:
+                    cols = {"_rank": rk}
+                    for name in pred_cols:
+                        if name != "_rank":
+                            cols[name] = b["props"][name][0][eidx]
+                    keep = pred(cols) & ve
+                else:
+                    keep = ve
+                cands.append(jnp.where(keep, dst, -1))
             hop_edges.append(edges)
             cand = jnp.concatenate(cands) if len(cands) > 1 else cands[0]
             u, _ = _sorted_unique(cand)
@@ -90,8 +102,21 @@ def build_bfs_fn(mesh, P: int, F: int, EB: int, max_steps: int,
 
 
 def build_bfs_fn_local(P: int, F: int, EB: int, max_steps: int,
-                       n_blocks: int, vmax: int):
+                       n_blocks: int, vmax: int, pred=None, pred_cols=()):
     """Single-chip variant (vmap over parts, transpose as all_to_all)."""
+
+    def one_part(block, f):
+        src, dst, rk, eidx, ve, total, ovf = _expand_block(
+            block["indptr"], block["nbr"], block["rank"], f, F, EB, P)
+        if pred is not None:
+            cols = {"_rank": rk}
+            for name in pred_cols:
+                if name != "_rank":
+                    cols[name] = block["props"][name][eidx]
+            keep = pred(cols) & ve
+        else:
+            keep = ve
+        return keep, dst, total, ovf
 
     def fn(blocks_data, frontier):
         fr = frontier                  # (P, F)
@@ -109,13 +134,15 @@ def build_bfs_fn_local(P: int, F: int, EB: int, max_steps: int,
             edges = jnp.zeros((P,), jnp.int32)
             for bi in range(n_blocks):
                 b = blocks_data[bi]
-                src, dst, rk, eidx, ve, total, ovf = jax.vmap(
-                    lambda ip, nb, rkk, f: _expand_block(
-                        ip, nb, rkk, f, F, EB, P)
-                )(b["indptr"], b["nbr"], b["rank"], fr)
+                keep, dst, total, ovf = jax.vmap(
+                    lambda ip, nb, rkk, prp, f: one_part(
+                        {"indptr": ip, "nbr": nb, "rank": rkk,
+                         "props": prp}, f)
+                )(b["indptr"], b["nbr"], b["rank"],
+                  b.get("props", {}), fr)
                 ovf_e = ovf_e | ovf
                 edges = edges + total
-                cands.append(jnp.where(ve, dst, -1))
+                cands.append(jnp.where(keep, dst, -1))
             hop_edges.append(edges)
             cand = (jnp.concatenate(cands, axis=1)
                     if len(cands) > 1 else cands[0])

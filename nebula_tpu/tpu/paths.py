@@ -23,11 +23,34 @@ def find_shortest_device(node, qctx, ectx) -> DataSet:
     etypes = a["edge_types"]
     direction = a["direction"]
     upto = a["upto"]
+    filt = a.get("filter")
+    if filt is not None:
+        # the mask compiler resolves props against ONE block's schema;
+        # multi-etype prop predicates (or non-vectorizable ones) must
+        # fall back BEFORE touching the kernel (same gate as the other
+        # device drivers) — raises CannotCompile for the executor
+        from .exprjit import CannotCompile, compilable
+        if not compilable(filt, etypes):
+            raise CannotCompile(
+                "shortest-path filter does not vectorize "
+                "over these edge types")
     rt = qctx.tpu_runtime
     store = qctx.store
     cat = store.catalog
     etype_ids = {e: cat.get_edge(space, e).edge_type for e in etypes}
     sd = store.space(space)
+
+    def edge_ok(e: Edge) -> bool:
+        """Host-side re-check during path reconstruction — the device
+        mask pruned reachability, but predecessors are rediscovered by
+        reverse scans which must apply the same filter."""
+        if filt is None:
+            return True
+        from ..core.expr import to_bool3
+        from ..exec.context import RowContext
+        rc = RowContext(qctx, space,
+                        {"_src": e.src, "_edge": e, "_dst": e.dst})
+        return to_bool3(filt.eval(rc)) is True
 
     if node.input_vars:
         a = dict(a)
@@ -43,7 +66,8 @@ def find_shortest_device(node, qctx, ectx) -> DataSet:
     rows: List[List[Any]] = []
 
     for s in srcs:
-        dist, stats = rt.bfs(store, space, [s], etypes, direction, upto)
+        dist, stats = rt.bfs(store, space, [s], etypes, direction, upto,
+                             edge_filter=filt)
         P = dist.shape[0]
 
         def depth_of(vid) -> int:
@@ -59,8 +83,10 @@ def find_shortest_device(node, qctx, ectx) -> DataSet:
                 if depth_of(u) == lv - 1:
                     eid = etype_ids[et]
                     # reverse-sd → forward edge sign (see bfs.py parity)
-                    yield u, Edge(u, v, et, rank, dict(props),
-                                  etype=eid if sdir < 0 else -eid)
+                    e = Edge(u, v, et, rank, dict(props),
+                             etype=eid if sdir < 0 else -eid)
+                    if edge_ok(e):
+                        yield u, e
 
         memo: Dict[Any, List[Tuple[List[Any], List[Edge]]]] = {}
 

@@ -619,3 +619,49 @@ def test_find_path_device_engages(rt):
                         'UPTO 3 STEPS YIELD path AS p')
     assert rs.error is None
     assert eng.qctx.last_tpu_stats is not None
+
+
+SHORTEST_FILTER_QS = [
+    'FIND SHORTEST PATH FROM 3 TO 44 OVER knows WHERE knows.w > 20 '
+    'UPTO 5 STEPS YIELD path AS p',
+    'FIND SHORTEST PATH FROM 3 TO 44, 17 OVER knows WHERE knows.w >= 10 '
+    'UPTO 4 STEPS YIELD path AS p',
+    # non-compilable predicate → CannotCompile → host fallback, same rows
+    'FIND SHORTEST PATH FROM 3 TO 44 OVER knows '
+    'WHERE knows.tag CONTAINS "a" UPTO 5 STEPS YIELD path AS p',
+]
+
+
+@pytest.mark.parametrize("q", SHORTEST_FILTER_QS)
+def test_filtered_shortest_path_device_parity(rt, q):
+    """FIND SHORTEST PATH WHERE <pred> runs the masked device BFS (or
+    falls back for non-compilable predicates) with host-identical
+    rows."""
+    st = random_store(61)
+    out = []
+    for tpu_rt in (None, rt):
+        eng = QueryEngine(st, tpu_runtime=tpu_rt)
+        s = eng.new_session()
+        eng.execute(s, "USE g")
+        rs = eng.execute(s, q)
+        assert rs.error is None, f"{q} -> {rs.error}"
+        out.append([[repr(c) for c in row] for row in rs.data.rows])
+    assert out[0] == out[1], q
+
+
+def test_filtered_shortest_path_multi_etype_falls_back(rt):
+    """A prop predicate over multiple edge types can't compile one mask
+    (exprjit forbids it); filtered shortest path must fall back to the
+    host with identical rows, not KeyError."""
+    st = random_store(62, extra_edge_type=True)
+    q = ('FIND SHORTEST PATH FROM 3 TO 44 OVER knows, likes '
+         'WHERE knows.w > 1 UPTO 4 STEPS YIELD path AS p')
+    out = []
+    for tpu_rt in (None, rt):
+        eng = QueryEngine(st, tpu_runtime=tpu_rt)
+        s = eng.new_session()
+        eng.execute(s, "USE g")
+        rs = eng.execute(s, q)
+        assert rs.error is None, f"{q} -> {rs.error}"
+        out.append([[repr(c) for c in row] for row in rs.data.rows])
+    assert out[0] == out[1]
