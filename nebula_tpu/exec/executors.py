@@ -1344,6 +1344,14 @@ def _show(node, qctx, ectx, space):
         sp = a.get("space")
         if not sp:
             raise ExecError("no space selected")
+        meta = getattr(qctx.store, "meta", None)
+        if meta is not None:
+            # cluster: real replica sets from the meta part map
+            # (replicas[0] is the placement leader)
+            return DataSet(["Partition Id", "Leader", "Peers"],
+                           [[pid, reps[0] if reps else "", list(reps)]
+                            for pid, reps in
+                            enumerate(meta.parts_of(sp))])
         sd = qctx.store.space(sp)
         return DataSet(["Partition Id", "Leader", "Peers"],
                        [[p, "127.0.0.1", ["127.0.0.1"]]

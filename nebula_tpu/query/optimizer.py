@@ -365,6 +365,35 @@ def merge_adjacent_limits(node: PlanNode) -> Optional[PlanNode]:
     return node
 
 
+# NOTE deliberately ABSENT: a Sort(Sort(x)) → Sort(x) collapse.  The
+# engine's Sort is stable, so the inner sort is observable through ties
+# of the outer keys — collapsing changes row order for equal keys.
+
+@register_rule
+def eliminate_limit_zero(node: PlanNode) -> Optional[PlanNode]:
+    """Limit(count=0) → empty result: the subtree can't contribute rows
+    (reference: the degenerate-plan prune family)."""
+    if node.kind != "Limit" or not node.deps:
+        return None
+    if node.args.get("count") == 0:
+        return PlanNode("Project", deps=[],
+                        col_names=list(node.col_names),
+                        args={"empty": True})
+    return None
+
+
+@register_rule
+def eliminate_noop_limit(node: PlanNode) -> Optional[PlanNode]:
+    """Limit(offset=0, count=unbounded) → child."""
+    if node.kind != "Limit" or not node.deps:
+        return None
+    cnt = node.args.get("count", -1)
+    off = node.args.get("offset", 0) or 0
+    if off == 0 and (cnt is None or cnt < 0):
+        return node.dep()
+    return None
+
+
 @register_rule
 def collapse_dedup(node: PlanNode) -> Optional[PlanNode]:
     """Dedup(Dedup(x)) → Dedup(x)."""

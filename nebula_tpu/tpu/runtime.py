@@ -294,8 +294,24 @@ class TpuRuntime:
             frontier = jax.device_put(fr_np, target)
             t0 = time.perf_counter()
             stats.put_s = t0 - tp
-            res = fn(*inputs_fn(F, EB), frontier)
-            jax.block_until_ready(res)
+            from ..utils.config import get_config
+            prof_dir = get_config().get("tpu_profiler_dir")
+            if prof_dir:
+                # device-plane tracing (SURVEY §5): one xplane trace per
+                # kernel run, viewable in TensorBoard/XProf.  Each run
+                # gets its own subdir — jax names dumps by wall-clock
+                # second, so two runs inside one second would otherwise
+                # overwrite each other.
+                self._prof_seq = getattr(self, "_prof_seq", 0) + 1
+                import os as _os
+                run_dir = _os.path.join(str(prof_dir),
+                                        f"run{self._prof_seq:06d}")
+                with jax.profiler.trace(run_dir):
+                    res = fn(*inputs_fn(F, EB), frontier)
+                    jax.block_until_ready(res)
+            else:
+                res = fn(*inputs_fn(F, EB), frontier)
+                jax.block_until_ready(res)
             t1 = time.perf_counter()
             stats.device_s = t1 - t0
             # two-phase fetch: capture arrays stay on device while the

@@ -165,5 +165,8 @@ define_flag("host_hb_expire_secs", 10.0,
             "heartbeat age after which a host reads as dead")
 define_flag("tpu_match_device", True,
             "run MATCH Traverse expansion on the device plane")
+define_flag("tpu_profiler_dir", "",
+            "when set, wrap every device kernel run in a jax.profiler "
+            "trace written under this directory (SURVEY §5 tracing)")
 define_flag("snapshot_dir", "./nebula_snapshots",
             "where CREATE SNAPSHOT checkpoints land")

@@ -426,3 +426,26 @@ def test_cluster_device_sees_writes(tmp_path):
         assert sorted(x[0] for x in r.data.rows) == [2, 3]
     finally:
         c.stop()
+
+
+def test_show_parts_cluster_real_map(tmp_path):
+    """SHOW PARTS in cluster mode reports the meta part map's replica
+    sets, not the standalone stub."""
+    c = LocalCluster(n_meta=1, n_storage=2, n_graph=1,
+                     data_dir=str(tmp_path))
+    try:
+        cl = c.client()
+        r = cl.execute("CREATE SPACE sp(partition_num=4, "
+                       "replica_factor=1, vid_type=INT64)")
+        assert r.error is None, r.error
+        c.reconcile_storage()
+        assert cl.execute("USE sp").error is None
+        r = cl.execute("SHOW PARTS")
+        assert r.error is None, r.error
+        assert len(r.data.rows) == 4
+        addrs = {s.my_addr for s in c.storageds}
+        for pid, leader, peers in r.data.rows:
+            assert leader in addrs
+            assert set(peers) <= addrs
+    finally:
+        c.stop()

@@ -288,3 +288,31 @@ def test_push_limit_down_fulltext_scan(eng):
     while scan.kind != "FulltextIndexScan":
         scan = scan.dep()
     assert scan.args.get("limit") == 2
+
+
+def test_adjacent_sorts_not_collapsed(eng):
+    """Sort is stable, so an inner ORDER BY is observable through ties
+    of the outer keys — the optimizer must NOT collapse Sort(Sort)."""
+    q = ('GO FROM "a" OVER knows YIELD dst(edge) AS d '
+         '| ORDER BY $-.d DESC | ORDER BY $-.d ASC')
+    p = plan_of(eng, q)
+    assert p.root.kind_tree().count("Sort") == 2
+
+
+def test_eliminate_limit_zero(eng):
+    q = 'GO FROM "a" OVER knows YIELD dst(edge) AS d | LIMIT 0'
+    p = plan_of(eng, q)
+    from nebula_tpu.query.plan import walk_plan
+    assert any(n.args.get("empty") for n in walk_plan(p.root)
+               if n.kind == "Project")
+    r = eng.execute(eng._sess, q)
+    assert r.ok and r.data.rows == []
+
+
+def test_eliminate_noop_limit(eng):
+    from nebula_tpu.query.plan import PlanNode
+    base = PlanNode("Start", col_names=["x"])
+    lm = PlanNode("Limit", deps=[base], col_names=["x"],
+                  args={"offset": 0, "count": -1})
+    p = optimize(ExecutionPlan(lm, "t"))
+    assert p.root.kind_tree() == ["Start"]
