@@ -24,10 +24,48 @@ class TpuUnavailable(Exception):
     to the host execution path."""
 
 
+def init_multihost():
+    """Join a multi-host jax runtime (ICI within a slice, DCN across
+    hosts) when the standard coordination env is present — after this,
+    `jax.devices()` is GLOBAL and make_mesh lays partitions across every
+    host's chips; `shard_map` collectives then ride ICI/DCN exactly as
+    on one host (SURVEY §5 distributed-comm: data plane = XLA
+    collectives, never RPC).
+
+    Controlled by NEBULA_COORDINATOR (host:port of process 0) plus
+    NEBULA_NUM_PROCESSES / NEBULA_PROCESS_ID; no-op when unset,
+    idempotent when called twice."""
+    import os
+    coord = os.environ.get("NEBULA_COORDINATOR")
+    if not coord:
+        return False
+    missing = [k for k in ("NEBULA_NUM_PROCESSES", "NEBULA_PROCESS_ID")
+               if k not in os.environ]
+    if missing:
+        raise TpuUnavailable(
+            f"NEBULA_COORDINATOR is set but {missing} are not — "
+            f"multi-host init needs all three")
+    if getattr(init_multihost, "_done", False):
+        return True
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coord,
+            num_processes=int(os.environ["NEBULA_NUM_PROCESSES"]),
+            process_id=int(os.environ["NEBULA_PROCESS_ID"]))
+    except RuntimeError as ex:
+        # already initialized (by the embedding app or a racing thread):
+        # the runtime is up, which is all we need
+        if "already" not in str(ex).lower():
+            raise
+    init_multihost._done = True
+    return True
+
+
 def make_mesh(n_devices: Optional[int] = None, devices=None) -> Mesh:
     """A 1-D 'part' mesh: one graph partition per device slot."""
     explicit = devices is not None
     if devices is None:
+        init_multihost()
         devices = jax.devices()
     if n_devices is None:
         n_devices = len(devices)

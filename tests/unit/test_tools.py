@@ -71,3 +71,51 @@ def test_storage_perf_smoke(capsys):
     assert rc == 0
     out = capsys.readouterr().out
     assert "getNeighbors" in out and "op/s" in out
+
+
+def test_meta_dump_data_dir(tmp_path, capsys):
+    from nebula_tpu.exec import QueryEngine
+    from nebula_tpu.graphstore.store import GraphStore
+    from nebula_tpu.tools import meta_dump
+
+    st = GraphStore(data_dir=str(tmp_path))
+    e = QueryEngine(st)
+    s = e.new_session()
+    for q in ['CREATE SPACE md(partition_num=2, vid_type=INT64)', 'USE md',
+              'CREATE TAG t(name string)', 'CREATE EDGE e(w int)',
+              'CREATE TAG INDEX i_n ON t(name)',
+              'CREATE FULLTEXT TAG INDEX ft_n ON t(name)',
+              'ADD LISTENER ELASTICSEARCH "127.0.0.1:9200"',
+              'CREATE USER reader WITH PASSWORD "x"',
+              'GRANT ROLE USER ON md TO reader']:
+        r = e.execute(s, q)
+        assert r.ok, f"{q} -> {r.error}"
+    st.close()
+
+    assert meta_dump.main(["--data-dir", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    for needle in ["space `md'", "tag t v", "edge e v", "tag index i_n",
+                   "fulltext tag index ft_n", "listener ELASTICSEARCH",
+                   "user `reader'", "md:USER"]:
+        assert needle in out, (needle, out)
+
+
+def test_meta_dump_live_cluster(tmp_path, capsys):
+    from nebula_tpu.cluster.launcher import LocalCluster
+    from nebula_tpu.tools import meta_dump
+
+    c = LocalCluster(n_meta=1, n_storage=2, n_graph=1,
+                     data_dir=str(tmp_path))
+    try:
+        cl = c.client()
+        assert cl.execute("CREATE SPACE lv(partition_num=4, "
+                          "replica_factor=1, vid_type=INT64)").error is None
+        c.reconcile_storage()
+        assert cl.execute("USE lv").error is None
+        assert cl.execute("CREATE TAG n(x int)").error is None
+        assert meta_dump.main(["--addr", c.meta_addrs[0]]) == 0
+        out = capsys.readouterr().out
+        assert "space `lv'" in out and "tag n v" in out \
+            and "part 0:" in out
+    finally:
+        c.stop()
