@@ -190,43 +190,76 @@ class DistributedStore:
         return True
 
     # ---- read ----
+    # Rows are fill_row'd against THIS client's catalog too: the serving
+    # storaged's cache may predate an ALTER ... ADD by one heartbeat,
+    # while the DDL issuer's catalog is refreshed synchronously — the
+    # reader's schema wins (read-side versioned-row upgrade, SURVEY §2
+    # row 9).  Schema versions resolve ONCE per call (_sv_maps), and a
+    # tag/edge the reader's catalog no longer lists is INVISIBLE — the
+    # host path's dropped-schema semantics.
+
+    def _sv_maps(self, space):
+        """-> ({tag: latest}, {etype: latest}) for one read call."""
+        tags = {t.name: t.latest for t in self.catalog.tags(space)}
+        edges = {e.name: e.latest for e in self.catalog.edges(space)}
+        return tags, edges
+
     def get_vertex(self, space: str, vid: Any):
+        from ..graphstore.schema import fill_row
         r = self.sc._call_part(space, self.sc.part_of(space, vid),
                                "storage.get_vertex", {"vid": to_wire(vid)})
         if r is None:
             return None
-        return {t: {k: from_wire(v) for k, v in row.items()}
-                for t, row in r.items()}
+        tag_svs, _ = self._sv_maps(space)
+        out = {t: fill_row(tag_svs[t],
+                           {k: from_wire(v) for k, v in row.items()})
+               for t, row in r.items() if t in tag_svs}
+        return out or None
 
     def get_edge(self, space: str, src: Any, etype: str, dst: Any,
                  rank: int = 0):
+        from ..graphstore.schema import SchemaError, fill_row
         r = self.sc._call_part(space, self.sc.part_of(space, src),
                                "storage.get_edge",
                                {"src": to_wire(src), "etype": etype,
                                 "dst": to_wire(dst), "rank": rank})
         if r is None:
             return None
-        return {k: from_wire(v) for k, v in r.items()}
+        try:
+            sv = self.catalog.get_edge(space, etype).latest
+        except SchemaError:
+            return None          # edge type dropped: rows invisible
+        return fill_row(sv, {k: from_wire(v) for k, v in r.items()})
 
     def scan_vertices(self, space: str, tag: Optional[str] = None,
                       parts: Optional[Iterable[int]] = None):
+        from ..graphstore.schema import fill_row
         pids = list(parts) if parts is not None else self.sc.all_parts(space)
+        tag_svs, _ = self._sv_maps(space)
         for pid, rows in self.sc.fanout(
                 space, {p: {"tag": tag} for p in pids},
                 "storage.scan_vertices"):
             for vid, t, row in rows:
-                yield from_wire(vid), t, \
-                    {k: from_wire(v) for k, v in row.items()}
+                sv = tag_svs.get(t)
+                if sv is None:
+                    continue     # tag dropped: rows invisible
+                yield from_wire(vid), t, fill_row(
+                    sv, {k: from_wire(v) for k, v in row.items()})
 
     def scan_edges(self, space: str, etype: Optional[str] = None,
                    parts: Optional[Iterable[int]] = None):
+        from ..graphstore.schema import fill_row
         pids = list(parts) if parts is not None else self.sc.all_parts(space)
+        _, edge_svs = self._sv_maps(space)
         for pid, rows in self.sc.fanout(
                 space, {p: {"etype": etype} for p in pids},
                 "storage.scan_edges"):
             for src, et, rank, dst, row in rows:
+                sv = edge_svs.get(et)
+                if sv is None:
+                    continue     # edge type dropped: rows invisible
                 yield from_wire(src), et, rank, from_wire(dst), \
-                    {k: from_wire(v) for k, v in row.items()}
+                    fill_row(sv, {k: from_wire(v) for k, v in row.items()})
 
     def get_neighbors(self, space: str, vids: List[Any],
                       edge_types: Optional[List[str]] = None,
@@ -236,7 +269,9 @@ class DistributedStore:
         (input vid order, etype name, then (rank, neighbor)).  A pushed
         edge_filter / limit ships to storaged as nGQL text and executes
         there — only surviving rows cross the RPC (SURVEY §2 row 12)."""
+        from ..graphstore.schema import fill_row
         from .pushdown import filter_to_wire
+        _, edge_svs = self._sv_maps(space)
         ftext = filter_to_wire(edge_filter)
         by_part = self.sc.split_by_part(space, vids)
         results = dict(self.sc.fanout(
@@ -251,9 +286,13 @@ class DistributedStore:
         for pid, rows in results.items():
             for (src, et, rank, other, props, sd) in rows:
                 src_v = from_wire(src)
+                sv = edge_svs.get(et)
+                if sv is None:
+                    continue     # edge type dropped: rows invisible
                 per_vid.setdefault(repr(src_v), []).append(
                     (src_v, et, rank, from_wire(other),
-                     {k: from_wire(v) for k, v in props.items()}, sd))
+                     fill_row(sv, {k: from_wire(v)
+                                   for k, v in props.items()}), sd))
         for vid in vids:
             for row in per_vid.get(repr(vid), []):
                 yield row

@@ -1254,6 +1254,18 @@ def _describe(node, qctx, ectx, space):
     space_name = a.get("space")
     if not space_name:
         raise ExecError("no space selected")
+    if a["kind"] == "index":
+        d = next((x for x in cat.indexes(space_name)
+                  if x.name == a["name"]), None)
+        if d is None:
+            raise ExecError(f"index `{a['name']}' not found "
+                            f"in space `{space_name}'")
+        schema = (cat.get_edge if d.is_edge else cat.get_tag)(
+            space_name, d.schema_name)
+        return DataSet(
+            ["Field", "Type"],
+            [[f, (p.ptype.value if (p := schema.latest.prop(f))
+                  else "(dropped)")] for f in d.fields])
     get = cat.get_edge if a["kind"] == "edge" else cat.get_tag
     schema = get(space_name, a["name"])
     rows = []

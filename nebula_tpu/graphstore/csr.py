@@ -340,9 +340,21 @@ def _build_block(sd: SpaceData, etype: str, direction: str,
     for pd in prop_defs:
         dt = _col_dtype(pd.ptype)
         fill = np.nan if dt == np.float64 else INT_NULL
+        # rows written before ALTER ... ADD lack the new key: encode the
+        # latest schema's default (read-side fill_row parity — the host
+        # serves the default, so the device column must too), coerced
+        # like insert-time defaults (a geography default is WKT text)
+        absent = fill
+        if pd.has_default:
+            try:
+                from .schema import coerce
+                absent = encode_prop(pd.ptype,
+                                     coerce(pd.ptype, pd.default), pool)
+            except Exception:  # noqa: BLE001 — malformed default:
+                pass           # NULL sentinel; host fill_row degrades too
         if rows:
             coo = np.fromiter(
-                (fill if (v := row.get(pd.name)) is None
+                (absent if (v := row.get(pd.name)) is None
                  else encode_prop(pd.ptype, v, pool) for row in rows),
                 dtype=dt, count=len(rows))
             col = np.where(valid, coo[safe_perm], fill).astype(dt)
@@ -389,6 +401,14 @@ def _build_tag_table(sd: SpaceData, tag: str, sv: SchemaVersion,
             for pd in prop_defs:
                 v = row.get(pd.name)
                 if v is None:
+                    if pd.has_default:   # pre-ALTER row: serve default
+                        try:
+                            from .schema import coerce
+                            props[pd.name][p, li] = encode_prop(
+                                pd.ptype, coerce(pd.ptype, pd.default),
+                                pool)
+                        except Exception:  # noqa: BLE001
+                            pass           # NULL sentinel
                     continue
                 props[pd.name][p, li] = encode_prop(pd.ptype, v, pool)
 

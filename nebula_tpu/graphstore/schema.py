@@ -559,6 +559,29 @@ class IndexDesc:
     fulltext: bool = False
 
 
+def fill_row(sv: SchemaVersion, row: Dict[str, Any]) -> Dict[str, Any]:
+    """Read-side schema upgrade: a row written before ALTER ... ADD is
+    served with the latest version's defaults (or NULL) for the added
+    props — the reference's versioned RowReader fallback (SURVEY §2
+    row 9).  Returns a copy only when something is missing."""
+    missing = [p for p in sv.props if p.name not in row]
+    if not missing:
+        return row
+    out = dict(row)
+    for p in missing:
+        if p.has_default:
+            # coerce exactly like insert-time apply_defaults, so an
+            # upgraded row is type-identical to a fresh one (e.g. a
+            # double default written as int, a geography as WKT text)
+            try:
+                out[p.name] = coerce(p.ptype, p.default)
+            except Exception:  # noqa: BLE001 — malformed default
+                out[p.name] = p.default
+        else:
+            out[p.name] = NULL
+    return out
+
+
 def apply_defaults(sv: SchemaVersion, props: Dict[str, Any],
                    insert_names: Optional[List[str]] = None) -> Dict[str, Any]:
     """Fill defaults / validate nullability for an insert row."""

@@ -28,7 +28,7 @@ from typing import Any, Dict, Iterable, List, Optional, Tuple
 
 from ..core.value import NULL, is_null
 from .schema import (Catalog, EdgeSchema, PropDef, SchemaError, SpaceDesc,
-                     TagSchema, apply_defaults)
+                     TagSchema, apply_defaults, fill_row)
 
 
 def ttl_expired(sv, row: Dict[str, Any], now: float) -> bool:
@@ -836,7 +836,7 @@ class GraphStore:
             except SchemaError:
                 continue            # tag dropped: its rows are invisible
             if not ttl_expired(sv, row, now):
-                out[t] = dict(row)
+                out[t] = dict(fill_row(sv, row))
         return out if out else None
 
     def get_edge(self, space: str, src: Any, etype: str, dst: Any,
@@ -850,7 +850,7 @@ class GraphStore:
         sv = self.catalog.get_edge(space, etype).latest
         if ttl_expired(sv, row, _t.time()):
             return None
-        return dict(row)
+        return dict(fill_row(sv, row))
 
     def scan_vertices(self, space: str, tag: Optional[str] = None,
                       parts: Optional[Iterable[int]] = None):
@@ -867,7 +867,7 @@ class GraphStore:
                         continue    # tag dropped: rows invisible
                     if (tag is None or t == tag) and \
                             not ttl_expired(svs[t], row, now):
-                        yield vid, t, row
+                        yield vid, t, fill_row(svs[t], row)
 
     def scan_edges(self, space: str, etype: Optional[str] = None,
                    parts: Optional[Iterable[int]] = None):
@@ -887,7 +887,7 @@ class GraphStore:
                         continue    # edge type dropped: rows invisible
                     for (rank, dst), row in em.items():
                         if not ttl_expired(sv, row, now):
-                            yield src, et, rank, dst, row
+                            yield src, et, rank, dst, fill_row(sv, row)
 
     # ---- read: getNeighbors (the hot-path op, host oracle form) ----
     def get_neighbors(self, space: str, vids: List[Any],
@@ -933,7 +933,8 @@ class GraphStore:
                         for (rank, dst) in sorted(em, key=_nbr_key):
                             row = em[(rank, dst)]
                             if not ttl_expired(sv, row, now):
-                                yield vid, et, rank, dst, row, 1
+                                yield (vid, et, rank, dst,
+                                       fill_row(sv, row), 1)
             if direction in ("in", "both"):
                 per = p.in_edges.get(vid, {})
                 for et in etypes:
@@ -943,7 +944,8 @@ class GraphStore:
                         for (rank, src) in sorted(em, key=_nbr_key):
                             row = em[(rank, src)]
                             if not ttl_expired(sv, row, now):
-                                yield vid, et, rank, src, row, -1
+                                yield (vid, et, rank, src,
+                                       fill_row(sv, row), -1)
 
     def compact(self, space: str) -> int:
         """Physically purge TTL-expired rows (the compaction-filter GC of

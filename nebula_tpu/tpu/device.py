@@ -42,16 +42,26 @@ def init_multihost():
     missing = [k for k in ("NEBULA_NUM_PROCESSES", "NEBULA_PROCESS_ID")
                if k not in os.environ]
     if missing:
-        raise TpuUnavailable(
+        # a plain config error, NOT TpuUnavailable: the executors treat
+        # TpuUnavailable as the routine host-fallback signal, which
+        # would silently mask a half-configured multi-host deployment
+        raise ValueError(
             f"NEBULA_COORDINATOR is set but {missing} are not — "
             f"multi-host init needs all three")
     if getattr(init_multihost, "_done", False):
         return True
     try:
+        n_proc = int(os.environ["NEBULA_NUM_PROCESSES"])
+        proc_id = int(os.environ["NEBULA_PROCESS_ID"])
+    except ValueError as ex:
+        raise ValueError(
+            f"NEBULA_NUM_PROCESSES / NEBULA_PROCESS_ID must be "
+            f"integers: {ex}") from None
+    try:
         jax.distributed.initialize(
             coordinator_address=coord,
-            num_processes=int(os.environ["NEBULA_NUM_PROCESSES"]),
-            process_id=int(os.environ["NEBULA_PROCESS_ID"]))
+            num_processes=n_proc,
+            process_id=proc_id)
     except RuntimeError as ex:
         # already initialized (by the embedding app or a racing thread):
         # the runtime is up, which is all we need

@@ -1,0 +1,86 @@
+Feature: Schema introspection and evolution
+
+  Background:
+    Given having executed:
+      """
+      CREATE SPACE si(partition_num=2, vid_type=INT64);
+      USE si;
+      CREATE TAG p(name string, age int DEFAULT 18);
+      CREATE EDGE r(w int);
+      CREATE TAG INDEX ip ON p(age)
+      """
+
+  Scenario: show create tag round trips the definition
+    When executing query:
+      """
+      SHOW CREATE TAG p
+      """
+    Then the result should be, in any order:
+      | Tag | Create Tag                                                     |
+      | "p" | "CREATE TAG `p` (`name` string NULL, `age` int64 NULL DEFAULT 18)" |
+
+  Scenario: show create space includes options
+    When executing query:
+      """
+      SHOW CREATE SPACE si
+      """
+    Then the result should be, in any order:
+      | Space | Create Space                                                              |
+      | "si"  | "CREATE SPACE `si` (partition_num = 2, replica_factor = 1, vid_type = INT64)" |
+
+  Scenario: describe index lists the indexed fields
+    When executing query:
+      """
+      DESCRIBE INDEX ip
+      """
+    Then the result should be, in any order:
+      | Field | Type    |
+      | "age" | "int64" |
+
+  Scenario: alter tag add then drop a column
+    Given having executed:
+      """
+      ALTER TAG p ADD (city string)
+      """
+    When executing query:
+      """
+      DESCRIBE TAG p
+      """
+    Then the result should be, in any order:
+      | Field  | Type     | Null  | Default |
+      | "name" | "string" | "YES" | NULL    |
+      | "age"  | "int64"  | "YES" | 18      |
+      | "city" | "string" | "YES" | NULL    |
+    Given having executed:
+      """
+      ALTER TAG p DROP (city)
+      """
+    When executing query:
+      """
+      DESCRIBE TAG p
+      """
+    Then the result should be, in any order:
+      | Field  | Type     | Null  | Default |
+      | "name" | "string" | "YES" | NULL    |
+      | "age"  | "int64"  | "YES" | 18      |
+
+  Scenario: new column applies defaults to pre-existing rows
+    Given having executed:
+      """
+      INSERT VERTEX p(name) VALUES 1:("old");
+      ALTER TAG p ADD (score int DEFAULT 5)
+      """
+    When executing query:
+      """
+      FETCH PROP ON p 1 YIELD p.name AS n, p.score AS s
+      """
+    Then the result should be, in any order:
+      | n     | s |
+      | "old" | 5 |
+
+  Scenario: describe missing index is an error
+    When executing query:
+      """
+      DESCRIBE INDEX nope
+      """
+    Then an ExecutionError should be raised
