@@ -18,7 +18,8 @@ from __future__ import annotations
 from typing import Any, Dict, Iterable, List, Optional
 
 from ..core.wire import from_wire, to_wire
-from ..graphstore.schema import SchemaError, apply_defaults
+from ..graphstore.schema import (SchemaError, apply_defaults,
+                                  fill_row)
 from ..graphstore.store import stable_vid_hash
 from .meta_client import MetaClient
 from .storage_client import StorageClient, StorageError
@@ -205,7 +206,6 @@ class DistributedStore:
         return tags, edges
 
     def get_vertex(self, space: str, vid: Any):
-        from ..graphstore.schema import fill_row
         r = self.sc._call_part(space, self.sc.part_of(space, vid),
                                "storage.get_vertex", {"vid": to_wire(vid)})
         if r is None:
@@ -218,7 +218,6 @@ class DistributedStore:
 
     def get_edge(self, space: str, src: Any, etype: str, dst: Any,
                  rank: int = 0):
-        from ..graphstore.schema import SchemaError, fill_row
         r = self.sc._call_part(space, self.sc.part_of(space, src),
                                "storage.get_edge",
                                {"src": to_wire(src), "etype": etype,
@@ -233,7 +232,6 @@ class DistributedStore:
 
     def scan_vertices(self, space: str, tag: Optional[str] = None,
                       parts: Optional[Iterable[int]] = None):
-        from ..graphstore.schema import fill_row
         pids = list(parts) if parts is not None else self.sc.all_parts(space)
         tag_svs, _ = self._sv_maps(space)
         for pid, rows in self.sc.fanout(
@@ -248,7 +246,6 @@ class DistributedStore:
 
     def scan_edges(self, space: str, etype: Optional[str] = None,
                    parts: Optional[Iterable[int]] = None):
-        from ..graphstore.schema import fill_row
         pids = list(parts) if parts is not None else self.sc.all_parts(space)
         _, edge_svs = self._sv_maps(space)
         for pid, rows in self.sc.fanout(
@@ -269,7 +266,6 @@ class DistributedStore:
         (input vid order, etype name, then (rank, neighbor)).  A pushed
         edge_filter / limit ships to storaged as nGQL text and executes
         there — only surviving rows cross the RPC (SURVEY §2 row 12)."""
-        from ..graphstore.schema import fill_row
         from .pushdown import filter_to_wire
         _, edge_svs = self._sv_maps(space)
         ftext = filter_to_wire(edge_filter)

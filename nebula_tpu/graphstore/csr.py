@@ -83,6 +83,19 @@ def _col_dtype(pt: PropType):
     return np.int64  # ints, bools, strings (codes), temporal (encoded)
 
 
+def _encode_default(pd, pool: StringPool):
+    """Encoded, coerced schema default for pre-ALTER rows (fill_row
+    parity), or None when there is no usable default — shared by the
+    edge-block and tag-table builders."""
+    if not pd.has_default:
+        return None
+    try:
+        from .schema import coerce
+        return encode_prop(pd.ptype, coerce(pd.ptype, pd.default), pool)
+    except Exception:  # noqa: BLE001 — malformed default → NULL, same
+        return None    # degradation as host fill_row
+
+
 def encode_prop(pt: PropType, v: Any, pool: StringPool) -> Any:
     if is_null(v):
         return np.nan if pt in (PropType.FLOAT, PropType.DOUBLE) else INT_NULL
@@ -344,14 +357,8 @@ def _build_block(sd: SpaceData, etype: str, direction: str,
         # latest schema's default (read-side fill_row parity — the host
         # serves the default, so the device column must too), coerced
         # like insert-time defaults (a geography default is WKT text)
-        absent = fill
-        if pd.has_default:
-            try:
-                from .schema import coerce
-                absent = encode_prop(pd.ptype,
-                                     coerce(pd.ptype, pd.default), pool)
-            except Exception:  # noqa: BLE001 — malformed default:
-                pass           # NULL sentinel; host fill_row degrades too
+        a = _encode_default(pd, pool)
+        absent = fill if a is None else a
         if rows:
             coo = np.fromiter(
                 (absent if (v := row.get(pd.name)) is None
@@ -377,11 +384,15 @@ def _build_tag_table(sd: SpaceData, tag: str, sv: SchemaVersion,
     present = np.zeros((P, vmax), bool)
     props: Dict[str, np.ndarray] = {}
     ptypes: Dict[str, PropType] = {}
+    absents: Dict[str, Any] = {}
     for pd in prop_defs:
         dt = _col_dtype(pd.ptype)
         fill = np.nan if dt == np.float64 else INT_NULL
         props[pd.name] = np.full((P, vmax), fill, dt)
         ptypes[pd.name] = pd.ptype
+        # encoded default for pre-ALTER rows, hoisted out of the row
+        # loop (identical for every row); None = leave the NULL fill
+        absents[pd.name] = _encode_default(pd, pool)
 
     import time as _time
 
@@ -401,14 +412,9 @@ def _build_tag_table(sd: SpaceData, tag: str, sv: SchemaVersion,
             for pd in prop_defs:
                 v = row.get(pd.name)
                 if v is None:
-                    if pd.has_default:   # pre-ALTER row: serve default
-                        try:
-                            from .schema import coerce
-                            props[pd.name][p, li] = encode_prop(
-                                pd.ptype, coerce(pd.ptype, pd.default),
-                                pool)
-                        except Exception:  # noqa: BLE001
-                            pass           # NULL sentinel
+                    a = absents[pd.name]   # pre-ALTER row: serve default
+                    if a is not None:
+                        props[pd.name][p, li] = a
                     continue
                 props[pd.name][p, li] = encode_prop(pd.ptype, v, pool)
 

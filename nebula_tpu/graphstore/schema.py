@@ -575,8 +575,9 @@ def fill_row(sv: SchemaVersion, row: Dict[str, Any]) -> Dict[str, Any]:
             # double default written as int, a geography as WKT text)
             try:
                 out[p.name] = coerce(p.ptype, p.default)
-            except Exception:  # noqa: BLE001 — malformed default
-                out[p.name] = p.default
+            except Exception:  # noqa: BLE001 — malformed default:
+                out[p.name] = NULL   # degrade exactly like the device
+                # column encode (csr.py), keeping host/device parity
         else:
             out[p.name] = NULL
     return out

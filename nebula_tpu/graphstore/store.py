@@ -233,11 +233,19 @@ class GraphStore:
 
     def _index_vertex(self, sd, space, vid, tag, old_row, new_row):
         part = sd.part_of(vid)
-        for idx in self._index_list(sd, space, tag, False):
-            if old_row is not None:
-                idx.remove(part, old_row, vid)
-            if new_row is not None:
-                idx.add(part, new_row, vid)
+        idxs = self._index_list(sd, space, tag, False)
+        if idxs:
+            # index keys must match what READS serve: rows stored before
+            # an ALTER ... ADD are keyed with the filled default, same
+            # as fill_row'd scans/rebuilds (else remove() misses)
+            sv = self.catalog.get_tag(space, tag).latest
+            old_f = fill_row(sv, old_row) if old_row is not None else None
+            new_f = fill_row(sv, new_row) if new_row is not None else None
+            for idx in idxs:
+                if old_f is not None:
+                    idx.remove(part, old_f, vid)
+                if new_f is not None:
+                    idx.add(part, new_f, vid)
         self._ft_enqueue(sd, space, tag, False, part, vid, old_row,
                          new_row)
 
@@ -245,11 +253,16 @@ class GraphStore:
                     new_row):
         part = sd.part_of(src)
         ent = (src, rank, dst)
-        for idx in self._index_list(sd, space, etype, True):
-            if old_row is not None:
-                idx.remove(part, old_row, ent)
-            if new_row is not None:
-                idx.add(part, new_row, ent)
+        idxs = self._index_list(sd, space, etype, True)
+        if idxs:
+            sv = self.catalog.get_edge(space, etype).latest
+            old_f = fill_row(sv, old_row) if old_row is not None else None
+            new_f = fill_row(sv, new_row) if new_row is not None else None
+            for idx in idxs:
+                if old_f is not None:
+                    idx.remove(part, old_f, ent)
+                if new_f is not None:
+                    idx.add(part, new_f, ent)
         self._ft_enqueue(sd, space, etype, True, part, ent, old_row,
                          new_row)
 
@@ -394,6 +407,9 @@ class GraphStore:
                 idx.index_id != d.index_id:
             idx = sd.index_data[index_name] = IndexData(
                 d.name, d.fields, d.is_edge, sd.num_parts, d.index_id)
+        sv = (self.catalog.get_edge(space, d.schema_name).latest
+              if d.is_edge else
+              self.catalog.get_tag(space, d.schema_name).latest)
         with sd.lock:
             part_ids = list(parts) if parts is not None \
                 else list(range(sd.num_parts))
@@ -405,11 +421,14 @@ class GraphStore:
                         em = per.get(d.schema_name)
                         if em:
                             for (rank, dst), row in em.items():
-                                idx.add(pid, row, (src, rank, dst))
+                                idx.add(pid, fill_row(sv, row),
+                                        (src, rank, dst))
                 else:
                     for vid, tv in p.vertices.items():
                         if d.schema_name in tv:
-                            idx.add(pid, tv[d.schema_name][1], vid)
+                            idx.add(pid,
+                                    fill_row(sv, tv[d.schema_name][1]),
+                                    vid)
             return sum(len(idx.parts[pid]) for pid in part_ids)
 
     def index_scan(self, space: str, index_name: str, eq_prefix: List[Any],

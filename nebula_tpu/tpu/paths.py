@@ -68,6 +68,7 @@ def find_shortest_device(node, qctx, ectx) -> DataSet:
     for s in srcs:
         dist, stats = rt.bfs(store, space, [s], etypes, direction, upto,
                              edge_filter=filt)
+        qctx.last_tpu_stats = stats      # PROFILE breadcrumb
         P = dist.shape[0]
 
         def depth_of(vid) -> int:

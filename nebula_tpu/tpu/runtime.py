@@ -254,7 +254,8 @@ class TpuRuntime:
         return keys
 
     def _escalate(self, dev: DeviceSnapshot, dense: Sequence[int],
-                  key_fn, build_fn, inputs_fn, stats: "TraverseStats"):
+                  key_fn, build_fn, inputs_fn, stats: "TraverseStats",
+                  min_buckets: Optional[Tuple[int, int]] = None):
         """Shared power-of-two bucket escalation driver for all device
         programs (traverse, bfs): initial frontier layout, jit cache,
         one batched fetch, overflow-driven retry (SURVEY §7 hard-part #1).
@@ -268,6 +269,12 @@ class TpuRuntime:
             cnt[d % P] += 1
         F = max(self.init_f, _pow2(max(cnt)))
         EB = self.init_eb
+        if min_buckets is not None:
+            # caller knows a static bound (e.g. BFS: frontier ≤ vmax,
+            # hop edges ≤ the block's padded Emax) — start there and
+            # never climb the recompile ladder
+            F = min(max(F, min_buckets[0]), self.max_cap)
+            EB = min(max(EB, min_buckets[1]), self.max_cap)
         # cache key includes the frontier-size bucket: one supernode
         # query must not permanently inflate every later small query of
         # the same program to supernode-sized padded kernels
@@ -640,6 +647,15 @@ class TpuRuntime:
                                 len(block_keys), dev.vmax,
                                 pred=pred, pred_cols=pred_cols)
 
+        # BFS buckets are statically bounded: a frontier never exceeds
+        # the per-part vertex count, and one hop's expansion never
+        # exceeds the block's padded edge capacity — start there and
+        # compile exactly once (escalation recompiles cost ~100s each on
+        # a tunneled chip; BFS has no capture arrays, so the memory cost
+        # of full-size buckets is just the transient expansion buffers)
+        f_bound = _pow2(max(dev.vmax, 1))
+        eb_bound = max(_pow2(max(dev.blocks[bk].nbr.shape[-1], 1))
+                       for bk in block_keys)
         res = self._escalate(
             dev, dense,
             key_fn=lambda F, EB: (space, dev.epoch, "bfs",
@@ -647,7 +663,8 @@ class TpuRuntime:
                                   pred_key, tuple(pred_cols)),
             build_fn=build,
             inputs_fn=lambda F, EB: (blocks_data,),
-            stats=stats)
+            stats=stats,
+            min_buckets=(f_bound, eb_bound))
         return res["dist"], stats
 
     # -- host materialization --------------------------------------------

@@ -143,3 +143,23 @@ def test_cluster_lookup_uses_index():
         assert rs.error is None and rs.data.rows == [[1]]
     finally:
         c.stop()
+
+
+def test_index_on_alter_added_default_column(eng):
+    """Rows stored before ALTER ... ADD are indexed under the filled
+    default — the index path and the fill_row'd scan path must return
+    the same rows (review regression)."""
+    eng._run('CREATE TAG q(name string)')
+    eng._run('INSERT VERTEX q(name) VALUES 10:("old1"), 11:("old2")')
+    eng._run('ALTER TAG q ADD (score int DEFAULT 5)')
+    eng._run('INSERT VERTEX q(name, score) VALUES 12:("new", 7)')
+    eng._run('CREATE TAG INDEX iq ON q(score)')
+    eng._run('REBUILD TAG INDEX iq')
+    assert ids(eng, 'LOOKUP ON q WHERE q.score == 5 YIELD id(vertex)') \
+        == [10, 11]
+    assert ids(eng, 'LOOKUP ON q WHERE q.score >= 5 YIELD id(vertex)') \
+        == [10, 11, 12]
+    # incremental maintenance on a pre-ALTER row keys consistently too
+    eng._run('UPDATE VERTEX ON q 10 SET name = "renamed"')
+    assert ids(eng, 'LOOKUP ON q WHERE q.score == 5 YIELD id(vertex)') \
+        == [10, 11]

@@ -665,3 +665,20 @@ def test_filtered_shortest_path_multi_etype_falls_back(rt):
         assert rs.error is None, f"{q} -> {rs.error}"
         out.append([[repr(c) for c in row] for row in rs.data.rows])
     assert out[0] == out[1]
+
+
+def test_bfs_single_compile_at_static_bounds(rt):
+    """BFS buckets derive from static bounds (frontier <= vmax, hop
+    edges <= padded Emax) so even a 1-seed BFS over a larger graph
+    converges with ZERO escalation retries — the recompile ladder is
+    the dominant first-run cost on a tunneled chip."""
+    st = random_store(71, n=600, avg_deg=8)
+    eng = QueryEngine(st, tpu_runtime=rt)
+    s = eng.new_session()
+    eng.execute(s, "USE g")
+    rs = eng.execute(s, 'FIND SHORTEST PATH FROM 3 TO 599 OVER knows '
+                        'UPTO 6 STEPS YIELD path AS p')
+    assert rs.error is None, rs.error
+    stats = eng.qctx.last_tpu_stats
+    assert stats is not None
+    assert stats.retries == 0, f"BFS escalated {stats.retries}x"
