@@ -204,7 +204,14 @@ class Duration:
     __slots__ = ("seconds", "microseconds", "months")
 
     def __init__(self, seconds: int = 0, microseconds: int = 0, months: int = 0):
-        self.seconds, self.microseconds, self.months = seconds, microseconds, months
+        # normalize: microseconds carry into seconds (0 <= us < 1e6), so
+        # arithmetically-equal durations compare/hash equal and repr
+        # stays well-formed after +/- (months are calendar-relative and
+        # never fold into seconds)
+        carry, microseconds = divmod(microseconds, 1_000_000)
+        self.seconds = seconds + carry
+        self.microseconds = microseconds
+        self.months = months
 
     def _key(self):
         return (self.months, self.seconds, self.microseconds)
@@ -567,6 +574,12 @@ def v_add(a: Any, b: Any) -> Any:
         return _date_plus_duration(a, b)
     if isinstance(a, DateTime) and isinstance(b, Duration):
         return _datetime_plus_duration(a, b)
+    if isinstance(a, Duration) and isinstance(b, Duration):
+        return Duration(a.seconds + b.seconds,
+                        a.microseconds + b.microseconds,
+                        a.months + b.months)
+    if isinstance(a, Time) and isinstance(b, Duration):
+        return _time_plus_duration(a, b)
     return NULL_BAD_TYPE
 
 
@@ -578,10 +591,9 @@ def v_sub(a: Any, b: Any) -> Any:
         if isinstance(a, int) and isinstance(b, int):
             return _int_result(r)
         return r
-    if isinstance(a, Date) and isinstance(b, Duration):
-        return _date_plus_duration(a, Duration(-b.seconds, -b.microseconds, -b.months))
-    if isinstance(a, DateTime) and isinstance(b, Duration):
-        return _datetime_plus_duration(a, Duration(-b.seconds, -b.microseconds, -b.months))
+    if isinstance(a, (Date, DateTime, Duration, Time)) \
+            and isinstance(b, Duration):
+        return v_add(a, _neg_duration(b))
     return NULL_BAD_TYPE
 
 
@@ -631,6 +643,24 @@ def v_neg(a: Any) -> Any:
     if isinstance(a, int):
         return _int_result(-a)
     return -a
+
+
+def _neg_duration(d: Duration) -> Duration:
+    return Duration(-d.seconds, -d.microseconds, -d.months)
+
+
+def _time_plus_duration(t: Time, dur: Duration) -> Any:
+    """Time-of-day shifted by a duration, wrapping within 24h; month
+    components don't apply to a bare time (reference semantics)."""
+    if dur.months:
+        return NULL_BAD_TYPE
+    us = ((t.hour * 3600 + t.minute * 60 + t.sec) * 1_000_000
+          + t.microsec + dur.seconds * 1_000_000 + dur.microseconds)
+    us %= 24 * 3600 * 1_000_000
+    sec, microsec = divmod(us, 1_000_000)
+    minute, s = divmod(sec, 60)
+    hour, m = divmod(minute, 60)
+    return Time(int(hour % 24), int(m), int(s), int(microsec))
 
 
 def _date_plus_duration(d: Date, dur: Duration) -> Date:

@@ -190,3 +190,21 @@ Feature: Advanced expressions, predicates, and aggregates
     Then the result should be, in any order:
       | x  |
       | 21 |
+
+  Scenario: temporal arithmetic with durations
+    When executing query:
+      """
+      YIELD datetime("2020-01-01T00:00:00") + duration({days: 1}) AS dt, date("2020-03-01") - duration({months: 1}) AS d, date("2020-01-31") + duration({months: 1}) AS eom
+      """
+    Then the result should be, in any order:
+      | dt                               | d                  | eom                |
+      | datetime("2020-01-02T00:00:00")  | date("2020-02-01") | date("2020-02-29") |
+
+  Scenario: duration and time-of-day arithmetic
+    When executing query:
+      """
+      YIELD duration({hours: 2}) + duration({minutes: 30}) AS a, time("23:30:00") + duration({hours: 1}) AS wrap
+      """
+    Then the result should be, in any order:
+      | a                          | wrap            |
+      | duration({seconds: 9000})  | time("00:30:00") |
