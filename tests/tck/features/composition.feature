@@ -1,0 +1,93 @@
+Feature: Statement composition across planes
+
+  Background:
+    Given having executed:
+      """
+      CREATE SPACE cmp(partition_num=4, vid_type=INT64);
+      USE cmp;
+      CREATE TAG person(name string, age int);
+      CREATE EDGE knows(w int);
+      CREATE FULLTEXT TAG INDEX ftn ON person(name);
+      INSERT VERTEX person(name, age) VALUES 1:("ann", 30), 2:("bob", 25), 3:("annie", 40), 4:("carl", 35);
+      INSERT EDGE knows(w) VALUES 1->2:(5), 2->3:(50), 3->4:(9), 1->3:(80)
+      """
+
+  Scenario: fulltext seeds feed a traversal through a pipe
+    When executing query:
+      """
+      LOOKUP ON person WHERE PREFIX(person.name, "ann") YIELD id(vertex) AS v | GO FROM $-.v OVER knows YIELD dst(edge) AS d
+      """
+    Then the result should be, in any order:
+      | d |
+      | 2 |
+      | 3 |
+      | 4 |
+
+  Scenario: traversal results feed a fetch through a pipe
+    When executing query:
+      """
+      GO FROM 1 OVER knows YIELD dst(edge) AS d | FETCH PROP ON person $-.d YIELD person.name AS n
+      """
+    Then the result should be, in any order:
+      | n       |
+      | "bob"   |
+      | "annie" |
+
+  Scenario: variable assignment bridges two traversals
+    When executing query:
+      """
+      $v = GO FROM 1 OVER knows YIELD dst(edge) AS d; GO FROM $v.d OVER knows YIELD src(edge) AS s, dst(edge) AS d2
+      """
+    Then the result should be, in any order:
+      | s | d2 |
+      | 2 | 3  |
+      | 3 | 4  |
+
+  Scenario: go m to n yields per-step rows with dst props
+    When executing query:
+      """
+      GO 1 TO 2 STEPS FROM 1 OVER knows YIELD dst(edge) AS d, $$.person.age AS a
+      """
+    Then the result should be, in any order:
+      | d | a  |
+      | 2 | 25 |
+      | 3 | 40 |
+      | 3 | 40 |
+      | 4 | 35 |
+
+  Scenario: destination-property filter stays on the host plane
+    When executing query:
+      """
+      GO FROM 1 OVER knows WHERE $$.person.age > 30 YIELD dst(edge) AS d
+      """
+    Then the result should be, in any order:
+      | d |
+      | 3 |
+
+  Scenario: string predicate operators in MATCH
+    When executing query:
+      """
+      MATCH (a:person) WHERE a.person.name STARTS WITH "ann" RETURN a.person.name AS n ORDER BY n
+      """
+    Then the result should be, in order:
+      | n       |
+      | "ann"   |
+      | "annie" |
+
+  Scenario: WITH filters between pattern and aggregate
+    When executing query:
+      """
+      MATCH (a:person)-[:knows]->(b) WITH b.person.age AS ba WHERE ba > 30 RETURN sum(ba) AS s
+      """
+    Then the result should be, in any order:
+      | s   |
+      | 115 |
+
+  Scenario: sample stage bounds piped rows
+    When executing query:
+      """
+      GO FROM 1 OVER knows YIELD dst(edge) AS d | SAMPLE 1 | YIELD count($-.d) AS c
+      """
+    Then the result should be, in any order:
+      | c |
+      | 1 |
