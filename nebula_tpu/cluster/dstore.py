@@ -411,6 +411,25 @@ class DistributedStore:
                                     sd.dense_to_vid)
         return snap
 
+    def stats_detail(self, space: str) -> Dict[str, Dict[str, int]]:
+        """Per-tag / per-edge-type counts aggregated over part leaders
+        (SHOW STATS per-schema rows)."""
+        pids = self.sc.all_parts(space)
+        tags: Dict[str, int] = {}
+        edges: Dict[str, int] = {}
+        vertices = 0
+        for pid, r in self.sc.fanout(
+                space, {p: {"detail": True} for p in pids},
+                "storage.part_stats"):
+            d = r.get("detail") or {}
+            vertices += d.get("vertices", 0)
+            for t, n in (d.get("tags") or {}).items():
+                tags[t] = tags.get(t, 0) + n
+            for et, n in (d.get("edges") or {}).items():
+                edges[et] = edges.get(et, 0) + n
+        return {"tags": tags, "edges": edges, "vertices": vertices,
+                "total_edges": sum(edges.values())}
+
     def stats(self, space: str) -> Dict[str, Any]:
         pids = self.sc.all_parts(space)
         per = dict(self.sc.fanout(space, {p: {} for p in pids},

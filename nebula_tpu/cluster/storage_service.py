@@ -460,11 +460,21 @@ class StorageService:
         return len(ft.values[p["part"]]) if ft is not None else 0
 
     def rpc_part_stats(self, p):
+        if p.get("detail"):
+            # per-schema counts are served authoritatively by the
+            # leader (a lagging follower would under-count); the plain
+            # totals/epoch probe stays follower-readable so device
+            # epoch checks survive a failover window
+            self._leader_part(p["space"], p["part"])
         sd = self.store.space(p["space"])
         pid = p["part"]
         part = sd.parts[pid]
-        return {"vertices": len(part.vertices),
-                "edges": part.edge_count(), "epoch": sd.epoch}
+        out = {"vertices": len(part.vertices),
+               "edges": part.edge_count(), "epoch": sd.epoch}
+        if p.get("detail"):
+            out["detail"] = self.store.stats_detail(p["space"],
+                                                    parts=[pid])
+        return out
 
     def rpc_part_raft_info(self, p):
         """Raft progress of one local part replica — the BALANCE

@@ -1372,10 +1372,13 @@ def _show(node, qctx, ectx, space):
         sp = a.get("space")
         if not sp:
             raise ExecError("no space selected")
-        st = qctx.store.stats(sp)
-        return DataSet(["Type", "Name", "Count"],
-                       [["Space", "vertices", st["vertices"]],
-                        ["Space", "edges", st["edges"]]])
+        det = qctx.store.stats_detail(sp)   # ONE scan/fan-out: the
+        # per-schema rows and the Space totals come from one snapshot
+        rows = [["Tag", t, n] for t, n in sorted(det["tags"].items())]
+        rows += [["Edge", e, n] for e, n in sorted(det["edges"].items())]
+        rows += [["Space", "vertices", det["vertices"]],
+                 ["Space", "edges", det["total_edges"]]]
+        return DataSet(["Type", "Name", "Count"], rows)
     if kind == "sessions":
         cluster = getattr(qctx, "cluster", None)
         if cluster is not None:

@@ -1014,6 +1014,31 @@ class GraphStore:
             "per_part_edges": [p.edge_count() for p in sd.parts],
         }
 
+    def stats_detail(self, space: str,
+                     parts: Optional[Iterable[int]] = None
+                     ) -> Dict[str, Dict[str, int]]:
+        """Per-tag / per-edge-type counts (reference: the STATS job's
+        per-schema rows surfaced by SHOW STATS)."""
+        sd = self.space(space)
+        part_ids = range(sd.num_parts) if parts is None else parts
+        tags: Dict[str, int] = {}
+        edges: Dict[str, int] = {}
+        vertices = 0
+        with sd.lock:
+            for pid in part_ids:
+                p = sd.parts[pid]
+                vertices += len(p.vertices)
+                for tv in p.vertices.values():
+                    for t in tv:
+                        tags[t] = tags.get(t, 0) + 1
+                for per in p.out_edges.values():
+                    for et, em in per.items():
+                        edges[et] = edges.get(et, 0) + len(em)
+        # totals ride along so SHOW STATS is ONE scan/fan-out and the
+        # per-schema rows agree with the Space totals (same snapshot)
+        return {"tags": tags, "edges": edges, "vertices": vertices,
+                "total_edges": sum(edges.values())}
+
 
 def _nbr_key(k: Tuple[int, Any]):
     """Neighbor iteration order within one (vid, etype): rank, then

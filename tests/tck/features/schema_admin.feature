@@ -155,3 +155,28 @@ Feature: Schema DDL and admin statements
     Then the result should be, in any order:
       | Name |
       | "sa" |
+
+  Scenario: show stats lists per-tag and per-edge counts
+    Given having executed:
+      """
+      CREATE SPACE stat2(partition_num=2, vid_type=INT64);
+      USE stat2;
+      CREATE TAG a();
+      CREATE TAG b();
+      CREATE EDGE e1();
+      INSERT VERTEX a() VALUES 1:(), 2:();
+      INSERT VERTEX b() VALUES 3:();
+      INSERT EDGE e1() VALUES 1->2:(), 2->3:();
+      SUBMIT JOB STATS
+      """
+    When executing query:
+      """
+      SHOW STATS
+      """
+    Then the result should be, in any order:
+      | Type    | Name       | Count |
+      | "Tag"   | "a"        | 2     |
+      | "Tag"   | "b"        | 1     |
+      | "Edge"  | "e1"       | 2     |
+      | "Space" | "vertices" | 3     |
+      | "Space" | "edges"    | 2     |
