@@ -117,6 +117,10 @@ class QueryEngine:
         profile_stats: Optional[ProfileStats] = None
         explain_only = False
         if isinstance(stmt, A.ExplainSentence):
+            if (stmt.fmt or "row") not in ("row", "dot"):
+                return ResultSet(error=f"SemanticError: unknown plan "
+                                       f"format `{stmt.fmt}' "
+                                       f"(row | dot)")
             if stmt.profile:
                 profile_stats = ProfileStats()
             else:
@@ -146,9 +150,11 @@ class QueryEngine:
 
         if explain_only:
             us = int((time.perf_counter() - t0) * 1e6)
-            return ResultSet(DataSet(["plan"], [[plan.describe()]]),
+            fmt = getattr(stmt, "fmt", "row") or "row"
+            desc = plan.describe(fmt)
+            return ResultSet(DataSet(["plan"], [[desc]]),
                              space=plan.space, latency_us=us,
-                             plan_desc=plan.describe())
+                             plan_desc=desc)
         # Per-statement ExecutionContext seeded with the session's $vars —
         # intermediates die with the statement; only $var results persist.
         stmt_ectx = ExecutionContext()
@@ -166,7 +172,12 @@ class QueryEngine:
         us = int((time.perf_counter() - t0) * 1e6)
         plan_desc = None
         if profile_stats is not None:
-            plan_desc = profile_stats.describe(plan)
+            if getattr(stmt, "fmt", "row") == "dot":
+                # DOT rendering carries the DAG shape; per-node timing
+                # stays in the row format (reference-compatible subset)
+                plan_desc = plan.describe_dot()
+            else:
+                plan_desc = profile_stats.describe(plan)
             data = DataSet(["plan"], [[plan_desc]])
         return ResultSet(data, space=plan.space, latency_us=us,
                          plan_desc=plan_desc)

@@ -1199,6 +1199,43 @@ def _rebuild_index(node, qctx, ectx, space):
     return DataSet(["New Job Id"], [[job.job_id]])
 
 
+@executor("CreateSpaceAs")
+def _create_space_as(node, qctx, ectx, space):
+    """CREATE SPACE <new> AS <src>: clone the schema plane (options,
+    tags, edges, secondary + fulltext indexes) — never the data
+    (reference semantics).  Composed from the ordinary catalog ops, so
+    it works identically against the standalone catalog and the
+    metad-replicated CatalogProxy."""
+    a = node.args
+    cat = qctx.catalog
+    src = a["source"]
+    sp = cat.get_space(src)
+    if a["if_not_exists"]:
+        try:
+            cat.get_space(a["name"])
+            return DataSet()
+        except SchemaError:
+            pass
+    qctx.store.create_space(a["name"], partition_num=sp.partition_num,
+                            replica_factor=sp.replica_factor,
+                            vid_type=sp.vid_type)
+    for t in cat.tags(src):
+        sv = t.latest
+        cat.create_tag(a["name"], t.name, sv.props,
+                       ttl_col=sv.ttl_col, ttl_duration=sv.ttl_duration)
+    for e in cat.edges(src):
+        sv = e.latest
+        cat.create_edge(a["name"], e.name, sv.props,
+                        ttl_col=sv.ttl_col, ttl_duration=sv.ttl_duration)
+    for d in cat.indexes(src):
+        cat.create_index(a["name"], d.name, d.schema_name, d.fields,
+                         d.is_edge)
+    for d in cat.fulltext_indexes(src):
+        cat.create_fulltext_index(a["name"], d.name, d.schema_name,
+                                  d.fields[0], d.is_edge)
+    return DataSet()
+
+
 @executor("CreateFulltextIndex")
 def _create_ft_index(node, qctx, ectx, space):
     a = node.args
@@ -1310,6 +1347,12 @@ def _show(node, qctx, ectx, space):
         return DataSet(["Index Name", "By Tag" if not want_edge else "By Edge",
                         "Columns"],
                        [[d.name, d.schema_name, d.fields] for d in idx])
+    if kind == "charset":
+        return DataSet(
+            ["Charset", "Description", "Default collation", "Maxlen"],
+            [["utf8", "UTF-8 Unicode", "utf8_bin", 4]])
+    if kind == "collation":
+        return DataSet(["Collation", "Charset"], [["utf8_bin", "utf8"]])
     if kind == "fulltext_indexes":
         sp = a.get("space")
         if not sp:

@@ -23,7 +23,7 @@ from ..query import ast as A
 # session's (or statement's) target space; "self_or_god" is CHANGE
 # PASSWORD's own-account carve-out.
 _GLOBAL_GOD = (
-    A.CreateSpaceSentence, A.DropSpaceSentence, A.CreateUserSentence,
+    A.CreateSpaceSentence, A.CreateSpaceAsSentence, A.DropSpaceSentence, A.CreateUserSentence,
     A.DropUserSentence, A.AlterUserSentence, A.CreateSnapshotSentence,
     A.DropSnapshotSentence, A.UpdateConfigsSentence,
     A.AddHostsSentence, A.DropZoneSentence)

@@ -203,6 +203,13 @@ class RebuildIndexSentence(Sentence):
 
 
 @dataclass
+class CreateSpaceAsSentence(Sentence):
+    name: str
+    source: str
+    if_not_exists: bool = False
+
+
+@dataclass
 class CreateFulltextIndexSentence(Sentence):
     is_edge: bool
     index_name: str

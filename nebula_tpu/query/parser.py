@@ -388,6 +388,8 @@ class Parser:
         if self.accept_kw("SPACE"):
             ine = self.p_if_not_exists()
             name = self.ident()
+            if self.accept_kw("AS"):
+                return A.CreateSpaceAsSentence(name, self.ident(), ine)
             kw = {"partition_num": 8, "replica_factor": 1,
                   "vid_type": "FIXED_STRING(32)"}
             if self.accept("("):
@@ -594,6 +596,9 @@ class Parser:
                 self.next()
                 self.expect_kw("INDEXES")
                 return A.ShowSentence("fulltext_indexes")
+            if kw in ("CHARSET", "COLLATION"):
+                self.next()
+                return A.ShowSentence(kw.lower())
             if kw == "LISTENER":
                 self.next()
                 return A.ShowSentence("listener")

@@ -86,8 +86,28 @@ class ExecutionPlan:
     root: PlanNode
     space: Optional[str] = None
 
-    def describe(self) -> str:
+    def describe(self, fmt: str = "row") -> str:
+        if fmt == "dot":
+            return self.describe_dot()
         return self.root.describe()
+
+    def describe_dot(self) -> str:
+        """Graphviz rendering of the plan DAG (reference: EXPLAIN
+        FORMAT=\"dot\")."""
+        def esc(t: str) -> str:
+            return t.replace("\\", "\\\\").replace('"', '\\"')
+
+        lines = ["digraph exec_plan {", "  rankdir=BT;"]
+        for n in walk_plan(self.root):
+            label = n.kind + (f"\\n{esc(str(n.col_names))}"
+                              if n.col_names else "")
+            lines.append(f'  n{n.id} [label="{label}#{n.id}", '
+                         f"shape=box];")
+        for n in walk_plan(self.root):
+            for d in n.deps:
+                lines.append(f"  n{d.id} -> n{n.id};")
+        lines.append("}")
+        return "\n".join(lines)
 
 
 # -- walk/transform helpers used by the optimizer ---------------------------

@@ -1248,6 +1248,9 @@ def _register_dispatch():
             vid_type=s.vid_type),
         A.DropSpaceSentence: lambda p, s: _admin(
             "DropSpace", name=s.name, if_exists=s.if_exists),
+        A.CreateSpaceAsSentence: lambda p, s: _admin(
+            "CreateSpaceAs", name=s.name, source=s.source,
+            if_not_exists=s.if_not_exists),
         A.CreateSchemaSentence: lambda p, s: _admin(
             "CreateSchema", is_edge=s.is_edge, name=s.name,
             props=s.props, if_not_exists=s.if_not_exists,

@@ -84,3 +84,46 @@ Feature: Schema introspection and evolution
       DESCRIBE INDEX nope
       """
     Then an ExecutionError should be raised
+
+  Scenario: create space as clones the schema plane but not the data
+    Given having executed:
+      """
+      INSERT VERTEX p(name) VALUES 7:("x");
+      CREATE SPACE si2 AS si;
+      USE si2
+      """
+    When executing query:
+      """
+      SHOW TAGS
+      """
+    Then the result should be, in any order:
+      | Name |
+      | "p"  |
+    When executing query:
+      """
+      SHOW TAG INDEXES
+      """
+    Then the result should be, in any order:
+      | Index Name | By Tag | Columns |
+      | "ip"       | "p"    | ["age"] |
+    When executing query:
+      """
+      FETCH PROP ON p 7 YIELD p.name
+      """
+    Then the result should be empty
+
+  Scenario: show charset and collation
+    When executing query:
+      """
+      SHOW CHARSET
+      """
+    Then the result should be, in any order:
+      | Charset | Description     | Default collation | Maxlen |
+      | "utf8"  | "UTF-8 Unicode" | "utf8_bin"        | 4      |
+    When executing query:
+      """
+      SHOW COLLATION
+      """
+    Then the result should be, in any order:
+      | Collation  | Charset |
+      | "utf8_bin" | "utf8"  |
