@@ -97,8 +97,14 @@ class DistributedStore:
 
     # ---- mutate ----
     def _write(self, space: str, pid: int, *cmds):
+        # cat_ver: the issuer's catalog version rides along so a
+        # storaged whose heartbeat-refreshed cache lags a just-issued
+        # DDL refreshes BEFORE applying — otherwise a write landing in
+        # the lag window applies without the new index/fulltext/TTL
+        # schema state (silently missing derived entries)
         self.sc._call_part(space, pid, "storage.write",
-                           {"cmds": [to_wire(list(c)) for c in cmds]})
+                           {"cmds": [to_wire(list(c)) for c in cmds],
+                            "cat_ver": self.meta.version})
 
     def insert_vertex(self, space: str, vid: Any, tag: str,
                       props: Dict[str, Any],

@@ -182,6 +182,16 @@ class StorageService:
             # divergence from skipping.
             try:
                 cmd = tuple(wire.loads(data))
+                if cmd and cmd[0] == "v":
+                    # version-stamped entry: best-effort catalog sync
+                    # before apply (a failed refresh degrades to the
+                    # old stale-cache behavior, never stalls the log)
+                    if cmd[1] > self.meta.version:
+                        try:
+                            self.meta.refresh(force=True)
+                        except Exception:  # noqa: BLE001
+                            pass
+                    cmd = tuple(cmd[2])
                 self._apply_cmd(space_name, cmd)
             except Exception as ex:      # noqa: BLE001
                 from ..utils.stats import stats
@@ -288,7 +298,8 @@ class StorageService:
                 # in-half apply is idempotent (same row overwrite), so
                 # re-driving a chain the graphd actually finished is safe
                 sc._call_part(space, entry["part"], "storage.write",
-                              {"cmds": [to_wire(list(entry["cmd"]))]})
+                              {"cmds": [to_wire(list(entry["cmd"]))],
+                               "cat_ver": self.meta.version})
                 part.propose(wire.dumps(("chain_done", pid, cid)))
 
     # -- helpers ----------------------------------------------------------
@@ -320,13 +331,24 @@ class StorageService:
 
     def rpc_write(self, p):
         space, pid = p["space"], p["part"]
+        cat_ver = p.get("cat_ver", -1)
+        if cat_ver > self.meta.version:
+            # the write issuer has seen newer DDL than our cache:
+            # refresh first so derived state (indexes/fulltext/TTL)
+            # is maintained against the schema the writer validated on
+            self.meta.refresh(force=True)
         part = self._leader_part(space, pid, lease=False)
         for cmd in p["cmds"]:
             # cmds arrive wire-encoded; decode-validate BEFORE propose
             # (a malformed command must fail here, not poison the log),
-            # then the raft entry stores the canonical wire form
+            # then the raft entry stores the canonical wire form —
+            # version-stamped so FOLLOWERS apply against a catalog at
+            # least as new as the issuer's (the leader-only RPC check
+            # would leave replica index state stale until failover)
             decoded = _validate_cmd(cmd)
-            idx = part.propose(wire.dumps(decoded))
+            stamped = ("v", max(cat_ver, self.meta.version),
+                       list(decoded))
+            idx = part.propose(wire.dumps(stamped))
             if idx is None:
                 raise RpcError("part_leader_changed: write not committed")
             err = self._apply_errors.pop((part.group, idx), None)

@@ -116,8 +116,10 @@ class QueryEngine:
                 return ResultSet(error=f"PermissionError: {msg}")
         profile_stats: Optional[ProfileStats] = None
         explain_only = False
+        plan_fmt = "row"
         if isinstance(stmt, A.ExplainSentence):
-            if (stmt.fmt or "row") not in ("row", "dot"):
+            plan_fmt = stmt.fmt or "row"
+            if plan_fmt not in ("row", "dot"):
                 return ResultSet(error=f"SemanticError: unknown plan "
                                        f"format `{stmt.fmt}' "
                                        f"(row | dot)")
@@ -150,8 +152,7 @@ class QueryEngine:
 
         if explain_only:
             us = int((time.perf_counter() - t0) * 1e6)
-            fmt = getattr(stmt, "fmt", "row") or "row"
-            desc = plan.describe(fmt)
+            desc = plan.describe(plan_fmt)
             return ResultSet(DataSet(["plan"], [[desc]]),
                              space=plan.space, latency_us=us,
                              plan_desc=desc)
@@ -172,7 +173,7 @@ class QueryEngine:
         us = int((time.perf_counter() - t0) * 1e6)
         plan_desc = None
         if profile_stats is not None:
-            if getattr(stmt, "fmt", "row") == "dot":
+            if plan_fmt == "dot":
                 # DOT rendering carries the DAG shape; per-node timing
                 # stays in the row format (reference-compatible subset)
                 plan_desc = plan.describe_dot()

@@ -1209,30 +1209,29 @@ def _create_space_as(node, qctx, ectx, space):
     a = node.args
     cat = qctx.catalog
     src = a["source"]
+    ine = a["if_not_exists"]
     sp = cat.get_space(src)
-    if a["if_not_exists"]:
-        try:
-            cat.get_space(a["name"])
-            return DataSet()
-        except SchemaError:
-            pass
+    # every step is individually idempotent under IF NOT EXISTS, so a
+    # retry after a partial failure COMPLETES the clone instead of
+    # short-circuiting on the half-created space
     qctx.store.create_space(a["name"], partition_num=sp.partition_num,
                             replica_factor=sp.replica_factor,
-                            vid_type=sp.vid_type)
+                            vid_type=sp.vid_type, if_not_exists=ine)
     for t in cat.tags(src):
         sv = t.latest
-        cat.create_tag(a["name"], t.name, sv.props,
+        cat.create_tag(a["name"], t.name, sv.props, if_not_exists=ine,
                        ttl_col=sv.ttl_col, ttl_duration=sv.ttl_duration)
     for e in cat.edges(src):
         sv = e.latest
-        cat.create_edge(a["name"], e.name, sv.props,
+        cat.create_edge(a["name"], e.name, sv.props, if_not_exists=ine,
                         ttl_col=sv.ttl_col, ttl_duration=sv.ttl_duration)
     for d in cat.indexes(src):
         cat.create_index(a["name"], d.name, d.schema_name, d.fields,
-                         d.is_edge)
+                         d.is_edge, if_not_exists=ine)
     for d in cat.fulltext_indexes(src):
         cat.create_fulltext_index(a["name"], d.name, d.schema_name,
-                                  d.fields[0], d.is_edge)
+                                  d.fields[0], d.is_edge,
+                                  if_not_exists=ine)
     return DataSet()
 
 
