@@ -1,0 +1,20 @@
+#!/bin/bash
+# TPU tunnel probe loop (VERDICT r2 item 1): log every probe with a
+# timestamp so a wedged tunnel is attributable to environment, not the
+# framework.  Appends one line per probe to .tpu_probe.log; exits as
+# soon as a probe succeeds (leaving PLATFORM=tpu as the last line).
+LOG=/root/repo/.tpu_probe.log
+while true; do
+  TS=$(date -u +"%Y-%m-%dT%H:%M:%SZ")
+  OUT=$(timeout 150 python -c "import jax; d=jax.devices(); print('PLATFORM='+d[0].platform)" 2>&1 | tail -1)
+  RC=$?
+  if [ $RC -eq 124 ] || [ $RC -eq 143 ]; then
+    echo "$TS probe TIMEOUT (150s) — tunnel wedged" >> "$LOG"
+  elif echo "$OUT" | grep -q "PLATFORM=tpu"; then
+    echo "$TS probe OK: $OUT" >> "$LOG"
+    exit 0
+  else
+    echo "$TS probe rc=$RC: $OUT" >> "$LOG"
+  fi
+  sleep 600
+done
