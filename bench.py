@@ -51,8 +51,17 @@ def _median(xs):
     return statistics.median(xs)
 
 
-def bench_engine_config(name, store, query, seeds_note, rt, space="snb"):
-    """Engine-E2E wall time, device plane OFF vs ON, identical rows."""
+def bench_engine_config(name, store, query, seeds_note, rt, space="snb",
+                        numpy_fn=None, canon=None):
+    """Engine-E2E wall time, device plane OFF vs ON, identical rows.
+
+    `numpy_fn` (VERDICT r2 item 2) is the HONEST CPU comparator: a
+    vectorized numpy CSR/columnar implementation of the same query.  It
+    is timed like the engine runs, its result is content-checked against
+    the engine rows via `canon(rows) == numpy_fn()`, and the per-config
+    speedup is reported against BOTH the framework's own host engine
+    (`speedup_e2e`) AND numpy (`speedup_vs_numpy`) — the row-at-a-time
+    Python engine is never quoted as "CPU" in a headline."""
     from nebula_tpu.exec.engine import QueryEngine
 
     out = {}
@@ -80,6 +89,22 @@ def bench_engine_config(name, store, query, seeds_note, rt, space="snb"):
             out["cpu_eps"] = round(edges / (out["cpu"]["p50_ms"] / 1e3), 1)
             out["speedup_e2e"] = round(out["cpu"]["p50_ms"]
                                        / out["tpu"]["p50_ms"], 3)
+        if mode == "tpu" and numpy_fn is not None:
+            nlat = []
+            for _ in range(REPEATS):
+                t0 = time.perf_counter()
+                nres = numpy_fn()
+                nlat.append(time.perf_counter() - t0)
+            out["numpy_p50_ms"] = round(_median(nlat) * 1e3, 2)
+            out["speedup_vs_numpy"] = round(_median(nlat) / _median(lat),
+                                            3)
+            if canon is not None:
+                import numpy as _np
+                want, got = canon(rs.data), nres
+                assert all(_np.array_equal(_np.asarray(a), _np.asarray(b))
+                           for a, b in zip(want, got)), \
+                    f"{name}: numpy comparator rows differ"
+                out["numpy_rows_match"] = True
     assert rows_by_mode["cpu"] == rows_by_mode["tpu"], \
         f"{name}: device rows differ from host rows"
     out["identical_rows"] = True
@@ -167,9 +192,11 @@ def main():
     import numpy as np
 
     from nebula_tpu.bench.datagen import (SnapshotStore, host_csr_traverse,
+                                          host_match_agg, host_trail_paths,
                                           make_social_arrays,
                                           make_social_graph, pick_seeds,
                                           snapshot_from_arrays)
+    from nebula_tpu.graphstore.csr import build_snapshot
     from nebula_tpu.core import expr as E
     from nebula_tpu.tpu.runtime import TpuRuntime
 
@@ -185,29 +212,71 @@ def main():
     small_build_s = time.perf_counter() - t0
     seeds = pick_seeds(store, "snb", n_seeds, min_degree=2)
     seed_list = ", ".join(str(s) for s in seeds)
+
+    # the honest CPU comparator for configs 1-4 (VERDICT r2 item 2): a
+    # numpy CSR/columnar implementation of each query over the SAME data
+    _mark("building numpy comparator snapshot (small graph)")
+    snap_small = build_snapshot(store, "snb")
+    sd_small = store.space("snb")
+    dense_seeds = [sd_small.dense_id(v) for v in seeds]
+    d2v_small = np.asarray(snap_small.dense_to_vid, dtype=np.int64)
+
+    def np_cfg1():
+        _, _, nxt, _w = host_csr_traverse(snap_small, dense_seeds, 2,
+                                          materialize=True)
+        return (np.sort(d2v_small[nxt]),)
+
+    def canon_cfg1(ds):
+        return (np.sort(np.asarray(ds.column("d"), np.int64)),)
+
+    def np_cfg2():
+        _, _, nxt, w = host_csr_traverse(snap_small, dense_seeds, 3,
+                                         w_gt=50, materialize=True)
+        d = d2v_small[nxt]
+        o = np.lexsort((w, d))
+        return (d[o], w[o].astype(np.int64))
+
+    def canon_cfg2(ds):
+        d = np.asarray(ds.column("d"), np.int64)
+        w = np.asarray(ds.column("w"), np.int64)
+        o = np.lexsort((w, d))
+        return (d[o], w[o])
+
     _mark("config 1: engine e2e GO 2 STEPS")
     configs["1_sf1_go2"] = bench_engine_config(
         "cfg1", store,
         f"GO 2 STEPS FROM {seed_list} OVER KNOWS YIELD dst(edge) AS d",
-        seeds, rt)
+        seeds, rt, numpy_fn=np_cfg1, canon=canon_cfg1)
     _mark("config 2: engine e2e GO 3 STEPS filtered")
     configs["2_sf30_go3_filtered"] = bench_engine_config(
         "cfg2", store,
         f"GO 3 STEPS FROM {seed_list} OVER KNOWS WHERE KNOWS.w > 50 "
         f"YIELD dst(edge) AS d, KNOWS.w AS w",
-        seeds, rt)
+        seeds, rt, numpy_fn=np_cfg2, canon=canon_cfg2)
 
     # config 3 (BASELINE: IC5/IC9-shaped): fixed-length MATCH pattern +
     # aggregate — Traverse + Aggregate executor composition, device
     # frames vs host DFS with identical grouped rows.
     _mark("config 3: engine e2e IC-shaped MATCH + aggregate")
     ic_seeds = ", ".join(str(s) for s in seeds[:4])
+    dense_ic = dense_seeds[:4]
+
+    def np_cfg3():
+        u, c = host_match_agg(snap_small, dense_ic, 30)
+        return (d2v_small[u], c.astype(np.int64))
+
+    def canon_cfg3(ds):
+        v = np.asarray(ds.column("v"), np.int64)
+        c = np.asarray(ds.column("c"), np.int64)
+        o = np.argsort(v)
+        return (v[o], c[o])
+
     configs["3_ic_match_agg"] = bench_engine_config(
         "cfg3", store,
         f"MATCH (p:Person)-[:KNOWS]->(f)-[:KNOWS]->(ff:Person) "
         f"WHERE id(p) IN [{ic_seeds}] AND ff.Person.age > 30 "
         f"RETURN id(ff) AS v, count(*) AS c",
-        seeds, rt)
+        seeds, rt, numpy_fn=np_cfg3, canon=canon_cfg3)
     rt.unpin("snb")
 
     # config 4 (BASELINE: Twitter-2010-shaped): variable-length *1..4
@@ -222,12 +291,22 @@ def main():
                            seed=11, space="tw")
     tw_seeds = pick_seeds(tw, "tw", 8, min_degree=3)
     tw_list = ", ".join(str(s) for s in tw_seeds)
+    snap_tw = build_snapshot(tw, "tw")
+    sd_tw = tw.space("tw")
+    dense_tw = [sd_tw.dense_id(v) for v in tw_seeds]
+
+    def np_cfg4():
+        return (np.int64(host_trail_paths(snap_tw, dense_tw, 4)),)
+
+    def canon_cfg4(ds):
+        return (np.int64(ds.rows[0][0]),)
+
     _mark("config 4: engine e2e MATCH *1..4")
     configs["4_twitter_var_len"] = bench_engine_config(
         "cfg4", tw,
         f"MATCH (a:Person)-[e:KNOWS*1..4]->(b) WHERE id(a) IN [{tw_list}] "
         f"RETURN count(*) AS paths",
-        tw_seeds, rt, space="tw")
+        tw_seeds, rt, space="tw", numpy_fn=np_cfg4, canon=canon_cfg4)
     rt.unpin("tw")
 
     # ---- north-star-scale array graph (configs 5 + 6) ----
@@ -269,8 +348,9 @@ def main():
     assert cpu_total == edges, (cpu_total, edges)
     assert cpu_kept == len(rows)
     # content equality, not just counts: device rows == baseline arrays
-    dev_d = np.asarray([r[0] for r in rows], np.int64)
-    dev_w = np.asarray([r[1] for r in rows], np.int64)
+    # (rows is a lazy ColumnarDataSet — compare columns directly)
+    dev_d = np.asarray(rows.column_array("d"), np.int64)
+    dev_w = np.asarray(rows.column_array("w"), np.int64)
     order_dev = np.lexsort((dev_w, dev_d))
     order_cpu = np.lexsort((cpu_w, cpu_dst))
     assert (dev_d[order_dev] == cpu_dst[order_cpu]).all()
@@ -278,11 +358,18 @@ def main():
     tpu_e2e_eps = edges / _median(lat)
     tpu_kernel_eps = edges / _median(klat)
     cpu_eps = cpu_total / cpu_s
+    # row boundary cost, reported separately: the e2e result is columnar
+    # (numpy columns, same currency as the numpy baseline's output); this
+    # is what a consumer would pay to build per-row Python lists
+    t0 = time.perf_counter()
+    _ = rows.rows
+    rows_ms = (time.perf_counter() - t0) * 1e3
     configs["6_north_star_go3"] = {
         "edges_per_run": edges, "result_rows": len(rows),
         "p50_ms": round(_median(lat) * 1e3, 2),
         "kernel_p50_ms": round(_median(klat) * 1e3, 2),
         "mat_ms": round(st.mat_s * 1e3, 2),
+        "rows_ms": round(rows_ms, 2),
         "fetch_ms": round(st.fetch_s * 1e3, 2),
         "tpu_e2e_eps": round(tpu_e2e_eps, 1),
         "tpu_kernel_eps": round(tpu_kernel_eps, 1),

@@ -418,6 +418,77 @@ class DataSet:
         return f"{head}\n{'-' * max(len(head), 1)}\n{body}{more}"
 
 
+_ROWS_SLOT = DataSet.__dict__["rows"]
+
+
+class ColumnarDataSet(DataSet):
+    """DataSet backed by numpy columns; rows materialize lazily.
+
+    The device plane's result handle (SURVEY §2 row 25): device output
+    stays columnar — numpy arrays straight off the fetched capture
+    buffers — through the executor/result boundary, and per-row Python
+    lists are built only if something actually touches ``.rows`` (the
+    wire/print boundary, host executors composing further).  ``len()``,
+    ``column()`` and column-wise serialization never pay the per-row
+    object cost.
+    """
+
+    __slots__ = ("_cols",)
+
+    def __init__(self, column_names: List[str], cols: List[Any]):
+        self.column_names = list(column_names)
+        self._cols = list(cols)          # 1-D numpy arrays, equal length
+        _ROWS_SLOT.__set__(self, None)
+
+    # rows: lazy over the backing columns ------------------------------
+    @property
+    def rows(self) -> List[List[Any]]:
+        r = _ROWS_SLOT.__get__(self, ColumnarDataSet)
+        if r is None:
+            r = self._build_rows()
+            _ROWS_SLOT.__set__(self, r)
+            self._cols = None            # rows own the data now
+        return r
+
+    @rows.setter
+    def rows(self, v) -> None:
+        _ROWS_SLOT.__set__(self, v)
+        self._cols = None
+
+    def _build_rows(self) -> List[List[Any]]:
+        import numpy as np
+        cols = self._cols
+        n = len(cols[0]) if cols else 0
+        if n == 0:
+            return []
+        # object-matrix assembly: one C-level .tolist() per column plus
+        # one for the matrix, instead of a Python per-row loop
+        m = np.empty((n, len(cols)), dtype=object)
+        for j, c in enumerate(cols):
+            m[:, j] = c if c.dtype == object else c.tolist()
+        return m.tolist()
+
+    def __len__(self) -> int:
+        if self._cols is not None:
+            return len(self._cols[0]) if self._cols else 0
+        return len(self.rows)
+
+    def __iter__(self):
+        return iter(self.rows)
+
+    def column(self, name: str) -> List[Any]:
+        if self._cols is not None:
+            c = self._cols[self.col_index(name)]
+            return list(c) if c.dtype == object else c.tolist()
+        return super().column(name)
+
+    def column_array(self, name: str):
+        """The backing numpy column; None once rows were materialized."""
+        if self._cols is None:
+            return None
+        return self._cols[self.col_index(name)]
+
+
 # --------------------------------------------------------------------------
 # Typing / printing
 # --------------------------------------------------------------------------

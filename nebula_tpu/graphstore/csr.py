@@ -155,6 +155,32 @@ def decode_prop_column(pt: PropType, raw: "np.ndarray",
     return [NULL if r == INT_NULL else r for r in vals]
 
 
+def decode_prop_column_np(pt: PropType, raw: "np.ndarray",
+                          pool: StringPool) -> "np.ndarray":
+    """decode_prop_column, columnar: returns a numpy array — native
+    numeric dtype on the null-free fast paths, object dtype otherwise —
+    creating NO per-element Python objects on the fast paths.  Feeds the
+    ColumnarDataSet result handle (device results stay columnar until
+    the wire/print boundary)."""
+    if pt in (PropType.FLOAT, PropType.DOUBLE):
+        a = raw.astype(np.float64)
+        if not np.isnan(a).any():
+            return a
+    elif pt in (PropType.STRING, PropType.FIXED_STRING):
+        av = raw.astype(np.int64)
+        ns = len(pool.strings)
+        if av.size == 0 or ((av >= 0) & (av < ns)).all():
+            return pool.obj_array()[av]
+    elif pt not in (PropType.BOOL, PropType.DATE, PropType.DATETIME,
+                    PropType.TIME, PropType.DURATION, PropType.GEOGRAPHY):
+        av = raw.astype(np.int64)
+        if not (av == INT_NULL).any():
+            return av
+    out = np.empty(len(raw), dtype=object)
+    out[:] = decode_prop_column(pt, raw, pool)
+    return out
+
+
 def decode_prop(pt: PropType, raw: Any, pool: StringPool) -> Any:
     """Exact inverse of encode_prop (sentinels → NULL)."""
     import datetime as _dt

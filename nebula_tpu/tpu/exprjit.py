@@ -384,6 +384,53 @@ def yieldable(e: "E.Expr") -> bool:
     return False
 
 
+def eval_yield_column_np(e: "E.Expr", b: Dict[str, Any]) -> "np.ndarray":
+    """eval_yield_column, columnar: returns numpy arrays (object dtype
+    for vids/strings, native dtype for numeric prop columns) with no
+    per-element tolist — the ColumnarDataSet fast path.  `b["props"]`
+    must hold numpy arrays (decode_prop_column_np)."""
+    import numpy as np
+
+    from ..core.value import NULL_UNKNOWN_PROP
+    n = b["n"]
+    fwd = b["etype"] >= 0
+
+    def _const(v, dtype=object):
+        a = np.empty(n, dtype=dtype)
+        a.fill(v)
+        return a
+
+    if e.kind == "literal":
+        return _const(e.value)
+    if e.kind == "function":
+        name = e.name
+        if name == "src":
+            return b["sv"] if fwd else b["dv"]
+        if name == "dst":
+            return b["dv"] if fwd else b["sv"]
+        if name == "rank":
+            return np.asarray(b["rr"], dtype=np.int64)
+        if name == "type":
+            return _const(b["et"])
+        if name == "typeid":
+            return _const(int(b["etype"]), dtype=np.int64)
+    if e.kind == "edge_prop":
+        pname = e.name
+        if pname == "_src":
+            return b["sv"] if fwd else b["dv"]
+        if pname == "_dst":
+            return b["dv"] if fwd else b["sv"]
+        if pname == "_rank":
+            return np.asarray(b["rr"], dtype=np.int64)
+        if pname == "_type":
+            return _const(b["et"])
+        col = b["props"].get(pname)
+        if col is None:
+            return _const(NULL_UNKNOWN_PROP)
+        return col
+    raise CannotCompile(f"yield not columnar: {e.kind}")
+
+
 def eval_yield_column(e: "E.Expr", b: Dict[str, Any]) -> List[Any]:
     """Evaluate one absorbed YIELD column over a materialized block.
 

@@ -21,11 +21,13 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec
 
 from ..core import expr as E
-from ..core.value import Edge
-from ..graphstore.csr import build_snapshot, decode_prop_column
+from ..core.value import ColumnarDataSet, Edge
+from ..graphstore.csr import (build_snapshot, decode_prop_column,
+                              decode_prop_column_np)
 from ..graphstore.store import GraphStore
 from .device import DeviceSnapshot, TpuUnavailable, make_mesh, pin_snapshot
-from .exprjit import CannotCompile, compile_predicate, eval_yield_column
+from .exprjit import (CannotCompile, compile_predicate, eval_yield_column,
+                      eval_yield_column_np)
 from .hop import build_traverse_fn, build_traverse_fn_local
 
 
@@ -71,49 +73,108 @@ class TraverseStats:
 
 
 class HopFrame:
-    """One hop's captured edge set, indexed for path assembly.
+    """One hop's captured edge set, columnar, indexed for path assembly.
 
-    src/dst: (n,) int64 dense vertex ids; edges: list of n Edge objects
-    (batch-decoded); adj: dense src id → (start, end) slice into the
-    src-sorted `order` index.  Within a source, edges keep CSR order
-    (per-block slot order), block-major — matching the host
-    get_neighbors iteration (etype list order, then (rank, neighbor))."""
-    __slots__ = ("src", "dst", "edges", "order", "adj", "n")
+    src/dst: (n,) int64 dense vertex ids in capture order (block-major,
+    then part, then per-src CSR slot order — matching the host
+    get_neighbors iteration).  Edge OBJECTS are decoded lazily: the
+    vectorized trail assembly touches only the entries that land on an
+    emitted path, and the full `.edges` object array is built only for
+    the DFS consumers (algorithms.py) that ask for it.
+
+    Trail-dedup identity is columnar too: (key_et, key_s, key_d, rank)
+    is the canonical physical-edge key (reverse-direction copies of one
+    logical edge canonicalize equal), compared component-wise — no
+    per-edge Python hashing.
+    """
+    __slots__ = ("src", "dst", "rank", "n", "order", "_us", "_ustart",
+                 "_ucnt", "key_et", "key_s", "key_d",
+                 "_segs", "_decode_seg", "_eobjs", "_edone")
 
     @classmethod
     def empty(cls) -> "HopFrame":
         f = cls()
         f.src = np.empty((0,), np.int64)
         f.dst = np.empty((0,), np.int64)
-        f.edges = []
-        f.order = np.empty((0,), np.int64)
-        f.adj = {}
+        f.rank = np.empty((0,), np.int64)
+        f.key_et = np.empty((0,), np.int64)
+        f.key_s = np.empty((0,), np.int64)
+        f.key_d = np.empty((0,), np.int64)
         f.n = 0
+        f.order = np.empty((0,), np.int64)
+        f._us = np.empty((0,), np.int64)
+        f._ustart = np.empty((0,), np.int64)
+        f._ucnt = np.empty((0,), np.int64)
+        f._segs = []
+        f._decode_seg = None
+        f._eobjs = None
+        f._edone = None
         return f
 
     @classmethod
-    def build(cls, src, dst, edges) -> "HopFrame":
+    def build(cls, src, dst, rank, key_et, key_s, key_d, segs,
+              decode_seg) -> "HopFrame":
+        """segs: list of (seg_start, seg_end, payload); decode_seg(
+        payload, offsets) -> list[Edge] decodes a segment's entries at
+        `offsets` (segment-relative)."""
         if src is None or src.size == 0:
             return cls.empty()
         f = cls()
-        f.src, f.dst, f.edges = src, dst, edges
+        f.src, f.dst, f.rank = src, dst, rank
+        f.key_et, f.key_s, f.key_d = key_et, key_s, key_d
         f.n = src.size
         f.order = np.argsort(src, kind="stable")
         ss = src[f.order]
         starts = np.flatnonzero(np.concatenate(
             [[True], ss[1:] != ss[:-1]]))
-        bounds = np.concatenate([starts, [ss.size]])
-        f.adj = {int(ss[starts[i]]): (int(bounds[i]), int(bounds[i + 1]))
-                 for i in range(starts.size)}
+        f._us = ss[starts]
+        f._ustart = starts
+        f._ucnt = np.diff(np.concatenate([starts, [ss.size]]))
+        f._segs = segs
+        f._decode_seg = decode_seg
+        f._eobjs = None
+        f._edone = None
         return f
 
     def out_edges(self, dense_id: int):
         """Indices (into src/dst/edges) of this hop's edges out of
         dense_id, in CSR order."""
-        se = self.adj.get(dense_id)
-        if se is None:
+        p = np.searchsorted(self._us, dense_id)
+        if p >= self._us.size or self._us[p] != dense_id:
             return ()
-        return self.order[se[0]:se[1]]
+        return self.order[self._ustart[p]:self._ustart[p]
+                          + self._ucnt[p]]
+
+    def src_slices(self):
+        """(us, ustart, ucnt): sorted unique srcs with their slice into
+        `order` — the vectorized join's lookup table."""
+        return self._us, self._ustart, self._ucnt
+
+    def decode(self, idx: np.ndarray) -> np.ndarray:
+        """Edge objects for frame indices `idx` (object array, aligned
+        with idx).  Decodes each entry at most once across calls."""
+        if self._eobjs is None:
+            self._eobjs = np.full((self.n,), None, dtype=object)
+            self._edone = np.zeros((self.n,), bool)
+        eo = self._eobjs
+        if idx.size:
+            uniq = np.unique(idx)
+            need = uniq[~self._edone[uniq]]
+            for (s0, s1, payload) in self._segs:
+                m = need[(need >= s0) & (need < s1)]
+                if m.size == 0:
+                    continue
+                eo[m] = self._decode_seg(payload, m - s0)
+                self._edone[m] = True
+        return eo[idx]
+
+    @property
+    def edges(self) -> np.ndarray:
+        """All Edge objects (decodes the whole frame once) — the DFS
+        consumers' (algorithms.py) contract."""
+        if self._eobjs is None or not self._edone.all():
+            self.decode(np.arange(self.n, dtype=np.int64))
+        return self._eobjs
 
 
 class TpuRuntime:
@@ -393,11 +454,11 @@ class TpuRuntime:
         Returns (rows, stats).  Without `yields`, rows are
         (src_vid, Edge, dst_vid) triples for every final-hop edge passing
         the predicate.  With `yields` — a list of (Expr, name) pairs the
-        fusion rule verified are columnar-computable — rows are the FINAL
-        output rows, produced by vectorized numpy column evaluation with
-        no per-edge Python objects at all (the E2E fast path).  Raises
-        CannotCompile if the filter does not vectorize (caller falls back
-        to the host path).
+        fusion rule verified are columnar-computable — rows are a lazy
+        ColumnarDataSet holding the FINAL output as numpy columns; no
+        per-row Python objects exist unless the consumer crosses the row
+        boundary (the E2E fast path).  Raises CannotCompile if the
+        filter does not vectorize (caller falls back to the host path).
         """
         t_start = time.perf_counter()
         dev = self.pin(store, space)
@@ -549,18 +610,51 @@ class TpuRuntime:
     def _build_frames(self, store: GraphStore, space: str,
                       dev: DeviceSnapshot, block_keys, cap, steps: int
                       ) -> List["HopFrame"]:
-        """cap arrays are (P, steps, nb, EB); one HopFrame per hop."""
+        """cap arrays are (P, steps, nb, EB); one columnar HopFrame per
+        hop.  NO Edge objects are built here — frames carry dense-id and
+        canonical-key columns, plus a per-segment decode closure that
+        materializes Edge objects only for the entries the assembly
+        actually emits (VERDICT r2 item 4)."""
         host = dev.host
         d2v_arr = _d2v(host)
         etype_ids = {et: store.catalog.get_edge(space, et).edge_type
                      for et, _ in block_keys}
         K = cap["src"].shape[-1]
         slot = np.arange(K, dtype=np.int32)
+
+        def make_decode(et, dirn, sgn):
+            hb = host.blocks[(et, dirn)]
+
+            def decode_seg(payload, offs):
+                ss, dd, rr, ee, sel_p = payload
+                ss, dd = ss[offs], dd[offs]
+                rr, ee, sp = rr[offs], ee[offs], sel_p[offs]
+                props = {n: decode_prop_column(
+                    hb.prop_types[n], hb.props[n][sp, ee], host.pool)
+                    for n in hb.props}
+                sv = d2v_arr[ss]
+                dvv = d2v_arr[dd]
+                names = list(props)
+                cols = [props[n] for n in names]
+                rrl = rr.tolist()
+                return [Edge(s, d, et, rrl[i],
+                             {n: c[i] for n, c in zip(names, cols)},
+                             etype=sgn)
+                        for i, (s, d) in enumerate(zip(sv.tolist(),
+                                                       dvv.tolist()))]
+            return decode_seg
+
+        def decode_seg(payload_dec, offs):
+            payload, dec = payload_dec
+            return dec(payload, offs)
+
         frames = []
         for h in range(steps):
-            srcs, dsts, edges = [], [], []
+            srcs, dsts, rks = [], [], []
+            ket, ks, kd = [], [], []
+            segs = []
+            pos = 0
             for bi, (et, dirn) in enumerate(block_keys):
-                hb = host.blocks[(et, dirn)]
                 kc = cap["kcount"][:, h, bi]        # (P,)
                 # nonzero is row-major: part order, then slot order — the
                 # device compaction is stable, so per (part, src) the
@@ -571,28 +665,30 @@ class TpuRuntime:
                     continue
                 ss = cap["src"][sel_p, h, bi, sel_j].astype(np.int64)
                 dd = cap["dst"][sel_p, h, bi, sel_j].astype(np.int64)
-                rr = cap["rank"][sel_p, h, bi, sel_j]
+                rr = cap["rank"][sel_p, h, bi, sel_j].astype(np.int64)
                 ee = cap["eidx"][sel_p, h, bi, sel_j]
-                props = {n: decode_prop_column(
-                    hb.prop_types[n], hb.props[n][sel_p, ee], host.pool)
-                    for n in hb.props}
                 eid = etype_ids[et]
                 sgn = eid if dirn == "out" else -eid
-                sv = d2v_arr[ss]
-                dvv = d2v_arr[dd]
-                names = list(props)
-                cols = [props[n] for n in names]
-                rrl = rr.tolist()
-                edges.extend(
-                    Edge(s, d, et, rrl[i],
-                         {n: c[i] for n, c in zip(names, cols)}, etype=sgn)
-                    for i, (s, d) in enumerate(zip(sv.tolist(),
-                                                   dvv.tolist())))
                 srcs.append(ss)
                 dsts.append(dd)
+                rks.append(rr)
+                # canonical physical-edge key: out/in copies of one
+                # logical edge compare equal (trail dedup currency)
+                ket.append(np.full(ss.size, eid, np.int64))
+                ks.append(ss if dirn == "out" else dd)
+                kd.append(dd if dirn == "out" else ss)
+                segs.append((pos, pos + ss.size,
+                             ((ss, dd, rr, ee, sel_p),
+                              make_decode(et, dirn, sgn))))
+                pos += ss.size
+            if not srcs:
+                frames.append(HopFrame.empty())
+                continue
             frames.append(HopFrame.build(
-                np.concatenate(srcs) if srcs else None,
-                np.concatenate(dsts) if dsts else None, edges))
+                np.concatenate(srcs), np.concatenate(dsts),
+                np.concatenate(rks), np.concatenate(ket),
+                np.concatenate(ks), np.concatenate(kd),
+                segs, decode_seg))
         return frames
 
     # -- BFS (FIND SHORTEST PATH device plane) ---------------------------
@@ -671,7 +767,8 @@ class TpuRuntime:
 
     def _block_columns(self, store: GraphStore, space: str,
                        dev: DeviceSnapshot, block_keys, cap,
-                       prop_names: Optional[Sequence[str]] = None):
+                       prop_names: Optional[Sequence[str]] = None,
+                       as_np: bool = False):
         """Vectorized gather of the captured final-hop edge set.
 
         Yields per-block dicts of flat numpy/object arrays: sv/dv (vids),
@@ -698,9 +795,10 @@ class TpuRuntime:
             rr = cap["rank"][sel_p, bi, sel_j]
             ee = cap["eidx"][sel_p, bi, sel_j]
             props = {}
+            dec = decode_prop_column_np if as_np else decode_prop_column
             for n in (hb.props if prop_names is None else
                       [x for x in prop_names if x in hb.props]):
-                props[n] = decode_prop_column(
+                props[n] = dec(
                     hb.prop_types[n], hb.props[n][sel_p, ee], host.pool)
             eid = etype_ids[et]
             yield {"et": et, "dirn": dirn, "etype": eid if dirn == "out"
@@ -729,19 +827,26 @@ class TpuRuntime:
 
     def _materialize_yields(self, store: GraphStore, space: str,
                             dev: DeviceSnapshot, block_keys, cap,
-                            yields) -> List[List[Any]]:
-        """Final output rows straight from columns (fused Project)."""
+                            yields) -> ColumnarDataSet:
+        """Final output as a lazy columnar DataSet (fused Project).
+
+        Columns are numpy arrays straight from the capture buffers; no
+        per-row Python objects are built here — the ColumnarDataSet
+        materializes rows only if the consumer crosses the row boundary
+        (VERDICT r2 item 3: device results stay columnar end-to-end)."""
         needed = [x.name for e, _ in yields for x in E.walk(e)
                   if x.kind == "edge_prop"]
-        out: List[List[Any]] = []
+        per_block: List[List[np.ndarray]] = []
         for b in self._block_columns(store, space, dev, block_keys, cap,
-                                     prop_names=needed):
-            cols = [eval_yield_column(e, b) for e, _ in yields]
-            # object-matrix assembly: one C-level .tolist() instead of a
-            # per-row Python zip/list loop (the E2E bench's former
-            # dominant cost — ~1s for 320k rows)
-            m = np.empty((b["n"], len(cols)), dtype=object)
-            for j, c in enumerate(cols):
-                m[:, j] = c
-            out.extend(m.tolist())
-        return out
+                                     prop_names=needed, as_np=True):
+            per_block.append([eval_yield_column_np(e, b)
+                              for e, _ in yields])
+        names = [alias for _, alias in yields]
+        if not per_block:
+            return ColumnarDataSet(
+                names, [np.empty(0, object) for _ in yields])
+        if len(per_block) == 1:
+            return ColumnarDataSet(names, per_block[0])
+        return ColumnarDataSet(
+            names, [np.concatenate([blk[j] for blk in per_block])
+                    for j in range(len(yields))])

@@ -158,6 +158,11 @@ def _tpu_traverse(node, qctx, ectx, space):
                 yields=yields)
             qctx.last_tpu_stats = stats
             if yields is not None:
+                if isinstance(rows, DataSet):
+                    # ColumnarDataSet: rows stay numpy columns until a
+                    # consumer crosses the row boundary (lazy handle)
+                    rows.column_names = list(node.col_names)
+                    return rows
                 return DataSet(list(node.col_names), rows)
             return DataSet(["_src", "_edge", "_dst"],
                            [[s, e, d] for (s, e, d) in rows])

@@ -111,7 +111,7 @@ def make_cluster(tmp_path, n=3, group="g0", snapshot=False, **kw):
     return tr, parts, apps
 
 
-def wait_leader(parts, timeout=5.0):
+def wait_leader(parts, timeout=20.0):
     dl = time.monotonic() + timeout
     while time.monotonic() < dl:
         leaders = [p for p in parts if p.is_leader() and p.alive]
@@ -121,7 +121,7 @@ def wait_leader(parts, timeout=5.0):
     raise AssertionError("no unique leader elected")
 
 
-def wait_applied(apps, want, timeout=5.0, exclude=()):
+def wait_applied(apps, want, timeout=20.0, exclude=()):
     dl = time.monotonic() + timeout
     while time.monotonic() < dl:
         if all(a.data() == want for i, a in enumerate(apps)
