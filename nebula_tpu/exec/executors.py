@@ -380,11 +380,14 @@ def _traverse_device(node, qctx, ectx, ds, ci, sp, etypes, direction,
 
     One batched device expansion to max_hop over ALL distinct sources —
     predicate applied per hop on device when it vectorizes, else frames
-    are a superset re-checked by edge_ok during assembly — then per-row
-    trail-semantics DFS over the layered HopFrames, mirroring the host
-    loop below exactly (same stack order, same emit points).  Returns
-    rows, or None to take the host path (no runtime, flag off, store
-    without a device snapshot surface, non-convergent escalation...).
+    are a superset re-checked by edge_ok during assembly — then a
+    vectorized trail assembly over the layered HopFrames.  Rows are
+    emitted in LEVEL order across all input rows (not the host DFS's
+    per-row stack order); parity with the host path holds up to row
+    reordering, which the unordered-MATCH contract permits (consumers
+    sort or aggregate).  Returns rows, or None to take the host path
+    (no runtime, flag off, store without a device snapshot surface,
+    non-convergent escalation...).
     """
     rt = getattr(qctx, "tpu_runtime", None)
     if rt is None or not ds.rows or max_hop < 1:
@@ -492,9 +495,9 @@ def _traverse_device(node, qctx, ectx, ds, ci, sp, etypes, direction,
             cand = np.flatnonzero(keep)
             eobj = fr.decode(fidx[cand])
             rsel = ridx[parent[cand]]
-            for j, ci in enumerate(cand.tolist()):
+            for j, kidx in enumerate(cand.tolist()):
                 if not edge_ok(eobj[j], in_rows[rsel[j]]):
-                    keep[ci] = False
+                    keep[kidx] = False
         sel = np.flatnonzero(keep)
         if sel.size == 0:
             break

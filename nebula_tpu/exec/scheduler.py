@@ -89,7 +89,7 @@ class Scheduler:
                     profile.per_node[node.id]["tpu"] = {
                         "device_s": round(ts.device_s, 6),
                         "hop_edges": ts.hop_edges,
-                        "buckets": {"F": ts.f_cap, "EB": ts.e_cap},
+                        "buckets": {"EB": ts.e_cap},
                         "retries": ts.retries,
                     }
 

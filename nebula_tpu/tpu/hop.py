@@ -1,81 +1,97 @@
-"""The sharded multi-hop traversal kernel.
+"""The sharded multi-hop traversal kernel — bitmap-frontier design.
 
 One `shard_map` program runs the WHOLE N-step GO expansion on device:
 per hop, each chip expands its shard of the frontier through its local
 CSR block(s) (a vectorized segment gather — the MXU/VPU replacement for
 the reference's per-vid RocksDB prefix loops in GetNeighborsProcessor),
-applies the compiled predicate mask, dedups via sort-unique, hash-routes
-destinations to their owning chips, and re-shards the frontier with ONE
-`lax.all_to_all` over ICI — replacing the reference's per-hop
-storage.thrift fan-out (StorageClient::getNeighbors; reference:
-src/clients/storage, src/storage/query [UNVERIFIED — empty mount,
-SURVEY §0]).
+applies the compiled predicate mask, and marks destination vertices in a
+per-owner **bitmap** that is exchanged with ONE bool `lax.all_to_all`
+over ICI — replacing the reference's per-hop storage.thrift fan-out
+(StorageClient::getNeighbors; reference: src/clients/storage,
+src/storage/query [UNVERIFIED — empty mount, SURVEY §0]).
 
-Static-shape policy (SURVEY §7 hard-part #1): frontier capacity F and
-per-block edge budget EB are power-of-two buckets chosen by the runtime;
-every kernel output carries per-part overflow flags, and the runtime
-re-runs with doubled buckets on overflow (inputs are never consumed, so
-the retry is exact).
+Why a bitmap (round-4 redesign, VERDICT r3 item 3): the previous design
+kept the frontier as a padded (P, F) sorted id list, which cost three
+O(EB log EB) sorts per hop (sort-unique dedup, stable argsort routing,
+merge sort) — sort-heavy work on sort-weak hardware for an expansion
+whose useful work is an int32 gather.  The frontier is now a
+(P, vmax) bool membership bitmap sharded by vid ownership
+(dense % P — the vid-hash partition map), which makes all three sorts
+disappear structurally:
 
-Frontier representation between hops: (P, F) int32 dense vertex ids,
--1 padded, each row owned by (and resident on) its chip; dense id
-encodes ownership as dense % P — the vid-hash partition map.
+  * dedup      = the scatter-max mark itself (duplicate dsts set the
+                 same bit);
+  * routing    = the bitmap's layout (row d of the mark matrix IS the
+                 bucket for part d — no argsort, no bucket overflow);
+  * merge      = a bool OR-reduce over the received rows;
+  * the F bucket, its escalation rung, and the ovf_route/ovf_frontier
+    flags cease to exist — the only dynamic budget left is EB.
+
+Per hop the work is O(EB) gathers/scatters + an O(vmax) cumsum, versus
+O(EB log EB) before; the exchange payload is P*vmax bools versus
+P*F int32 words (at north-star shape: 1 MB versus 64 MB).
+
+Static-shape policy (SURVEY §7 hard-part #1): the per-block edge budget
+EB is a power-of-two bucket chosen by the runtime; every kernel output
+carries overflow flags, and the runtime re-runs with doubled buckets on
+overflow (inputs are never consumed, so the retry is exact).
+
+Frontier representation between hops: (P, vmax) bool, row p = the
+membership bitmap of part p's local ids (dense id = local * P + p).
+Expansion enumerates set bits in ascending local-id order, so captured
+edge slots stay (part, src)-contiguous ascending-eidx — the invariant
+the host materializers rely on.
 """
 from __future__ import annotations
 
-from functools import partial
-from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import PartitionSpec
 
 MAXI = np.iinfo(np.int32).max
 
 
-def _sorted_unique(vals):
-    """vals: (N,) int32 with -1 invalid → (u, count): u has the unique
-    valid values somewhere (others MAXI), count = #unique."""
-    key = jnp.where(vals >= 0, vals, MAXI).astype(jnp.int32)
-    s = jnp.sort(key)
-    first = jnp.concatenate([jnp.ones((1,), bool), s[1:] != s[:-1]])
-    first = first & (s != MAXI)
-    u = jnp.where(first, s, MAXI)
-    return u, jnp.sum(first, dtype=jnp.int32)
+def _expand_block(indptr, nbr, rank, fbm, EB: int, P: int, pid):
+    """Vectorized CSR expansion of one block from one part's frontier
+    bitmap.
 
+    indptr: (vmax+1,) local CSR row pointers; nbr/rank: (E,) edge
+    arrays; fbm: (vmax,) bool frontier membership; pid: this part's id
+    (dense id = local * P + pid).
 
-def _route(u, P: int, cap: int):
-    """Bucket unique candidates by owner part (owner = v % P).
-
-    u: (N,) int32 values or MAXI.  Returns:
-      out   (P, cap) int32  — row d = candidates destined for part d
-      sendc (P,)     int32  — valid count per destination
-      ovf   ()       bool   — some destination bucket overflowed
+    Returns per-edge-slot arrays of length EB:
+      src (frontier dense id), dst, rk, eidx (index into the block's
+      edge arrays — the host uses it to decode properties), ve (slot
+      valid), plus (total, ovf): true expansion size and overflow flag.
     """
-    ok = u != MAXI
-    owner = jnp.where(ok, u % P, P).astype(jnp.int32)
-    perm = jnp.argsort(owner, stable=True)
-    so = owner[perm]
-    sv = u[perm]
-    counts = jnp.zeros((P + 1,), jnp.int32).at[so].add(1)
-    starts = jnp.concatenate(
-        [jnp.zeros((1,), jnp.int32), jnp.cumsum(counts[:-1])])
-    pos = jnp.arange(so.shape[0], dtype=jnp.int32) - starts[so]
-    out = jnp.full((P, cap), -1, jnp.int32)
-    out = out.at[so, pos].set(sv, mode="drop")
-    sendc = jnp.minimum(counts[:P], cap)
-    ovf = jnp.any(counts[:P] > cap)
-    return out, sendc, ovf
+    vmax = fbm.shape[0]
+    deg = jnp.where(fbm, indptr[1:] - indptr[:-1], 0).astype(jnp.int32)
+    ends = jnp.cumsum(deg)
+    total = ends[-1]
+    j = jnp.arange(EB, dtype=jnp.int32)
+    row = jnp.searchsorted(ends, j, side="right").astype(jnp.int32)
+    row = jnp.minimum(row, vmax - 1)
+    starts = ends - deg
+    eidx = indptr[row] + (j - starts[row])
+    ve = j < jnp.minimum(total, EB)
+    eidx = jnp.where(ve, eidx, 0).astype(jnp.int32)
+    dst = jnp.where(ve, nbr[eidx], -1)
+    src = jnp.where(ve, row * P + pid, -1)
+    rk = jnp.where(ve, rank[eidx], 0)
+    return src, dst, rk, eidx, ve, total, total > EB
 
 
-def _merge_frontier(recv, F: int):
-    """recv: (P, cap) candidates received from every chip → next frontier
-    (F,) sorted ascending, -1 padded, + count + overflow."""
-    u, cnt = _sorted_unique(recv.reshape(-1))
-    nf = jnp.sort(u)[:F]
-    nf = jnp.where(nf != MAXI, nf, -1)
-    return nf, jnp.minimum(cnt, F), cnt > F
+def _mark(dst, keep, P: int, vmax: int, acc=None):
+    """Scatter keep-passing dense dst ids into a (P, vmax) ownership
+    bitmap: row d = the candidate set destined for part d.  This is the
+    sort-free dedup + route: duplicates set the same bit, and the row
+    index IS the routing bucket (no argsort, no bucket overflow)."""
+    owner = jnp.where(keep, dst % P, 0).astype(jnp.int32)
+    loc = jnp.where(keep, dst // P, 0).astype(jnp.int32)
+    m = jnp.zeros((P, vmax), bool) if acc is None else acc
+    return m.at[owner, loc].max(keep)
 
 
 def _compact_cap(src, dst, rk, eidx, keep, EB: int):
@@ -98,33 +114,7 @@ def _compact_cap(src, dst, rk, eidx, keep, EB: int):
             put(eidx, 0), jnp.sum(keep, dtype=jnp.int32))
 
 
-def _expand_block(indptr, nbr, rank, fr, F: int, EB: int, P: int):
-    """Vectorized CSR expansion of one block for one shard's frontier.
-
-    Returns per-edge-slot arrays of length EB:
-      src (frontier dense id), dst, rk, eidx (index into the block's edge
-      arrays — the host uses it to decode properties), ve (slot valid),
-    plus (total, ovf): true expansion size and overflow flag.
-    """
-    valid = fr >= 0
-    lf = jnp.where(valid, fr // P, 0)
-    deg = jnp.where(valid, indptr[lf + 1] - indptr[lf], 0)
-    ends = jnp.cumsum(deg)
-    total = ends[-1]
-    j = jnp.arange(EB, dtype=jnp.int32)
-    row = jnp.searchsorted(ends, j, side="right").astype(jnp.int32)
-    row = jnp.minimum(row, F - 1)
-    starts = ends - deg
-    eidx = indptr[lf[row]] + (j - starts[row])
-    ve = j < jnp.minimum(total, EB)
-    eidx = jnp.where(ve, eidx, 0).astype(jnp.int32)
-    dst = jnp.where(ve, nbr[eidx], -1)
-    src = jnp.where(ve, fr[row], -1)
-    rk = jnp.where(ve, rank[eidx], 0)
-    return src, dst, rk, eidx, ve, total, total > EB
-
-
-def build_traverse_fn(mesh, P: int, F: int, EB: int, steps: int,
+def build_traverse_fn(mesh, P: int, EB: int, steps: int,
                       n_blocks: int,
                       pred: Optional[Callable[[Dict[str, Any]], Any]] = None,
                       pred_cols: Sequence[str] = (),
@@ -133,17 +123,18 @@ def build_traverse_fn(mesh, P: int, F: int, EB: int, steps: int,
     """Compile the N-step traversal program for one bucket configuration.
 
     blocks_data (runtime arg): tuple of n_blocks dicts with keys
-      indptr (P, V+1), nbr (P, E), rank (P, E), props {name: (P, E)}
+      indptr (P, vmax+1), nbr (P, E), rank (P, E), props {name: (P, E)}
     where props holds ONLY the columns the predicate needs (property
     decode for result rows happens on host via captured eidx).
 
     Returns jitted fn(blocks_data, frontier) -> dict with:
-      frontier (P, F), fcount (P,): next frontier after the LAST hop
-        (mid-hop frontiers never leave the device)
+      frontier (P, vmax) bool, fcount (P,): next frontier after the LAST
+        hop (mid-hop frontiers never leave the device)
       hop_edges (P, steps): pre-filter expansion size per hop per part
-      ovf_expand / ovf_route / ovf_frontier (P,) bool
+      ovf_expand (P,) bool: some hop's expansion exceeded EB
       cap (if capture): dict of (P, n_blocks, EB) arrays
-        src, dst, rank, eidx, keep — the final hop's edge set
+        src, dst, rank, eidx — the final hop's edge set (kept entries
+        compacted to a prefix; kcount (P, n_blocks) gives the counts)
 
     capture_hops=True is the MATCH mode (SURVEY §2 row 23 Traverse):
     the predicate is applied at EVERY hop (a MATCH edge pattern's filter
@@ -154,25 +145,25 @@ def build_traverse_fn(mesh, P: int, F: int, EB: int, steps: int,
     """
 
     def kernel(blocks_data, frontier):
-        fr = frontier[0]                       # (F,)
+        fbm = frontier[0]                      # (vmax,) bool
+        vmax = fbm.shape[0]
+        pid = jax.lax.axis_index("part").astype(jnp.int32)
         hop_edges: List[Any] = []
         ovf_e = jnp.zeros((), bool)
-        ovf_r = jnp.zeros((), bool)
-        ovf_f = jnp.zeros((), bool)
         cap_out = None
         hop_caps: List[Dict[str, Any]] = []
-        fcount = jnp.zeros((), jnp.int32)
 
         for hop in range(steps):
             last = hop == steps - 1
-            cands = []
+            marks = None
             edges_this_hop = jnp.zeros((), jnp.int32)
             caps = {"src": [], "dst": [], "rank": [], "eidx": [],
                     "kcount": []}
             for bi in range(n_blocks):
                 b = blocks_data[bi]
                 src, dst, rk, eidx, ve, total, ovf = _expand_block(
-                    b["indptr"][0], b["nbr"][0], b["rank"][0], fr, F, EB, P)
+                    b["indptr"][0], b["nbr"][0], b["rank"][0], fbm, EB, P,
+                    pid)
                 ovf_e = ovf_e | ovf
                 edges_this_hop = edges_this_hop + total
                 if pred is not None and (last or capture_hops):
@@ -192,7 +183,7 @@ def build_traverse_fn(mesh, P: int, F: int, EB: int, steps: int,
                     caps["eidx"].append(ce)
                     caps["kcount"].append(kc)
                 if not last:
-                    cands.append(jnp.where(keep, dst, -1))
+                    marks = _mark(dst, keep, P, vmax, marks)
             hop_edges.append(edges_this_hop)
             if capture and (last or capture_hops):
                 hop_caps.append({k: jnp.stack(v) for k, v in caps.items()})
@@ -211,38 +202,32 @@ def build_traverse_fn(mesh, P: int, F: int, EB: int, steps: int,
                                    for k in arr_keys}
                         kcount_out = hop_caps[-1]["kcount"][None]
                 # the post-final frontier is not needed for GO; report empty
-                fr = jnp.full((F,), -1, jnp.int32)
-                fcount = jnp.zeros((), jnp.int32)
+                fbm = jnp.zeros((vmax,), bool)
             else:
-                cand = jnp.concatenate(cands) if len(cands) > 1 else cands[0]
-                u, _ = _sorted_unique(cand)
-                out, sendc, ovf = _route(u, P, F)
-                ovf_r = ovf_r | ovf
-                recv = jax.lax.all_to_all(out, "part", 0, 0, tiled=False)
-                recv = recv.reshape(P, F)
-                fr, fcount, ovf = _merge_frontier(recv, F)
-                ovf_f = ovf_f | ovf
+                # ONE bool exchange: row d of marks goes to part d, which
+                # ORs the P received rows into its next frontier bitmap
+                recv = jax.lax.all_to_all(marks, "part", 0, 0, tiled=False)
+                fbm = recv.reshape(P, vmax).any(axis=0)
 
         res = {
-            "frontier": fr[None],
-            "fcount": fcount[None],
+            "frontier": fbm[None],
+            "fcount": jnp.sum(fbm, dtype=jnp.int32)[None],
             "hop_edges": jnp.stack(hop_edges)[None],
             "ovf_expand": ovf_e[None],
-            "ovf_route": ovf_r[None],
-            "ovf_frontier": ovf_f[None],
         }
         if capture:
             res["cap"] = cap_out
             res["kcount"] = kcount_out   # small: fetched with the meta
         return res
 
+    from jax.sharding import PartitionSpec
     spec = PartitionSpec("part")
     smapped = jax.shard_map(kernel, mesh=mesh,
                             in_specs=(spec, spec), out_specs=spec)
     return jax.jit(smapped)
 
 
-def build_traverse_fn_local(P: int, F: int, EB: int, steps: int,
+def build_traverse_fn_local(P: int, EB: int, steps: int,
                             n_blocks: int,
                             pred: Optional[Callable[[Dict[str, Any]], Any]] = None,
                             pred_cols: Sequence[str] = (),
@@ -250,16 +235,17 @@ def build_traverse_fn_local(P: int, F: int, EB: int, steps: int,
                             capture_hops: bool = False):
     """Single-chip variant: all P partitions resident on one device, the
     per-part kernel vmapped over the part axis, and the frontier exchange
-    a plain transpose (the degenerate all_to_all).  This is the program
-    that runs on one real chip (the bench config) — identical semantics
-    to the sharded build, no ICI.  capture_hops follows the sharded
-    contract (MATCH mode: per-hop pred + per-hop frames, cap arrays
-    (P, steps, n_blocks, EB)).
+    an OR-reduce over the mark matrices (the degenerate all_to_all).
+    This is the program that runs on one real chip (the bench config) —
+    identical semantics to the sharded build, no ICI.  capture_hops
+    follows the sharded contract (MATCH mode: per-hop pred + per-hop
+    frames, cap arrays (P, steps, n_blocks, EB)).
     """
+    pids = jnp.arange(P, dtype=jnp.int32)
 
-    def one_part_expand(block, fr, want_pred):
+    def one_part_expand(block, fbm, pid, want_pred):
         src, dst, rk, eidx, ve, total, ovf = _expand_block(
-            block["indptr"], block["nbr"], block["rank"], fr, F, EB, P)
+            block["indptr"], block["nbr"], block["rank"], fbm, EB, P, pid)
         if want_pred:
             cols = {"_rank": rk}
             for name in pred_cols:
@@ -271,18 +257,16 @@ def build_traverse_fn_local(P: int, F: int, EB: int, steps: int,
         return src, dst, rk, eidx, ve, keep, total, ovf
 
     def fn(blocks_data, frontier):
-        fr = frontier                      # (P, F)
+        fbm = frontier                     # (P, vmax) bool
+        vmax = fbm.shape[1]
         hop_edges = []
         ovf_e = jnp.zeros((P,), bool)
-        ovf_r = jnp.zeros((P,), bool)
-        ovf_f = jnp.zeros((P,), bool)
         cap_out = None
         hop_caps = []
-        fcount = jnp.zeros((P,), jnp.int32)
 
         for hop in range(steps):
             last = hop == steps - 1
-            cands = []
+            marks = None                   # (P_src, P_dst, vmax) bool
             edges = jnp.zeros((P,), jnp.int32)
             caps = {"src": [], "dst": [], "rank": [], "eidx": [],
                     "kcount": []}
@@ -290,10 +274,10 @@ def build_traverse_fn_local(P: int, F: int, EB: int, steps: int,
                 b = blocks_data[bi]
                 want_pred = pred is not None and (last or capture_hops)
                 src, dst, rk, eidx, ve, keep, total, ovf = jax.vmap(
-                    lambda ip, nb, rkk, prp, f: one_part_expand(
+                    lambda ip, nb, rkk, prp, f, pd: one_part_expand(
                         {"indptr": ip, "nbr": nb, "rank": rkk, "props": prp},
-                        f, want_pred)
-                )(b["indptr"], b["nbr"], b["rank"], b["props"], fr)
+                        f, pd, want_pred)
+                )(b["indptr"], b["nbr"], b["rank"], b["props"], fbm, pids)
                 ovf_e = ovf_e | ovf
                 edges = edges + total
                 if capture and (last or capture_hops):
@@ -307,7 +291,10 @@ def build_traverse_fn_local(P: int, F: int, EB: int, steps: int,
                     caps["eidx"].append(ce)
                     caps["kcount"].append(kc)
                 if not last:
-                    cands.append(jnp.where(keep, dst, -1))
+                    blk_marks = jax.vmap(
+                        lambda d, k: _mark(d, k, P, vmax))(dst, keep)
+                    marks = blk_marks if marks is None \
+                        else marks | blk_marks
             hop_edges.append(edges)
             if capture and (last or capture_hops):
                 # arrays (P, nb, EB); kcount (P, nb)
@@ -327,29 +314,17 @@ def build_traverse_fn_local(P: int, F: int, EB: int, steps: int,
                     else:
                         cap_out = {k: hop_caps[-1][k] for k in arr_keys}
                         kcount_out = hop_caps[-1]["kcount"]
-                fr = jnp.full((P, F), -1, jnp.int32)
-                fcount = jnp.zeros((P,), jnp.int32)
+                fbm = jnp.zeros((P, vmax), bool)
             else:
-                cand = (jnp.concatenate(cands, axis=1)
-                        if len(cands) > 1 else cands[0])    # (P, nb*EB)
-
-                def route_one(c):
-                    u, _ = _sorted_unique(c)
-                    return _route(u, P, F)
-                outs, sendc, ovr = jax.vmap(route_one)(cand)
-                ovf_r = ovf_r | ovr
-                recv = outs.transpose(1, 0, 2)              # dest-major
-                fr, fcount, ovr2 = jax.vmap(
-                    lambda r: _merge_frontier(r, F))(recv)
-                ovf_f = ovf_f | ovr2
+                # marks[s, d] = part s's candidate bitmap for part d;
+                # OR over sources = the exchange + merge in one reduce
+                fbm = marks.any(axis=0)
 
         res = {
-            "frontier": fr,
-            "fcount": fcount,
+            "frontier": fbm,
+            "fcount": jnp.sum(fbm, axis=1, dtype=jnp.int32),
             "hop_edges": jnp.stack(hop_edges, axis=1),      # (P, steps)
             "ovf_expand": ovf_e,
-            "ovf_route": ovf_r,
-            "ovf_frontier": ovf_f,
         }
         if capture:
             res["cap"] = cap_out

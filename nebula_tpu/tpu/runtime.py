@@ -89,7 +89,7 @@ class HopFrame:
     """
     __slots__ = ("src", "dst", "rank", "n", "order", "_us", "_ustart",
                  "_ucnt", "key_et", "key_s", "key_d",
-                 "_segs", "_decode_seg", "_eobjs", "_edone")
+                 "_segs", "_decode_seg", "_eobjs", "_edone", "_all_done")
 
     @classmethod
     def empty(cls) -> "HopFrame":
@@ -107,8 +107,9 @@ class HopFrame:
         f._ucnt = np.empty((0,), np.int64)
         f._segs = []
         f._decode_seg = None
-        f._eobjs = None
+        f._eobjs = np.empty((0,), object)
         f._edone = None
+        f._all_done = True
         return f
 
     @classmethod
@@ -134,6 +135,7 @@ class HopFrame:
         f._decode_seg = decode_seg
         f._eobjs = None
         f._edone = None
+        f._all_done = False
         return f
 
     def out_edges(self, dense_id: int):
@@ -171,9 +173,11 @@ class HopFrame:
     @property
     def edges(self) -> np.ndarray:
         """All Edge objects (decodes the whole frame once) — the DFS
-        consumers' (algorithms.py) contract."""
-        if self._eobjs is None or not self._edone.all():
+        consumers' (algorithms.py) contract.  O(1) once fully decoded
+        (ADVICE r3: per-access `_edone.all()` made DFS replay O(n²))."""
+        if not self._all_done:
             self.decode(np.arange(self.n, dtype=np.int64))
+            self._all_done = True
         return self._eobjs
 
 
@@ -186,9 +190,11 @@ class TpuRuntime:
         self.local_mode = self.mesh_size == 1
         self.snapshots: Dict[str, DeviceSnapshot] = {}
         self._fns: Dict[Tuple, Any] = {}
-        # program → last converged (F, EB): repeat queries start AT the
-        # converged buckets instead of re-climbing the escalation ladder
-        # (the ladder re-runs the kernel once per rung, per query)
+        # program → last converged (0, EB): repeat queries start AT the
+        # converged bucket instead of re-climbing the escalation ladder
+        # (the ladder re-runs the kernel once per rung, per query).
+        # Value stays a 2-tuple for cache-file compat; slot 0 (the old
+        # frontier bucket F) is always 0 with the bitmap frontier.
         self._buckets: Dict[Tuple, Tuple[int, int]] = {}
         # optional cross-process persistence (NEBULA_BUCKET_CACHE=path):
         # each escalation rung is a fresh XLA compile (~100s on a
@@ -209,7 +215,8 @@ class TpuRuntime:
                 self._buckets = {}
         self.max_retries = 10
         from ..utils.config import get_config
-        self.init_f = int(get_config().get("tpu_init_frontier"))
+        # the bitmap frontier (round-4 redesign) has no size bucket;
+        # the only escalating budget left is the per-block edge budget
         self.init_eb = int(get_config().get("tpu_init_edge_budget"))
         self.max_cap = 1 << 24          # escalation sanity bound
 
@@ -291,17 +298,17 @@ class TpuRuntime:
 
     # -- traversal --------------------------------------------------------
 
-    def _initial_frontier(self, dev: DeviceSnapshot, dense_ids: Sequence[int],
-                          F: int) -> Optional[np.ndarray]:
+    def _initial_frontier(self, dev: DeviceSnapshot,
+                          dense_ids: Sequence[int]) -> np.ndarray:
+        """Seed bitmap: (P, vmax) bool, row p marking part p's local ids
+        (dense = local * P + p).  The bitmap frontier has no capacity
+        bucket — any seed set fits (round-4 sort-free redesign)."""
         P = dev.num_parts
-        byp: List[List[int]] = [[] for _ in range(P)]
-        for d in sorted(set(int(x) for x in dense_ids if x >= 0)):
-            byp[d % P].append(d)
-        if max((len(b) for b in byp), default=0) > F:
-            return None
-        fr = np.full((P, F), -1, np.int32)
-        for p in range(P):
-            fr[p, :len(byp[p])] = byp[p]
+        fr = np.zeros((P, dev.vmax), bool)
+        d = np.asarray(sorted(set(int(x) for x in dense_ids if x >= 0)),
+                       np.int64)
+        if d.size:
+            fr[d % P, d // P] = True
         return fr
 
     def _blocks_for(self, dev: DeviceSnapshot, etypes: Sequence[str],
@@ -316,52 +323,49 @@ class TpuRuntime:
 
     def _escalate(self, dev: DeviceSnapshot, dense: Sequence[int],
                   key_fn, build_fn, inputs_fn, stats: "TraverseStats",
-                  min_buckets: Optional[Tuple[int, int]] = None):
+                  min_eb: Optional[int] = None):
         """Shared power-of-two bucket escalation driver for all device
-        programs (traverse, bfs): initial frontier layout, jit cache,
-        one batched fetch, overflow-driven retry (SURVEY §7 hard-part #1).
+        programs (traverse, bfs): seed bitmap layout, jit cache, one
+        batched fetch, overflow-driven retry (SURVEY §7 hard-part #1).
 
-        key_fn(F, EB) → jit-cache key; build_fn(F, EB) → jitted program
-        fn(*inputs, frontier); inputs_fn(F, EB) → tuple of extra inputs.
+        key_fn(EB) → jit-cache key; build_fn(EB) → jitted program
+        fn(*inputs, frontier); inputs_fn(EB) → tuple of extra inputs.
+
+        With the bitmap frontier (round-4 redesign) the only dynamic
+        budget is the per-block edge budget EB — the frontier and the
+        routing buckets are structurally overflow-free.
         """
-        P = dev.num_parts
-        cnt = [0] * P
-        for d in set(dense):
-            cnt[d % P] += 1
-        F = max(self.init_f, _pow2(max(cnt)))
         EB = self.init_eb
-        if min_buckets is not None:
-            # caller knows a static bound (e.g. BFS: frontier ≤ vmax,
-            # hop edges ≤ the block's padded Emax) — start there and
+        if min_eb is not None:
+            # caller knows a static bound (e.g. BFS: one hop's expansion
+            # never exceeds the block's padded Emax) — start there and
             # never climb the recompile ladder
-            F = min(max(F, min_buckets[0]), self.max_cap)
-            EB = min(max(EB, min_buckets[1]), self.max_cap)
-        # cache key includes the frontier-size bucket: one supernode
-        # query must not permanently inflate every later small query of
-        # the same program to supernode-sized padded kernels
-        bkey = (key_fn(0, 0), _pow2(max(len(set(dense)), 1)))
+            EB = min(max(EB, min_eb), self.max_cap)
+        # cache key includes the seed-count bucket: one supernode query
+        # must not permanently inflate every later small query of the
+        # same program to supernode-sized padded kernels
+        bkey = (key_fn(0), _pow2(max(len(set(dense)), 1)))
         prev = self._buckets.get(bkey)
         if prev is not None:
-            F, EB = max(F, prev[0]), max(EB, prev[1])
+            # value kept as (F, EB) for cache-file compat; F is 0 now
+            EB = max(EB, prev[-1])
         if self.local_mode:
             target = self.mesh.devices.reshape(-1)[0]
         else:
             target = NamedSharding(self.mesh, PartitionSpec("part"))
 
+        fr_np = self._initial_frontier(dev, dense)
+        tp = time.perf_counter()
+        frontier = jax.device_put(fr_np, target)
+        stats.put_s = time.perf_counter() - tp
+
         for attempt in range(self.max_retries):
             stats.retries = attempt
-            fr_np = self._initial_frontier(dev, dense, F)
-            if fr_np is None:
-                F *= 2
-                continue
-            key = key_fn(F, EB)
+            key = key_fn(EB)
             fn = self._fns.get(key)
             if fn is None:
-                fn = self._fns[key] = build_fn(F, EB)
-            tp = time.perf_counter()
-            frontier = jax.device_put(fr_np, target)
+                fn = self._fns[key] = build_fn(EB)
             t0 = time.perf_counter()
-            stats.put_s = t0 - tp
             from ..utils.config import get_config
             prof_dir = get_config().get("tpu_profiler_dir")
             if prof_dir:
@@ -375,10 +379,10 @@ class TpuRuntime:
                 run_dir = _os.path.join(str(prof_dir),
                                         f"run{self._prof_seq:06d}")
                 with jax.profiler.trace(run_dir):
-                    res = fn(*inputs_fn(F, EB), frontier)
+                    res = fn(*inputs_fn(EB), frontier)
                     jax.block_until_ready(res)
             else:
-                res = fn(*inputs_fn(F, EB), frontier)
+                res = fn(*inputs_fn(EB), frontier)
                 jax.block_until_ready(res)
             t1 = time.perf_counter()
             stats.device_s = t1 - t0
@@ -393,29 +397,21 @@ class TpuRuntime:
             res = jax.device_get(res)
             stats.fetch_s = time.perf_counter() - t1
 
-            esc = False
             if res["ovf_expand"].any():
                 # hop_edges reports the true per-part pre-filter expansion
                 # size, so jump STRAIGHT to the needed bucket — blind
                 # doubling needs ~20 rounds for a 1-seed BFS over a
-                # 30M-edge graph and times out the retry budget
+                # 30M-edge graph and times out the retry budget.  Drop
+                # the failed rung's device capture buffers BEFORE the
+                # larger rung runs — holding both nearly doubles peak
+                # HBM and can fail a retry that would converge.
                 need = _pow2(int(res["hop_edges"].max()))
                 EB = min(max(EB * 2, need), self.max_cap)
-                esc = True
-            if res["ovf_route"].any() or res["ovf_frontier"].any():
-                # frontier size is only known post-dedup (the overflow
-                # truncated it) — jump 4x per round instead of 2x
-                F = min(F * 4, self.max_cap)
-                esc = True
-            if esc:
-                # drop the failed rung's device capture buffers BEFORE
-                # the larger rung runs — holding both nearly doubles
-                # peak HBM and can fail a retry that would converge
                 cap_dev = None
-            if not esc:
-                stats.f_cap, stats.e_cap = F, EB
-                if self._buckets.get(bkey) != (F, EB):
-                    self._buckets[bkey] = (F, EB)
+            else:
+                stats.f_cap, stats.e_cap = 0, EB
+                if self._buckets.get(bkey) != (0, EB):
+                    self._buckets[bkey] = (0, EB)
                     # bound by evicting oldest entries — a wholesale
                     # clear() would also wipe the persistent cache file
                     # on the next save, re-exposing every converged
@@ -491,22 +487,22 @@ class TpuRuntime:
                        if n != "_rank"}}
             for bk in block_keys)
 
-        def build(F, EB):
+        def build(EB):
             if self.local_mode:
                 return build_traverse_fn_local(
-                    P, F, EB, steps, len(block_keys), pred=pred,
+                    P, EB, steps, len(block_keys), pred=pred,
                     pred_cols=pred_cols, capture=capture)
             return build_traverse_fn(
-                self.mesh, P, F, EB, steps, len(block_keys),
+                self.mesh, P, EB, steps, len(block_keys),
                 pred=pred, pred_cols=pred_cols, capture=capture)
 
         res = self._escalate(
             dev, dense,
-            key_fn=lambda F, EB: (space, dev.epoch, tuple(block_keys),
-                                  steps, F, EB, pred_key, capture,
-                                  tuple(pred_cols)),
+            key_fn=lambda EB: (space, dev.epoch, tuple(block_keys),
+                               steps, EB, pred_key, capture,
+                               tuple(pred_cols)),
             build_fn=build,
-            inputs_fn=lambda F, EB: (blocks_data,),
+            inputs_fn=lambda EB: (blocks_data,),
             stats=stats)
         if not capture:
             stats.total_s = time.perf_counter() - t_start
@@ -580,23 +576,23 @@ class TpuRuntime:
                        if n != "_rank"}}
             for bk in block_keys)
 
-        def build(F, EB):
+        def build(EB):
             if self.local_mode:
                 return build_traverse_fn_local(
-                    P, F, EB, max_hop, len(block_keys), pred=pred,
+                    P, EB, max_hop, len(block_keys), pred=pred,
                     pred_cols=pred_cols, capture=True, capture_hops=True)
             return build_traverse_fn(
-                self.mesh, P, F, EB, max_hop, len(block_keys),
+                self.mesh, P, EB, max_hop, len(block_keys),
                 pred=pred, pred_cols=pred_cols, capture=True,
                 capture_hops=True)
 
         res = self._escalate(
             dev, dense,
-            key_fn=lambda F, EB: (space, dev.epoch, "hops",
-                                  tuple(block_keys), max_hop, F, EB,
-                                  pred_key, tuple(pred_cols)),
+            key_fn=lambda EB: (space, dev.epoch, "hops",
+                               tuple(block_keys), max_hop, EB,
+                               pred_key, tuple(pred_cols)),
             build_fn=build,
-            inputs_fn=lambda F, EB: (blocks_data,),
+            inputs_fn=lambda EB: (blocks_data,),
             stats=stats)
 
         t_mat = time.perf_counter()
@@ -734,33 +730,32 @@ class TpuRuntime:
                            if n != "_rank"}} if pred is not None else {})}
             for bk in block_keys)
 
-        def build(F, EB):
+        def build(EB):
             if self.local_mode:
-                return build_bfs_fn_local(P, F, EB, max_steps,
+                return build_bfs_fn_local(P, EB, max_steps,
                                           len(block_keys), dev.vmax,
                                           pred=pred, pred_cols=pred_cols)
-            return build_bfs_fn(self.mesh, P, F, EB, max_steps,
+            return build_bfs_fn(self.mesh, P, EB, max_steps,
                                 len(block_keys), dev.vmax,
                                 pred=pred, pred_cols=pred_cols)
 
-        # BFS buckets are statically bounded: a frontier never exceeds
-        # the per-part vertex count, and one hop's expansion never
-        # exceeds the block's padded edge capacity — start there and
-        # compile exactly once (escalation recompiles cost ~100s each on
-        # a tunneled chip; BFS has no capture arrays, so the memory cost
-        # of full-size buckets is just the transient expansion buffers)
-        f_bound = _pow2(max(dev.vmax, 1))
+        # The BFS edge budget is statically bounded: one hop's expansion
+        # never exceeds the block's padded edge capacity — start there
+        # and compile exactly once (escalation recompiles cost ~100s
+        # each on a tunneled chip; BFS has no capture arrays, so the
+        # memory cost of a full-size bucket is just the transient
+        # expansion buffers)
         eb_bound = max(_pow2(max(dev.blocks[bk].nbr.shape[-1], 1))
                        for bk in block_keys)
         res = self._escalate(
             dev, dense,
-            key_fn=lambda F, EB: (space, dev.epoch, "bfs",
-                                  tuple(block_keys), max_steps, F, EB,
-                                  pred_key, tuple(pred_cols)),
+            key_fn=lambda EB: (space, dev.epoch, "bfs",
+                               tuple(block_keys), max_steps, EB,
+                               pred_key, tuple(pred_cols)),
             build_fn=build,
-            inputs_fn=lambda F, EB: (blocks_data,),
+            inputs_fn=lambda EB: (blocks_data,),
             stats=stats,
-            min_buckets=(f_bound, eb_bound))
+            min_eb=eb_bound)
         return res["dist"], stats
 
     # -- host materialization --------------------------------------------
@@ -847,6 +842,17 @@ class TpuRuntime:
                 names, [np.empty(0, object) for _ in yields])
         if len(per_block) == 1:
             return ColumnarDataSet(names, per_block[0])
-        return ColumnarDataSet(
-            names, [np.concatenate([blk[j] for blk in per_block])
-                    for j in range(len(yields))])
+
+        def _cat(j):
+            # ADVICE r3: int+float blocks (multi-etype GO) must not
+            # upcast to float64 — that silently turns 5 into 5.0 and
+            # diverges from the host path's exact per-element types.
+            # Mixed numeric kinds concatenate as object instead.
+            blks = [blk[j] for blk in per_block]
+            kinds = {b.dtype.kind for b in blks}
+            if len(kinds) > 1 and "O" not in kinds:
+                blks = [b.astype(object) for b in blks]
+            return np.concatenate(blks)
+
+        return ColumnarDataSet(names, [_cat(j)
+                                       for j in range(len(yields))])

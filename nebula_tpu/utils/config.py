@@ -155,8 +155,6 @@ define_flag("minloglevel", 0, "log severity threshold")
 define_flag("v", 0, "verbose log level")
 define_flag("enable_authorize", False, "require password auth in graphd")
 define_flag("tpu_enable", True, "allow the device execution plane")
-define_flag("tpu_init_frontier", 256,
-            "initial frontier bucket (power of two)")
 define_flag("tpu_init_edge_budget", 2048,
             "initial per-block edge budget (power of two)")
 define_flag("scheduler_threads", 4,

@@ -270,13 +270,22 @@ def test_snapshot_compaction_and_laggard_catchup(tmp_path):
         leader = wait_leader(parts)
         lag = [p for p in parts if p is not leader][0]
         lag_i = parts.index(lag)
+        # isolate the laggard from BOTH peers: it can neither receive
+        # entries nor win an election.  A CPU-starved election may still
+        # move leadership between the other two mid-loop (propose then
+        # returns False) — follow the new leader instead of failing.
         for o in parts:
-            if o is not leader:
-                pass
-        tr.partition(leader.node_id, lag.node_id)
+            if o is not lag:
+                tr.partition(o.node_id, lag.node_id)
         n_entries = 25
-        for i in range(n_entries):
-            assert leader.propose(f"s{i}".encode())
+        deadline = time.monotonic() + 15
+        i = 0
+        while i < n_entries:
+            if leader.propose(f"s{i}".encode()):
+                i += 1
+            else:
+                assert time.monotonic() < deadline, "no stable leader"
+                leader = wait_leader([p for p in parts if p is not lag])
         want = [f"s{i}".encode() for i in range(n_entries)]
         wait_applied(apps, want, exclude=(lag_i,))
         # leader compacted its log past the laggard's position

@@ -185,7 +185,7 @@ def test_bucket_escalation(rt):
     """Tiny initial buckets must converge via doubling, same answer."""
     st = random_store(6, n=200, avg_deg=8)
     small = TpuRuntime(make_mesh(P))
-    small.init_f, small.init_eb = 2, 4
+    small.init_eb = 4
     rows, stats = small.traverse(st, "g", [1, 2, 3, 4], ["knows"], "out", 3)
     got = sorted(norm_edge(e) for (_, e, _) in rows)
     want = host_go(st, "g", [1, 2, 3, 4], ["knows"], "out", 3)

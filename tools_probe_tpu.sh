@@ -1,20 +1,35 @@
 #!/bin/bash
-# TPU tunnel probe loop (VERDICT r2 item 1): log every probe with a
-# timestamp so a wedged tunnel is attributable to environment, not the
-# framework.  Appends one line per probe to .tpu_probe.log; exits as
-# soon as a probe succeeds (leaving PLATFORM=tpu as the last line).
+# TPU tunnel probe loop (VERDICT r2 item 1, r3 item 1): log every probe
+# with a timestamp so a wedged tunnel is attributable to environment,
+# not the framework.  Appends one line per probe to .tpu_probe.log.
+#
+# r4 fixes: the r3 loop grepped for PLATFORM=tpu, which can never match
+# the axon tunnel's platform string ("axon") — successful probes were
+# logged as anonymous rc=0 lines and the loop never exited.  Now any
+# non-cpu platform counts as OK, the FULL probe stdout is logged, the
+# probe's own exit status is captured (not the log pipeline's), and a
+# lockfile (.tpu_in_use, created by bench.py around device runs) skips
+# probing while a bench run holds the chip (concurrent clients contend
+# for the single chip claim and can wedge the tunnel).
 LOG=/root/repo/.tpu_probe.log
+LOCK=/root/repo/.tpu_in_use
+TMP=$(mktemp)
+trap 'rm -f "$TMP"' EXIT
 while true; do
   TS=$(date -u +"%Y-%m-%dT%H:%M:%SZ")
-  OUT=$(timeout 150 python -c "import jax; d=jax.devices(); print('PLATFORM='+d[0].platform)" 2>&1 | tail -1)
-  RC=$?
-  if [ $RC -eq 124 ] || [ $RC -eq 143 ]; then
-    echo "$TS probe TIMEOUT (150s) — tunnel wedged" >> "$LOG"
-  elif echo "$OUT" | grep -q "PLATFORM=tpu"; then
-    echo "$TS probe OK: $OUT" >> "$LOG"
-    exit 0
+  if [ -e "$LOCK" ]; then
+    echo "$TS probe SKIPPED (chip held by $(cat "$LOCK" 2>/dev/null))" >> "$LOG"
   else
-    echo "$TS probe rc=$RC: $OUT" >> "$LOG"
+    timeout 150 python -c "import jax; d=jax.devices(); print('PLATFORM='+d[0].platform+' N='+str(len(d)))" > "$TMP" 2>&1
+    RC=$?
+    OUT=$(grep -v "^WARNING" "$TMP" | tail -2 | tr '\n' ' ')
+    if [ $RC -eq 124 ] || [ $RC -eq 143 ]; then
+      echo "$TS probe TIMEOUT (150s) — tunnel wedged" >> "$LOG"
+    elif echo "$OUT" | grep -qE "PLATFORM=(tpu|axon)"; then
+      echo "$TS probe OK: $OUT" >> "$LOG"
+    else
+      echo "$TS probe rc=$RC: $OUT" >> "$LOG"
+    fi
   fi
   sleep 600
 done
