@@ -139,11 +139,18 @@ def _spaces(meta, space: Optional[str]) -> List[str]:
     return sorted(n for n in meta.catalog.spaces)
 
 
-def balance_data(store, space: Optional[str] = None) -> Dict[str, Any]:
+def balance_data(store, space: Optional[str] = None,
+                 exclude: Optional[List[str]] = None) -> Dict[str, Any]:
     """Heal under-replication (dead hosts), spread parts over new hosts,
-    drop dead replicas.  Returns the executed plan."""
+    drop dead replicas.  Returns the executed plan.
+
+    `exclude` (BALANCE DATA REMOVE "host"): drain — the listed hosts are
+    treated as gone, so their replicas re-home onto the remaining alive
+    hosts and the drained copies are dropped; afterwards DROP HOSTS can
+    remove them from the cluster."""
     meta, sc = store.meta, store.sc
-    alive = _alive_storage(meta)
+    alive = [h for h in _alive_storage(meta)
+             if not exclude or h not in exclude]
     if not alive:
         raise BalanceError("no alive storage hosts")
     plan: List[Dict[str, Any]] = []

@@ -91,6 +91,20 @@ class DistributedStore:
     def drop_space(self, name: str, if_exists=False):
         self.meta.drop_space(name, if_exists=if_exists)
 
+    def clear_space(self, name: str, if_exists=False):
+        """CLEAR SPACE across the cluster: one raft-replicated
+        clear_part per partition (data gone on every replica), schema
+        untouched."""
+        from ..graphstore.schema import SchemaError
+        try:
+            self.catalog.get_space(name)
+        except SchemaError:
+            if if_exists:
+                return
+            raise
+        for pid in range(len(self.meta.parts_of(name))):
+            self._write(name, pid, ("clear_part", pid))
+
     def space(self, name: str):
         """Minimal space info for executors (partition count, epoch)."""
         return _SpaceView(self, name)

@@ -190,6 +190,15 @@ class MetaClient:
     def drop_zone(self, zone: str):
         self.call("meta.drop_zone", zone=zone)
 
+    def merge_zones(self, zones, into: str):
+        self.call("meta.merge_zones", zones=list(zones), into=into)
+
+    def rename_zone(self, old: str, new: str):
+        self.call("meta.rename_zone", old=old, new=new)
+
+    def drop_hosts(self, hosts):
+        self.call("meta.drop_hosts", hosts=list(hosts))
+
     def list_zones(self):
         return self.call("meta.list_zones")
 

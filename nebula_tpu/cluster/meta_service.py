@@ -190,6 +190,45 @@ class MetaState:
             raise RpcError(f"zone `{c['zone']}' not found")
         self.zones.pop(c["zone"])
 
+    def _ap_merge_zones(self, c):
+        """MERGE ZONE a,b INTO z: union the member hosts, drop sources.
+        The target may be one of the sources or a new zone."""
+        for z in c["zones"]:
+            if z not in self.zones:
+                raise RpcError(f"zone `{z}' not found")
+        members: List[str] = []
+        for z in c["zones"]:
+            for h in self.zones.pop(z):
+                if h not in members:
+                    members.append(h)
+        tgt = self.zones.setdefault(c["into"], [])
+        for h in members:
+            if h not in tgt:
+                tgt.append(h)
+
+    def _ap_rename_zone(self, c):
+        if c["old"] not in self.zones:
+            raise RpcError(f"zone `{c['old']}' not found")
+        if c["new"] in self.zones:
+            raise RpcError(f"zone `{c['new']}' already exists")
+        self.zones[c["new"]] = self.zones.pop(c["old"])
+
+    def _ap_drop_hosts(self, c):
+        """DROP HOSTS: remove hosts from placement metadata.  Refused
+        while any part replica still lives on the host — BALANCE DATA
+        REMOVE must drain it first (reference semantics)."""
+        for h in c["hosts"]:
+            for sp, pm in self.part_map.items():
+                for pid, reps in enumerate(pm):
+                    if h in reps:
+                        raise RpcError(
+                            f"host {h} still holds {sp}/part {pid}; "
+                            f"run BALANCE DATA REMOVE first")
+        for h in c["hosts"]:
+            for hs in self.zones.values():
+                if h in hs:
+                    hs.remove(h)
+
     def _ap_allocate_ids(self, c):
         start = self.next_alloc_id
         self.next_alloc_id += int(c["count"])
@@ -451,6 +490,27 @@ class MetaService:
 
     def rpc_drop_zone(self, p):
         return self._propose({"op": "drop_zone", "zone": p["zone"]})
+
+    def rpc_merge_zones(self, p):
+        return self._propose({"op": "merge_zones", "zones": list(p["zones"]),
+                              "into": p["into"]})
+
+    def rpc_rename_zone(self, p):
+        return self._propose({"op": "rename_zone", "old": p["old"],
+                              "new": p["new"]})
+
+    def rpc_drop_hosts(self, p):
+        with self.state_lock:
+            zoned = {h for hs in self.state.zones.values() for h in hs}
+        for h in p["hosts"]:
+            if h not in self.active_hosts and h not in zoned:
+                raise RpcError(f"host {h} not found")
+        out = self._propose({"op": "drop_hosts", "hosts": list(p["hosts"])})
+        # liveness is leader-local (not raft state): forget the host so
+        # SHOW HOSTS stops listing it
+        for h in p["hosts"]:
+            self.active_hosts.pop(h, None)
+        return out
 
     def rpc_list_zones(self, p):
         with self.state_lock:

@@ -27,7 +27,7 @@ from .rpc import RpcError, RpcRaftTransport, RpcServer
 _STORAGE_OPS = frozenset({
     "vertex", "edge_half", "del_vertex", "del_edge_half", "upd_vertex",
     "upd_edge_half", "del_tag", "rebuild_index", "rebuild_fulltext",
-    "chain_mark", "chain_done", "batch"})
+    "chain_mark", "chain_done", "batch", "clear_part"})
 
 
 def _validate_cmd(cmd) -> tuple:
@@ -231,6 +231,8 @@ class StorageService:
                                       updates, which)
         elif op == "del_tag":
             st.delete_tag(space, cmd[1], cmd[2])
+        elif op == "clear_part":
+            st.clear_part(space, cmd[1])
         elif op == "rebuild_index":
             st.rebuild_index(space, cmd[1], parts=[cmd[2]])
         elif op == "rebuild_fulltext":

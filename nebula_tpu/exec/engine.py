@@ -34,6 +34,7 @@ class Session:
         self.created = time.time()
         self.last_used = self.created
         self.queries: Dict[int, str] = {}
+        self.killed = False
 
 
 class QueryEngine:
@@ -46,13 +47,27 @@ class QueryEngine:
         self.store = store if store is not None else GraphStore()
         self.qctx = QueryContext(self.store, params)
         self.qctx.tpu_runtime = tpu_runtime
+        self.qctx.engine = self          # session admin (KILL SESSION)
         self.scheduler = Scheduler(self.qctx)
         self.enable_optimizer = enable_optimizer
         self._slow_override = (params or {}).get("slow_query_threshold_us")
         self.slow_log: list = []
+        self.sessions: Dict[int, Session] = {}
 
     def new_session(self, user: str = "root") -> Session:
-        return Session(user)
+        s = Session(user)
+        self.sessions[s.id] = s
+        return s
+
+    def kill_session(self, sid: int) -> bool:
+        """KILL SESSION <id>: the session's next execute is rejected.
+        Returns False when the id is unknown (standalone engine only —
+        the cluster layer kills through metad)."""
+        s = self.sessions.pop(sid, None)
+        if s is None:
+            return False
+        s.killed = True
+        return True
 
     @property
     def slow_query_us(self) -> int:
@@ -66,6 +81,10 @@ class QueryEngine:
     def execute(self, session: Session, text: str,
                 params: Optional[Dict[str, Any]] = None) -> ResultSet:
         t0 = time.perf_counter()
+        if session.killed:
+            rs = ResultSet()
+            rs.error = "Session was killed"
+            return rs
         session.last_used = time.time()
         from ..utils.stats import stats
         try:
@@ -146,7 +165,8 @@ class QueryEngine:
             from ..utils.config import get_config
             plan = optimize(plan, enable=self.enable_optimizer,
                             tpu=self.qctx.tpu_runtime is not None
-                            and bool(get_config().get("tpu_enable")))
+                            and bool(get_config().get("tpu_enable")),
+                            pctx=pctx)
         except QueryError as ex:
             return ResultSet(error=f"SemanticError: {ex}")
 

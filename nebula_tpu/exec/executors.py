@@ -1253,7 +1253,7 @@ def _drop_index(node, qctx, ectx, space):
 def _rebuild_index(node, qctx, ectx, space):
     a = node.args
     from .jobs import job_manager
-    job = job_manager().submit(qctx, f"rebuild index {a['index_name']}",
+    job = job_manager(qctx.store).submit(qctx, f"rebuild index {a['index_name']}",
                                a["space"])
     return DataSet(["New Job Id"], [[job.job_id]])
 
@@ -1317,7 +1317,7 @@ def _rebuild_ft_index(node, qctx, ectx, space):
     from .jobs import job_manager
     cmd = "rebuild fulltext" + (f" {a['index_name']}"
                                 if a.get("index_name") else "")
-    job = job_manager().submit(qctx, cmd, a["space"])
+    job = job_manager(qctx.store).submit(qctx, cmd, a["space"])
     return DataSet(["New Job Id"], [[job.job_id]])
 
 
@@ -1434,12 +1434,15 @@ def _show(node, qctx, ectx, space):
             rows.append([0, ltype, ep, "ONLINE", st.get("lag", 0)])
         return DataSet(["PartId", "Type", "Host", "Status", "Lag"], rows)
     if kind == "hosts":
+        role = a.get("extra")               # None | graph | storage | meta
         cluster = getattr(qctx, "cluster", None)
         if cluster is not None:
             with cluster.lock:
                 pm = dict(cluster.part_map)
             rows = []
             for h in cluster.list_hosts():
+                if role is not None and h.get("role") != role:
+                    continue
                 host, port = h["addr"].rsplit(":", 1)
                 leaders = sum(1 for parts in pm.values()
                               for reps in parts if reps[:1] == [h["addr"]])
@@ -1453,6 +1456,18 @@ def _show(node, qctx, ectx, space):
         return DataSet(["Host", "Port", "Status", "Leader count",
                         "Partition distribution"],
                        [["127.0.0.1", 0, "ONLINE", 0, "in-process"]])
+    if kind in ("tag_indexes_status", "edge_indexes_status"):
+        from .jobs import job_manager
+        rows = [[j.command[len("rebuild index "):], j.status]
+                for j in sorted(job_manager(qctx.store).jobs.values(),
+                                key=lambda x: x.job_id)
+                if j.command.startswith("rebuild index ")]
+        return DataSet(["Name", "Index Status"], rows)
+    if kind == "text_search_clients":
+        from ..graphstore.fulltext import text_services
+        return DataSet(["Host", "Port", "Connection type"],
+                       [[c["host"], c["port"], c["conn"]]
+                        for c in text_services(qctx.store).clients])
     if kind == "parts":
         sp = a.get("space")
         if not sp:
@@ -1487,19 +1502,25 @@ def _show(node, qctx, ectx, space):
                 ["SessionId", "UserName", "SpaceName", "GraphAddr"],
                 [[s["sid"], s["user"], s.get("space"), s["graphd"]]
                  for s in cluster.list_sessions()])
-        return DataSet(["SessionId", "SpaceName"], [])
+        eng = getattr(qctx, "engine", None)
+        rows = [[s.id, s.user, s.space, "in-process"]
+                for s in (eng.sessions.values() if eng else ())]
+        return DataSet(["SessionId", "UserName", "SpaceName", "GraphAddr"],
+                       sorted(rows))
     if kind == "snapshots":
         from .jobs import list_snapshots
         return list_snapshots()
     if kind == "queries":
-        return DataSet(["SessionId", "Query", "Status"], [])
+        eng = getattr(qctx, "engine", None)
+        rows = []
+        if eng is not None:
+            for s in eng.sessions.values():
+                for qid, qtext in s.queries.items():
+                    rows.append([s.id, qtext, "RUNNING"])
+        return DataSet(["SessionId", "Query", "Status"], rows)
     if kind == "configs":
-        from ..utils.config import get_config
-        rows = [["graph", k, type(v).__name__, "MUTABLE", str(v)]
-                for k, v in sorted(get_config().all_values().items())]
-        rows += [["session", k, type(v).__name__, "MUTABLE", str(v)]
-                 for k, v in sorted(qctx.params.items())]
-        return DataSet(["Module", "Name", "Type", "Mode", "Value"], rows)
+        return DataSet(["Module", "Name", "Type", "Mode", "Value"],
+                       _config_rows(qctx))
     if kind == "create":
         which, name = a["extra"]
         sp = a.get("space")
@@ -1524,23 +1545,174 @@ def _show(node, qctx, ectx, space):
     raise ExecError(f"unsupported SHOW {kind}")
 
 
-@executor("AddHosts")
-def _add_hosts(node, qctx, ectx, space):
+def _need_cluster(qctx, what: str):
     cluster = getattr(qctx, "cluster", None)
     if cluster is None:
-        raise ExecError("ADD HOSTS ... INTO ZONE needs cluster mode "
-                        "(zones are a metad placement concept)")
+        raise ExecError(f"{what} needs cluster mode "
+                        "(hosts/zones are a metad placement concept)")
+    return cluster
+
+
+@executor("AddHosts")
+def _add_hosts(node, qctx, ectx, space):
+    cluster = _need_cluster(qctx, "ADD HOSTS ... INTO ZONE")
     cluster.add_hosts_to_zone(node.args["hosts"], node.args["zone"])
+    return DataSet()
+
+
+@executor("DropHosts")
+def _drop_hosts(node, qctx, ectx, space):
+    from ..cluster.rpc import RpcError
+    cluster = _need_cluster(qctx, "DROP HOSTS")
+    try:
+        cluster.drop_hosts(node.args["hosts"])
+    except RpcError as ex:
+        raise ExecError(str(ex)) from None
     return DataSet()
 
 
 @executor("DropZone")
 def _drop_zone(node, qctx, ectx, space):
-    cluster = getattr(qctx, "cluster", None)
-    if cluster is None:
-        raise ExecError("DROP ZONE needs cluster mode")
+    cluster = _need_cluster(qctx, "DROP ZONE")
     cluster.drop_zone(node.args["zone"])
     return DataSet()
+
+
+@executor("MergeZone")
+def _merge_zone(node, qctx, ectx, space):
+    from ..cluster.rpc import RpcError
+    cluster = _need_cluster(qctx, "MERGE ZONE")
+    try:
+        cluster.merge_zones(node.args["zones"], node.args["into"])
+    except RpcError as ex:
+        raise ExecError(str(ex)) from None
+    return DataSet()
+
+
+@executor("RenameZone")
+def _rename_zone(node, qctx, ectx, space):
+    from ..cluster.rpc import RpcError
+    cluster = _need_cluster(qctx, "RENAME ZONE")
+    try:
+        cluster.rename_zone(node.args["old"], node.args["new"])
+    except RpcError as ex:
+        raise ExecError(str(ex)) from None
+    return DataSet()
+
+
+@executor("DescZone")
+def _desc_zone(node, qctx, ectx, space):
+    cluster = _need_cluster(qctx, "DESC ZONE")
+    zones = cluster.list_zones()
+    z = node.args["zone"]
+    if z not in zones:
+        raise ExecError(f"zone `{z}' not found")
+    return DataSet(["Hosts"], [[h] for h in zones[z]])
+
+
+@executor("ClearSpace")
+def _clear_space(node, qctx, ectx, space):
+    from ..graphstore.schema import SchemaError
+    try:
+        qctx.store.clear_space(node.args["name"],
+                               if_exists=node.args["if_exists"])
+    except SchemaError as ex:
+        raise ExecError(str(ex)) from None
+    return DataSet()
+
+
+@executor("StopJob")
+def _stop_job(node, qctx, ectx, space):
+    from .jobs import stop_job
+    try:
+        return stop_job(node, qctx)
+    except ValueError as ex:
+        raise ExecError(str(ex)) from None
+
+
+@executor("RecoverJob")
+def _recover_job(node, qctx, ectx, space):
+    from .jobs import recover_job
+    try:
+        return recover_job(node, qctx)
+    except ValueError as ex:
+        raise ExecError(str(ex)) from None
+
+
+@executor("KillSession")
+def _kill_session(node, qctx, ectx, space):
+    sid = node.args["session_id"]
+    cluster = getattr(qctx, "cluster", None)
+    if cluster is not None:
+        # metad's table names the OWNING graphd — the kill must reach it
+        # so its live session registry drops the entry too (removing the
+        # metad row alone would leave the session serving queries)
+        sess = next((s for s in cluster.list_sessions()
+                     if s["sid"] == sid), None)
+        if sess is None:
+            raise ExecError(f"session {sid} not found")
+        try:
+            from ..cluster.rpc import RpcClient
+            RpcClient.from_addr(sess["graphd"]).call(
+                "graph.kill_session", session_id=sid)
+        except Exception:  # noqa: BLE001 — owner down: still drop meta row
+            cluster.remove_session(sid)
+        return DataSet()
+    eng = getattr(qctx, "engine", None)
+    if eng is None or not eng.kill_session(sid):
+        raise ExecError(f"session {sid} not found")
+    return DataSet()
+
+
+def _config_rows(qctx):
+    """One row per flag + session param — the shared currency of SHOW
+    CONFIGS and GET CONFIGS (they must never drift)."""
+    from ..utils.config import get_config
+    rows = [["graph", k, type(v).__name__, "MUTABLE", str(v)]
+            for k, v in sorted(get_config().all_values().items())]
+    rows += [["session", k, type(v).__name__, "MUTABLE", str(v)]
+             for k, v in sorted(qctx.params.items())]
+    return rows
+
+
+@executor("GetConfigs")
+def _get_configs(node, qctx, ectx, space):
+    name = node.args.get("name")
+    rows = _config_rows(qctx)
+    if name is not None:
+        rows = [r for r in rows if r[1] == name]
+        if not rows:
+            raise ExecError(f"unknown config `{name}'")
+    return DataSet(["Module", "Name", "Type", "Mode", "Value"], rows)
+
+
+@executor("SignInTextService")
+def _sign_in_text_service(node, qctx, ectx, space):
+    from ..graphstore.fulltext import text_services
+    text_services(qctx.store).sign_in(
+        node.args["endpoints"], node.args.get("user"),
+        node.args.get("password"))
+    return DataSet()
+
+
+@executor("SignOutTextService")
+def _sign_out_text_service(node, qctx, ectx, space):
+    from ..graphstore.fulltext import text_services
+    try:
+        text_services(qctx.store).sign_out()
+    except ValueError as ex:
+        raise ExecError(str(ex)) from None
+    return DataSet()
+
+
+@executor("DescribeUser")
+def _describe_user(node, qctx, ectx, space):
+    name = node.args["name"]
+    u = qctx.catalog.users.get(name)
+    if u is None:
+        raise ExecError(f"user `{name}' not found")
+    rows = [[r, sp] for sp, r in sorted(u.roles.items())]
+    return DataSet(["role", "space"], rows)
 
 
 @executor("CreateUser")

@@ -15,9 +15,6 @@ from typing import Any, Dict, List, Optional
 
 from ..core.value import DataSet
 
-_job_ids = itertools.count(1)
-
-
 @dataclass
 class Job:
     job_id: int
@@ -26,14 +23,16 @@ class Job:
     start_time: float = 0.0
     stop_time: float = 0.0
     result: Optional[Dict[str, Any]] = None
+    space: Optional[str] = None          # RECOVER re-runs in this space
 
 
 class JobManager:
     def __init__(self):
         self.jobs: Dict[int, Job] = {}
+        self._ids = itertools.count(1)   # per-manager: deterministic ids
 
     def submit(self, qctx, command: str, space: Optional[str]) -> Job:
-        job = Job(next(_job_ids), command)
+        job = Job(next(self._ids), command, space=space)
         self.jobs[job.job_id] = job
         job.status = "RUNNING"
         job.start_time = time.time()
@@ -65,13 +64,17 @@ class JobManager:
                 # SST-compaction analog, SURVEY §2 row 10)
                 out["journal_compacted_to"] = qctx.store.compact_journal()
             return out
-        if command in ("balance data", "balance leader"):
+        if command in ("balance data", "balance leader") \
+                or command.startswith("balance data remove "):
             meta = getattr(qctx.store, "meta", None)
             if meta is not None:        # cluster: run the real plan
                 from ..cluster.balance import balance_data, balance_leader
-                if command == "balance data":
-                    return balance_data(qctx.store, space)
-                return balance_leader(qctx.store, space)
+                if command == "balance leader":
+                    return balance_leader(qctx.store, space)
+                exclude = None
+                if command.startswith("balance data remove "):
+                    exclude = command[len("balance data remove "):].split(",")
+                return balance_data(qctx.store, space, exclude=exclude)
             # standalone: one host owns every part — nothing to move
             if space:
                 return {"parts": qctx.store.stats(space)["per_part_edges"]}
@@ -96,24 +99,75 @@ class JobManager:
         raise ValueError(f"unknown job `{command}'")
 
 
-_manager = JobManager()
 _snapshots: Dict[str, float] = {}
 
 
-def job_manager() -> JobManager:
-    return _manager
+def job_manager(store) -> JobManager:
+    """The store's job manager (created on demand) — store-scoped like
+    the catalog, so engines and tests get isolated job state (the
+    reference's JobManager lives in each cluster's metad)."""
+    mgr = getattr(store, "_job_manager", None)
+    if mgr is None:
+        mgr = store._job_manager = JobManager()
+    return mgr
 
 
 def submit_job(node, qctx) -> DataSet:
-    job = _manager.submit(qctx, node.args["job"], node.args.get("space"))
+    job = job_manager(qctx.store).submit(qctx, node.args["job"],
+                                         node.args.get("space"))
     return DataSet(["New Job Id"], [[job.job_id]])
+
+
+def stop_job(node, qctx) -> DataSet:
+    """STOP JOB <id>: single-process jobs run synchronously, so a live
+    job can't actually be interrupted — QUEUE'd jobs are cancelled and
+    anything unfinished is marked STOPPED (the reference semantics for
+    an already-finished job: an error)."""
+    jid = node.args["job_id"]
+    job = job_manager(qctx.store).jobs.get(jid)
+    if job is None:
+        raise ValueError(f"job {jid} not found")
+    if job.status == "FINISHED":
+        raise ValueError(f"job {jid} already finished")
+    job.status = "STOPPED"
+    job.stop_time = time.time()
+    return DataSet(["Result"], [["Job stopped"]])
+
+
+def recover_job(node, qctx) -> DataSet:
+    """RECOVER JOB [<id>]: re-run FAILED/STOPPED jobs (all of them when
+    no id is given); returns how many were recovered."""
+    mgr = job_manager(qctx.store)
+    jid = node.args.get("job_id")
+    targets = [j for j in mgr.jobs.values()
+               if j.status in ("FAILED", "STOPPED")
+               and (jid is None or j.job_id == jid)]
+    if jid is not None and not targets:
+        j = mgr.jobs.get(jid)
+        if j is None:
+            raise ValueError(f"job {jid} not found")
+        raise ValueError(f"job {jid} is {j.status}, not recoverable")
+    n = 0
+    for j in targets:
+        j.status = "RUNNING"
+        j.start_time = time.time()
+        try:
+            j.result = mgr._run(qctx, j.command, j.space)
+            j.status = "FINISHED"
+        except Exception as ex:  # noqa: BLE001 — job errors are recorded
+            j.status = "FAILED"
+            j.result = {"error": str(ex)}
+        j.stop_time = time.time()
+        n += 1
+    return DataSet(["Recovered job num"], [[n]])
 
 
 def show_jobs(node, qctx) -> DataSet:
     jid = node.args.get("job_id")
     cols = ["Job Id", "Command", "Status"]
     rows = []
-    for j in sorted(_manager.jobs.values(), key=lambda x: x.job_id):
+    for j in sorted(job_manager(qctx.store).jobs.values(),
+                    key=lambda x: x.job_id):
         if jid is not None and j.job_id != jid:
             continue
         rows.append([j.job_id, j.command, j.status])

@@ -26,7 +26,11 @@ _GLOBAL_GOD = (
     A.CreateSpaceSentence, A.CreateSpaceAsSentence, A.DropSpaceSentence, A.CreateUserSentence,
     A.DropUserSentence, A.AlterUserSentence, A.CreateSnapshotSentence,
     A.DropSnapshotSentence, A.UpdateConfigsSentence,
-    A.AddHostsSentence, A.DropZoneSentence)
+    A.AddHostsSentence, A.DropZoneSentence,
+    A.DropHostsSentence, A.MergeZoneSentence, A.RenameZoneSentence,
+    A.ClearSpaceSentence, A.KillSessionSentence, A.StopJobSentence,
+    A.RecoverJobSentence, A.SignInTextServiceSentence,
+    A.SignOutTextServiceSentence, A.DescribeUserSentence)
 _SPACE_ADMIN = (A.GrantRoleSentence, A.RevokeRoleSentence)
 _SPACE_DBA = (
     A.CreateSchemaSentence, A.AlterSchemaSentence, A.DropSchemaSentence,

@@ -208,6 +208,9 @@ class DurableEngine:
         if op == "clear_part":
             store.clear_part(cmd[1], cmd[2])
             return
+        if op == "clear_space":
+            store.clear_space(cmd[1], if_exists=True)
+            return
         raise ValueError(f"unknown journal op {op!r}")
 
     # -- compaction ---------------------------------------------------------

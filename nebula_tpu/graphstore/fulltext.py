@@ -71,6 +71,39 @@ def levenshtein_leq(a: str, b: str, k: int) -> bool:
     return prev[len(b)] <= k
 
 
+class TextServiceRegistry:
+    """SIGN IN/OUT TEXT SERVICE target list (the reference registers
+    external Elasticsearch clients in metad; the in-process fulltext
+    plane doesn't need one to FUNCTION, but the statement surface and
+    SHOW TEXT SEARCH CLIENTS must reflect what an operator signed in).
+    Process-local; the cluster graphd layer shares one process."""
+
+    def __init__(self):
+        self.clients: list = []     # [{"host", "port", "user"}]
+
+    def sign_in(self, endpoints, user=None, password=None):
+        for ep in endpoints:
+            host, _, port = ep.partition(":")
+            self.clients.append({"host": host,
+                                 "port": int(port) if port else 9200,
+                                 "user": user or "", "conn": "http"})
+
+    def sign_out(self):
+        if not self.clients:
+            raise ValueError("no text service clients signed in")
+        self.clients.clear()
+
+
+def text_services(store) -> TextServiceRegistry:
+    """The store's registry (created on demand) — store-scoped so every
+    engine/test gets isolated sign-in state, like the rest of the
+    catalog."""
+    reg = getattr(store, "_text_services", None)
+    if reg is None:
+        reg = store._text_services = TextServiceRegistry()
+    return reg
+
+
 class FulltextIndexData:
     """One full-text index over one string field of one tag/edge.
 

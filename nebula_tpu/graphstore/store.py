@@ -203,6 +203,23 @@ class GraphStore:
             self.data.pop(sp.space_id, None)
         self._log("drop_space", name)
 
+    def clear_space(self, name: str, if_exists=False):
+        """CLEAR SPACE: wipe every partition's data (vertices, edges,
+        derived indexes, TOSS chains, the dense-id dictionary) while
+        keeping the schema catalog — the reference's admin statement for
+        re-ingesting a space without re-issuing DDL."""
+        from .schema import SchemaError
+        try:
+            self.catalog.get_space(name)
+        except SchemaError:
+            if if_exists:
+                return
+            raise
+        sd = self.space(name)
+        for pid in range(sd.num_parts):
+            self.clear_part(name, pid)
+        self._log("clear_space", name)
+
     def space(self, name: str) -> SpaceData:
         sp = self.catalog.get_space(name)
         sd = self.data.get(sp.space_id)

@@ -332,8 +332,73 @@ class AddHostsSentence(Sentence):
 
 
 @dataclass
+class DropHostsSentence(Sentence):
+    hosts: list
+
+
+@dataclass
 class DropZoneSentence(Sentence):
     zone: str
+
+
+@dataclass
+class MergeZoneSentence(Sentence):
+    zones: List[str]
+    into: str
+
+
+@dataclass
+class RenameZoneSentence(Sentence):
+    old: str
+    new: str
+
+
+@dataclass
+class DescZoneSentence(Sentence):
+    zone: str
+
+
+@dataclass
+class ClearSpaceSentence(Sentence):
+    name: str
+    if_exists: bool = False
+
+
+@dataclass
+class StopJobSentence(Sentence):
+    job_id: int
+
+
+@dataclass
+class RecoverJobSentence(Sentence):
+    job_id: Optional[int] = None        # None = all failed jobs
+
+
+@dataclass
+class KillSessionSentence(Sentence):
+    session_id: int
+
+
+@dataclass
+class GetConfigsSentence(Sentence):
+    name: Optional[str] = None          # None = all (== SHOW CONFIGS)
+
+
+@dataclass
+class SignInTextServiceSentence(Sentence):
+    endpoints: List[str]
+    user: Optional[str] = None
+    password: Optional[str] = None
+
+
+@dataclass
+class SignOutTextServiceSentence(Sentence):
+    pass
+
+
+@dataclass
+class DescribeUserSentence(Sentence):
+    name: str
 
 
 @dataclass

@@ -9,7 +9,7 @@ import time — a new rule here is exactly where the TPU rewrite plugs in.
 """
 from __future__ import annotations
 
-from typing import Callable, List, Optional
+from typing import Any, Callable, List, Optional
 
 from ..core.expr import (Binary, Expr, InputProp, join_conjuncts,
                          split_conjuncts, walk)
@@ -18,6 +18,14 @@ from .plan import ExecutionPlan, PlanNode, transform_plan, walk_plan
 Rule = Callable[[PlanNode], Optional[PlanNode]]
 
 RULES: List[Rule] = []
+
+# Exploration rules (the OptGroup-memo leg): called as fn(node, pctx)
+# and return a LIST of alternative subtrees for the node's group; the
+# cost model picks the cheapest member (see find_best_plan).  Unlike
+# RULES (rewrites that are always-better), these are choices — e.g.
+# which index seeds a MATCH label scan.
+ExploreRule = Callable[[PlanNode, Any], List[PlanNode]]
+EXPLORE_RULES: List[ExploreRule] = []
 
 # TPU fusion rule factories: each is called per-pass with a {node_id:
 # parent_count} map and returns a Rule.  Populated by nebula_tpu.tpu
@@ -30,8 +38,99 @@ def register_rule(fn: Rule) -> Rule:
     return fn
 
 
+def register_explore_rule(fn: ExploreRule) -> ExploreRule:
+    EXPLORE_RULES.append(fn)
+    return fn
+
+
+# ---------------------------------------------------------------------------
+# Cost-lite memo (reference analog: Optimizer::findBestPlan over OptGroup
+# alternatives [UNVERIFIED — empty mount, SURVEY §2 row 22]).  Plans here
+# are trees of tens of nodes, so the memo is a per-node group of
+# alternative subtrees with a cardinality-flow cost; exhaustive
+# exploration is affordable and deterministic.
+# ---------------------------------------------------------------------------
+
+_BASE_ROWS = 1_000_000.0          # assumed table cardinality for scans
+_EQ_SELECTIVITY = 100.0           # one bound eq column divides rows by this
+_RANGE_SELECTIVITY = 10.0
+
+
+def est_rows(node: PlanNode, child_rows: List[float]) -> float:
+    """Heuristic output cardinality of one node."""
+    k = node.kind
+    inp = max(child_rows) if child_rows else 0.0
+    if k in ("ScanVertices", "ScanEdges"):
+        return _BASE_ROWS
+    if k in ("IndexScan", "FulltextIndexScan"):
+        if not node.args.get("index"):
+            return _BASE_ROWS
+        sel = _EQ_SELECTIVITY ** len(node.args.get("eq") or ())
+        if node.args.get("range"):
+            sel *= _RANGE_SELECTIVITY
+        return max(_BASE_ROWS / sel, 1.0)
+    if k == "Filter":
+        return inp / 4.0
+    if k in ("Limit", "TopN", "Sample"):
+        lim = node.args.get("count")
+        return min(inp, float(lim)) if lim is not None else inp
+    if k == "Dedup":
+        return inp / 2.0
+    if k == "Aggregate":
+        return max(inp / 10.0, 1.0)
+    if k in ("GetNeighbors", "Traverse", "Expand", "TpuTraverse"):
+        return inp * 10.0
+    return inp
+
+
+def est_cost(node: PlanNode, memo: dict) -> float:
+    """Total cardinality flowing through the subtree (each node costs
+    its own output rows; children shared by id are costed once)."""
+    got = memo.get(node.id)
+    if got is not None:
+        return got[1]
+    child_rows = []
+    total = 0.0
+    for d in node.deps:
+        est_cost(d, memo)
+        rows_d, cost_d = memo[d.id]
+        child_rows.append(rows_d)
+        total += cost_d
+    rows = est_rows(node, child_rows)
+    total += rows
+    memo[node.id] = (rows, total)
+    return total
+
+
+def find_best_plan(root: PlanNode, pctx) -> PlanNode:
+    """Bottom-up group exploration: children first, then this node's
+    alternatives from EXPLORE_RULES; the cheapest subtree (est_cost)
+    wins its group.  Memoized by node id (shared deps explored once)."""
+    chosen: dict = {}
+
+    def rec(node: PlanNode) -> PlanNode:
+        got = chosen.get(node.id)
+        if got is not None:
+            return got
+        new_deps = [rec(d) for d in node.deps]
+        if new_deps != node.deps:
+            node.deps = new_deps
+            node.input_vars = [d.output_var for d in new_deps]
+        alts = [node]
+        for rule in EXPLORE_RULES:
+            try:
+                alts.extend(rule(node, pctx) or ())
+            except Exception:  # noqa: BLE001 — exploration must not fail a plan
+                continue
+        best = min(alts, key=lambda n: est_cost(n, {}))
+        chosen[node.id] = best
+        return best
+
+    return rec(root)
+
+
 def optimize(plan: ExecutionPlan, enable: bool = True,
-             tpu: bool = False) -> ExecutionPlan:
+             tpu: bool = False, pctx=None) -> ExecutionPlan:
     if not enable:
         return plan
     # When a rule replaces a node with one of its children, any by-name
@@ -54,6 +153,8 @@ def optimize(plan: ExecutionPlan, enable: bool = True,
         plan.root = transform_plan(plan.root, apply_once)
         if not changed[0]:
             break
+    if pctx is not None and EXPLORE_RULES:
+        plan.root = find_best_plan(plan.root, pctx)
     if tpu and TPU_RULES:
         # Fusion pass after pushdowns.  TOP-down (outermost node first) so a
         # whole N-step frontier chain fuses as one unit — bottom-up would
@@ -572,3 +673,256 @@ def eliminate_noop_project(node: PlanNode) -> Optional[PlanNode]:
         if not (isinstance(e, InputProp) and e.name == n):
             return None
     return child
+
+
+def _rename_only_project(node: PlanNode) -> bool:
+    """Project whose every column is a bare input reference (possibly
+    renamed) — commuting row-count operators through it is safe."""
+    if node.kind != "Project" or len(node.deps) != 1:
+        return False
+    if any(node.args.get(f) for f in
+           ("go_row", "match_row", "lookup_row", "fetch_row")):
+        return False
+    return all(isinstance(e, InputProp)
+               for e, _ in node.args.get("columns", []))
+
+
+@register_rule
+def push_topn_down_project(node: PlanNode) -> Optional[PlanNode]:
+    """TopN(Project[rename-only]) → Project(TopN') with sort keys
+    remapped through the rename (reference: PushTopNDownProjectRule) —
+    the Project then materializes only the kept rows."""
+    if node.kind != "TopN" or len(node.deps) != 1:
+        return None
+    proj = node.dep()
+    if not _rename_only_project(proj) or len(proj.deps) != 1:
+        return None
+    rename = {n: e.name for e, n in proj.args.get("columns", [])}
+    factors = node.args.get("factors", [])
+    try:
+        new_factors = [(rename[name], asc) for name, asc in factors]
+    except (KeyError, TypeError, ValueError):
+        return None
+    child = proj.dep()
+    topn = PlanNode("TopN", deps=[child], col_names=list(child.col_names),
+                    args={"factors": new_factors,
+                          "count": node.args.get("count"),
+                          "offset": node.args.get("offset", 0),
+                          "match_row": node.args.get("match_row", False)})
+    return PlanNode("Project", deps=[topn],
+                    col_names=list(proj.col_names),
+                    args=dict(proj.args))
+
+
+@register_rule
+def push_dedup_through_project(node: PlanNode) -> Optional[PlanNode]:
+    """Dedup(Project[rename-only, no duplicated source col]) →
+    Project(Dedup) (reference: PushDedupDownProjectRule analog): dedup
+    on the narrower pre-rename rows is the same row set when the
+    projection is a bijection of columns."""
+    if node.kind != "Dedup" or len(node.deps) != 1:
+        return None
+    proj = node.dep()
+    if not _rename_only_project(proj) or len(proj.deps) != 1:
+        return None
+    srcs = [e.name for e, _ in proj.args.get("columns", [])]
+    child = proj.dep()
+    # bijection: every input column referenced exactly once, all of them
+    if sorted(srcs) != sorted(child.col_names):
+        return None
+    dd = PlanNode("Dedup", deps=[child], col_names=list(child.col_names),
+                  args={"match_row": node.args.get("match_row", False)})
+    return PlanNode("Project", deps=[dd], col_names=list(proj.col_names),
+                    args=dict(proj.args))
+
+
+@register_rule
+def push_filter_into_index_scan(node: PlanNode) -> Optional[PlanNode]:
+    """Filter(IndexScan) in LOOKUP (schema-name) form → IndexScan with
+    the residual filter applied during the scan (reference:
+    PushFilterDownIndexScanRule): entities are dropped before the
+    Project materializes them."""
+    if node.kind != "Filter" or len(node.deps) != 1:
+        return None
+    if node.args.get("match_row"):      # MATCH-form exprs bind aliases,
+        return None                     # not the schema name
+    scan = node.dep()
+    if scan.kind != "IndexScan" or scan.args.get("filter") is not None \
+            or scan.args.get("limit") is not None:
+        return None
+    schema = scan.args.get("schema")
+    cond = node.args.get("condition")
+    if cond is None:
+        return None
+    # only conditions over the scanned schema's own props evaluate
+    # identically inside the scan's row context
+    for x in walk(cond):
+        if x.kind == "label_tag_prop":
+            if x.var != schema:
+                return None
+        elif x.kind not in ("literal", "binary", "unary", "list", "set",
+                            "edge_prop"):
+            return None
+        elif x.kind == "edge_prop" and x.edge not in (schema, "__edge__"):
+            return None
+    new_args = dict(scan.args)
+    new_args["filter"] = node.args["condition"]
+    return PlanNode("IndexScan", deps=[], col_names=list(scan.col_names),
+                    args=new_args)
+
+
+@register_rule
+def eliminate_dedup_after_unique_scan(node: PlanNode) -> Optional[PlanNode]:
+    """Dedup over a scan that already emits unique single-entity rows
+    (ScanVertices / vertex IndexScan dedup by vid internally) → child
+    (reference: RemoveNoopDedupRule class)."""
+    if node.kind != "Dedup" or len(node.deps) != 1:
+        return None
+    child = node.dep()
+    if child.kind == "ScanVertices" and len(child.col_names) == 1:
+        return child
+    if child.kind == "IndexScan" and not child.args.get("is_edge") \
+            and len(child.col_names) == 1:
+        return child
+    return None
+
+
+@register_rule
+def const_fold_filter_condition(node: PlanNode) -> Optional[PlanNode]:
+    """Filter whose condition is a literal-only expression folds to the
+    TRUE/FALSE form the eliminate_{true,false}_filter rules consume
+    (reference: FoldConstantExprRule, filter leg)."""
+    from ..core.expr import DictContext, Literal, to_bool3
+    if node.kind != "Filter":
+        return None
+    cond = node.args.get("condition")
+    if cond is None or cond.kind == "literal":
+        return None
+    if any(x.kind not in ("literal", "binary", "unary", "list", "set")
+           for x in walk(cond)):
+        return None
+    try:
+        val = to_bool3(cond.eval(DictContext()))
+    except Exception:  # noqa: BLE001 — leave runtime errors to runtime
+        return None
+    new_args = dict(node.args)
+    new_args["condition"] = Literal(val is True)
+    return PlanNode("Filter", deps=list(node.deps),
+                    col_names=list(node.col_names), args=new_args)
+
+
+def _setop_pushable(node: PlanNode) -> bool:
+    if len(node.deps) != 2:
+        return False
+    l, r = node.deps
+    return list(l.col_names) == list(node.col_names) \
+        and list(r.col_names) == list(node.col_names)
+
+
+@register_rule
+def push_filter_down_set_op(node: PlanNode) -> Optional[PlanNode]:
+    """Filter(Union/Intersect/Minus) → SetOp(Filter(l), Filter(r)) —
+    row-level predicates commute with all three set ops (reference:
+    PushFilterDownUnionRule family); each branch shrinks before the
+    hash-join/dedup work."""
+    if node.kind != "Filter" or len(node.deps) != 1:
+        return None
+    op = node.dep()
+    if op.kind not in ("Union", "Intersect", "Minus") \
+            or not _setop_pushable(op):
+        return None
+    if any(x.kind == "input_prop" and x.name not in op.col_names
+           for x in walk(node.args.get("condition"))):
+        return None
+    branches = []
+    for d in op.deps:
+        f = PlanNode("Filter", deps=[d], col_names=list(d.col_names),
+                     args=dict(node.args))
+        branches.append(f)
+    return PlanNode(op.kind, deps=branches,
+                    col_names=list(op.col_names), args=dict(op.args))
+
+
+@register_rule
+def push_limit_into_union_all(node: PlanNode) -> Optional[PlanNode]:
+    """Limit(UNION ALL) keeps its outer cut but plants the same bound on
+    each branch (reference: PushLimitDownUnionAllRule): each side stops
+    producing past offset+count rows."""
+    if node.kind != "Limit" or len(node.deps) != 1:
+        return None
+    u = node.dep()
+    if u.kind != "Union" or u.args.get("distinct") \
+            or not _setop_pushable(u):
+        return None
+    cnt = node.args.get("count")
+    if cnt is None or cnt < 0:
+        return None
+    bound = cnt + (node.args.get("offset") or 0)
+    if any(d.kind == "Limit" for d in u.deps):
+        return None                      # already planted (fixpoint stop)
+    branches = [PlanNode("Limit", deps=[d], col_names=list(d.col_names),
+                         args={"count": bound, "offset": 0})
+                for d in u.deps]
+    nu = PlanNode("Union", deps=branches, col_names=list(u.col_names),
+                  args=dict(u.args))
+    return PlanNode("Limit", deps=[nu], col_names=list(node.col_names),
+                    args=dict(node.args))
+
+
+@register_explore_rule
+def index_seed_for_match_scan(node: PlanNode, pctx) -> List[PlanNode]:
+    """MATCH (a:T) WHERE a.T.prop ... : offer Filter(IndexScan) as an
+    alternative to Filter(ScanVertices) — one alternative per index
+    whose column hints bind at least one predicate (reference:
+    OptimizeTagIndexScanByFilterRule).  The full filter stays on top
+    (the hints are implied by it), so rows are identical; the cost
+    model picks the most selective binding."""
+    if node.kind != "Filter" or len(node.deps) != 1:
+        return []
+    scan = node.dep()
+    if scan.kind != "ScanVertices" or not scan.args.get("tag"):
+        return []
+    tag = scan.args["tag"]
+    alias = scan.args.get("as_col") or scan.col_names[0]
+    space = scan.args["space"]
+    cond = node.args.get("condition")
+    if cond is None:
+        return []
+    conds = {}
+    for i, c in enumerate(split_conjuncts(cond)):
+        if c.kind != "binary" or c.op not in ("==", "<", "<=", ">", ">="):
+            continue
+        lhs, rhs, op = c.lhs, c.rhs, c.op
+        if rhs.kind == "label_tag_prop" and lhs.kind == "literal":
+            lhs, rhs = rhs, lhs
+            op = {"<": ">", "<=": ">=", ">": "<", ">=": "<="}.get(op, op)
+        if lhs.kind != "label_tag_prop" or rhs.kind != "literal":
+            continue
+        if lhs.var != alias or lhs.tag != tag:
+            continue
+        conds.setdefault(lhs.prop, []).append((op, rhs.value, i))
+    if not conds:
+        return []
+    try:
+        indexes = pctx.catalog.indexes_for(space, tag, False)
+    except Exception:  # noqa: BLE001 — schema raced away; no alternative
+        return []
+    from .planner import score_index_hints
+    alts = []
+    for d in indexes:
+        best = score_index_hints([d], conds)
+        if best is None:
+            continue
+        (n_eq, has_rng), name, eq, rng, _used = best
+        if n_eq == 0 and not has_rng:
+            continue
+        iscan = PlanNode("IndexScan", deps=[], col_names=[alias],
+                         args={"space": space, "schema": tag,
+                               "is_edge": False, "index": name,
+                               "eq": eq, "range": rng})
+        filt = PlanNode("Filter", deps=[iscan],
+                        col_names=list(node.col_names),
+                        args=dict(node.args))
+        filt.output_var = node.output_var
+        alts.append(filt)
+    return alts

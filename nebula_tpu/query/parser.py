@@ -158,6 +158,13 @@ class Parser:
     # ---- statement dispatch ----
     def parse_basic(self) -> A.Sentence:
         t = self.peek()
+        if t.kind == "(":
+            # parenthesized compound statement: set-op operands and
+            # pipe sources may be grouped, `(A UNION B) | C`
+            self.next()
+            inner = self.parse_set_op()
+            self.expect(")")
+            return inner
         if t.kind != "KEYWORD":
             raise ParseError(f"unexpected {t.kind}({t.value!r}) at pos {t.pos}")
         kw = t.value
@@ -169,14 +176,17 @@ class Parser:
             "UPDATE": self.p_update, "UPSERT": self.p_update,
             "FETCH": self.p_fetch, "LOOKUP": self.p_lookup,
             "MATCH": self.p_match, "OPTIONAL": self.p_match,
-            "FIND": self.p_find_path, "GET": self.p_subgraph,
+            "FIND": self.p_find_path, "GET": self.p_get,
             "YIELD": self.p_yield_stmt, "GROUP": self.p_group_by,
             "ORDER": self.p_order_by, "LIMIT": self.p_limit,
             "SAMPLE": self.p_sample, "REBUILD": self.p_rebuild,
             "SUBMIT": self.p_submit, "KILL": self.p_kill,
             "UNWIND": self.p_match, "GRANT": self.p_grant, "ADD": self.p_add,
             "REVOKE": self.p_revoke, "CHANGE": self.p_change_password,
-            "REMOVE": self.p_remove,
+            "REMOVE": self.p_remove, "CLEAR": self.p_clear,
+            "STOP": self.p_stop_job, "RECOVER": self.p_recover_job,
+            "SIGN": self.p_sign, "MERGE": self.p_merge_zone,
+            "RENAME": self.p_rename_zone, "BALANCE": self.p_balance,
         }.get(kw)
         if fn is None:
             raise ParseError(f"unsupported statement `{kw}' at pos {t.pos}")
@@ -204,6 +214,93 @@ class Parser:
         self.expect_kw("REMOVE")
         self.expect_kw("LISTENER")
         return A.RemoveListenerSentence(self.expect_kw("ELASTICSEARCH").value)
+
+    def p_get(self) -> A.Sentence:
+        """GET SUBGRAPH ... | GET CONFIGS [name]."""
+        if self.peek(1).kind == "KEYWORD" and self.peek(1).value == "CONFIGS":
+            self.expect_kw("GET")
+            self.expect_kw("CONFIGS")
+            name = None
+            if self.peek().kind in ("IDENT", "KEYWORD") \
+                    and not self.at(";"):
+                name = self.ident()
+            return A.GetConfigsSentence(name)
+        return self.p_subgraph()
+
+    def p_clear(self) -> A.ClearSpaceSentence:
+        """CLEAR SPACE [IF EXISTS] name — wipe data, keep schema."""
+        self.expect_kw("CLEAR")
+        self.expect_kw("SPACE")
+        return A.ClearSpaceSentence(if_exists=self.p_if_exists(),
+                                    name=self.ident())
+
+    def p_stop_job(self) -> A.StopJobSentence:
+        self.expect_kw("STOP")
+        self.expect_kw("JOB")
+        return A.StopJobSentence(self.expect("INT").value)
+
+    def p_recover_job(self) -> A.RecoverJobSentence:
+        self.expect_kw("RECOVER")
+        self.expect_kw("JOB")
+        jid = None
+        if self.at("INT"):
+            jid = self.next().value
+        return A.RecoverJobSentence(jid)
+
+    def p_sign(self) -> A.Sentence:
+        """SIGN IN TEXT SERVICE (host[, user, pw])[, ...] / SIGN OUT
+        TEXT SERVICE — external full-text endpoint registration."""
+        self.expect_kw("SIGN")
+        if self.accept_kw("OUT"):
+            self.expect_kw("TEXT")
+            self.expect_kw("SERVICE")
+            return A.SignOutTextServiceSentence()
+        self.expect_kw("IN")
+        self.expect_kw("TEXT")
+        self.expect_kw("SERVICE")
+        eps, user, pw = [], None, None
+        while self.accept("("):
+            eps.append(self.expect("STRING").value)
+            if self.accept(","):
+                user = self.expect("STRING").value
+                self.expect(",")
+                pw = self.expect("STRING").value
+            self.expect(")")
+            if not self.accept(","):
+                break
+        if not eps:
+            raise ParseError("SIGN IN TEXT SERVICE needs (host) endpoints")
+        return A.SignInTextServiceSentence(eps, user, pw)
+
+    def p_merge_zone(self) -> A.MergeZoneSentence:
+        self.expect_kw("MERGE")
+        self.expect_kw("ZONE")
+        zones = [self.ident()]
+        while self.accept(","):
+            zones.append(self.ident())
+        self.expect_kw("INTO")
+        return A.MergeZoneSentence(zones, self.ident())
+
+    def p_rename_zone(self) -> A.RenameZoneSentence:
+        self.expect_kw("RENAME")
+        self.expect_kw("ZONE")
+        old = self.ident()
+        self.expect_kw("TO")
+        return A.RenameZoneSentence(old, self.ident())
+
+    def p_balance(self) -> A.SubmitJobSentence:
+        """BALANCE DATA [REMOVE "host" [, ...]] / BALANCE LEADER — the
+        2.x spelling; canonicalizes to the SUBMIT JOB form the job
+        manager executes."""
+        self.expect_kw("BALANCE")
+        which = self.expect_kw("DATA", "LEADER").value.lower()
+        job = f"balance {which}"
+        if which == "data" and self.accept_kw("REMOVE"):
+            hosts = [self.expect("STRING").value]
+            while self.accept(","):
+                hosts.append(self.expect("STRING").value)
+            job += " remove " + ",".join(hosts)
+        return A.SubmitJobSentence(job)
 
     # ---- user management (reference: GRANT/REVOKE ROLE, CHANGE PASSWORD) --
     def p_grant(self) -> A.GrantRoleSentence:
@@ -536,8 +633,13 @@ class Parser:
             return A.DropUserSentence(self.ident(), ife)
         if self.accept_kw("ZONE"):
             return A.DropZoneSentence(self.ident())
+        if self.accept_kw("HOSTS"):
+            hosts = [self.expect("STRING").value]
+            while self.accept(","):
+                hosts.append(self.expect("STRING").value)
+            return A.DropHostsSentence(hosts)
         raise ParseError(
-            "expected SPACE/TAG/EDGE/SNAPSHOT/USER/ZONE after DROP")
+            "expected SPACE/TAG/EDGE/SNAPSHOT/USER/ZONE/HOSTS after DROP")
 
     def p_alter(self) -> A.Sentence:
         self.expect_kw("ALTER")
@@ -583,12 +685,22 @@ class Parser:
         t = self.peek()
         if t.kind == "KEYWORD":
             kw = t.value
-            if kw in ("SPACES", "HOSTS", "PARTS", "STATS", "JOBS", "SESSIONS",
+            if kw == "HOSTS":
+                self.next()
+                role = self.accept_kw("GRAPH", "STORAGE", "META")
+                return A.ShowSentence(
+                    "hosts", role.value.lower() if role else None)
+            if kw in ("SPACES", "PARTS", "STATS", "JOBS", "SESSIONS",
                       "SNAPSHOTS", "QUERIES", "CONFIGS"):
                 self.next()
                 if kw == "JOBS":
                     return A.ShowJobsSentence()
                 return A.ShowSentence(kw.lower())
+            if kw == "TEXT":
+                self.next()
+                self.expect_kw("SEARCH")
+                self.expect_kw("CLIENTS")
+                return A.ShowSentence("text_search_clients")
             if kw in ("TAGS", "EDGES", "USERS", "ZONES"):
                 self.next()
                 return A.ShowSentence(kw.lower())
@@ -609,7 +721,15 @@ class Parser:
             if kw in ("TAG", "EDGE"):
                 self.next()
                 if self.accept_kw("INDEXES"):
-                    return A.ShowSentence("tag_indexes" if kw == "TAG" else "edge_indexes")
+                    which = "tag_indexes" if kw == "TAG" else "edge_indexes"
+                    if self.accept_kw("STATUS"):
+                        return A.ShowSentence(which + "_status")
+                    return A.ShowSentence(which)
+                if self.accept_kw("INDEX"):
+                    self.expect_kw("STATUS")
+                    return A.ShowSentence(
+                        ("tag_indexes" if kw == "TAG" else "edge_indexes")
+                        + "_status")
                 raise ParseError("expected INDEXES after SHOW TAG/EDGE")
             if kw == "CREATE":
                 self.next()
@@ -620,8 +740,12 @@ class Parser:
                 return A.ShowJobsSentence(self.expect("INT").value)
         raise ParseError(f"unsupported SHOW target at pos {t.pos}")
 
-    def p_describe(self) -> A.DescribeSentence:
+    def p_describe(self) -> A.Sentence:
         self.expect_kw("DESCRIBE", "DESC")
+        if self.accept_kw("USER"):
+            return A.DescribeUserSentence(self.ident())
+        if self.accept_kw("ZONE"):
+            return A.DescZoneSentence(self.ident())
         kind = self.expect_kw("SPACE", "TAG", "EDGE", "INDEX").value.lower()
         return A.DescribeSentence(kind, self.ident())
 
@@ -645,8 +769,10 @@ class Parser:
             parts.append(self.ident().lower())
         return A.SubmitJobSentence(" ".join(parts))
 
-    def p_kill(self) -> A.KillQuerySentence:
+    def p_kill(self) -> A.Sentence:
         self.expect_kw("KILL")
+        if self.accept_kw("SESSION", "SESSIONS"):
+            return A.KillSessionSentence(self.expect("INT").value)
         self.expect_kw("QUERY")
         out = A.KillQuerySentence()
         self.expect("(")

@@ -569,29 +569,18 @@ def _lookup_text_cond(c: Expr, schema: str, is_edge: bool):
     return (c.name.upper(), field, pat)
 
 
-def _choose_index(pctx, space: str, schema: str, is_edge: bool,
-                  filt: Optional[Expr]):
-    """Pick the best index + column hints for a LOOKUP predicate.
+def score_index_hints(indexes, conds: Dict[str, list]):
+    """Shared predicate→IndexColumnHint scoring (reference analog:
+    OptimizerUtils; SURVEY §2 rows 15/22).
 
-    Reference analog: the optimizer's predicate→IndexColumnHint
-    extraction (OptimizerUtils; SURVEY §2 rows 15/22).  Returns
-    (index_name, eq_values, range_hint, residual_filter).
+    conds: {field: [(op, value, conjunct_idx), ...]}.  For each index,
+    bind an equality prefix over its fields, then a range on the next
+    field; score = (#eq, has_range).  Returns the best
+    (score, index_name, eq_values, range_hint, used_conjunct_idxs) —
+    used by both the LOOKUP planner and the optimizer's MATCH
+    scan→index exploration rule.
     """
-    from ..graphstore.index import MAX, MIN
-    indexes = pctx.catalog.indexes_for(space, schema, is_edge)
-    if not indexes:
-        kind = "edge" if is_edge else "tag"
-        raise QueryError(
-            f"no valid index found on {kind} `{schema}' "
-            f"(LOOKUP requires one; CREATE {kind.upper()} INDEX first)")
-    if filt is None:
-        return indexes[0].name, [], None, None
-    conjs = split_conjuncts(filt)
-    conds: Dict[str, list] = {}
-    for i, c in enumerate(conjs):
-        m = _lookup_field_cond(c, schema, is_edge)
-        if m is not None:
-            conds.setdefault(m[0], []).append((m[1], m[2], i))
+    from ..graphstore.index import MAX, MIN, norm
     best = None
     for d in indexes:
         used: set = set()
@@ -605,7 +594,6 @@ def _choose_index(pctx, space: str, schema: str, is_edge: bool,
             used.add(hit[1])
         rng = None
         if len(eq) < len(d.fields):
-            from ..graphstore.index import norm
             nf = d.fields[len(eq)]
             lo, hi, lo_inc, hi_inc = MIN, MAX, True, True
             found = False
@@ -630,7 +618,28 @@ def _choose_index(pctx, space: str, schema: str, is_edge: bool,
         score = (len(eq), 1 if rng else 0)
         if best is None or score > best[0]:
             best = (score, d.name, eq, rng, used)
-    _, name, eq, rng, used = best
+    return best
+
+
+def _choose_index(pctx, space: str, schema: str, is_edge: bool,
+                  filt: Optional[Expr]):
+    """Pick the best index + column hints for a LOOKUP predicate.
+    Returns (index_name, eq_values, range_hint, residual_filter)."""
+    indexes = pctx.catalog.indexes_for(space, schema, is_edge)
+    if not indexes:
+        kind = "edge" if is_edge else "tag"
+        raise QueryError(
+            f"no valid index found on {kind} `{schema}' "
+            f"(LOOKUP requires one; CREATE {kind.upper()} INDEX first)")
+    if filt is None:
+        return indexes[0].name, [], None, None
+    conjs = split_conjuncts(filt)
+    conds: Dict[str, list] = {}
+    for i, c in enumerate(conjs):
+        m = _lookup_field_cond(c, schema, is_edge)
+        if m is not None:
+            conds.setdefault(m[0], []).append((m[1], m[2], i))
+    _, name, eq, rng, used = score_index_hints(indexes, conds)
     residual = join_conjuncts(
         [c for i, c in enumerate(conjs) if i not in used])
     return name, eq, rng, residual
@@ -1303,12 +1312,38 @@ def _register_dispatch():
         A.DropSnapshotSentence: lambda p, s: _admin("DropSnapshot", name=s.name),
         A.KillQuerySentence: lambda p, s: _admin(
             "KillQuery", session_id=s.session_id, plan_id=s.plan_id),
+        A.KillSessionSentence: lambda p, s: _admin(
+            "KillSession", session_id=s.session_id),
         A.UpdateConfigsSentence: lambda p, s: _admin(
             "UpdateConfigs", name=s.name, value=s.value),
+        A.GetConfigsSentence: lambda p, s: _admin(
+            "GetConfigs", cols=["Module", "Name", "Type", "Mode", "Value"],
+            name=s.name),
         A.AddHostsSentence: lambda p, s: _admin(
             "AddHosts", hosts=s.hosts, zone=s.zone),
+        A.DropHostsSentence: lambda p, s: _admin(
+            "DropHosts", hosts=s.hosts),
         A.DropZoneSentence: lambda p, s: _admin(
             "DropZone", zone=s.zone),
+        A.MergeZoneSentence: lambda p, s: _admin(
+            "MergeZone", zones=s.zones, into=s.into),
+        A.RenameZoneSentence: lambda p, s: _admin(
+            "RenameZone", old=s.old, new=s.new),
+        A.DescZoneSentence: lambda p, s: _admin(
+            "DescZone", cols=["Hosts"], zone=s.zone),
+        A.ClearSpaceSentence: lambda p, s: _admin(
+            "ClearSpace", name=s.name, if_exists=s.if_exists),
+        A.StopJobSentence: lambda p, s: _admin(
+            "StopJob", cols=["Result"], job_id=s.job_id),
+        A.RecoverJobSentence: lambda p, s: _admin(
+            "RecoverJob", cols=["Recovered job num"], job_id=s.job_id),
+        A.SignInTextServiceSentence: lambda p, s: _admin(
+            "SignInTextService", endpoints=s.endpoints, user=s.user,
+            password=s.password),
+        A.SignOutTextServiceSentence: lambda p, s: _admin(
+            "SignOutTextService"),
+        A.DescribeUserSentence: lambda p, s: _admin(
+            "DescribeUser", cols=["role", "space"], name=s.name),
         A.CreateUserSentence: lambda p, s: _admin(
             "CreateUser", name=s.name, password=s.password,
             if_not_exists=s.if_not_exists),

@@ -31,7 +31,9 @@ KEYWORDS = {
     "TTL_COL", "DEFAULT", "NULL", "COMMENT", "SAMPLE", "INGEST",
     "USER", "USERS", "PASSWORD", "GRANT", "REVOKE", "ROLE", "ROLES",
     "ZONE", "ZONES", "INTO", "FULLTEXT", "LISTENER", "ELASTICSEARCH",
-    "REMOVE", "CHARSET", "COLLATION",
+    "REMOVE", "CHARSET", "COLLATION", "CLEAR", "STOP", "RECOVER", "SIGN",
+    "MERGE", "RENAME", "TEXT", "SERVICE", "SEARCH", "CLIENTS", "STATUS",
+    "META", "GRAPH", "STORAGE",
     # types
     "INT", "INT64", "INT32", "INT16", "INT8", "FLOAT", "DOUBLE", "STRING",
     "FIXED_STRING", "BOOL", "TIMESTAMP", "DATE", "TIME", "DATETIME",

@@ -233,6 +233,240 @@ def _tag_prop_type(tag: str, name: str, scope: Scope) -> str:
 
 
 # ---------------------------------------------------------------------------
+# per-statement validators (reference: one Validator subclass per
+# sentence — GoValidator, MatchValidator, ... [UNVERIFIED — empty mount,
+# SURVEY §2 row 19]).  Each entry checks the STRUCTURAL semantics of its
+# sentence (step ranges, schema references, prop-name conformance)
+# before the generic expression type deduction runs.  Registered by
+# sentence class; statements without an entry only get type deduction.
+# ---------------------------------------------------------------------------
+
+_SENTENCE_VALIDATORS: Dict[type, Any] = {}
+
+
+def _svalidator(cls):
+    def deco(fn):
+        _SENTENCE_VALIDATORS[cls] = fn
+        return fn
+    return deco
+
+
+def _has_edge(pctx, name: str) -> bool:
+    try:
+        pctx.catalog.get_edge(pctx.space, name)
+        return True
+    except SchemaError:
+        return False
+
+
+def _has_tag(pctx, name: str) -> bool:
+    try:
+        pctx.catalog.get_tag(pctx.space, name)
+        return True
+    except SchemaError:
+        return False
+
+
+def _check_steps(m, n):
+    if m is not None and m < 0:
+        raise ValidationError(f"step number {m} is negative")
+    if m is not None and n is not None and n < m:
+        raise ValidationError(
+            f"upper bound steps {n} must be greater than or equal to "
+            f"lower bound {m}")
+
+
+def _register_sentence_validators():
+    from . import ast as A
+
+    @_svalidator(A.GoSentence)
+    def v_go(stmt, pctx):
+        if stmt.steps is not None:
+            _check_steps(stmt.steps.m, stmt.steps.n)
+        if pctx.space and stmt.over is not None and not stmt.over.is_all:
+            for et in stmt.over.edges or ():
+                if not _has_edge(pctx, et):
+                    raise ValidationError(f"edge `{et}' not found")
+
+    @_svalidator(A.FetchVerticesSentence)
+    def v_fetch_v(stmt, pctx):
+        if not pctx.space:
+            return
+        for t in stmt.tags:
+            if t != "*" and not _has_tag(pctx, t):
+                raise ValidationError(f"tag `{t}' not found")
+
+    @_svalidator(A.FetchEdgesSentence)
+    def v_fetch_e(stmt, pctx):
+        if pctx.space and not _has_edge(pctx, stmt.etype):
+            raise ValidationError(f"edge `{stmt.etype}' not found")
+
+    @_svalidator(A.LookupSentence)
+    def v_lookup(stmt, pctx):
+        if pctx.space and not (_has_tag(pctx, stmt.schema_name)
+                               or _has_edge(pctx, stmt.schema_name)):
+            raise ValidationError(
+                f"schema `{stmt.schema_name}' not found")
+
+    @_svalidator(A.FindPathSentence)
+    def v_find_path(stmt, pctx):
+        if stmt.upto is not None and stmt.upto < 0:
+            raise ValidationError(
+                f"UPTO {stmt.upto} STEPS is negative")
+        if pctx.space and stmt.over is not None and not stmt.over.is_all:
+            for et in stmt.over.edges or ():
+                if not _has_edge(pctx, et):
+                    raise ValidationError(f"edge `{et}' not found")
+
+    @_svalidator(A.SubgraphSentence)
+    def v_subgraph(stmt, pctx):
+        if stmt.steps is not None and stmt.steps < 0:
+            raise ValidationError(f"step number {stmt.steps} is negative")
+        if pctx.space:
+            for et in (tuple(stmt.in_edges or ())
+                       + tuple(stmt.out_edges or ())
+                       + tuple(stmt.both_edges or ())):
+                if et != "*" and not _has_edge(pctx, et):
+                    raise ValidationError(f"edge `{et}' not found")
+
+    @_svalidator(A.MatchSentence)
+    def v_match(stmt, pctx):
+        for cl in getattr(stmt, "clauses", ()) or ():
+            pat = getattr(cl, "patterns", None)
+            for pp in pat or ():
+                for ep in getattr(pp, "edges", ()) or ():
+                    if ep.min_hop < 0:
+                        raise ValidationError(
+                            f"hop lower bound {ep.min_hop} is negative")
+                    if ep.max_hop != -1 and ep.max_hop < ep.min_hop:
+                        raise ValidationError(
+                            f"hop upper bound {ep.max_hop} must be "
+                            f">= lower bound {ep.min_hop}")
+                    if pctx.space:
+                        for et in ep.types or ():
+                            if not _has_edge(pctx, et):
+                                raise ValidationError(
+                                    f"edge `{et}' not found")
+                for np_ in getattr(pp, "nodes", ()) or ():
+                    if pctx.space:
+                        for lb, _props in np_.labels or ():
+                            if not _has_tag(pctx, lb):
+                                raise ValidationError(
+                                    f"tag `{lb}' not found")
+
+    @_svalidator(A.InsertVerticesSentence)
+    def v_insert_v(stmt, pctx):
+        for row in stmt.rows:
+            if len(row.values) != len(stmt.prop_names):
+                raise ValidationError(
+                    f"vertex row has {len(row.values)} values for "
+                    f"{len(stmt.prop_names)} properties")
+        if not pctx.space:
+            return
+        if not _has_tag(pctx, stmt.tag):
+            raise ValidationError(f"tag `{stmt.tag}' not found")
+        sv = pctx.catalog.get_tag(pctx.space, stmt.tag).latest
+        have = {p.name for p in sv.props}
+        for pn in stmt.prop_names:
+            if pn not in have:
+                raise ValidationError(
+                    f"tag `{stmt.tag}' has no property `{pn}'")
+
+    @_svalidator(A.InsertEdgesSentence)
+    def v_insert_e(stmt, pctx):
+        for row in stmt.rows:
+            if len(row.values) != len(stmt.prop_names):
+                raise ValidationError(
+                    f"edge row has {len(row.values)} values for "
+                    f"{len(stmt.prop_names)} properties")
+        if not pctx.space:
+            return
+        if not _has_edge(pctx, stmt.etype):
+            raise ValidationError(f"edge `{stmt.etype}' not found")
+        sv = pctx.catalog.get_edge(pctx.space, stmt.etype).latest
+        have = {p.name for p in sv.props}
+        for pn in stmt.prop_names:
+            if pn not in have:
+                raise ValidationError(
+                    f"edge `{stmt.etype}' has no property `{pn}'")
+
+    @_svalidator(A.UpdateSentence)
+    def v_update(stmt, pctx):
+        if not pctx.space:
+            return
+        get = _has_edge if stmt.is_edge else _has_tag
+        if not get(pctx, stmt.schema_name):
+            kind = "edge" if stmt.is_edge else "tag"
+            raise ValidationError(
+                f"{kind} `{stmt.schema_name}' not found")
+        getter = (pctx.catalog.get_edge if stmt.is_edge
+                  else pctx.catalog.get_tag)
+        sv = getter(pctx.space, stmt.schema_name).latest
+        have = {p.name for p in sv.props}
+        for pn, _e in stmt.sets:
+            if pn not in have:
+                raise ValidationError(
+                    f"`{stmt.schema_name}' has no property `{pn}'")
+
+    @_svalidator(A.CreateSchemaSentence)
+    def v_create_schema(stmt, pctx):
+        seen = set()
+        for p in stmt.props:
+            if p.name in seen:
+                raise ValidationError(
+                    f"duplicate property `{p.name}'")
+            seen.add(p.name)
+        if stmt.ttl_col:
+            pd = next((p for p in stmt.props if p.name == stmt.ttl_col),
+                      None)
+            if pd is None:
+                raise ValidationError(
+                    f"TTL column `{stmt.ttl_col}' does not exist")
+            if pd.type_name.upper() not in ("INT", "INT64", "TIMESTAMP"):
+                raise ValidationError(
+                    f"TTL column `{stmt.ttl_col}' must be "
+                    f"int/timestamp typed")
+
+    @_svalidator(A.CreateIndexSentence)
+    def v_create_index(stmt, pctx):
+        if len(set(stmt.fields)) != len(stmt.fields):
+            raise ValidationError("duplicate index field")
+        if not pctx.space:
+            return
+        get = _has_edge if stmt.is_edge else _has_tag
+        if not get(pctx, stmt.schema_name):
+            kind = "edge" if stmt.is_edge else "tag"
+            raise ValidationError(
+                f"{kind} `{stmt.schema_name}' not found")
+        getter = (pctx.catalog.get_edge if stmt.is_edge
+                  else pctx.catalog.get_tag)
+        sv = getter(pctx.space, stmt.schema_name).latest
+        have = {p.name for p in sv.props}
+        for f in stmt.fields:
+            if f not in have:
+                raise ValidationError(
+                    f"`{stmt.schema_name}' has no property `{f}'")
+
+    @_svalidator(A.LimitSentence)
+    def v_limit(stmt, pctx):
+        if stmt.count is not None and stmt.count < 0:
+            raise ValidationError("LIMIT count is negative")
+        if getattr(stmt, "offset", None) is not None and stmt.offset < 0:
+            raise ValidationError("LIMIT offset is negative")
+
+    @_svalidator(A.DeleteTagsSentence)
+    def v_delete_tags(stmt, pctx):
+        if not pctx.space:
+            return
+        for t in stmt.tags:
+            if not _has_tag(pctx, t):
+                raise ValidationError(f"tag `{t}' not found")
+
+
+_register_sentence_validators()
+
+
+# ---------------------------------------------------------------------------
 # sentence-level validation
 # ---------------------------------------------------------------------------
 
@@ -275,6 +509,15 @@ def validate(stmt, pctx) -> None:
     if isinstance(stmt, A.AssignSentence):
         validate(stmt.stmt, pctx)
         return
+
+    sv = _SENTENCE_VALIDATORS.get(type(stmt))
+    if sv is not None:
+        try:
+            sv(stmt, pctx)
+        except ValidationError:
+            raise
+        except Exception:  # noqa: BLE001 — structural checks never block
+            pass
 
     edge_types = ()
     if isinstance(stmt, A.GoSentence) and stmt.over is not None:

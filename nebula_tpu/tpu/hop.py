@@ -61,6 +61,13 @@ def _expand_block(indptr, nbr, rank, fbm, EB: int, P: int, pid):
     arrays; fbm: (vmax,) bool frontier membership; pid: this part's id
     (dense id = local * P + pid).
 
+    Slot→source-row assignment is a cumsum-scatter, not a binary
+    search: bump +1 at each frontier vertex's first slot, prefix-sum
+    over the EB slots, then map the compact row number back to a local
+    id through a scattered lookup table — O(vmax + EB) total, versus
+    O(EB log vmax) for searchsorted (the log factor dominated the old
+    kernel's per-slot cost on both the VPU and the CPU-emulated mesh).
+
     Returns per-edge-slot arrays of length EB:
       src (frontier dense id), dst, rk, eidx (index into the block's
       edge arrays — the host uses it to decode properties), ve (slot
@@ -70,10 +77,19 @@ def _expand_block(indptr, nbr, rank, fbm, EB: int, P: int, pid):
     deg = jnp.where(fbm, indptr[1:] - indptr[:-1], 0).astype(jnp.int32)
     ends = jnp.cumsum(deg)
     total = ends[-1]
+    starts = ends - deg                       # (vmax,)
+    has = deg > 0
+    # compact index of each expanding vertex, and its inverse table
+    cidx = jnp.cumsum(has.astype(jnp.int32)) - 1
+    vid_of = jnp.zeros((vmax,), jnp.int32).at[
+        jnp.where(has, cidx, vmax)].set(
+        jnp.arange(vmax, dtype=jnp.int32), mode="drop")
+    # +1 at each expanding vertex's first slot; prefix-sum = compact row
+    bump = jnp.zeros((EB,), jnp.int32).at[
+        jnp.where(has, starts, EB)].add(1, mode="drop")
+    crow = jnp.cumsum(bump) - 1               # (EB,)
+    row = vid_of[jnp.maximum(crow, 0)]
     j = jnp.arange(EB, dtype=jnp.int32)
-    row = jnp.searchsorted(ends, j, side="right").astype(jnp.int32)
-    row = jnp.minimum(row, vmax - 1)
-    starts = ends - deg
     eidx = indptr[row] + (j - starts[row])
     ve = j < jnp.minimum(total, EB)
     eidx = jnp.where(ve, eidx, 0).astype(jnp.int32)
@@ -114,13 +130,27 @@ def _compact_cap(src, dst, rk, eidx, keep, EB: int):
             put(eidx, 0), jnp.sum(keep, dtype=jnp.int32))
 
 
-def build_traverse_fn(mesh, P: int, EB: int, steps: int,
+def _norm_ebs(EB, steps: int, capture_hops: bool):
+    """Per-hop edge budgets: an int is uniform; a sequence gives each
+    hop its own bucket (a 3-hop GO's first hop expands a few hundred
+    edges while the last expands millions — one uniform bucket made
+    every hop pay the final hop's padding).  capture_hops mode stacks
+    per-hop capture arrays along a hop axis, which requires equal EB."""
+    ebs = tuple([EB] * steps) if isinstance(EB, int) else tuple(EB)
+    assert len(ebs) == steps, (ebs, steps)
+    if capture_hops:
+        assert len(set(ebs)) == 1, "capture_hops requires uniform EB"
+    return ebs
+
+
+def build_traverse_fn(mesh, P: int, EB, steps: int,
                       n_blocks: int,
                       pred: Optional[Callable[[Dict[str, Any]], Any]] = None,
                       pred_cols: Sequence[str] = (),
                       capture: bool = True,
                       capture_hops: bool = False):
     """Compile the N-step traversal program for one bucket configuration.
+    EB: per-block edge budget — an int (uniform) or a per-hop sequence.
 
     blocks_data (runtime arg): tuple of n_blocks dicts with keys
       indptr (P, vmax+1), nbr (P, E), rank (P, E), props {name: (P, E)}
@@ -144,6 +174,8 @@ def build_traverse_fn(mesh, P: int, EB: int, steps: int,
     trail-semantics paths from the layered frames (runtime.py).
     """
 
+    ebs = _norm_ebs(EB, steps, capture_hops)
+
     def kernel(blocks_data, frontier):
         fbm = frontier[0]                      # (vmax,) bool
         vmax = fbm.shape[0]
@@ -155,6 +187,7 @@ def build_traverse_fn(mesh, P: int, EB: int, steps: int,
 
         for hop in range(steps):
             last = hop == steps - 1
+            EBh = ebs[hop]
             marks = None
             edges_this_hop = jnp.zeros((), jnp.int32)
             caps = {"src": [], "dst": [], "rank": [], "eidx": [],
@@ -162,7 +195,7 @@ def build_traverse_fn(mesh, P: int, EB: int, steps: int,
             for bi in range(n_blocks):
                 b = blocks_data[bi]
                 src, dst, rk, eidx, ve, total, ovf = _expand_block(
-                    b["indptr"][0], b["nbr"][0], b["rank"][0], fbm, EB, P,
+                    b["indptr"][0], b["nbr"][0], b["rank"][0], fbm, EBh, P,
                     pid)
                 ovf_e = ovf_e | ovf
                 edges_this_hop = edges_this_hop + total
@@ -176,7 +209,7 @@ def build_traverse_fn(mesh, P: int, EB: int, steps: int,
                     keep = ve
                 if capture and (last or capture_hops):
                     cs, cd, cr, ce, kc = _compact_cap(src, dst, rk, eidx,
-                                                      keep, EB)
+                                                      keep, EBh)
                     caps["src"].append(cs)
                     caps["dst"].append(cd)
                     caps["rank"].append(cr)
@@ -227,7 +260,7 @@ def build_traverse_fn(mesh, P: int, EB: int, steps: int,
     return jax.jit(smapped)
 
 
-def build_traverse_fn_local(P: int, EB: int, steps: int,
+def build_traverse_fn_local(P: int, EB, steps: int,
                             n_blocks: int,
                             pred: Optional[Callable[[Dict[str, Any]], Any]] = None,
                             pred_cols: Sequence[str] = (),
@@ -242,10 +275,11 @@ def build_traverse_fn_local(P: int, EB: int, steps: int,
     frames, cap arrays (P, steps, n_blocks, EB)).
     """
     pids = jnp.arange(P, dtype=jnp.int32)
+    ebs = _norm_ebs(EB, steps, capture_hops)
 
-    def one_part_expand(block, fbm, pid, want_pred):
+    def one_part_expand(block, fbm, pid, want_pred, EBh):
         src, dst, rk, eidx, ve, total, ovf = _expand_block(
-            block["indptr"], block["nbr"], block["rank"], fbm, EB, P, pid)
+            block["indptr"], block["nbr"], block["rank"], fbm, EBh, P, pid)
         if want_pred:
             cols = {"_rank": rk}
             for name in pred_cols:
@@ -266,6 +300,7 @@ def build_traverse_fn_local(P: int, EB: int, steps: int,
 
         for hop in range(steps):
             last = hop == steps - 1
+            EBh = ebs[hop]
             marks = None                   # (P_src, P_dst, vmax) bool
             edges = jnp.zeros((P,), jnp.int32)
             caps = {"src": [], "dst": [], "rank": [], "eidx": [],
@@ -276,14 +311,14 @@ def build_traverse_fn_local(P: int, EB: int, steps: int,
                 src, dst, rk, eidx, ve, keep, total, ovf = jax.vmap(
                     lambda ip, nb, rkk, prp, f, pd: one_part_expand(
                         {"indptr": ip, "nbr": nb, "rank": rkk, "props": prp},
-                        f, pd, want_pred)
+                        f, pd, want_pred, EBh)
                 )(b["indptr"], b["nbr"], b["rank"], b["props"], fbm, pids)
                 ovf_e = ovf_e | ovf
                 edges = edges + total
                 if capture and (last or capture_hops):
                     cs, cd, cr, ce, kc = jax.vmap(
                         lambda s, d, r, e, k: _compact_cap(s, d, r, e, k,
-                                                           EB)
+                                                           EBh)
                     )(src, dst, rk, eidx, keep)
                     caps["src"].append(cs)
                     caps["dst"].append(cd)

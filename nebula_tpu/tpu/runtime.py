@@ -323,32 +323,46 @@ class TpuRuntime:
 
     def _escalate(self, dev: DeviceSnapshot, dense: Sequence[int],
                   key_fn, build_fn, inputs_fn, stats: "TraverseStats",
+                  n_hops: int = 1, uniform: bool = False,
                   min_eb: Optional[int] = None):
         """Shared power-of-two bucket escalation driver for all device
         programs (traverse, bfs): seed bitmap layout, jit cache, one
         batched fetch, overflow-driven retry (SURVEY §7 hard-part #1).
 
-        key_fn(EB) → jit-cache key; build_fn(EB) → jitted program
-        fn(*inputs, frontier); inputs_fn(EB) → tuple of extra inputs.
+        key_fn(ebs) → jit-cache key; build_fn(ebs) → jitted program
+        fn(*inputs, frontier); inputs_fn(ebs) → tuple of extra inputs;
+        ebs is the per-hop edge-budget tuple (len n_hops).
 
         With the bitmap frontier (round-4 redesign) the only dynamic
-        budget is the per-block edge budget EB — the frontier and the
-        routing buckets are structurally overflow-free.
+        budget is the per-block edge budget — the frontier and the
+        routing buckets are structurally overflow-free.  Budgets are
+        per-hop: hop h's bucket grows to pow2(its own measured
+        expansion), so a 3-hop GO's first hop does not pay the final
+        hop's padding.  `uniform=True` keeps all hops at one size
+        (capture_hops stacks frames along a hop axis; BFS compiles one
+        per-level body).
         """
-        EB = self.init_eb
+        base = self.init_eb
         if min_eb is not None:
             # caller knows a static bound (e.g. BFS: one hop's expansion
             # never exceeds the block's padded Emax) — start there and
             # never climb the recompile ladder
-            EB = min(max(EB, min_eb), self.max_cap)
+            base = min(max(base, min_eb), self.max_cap)
+        EBs = [base] * n_hops
         # cache key includes the seed-count bucket: one supernode query
         # must not permanently inflate every later small query of the
         # same program to supernode-sized padded kernels
-        bkey = (key_fn(0), _pow2(max(len(set(dense)), 1)))
+        bkey = (key_fn(()), _pow2(max(len(set(dense)), 1)))
         prev = self._buckets.get(bkey)
         if prev is not None:
-            # value kept as (F, EB) for cache-file compat; F is 0 now
-            EB = max(EB, prev[-1])
+            # value kept as (0, ebs) for cache-file compat (slot 0 was
+            # the old frontier bucket F); an int ebs is a legacy uniform
+            pe = prev[-1]
+            pe = [pe] * n_hops if isinstance(pe, int) else list(pe)
+            if len(pe) == n_hops:
+                EBs = [max(a, int(b)) for a, b in zip(EBs, pe)]
+        if uniform:
+            EBs = [max(EBs)] * n_hops
         if self.local_mode:
             target = self.mesh.devices.reshape(-1)[0]
         else:
@@ -361,10 +375,11 @@ class TpuRuntime:
 
         for attempt in range(self.max_retries):
             stats.retries = attempt
-            key = key_fn(EB)
+            ebs = tuple(EBs)
+            key = key_fn(ebs)
             fn = self._fns.get(key)
             if fn is None:
-                fn = self._fns[key] = build_fn(EB)
+                fn = self._fns[key] = build_fn(ebs)
             t0 = time.perf_counter()
             from ..utils.config import get_config
             prof_dir = get_config().get("tpu_profiler_dir")
@@ -379,10 +394,10 @@ class TpuRuntime:
                 run_dir = _os.path.join(str(prof_dir),
                                         f"run{self._prof_seq:06d}")
                 with jax.profiler.trace(run_dir):
-                    res = fn(*inputs_fn(EB), frontier)
+                    res = fn(*inputs_fn(ebs), frontier)
                     jax.block_until_ready(res)
             else:
-                res = fn(*inputs_fn(EB), frontier)
+                res = fn(*inputs_fn(ebs), frontier)
                 jax.block_until_ready(res)
             t1 = time.perf_counter()
             stats.device_s = t1 - t0
@@ -398,20 +413,27 @@ class TpuRuntime:
             stats.fetch_s = time.perf_counter() - t1
 
             if res["ovf_expand"].any():
-                # hop_edges reports the true per-part pre-filter expansion
-                # size, so jump STRAIGHT to the needed bucket — blind
-                # doubling needs ~20 rounds for a 1-seed BFS over a
-                # 30M-edge graph and times out the retry budget.  Drop
-                # the failed rung's device capture buffers BEFORE the
-                # larger rung runs — holding both nearly doubles peak
-                # HBM and can fail a retry that would converge.
-                need = _pow2(int(res["hop_edges"].max()))
-                EB = min(max(EB * 2, need), self.max_cap)
+                # hop_edges reports the true per-part pre-filter
+                # expansion size PER HOP, so jump each overflowed hop
+                # STRAIGHT to its needed bucket — blind doubling needs
+                # ~20 rounds for a 1-seed BFS over a 30M-edge graph and
+                # times out the retry budget.  (A pre-overflow hop's
+                # count is exact; a post-overflow hop's is a lower bound
+                # from the truncated frontier — the loop converges.)
+                # Drop the failed rung's device capture buffers BEFORE
+                # the larger rung runs — holding both nearly doubles
+                # peak HBM and can fail a retry that would converge.
+                need = np.asarray(res["hop_edges"]).max(axis=0)
+                EBs = [e if need[h] <= e else
+                       min(max(2 * e, _pow2(int(need[h]))), self.max_cap)
+                       for h, e in enumerate(EBs)]
+                if uniform:
+                    EBs = [max(EBs)] * n_hops
                 cap_dev = None
             else:
-                stats.f_cap, stats.e_cap = 0, EB
-                if self._buckets.get(bkey) != (0, EB):
-                    self._buckets[bkey] = (0, EB)
+                stats.f_cap, stats.e_cap = 0, list(EBs)
+                if self._buckets.get(bkey) != (0, ebs):
+                    self._buckets[bkey] = (0, ebs)
                     # bound by evicting oldest entries — a wholesale
                     # clear() would also wipe the persistent cache file
                     # on the next save, re-exposing every converged
@@ -425,7 +447,7 @@ class TpuRuntime:
                     tf = time.perf_counter()
                     kc = np.asarray(res["kcount"])
                     kmax = int(kc.max()) if kc.size else 0
-                    K = min(EB, _pow2(max(kmax, 1)))
+                    K = min(max(EBs), _pow2(max(kmax, 1)))
                     res["cap"] = {k: np.asarray(
                         jax.device_get(v[..., :K]))
                         for k, v in cap_dev.items()}
@@ -487,23 +509,23 @@ class TpuRuntime:
                        if n != "_rank"}}
             for bk in block_keys)
 
-        def build(EB):
+        def build(ebs):
             if self.local_mode:
                 return build_traverse_fn_local(
-                    P, EB, steps, len(block_keys), pred=pred,
+                    P, ebs, steps, len(block_keys), pred=pred,
                     pred_cols=pred_cols, capture=capture)
             return build_traverse_fn(
-                self.mesh, P, EB, steps, len(block_keys),
+                self.mesh, P, ebs, steps, len(block_keys),
                 pred=pred, pred_cols=pred_cols, capture=capture)
 
         res = self._escalate(
             dev, dense,
-            key_fn=lambda EB: (space, dev.epoch, tuple(block_keys),
-                               steps, EB, pred_key, capture,
-                               tuple(pred_cols)),
+            key_fn=lambda ebs: (space, dev.epoch, tuple(block_keys),
+                                steps, ebs, pred_key, capture,
+                                tuple(pred_cols)),
             build_fn=build,
-            inputs_fn=lambda EB: (blocks_data,),
-            stats=stats)
+            inputs_fn=lambda ebs: (blocks_data,),
+            stats=stats, n_hops=steps)
         if not capture:
             stats.total_s = time.perf_counter() - t_start
             return [], stats
@@ -576,24 +598,24 @@ class TpuRuntime:
                        if n != "_rank"}}
             for bk in block_keys)
 
-        def build(EB):
+        def build(ebs):
             if self.local_mode:
                 return build_traverse_fn_local(
-                    P, EB, max_hop, len(block_keys), pred=pred,
+                    P, ebs, max_hop, len(block_keys), pred=pred,
                     pred_cols=pred_cols, capture=True, capture_hops=True)
             return build_traverse_fn(
-                self.mesh, P, EB, max_hop, len(block_keys),
+                self.mesh, P, ebs, max_hop, len(block_keys),
                 pred=pred, pred_cols=pred_cols, capture=True,
                 capture_hops=True)
 
         res = self._escalate(
             dev, dense,
-            key_fn=lambda EB: (space, dev.epoch, "hops",
-                               tuple(block_keys), max_hop, EB,
-                               pred_key, tuple(pred_cols)),
+            key_fn=lambda ebs: (space, dev.epoch, "hops",
+                                tuple(block_keys), max_hop, ebs,
+                                pred_key, tuple(pred_cols)),
             build_fn=build,
-            inputs_fn=lambda EB: (blocks_data,),
-            stats=stats)
+            inputs_fn=lambda ebs: (blocks_data,),
+            stats=stats, n_hops=max_hop, uniform=True)
 
         t_mat = time.perf_counter()
         frames = self._build_frames(store, space, dev, block_keys,
@@ -730,12 +752,12 @@ class TpuRuntime:
                            if n != "_rank"}} if pred is not None else {})}
             for bk in block_keys)
 
-        def build(EB):
+        def build(ebs):
             if self.local_mode:
-                return build_bfs_fn_local(P, EB, max_steps,
+                return build_bfs_fn_local(P, ebs[0], max_steps,
                                           len(block_keys), dev.vmax,
                                           pred=pred, pred_cols=pred_cols)
-            return build_bfs_fn(self.mesh, P, EB, max_steps,
+            return build_bfs_fn(self.mesh, P, ebs[0], max_steps,
                                 len(block_keys), dev.vmax,
                                 pred=pred, pred_cols=pred_cols)
 
@@ -749,12 +771,12 @@ class TpuRuntime:
                        for bk in block_keys)
         res = self._escalate(
             dev, dense,
-            key_fn=lambda EB: (space, dev.epoch, "bfs",
-                               tuple(block_keys), max_steps, EB,
-                               pred_key, tuple(pred_cols)),
+            key_fn=lambda ebs: (space, dev.epoch, "bfs",
+                                tuple(block_keys), max_steps, ebs,
+                                pred_key, tuple(pred_cols)),
             build_fn=build,
-            inputs_fn=lambda EB: (blocks_data,),
-            stats=stats,
+            inputs_fn=lambda ebs: (blocks_data,),
+            stats=stats, n_hops=max_steps, uniform=True,
             min_eb=eb_bound)
         return res["dist"], stats
 

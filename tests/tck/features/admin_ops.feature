@@ -1,0 +1,180 @@
+Feature: Cluster and operational admin statements
+
+  Background:
+    Given having executed:
+      """
+      CREATE SPACE ao(partition_num=4, vid_type=INT64);
+      USE ao;
+      CREATE TAG person(name string, age int);
+      CREATE EDGE knows(since int);
+      INSERT VERTEX person(name, age) VALUES 1:("Ann", 30), 2:("Bob", 41);
+      INSERT EDGE knows(since) VALUES 1->2:(2015)
+      """
+
+  Scenario: clear space wipes data and keeps schema
+    When executing query:
+      """
+      CLEAR SPACE ao;
+      SHOW TAGS
+      """
+    Then the result should be, in any order:
+      | Name     |
+      | "person" |
+
+  Scenario: clear space leaves no rows behind
+    When executing query:
+      """
+      CLEAR SPACE ao;
+      FETCH PROP ON person 1, 2 YIELD person.name AS n
+      """
+    Then the result should be empty
+
+  Scenario: clear space if exists tolerates a missing space
+    When executing query:
+      """
+      CLEAR SPACE IF EXISTS never_created_space;
+      YIELD 1 AS ok
+      """
+    Then the result should be, in order:
+      | ok |
+      | 1  |
+
+  Scenario: clear space on a missing space is an error
+    When executing query:
+      """
+      CLEAR SPACE never_created_space
+      """
+    Then an ExecutionError should be raised
+
+  Scenario: stop job rejects a finished job
+    When executing query:
+      """
+      SUBMIT JOB STATS;
+      STOP JOB 1
+      """
+    Then an ExecutionError should be raised
+
+  Scenario: stop job rejects an unknown job id
+    When executing query:
+      """
+      STOP JOB 424242
+      """
+    Then an ExecutionError should be raised
+
+  Scenario: recover job with no failed jobs recovers zero
+    When executing query:
+      """
+      RECOVER JOB
+      """
+    Then the result should be, in order:
+      | Recovered job num |
+      | 0                 |
+
+  Scenario: balance data records a job in standalone mode
+    When executing query:
+      """
+      BALANCE DATA;
+      YIELD 1 AS ok
+      """
+    Then the result should be, in order:
+      | ok |
+      | 1  |
+
+  Scenario: get configs returns one named flag
+    When executing query:
+      """
+      GET CONFIGS minloglevel
+      """
+    Then the result should be, in order:
+      | Module  | Name          | Type  | Mode      | Value |
+      | "graph" | "minloglevel" | "int" | "MUTABLE" | "0"   |
+
+  Scenario: get configs of an unknown flag is an error
+    When executing query:
+      """
+      GET CONFIGS no_such_flag_anywhere
+      """
+    Then an ExecutionError should be raised
+
+  Scenario: text service sign in is visible to show clients
+    When executing query:
+      """
+      SIGN IN TEXT SERVICE ("es-host:9200");
+      SHOW TEXT SEARCH CLIENTS
+      """
+    Then the result should be, in any order:
+      | Host      | Port | Connection type |
+      | "es-host" | 9200 | "http"          |
+
+  Scenario: text service sign out clears the client list
+    When executing query:
+      """
+      SIGN IN TEXT SERVICE ("es-host:9200");
+      SIGN OUT TEXT SERVICE;
+      SHOW TEXT SEARCH CLIENTS
+      """
+    Then the result should be empty
+
+  Scenario: sign out with nothing signed in is an error
+    When executing query:
+      """
+      SIGN OUT TEXT SERVICE
+      """
+    Then an ExecutionError should be raised
+
+  Scenario: show tag index status lists rebuild jobs
+    When executing query:
+      """
+      CREATE TAG INDEX pidx ON person(age);
+      REBUILD TAG INDEX pidx;
+      SHOW TAG INDEXES STATUS
+      """
+    Then the result should be, in any order:
+      | Name   | Index Status |
+      | "pidx" | "FINISHED"   |
+
+  Scenario: describe user lists granted roles
+    When executing query:
+      """
+      CREATE USER reader WITH PASSWORD "pw";
+      GRANT ROLE USER ON ao TO reader;
+      DESCRIBE USER reader
+      """
+    Then the result should be, in any order:
+      | role   | space |
+      | "USER" | "ao"  |
+
+  Scenario: describe user on an unknown account is an error
+    When executing query:
+      """
+      DESCRIBE USER who_is_this
+      """
+    Then an ExecutionError should be raised
+
+  Scenario: merge zone needs cluster mode
+    When executing query:
+      """
+      MERGE ZONE a, b INTO c
+      """
+    Then an ExecutionError should be raised
+
+  Scenario: drop hosts rejects an unknown host
+    When executing query:
+      """
+      DROP HOSTS "no-such-host:1"
+      """
+    Then an ExecutionError should be raised
+
+  Scenario: show sessions lists the current session
+    When executing query:
+      """
+      SHOW SESSIONS
+      """
+    Then the result should not be empty
+
+  Scenario: show hosts with a role filter answers in standalone too
+    When executing query:
+      """
+      SHOW HOSTS GRAPH
+      """
+    Then the result should not be empty

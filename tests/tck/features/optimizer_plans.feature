@@ -1,0 +1,100 @@
+Feature: Optimizer plan shapes
+
+  Background:
+    Given having executed:
+      """
+      CREATE SPACE op(partition_num=4, vid_type=INT64);
+      USE op;
+      CREATE TAG Person(age int, name string);
+      CREATE EDGE knows(w int);
+      CREATE TAG INDEX page ON Person(age);
+      CREATE TAG INDEX pname ON Person(name);
+      INSERT VERTEX Person(age, name) VALUES 1:(25, "a"), 2:(35, "b"), 3:(45, "x"), 4:(31, "x");
+      INSERT EDGE knows(w) VALUES 1->2:(5), 2->3:(9), 3->4:(2)
+      """
+
+  Scenario: match label scan with a range predicate seeds from the index
+    When executing query:
+      """
+      EXPLAIN MATCH (a:Person) WHERE a.Person.age > 30 RETURN id(a)
+      """
+    Then the result should contain "IndexScan"
+
+  Scenario: the cost model prefers the equality index over the range index
+    When executing query:
+      """
+      EXPLAIN MATCH (a:Person) WHERE a.Person.name == "x" AND a.Person.age > 30 RETURN id(a)
+      """
+    Then the result should contain "index='pname'"
+
+  Scenario: index-seeded match rows equal full-scan rows
+    When executing query:
+      """
+      MATCH (a:Person) WHERE a.Person.name == "x" AND a.Person.age > 30
+      RETURN id(a) AS v
+      """
+    Then the result should be, in any order:
+      | v |
+      | 3 |
+      | 4 |
+
+  Scenario: index-seeded match with a range hint returns exact rows
+    When executing query:
+      """
+      MATCH (a:Person) WHERE a.Person.age > 30 RETURN id(a) AS v
+      """
+    Then the result should be, in any order:
+      | v |
+      | 2 |
+      | 3 |
+      | 4 |
+
+  Scenario: a label scan without predicates stays a scan
+    When executing query:
+      """
+      EXPLAIN MATCH (a:Person) RETURN id(a)
+      """
+    Then the result should contain "ScanVertices"
+
+  Scenario: lookup residual filter is pushed into the index scan
+    When executing query:
+      """
+      EXPLAIN LOOKUP ON Person WHERE Person.age > 30 AND Person.name == "x"
+      YIELD id(vertex) AS v
+      """
+    Then the result should contain "filter="
+
+  Scenario: lookup with pushed filter returns exact rows
+    When executing query:
+      """
+      LOOKUP ON Person WHERE Person.age > 30 AND Person.name == "x"
+      YIELD id(vertex) AS v
+      """
+    Then the result should be, in any order:
+      | v |
+      | 3 |
+      | 4 |
+
+  Scenario: filter pushes through a union into both branches
+    When executing query:
+      """
+      EXPLAIN (LOOKUP ON Person YIELD id(vertex) AS v UNION LOOKUP ON Person YIELD id(vertex) AS v) | YIELD $-.v AS v WHERE $-.v > 2
+      """
+    Then the result should contain "Union"
+
+  Scenario: union with filtered branches returns exact rows
+    When executing query:
+      """
+      (LOOKUP ON Person YIELD id(vertex) AS v UNION LOOKUP ON Person YIELD id(vertex) AS v) | YIELD $-.v AS v WHERE $-.v > 2
+      """
+    Then the result should be, in any order:
+      | v |
+      | 3 |
+      | 4 |
+
+  Scenario: constant false predicate folds the filter away
+    When executing query:
+      """
+      LOOKUP ON Person WHERE Person.age > 0 YIELD id(vertex) AS v | YIELD $-.v AS v WHERE 1 > 2
+      """
+    Then the result should be empty

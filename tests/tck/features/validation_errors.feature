@@ -1,0 +1,206 @@
+Feature: Per-statement semantic validation errors
+
+  Background:
+    Given having executed:
+      """
+      CREATE SPACE ve(partition_num=4, vid_type=INT64);
+      USE ve;
+      CREATE TAG person(name string, age int);
+      CREATE EDGE knows(since int)
+      """
+
+  Scenario: go over an unknown edge type
+    When executing query:
+      """
+      GO 1 STEPS FROM 1 OVER follows YIELD dst(edge)
+      """
+    Then a SemanticError should be raised
+
+  Scenario: go with inverted step range
+    When executing query:
+      """
+      GO 3 TO 1 STEPS FROM 1 OVER knows YIELD dst(edge)
+      """
+    Then a SemanticError should be raised
+
+  Scenario: fetch prop on an unknown tag
+    When executing query:
+      """
+      FETCH PROP ON animal 1 YIELD vertex AS v
+      """
+    Then a SemanticError should be raised
+
+  Scenario: fetch prop on an unknown edge
+    When executing query:
+      """
+      FETCH PROP ON likes 1->2 YIELD edge AS e
+      """
+    Then a SemanticError should be raised
+
+  Scenario: lookup on an unknown schema
+    When executing query:
+      """
+      LOOKUP ON nothing YIELD id(vertex)
+      """
+    Then a SemanticError should be raised
+
+  Scenario: find path over an unknown edge
+    When executing query:
+      """
+      FIND SHORTEST PATH FROM 1 TO 2 OVER follows UPTO 3 STEPS YIELD path AS p
+      """
+    Then a SemanticError should be raised
+
+  Scenario: match with an unknown edge type
+    When executing query:
+      """
+      MATCH (a)-[e:follows]->(b) RETURN e
+      """
+    Then a SemanticError should be raised
+
+  Scenario: match with an unknown tag label
+    When executing query:
+      """
+      MATCH (a:animal) RETURN a
+      """
+    Then a SemanticError should be raised
+
+  Scenario: match with inverted hop bounds
+    When executing query:
+      """
+      MATCH (a)-[e:knows*3..1]->(b) RETURN e
+      """
+    Then a SemanticError should be raised
+
+  Scenario: insert vertex with an unknown property
+    When executing query:
+      """
+      INSERT VERTEX person(name, height) VALUES 1:("Ann", 170)
+      """
+    Then a SemanticError should be raised
+
+  Scenario: insert vertex with wrong value arity
+    When executing query:
+      """
+      INSERT VERTEX person(name, age) VALUES 1:("Ann")
+      """
+    Then a SemanticError should be raised
+
+  Scenario: insert edge with an unknown property
+    When executing query:
+      """
+      INSERT EDGE knows(weight) VALUES 1->2:(5)
+      """
+    Then a SemanticError should be raised
+
+  Scenario: insert edge with wrong value arity
+    When executing query:
+      """
+      INSERT EDGE knows(since) VALUES 1->2:(2015, 7)
+      """
+    Then a SemanticError should be raised
+
+  Scenario: update with an unknown property
+    When executing query:
+      """
+      UPDATE VERTEX ON person 1 SET height = 170
+      """
+    Then a SemanticError should be raised
+
+  Scenario: update on an unknown schema
+    When executing query:
+      """
+      UPDATE VERTEX ON animal 1 SET age = 4
+      """
+    Then a SemanticError should be raised
+
+  Scenario: create index on an unknown schema
+    When executing query:
+      """
+      CREATE TAG INDEX ai ON animal(age)
+      """
+    Then a SemanticError should be raised
+
+  Scenario: create index on an unknown property
+    When executing query:
+      """
+      CREATE TAG INDEX hi ON person(height)
+      """
+    Then a SemanticError should be raised
+
+  Scenario: create index with a duplicate field
+    When executing query:
+      """
+      CREATE TAG INDEX di ON person(age, age)
+      """
+    Then a SemanticError should be raised
+
+  Scenario: create tag with duplicate properties
+    When executing query:
+      """
+      CREATE TAG t2(a int, a string)
+      """
+    Then a SemanticError should be raised
+
+  Scenario: ttl column must exist
+    When executing query:
+      """
+      CREATE TAG t3(a int) TTL_DURATION = 5, TTL_COL = "missing"
+      """
+    Then a SemanticError should be raised
+
+  Scenario: ttl column must be integer typed
+    When executing query:
+      """
+      CREATE TAG t4(a string) TTL_DURATION = 5, TTL_COL = "a"
+      """
+    Then a SemanticError should be raised
+
+  Scenario: get subgraph over an unknown edge
+    When executing query:
+      """
+      GET SUBGRAPH 2 STEPS FROM 1 OUT follows YIELD VERTICES AS v
+      """
+    Then a SemanticError should be raised
+
+  Scenario: delete tag of an unknown tag
+    When executing query:
+      """
+      DELETE TAG animal FROM 1
+      """
+    Then a SemanticError should be raised
+
+  Scenario: boolean operator over a non-boolean operand
+    When executing query:
+      """
+      GO 1 STEPS FROM 1 OVER knows WHERE knows.since AND true YIELD dst(edge)
+      """
+    Then a SemanticError should be raised
+
+  Scenario: comparison between string and int literals
+    When executing query:
+      """
+      YIELD 1 < "x" AS bad
+      """
+    Then a SemanticError should be raised
+
+  Scenario: unary minus over a string
+    When executing query:
+      """
+      YIELD -("abc") AS bad
+      """
+    Then a SemanticError should be raised
+
+  Scenario: arithmetic plus between int and bool
+    When executing query:
+      """
+      YIELD 1 + true AS bad
+      """
+    Then a SemanticError should be raised
+
+  Scenario: case when condition must be boolean
+    When executing query:
+      """
+      YIELD CASE WHEN 7 THEN 1 ELSE 2 END AS bad
+      """
+    Then a SemanticError should be raised

@@ -105,6 +105,13 @@ def parse_feature(text: str) -> List[Scenario]:
                 m = re.search(r"an?\s+(\w+)\s+should be raised", ln)
                 step = Step("error", error_kind=m.group(1))
                 i += 1
+            elif "should not be empty" in ln:
+                step = Step("nonempty")
+                i += 1
+            elif "should contain" in ln:
+                m = re.search(r'should contain\s+"([^"]+)"', ln)
+                step = Step("contain", text=m.group(1))
+                i += 1
             elif "should be empty" in ln:
                 step = Step("empty")
                 i += 1
@@ -194,6 +201,14 @@ def run_scenario(scn: Scenario, make_engine) -> None:
             assert last.error is None, f"{where} error: {last.error}"
             assert last.data.rows == [], \
                 f"{where} expected empty, got {last.data.rows!r}"
+        elif step.kind == "nonempty":
+            assert last.error is None, f"{where} error: {last.error}"
+            assert last.data.rows, f"{where} expected non-empty result"
+        elif step.kind == "contain":
+            assert last.error is None, f"{where} error: {last.error}"
+            assert any(step.text in str(c) for row in last.data.rows
+                       for c in row), \
+                f"{where} no cell contains {step.text!r}"
         elif step.kind == "expect":
             assert last.error is None, f"{where} error: {last.error}"
             msg = check_result(last.data, step.table, step.ordered)

@@ -313,6 +313,31 @@ def test_zones_and_id_allocation(tmp_path):
         assert rs.error is None
         rs = client.execute("SHOW ZONES")
         assert sorted({r[0] for r in rs.data.rows}) == ["west"]
+
+        # zone admin verbs (round 4): DESC, RENAME, MERGE
+        # east was dropped while holding addrs[1], so west holds the rest
+        west_set = {addrs[0], addrs[2], addrs[3]}
+        rs = client.execute("DESC ZONE west")
+        assert rs.error is None
+        assert {r[0] for r in rs.data.rows} == west_set
+        rs = client.execute("RENAME ZONE west TO coast")
+        assert rs.error is None
+        zones = meta.list_zones()
+        assert "coast" in zones and "west" not in zones
+        rs = client.execute("RENAME ZONE nope TO x")
+        assert rs.error is not None
+        rs = client.execute(
+            f'ADD HOSTS "{addrs[0]}" INTO ZONE solo')
+        assert rs.error is None, rs.error
+        rs = client.execute("MERGE ZONE solo, coast INTO merged")
+        assert rs.error is None, rs.error
+        zones = meta.list_zones()
+        assert set(zones) == {"merged"}
+        assert set(zones["merged"]) == west_set
+
+        # DROP HOSTS refuses while replicas live on the host
+        rs = client.execute(f'DROP HOSTS "{addrs[0]}"')
+        assert rs.error is not None and "BALANCE" in rs.error, rs.error
     finally:
         c.stop()
 

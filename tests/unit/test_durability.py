@@ -108,6 +108,30 @@ def test_drop_space_recovers(tmp_path):
     store2.close()
 
 
+def test_clear_space_recovers(tmp_path):
+    """CLEAR SPACE survives a restart: replay must wipe the data again
+    while the schema (journaled DDL) stays."""
+    store = GraphStore(data_dir=str(tmp_path / "db"))
+    eng = QueryEngine(store)
+    s = eng.new_session()
+    eng.execute(s, "CREATE SPACE cs(partition_num=2, vid_type=INT64)")
+    eng.execute(s, "USE cs")
+    eng.execute(s, "CREATE TAG t(x int)")
+    eng.execute(s, "INSERT VERTEX t(x) VALUES 1:(1), 2:(2)")
+    rs = eng.execute(s, "CLEAR SPACE cs")
+    assert rs.error is None, rs.error
+    store.close()
+    store2 = GraphStore(data_dir=str(tmp_path / "db"))
+    eng2 = QueryEngine(store2)
+    s2 = eng2.new_session()
+    eng2.execute(s2, "USE cs")
+    rs = eng2.execute(s2, "DESCRIBE TAG t")
+    assert rs.error is None and rs.data.rows
+    rs = eng2.execute(s2, "FETCH PROP ON t 1, 2 YIELD t.x")
+    assert rs.error is None and rs.data.rows == []
+    store2.close()
+
+
 def test_memory_store_unaffected():
     store = GraphStore()
     assert store._engine is None

@@ -373,3 +373,48 @@ def test_scheduler_sequential_when_disabled():
         assert rs.error is None and len(rs.data.rows) == 4
     finally:
         get_config().set_dynamic("scheduler_threads", 4)
+
+
+def test_recover_job_reruns_in_its_space():
+    """RECOVER JOB re-runs a FAILED job with the space it was submitted
+    in (ADVICE r4: recovery used the current session space, which is
+    None inside the executor — jobs could never actually recover)."""
+    eng = QueryEngine()
+    s = eng.new_session()
+    for t in ["CREATE SPACE rj(partition_num=2, vid_type=INT64)",
+              "USE rj", "CREATE TAG P(a int)"]:
+        assert eng.execute(s, t).error is None
+    jid = eng.execute(s, "SUBMIT JOB STATS").data.rows[0][0]
+    from nebula_tpu.exec.jobs import job_manager
+    mgr = job_manager(eng.store)
+    mgr.jobs[jid].status = "FAILED"
+    rs = eng.execute(s, "RECOVER JOB")
+    assert rs.error is None and rs.data.rows == [[1]]
+    assert mgr.jobs[jid].status == "FINISHED"
+    assert "error" not in (mgr.jobs[jid].result or {})
+
+
+def test_kill_session_standalone():
+    eng = QueryEngine()
+    s1 = eng.new_session()
+    s2 = eng.new_session()
+    rs = eng.execute(s1, f"KILL SESSION {s2.id}")
+    assert rs.error is None
+    rs = eng.execute(s2, "SHOW SPACES")
+    assert rs.error == "Session was killed"
+    rs = eng.execute(s1, "KILL SESSION 999999")
+    assert rs.error is not None
+
+
+def test_get_configs_includes_session_params():
+    """GET CONFIGS must agree with SHOW CONFIGS row-for-row, including
+    the session-param module (ADVICE r4: the two had diverged)."""
+    eng = QueryEngine(params={"my_session_knob": 7})
+    s = eng.new_session()
+    show = eng.execute(s, "SHOW CONFIGS")
+    get = eng.execute(s, "GET CONFIGS")
+    assert show.error is None and get.error is None
+    assert sorted(map(repr, show.data.rows)) == \
+        sorted(map(repr, get.data.rows))
+    one = eng.execute(s, "GET CONFIGS my_session_knob")
+    assert one.error is None and one.data.rows[0][0] == "session"

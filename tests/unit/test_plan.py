@@ -316,3 +316,140 @@ def test_eliminate_noop_limit(eng):
                   args={"offset": 0, "count": -1})
     p = optimize(ExecutionPlan(lm, "t"))
     assert p.root.kind_tree() == ["Start"]
+
+
+# ---- round-4 rules: memo/exploration + new pushdowns ----------------------
+
+
+def _pctx(eng):
+    return PlannerContext(eng.qctx, "t")
+
+
+def test_index_seed_for_match_scan(eng):
+    """Filter(ScanVertices) with an indexable tag-prop predicate is
+    replaced by Filter(IndexScan) via the cost-model exploration."""
+    pctx = _pctx(eng)
+    root = _plan(pctx, parse(
+        "MATCH (a:person) WHERE a.person.age > 21 RETURN id(a)"))
+    p = optimize(ExecutionPlan(root, "t"), pctx=pctx)
+    kinds = p.root.kind_tree()
+    assert "IndexScan" in kinds and "ScanVertices" not in kinds
+    from nebula_tpu.query.plan import walk_plan
+    scan = next(n for n in walk_plan(p.root) if n.kind == "IndexScan")
+    assert scan.args["index"] == "i_age"
+    assert scan.args["range"] is not None
+
+
+def test_index_seed_prefers_equality(eng):
+    s = eng._sess
+    assert eng.execute(s, "CREATE TAG INDEX i_name ON person(name)").ok
+    pctx = _pctx(eng)
+    root = _plan(pctx, parse(
+        'MATCH (a:person) WHERE a.person.name == "x" AND '
+        'a.person.age > 21 RETURN id(a)'))
+    p = optimize(ExecutionPlan(root, "t"), pctx=pctx)
+    from nebula_tpu.query.plan import walk_plan
+    scan = next(n for n in walk_plan(p.root) if n.kind == "IndexScan")
+    assert scan.args["index"] == "i_name"       # eq beats range in cost
+    r = eng.execute(s, "DROP TAG INDEX i_name")
+    assert r.ok
+
+
+def test_scan_without_predicate_not_rewritten(eng):
+    pctx = _pctx(eng)
+    root = _plan(pctx, parse("MATCH (a:person) RETURN id(a)"))
+    p = optimize(ExecutionPlan(root, "t"), pctx=pctx)
+    assert "ScanVertices" in p.root.kind_tree()
+
+
+def test_push_filter_into_index_scan(eng):
+    pctx = _pctx(eng)
+    root = _plan(pctx, parse(
+        'LOOKUP ON person WHERE person.age > 21 AND '
+        'person.name == "q" YIELD id(vertex) AS v'))
+    p = optimize(ExecutionPlan(root, "t"), pctx=pctx)
+    from nebula_tpu.query.plan import walk_plan
+    kinds = p.root.kind_tree()
+    assert "Filter" not in kinds
+    scan = next(n for n in walk_plan(p.root) if n.kind == "IndexScan")
+    assert scan.args.get("filter") is not None
+
+
+def test_push_filter_down_set_op(eng):
+    from nebula_tpu.core.expr import Binary, InputProp, Literal
+    from nebula_tpu.query.plan import PlanNode
+    l = PlanNode("Start", col_names=["v"])
+    r = PlanNode("Start", col_names=["v"])
+    u = PlanNode("Union", deps=[l, r], col_names=["v"],
+                 args={"distinct": True})
+    f = PlanNode("Filter", deps=[u], col_names=["v"],
+                 args={"condition": Binary(">", InputProp("v"),
+                                           Literal(2))})
+    p = optimize(ExecutionPlan(f, "t"))
+    assert p.root.kind == "Union"
+    assert all(d.kind == "Filter" for d in p.root.deps)
+
+
+def test_push_limit_into_union_all(eng):
+    from nebula_tpu.query.plan import PlanNode
+    l = PlanNode("Start", col_names=["v"])
+    r = PlanNode("Start", col_names=["v"])
+    u = PlanNode("Union", deps=[l, r], col_names=["v"],
+                 args={"distinct": False})
+    lm = PlanNode("Limit", deps=[u], col_names=["v"],
+                  args={"offset": 1, "count": 3})
+    p = optimize(ExecutionPlan(lm, "t"))
+    assert p.root.kind == "Limit"
+    assert p.root.dep().kind == "Union"
+    assert all(d.kind == "Limit" and d.args["count"] == 4
+               for d in p.root.dep().deps)
+
+
+def test_push_topn_down_project(eng):
+    from nebula_tpu.core.expr import InputProp
+    from nebula_tpu.query.plan import PlanNode
+    base = PlanNode("Start", col_names=["a", "b"])
+    proj = PlanNode("Project", deps=[base], col_names=["x", "y"],
+                    args={"columns": [(InputProp("a"), "x"),
+                                      (InputProp("b"), "y")]})
+    topn = PlanNode("TopN", deps=[proj], col_names=["x", "y"],
+                    args={"factors": [("x", True)], "count": 5,
+                          "offset": 0})
+    p = optimize(ExecutionPlan(topn, "t"))
+    assert p.root.kind == "Project"
+    assert p.root.dep().kind == "TopN"
+    assert p.root.dep().args["factors"] == [("a", True)]
+
+
+def test_push_dedup_through_project(eng):
+    from nebula_tpu.core.expr import InputProp
+    from nebula_tpu.query.plan import PlanNode
+    base = PlanNode("Start", col_names=["a", "b"])
+    proj = PlanNode("Project", deps=[base], col_names=["x", "y"],
+                    args={"columns": [(InputProp("b"), "x"),
+                                      (InputProp("a"), "y")]})
+    dd = PlanNode("Dedup", deps=[proj], col_names=["x", "y"], args={})
+    p = optimize(ExecutionPlan(dd, "t"))
+    assert p.root.kind == "Project"
+    assert p.root.dep().kind == "Dedup"
+
+
+def test_const_fold_filter(eng):
+    from nebula_tpu.core.expr import Binary, Literal
+    from nebula_tpu.query.plan import PlanNode
+    base = PlanNode("Start", col_names=["v"])
+    f = PlanNode("Filter", deps=[base], col_names=["v"],
+                 args={"condition": Binary(">", Literal(1), Literal(2))})
+    p = optimize(ExecutionPlan(f, "t"))
+    # 1 > 2 folds to false; the false-filter eliminator empties the plan
+    from nebula_tpu.query.plan import walk_plan
+    assert all(n.kind != "Filter" for n in walk_plan(p.root))
+
+
+def test_eliminate_dedup_after_unique_scan(eng):
+    from nebula_tpu.query.plan import PlanNode
+    scan = PlanNode("ScanVertices", deps=[], col_names=["a"],
+                    args={"space": "t", "tag": "person", "as_col": "a"})
+    dd = PlanNode("Dedup", deps=[scan], col_names=["a"], args={})
+    p = optimize(ExecutionPlan(dd, "t"))
+    assert p.root.kind == "ScanVertices"
