@@ -53,6 +53,17 @@ def _median(xs):
     return statistics.median(xs)
 
 
+def _gc_settle():
+    """Collect then freeze the live object graph (graphs, pinned
+    snapshots, the jax runtime) out of the collector's scan set.
+    Periodic gen-2 collections over jax's module graph stalled queries
+    by ~250 ms — a bimodal 60/290 ms p50 on an otherwise idle host.
+    Freezing is cumulative and cheap; fresh garbage is still collected."""
+    import gc
+    gc.collect()
+    gc.freeze()
+
+
 def bench_engine_config(name, store, query, seeds_note, rt, space="snb",
                         numpy_fn=None, canon=None):
     """Engine-E2E wall time, device plane OFF vs ON, identical rows.
@@ -74,6 +85,7 @@ def bench_engine_config(name, store, query, seeds_note, rt, space="snb",
         eng.execute(s, f"USE {space}")
         rs = eng.execute(s, query)          # warmup (compile + pin)
         assert rs.error is None, f"{name}: {rs.error}"
+        _gc_settle()
         lat = []
         for _ in range(REPEATS):
             t0 = time.perf_counter()
@@ -381,6 +393,7 @@ def main():
     _mark("config 6: warmup traverse (compile + escalation)")
     rows, st = rt.traverse(sstore, "ns", big_seeds, ["KNOWS"], "out", 3,
                            yields=yields)   # warmup + escalation settle
+    _gc_settle()
     _mark("config 6: timed repeats")
     lat, klat = [], []
     for _ in range(REPEATS):
@@ -434,6 +447,7 @@ def main():
     _mark("config 5: BFS")
     bfs_src = big_seeds[:1]
     dist, stb = rt.bfs(sstore, "ns", bfs_src, ["KNOWS"], "out", 5)
+    _gc_settle()
     lat = []
     for _ in range(3):
         t0 = time.perf_counter()

@@ -31,7 +31,8 @@ class GraphService:
         # SHOW HOSTS / SHOW SESSIONS read live cluster state through meta
         self.engine.qctx.cluster = meta
         self.sessions: Dict[int, Session] = {}
-        self.lock = threading.RLock()
+        from ..utils.racecheck import make_lock
+        self.lock = make_lock("graph_sessions")
         # password auth; default open root (the reference ships
         # enable_authorize=false with root/nebula)
         self.users = users if users is not None else {"root": "nebula"}

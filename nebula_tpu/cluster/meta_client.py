@@ -36,7 +36,8 @@ class MetaClient:
         self.catalog = Catalog()
         self.part_map: Dict[str, List[List[str]]] = {}
         self.version = -1
-        self.lock = threading.RLock()
+        from ..utils.racecheck import make_lock
+        self.lock = make_lock("meta_client")
         self._clients: Dict[str, RpcClient] = {}
         self._leader: Optional[str] = None
         self._hb_thread: Optional[threading.Thread] = None

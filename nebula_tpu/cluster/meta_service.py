@@ -253,7 +253,8 @@ class MetaService:
         self.my_addr = my_addr
         self.peers = peers
         self.state = MetaState()
-        self.state_lock = threading.RLock()
+        from ..utils.racecheck import make_lock
+        self.state_lock = make_lock("meta_state")
         # addr → {"role", "last_hb" (monotonic), "parts": {space: [pids]}}
         self.active_hosts: Dict[str, Dict[str, Any]] = {}
 

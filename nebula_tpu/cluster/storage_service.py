@@ -53,7 +53,8 @@ class StorageService:
         self.data_dir = data_dir
         self.store = GraphStore(catalog=meta.catalog)
         self.parts: Dict[Tuple[int, int], RaftPart] = {}   # (space_id, pid)
-        self.parts_lock = threading.RLock()
+        from ..utils.racecheck import make_lock
+        self.parts_lock = make_lock("storage_parts")
         self._resume_alive = False
         self._resume_thread: Optional[threading.Thread] = None
         # (group, idx) → error string for entries whose apply failed;

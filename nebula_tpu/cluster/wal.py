@@ -31,7 +31,8 @@ class Wal:
     def __init__(self, path: str, sync: bool = False):
         self.path = path
         self.sync = sync
-        self.lock = threading.RLock()
+        from ..utils.racecheck import make_lock
+        self.lock = make_lock("wal")
         self._entries: List[Tuple[int, int, int]] = []  # (index, term, offset)
         self._first_index = 1
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)

@@ -55,6 +55,15 @@ class QueryEngine:
         self.sessions: Dict[int, Session] = {}
 
     def new_session(self, user: str = "root") -> Session:
+        # reap idle sessions so a long-lived embedded engine doesn't
+        # accumulate them (the cluster graphd reaps via metad TTL; the
+        # standalone registry uses the same idle-timeout flag)
+        from ..utils.config import get_config
+        ttl = float(get_config().get("session_idle_timeout_secs"))
+        now = time.time()
+        for sid in [sid for sid, ss in self.sessions.items()
+                    if now - ss.last_used > ttl]:
+            self.sessions.pop(sid, None)
         s = Session(user)
         self.sessions[s.id] = s
         return s

@@ -772,19 +772,26 @@ def _aggregate(node, qctx, ectx, space):
     cols: List[Tuple[Expr, str]] = a["columns"]
     names = [n for _, n in cols]
 
+    # per-column aggregate structure is static — derive it ONCE, not per
+    # row (has_aggregate/collect_aggregates per row dominated the whole
+    # executor on wide inputs)
+    col_aggs = [collect_aggregates(e) if has_aggregate(e) else None
+                for e, _ in cols]
+
     groups: Dict[Tuple, Dict[str, Any]] = {}
     order: List[Tuple] = []
     for r in ds.rows:
         rc = RowContext(qctx, space, row_dict(ds, r))
-        key = tuple(hashable_key(k.eval(rc)) for k in group_keys)
+        key_vals = [k.eval(rc) for k in group_keys]
+        key = tuple(hashable_key(v) for v in key_vals)
         g = groups.get(key)
         if g is None:
-            g = groups[key] = {"key_vals": [k.eval(rc) for k in group_keys],
+            g = groups[key] = {"key_vals": key_vals,
                                "agg_inputs": [[] for _ in cols]}
             order.append(key)
         for i, (e, _) in enumerate(cols):
-            if has_aggregate(e):
-                aggs = collect_aggregates(e)
+            aggs = col_aggs[i]
+            if aggs is not None:
                 g["agg_inputs"][i].append(
                     [ag.eval(rc) if ag.arg is not None else 1 for ag in aggs])
             else:
@@ -810,7 +817,7 @@ def _aggregate(node, qctx, ectx, space):
             vals = g["agg_inputs"][i]
             if isinstance(e, AggExpr):
                 out.append(e.apply([v[0] for v in vals]))
-            elif has_aggregate(e):
+            elif col_aggs[i] is not None:
                 out.append(_eval_with_aggs(e, vals, qctx, space))
             else:
                 out.append(vals[0][0] if vals else NULL)
@@ -1463,6 +1470,16 @@ def _show(node, qctx, ectx, space):
                                 key=lambda x: x.job_id)
                 if j.command.startswith("rebuild index ")]
         return DataSet(["Name", "Index Status"], rows)
+    if kind == "meta_leader":
+        cluster = getattr(qctx, "cluster", None)
+        if cluster is not None:
+            cluster.call("meta.ready")           # refresh the hint
+            addr = cluster._leader or ""
+            host, _, port = addr.partition(":")
+            return DataSet(["Meta Leader", "secs from last heart beat"],
+                           [[f"{host}:{port}", 0]])
+        return DataSet(["Meta Leader", "secs from last heart beat"],
+                       [["in-process", 0]])
     if kind == "text_search_clients":
         from ..graphstore.fulltext import text_services
         return DataSet(["Host", "Port", "Connection type"],
@@ -1703,6 +1720,28 @@ def _sign_out_text_service(node, qctx, ectx, space):
     except ValueError as ex:
         raise ExecError(str(ex)) from None
     return DataSet()
+
+
+@executor("AlterSpace")
+def _alter_space(node, qctx, ectx, space):
+    """ALTER SPACE s ADD ZONE z: future replicas of s may also land in
+    zone z's hosts.  The placement model here derives candidate hosts
+    from ALL zones at CREATE/BALANCE time, so the zone set is validated
+    and the statement acknowledged (a per-space zone whitelist is a
+    placement-policy refinement the balancer does not yet enforce)."""
+    cluster = _need_cluster(qctx, "ALTER SPACE ... ADD ZONE")
+    qctx.catalog.get_space(node.args["name"])
+    zones = cluster.list_zones()
+    if node.args["zone"] not in zones:
+        raise ExecError(f"zone `{node.args['zone']}' not found")
+    return DataSet()
+
+
+@executor("Download")
+def _download(node, qctx, ectx, space):
+    raise ExecError("DOWNLOAD HDFS needs an HDFS endpoint (none is "
+                    "configured in this deployment; use the bulk "
+                    "importer: nebula_tpu.tools.ldbc_import)")
 
 
 @executor("DescribeUser")

@@ -15,6 +15,10 @@ from typing import Any, Dict, List, Optional
 
 from ..core.value import DataSet
 
+class JobStopped(Exception):
+    """A task observed its cancel token (STOP JOB) and aborted."""
+
+
 @dataclass
 class Job:
     job_id: int
@@ -24,6 +28,7 @@ class Job:
     stop_time: float = 0.0
     result: Optional[Dict[str, Any]] = None
     space: Optional[str] = None          # RECOVER re-runs in this space
+    cancel: Any = None                   # threading.Event (task lifecycle)
 
 
 class JobManager:
@@ -32,20 +37,42 @@ class JobManager:
         self._ids = itertools.count(1)   # per-manager: deterministic ids
 
     def submit(self, qctx, command: str, space: Optional[str]) -> Job:
-        job = Job(next(self._ids), command, space=space)
+        import threading
+        job = Job(next(self._ids), command, space=space,
+                  cancel=threading.Event())
         self.jobs[job.job_id] = job
         job.status = "RUNNING"
         job.start_time = time.time()
         try:
-            job.result = self._run(qctx, command, space)
+            job.result = self._run(qctx, command, space, job)
             job.status = "FINISHED"
+        except JobStopped:
+            job.status = "STOPPED"
+            job.result = {"stopped": True}
         except Exception as ex:  # noqa: BLE001 - job errors are recorded
             job.status = "FAILED"
             job.result = {"error": str(ex)}
         job.stop_time = time.time()
         return job
 
-    def _run(self, qctx, command: str, space: Optional[str]) -> Dict[str, Any]:
+    def _run(self, qctx, command: str, space: Optional[str],
+             job: Optional[Job] = None) -> Dict[str, Any]:
+        token = job.cancel if job is not None else None
+        if command.startswith("repartition "):
+            # the part split/merge task (SURVEY §2 row 16): re-home the
+            # space onto a new partition count; cancellable mid-scan
+            if not space:
+                raise ValueError("repartition job needs a space")
+            if not hasattr(qctx.store, "repartition"):
+                raise ValueError(
+                    "repartition runs on the standalone store; the "
+                    "cluster form needs a metad-orchestrated part-move "
+                    "plan (BALANCE DATA) instead")
+            n = int(command[len("repartition "):])
+            moved = qctx.store.repartition(space, n, cancel=token)
+            if moved < 0:
+                raise JobStopped()
+            return {"moved_vertices": moved, "partition_num": n}
         if command == "stats":
             if not space:
                 raise ValueError("stats job needs a space")
@@ -81,6 +108,13 @@ class JobManager:
             return {}
         if command == "ingest":
             return {}
+        if command == "flush":
+            # persist in-memory state: a checkpoint + journal truncation
+            # (the memtable-flush analog of the reference's FLUSH job)
+            if getattr(qctx.store, "_engine", None) is not None:
+                return {"journal_compacted_to":
+                        qctx.store.compact_journal()}
+            return {"flushed": False, "reason": "in-memory store"}
         if command.startswith("rebuild index "):
             if not space:
                 raise ValueError("rebuild index job needs a space")
@@ -129,8 +163,12 @@ def stop_job(node, qctx) -> DataSet:
         raise ValueError(f"job {jid} not found")
     if job.status == "FINISHED":
         raise ValueError(f"job {jid} already finished")
-    job.status = "STOPPED"
-    job.stop_time = time.time()
+    if job.cancel is not None:
+        job.cancel.set()         # a RUNNING task aborts at its next
+        # cancel point (repartition: between source partitions)
+    if job.status != "RUNNING":
+        job.status = "STOPPED"
+        job.stop_time = time.time()
     return DataSet(["Result"], [["Job stopped"]])
 
 
@@ -151,9 +189,15 @@ def recover_job(node, qctx) -> DataSet:
     for j in targets:
         j.status = "RUNNING"
         j.start_time = time.time()
+        if j.cancel is not None:
+            j.cancel.clear()     # the re-run gets a LIVE cancel token —
+            # STOP JOB on a recovered task must still work
         try:
-            j.result = mgr._run(qctx, j.command, j.space)
+            j.result = mgr._run(qctx, j.command, j.space, j)
             j.status = "FINISHED"
+        except JobStopped:
+            j.status = "STOPPED"
+            j.result = {"stopped": True}
         except Exception as ex:  # noqa: BLE001 — job errors are recorded
             j.status = "FAILED"
             j.result = {"error": str(ex)}

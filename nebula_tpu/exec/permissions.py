@@ -30,7 +30,8 @@ _GLOBAL_GOD = (
     A.DropHostsSentence, A.MergeZoneSentence, A.RenameZoneSentence,
     A.ClearSpaceSentence, A.KillSessionSentence, A.StopJobSentence,
     A.RecoverJobSentence, A.SignInTextServiceSentence,
-    A.SignOutTextServiceSentence, A.DescribeUserSentence)
+    A.SignOutTextServiceSentence, A.DescribeUserSentence,
+    A.AlterSpaceSentence, A.DownloadSentence, A.IngestSentence)
 _SPACE_ADMIN = (A.GrantRoleSentence, A.RevokeRoleSentence)
 _SPACE_DBA = (
     A.CreateSchemaSentence, A.AlterSchemaSentence, A.DropSchemaSentence,

@@ -86,7 +86,8 @@ class DurableEngine:
         os.makedirs(data_dir, exist_ok=True)
         self.ckpt_dir = os.path.join(data_dir, "checkpoint")
         self.journal = Wal(os.path.join(data_dir, "journal.wal"), sync=True)
-        self.lock = threading.Lock()
+        from ..utils.racecheck import make_lock
+        self.lock = make_lock("journal")
         self._replaying = False
 
     # -- write path --------------------------------------------------------
@@ -210,6 +211,9 @@ class DurableEngine:
             return
         if op == "clear_space":
             store.clear_space(cmd[1], if_exists=True)
+            return
+        if op == "repartition":
+            store.repartition(cmd[1], cmd[2])
             return
         raise ValueError(f"unknown journal op {op!r}")
 

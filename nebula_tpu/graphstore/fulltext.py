@@ -123,7 +123,8 @@ class FulltextIndexData:
         # guards values/tokens: the listener thread writes while query
         # threads search — unsynchronized dict iteration would raise
         # "dictionary changed size during iteration" mid-LOOKUP
-        self.lock = threading.RLock()
+        from ..utils.racecheck import make_lock
+        self.lock = make_lock("fulltext_data")
         self.values: List[Dict[Any, str]] = [dict()
                                              for _ in range(num_parts)]
         self.tokens: List[Dict[str, set]] = [dict()

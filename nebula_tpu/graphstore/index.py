@@ -67,7 +67,8 @@ class IndexData:
         self.is_edge = is_edge
         self.index_id = index_id
         self.parts: List[List[Tuple]] = [[] for _ in range(num_parts)]
-        self.lock = threading.RLock()
+        from ..utils.racecheck import make_lock
+        self.lock = make_lock("index_data")
 
     def key_of(self, row: Dict[str, Any]) -> Tuple:
         return tuple(norm(row.get(f)) for f in self.fields)

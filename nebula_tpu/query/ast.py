@@ -402,6 +402,28 @@ class DescribeUserSentence(Sentence):
 
 
 @dataclass
+class AlterSpaceSentence(Sentence):
+    name: str
+    op: str                             # add_zone
+    zone: str
+
+
+@dataclass
+class DownloadSentence(Sentence):
+    """DOWNLOAD HDFS "url" — the legacy bulk-load pipeline's fetch leg
+    (always errors here: no HDFS offline; the surface exists for grammar
+    parity)."""
+    url: str
+
+
+@dataclass
+class IngestSentence(Sentence):
+    """INGEST — the legacy bulk-load pipeline's apply leg (canonicalized
+    to the ingest job)."""
+    pass
+
+
+@dataclass
 class CreateUserSentence(Sentence):
     name: str
     password: str

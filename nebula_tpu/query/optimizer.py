@@ -869,6 +869,171 @@ def push_limit_into_union_all(node: PlanNode) -> Optional[PlanNode]:
                     args=dict(node.args))
 
 
+def _is_empty_marker(n: PlanNode) -> bool:
+    return n.kind == "Project" and n.args.get("empty") and not n.deps
+
+
+@register_rule
+def eliminate_empty_set_op_branch(node: PlanNode) -> Optional[PlanNode]:
+    """Set op with a statically-empty branch simplifies (reference: the
+    degenerate-plan prune family): UNION keeps the live side (deduped
+    when distinct), INTERSECT dies, MINUS keeps/dies by side."""
+    if node.kind not in ("Union", "Intersect", "Minus") \
+            or not _setop_pushable(node):
+        return None                      # branch col names must equal the
+    l, r = node.deps                     # op's: the survivor replaces it
+    le, re_ = _is_empty_marker(l), _is_empty_marker(r)
+    if not le and not re_:
+        return None
+
+    def empty():
+        return PlanNode("Project", deps=[], col_names=list(node.col_names),
+                        args={"empty": True})
+
+    def distinct_of(side):
+        # set-op executors dedup their output; the surviving branch
+        # must keep that semantics
+        return PlanNode("Dedup", deps=[side],
+                        col_names=list(side.col_names), args={})
+
+    if node.kind == "Union":
+        if le and re_:
+            return empty()
+        live = r if le else l
+        return distinct_of(live) if node.args.get("distinct") else live
+    if node.kind == "Intersect":
+        return empty()
+    # Minus
+    if le:
+        return empty()
+    return distinct_of(l)
+
+
+@register_rule
+def fold_constant_project_columns(node: PlanNode) -> Optional[PlanNode]:
+    """Project columns that are literal-only arithmetic fold to their
+    value at plan time (reference: FoldConstantExprRule, project leg)."""
+    from ..core.expr import DictContext, Literal
+    if node.kind != "Project":
+        return None
+    cols = node.args.get("columns") or []
+    new_cols, changed = [], False
+    for e, n in cols:
+        if e.kind in ("binary", "unary") and all(
+                x.kind in ("literal", "binary", "unary")
+                for x in walk(e)):
+            try:
+                val = e.eval(DictContext())
+            except Exception:  # noqa: BLE001 — leave runtime errors alone
+                new_cols.append((e, n))
+                continue
+            from ..core.value import is_null
+            if is_null(val) or isinstance(val, (list, tuple, set, dict)):
+                # null KINDS and container identity must survive to
+                # runtime untouched
+                new_cols.append((e, n))
+                continue
+            new_cols.append((Literal(val), n))
+            changed = True
+        else:
+            new_cols.append((e, n))
+    if not changed:
+        return None
+    new_args = dict(node.args)
+    new_args["columns"] = new_cols
+    return PlanNode("Project", deps=list(node.deps),
+                    col_names=list(node.col_names), args=new_args)
+
+
+@register_rule
+def push_sample_down_project(node: PlanNode) -> Optional[PlanNode]:
+    """Sample(Project[rename-only]) → Project(Sample) — sampling rows
+    commutes with a column rename; the Project then materializes only
+    the sampled rows (reference: PushSampleDownProjectRule class)."""
+    if node.kind != "Sample" or len(node.deps) != 1:
+        return None
+    proj = node.dep()
+    if not _rename_only_project(proj) or len(proj.deps) != 1:
+        return None
+    child = proj.dep()
+    smp = PlanNode("Sample", deps=[child], col_names=list(child.col_names),
+                   args=dict(node.args))
+    return PlanNode("Project", deps=[smp], col_names=list(proj.col_names),
+                    args=dict(proj.args))
+
+
+@register_rule
+def merge_dedup_into_distinct_union(node: PlanNode) -> Optional[PlanNode]:
+    """Dedup(UNION DISTINCT) → the union (its executor already dedups)
+    (reference: RemoveNoopDedupRule over distinct set ops)."""
+    if node.kind != "Dedup" or len(node.deps) != 1:
+        return None
+    child = node.dep()
+    if child.kind in ("Union",) and child.args.get("distinct"):
+        return child
+    if child.kind in ("Intersect", "Minus"):
+        return child                     # both executors emit distinct rows
+    return None
+
+
+@register_rule
+def push_filter_down_sort(node: PlanNode) -> Optional[PlanNode]:
+    """Filter(Sort) → Sort(Filter): filtering preserves a stable sort's
+    order, and the sort then works on fewer rows (reference:
+    PushFilterDownSortRule class)."""
+    if node.kind != "Filter" or len(node.deps) != 1:
+        return None
+    srt = node.dep()
+    if srt.kind != "Sort" or len(srt.deps) != 1:
+        return None
+    child = srt.dep()
+    f = PlanNode("Filter", deps=[child], col_names=list(child.col_names),
+                 args=dict(node.args))
+    return PlanNode("Sort", deps=[f], col_names=list(srt.col_names),
+                    args=dict(srt.args))
+
+
+@register_rule
+def eliminate_dedup_after_aggregate(node: PlanNode) -> Optional[PlanNode]:
+    """Dedup(Aggregate) → Aggregate when every group key is among the
+    projected columns — each group emits exactly one row, and rows from
+    different groups differ on the key columns."""
+    from ..core.expr import to_text
+    if node.kind != "Dedup" or len(node.deps) != 1:
+        return None
+    agg = node.dep()
+    if agg.kind != "Aggregate":
+        return None
+    keys = agg.args.get("group_keys") or []
+    if not keys:
+        return agg                       # global aggregate: single row
+    col_texts = {to_text(e) for e, _ in agg.args.get("columns", [])}
+    if all(to_text(k) in col_texts for k in keys):
+        return agg
+    return None
+
+
+@register_rule
+def merge_limit_into_topn(node: PlanNode) -> Optional[PlanNode]:
+    """Limit(TopN) → TopN with the composed window (same offset
+    composition as merge_adjacent_limits)."""
+    if node.kind != "Limit" or len(node.deps) != 1:
+        return None
+    tn = node.dep()
+    if tn.kind != "TopN":
+        return None
+    lo, lc = node.args.get("offset") or 0, node.args.get("count")
+    to_, tc = tn.args.get("offset") or 0, tn.args.get("count")
+    if lc is None or lc < 0 or tc is None or tc < 0:
+        return None
+    new_off = to_ + lo
+    new_cnt = max(0, min(tc - lo, lc))
+    new_args = dict(tn.args)
+    new_args["offset"], new_args["count"] = new_off, new_cnt
+    return PlanNode("TopN", deps=list(tn.deps),
+                    col_names=list(node.col_names), args=new_args)
+
+
 @register_explore_rule
 def index_seed_for_match_scan(node: PlanNode, pctx) -> List[PlanNode]:
     """MATCH (a:T) WHERE a.T.prop ... : offer Filter(IndexScan) as an

@@ -187,6 +187,7 @@ class Parser:
             "STOP": self.p_stop_job, "RECOVER": self.p_recover_job,
             "SIGN": self.p_sign, "MERGE": self.p_merge_zone,
             "RENAME": self.p_rename_zone, "BALANCE": self.p_balance,
+            "DOWNLOAD": self.p_download, "INGEST": self.p_ingest,
         }.get(kw)
         if fn is None:
             raise ParseError(f"unsupported statement `{kw}' at pos {t.pos}")
@@ -224,6 +225,8 @@ class Parser:
             if self.peek().kind in ("IDENT", "KEYWORD") \
                     and not self.at(";"):
                 name = self.ident()
+                if self.accept(":"):    # module prefix (one process)
+                    name = self.ident()
             return A.GetConfigsSentence(name)
         return self.p_subgraph()
 
@@ -287,6 +290,15 @@ class Parser:
         old = self.ident()
         self.expect_kw("TO")
         return A.RenameZoneSentence(old, self.ident())
+
+    def p_download(self) -> A.DownloadSentence:
+        self.expect_kw("DOWNLOAD")
+        self.expect_kw("HDFS")
+        return A.DownloadSentence(self.expect("STRING").value)
+
+    def p_ingest(self) -> A.IngestSentence:
+        self.expect_kw("INGEST")
+        return A.IngestSentence()
 
     def p_balance(self) -> A.SubmitJobSentence:
         """BALANCE DATA [REMOVE "host" [, ...]] / BALANCE LEADER — the
@@ -648,6 +660,11 @@ class Parser:
             self.expect_kw("WITH")
             self.expect_kw("PASSWORD")
             return A.AlterUserSentence(name, self.expect("STRING").value)
+        if self.accept_kw("SPACE"):
+            name = self.ident()
+            self.expect_kw("ADD")
+            self.expect_kw("ZONE")
+            return A.AlterSpaceSentence(name, "add_zone", self.ident())
         is_edge = self.expect_kw("TAG", "EDGE").value == "EDGE"
         name = self.ident()
         out = A.AlterSchemaSentence(is_edge, name)
@@ -701,6 +718,10 @@ class Parser:
                 self.expect_kw("SEARCH")
                 self.expect_kw("CLIENTS")
                 return A.ShowSentence("text_search_clients")
+            if kw == "META":
+                self.next()
+                self.expect_kw("LEADER")
+                return A.ShowSentence("meta_leader")
             if kw in ("TAGS", "EDGES", "USERS", "ZONES"):
                 self.next()
                 return A.ShowSentence(kw.lower())
@@ -765,8 +786,11 @@ class Parser:
         self.expect_kw("SUBMIT")
         self.expect_kw("JOB")
         parts = [self.ident().lower()]
-        while self.peek().kind in ("KEYWORD", "IDENT"):
-            parts.append(self.ident().lower())
+        while self.peek().kind in ("KEYWORD", "IDENT", "INT"):
+            if self.at("INT"):
+                parts.append(str(self.next().value))
+            else:
+                parts.append(self.ident().lower())
         return A.SubmitJobSentence(" ".join(parts))
 
     def p_kill(self) -> A.Sentence:

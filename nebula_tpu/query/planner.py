@@ -1344,6 +1344,12 @@ def _register_dispatch():
             "SignOutTextService"),
         A.DescribeUserSentence: lambda p, s: _admin(
             "DescribeUser", cols=["role", "space"], name=s.name),
+        A.AlterSpaceSentence: lambda p, s: _admin(
+            "AlterSpace", name=s.name, op=s.op, zone=s.zone),
+        A.DownloadSentence: lambda p, s: _admin(
+            "Download", url=s.url),
+        A.IngestSentence: lambda p, s: _admin(
+            "SubmitJob", cols=["New Job Id"], job="ingest", space=p.space),
         A.CreateUserSentence: lambda p, s: _admin(
             "CreateUser", name=s.name, password=s.password,
             if_not_exists=s.if_not_exists),

@@ -33,7 +33,7 @@ KEYWORDS = {
     "ZONE", "ZONES", "INTO", "FULLTEXT", "LISTENER", "ELASTICSEARCH",
     "REMOVE", "CHARSET", "COLLATION", "CLEAR", "STOP", "RECOVER", "SIGN",
     "MERGE", "RENAME", "TEXT", "SERVICE", "SEARCH", "CLIENTS", "STATUS",
-    "META", "GRAPH", "STORAGE",
+    "META", "GRAPH", "STORAGE", "DOWNLOAD", "HDFS",
     # types
     "INT", "INT64", "INT32", "INT16", "INT8", "FLOAT", "DOUBLE", "STRING",
     "FIXED_STRING", "BOOL", "TIMESTAMP", "DATE", "TIME", "DATETIME",

@@ -453,3 +453,72 @@ def test_eliminate_dedup_after_unique_scan(eng):
     dd = PlanNode("Dedup", deps=[scan], col_names=["a"], args={})
     p = optimize(ExecutionPlan(dd, "t"))
     assert p.root.kind == "ScanVertices"
+
+
+def test_eliminate_empty_set_op_branch(eng):
+    from nebula_tpu.query.plan import PlanNode
+    live = PlanNode("Start", col_names=["v"])
+    empty = PlanNode("Project", deps=[], col_names=["v"],
+                     args={"empty": True})
+    u = PlanNode("Union", deps=[empty, live], col_names=["v"],
+                 args={"distinct": True})
+    p = optimize(ExecutionPlan(u, "t"))
+    assert p.root.kind == "Dedup" and p.root.dep().kind == "Start"
+    i = PlanNode("Intersect", deps=[live, empty], col_names=["v"], args={})
+    p = optimize(ExecutionPlan(i, "t"))
+    assert p.root.args.get("empty")
+    m = PlanNode("Minus", deps=[live, empty], col_names=["v"], args={})
+    p = optimize(ExecutionPlan(m, "t"))
+    assert p.root.kind == "Dedup"
+
+
+def test_fold_constant_project_columns(eng):
+    from nebula_tpu.core.expr import Binary, Literal
+    from nebula_tpu.query.plan import PlanNode
+    base = PlanNode("Start", col_names=[])
+    proj = PlanNode("Project", deps=[base], col_names=["x"],
+                    args={"columns": [(Binary("+", Literal(2),
+                                              Literal(3)), "x")]})
+    p = optimize(ExecutionPlan(proj, "t"))
+    e = p.root.args["columns"][0][0]
+    assert e.kind == "literal" and e.value == 5
+
+
+def test_push_filter_down_sort(eng):
+    from nebula_tpu.core.expr import Binary, InputProp, Literal
+    from nebula_tpu.query.plan import PlanNode
+    base = PlanNode("Start", col_names=["v"])
+    srt = PlanNode("Sort", deps=[base], col_names=["v"],
+                   args={"factors": [("v", True)]})
+    f = PlanNode("Filter", deps=[srt], col_names=["v"],
+                 args={"condition": Binary(">", InputProp("v"),
+                                           Literal(0))})
+    p = optimize(ExecutionPlan(f, "t"))
+    assert p.root.kind == "Sort" and p.root.dep().kind == "Filter"
+
+
+def test_merge_limit_into_topn(eng):
+    from nebula_tpu.query.plan import PlanNode
+    base = PlanNode("Start", col_names=["v"])
+    tn = PlanNode("TopN", deps=[base], col_names=["v"],
+                  args={"factors": [("v", True)], "offset": 1,
+                        "count": 10})
+    lm = PlanNode("Limit", deps=[tn], col_names=["v"],
+                  args={"offset": 2, "count": 4})
+    p = optimize(ExecutionPlan(lm, "t"))
+    assert p.root.kind == "TopN"
+    assert p.root.args["offset"] == 3 and p.root.args["count"] == 4
+
+
+def test_eliminate_dedup_after_aggregate(eng):
+    from nebula_tpu.core.expr import InputProp
+    from nebula_tpu.core.expr import AggExpr
+    from nebula_tpu.query.plan import PlanNode
+    base = PlanNode("Start", col_names=["v"])
+    agg = PlanNode("Aggregate", deps=[base], col_names=["v", "c"],
+                   args={"group_keys": [InputProp("v")],
+                         "columns": [(InputProp("v"), "v"),
+                                     (AggExpr("count", None), "c")]})
+    dd = PlanNode("Dedup", deps=[agg], col_names=["v", "c"], args={})
+    p = optimize(ExecutionPlan(dd, "t"))
+    assert p.root.kind == "Aggregate"
