@@ -1,0 +1,147 @@
+Feature: Builtin function library coverage
+
+  Background:
+    Given having executed:
+      """
+      CREATE SPACE fl(partition_num=2, vid_type=INT64);
+      USE fl;
+      CREATE TAG person(name string, age int)
+      """
+
+  Scenario: numeric functions
+    When executing query:
+      """
+      YIELD abs(-3) AS a, sign(-7) AS s, floor(2.7) AS f, ceil(2.1) AS c,
+            round(2.5) AS r, sqrt(16) AS q, cbrt(27) AS cb,
+            pow(2, 10) AS p, hypot(3, 4) AS h
+      """
+    Then the result should be, in order:
+      | a | s  | f   | c   | r   | q   | cb  | p    | h   |
+      | 3 | -1 | 2.0 | 3.0 | 3.0 | 4.0 | 3.0 | 1024 | 5.0 |
+
+  Scenario: exp and log family
+    When executing query:
+      """
+      YIELD exp(0) AS e0, exp2(3) AS e2, log(e()) AS l, log2(8) AS l2,
+            log10(1000) AS l10
+      """
+    Then the result should be, in order:
+      | e0  | e2  | l   | l2  | l10 |
+      | 1.0 | 8.0 | 1.0 | 3.0 | 3.0 |
+
+  Scenario: rounding is half away from zero
+    When executing query:
+      """
+      YIELD round(0.5) AS a, round(-0.5) AS b, round(1.25, 1) AS c
+      """
+    Then the result should be, in order:
+      | a   | b    | c   |
+      | 1.0 | -1.0 | 1.3 |
+
+  Scenario: string functions
+    When executing query:
+      """
+      YIELD upper("ab") AS u, lower("AB") AS l, reverse("abc") AS r,
+            trim("  x  ") AS t, left("hello", 2) AS lf,
+            right("hello", 2) AS rt, replace("aXa", "X", "b") AS rp,
+            lpad("7", 3, "0") AS lp, rpad("7", 3, "0") AS rd
+      """
+    Then the result should be, in order:
+      | u    | l    | r     | t   | lf   | rt   | rp    | lp    | rd    |
+      | "AB" | "ab" | "cba" | "x" | "he" | "lo" | "aba" | "007" | "700" |
+
+  Scenario: substring and split
+    When executing query:
+      """
+      YIELD substr("hello", 1, 3) AS s, split("a,b,c", ",") AS sp,
+            concat("a", 1, "b") AS c, concat_ws("-", "x", "y") AS cw
+      """
+    Then the result should be, in order:
+      | s     | sp              | c      | cw    |
+      | "ell" | ["a", "b", "c"] | "a1b"  | "x-y" |
+
+  Scenario: strcasecmp and length
+    When executing query:
+      """
+      YIELD strcasecmp("abc", "ABC") AS eq, length("abcd") AS n,
+            size([1, 2, 3]) AS sz
+      """
+    Then the result should be, in order:
+      | eq | n | sz |
+      | 0  | 4 | 3  |
+
+  Scenario: type conversions
+    When executing query:
+      """
+      YIELD toInteger("42") AS i, toFloat("2.5") AS f,
+            toBoolean("true") AS b, toString(7) AS s,
+            toInteger("nope") AS bad
+      """
+    Then the result should be, in order:
+      | i  | f   | b    | s   | bad  |
+      | 42 | 2.5 | true | "7" | NULL |
+
+  Scenario: null propagation through scalar functions
+    When executing query:
+      """
+      YIELD abs(NULL) AS a, upper(NULL) AS u, pow(NULL, 2) AS p
+      """
+    Then the result should be, in order:
+      | a    | u    | p    |
+      | NULL | NULL | NULL |
+
+  Scenario: bad argument types return bad type null
+    When executing query:
+      """
+      YIELD sqrt("x") IS NULL AS q
+      """
+    Then the result should be, in order:
+      | q    |
+      | true |
+
+  Scenario: collection functions
+    When executing query:
+      """
+      YIELD head([1, 2, 3]) AS h, last([1, 2, 3]) AS l,
+            tail([1, 2, 3]) AS t, range(1, 4) AS r, keys({a: 1, b: 2}) AS k
+      """
+    Then the result should be, in order:
+      | h | l | t      | r            | k          |
+      | 1 | 3 | [2, 3] | [1, 2, 3, 4] | ["a", "b"] |
+
+  Scenario: coalesce picks the first non null
+    When executing query:
+      """
+      YIELD coalesce(NULL, NULL, 7, 9) AS c, coalesce(NULL) AS n
+      """
+    Then the result should be, in order:
+      | c | n    |
+      | 7 | NULL |
+
+  Scenario: hash and digest functions are deterministic
+    When executing query:
+      """
+      YIELD hash("x") == hash("x") AS h, md5("") AS m
+      """
+    Then the result should be, in order:
+      | h    | m                                  |
+      | true | "d41d8cd98f00b204e9800998ecf8427e" |
+
+  Scenario: bit aggregates over grouped rows
+    When executing query:
+      """
+      UNWIND [12, 10, 6] AS v RETURN bit_and(v) AS a, bit_or(v) AS o,
+      bit_xor(v) AS x
+      """
+    Then the result should be, in order:
+      | a | o  | x |
+      | 0 | 14 | 0 |
+
+  Scenario: e and pi constants
+    When executing query:
+      """
+      YIELD round(e(), 3) AS e, round(pi(), 3) AS p
+      """
+    Then the result should be, in order:
+      | e     | p     |
+      | 2.718 | 3.142 |

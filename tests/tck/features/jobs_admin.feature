@@ -1,0 +1,100 @@
+Feature: Job manager and repartition task
+
+  Background:
+    Given having executed:
+      """
+      CREATE SPACE ja(partition_num=2, vid_type=INT64);
+      USE ja;
+      CREATE TAG P(a int);
+      CREATE EDGE E(w int);
+      CREATE TAG INDEX pa ON P(a);
+      INSERT VERTEX P(a) VALUES 1:(10), 2:(20), 3:(30), 4:(40), 5:(50);
+      INSERT EDGE E(w) VALUES 1->2:(1), 2->3:(2), 3->4:(3), 4->5:(4), 5->1:(5)
+      """
+
+  Scenario: stats job reports counts
+    When executing query:
+      """
+      SUBMIT JOB STATS;
+      SHOW STATS
+      """
+    Then the result should be, in any order:
+      | Type    | Name       | Count |
+      | "Tag"   | "P"        | 5     |
+      | "Edge"  | "E"        | 5     |
+      | "Space" | "vertices" | 5     |
+      | "Space" | "edges"    | 5     |
+
+  Scenario: compact job finishes [standalone]
+    When executing query:
+      """
+      SUBMIT JOB COMPACT;
+      SHOW JOB 1
+      """
+    Then the result should be, in order:
+      | Job Id | Command   | Status     |
+      | 1      | "compact" | "FINISHED" |
+
+  Scenario: flush job finishes [standalone]
+    When executing query:
+      """
+      SUBMIT JOB FLUSH;
+      SHOW JOB 1
+      """
+    Then the result should be, in order:
+      | Job Id | Command | Status     |
+      | 1      | "flush" | "FINISHED" |
+
+  Scenario: repartition keeps traversal results identical [standalone]
+    When executing query:
+      """
+      SUBMIT JOB REPARTITION 8;
+      GO 2 STEPS FROM 1 OVER E YIELD dst(edge) AS d
+      """
+    Then the result should be, in order:
+      | d |
+      | 3 |
+
+  Scenario: repartition keeps index lookups working [standalone]
+    When executing query:
+      """
+      SUBMIT JOB REPARTITION 4;
+      LOOKUP ON P WHERE P.a > 25 YIELD id(vertex) AS v | ORDER BY $-.v
+      """
+    Then the result should be, in order:
+      | v |
+      | 3 |
+      | 4 |
+      | 5 |
+
+  Scenario: repartition job records its result [standalone]
+    When executing query:
+      """
+      SUBMIT JOB REPARTITION 8;
+      SHOW JOB 1
+      """
+    Then the result should be, in order:
+      | Job Id | Command         | Status     |
+      | 1      | "repartition 8" | "FINISHED" |
+
+  Scenario: unknown job command fails the job [standalone]
+    When executing query:
+      """
+      SUBMIT JOB NO_SUCH_THING;
+      SHOW JOB 1
+      """
+    Then the result should be, in order:
+      | Job Id | Command         | Status   |
+      | 1      | "no_such_thing" | "FAILED" |
+
+  Scenario: show jobs lists every submitted job [standalone]
+    When executing query:
+      """
+      SUBMIT JOB STATS;
+      SUBMIT JOB COMPACT;
+      SHOW JOBS
+      """
+    Then the result should be, in any order:
+      | Job Id | Command   | Status     |
+      | 1      | "stats"   | "FINISHED" |
+      | 2      | "compact" | "FINISHED" |

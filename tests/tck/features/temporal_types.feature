@@ -1,0 +1,79 @@
+Feature: Temporal types and accessors
+
+  Background:
+    Given having executed:
+      """
+      CREATE SPACE tt(partition_num=2, vid_type=INT64);
+      USE tt;
+      CREATE TAG event(at datetime, d date, t time)
+      """
+
+  Scenario: date components
+    When executing query:
+      """
+      YIELD year(date("2024-03-09")) AS y, month(date("2024-03-09")) AS m,
+            day(date("2024-03-09")) AS d
+      """
+    Then the result should be, in order:
+      | y    | m | d |
+      | 2024 | 3 | 9 |
+
+  Scenario: time components
+    When executing query:
+      """
+      YIELD hour(time("13:04:05")) AS h, minute(time("13:04:05")) AS m,
+            second(time("13:04:05")) AS s
+      """
+    Then the result should be, in order:
+      | h  | m | s |
+      | 13 | 4 | 5 |
+
+  Scenario: datetime roundtrip through storage
+    When executing query:
+      """
+      INSERT VERTEX event(at, d, t)
+        VALUES 1:(datetime("2024-03-09T13:04:05"), date("2024-03-09"), time("13:04:05"));
+      FETCH PROP ON event 1 YIELD year(event.at) AS y, day(event.d) AS dd,
+        hour(event.t) AS h
+      """
+    Then the result should be, in order:
+      | y    | dd | h  |
+      | 2024 | 9  | 13 |
+
+  Scenario: date ordering
+    When executing query:
+      """
+      YIELD date("2024-01-02") < date("2024-02-01") AS lt,
+            date("2024-01-02") == date("2024-01-02") AS eq
+      """
+    Then the result should be, in order:
+      | lt   | eq   |
+      | true | true |
+
+  Scenario: duration arithmetic shifts dates
+    When executing query:
+      """
+      YIELD date("2024-03-09") + duration({days: 3}) AS p,
+            date("2024-03-09") - duration({days: 9}) AS m
+      """
+    Then the result should be, in order:
+      | p            | m            |
+      | 2024-03-12   | 2024-02-29   |
+
+  Scenario: dayofweek and dayofyear
+    When executing query:
+      """
+      YIELD dayofweek(date("2024-03-09")) AS w, dayofyear(date("2024-03-09")) AS y
+      """
+    Then the result should be, in order:
+      | w | y  |
+      | 7 | 69 |
+
+  Scenario: malformed temporal literals error
+    When executing query:
+      """
+      YIELD date("not-a-date") IS NULL AS bad
+      """
+    Then the result should be, in order:
+      | bad  |
+      | true |
