@@ -39,13 +39,89 @@ def _pow2(n: int) -> int:
 
 
 def _d2v(host) -> np.ndarray:
-    """Cached dense-id → vid object array for batch vid decode (shared
-    by the GO materializer and the MATCH frame builder)."""
+    """Cached dense-id → vid array for batch vid decode (shared by the
+    GO materializer and the MATCH frame builder).  INT64 when every vid
+    is an int (the common case — object-array gathers over millions of
+    result edges cost ~10× an int64 gather), object otherwise."""
     arr = getattr(host, "_d2v_arr", None)
     if arr is None or len(arr) != len(host.dense_to_vid):
-        arr = np.asarray(host.dense_to_vid, dtype=object)
+        d2v = host.dense_to_vid
+        # gate on an ACTUAL int vid: np.asarray would happily parse
+        # digit STRINGS ('12' → 12), silently retyping FIXED_STRING
+        # results — a space's vids are homogeneous, so one sample
+        # decides (None slots are deleted vids → object path)
+        sample = next((v for v in d2v if v is not None), None)
+        if isinstance(sample, int) and not isinstance(sample, bool):
+            try:
+                arr = np.asarray(d2v, dtype=np.int64)
+            except (TypeError, ValueError, OverflowError):
+                arr = np.asarray(d2v, dtype=object)
+        else:
+            arr = np.asarray(d2v, dtype=object)
         host._d2v_arr = arr
     return arr
+
+
+def _cap_keys_for_yields(yields) -> Optional[set]:
+    """Which capture arrays a yield list reads: {'src','dst','rank',
+    'eidx'} subset, or None (fetch everything) when a yield isn't fully
+    recognized.  Mirrors eval_yield_column_np's access pattern."""
+    if yields is None:
+        return None
+    need = set()
+    for e, _ in yields:
+        for x in E.walk(e):
+            k = x.kind
+            # exactly the kinds the fusion gate (exprjit.yieldable)
+            # admits — anything else means this walker is stale vs the
+            # eval surface, so fetch everything
+            if k in ("literal", "function", "edge_prop", "edge"):
+                if k == "function":
+                    name = getattr(x, "name", "")
+                    if name == "src":
+                        need.add("src")
+                    elif name == "dst":
+                        need.add("dst")
+                    elif name == "rank":
+                        need.add("rank")
+                    elif name in ("type", "typeid"):
+                        pass             # per-block constants
+                    else:
+                        return None      # unknown function: fetch all
+                elif k == "edge_prop":
+                    if x.name == "_rank":
+                        need.add("rank")
+                    elif x.name == "_src":
+                        need.add("src")
+                    elif x.name == "_dst":
+                        need.add("dst")
+                    elif x.name == "_type":
+                        pass             # per-block constant
+                    else:
+                        need.add("eidx")
+            else:
+                return None              # unmodeled expr: fetch all
+    return need
+
+
+def _cat_parts(parts, dtype=None):
+    """Concatenate per-part kept-prefix slices of a capture array (the
+    device compacts kept entries to the front of each part row) —
+    contiguous slices instead of a 2D fancy gather, preserving
+    (part, slot) order.  Always returns an owned array: a view of the
+    K-padded capture buffer must not escape into long-lived results
+    (it would pin the whole bucket for a handful of rows)."""
+    if dtype is not None:
+        if len(parts) > 1:
+            return np.concatenate(parts, dtype=dtype)   # one pass
+        return parts[0].astype(dtype)
+    if len(parts) > 1:
+        return np.concatenate(parts)
+    return parts[0].copy()
+
+
+def _cat_prefix(arr, bi, pids, kc, dtype=None):
+    return _cat_parts([arr[p, bi, :kc[p]] for p in pids], dtype)
 
 
 class TraverseStats:
@@ -324,7 +400,8 @@ class TpuRuntime:
     def _escalate(self, dev: DeviceSnapshot, dense: Sequence[int],
                   key_fn, build_fn, inputs_fn, stats: "TraverseStats",
                   n_hops: int = 1, uniform: bool = False,
-                  min_eb: Optional[int] = None):
+                  min_eb: Optional[int] = None,
+                  fetch_keys: Optional[set] = None):
         """Shared power-of-two bucket escalation driver for all device
         programs (traverse, bfs): seed bitmap layout, jit cache, one
         batched fetch, overflow-driven retry (SURVEY §7 hard-part #1).
@@ -450,7 +527,8 @@ class TpuRuntime:
                     K = min(max(EBs), _pow2(max(kmax, 1)))
                     res["cap"] = {k: np.asarray(
                         jax.device_get(v[..., :K]))
-                        for k, v in cap_dev.items()}
+                        for k, v in cap_dev.items()
+                        if fetch_keys is None or k in fetch_keys}
                     res["cap"]["kcount"] = kc
                     stats.fetch_s += time.perf_counter() - tf
                 from ..utils.stats import stats as _metrics
@@ -509,6 +587,16 @@ class TpuRuntime:
                        if n != "_rank"}}
             for bk in block_keys)
 
+        # fetch only the capture arrays the yields actually read (each
+        # is a kept-sized int32 column — src+rank are ~half the result
+        # transfer on a dst+prop GO, the common shape)
+        fetch_keys = _cap_keys_for_yields(yields) if capture else None
+        if fetch_keys is not None and fetch_keys & {"src", "dst"} \
+                and any(d == "in" for _, d in block_keys):
+            # reverse blocks serve src(edge) from the dst array and vice
+            # versa (physical-edge orientation) — need both
+            fetch_keys |= {"src", "dst"}
+
         def build(ebs):
             if self.local_mode:
                 return build_traverse_fn_local(
@@ -525,7 +613,7 @@ class TpuRuntime:
                                 tuple(pred_cols)),
             build_fn=build,
             inputs_fn=lambda ebs: (blocks_data,),
-            stats=stats, n_hops=steps)
+            stats=stats, n_hops=steps, fetch_keys=fetch_keys)
         if not capture:
             stats.total_s = time.perf_counter() - t_start
             return [], stats
@@ -637,9 +725,6 @@ class TpuRuntime:
         d2v_arr = _d2v(host)
         etype_ids = {et: store.catalog.get_edge(space, et).edge_type
                      for et, _ in block_keys}
-        K = cap["src"].shape[-1]
-        slot = np.arange(K, dtype=np.int32)
-
         def make_decode(et, dirn, sgn):
             hb = host.blocks[(et, dirn)]
 
@@ -674,17 +759,21 @@ class TpuRuntime:
             pos = 0
             for bi, (et, dirn) in enumerate(block_keys):
                 kc = cap["kcount"][:, h, bi]        # (P,)
-                # nonzero is row-major: part order, then slot order — the
-                # device compaction is stable, so per (part, src) the
-                # kept slots stay contiguous ascending eidx and the
-                # concat order below is already (src-stable) CSR order
-                sel_p, sel_j = np.nonzero(slot[None, :] < kc[:, None])
-                if sel_p.size == 0:
+                # kept entries are a device-compacted prefix per part
+                # row: per-part slice concat preserves the (part, slot)
+                # order nonzero gave — per (part, src) the kept slots
+                # stay contiguous ascending eidx, so the concat below is
+                # already (src-stable) CSR order
+                pids = [p for p in range(kc.shape[0]) if kc[p] > 0]
+                if not pids:
                     continue
-                ss = cap["src"][sel_p, h, bi, sel_j].astype(np.int64)
-                dd = cap["dst"][sel_p, h, bi, sel_j].astype(np.int64)
-                rr = cap["rank"][sel_p, h, bi, sel_j].astype(np.int64)
-                ee = cap["eidx"][sel_p, h, bi, sel_j]
+                ss = _cat_prefix(cap["src"][:, h], bi, pids, kc, np.int64)
+                dd = _cat_prefix(cap["dst"][:, h], bi, pids, kc, np.int64)
+                rr = _cat_prefix(cap["rank"][:, h], bi, pids, kc,
+                                 np.int64)
+                ee = _cat_prefix(cap["eidx"][:, h], bi, pids, kc)
+                sel_p = np.repeat(np.asarray(pids, np.int64),
+                                  [int(kc[p]) for p in pids])
                 eid = etype_ids[et]
                 sgn = eid if dirn == "out" else -eid
                 srcs.append(ss)
@@ -798,29 +887,42 @@ class TpuRuntime:
         etype_ids = {et: store.catalog.get_edge(space, et).edge_type
                      for et, _ in block_keys}
         kcount = cap["kcount"]              # (P, nb); arrays (P, nb, K)
-        K = cap["src"].shape[-1]
-        slot = np.arange(K, dtype=np.int32)
+        P = kcount.shape[0]
         for bi, (et, dirn) in enumerate(block_keys):
             hb = host.blocks[(et, dirn)]
-            # kept entries are a device-compacted prefix per part row
-            sel_p, sel_j = np.nonzero(slot[None, :]
-                                      < kcount[:, bi][:, None])
-            if sel_p.size == 0:
+            # kept entries are a device-compacted PREFIX per part row —
+            # selection is contiguous slices, not a 2D fancy gather
+            # (nonzero + fancy indexing cost ~60% of materialization at
+            # north-star scale)
+            kc = kcount[:, bi]
+            pids = [p for p in range(P) if kc[p] > 0]
+            if not pids:
                 continue
-            ss = cap["src"][sel_p, bi, sel_j].astype(np.int64)
-            dd = cap["dst"][sel_p, bi, sel_j].astype(np.int64)
-            rr = cap["rank"][sel_p, bi, sel_j]
-            ee = cap["eidx"][sel_p, bi, sel_j]
+            n_rows = int(sum(int(kc[p]) for p in pids))
+            # arrays the caller's yields never read were not fetched
+            # (fetch_keys) — and are not decoded here either
+            ss = (_cat_prefix(cap["src"], bi, pids, kc, np.int64)
+                  if "src" in cap else None)
+            dd = (_cat_prefix(cap["dst"], bi, pids, kc, np.int64)
+                  if "dst" in cap else None)
+            rr = (_cat_prefix(cap["rank"], bi, pids, kc)
+                  if "rank" in cap else None)
             props = {}
-            dec = decode_prop_column_np if as_np else decode_prop_column
-            for n in (hb.props if prop_names is None else
-                      [x for x in prop_names if x in hb.props]):
-                props[n] = dec(
-                    hb.prop_types[n], hb.props[n][sel_p, ee], host.pool)
+            if "eidx" in cap:
+                ee_parts = [cap["eidx"][p, bi, :kc[p]] for p in pids]
+                dec = decode_prop_column_np if as_np \
+                    else decode_prop_column
+                for n in (hb.props if prop_names is None else
+                          [x for x in prop_names if x in hb.props]):
+                    col = hb.props[n]
+                    raw = [col[p][e] for p, e in zip(pids, ee_parts)]
+                    raw = np.concatenate(raw) if len(raw) > 1 else raw[0]
+                    props[n] = dec(hb.prop_types[n], raw, host.pool)
             eid = etype_ids[et]
             yield {"et": et, "dirn": dirn, "etype": eid if dirn == "out"
-                   else -eid, "n": sel_p.size,
-                   "sv": d2v_arr[ss], "dv": d2v_arr[dd],
+                   else -eid, "n": n_rows,
+                   "sv": d2v_arr[ss] if ss is not None else None,
+                   "dv": d2v_arr[dd] if dd is not None else None,
                    "rr": rr, "props": props,
                    "prop_types": hb.prop_types}
 
