@@ -63,6 +63,7 @@ class GraphService:
     def _drop_session(self, sid: int):
         with self.lock:
             self.sessions.pop(sid, None)
+        self.engine.sessions.pop(sid, None)
         try:
             self.meta.remove_session(sid)
         except Exception:  # noqa: BLE001 — metad may be down; reap anyway
@@ -109,6 +110,10 @@ class GraphService:
         sess.id = sid
         with self.lock:
             self.sessions[sid] = sess
+        # the engine's registry serves SHOW QUERIES / KILL QUERY — a
+        # cluster session must be visible there too (same object, metad
+        # session id)
+        self.engine.sessions[sid] = sess
         return {"session_id": sid}
 
     def rpc_signout(self, p):

@@ -22,6 +22,7 @@ from .context import ExecutionContext, QueryContext, ResultSet
 from .scheduler import ProfileStats, Scheduler
 
 _session_ids = itertools.count(1)
+_query_ids = itertools.count(1)
 
 
 class Session:
@@ -33,7 +34,8 @@ class Session:
         self.var_cols: Dict[str, list] = {}
         self.created = time.time()
         self.last_used = self.created
-        self.queries: Dict[int, str] = {}
+        self.queries: Dict[int, str] = {}    # qid → text (RUNNING)
+        self.running_kill: Dict[int, Any] = {}   # qid → kill Event
         self.killed = False
 
 
@@ -190,10 +192,21 @@ class QueryEngine:
         stmt_ectx = ExecutionContext()
         stmt_ectx.results.update({k: v for k, v in session.ectx.results.items()
                                   if k.startswith("$")})
+        # register as a running query: SHOW QUERIES lists it, KILL QUERY
+        # (session=sid, plan=qid) sets its kill event — the scheduler
+        # checks it between plan nodes
+        import threading as _threading
+        qid = next(_query_ids)
+        stmt_ectx.kill_event = _threading.Event()
+        session.queries[qid] = text
+        session.running_kill[qid] = stmt_ectx.kill_event
         try:
             data = self.scheduler.run(plan, stmt_ectx, profile_stats)
         except Exception as ex:  # noqa: BLE001 — runtime errors go to client
             return ResultSet(error=f"ExecutionError: {ex}", space=plan.space)
+        finally:
+            session.queries.pop(qid, None)
+            session.running_kill.pop(qid, None)
         session.ectx.results.update({k: v for k, v in stmt_ectx.results.items()
                                      if k.startswith("$")})
 

@@ -1521,7 +1521,7 @@ def _show(node, qctx, ectx, space):
                  for s in cluster.list_sessions()])
         eng = getattr(qctx, "engine", None)
         rows = [[s.id, s.user, s.space, "in-process"]
-                for s in (eng.sessions.values() if eng else ())]
+                for s in (list(eng.sessions.values()) if eng else ())]
         return DataSet(["SessionId", "UserName", "SpaceName", "GraphAddr"],
                        sorted(rows))
     if kind == "snapshots":
@@ -1531,10 +1531,11 @@ def _show(node, qctx, ectx, space):
         eng = getattr(qctx, "engine", None)
         rows = []
         if eng is not None:
-            for s in eng.sessions.values():
-                for qid, qtext in s.queries.items():
-                    rows.append([s.id, qtext, "RUNNING"])
-        return DataSet(["SessionId", "Query", "Status"], rows)
+            for s in list(eng.sessions.values()):
+                for qid, qtext in list(s.queries.items()):
+                    rows.append([s.id, qid, s.user, qtext, "RUNNING"])
+        return DataSet(["SessionId", "ExecutionPlanId", "User", "Query",
+                        "Status"], rows)
     if kind == "configs":
         return DataSet(["Module", "Name", "Type", "Mode", "Value"],
                        _config_rows(qctx))
@@ -1835,6 +1836,24 @@ def _drop_snapshot(node, qctx, ectx, space):
 
 @executor("KillQuery")
 def _kill_query(node, qctx, ectx, space):
+    """KILL QUERY (session=sid, plan=qid): set the running query's kill
+    event — its scheduler aborts before the next plan node."""
+    eng = getattr(qctx, "engine", None)
+    sid = node.args.get("session_id")
+    qid = node.args.get("plan_id")
+    if eng is None:
+        return DataSet()
+    targets = [s for s in list(eng.sessions.values())
+               if sid is None or s.id == sid]
+    hit = False
+    for s in targets:
+        for q, ev in list(s.running_kill.items()):
+            if qid is None or q == qid:
+                ev.set()
+                hit = True
+    if not hit and (sid is not None or qid is not None):
+        raise ExecError(f"no running query matches "
+                        f"(session={sid}, plan={qid})")
     return DataSet()
 
 

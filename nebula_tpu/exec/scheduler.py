@@ -73,6 +73,10 @@ class Scheduler:
         topo(plan.root)
 
         def exec_one(node: PlanNode):
+            kill = getattr(ectx, "kill_event", None)
+            if kill is not None and kill.is_set():
+                from .executors import ExecError
+                raise ExecError("query was killed")
             t0 = time.perf_counter()
             if profile is not None:
                 self.qctx.last_tpu_stats = None

@@ -25,7 +25,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from .hop import _expand_block, _mark
+from .hop import _exchange_marks, _expand_block, _mark
 
 
 def build_bfs_fn(mesh, P: int, EB: int, max_steps: int,
@@ -65,8 +65,7 @@ def build_bfs_fn(mesh, P: int, EB: int, max_steps: int,
                     keep = ve
                 marks = _mark(dst, keep, P, vmax, marks)
             hop_edges.append(edges)
-            recv = jax.lax.all_to_all(marks, "part", 0, 0, tiled=False)
-            cand = recv.reshape(P, vmax).any(axis=0)
+            cand = _exchange_marks(marks, P, vmax)
             new = cand & (dist < 0)
             dist = jnp.where(new, level, dist)
             fbm = new

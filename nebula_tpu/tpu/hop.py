@@ -110,6 +110,41 @@ def _mark(dst, keep, P: int, vmax: int, acc=None):
     return m.at[owner, loc].max(keep)
 
 
+def _pack_bits(m):
+    """(P, vmax) bool → (P, W) uint32 words (W = ceil(vmax/32)): the
+    mark matrix is bit-packed BEFORE the inter-chip exchange, cutting
+    the all_to_all payload 8× vs bool (at SF300 scale: ~35 MB/chip/hop
+    instead of ~280 MB).  Packing is a shift-weighted sum over disjoint
+    bits (sum of distinct powers of two == OR — no overflow)."""
+    P, vmax = m.shape
+    W = -(-vmax // 32)
+    pad = W * 32 - vmax
+    mb = jnp.pad(m, ((0, 0), (0, pad)))
+    bits = mb.reshape(P, W, 32).astype(jnp.uint32)
+    weights = jnp.left_shift(jnp.uint32(1),
+                             jnp.arange(32, dtype=jnp.uint32))
+    return jnp.sum(bits * weights, axis=-1, dtype=jnp.uint32)
+
+
+def _unpack_or(recv, vmax: int):
+    """(P, W) received words → (vmax,) bool: OR the P rows on PACKED
+    words, then unpack once."""
+    ored = recv[0]
+    for i in range(1, recv.shape[0]):
+        ored = ored | recv[i]
+    bits = (ored[:, None] >> jnp.arange(32, dtype=jnp.uint32)) & 1
+    return bits.reshape(-1)[:vmax].astype(bool)
+
+
+def _exchange_marks(marks, P: int, vmax: int):
+    """The per-hop frontier exchange: row d of `marks` is part d's
+    candidate bitmap; ship it there (ONE all_to_all over ICI, packed)
+    and OR what this part received."""
+    packed = _pack_bits(marks)
+    recv = jax.lax.all_to_all(packed, "part", 0, 0, tiled=False)
+    return _unpack_or(recv.reshape(P, -1), vmax)
+
+
 def _compact_cap(src, dst, rk, eidx, keep, EB: int):
     """Stable-partition the kept edge slots to the FRONT of each capture
     row (cumsum scatter, O(EB)) and return the kept count.
@@ -237,10 +272,7 @@ def build_traverse_fn(mesh, P: int, EB, steps: int,
                 # the post-final frontier is not needed for GO; report empty
                 fbm = jnp.zeros((vmax,), bool)
             else:
-                # ONE bool exchange: row d of marks goes to part d, which
-                # ORs the P received rows into its next frontier bitmap
-                recv = jax.lax.all_to_all(marks, "part", 0, 0, tiled=False)
-                fbm = recv.reshape(P, vmax).any(axis=0)
+                fbm = _exchange_marks(marks, P, vmax)
 
         res = {
             "frontier": fbm[None],

@@ -172,6 +172,13 @@ Feature: Cluster and operational admin statements
       """
     Then the result should not be empty
 
+  Scenario: show queries lists the statement itself
+    When executing query:
+      """
+      SHOW QUERIES
+      """
+    Then the result should contain "SHOW QUERIES"
+
   Scenario: show hosts with a role filter answers in standalone too
     When executing query:
       """

@@ -1,4 +1,6 @@
 """End-to-end query engine tests — the minimum E2E slice and beyond."""
+import time
+
 import pytest
 
 from nebula_tpu.core import NULL, Path, Vertex, is_null
@@ -418,3 +420,74 @@ def test_get_configs_includes_session_params():
         sorted(map(repr, get.data.rows))
     one = eng.execute(s, "GET CONFIGS my_session_knob")
     assert one.error is None and one.data.rows[0][0] == "session"
+
+
+def test_kill_query_aborts_running_statement():
+    """KILL QUERY (session=sid, plan=qid) from another session sets the
+    running query's kill event; its scheduler aborts between nodes."""
+    import threading
+    from nebula_tpu.exec.executors import EXECUTORS, executor
+    from nebula_tpu.core.value import DataSet as _DS
+
+    eng = QueryEngine()
+    victim = eng.new_session()
+    killer = eng.new_session()
+    started = threading.Event()
+
+    @executor("_StallTest")
+    def _stall(node, qctx, ectx, space):
+        started.set()
+        time.sleep(0.8)
+        return _DS(["x"], [[1]])
+
+    # a plan with a stalling node followed by another node: the kill
+    # lands during the stall, the second node never runs
+    from nebula_tpu.query.plan import ExecutionPlan, PlanNode
+    from nebula_tpu.exec.context import ExecutionContext
+
+    out = {}
+
+    def run_victim():
+        a = PlanNode("_StallTest", deps=[], col_names=["x"])
+        b = PlanNode("_StallTest", deps=[a], col_names=["x"])
+        plan = ExecutionPlan(b, None)
+        # drive through the engine internals the way execute() does
+        stmt_ectx = ExecutionContext()
+        import nebula_tpu.exec.engine as em
+        qid = next(em._query_ids)
+        stmt_ectx.kill_event = threading.Event()
+        victim.queries[qid] = "stall"
+        victim.running_kill[qid] = stmt_ectx.kill_event
+        out["qid"] = qid
+        try:
+            eng.scheduler.run(plan, stmt_ectx)
+            out["err"] = None
+        except Exception as ex:  # noqa: BLE001
+            out["err"] = str(ex)
+        finally:
+            victim.queries.pop(qid, None)
+            victim.running_kill.pop(qid, None)
+
+    try:
+        t = threading.Thread(target=run_victim)
+        t.start()
+        assert started.wait(5)
+        assert "qid" in out, out       # registration precedes the stall
+        rs = eng.execute(killer, "SHOW QUERIES")
+        assert rs.error is None
+        assert any(r[0] == victim.id and r[3] == "stall"
+                   for r in rs.data.rows), \
+            (rs.data.rows, victim.id, dict(victim.queries),
+             list(eng.sessions), out)
+        rs = eng.execute(
+            killer,
+            f"KILL QUERY (session={victim.id}, plan={out['qid']})")
+        assert rs.error is None, rs.error
+        t.join(timeout=5)
+        assert out["err"] is not None and "killed" in out["err"]
+    finally:
+        EXECUTORS.pop("_StallTest", None)
+
+    # killing a nonexistent query errors
+    rs = eng.execute(killer, "KILL QUERY (session=999999, plan=1)")
+    assert rs.error is not None
