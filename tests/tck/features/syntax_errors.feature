@@ -1,0 +1,150 @@
+Feature: Syntax error conformance
+
+  Background:
+    Given having executed:
+      """
+      CREATE SPACE se(partition_num=2, vid_type=INT64);
+      USE se;
+      CREATE TAG person(age int);
+      CREATE EDGE knows(w int)
+      """
+
+  Scenario: unknown leading keyword
+    When executing query:
+      """
+      WALK FROM 1 OVER knows
+      """
+    Then a SyntaxError should be raised
+
+  Scenario: go without a source
+    When executing query:
+      """
+      GO OVER knows YIELD dst(edge)
+      """
+    Then a SyntaxError should be raised
+
+  Scenario: go with a dangling where
+    When executing query:
+      """
+      GO FROM 1 OVER knows WHERE YIELD dst(edge)
+      """
+    Then a SyntaxError should be raised
+
+  Scenario: unterminated string literal
+    When executing query:
+      """
+      YIELD "abc
+      """
+    Then a SyntaxError should be raised
+
+  Scenario: unbalanced parentheses in expression
+    When executing query:
+      """
+      YIELD (1 + 2 AS x
+      """
+    Then a SyntaxError should be raised
+
+  Scenario: insert missing values keyword
+    When executing query:
+      """
+      INSERT VERTEX person(age) 1:(5)
+      """
+    Then a SyntaxError should be raised
+
+  Scenario: insert edge missing arrow
+    When executing query:
+      """
+      INSERT EDGE knows(w) VALUES 1 2:(5)
+      """
+    Then a SyntaxError should be raised
+
+  Scenario: create tag with unclosed property list
+    When executing query:
+      """
+      CREATE TAG broken(a int
+      """
+    Then a SyntaxError should be raised
+
+  Scenario: match missing return
+    When executing query:
+      """
+      MATCH (a:person)
+      """
+    Then a SyntaxError should be raised
+
+  Scenario: fetch without prop keyword
+    When executing query:
+      """
+      FETCH person 1 YIELD vertex AS v
+      """
+    Then a SyntaxError should be raised
+
+  Scenario: lookup missing on
+    When executing query:
+      """
+      LOOKUP person YIELD id(vertex)
+      """
+    Then a SyntaxError should be raised
+
+  Scenario: order by without a pipe input
+    When executing query:
+      """
+      ORDER BY
+      """
+    Then a SyntaxError should be raised
+
+  Scenario: show with an unknown target
+    When executing query:
+      """
+      SHOW GIZMOS
+      """
+    Then a SyntaxError should be raised
+
+  Scenario: drop with an unknown target
+    When executing query:
+      """
+      DROP GIZMO g
+      """
+    Then a SyntaxError should be raised
+
+  Scenario: find path without endpoints
+    When executing query:
+      """
+      FIND SHORTEST PATH OVER knows
+      """
+    Then a SyntaxError should be raised
+
+  Scenario: trailing operator in expression
+    When executing query:
+      """
+      YIELD 1 +
+      """
+    Then a SyntaxError should be raised
+
+  Scenario: double pipe with empty stage
+    When executing query:
+      """
+      YIELD 1 AS x | | YIELD $-.x
+      """
+    Then a SyntaxError should be raised
+
+  Scenario: yield without columns
+    When executing query:
+      """
+      YIELD
+      """
+    Then a SyntaxError should be raised
+
+  Scenario: kill query without parentheses
+    When executing query:
+      """
+      KILL QUERY session=1
+      """
+    Then a SyntaxError should be raised
+
+  Scenario: use without a space name
+    When executing query:
+      """
+      USE
+      """
+    Then a SyntaxError should be raised
