@@ -183,14 +183,22 @@ def build_traverse_fn(mesh, P: int, EB, steps: int,
                       pred: Optional[Callable[[Dict[str, Any]], Any]] = None,
                       pred_cols: Sequence[str] = (),
                       capture: bool = True,
-                      capture_hops: bool = False):
+                      capture_hops: bool = False,
+                      yield_cols: Sequence[str] = ()):
     """Compile the N-step traversal program for one bucket configuration.
     EB: per-block edge budget — an int (uniform) or a per-hop sequence.
 
+    yield_cols: edge-prop names the caller's YIELD list reads — their
+    values are gathered ON DEVICE from the pinned prop columns at the
+    compacted final-hop slots and captured as `prop:<name>` arrays, so
+    the host fetches exactly the result columns instead of eidx + a
+    host-side gather (GO capture mode only; x64 is enabled, so device
+    gathers are bit-exact with the host decode).
+
     blocks_data (runtime arg): tuple of n_blocks dicts with keys
       indptr (P, vmax+1), nbr (P, E), rank (P, E), props {name: (P, E)}
-    where props holds ONLY the columns the predicate needs (property
-    decode for result rows happens on host via captured eidx).
+    where props holds the columns the predicate needs PLUS yield_cols
+    (any other result prop decodes on host via the captured eidx).
 
     Returns jitted fn(blocks_data, frontier) -> dict with:
       frontier (P, vmax) bool, fcount (P,): next frontier after the LAST
@@ -198,8 +206,9 @@ def build_traverse_fn(mesh, P: int, EB, steps: int,
       hop_edges (P, steps): pre-filter expansion size per hop per part
       ovf_expand (P,) bool: some hop's expansion exceeded EB
       cap (if capture): dict of (P, n_blocks, EB) arrays
-        src, dst, rank, eidx — the final hop's edge set (kept entries
-        compacted to a prefix; kcount (P, n_blocks) gives the counts)
+        src, dst, rank, eidx, prop:<name> per yield_col — the final
+        hop's edge set (kept entries compacted to a prefix;
+        kcount (P, n_blocks) gives the counts)
 
     capture_hops=True is the MATCH mode (SURVEY §2 row 23 Traverse):
     the predicate is applied at EVERY hop (a MATCH edge pattern's filter
@@ -250,6 +259,10 @@ def build_traverse_fn(mesh, P: int, EB, steps: int,
                     caps["rank"].append(cr)
                     caps["eidx"].append(ce)
                     caps["kcount"].append(kc)
+                    if last and not capture_hops:
+                        for name in yield_cols:
+                            caps.setdefault("prop:" + name, []).append(
+                                b["props"][name][0][ce])
                 if not last:
                     marks = _mark(dst, keep, P, vmax, marks)
             hop_edges.append(edges_this_hop)
@@ -258,16 +271,17 @@ def build_traverse_fn(mesh, P: int, EB, steps: int,
 
             if last:
                 if capture:
-                    arr_keys = ("src", "dst", "rank", "eidx")
                     if capture_hops:
+                        arr_keys = ("src", "dst", "rank", "eidx")
                         cap_out = {k: jnp.stack([hc[k] for hc in hop_caps]
                                                 )[None]
                                    for k in arr_keys}
                         kcount_out = jnp.stack(
                             [hc["kcount"] for hc in hop_caps])[None]
                     else:
-                        cap_out = {k: hop_caps[-1][k][None]
-                                   for k in arr_keys}
+                        cap_out = {k: v[None]
+                                   for k, v in hop_caps[-1].items()
+                                   if k != "kcount"}
                         kcount_out = hop_caps[-1]["kcount"][None]
                 # the post-final frontier is not needed for GO; report empty
                 fbm = jnp.zeros((vmax,), bool)
@@ -297,7 +311,8 @@ def build_traverse_fn_local(P: int, EB, steps: int,
                             pred: Optional[Callable[[Dict[str, Any]], Any]] = None,
                             pred_cols: Sequence[str] = (),
                             capture: bool = True,
-                            capture_hops: bool = False):
+                            capture_hops: bool = False,
+                            yield_cols: Sequence[str] = ()):
     """Single-chip variant: all P partitions resident on one device, the
     per-part kernel vmapped over the part axis, and the frontier exchange
     an OR-reduce over the mark matrices (the degenerate all_to_all).
@@ -357,6 +372,11 @@ def build_traverse_fn_local(P: int, EB, steps: int,
                     caps["rank"].append(cr)
                     caps["eidx"].append(ce)
                     caps["kcount"].append(kc)
+                    if last and not capture_hops:
+                        for name in yield_cols:
+                            caps.setdefault("prop:" + name, []).append(
+                                jax.vmap(lambda c, e: c[e])(
+                                    b["props"][name], ce))
                 if not last:
                     blk_marks = jax.vmap(
                         lambda d, k: _mark(d, k, P, vmax))(dst, keep)
@@ -370,8 +390,8 @@ def build_traverse_fn_local(P: int, EB, steps: int,
 
             if last:
                 if capture:
-                    arr_keys = ("src", "dst", "rank", "eidx")
                     if capture_hops:
+                        arr_keys = ("src", "dst", "rank", "eidx")
                         # (P, steps, nb, EB); kcount (P, steps, nb)
                         cap_out = {k: jnp.stack([hc[k] for hc in hop_caps],
                                                 axis=1)
@@ -379,7 +399,8 @@ def build_traverse_fn_local(P: int, EB, steps: int,
                         kcount_out = jnp.stack(
                             [hc["kcount"] for hc in hop_caps], axis=1)
                     else:
-                        cap_out = {k: hop_caps[-1][k] for k in arr_keys}
+                        cap_out = {k: v for k, v in hop_caps[-1].items()
+                                   if k != "kcount"}
                         kcount_out = hop_caps[-1]["kcount"]
                 fbm = jnp.zeros((P, vmax), bool)
             else:

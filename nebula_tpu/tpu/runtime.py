@@ -62,10 +62,11 @@ def _d2v(host) -> np.ndarray:
     return arr
 
 
-def _cap_keys_for_yields(yields) -> Optional[set]:
-    """Which capture arrays a yield list reads: {'src','dst','rank',
-    'eidx'} subset, or None (fetch everything) when a yield isn't fully
-    recognized.  Mirrors eval_yield_column_np's access pattern."""
+def _cap_keys_for_yields(yields, device_props=()) -> Optional[set]:
+    """Which capture arrays a yield list reads: a subset of {'src',
+    'dst','rank','eidx'} plus 'prop:<name>' for props the kernel
+    gathers on device, or None (fetch everything) when a yield isn't
+    fully recognized.  Mirrors eval_yield_column_np's access pattern."""
     if yields is None:
         return None
     need = set()
@@ -97,6 +98,8 @@ def _cap_keys_for_yields(yields) -> Optional[set]:
                         need.add("dst")
                     elif x.name == "_type":
                         pass             # per-block constant
+                    elif x.name in device_props:
+                        need.add("prop:" + x.name)
                     else:
                         need.add("eidx")
             else:
@@ -580,17 +583,38 @@ class TpuRuntime:
             return [], stats
 
         P = dev.num_parts
+        # edge props the yields read and EVERY block carries are
+        # gathered on device at the compacted final-hop slots (the
+        # fused-Project leg: the fetch then ships exactly the result
+        # columns); props missing from some block fall back to the
+        # host-side eidx gather
+        yield_cols: tuple = ()
+        if capture and yields is not None:
+            wanted = {x.name for e, _ in yields for x in E.walk(e)
+                      if x.kind == "edge_prop"
+                      and not x.name.startswith("_")}
+            yield_cols = tuple(sorted(
+                n for n in wanted
+                if all(n in dev.blocks[bk].props for bk in block_keys)))
+            # each device-gathered col is one more EB-padded capture
+            # buffer per block — cap the count so a wide YIELD can't
+            # double peak HBM on the escalation ladder; the rest decode
+            # on host via eidx as before
+            if len(yield_cols) > 4:
+                yield_cols = yield_cols[:4]
+        prop_names = {n for n in pred_cols if n != "_rank"}
+        prop_names |= set(yield_cols)
         blocks_data = tuple(
             {"indptr": dev.blocks[bk].indptr, "nbr": dev.blocks[bk].nbr,
              "rank": dev.blocks[bk].rank,
-             "props": {n: dev.blocks[bk].props[n] for n in pred_cols
-                       if n != "_rank"}}
+             "props": {n: dev.blocks[bk].props[n] for n in prop_names}}
             for bk in block_keys)
 
         # fetch only the capture arrays the yields actually read (each
-        # is a kept-sized int32 column — src+rank are ~half the result
+        # is a kept-sized column — src+rank+eidx are most of the result
         # transfer on a dst+prop GO, the common shape)
-        fetch_keys = _cap_keys_for_yields(yields) if capture else None
+        fetch_keys = (_cap_keys_for_yields(yields, yield_cols)
+                      if capture else None)
         if fetch_keys is not None and fetch_keys & {"src", "dst"} \
                 and any(d == "in" for _, d in block_keys):
             # reverse blocks serve src(edge) from the dst array and vice
@@ -601,16 +625,18 @@ class TpuRuntime:
             if self.local_mode:
                 return build_traverse_fn_local(
                     P, ebs, steps, len(block_keys), pred=pred,
-                    pred_cols=pred_cols, capture=capture)
+                    pred_cols=pred_cols, capture=capture,
+                    yield_cols=yield_cols)
             return build_traverse_fn(
                 self.mesh, P, ebs, steps, len(block_keys),
-                pred=pred, pred_cols=pred_cols, capture=capture)
+                pred=pred, pred_cols=pred_cols, capture=capture,
+                yield_cols=yield_cols)
 
         res = self._escalate(
             dev, dense,
             key_fn=lambda ebs: (space, dev.epoch, tuple(block_keys),
                                 steps, ebs, pred_key, capture,
-                                tuple(pred_cols)),
+                                tuple(pred_cols), yield_cols),
             build_fn=build,
             inputs_fn=lambda ebs: (blocks_data,),
             stats=stats, n_hops=steps, fetch_keys=fetch_keys)
@@ -908,16 +934,23 @@ class TpuRuntime:
             rr = (_cat_prefix(cap["rank"], bi, pids, kc)
                   if "rank" in cap else None)
             props = {}
-            if "eidx" in cap:
-                ee_parts = [cap["eidx"][p, bi, :kc[p]] for p in pids]
-                dec = decode_prop_column_np if as_np \
-                    else decode_prop_column
-                for n in (hb.props if prop_names is None else
-                          [x for x in prop_names if x in hb.props]):
+            dec = decode_prop_column_np if as_np else decode_prop_column
+            ee_parts = None
+            for n in (hb.props if prop_names is None else
+                      [x for x in prop_names if x in hb.props]):
+                if ("prop:" + n) in cap:
+                    # device-gathered yield column: fetched ready-made
+                    raw = _cat_prefix(cap["prop:" + n], bi, pids, kc)
+                elif "eidx" in cap:
+                    if ee_parts is None:
+                        ee_parts = [cap["eidx"][p, bi, :kc[p]]
+                                    for p in pids]
                     col = hb.props[n]
                     raw = [col[p][e] for p, e in zip(pids, ee_parts)]
                     raw = np.concatenate(raw) if len(raw) > 1 else raw[0]
-                    props[n] = dec(hb.prop_types[n], raw, host.pool)
+                else:
+                    continue
+                props[n] = dec(hb.prop_types[n], raw, host.pool)
             eid = etype_ids[et]
             yield {"et": et, "dirn": dirn, "etype": eid if dirn == "out"
                    else -eid, "n": n_rows,
