@@ -286,9 +286,10 @@ def main():
     from nebula_tpu.graphstore.store import GraphStore
     from nebula_tpu.tools import ldbc_import as ldbc
     csv_dir = tempfile.mkdtemp(prefix="nebula_bench_snb_")
-    ppath, kpath, n_pv, n_ke = write_snb_csvs(csv_dir, small_n, degree,
-                                              seed=7)
-    _mark(f"importing {n_pv} persons + {n_ke} knows via ldbc_import")
+    ppath, kpath, lpath, n_pv, n_ke, n_le = write_snb_csvs(
+        csv_dir, small_n, degree, seed=7)
+    _mark(f"importing {n_pv} persons + {n_ke} knows + {n_le} likes "
+          f"via ldbc_import")
     t0 = time.perf_counter()
     store = GraphStore()
     store.create_space("snb", partition_num=parts, vid_type="INT64")
@@ -298,10 +299,14 @@ def main():
     got_e = ldbc.import_edges(
         store, "snb", f"KNOWS:{kpath}:src,dst,w:int,f:float", "|",
         vid_is_int=True, header=True)
+    got_l = ldbc.import_edges(
+        store, "snb", f"LIKES:{lpath}:src,dst,w:int,f:float", "|",
+        vid_is_int=True, header=True)
     small_build_s = time.perf_counter() - t0
-    assert got_v == n_pv and got_e == n_ke, (got_v, n_pv, got_e, n_ke)
+    assert got_v == n_pv and got_e == n_ke and got_l == n_le, \
+        (got_v, n_pv, got_e, n_ke, got_l, n_le)
     import_info = {"csv_dir": csv_dir, "person_rows": got_v,
-                   "knows_rows": got_e,
+                   "knows_rows": got_e, "likes_rows": got_l,
                    "import_s": round(small_build_s, 2),
                    "native_lib": __import__(
                        "nebula_tpu.native", fromlist=["get_lib"]
@@ -351,6 +356,23 @@ def main():
         f"GO 3 STEPS FROM {seed_list} OVER KNOWS WHERE KNOWS.w > 50 "
         f"YIELD dst(edge) AS d, KNOWS.w AS w",
         seeds, rt, numpy_fn=np_cfg2, canon=canon_cfg2)
+
+    # config 2b (BASELINE row 2's OVER * shape): multi-edge-type
+    # expansion — two CSR blocks per hop on device (the per-edge-type
+    # block axis).  Unfiltered: the fused predicate mask is single-etype
+    # by design (per-block prop columns), so the filtered leg above
+    # keeps OVER KNOWS.
+    def np_cfg2b():
+        _, _, nxt, _w = host_csr_traverse(snap_small, dense_seeds, 3,
+                                          materialize=True,
+                                          etypes=("KNOWS", "LIKES"))
+        return (np.sort(d2v_small[nxt]),)
+
+    _mark("config 2b: engine e2e GO 3 STEPS OVER *")
+    configs["2b_go3_over_all"] = bench_engine_config(
+        "cfg2b", store,
+        f"GO 3 STEPS FROM {seed_list} OVER * YIELD dst(edge) AS d",
+        seeds, rt, numpy_fn=np_cfg2b, canon=canon_cfg1)
 
     # config 3 (BASELINE: IC5/IC9-shaped): fixed-length MATCH pattern +
     # aggregate — Traverse + Aggregate executor composition, device

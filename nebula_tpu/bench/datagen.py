@@ -72,10 +72,12 @@ def write_snb_csvs(outdir: str, n_persons: int, avg_degree: int,
 
     person.csv: id|age|name          (string column → csv.reader path)
     knows.csv:  src|dst|w|f          (all numeric → native csv_ingest)
+    likes.csv:  src|dst|w|f          (second edge type: OVER * configs)
 
     Same degree distribution as make_social_graph (uniform dsts with a
-    Zipf supernode tail, self-loops dropped).  Returns
-    (person_path, knows_path, n_person_rows, n_knows_rows)."""
+    Zipf supernode tail, self-loops dropped); LIKES carries ~20% of
+    KNOWS' volume.  Returns (person_path, knows_path, likes_path,
+    n_person_rows, n_knows_rows, n_likes_rows)."""
     import os
     rng = np.random.default_rng(seed)
     ages = rng.integers(13, 90, n_persons)
@@ -86,22 +88,27 @@ def write_snb_csvs(outdir: str, n_persons: int, avg_degree: int,
         f.writelines(f"{v}|{ages[v]}|{_NAMES[name_ix[v]]}\n"
                      for v in range(n_persons))
 
-    n_edges = n_persons * avg_degree
-    src = rng.integers(0, n_persons, n_edges)
-    dst = rng.integers(0, n_persons, n_edges)
-    hot = rng.random(n_edges) < 0.15
-    dst[hot] = (rng.zipf(1.6, int(hot.sum())) - 1) % n_persons
-    keep = src != dst
-    src, dst = src[keep], dst[keep]
-    w = rng.integers(0, 100, src.size)
-    fv = rng.random(src.size)
-    kpath = os.path.join(outdir, "knows.csv")
-    with open(kpath, "w") as f:
-        f.write("src|dst|w|f\n")
-        f.writelines(f"{s}|{d}|{ww}|{ff!r}\n"
-                     for s, d, ww, ff in zip(src.tolist(), dst.tolist(),
-                                             w.tolist(), fv.tolist()))
-    return ppath, kpath, n_persons, int(src.size)
+    def edge_file(name, n_edges):
+        src = rng.integers(0, n_persons, n_edges)
+        dst = rng.integers(0, n_persons, n_edges)
+        hot = rng.random(n_edges) < 0.15
+        dst[hot] = (rng.zipf(1.6, int(hot.sum())) - 1) % n_persons
+        keep = src != dst
+        src, dst = src[keep], dst[keep]
+        w = rng.integers(0, 100, src.size)
+        fv = rng.random(src.size)
+        path = os.path.join(outdir, name)
+        with open(path, "w") as f:
+            f.write("src|dst|w|f\n")
+            f.writelines(f"{s}|{d}|{ww}|{ff!r}\n"
+                         for s, d, ww, ff in zip(src.tolist(),
+                                                 dst.tolist(),
+                                                 w.tolist(), fv.tolist()))
+        return path, int(src.size)
+
+    kpath, nk = edge_file("knows.csv", n_persons * avg_degree)
+    lpath, nl = edge_file("likes.csv", max(n_persons * avg_degree // 5, 1))
+    return ppath, kpath, lpath, n_persons, nk, nl
 
 
 def pick_seeds(store: GraphStore, space: str, k: int,
@@ -219,37 +226,49 @@ def snapshot_from_arrays(arrs, parts: int = 8, space: str = "snb"):
 
 
 def host_csr_traverse(snap, seeds, steps: int, w_gt=None,
-                      materialize: bool = False):
+                      materialize: bool = False,
+                      etypes=("KNOWS",)):
     """Vectorized numpy host baseline over the same CSR: per hop, gather
     neighbor ranges with repeat, dedup with np.unique.  This is the
     strongest honest CPU single-core baseline available here (a C++
     row-at-a-time engine does strictly more work per edge).
 
-    Returns (edges_traversed, final_kept_edge_count) — and with
-    materialize=True, also (dst_vids, w) numpy arrays of the final-hop
-    result so the baseline pays the same output cost class the device
-    E2E path does (VERDICT r1 weak #2: no flattering asymmetries).
+    `etypes` expands through multiple out-blocks per hop (the OVER *
+    comparator).  Returns (edges_traversed, final_kept_edge_count) —
+    and with materialize=True, also (dst_vids, w) numpy arrays of the
+    final-hop result so the baseline pays the same output cost class
+    the device E2E path does (VERDICT r1 weak #2: no flattering
+    asymmetries).
     """
     P = snap.num_parts
-    blk = snap.block("KNOWS", "out")
+    blks = [snap.block(et, "out") for et in etypes]
     frontier = np.unique(np.asarray(seeds, np.int64))
     total = 0
     for hop in range(steps):
         owner = frontier % P
         local = frontier // P
-        s = blk.indptr[owner, local].astype(np.int64)
-        e = blk.indptr[owner, local + 1].astype(np.int64)
-        deg = e - s
-        total += int(deg.sum())
-        if deg.sum() == 0:
+        nxts, ws = [], []
+        for blk in blks:
+            s = blk.indptr[owner, local].astype(np.int64)
+            e = blk.indptr[owner, local + 1].astype(np.int64)
+            deg = e - s
+            total += int(deg.sum())
+            if deg.sum() == 0:
+                nxts.append(np.empty(0, np.int64))
+                ws.append(np.empty(0, blk.props["w"].dtype))
+                continue
+            rows = np.repeat(np.arange(frontier.size), deg)
+            offs = np.arange(deg.sum(), dtype=np.int64) - \
+                np.repeat(np.cumsum(deg) - deg, deg)
+            idx = s[rows] + offs
+            nxts.append(blk.nbr[owner[rows], idx].astype(np.int64))
+            if hop == steps - 1:
+                ws.append(blk.props["w"][owner[rows], idx])
+        nxt = np.concatenate(nxts) if len(nxts) > 1 else nxts[0]
+        if nxt.size == 0:
             return (total, 0, None, None) if materialize else (total, 0)
-        rows = np.repeat(np.arange(frontier.size), deg)
-        offs = np.arange(deg.sum(), dtype=np.int64) - \
-            np.repeat(np.cumsum(deg) - deg, deg)
-        idx = s[rows] + offs
-        nxt = blk.nbr[owner[rows], idx].astype(np.int64)
         if hop == steps - 1:
-            w = blk.props["w"][owner[rows], idx]
+            w = np.concatenate(ws) if len(ws) > 1 else ws[0]
             if w_gt is not None:
                 keep = w > w_gt
                 nxt, w = nxt[keep], w[keep]
