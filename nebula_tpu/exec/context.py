@@ -53,10 +53,65 @@ class QueryContext:
     def catalog(self):
         return self.store.catalog
 
+    def _space_has_ttl(self, space: str) -> bool:
+        """Cached per catalog version: does ANY tag in the space carry a
+        TTL (which makes vertices time-variant)?"""
+        memo = getattr(self, "_ttl_memo", None)
+        if memo is None:
+            memo = self._ttl_memo = {}
+        ver = getattr(self.catalog, "version", None)
+        hit = memo.get(space)
+        if hit is not None and hit[0] == ver:
+            return hit[1]
+        try:
+            has = any(t.latest.ttl_col and t.latest.ttl_duration > 0
+                      for t in self.catalog.tags(space))
+        except Exception:  # noqa: BLE001 — no such space yet
+            has = True      # unknown: be conservative, skip caching
+        memo[space] = (ver, has)
+        return has
+
     def build_vertex(self, space: str, vid: Any,
                      tags: Optional[List[str]] = None) -> Optional[Vertex]:
+        # epoch-keyed memo: a Vertex is immutable for a given space
+        # epoch (every write bumps it), and MATCH/GO pipelines rebuild
+        # the same vertices once per row — across rows AND statements
+        # the cache hit is exact, never stale
+        cache = key = None
+        from ..graphstore.store import GraphStore
+        # Local stores only: the cluster _SpaceView's epoch property is
+        # a part_stats RPC fan-out, far costlier than the build it
+        # would save (and its CatalogProxy makes the TTL probe remote).
+        if tags is None and isinstance(self.store, GraphStore):
+            # TTL rows go invisible by WALL CLOCK without an epoch bump —
+            # a TTL'd space must rebuild every time.
+            if not self._space_has_ttl(space):
+                try:
+                    ep = self.store.space(space).epoch
+                except Exception:  # noqa: BLE001 — space raced away
+                    ep = None
+                if ep is not None:
+                    cache = getattr(self, "_vx_cache", None)
+                    if cache is None:
+                        cache = self._vx_cache = {}
+                    # catalog.version covers DDL (ALTER/DROP TAG change
+                    # what fill_row produces without touching the epoch)
+                    key = (space, ep,
+                           getattr(self.catalog, "version", 0), vid)
+                    hit = cache.get(key)
+                    if hit is not None:
+                        return hit if hit is not False else None
+
+        def memo(val):
+            if cache is not None:
+                if len(cache) > 200_000:
+                    cache.clear()
+                cache[key] = val
+            return val
+
         tv = self.store.get_vertex(space, vid)
         if tv is None:
+            memo(False)
             return None
         out = []
         for t, props in sorted(tv.items()):
@@ -65,7 +120,7 @@ class QueryContext:
             out.append(Tag(t, props))
         if tags and not out:
             return None
-        return Vertex(vid, out)
+        return memo(Vertex(vid, out))
 
 
 class ExecutionContext:
