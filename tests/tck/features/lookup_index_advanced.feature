@@ -1,0 +1,145 @@
+Feature: Index scan boundaries and compound hints
+
+  Background:
+    Given having executed:
+      """
+      CREATE SPACE li(partition_num=4, vid_type=INT64);
+      USE li;
+      CREATE TAG person(city string, age int, score int);
+      CREATE TAG INDEX i_city_age ON person(city, age);
+      CREATE TAG INDEX i_score ON person(score);
+      INSERT VERTEX person(city, age, score) VALUES
+        1:("oslo", 20, 5), 2:("oslo", 30, 15), 3:("oslo", 40, 25),
+        4:("bergen", 30, 35), 5:("bergen", 50, 45), 6:("tromso", 30, 55)
+      """
+
+  Scenario: exclusive lower bound
+    When executing query:
+      """
+      LOOKUP ON person WHERE person.score > 25 YIELD id(vertex) AS v | ORDER BY $-.v
+      """
+    Then the result should be, in order:
+      | v |
+      | 4 |
+      | 5 |
+      | 6 |
+
+  Scenario: inclusive lower bound
+    When executing query:
+      """
+      LOOKUP ON person WHERE person.score >= 25 YIELD id(vertex) AS v | ORDER BY $-.v
+      """
+    Then the result should be, in order:
+      | v |
+      | 3 |
+      | 4 |
+      | 5 |
+      | 6 |
+
+  Scenario: two sided range
+    When executing query:
+      """
+      LOOKUP ON person WHERE person.score > 5 AND person.score < 45
+      YIELD id(vertex) AS v | ORDER BY $-.v
+      """
+    Then the result should be, in order:
+      | v |
+      | 2 |
+      | 3 |
+      | 4 |
+
+  Scenario: compound index equality prefix plus range
+    When executing query:
+      """
+      LOOKUP ON person WHERE person.city == "oslo" AND person.age > 20
+      YIELD id(vertex) AS v | ORDER BY $-.v
+      """
+    Then the result should be, in order:
+      | v |
+      | 2 |
+      | 3 |
+
+  Scenario: equality prefix alone uses the compound index
+    When executing query:
+      """
+      LOOKUP ON person WHERE person.city == "bergen"
+      YIELD id(vertex) AS v | ORDER BY $-.v
+      """
+    Then the result should be, in order:
+      | v |
+      | 4 |
+      | 5 |
+
+  Scenario: residual predicate filters index hits
+    When executing query:
+      """
+      LOOKUP ON person WHERE person.city == "oslo" AND person.score > 10
+      YIELD id(vertex) AS v | ORDER BY $-.v
+      """
+    Then the result should be, in order:
+      | v |
+      | 2 |
+      | 3 |
+
+  Scenario: explain shows the chosen compound index
+    When executing query:
+      """
+      EXPLAIN LOOKUP ON person WHERE person.city == "oslo" AND person.age > 20
+      YIELD id(vertex) AS v
+      """
+    Then the result should contain "i_city_age"
+
+  Scenario: yield indexed props without a filter
+    When executing query:
+      """
+      LOOKUP ON person YIELD id(vertex) AS v, person.age AS a | ORDER BY $-.v | LIMIT 2
+      """
+    Then the result should be, in order:
+      | v | a  |
+      | 1 | 20 |
+      | 2 | 30 |
+
+  Scenario: index backfills existing rows on rebuild
+    When executing query:
+      """
+      CREATE TAG late(x int);
+      INSERT VERTEX late(x) VALUES 7:(70), 8:(80);
+      CREATE TAG INDEX i_late ON late(x);
+      REBUILD TAG INDEX i_late;
+      LOOKUP ON late WHERE late.x >= 70 YIELD id(vertex) AS v | ORDER BY $-.v
+      """
+    Then the result should be, in order:
+      | v |
+      | 7 |
+      | 8 |
+
+  Scenario: a fresh index does not see pre-existing rows before rebuild
+    When executing query:
+      """
+      CREATE TAG cold(x int);
+      INSERT VERTEX cold(x) VALUES 9:(90);
+      CREATE TAG INDEX i_cold ON cold(x);
+      LOOKUP ON cold WHERE cold.x == 90 YIELD id(vertex) AS v
+      """
+    Then the result should be empty
+
+  Scenario: writes after index creation are visible without rebuild
+    When executing query:
+      """
+      CREATE TAG warm(x int);
+      CREATE TAG INDEX i_warm ON warm(x);
+      INSERT VERTEX warm(x) VALUES 10:(100);
+      LOOKUP ON warm WHERE warm.x == 100 YIELD id(vertex) AS v
+      """
+    Then the result should be, in order:
+      | v  |
+      | 10 |
+
+  Scenario: dropping the only index breaks lookup again
+    When executing query:
+      """
+      DROP TAG INDEX i_score;
+      DROP TAG INDEX i_city_age;
+      LOOKUP ON person WHERE person.score > 0 YIELD id(vertex)
+      """
+    Then a SemanticError should be raised

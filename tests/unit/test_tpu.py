@@ -722,3 +722,25 @@ def test_device_parity_fuzz(rt, seed):
             out.append(sorted(
                 [[repr(c) for c in row] for row in rs.data.rows]))
         assert out[0] == out[1], f"[seed {seed}] {q}"
+
+
+def test_pack_unpack_exchange_roundtrip():
+    """The bit-packed frontier exchange: pack → OR → unpack must equal
+    the bool OR for arbitrary mark matrices (incl. non-multiple-of-32
+    vmax, empty, and full rows)."""
+    import numpy as np
+    from nebula_tpu.tpu.hop import _pack_bits, _unpack_or
+
+    rng = np.random.default_rng(3)
+    for vmax in (1, 31, 32, 33, 100, 257):
+        for density in (0.0, 0.03, 0.5, 1.0):
+            m = rng.random((4, vmax)) < density
+            packed = _pack_bits(jnp_asarray(m))
+            got = np.asarray(_unpack_or(packed, vmax))
+            want = m.any(axis=0)
+            assert (got == want).all(), (vmax, density)
+
+
+def jnp_asarray(x):
+    import jax.numpy as jnp
+    return jnp.asarray(x)
