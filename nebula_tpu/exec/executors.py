@@ -623,6 +623,7 @@ def _traverse(node, qctx, ectx, space):
 
 @executor("AppendVertices")
 def _append_vertices(node, qctx, ectx, space):
+    from ..core.expr import walk as _walk
     a = node.args
     sp = a["space"]
     ds = _input(node, ectx)
@@ -630,6 +631,30 @@ def _append_vertices(node, qctx, ectx, space):
     ci = ds.col_index(col)
     labels = a.get("labels") or []
     filt = a.get("filter")
+    # a filter that reads ONLY the appended vertex has a constant
+    # verdict per vid — evaluate once per unique vertex, not per row
+    # (MATCH rows repeat terminal vertices heavily)
+    per_vertex = False
+    if filt is not None:
+        refs = set()
+        only_vertex_refs = True
+        for x in _walk(filt):
+            k = x.kind
+            if k == "label":
+                refs.add(x.name)
+            elif k == "label_tag_prop":
+                refs.add(x.var)
+            elif k in ("literal", "binary", "unary", "function", "list",
+                       "set", "map", "case", "subscript", "slice"):
+                pass                     # composition over the leaves
+            else:
+                # anything that can read OTHER row state ($-.col, $var,
+                # vertex/edge context, props of other aliases) — or a
+                # kind this classifier doesn't model — disables the
+                # per-vertex shortcut
+                only_vertex_refs = False
+        per_vertex = only_vertex_refs and refs <= {col}
+    verdicts: Dict[Any, bool] = {}
     rows = []
     cache: Dict[Any, Optional[Vertex]] = {}
     for r in ds.rows:
@@ -645,9 +670,18 @@ def _append_vertices(node, qctx, ectx, space):
         nr = list(r)
         nr[ci] = full
         if filt is not None:
-            rc = RowContext(qctx, sp, row_dict(ds, nr))
-            if to_bool3(filt.eval(rc)) is not True:
-                continue
+            if per_vertex:
+                vd = verdicts.get(vid)
+                if vd is None:
+                    rc = RowContext(qctx, sp, {col: full})
+                    vd = to_bool3(filt.eval(rc)) is True
+                    verdicts[vid] = vd
+                if not vd:
+                    continue
+            else:
+                rc = RowContext(qctx, sp, row_dict(ds, nr))
+                if to_bool3(filt.eval(rc)) is not True:
+                    continue
         rows.append(nr)
     return DataSet(list(ds.column_names), rows)
 

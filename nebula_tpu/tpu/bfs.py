@@ -25,10 +25,10 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from .hop import _exchange_marks, _expand_block, _mark
+from .hop import _exchange_marks, _expand_block, _mark, _norm_ebs
 
 
-def build_bfs_fn(mesh, P: int, EB: int, max_steps: int,
+def build_bfs_fn(mesh, P: int, EB, max_steps: int,
                  n_blocks: int, vmax: int, pred=None, pred_cols=()):
     """Sharded BFS program: (blocks_data, frontier) →
     {dist (P, vmax), ovf_expand, hop_edges (P, steps)}.
@@ -38,6 +38,8 @@ def build_bfs_fn(mesh, P: int, EB: int, max_steps: int,
     only traverses mask-passing edges, matching the host oracle's
     per-expansion filter."""
 
+    ebs = _norm_ebs(EB, max_steps, False)
+
     def kernel(blocks_data, frontier):
         fbm = frontier[0]                       # (vmax,) bool seeds
         pid = jax.lax.axis_index("part").astype(jnp.int32)
@@ -46,12 +48,13 @@ def build_bfs_fn(mesh, P: int, EB: int, max_steps: int,
         hop_edges = []
 
         for level in range(1, max_steps + 1):
+            EBl = ebs[level - 1]
             marks = None
             edges = jnp.zeros((), jnp.int32)
             for bi in range(n_blocks):
                 b = blocks_data[bi]
                 src, dst, rk, eidx, ve, total, ovf = _expand_block(
-                    b["indptr"][0], b["nbr"][0], b["rank"][0], fbm, EB, P,
+                    b["indptr"][0], b["nbr"][0], b["rank"][0], fbm, EBl, P,
                     pid)
                 ovf_e = ovf_e | ovf
                 edges = edges + total
@@ -81,14 +84,30 @@ def build_bfs_fn(mesh, P: int, EB: int, max_steps: int,
     return jax.jit(smapped)
 
 
-def build_bfs_fn_local(P: int, EB: int, max_steps: int,
-                       n_blocks: int, vmax: int, pred=None, pred_cols=()):
-    """Single-chip variant (vmap over parts, OR-reduce as all_to_all)."""
-    pids = jnp.arange(P, dtype=jnp.int32)
+def build_bfs_fn_local(P: int, EB, max_steps: int,
+                       n_blocks: int, vmax: int, pred=None, pred_cols=(),
+                       have_rev: bool = False, n_phantom: int = 0):
+    """Single-chip variant (vmap over parts, OR-reduce as all_to_all).
 
-    def one_part(block, fbm, pid):
+    With `have_rev` (blocks_data carries each block's REVERSE-direction
+    twin under "rev_*" keys) the kernel is DIRECTION-OPTIMIZING: on
+    dense levels it switches bottom-up — every still-unvisited vertex
+    scans its reverse-adjacency and joins the next frontier if any
+    in-neighbor's bit is set in the (single-chip-resident) frontier
+    bitmap.  Bottom-up needs NO routing exchange at all: each owner
+    decides its own vertices from the global bitmap, which is exactly
+    what the bitmap-frontier currency makes cheap.  Both branches share
+    the level body via lax.cond; the classic switch heuristic
+    (frontier edges vs unvisited edges, Beamer-style) degrades to a
+    frontier-population threshold since degrees are already summed by
+    the expansion itself."""
+    pids = jnp.arange(P, dtype=jnp.int32)
+    ebs = _norm_ebs(EB, max_steps, False)
+
+    def one_part(block, fbm, pid, EBl):
         src, dst, rk, eidx, ve, total, ovf = _expand_block(
-            block["indptr"], block["nbr"], block["rank"], fbm, EB, P, pid)
+            block["indptr"], block["nbr"], block["rank"], fbm, EBl, P,
+            pid)
         if pred is not None:
             cols = {"_rank": rk}
             for name in pred_cols:
@@ -97,7 +116,50 @@ def build_bfs_fn_local(P: int, EB: int, max_steps: int,
             keep = pred(cols) & ve
         else:
             keep = ve
-        return keep, dst, total, ovf
+        return src, dst, keep, total, ovf
+
+    def top_down(blocks_data, fbm, EBl):
+        marks = None
+        edges = jnp.zeros((P,), jnp.int32)
+        ovf = jnp.zeros((P,), bool)
+        for bi in range(n_blocks):
+            b = blocks_data[bi]
+            _s, dst, keep, total, ov = jax.vmap(
+                lambda ip, nb, rkk, prp, f, pd: one_part(
+                    {"indptr": ip, "nbr": nb, "rank": rkk,
+                     "props": prp}, f, pd, EBl)
+            )(b["indptr"], b["nbr"], b["rank"],
+              b.get("props", {}), fbm, pids)
+            ovf = ovf | ov
+            edges = edges + total
+            blk_marks = jax.vmap(
+                lambda d, k: _mark(d, k, P, vmax))(dst, keep)
+            marks = blk_marks if marks is None else marks | blk_marks
+        return marks.any(axis=0), edges, ovf
+
+    def bottom_up(blocks_data, fbm, unvis, EBl):
+        # expand the REVERSE adjacency of unvisited vertices; a vertex
+        # joins the frontier if any in-neighbor is currently in it
+        cand = jnp.zeros((P, vmax), bool)
+        edges = jnp.zeros((P,), jnp.int32)
+        ovf = jnp.zeros((P,), bool)
+        for bi in range(n_blocks):
+            b = blocks_data[bi]
+            src, nb, keep, total, ov = jax.vmap(
+                lambda ip, nbr, rkk, prp, f, pd: one_part(
+                    {"indptr": ip, "nbr": nbr, "rank": rkk,
+                     "props": prp}, f, pd, EBl)
+            )(b["rev_indptr"], b["rev_nbr"], b["rev_rank"],
+              b.get("rev_props", {}), unvis, pids)
+            ovf = ovf | ov
+            edges = edges + total
+            member = fbm[nb % P, nb // P] & keep       # (P, EB)
+            blk = jax.vmap(
+                lambda s, m: jnp.zeros((vmax,), bool).at[
+                    jnp.where(m, s // P, vmax)].max(m, mode="drop")
+            )(src, member)
+            cand = cand | blk
+        return cand, edges, ovf
 
     def fn(blocks_data, frontier):
         fbm = frontier                          # (P, vmax) bool seeds
@@ -106,23 +168,25 @@ def build_bfs_fn_local(P: int, EB: int, max_steps: int,
         hop_edges = []
 
         for level in range(1, max_steps + 1):
-            marks = None                        # (P_src, P_dst, vmax)
-            edges = jnp.zeros((P,), jnp.int32)
-            for bi in range(n_blocks):
-                b = blocks_data[bi]
-                keep, dst, total, ovf = jax.vmap(
-                    lambda ip, nb, rkk, prp, f, pd: one_part(
-                        {"indptr": ip, "nbr": nb, "rank": rkk,
-                         "props": prp}, f, pd)
-                )(b["indptr"], b["nbr"], b["rank"],
-                  b.get("props", {}), fbm, pids)
-                ovf_e = ovf_e | ovf
-                edges = edges + total
-                blk_marks = jax.vmap(
-                    lambda d, k: _mark(d, k, P, vmax))(dst, keep)
-                marks = blk_marks if marks is None else marks | blk_marks
+            EBl = ebs[level - 1]
+            if have_rev:
+                unvis = dist < 0
+                # dense-level switch: frontier holds a meaningful share
+                # of the unvisited set → scanning unvisited in-edges
+                # beats expanding frontier out-edges.  Padding slots of
+                # smaller partitions sit forever in `unvis`; subtract
+                # them so skewed layouts don't suppress the switch.
+                use_bu = fbm.sum() * 8 > unvis.sum() - n_phantom
+                cand, edges, ovf = jax.lax.cond(
+                    use_bu,
+                    lambda args: bottom_up(blocks_data, args[0], args[1],
+                                           EBl),
+                    lambda args: top_down(blocks_data, args[0], EBl),
+                    (fbm, unvis))
+            else:
+                cand, edges, ovf = top_down(blocks_data, fbm, EBl)
+            ovf_e = ovf_e | ovf
             hop_edges.append(edges)
-            cand = marks.any(axis=0)            # (P_dst, vmax)
             new = cand & (dist < 0)
             dist = jnp.where(new, level, dist)
             fbm = new

@@ -453,7 +453,11 @@ class TpuRuntime:
         frontier = jax.device_put(fr_np, target)
         stats.put_s = time.perf_counter() - tp
 
-        for attempt in range(self.max_retries):
+        # a post-overflow hop's reported count is a LOWER bound (its
+        # frontier was truncated), so in the worst case each attempt
+        # finalizes only one more hop's bucket — the retry budget must
+        # scale with the hop count
+        for attempt in range(max(self.max_retries, n_hops + 3)):
             stats.retries = attempt
             ebs = tuple(EBs)
             key = key_fn(ebs)
@@ -860,39 +864,71 @@ class TpuRuntime:
             return np.full((dev.num_parts, dev.vmax), -1, np.int32), stats
 
         P = dev.num_parts
-        blocks_data = tuple(
-            {"indptr": dev.blocks[bk].indptr, "nbr": dev.blocks[bk].nbr,
-             "rank": dev.blocks[bk].rank,
-             **({"props": {n: dev.blocks[bk].props[n] for n in pred_cols
-                           if n != "_rank"}} if pred is not None else {})}
-            for bk in block_keys)
+        # direction-optimizing leg (single chip): each block's REVERSE
+        # twin rides along so dense levels can go bottom-up (a vertex
+        # scans its in-neighbors against the resident frontier bitmap).
+        # 'both' already traverses both planes — no distinct reverse.
+        rev_of = {"out": "in", "in": "out"}
+        rev_keys = [(et, rev_of[d]) for et, d in block_keys
+                    if d in rev_of]
+        have_rev = (self.local_mode and len(rev_keys) == len(block_keys)
+                    and all(rk in dev.blocks for rk in rev_keys))
+        pnames = [n for n in pred_cols if n != "_rank"]
+
+        def _bd(bk):
+            out = {"indptr": dev.blocks[bk].indptr,
+                   "nbr": dev.blocks[bk].nbr,
+                   "rank": dev.blocks[bk].rank}
+            if pred is not None:
+                out["props"] = {n: dev.blocks[bk].props[n] for n in pnames}
+            return out
+
+        blocks_data = []
+        for i, bk in enumerate(block_keys):
+            d = _bd(bk)
+            if have_rev:
+                rb = dev.blocks[rev_keys[i]]
+                d["rev_indptr"] = rb.indptr
+                d["rev_nbr"] = rb.nbr
+                d["rev_rank"] = rb.rank
+                if pred is not None:
+                    d["rev_props"] = {n: rb.props[n] for n in pnames}
+                else:
+                    d["rev_props"] = {}
+            if pred is None:
+                d.setdefault("props", {})
+            blocks_data.append(d)
+        blocks_data = tuple(blocks_data)
+
+        n_phantom = int(P * dev.vmax
+                        - np.asarray(dev.num_vertices).sum())
 
         def build(ebs):
             if self.local_mode:
-                return build_bfs_fn_local(P, ebs[0], max_steps,
+                return build_bfs_fn_local(P, ebs, max_steps,
                                           len(block_keys), dev.vmax,
-                                          pred=pred, pred_cols=pred_cols)
-            return build_bfs_fn(self.mesh, P, ebs[0], max_steps,
+                                          pred=pred, pred_cols=pred_cols,
+                                          have_rev=have_rev,
+                                          n_phantom=n_phantom)
+            return build_bfs_fn(self.mesh, P, ebs, max_steps,
                                 len(block_keys), dev.vmax,
                                 pred=pred, pred_cols=pred_cols)
 
-        # The BFS edge budget is statically bounded: one hop's expansion
-        # never exceeds the block's padded edge capacity — start there
-        # and compile exactly once (escalation recompiles cost ~100s
-        # each on a tunneled chip; BFS has no capture arrays, so the
-        # memory cost of a full-size bucket is just the transient
-        # expansion buffers)
-        eb_bound = max(_pow2(max(dev.blocks[bk].nbr.shape[-1], 1))
-                       for bk in block_keys)
+        # Per-LEVEL edge budgets (like the traverse kernel's per-hop
+        # buckets): a BFS's first and last levels examine orders of
+        # magnitude fewer edges than its middle, so one uniform bucket
+        # made every level pay the widest level's padding.  The kernel
+        # reports exact per-level counts, so the ladder jumps straight
+        # to each level's bucket; the persistent bucket cache remembers
+        # the converged shape across runs.
         res = self._escalate(
             dev, dense,
             key_fn=lambda ebs: (space, dev.epoch, "bfs",
                                 tuple(block_keys), max_steps, ebs,
-                                pred_key, tuple(pred_cols)),
+                                pred_key, tuple(pred_cols), have_rev),
             build_fn=build,
             inputs_fn=lambda ebs: (blocks_data,),
-            stats=stats, n_hops=max_steps, uniform=True,
-            min_eb=eb_bound)
+            stats=stats, n_hops=max_steps)
         return res["dist"], stats
 
     # -- host materialization --------------------------------------------

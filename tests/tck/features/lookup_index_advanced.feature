@@ -99,7 +99,7 @@ Feature: Index scan boundaries and compound hints
       | 1 | 20 |
       | 2 | 30 |
 
-  Scenario: index backfills existing rows on rebuild
+  Scenario: index backfills existing rows on rebuild [standalone]
     When executing query:
       """
       CREATE TAG late(x int);

@@ -744,3 +744,38 @@ def test_pack_unpack_exchange_roundtrip():
 def jnp_asarray(x):
     import jax.numpy as jnp
     return jnp.asarray(x)
+
+
+def test_direction_optimizing_bfs_parity_local():
+    """Single-chip BFS (the bench path) switches bottom-up on dense
+    levels; distances must equal the numpy level-synchronous BFS, and
+    the FIND SHORTEST PATH rows must equal the host engine's."""
+    from nebula_tpu.bench.datagen import host_bfs
+    from nebula_tpu.graphstore.csr import build_snapshot
+
+    st = random_store(21, n=400, avg_deg=6)
+    rt1 = TpuRuntime(make_mesh(1))          # local mode: have_rev leg
+    assert rt1.local_mode
+    snap = build_snapshot(st, "g")
+    sd = st.space("g")
+    for srcs in ([1], [2, 3, 5], list(range(40))):
+        dist, stats = rt1.bfs(st, "g", srcs, ["knows"], "out", 6)
+        dense = [sd.dense_id(v) for v in srcs]
+        want = host_bfs(snap, dense, 6, etype="knows")
+        got = np.asarray(dist, np.int32)
+        nv = want.shape[0]
+        vv = np.arange(nv)
+        assert np.array_equal(got[vv % 8, vv // 8], want), srcs
+    # engine-level rows: local runtime vs host path
+    eng_dev = QueryEngine(st, tpu_runtime=rt1)
+    eng_cpu = QueryEngine(st)
+    q = ("FIND SHORTEST PATH FROM 1 TO 250 OVER knows UPTO 6 STEPS "
+         "YIELD path AS p")
+    got = {}
+    for eng in (eng_dev, eng_cpu):
+        s = eng.new_session()
+        eng.execute(s, "USE g")
+        rs = eng.execute(s, q)
+        assert rs.error is None, rs.error
+        got[id(eng)] = sorted(map(repr, rs.data.rows))
+    assert got[id(eng_dev)] == got[id(eng_cpu)]
