@@ -1,0 +1,97 @@
+Feature: Observability surface
+
+  Background:
+    Given having executed:
+      """
+      CREATE SPACE ob(partition_num=2, vid_type=INT64);
+      USE ob;
+      CREATE TAG P(a int);
+      CREATE EDGE E(w int);
+      INSERT VERTEX P(a) VALUES 1:(1), 2:(2), 3:(3);
+      INSERT EDGE E(w) VALUES 1->2:(5), 2->3:(7)
+      """
+
+  Scenario: explain row format carries the yield expression
+    When executing query:
+      """
+      EXPLAIN GO 2 STEPS FROM 1 OVER E YIELD dst(edge) AS d
+      """
+    Then the result should contain "dst(edge)"
+
+  Scenario: explain dot format emits a digraph
+    When executing query:
+      """
+      EXPLAIN FORMAT="dot" GO FROM 1 OVER E YIELD dst(edge)
+      """
+    Then the result should contain "digraph"
+
+  Scenario: explain of an unknown format errors
+    When executing query:
+      """
+      EXPLAIN FORMAT="svg" GO FROM 1 OVER E YIELD dst(edge)
+      """
+    Then a SemanticError should be raised
+
+  Scenario: show stats reflects deletes after a stats job
+    When executing query:
+      """
+      DELETE VERTEX 3 WITH EDGE;
+      SUBMIT JOB STATS;
+      SHOW STATS
+      """
+    Then the result should be, in any order:
+      | Type    | Name       | Count |
+      | "Tag"   | "P"        | 2     |
+      | "Edge"  | "E"        | 1     |
+      | "Space" | "vertices" | 2     |
+      | "Space" | "edges"    | 1     |
+
+  Scenario: update configs takes effect live and reads back
+    When executing query:
+      """
+      UPDATE CONFIGS minloglevel = 1;
+      GET CONFIGS minloglevel
+      """
+    Then the result should be, in order:
+      | Module  | Name          | Type  | Mode      | Value |
+      | "graph" | "minloglevel" | "int" | "MUTABLE" | "1"   |
+
+  Scenario: reset the flag for later scenarios
+    When executing query:
+      """
+      UPDATE CONFIGS minloglevel = 0;
+      GET CONFIGS minloglevel
+      """
+    Then the result should be, in order:
+      | Module  | Name          | Type  | Mode      | Value |
+      | "graph" | "minloglevel" | "int" | "MUTABLE" | "0"   |
+
+  Scenario: updating an unknown config errors
+    When executing query:
+      """
+      UPDATE CONFIGS never_a_flag = 1
+      """
+    Then an ExecutionError should be raised
+
+  Scenario: show charset and collation answer
+    When executing query:
+      """
+      SHOW CHARSET
+      """
+    Then the result should be, in order:
+      | Charset | Description     | Default collation | Maxlen |
+      | "utf8"  | "UTF-8 Unicode" | "utf8_bin"        | 4      |
+
+  Scenario: describe space reports its shape
+    When executing query:
+      """
+      DESCRIBE SPACE ob
+      """
+    Then the result should not be empty
+
+  Scenario: show parts lists every partition
+    When executing query:
+      """
+      SHOW PARTS
+      """
+    Then the result should not be empty
