@@ -268,12 +268,24 @@ class GraphStore:
                     dense2(dst)
                     tgt = parts2[stable_vid_hash(dst) % P2].in_edges
                     tgt[dst] = {et: dict(em) for et, em in per.items()}
-            # phase 2: the swap (all-or-nothing)
-            desc.partition_num = P2
-            sd.parts = parts2
-            sd.part_counts = counts2
-            sd.vid_to_dense = v2d
-            sd.dense_to_vid = d2v
+            # phase 2: the swap.  Writers are excluded by sd.lock, but
+            # READ paths are lock-free — order the assignments so a
+            # racing reader can transiently MISS but never index past a
+            # list's end: growing, install the bigger parts list before
+            # the partition count that routes into its tail; shrinking,
+            # shrink the count first.
+            if P2 >= desc.partition_num:
+                sd.parts = parts2
+                sd.part_counts = counts2
+                sd.vid_to_dense = v2d
+                sd.dense_to_vid = d2v
+                desc.partition_num = P2
+            else:
+                desc.partition_num = P2
+                sd.parts = parts2
+                sd.part_counts = counts2
+                sd.vid_to_dense = v2d
+                sd.dense_to_vid = d2v
             sd.index_data = {}
             sd.ft_data = {}
             sd.epoch += 1
