@@ -97,6 +97,15 @@ class SpaceData:
     def part_of(self, vid: Any) -> int:
         return stable_vid_hash(vid) % self.num_parts
 
+    def part_for(self, vid: Any) -> "Partition":
+        """Coherent lock-free part lookup: ONE read of the parts list,
+        modulus from that snapshot's own length — a racing REPARTITION
+        swap yields a stale-but-coherent partition (transient miss),
+        never an IndexError.  Write paths under sd.lock (which the swap
+        also holds) keep using part_of()."""
+        parts = self.parts
+        return parts[stable_vid_hash(vid) % len(parts)]
+
     def dense_id(self, vid: Any, create: bool = False) -> int:
         d = self.vid_to_dense.get(vid)
         if d is not None:
@@ -955,7 +964,7 @@ class GraphStore:
         """vid → {tag: props} or None (TTL-expired tags invisible)."""
         import time as _t
         sd = self.space(space)
-        tv = sd.parts[sd.part_of(vid)].vertices.get(vid)
+        tv = sd.part_for(vid).vertices.get(vid)
         if tv is None:
             return None
         now = _t.time()
@@ -973,7 +982,7 @@ class GraphStore:
                  rank: int = 0) -> Optional[Dict[str, Any]]:
         import time as _t
         sd = self.space(space)
-        row = sd.parts[sd.part_of(src)].out_edges.get(src, {}).get(etype, {}) \
+        row = sd.part_for(src).out_edges.get(src, {}).get(etype, {}) \
             .get((rank, dst))
         if row is None:
             return None
@@ -987,11 +996,14 @@ class GraphStore:
         """Yields (vid, tag, props)."""
         import time as _t
         sd = self.space(space)
-        part_ids = range(sd.num_parts) if parts is None else parts
+        plist = sd.parts                 # one snapshot: repartition-safe
+        part_ids = range(len(plist)) if parts is None else parts
         svs = {t.name: t.latest for t in self.catalog.tags(space)}
         now = _t.time()
         for pid in part_ids:
-            for vid, tv in sd.parts[pid].vertices.items():
+            if pid >= len(plist):
+                continue
+            for vid, tv in plist[pid].vertices.items():
                 for t, (_, row) in tv.items():
                     if t not in svs:
                         continue    # tag dropped: rows invisible
@@ -1004,11 +1016,14 @@ class GraphStore:
         """Yields (src, etype, rank, dst, props) from the out-plane."""
         import time as _t
         sd = self.space(space)
-        part_ids = range(sd.num_parts) if parts is None else parts
+        plist = sd.parts                 # one snapshot: repartition-safe
+        part_ids = range(len(plist)) if parts is None else parts
         svs = {e.name: e.latest for e in self.catalog.edges(space)}
         now = _t.time()
         for pid in part_ids:
-            for src, per in sd.parts[pid].out_edges.items():
+            if pid >= len(plist):
+                continue
+            for src, per in plist[pid].out_edges.items():
                 for et, em in per.items():
                     if etype is not None and et != etype:
                         continue
@@ -1053,7 +1068,7 @@ class GraphStore:
         svs = {et: self.catalog.get_edge(space, et).latest for et in etypes}
         now = _t.time()
         for vid in vids:
-            p = sd.parts[sd.part_of(vid)]
+            p = sd.part_for(vid)
             if direction in ("out", "both"):
                 per = p.out_edges.get(vid, {})
                 for et in etypes:

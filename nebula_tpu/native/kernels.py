@@ -50,8 +50,15 @@ def build_coo_csr(src_dense: np.ndarray, dst_dense: np.ndarray,
         if got == emax:
             return indptr, nbr, rk, perm, emax
         # fall through to numpy on unexpected failure
+    return _numpy_coo_csr(src_dense, dst_dense, rank, dst_key, P, vmax,
+                          emax)
 
-    # NumPy fallback: identical order (part, local, rank, dst_key, idx)
+
+def _numpy_coo_csr(src_dense, dst_dense, rank, dst_key, P, vmax, emax):
+    """The pure-numpy twin of the native build (identical slot order:
+    part, local, rank, dst_key, idx) — the fallback AND the property
+    tests' oracle for the C path."""
+    n = int(src_dense.shape[0])
     part = src_dense % P
     local = src_dense // P
     order = np.lexsort((np.arange(n), dst_key, rank, local, part))

@@ -1,0 +1,128 @@
+"""Property-based tests (SURVEY §7: 'every kernel vs CPU oracle on
+random graphs (hypothesis)') — hypothesis drives the input spaces and
+shrinks failures; each property states an invariant two independent
+implementations must share."""
+import numpy as np
+from hypothesis import HealthCheck, given, settings
+from hypothesis import strategies as st
+
+_slow = settings(max_examples=25, deadline=None,
+                 suppress_health_check=[HealthCheck.too_slow])
+
+
+# -- wire encoding round-trips ----------------------------------------------
+
+_scalars = st.one_of(
+    st.integers(min_value=-2**62, max_value=2**62),
+    st.floats(allow_nan=False, allow_infinity=False, width=64),
+    st.text(max_size=12), st.booleans(), st.none())
+_values = st.recursive(
+    _scalars,
+    lambda inner: st.one_of(
+        st.lists(inner, max_size=4),
+        st.dictionaries(st.text(min_size=1, max_size=6), inner,
+                        max_size=4)),
+    max_leaves=12)
+
+
+@_slow
+@given(_values)
+def test_wire_roundtrip(v):
+    from nebula_tpu.graphstore import schema_wire as w
+    assert w.loads(w.dumps(v)) == v
+
+
+# -- native CSR builder vs the numpy fallback -------------------------------
+
+@_slow
+@given(st.integers(1, 6), st.integers(0, 120), st.integers(2, 40),
+       st.integers(0, 2**31 - 1))
+def test_native_coo_csr_matches_numpy(P, n_edges, n_vertices, seed):
+    from nebula_tpu.native import get_lib
+    from nebula_tpu.native.kernels import build_coo_csr, _numpy_coo_csr
+    rng = np.random.default_rng(seed)
+    src = rng.integers(0, n_vertices, n_edges, dtype=np.int64)
+    dst = rng.integers(0, n_vertices, n_edges, dtype=np.int64)
+    rank = rng.integers(0, 3, n_edges, dtype=np.int64)
+    vmax = -(-n_vertices // P)
+    src_dense = (src % vmax) * P + (src % P)     # any valid dense layout
+    out_native = build_coo_csr(src_dense, dst, rank, dst, P, vmax)
+    if get_lib() is None or n_edges == 0:
+        return                                   # numpy-only env / trivial
+    emax = out_native[-1]
+    out_np = _numpy_coo_csr(src_dense.astype(np.int64),
+                            dst.astype(np.int64), rank.astype(np.int64),
+                            dst.astype(np.int64), P, vmax, emax)
+    for a, b in zip(out_native, out_np):
+        if isinstance(a, np.ndarray):
+            assert np.array_equal(a, b)
+        else:
+            assert a == b
+
+
+# -- null propagation over scalar builtins ----------------------------------
+
+@_slow
+@given(st.sampled_from(["abs", "floor", "ceil", "sqrt", "exp", "log",
+                        "sign", "lower", "upper", "trim", "reverse",
+                        "length", "tostring"]))
+def test_scalar_functions_propagate_null(name):
+    from nebula_tpu.core.functions import FUNCTIONS
+    from nebula_tpu.core.value import NULL, is_null
+    out = FUNCTIONS[name](None, [NULL])
+    assert is_null(out), (name, out)
+
+
+# -- total order over mixed values ------------------------------------------
+
+@_slow
+@given(st.lists(_scalars, max_size=12))
+def test_total_order_key_sorts_consistently(vals):
+    from nebula_tpu.core.value import total_order_key
+    keys = [total_order_key(v) for v in vals]
+    s1 = sorted(keys)
+    s2 = sorted(sorted(keys, reverse=True))
+    assert s1 == s2                              # deterministic total order
+
+
+# -- conjunct split/join round-trip -----------------------------------------
+
+@_slow
+@given(st.integers(1, 5), st.integers(0, 2**31 - 1))
+def test_split_join_conjuncts_roundtrip(n, seed):
+    from nebula_tpu.core.expr import (Binary, Literal, join_conjuncts,
+                                      split_conjuncts, to_text)
+    rng = np.random.default_rng(seed)
+    parts = [Binary(">", Literal(int(rng.integers(0, 50))),
+                    Literal(int(rng.integers(0, 50)))) for _ in range(n)]
+    joined = join_conjuncts(parts)
+    back = split_conjuncts(joined)
+    assert [to_text(p) for p in parts] == [to_text(b) for b in back]
+
+
+# -- device GO vs host engine on random graphs ------------------------------
+
+@settings(max_examples=6, deadline=None,
+          suppress_health_check=[HealthCheck.too_slow])
+@given(st.integers(0, 2**31 - 1), st.integers(1, 3),
+       st.sampled_from(["out", "in", "both"]))
+def test_device_go_matches_host_on_random_graphs(seed, steps, direction):
+    from test_tpu import host_go, norm_edge, random_store
+    from nebula_tpu.tpu import TpuRuntime, make_mesh
+    rt = _shared_rt()
+    st_ = random_store(seed % 1000, n=60, avg_deg=3)
+    rows, _ = rt.traverse(st_, "g", [1, 5, 9], ["knows"], direction,
+                          steps)
+    got = sorted(norm_edge(e) for (_, e, _) in rows)
+    want = host_go(st_, "g", [1, 5, 9], ["knows"], direction, steps)
+    assert got == want
+
+
+_rt_box = []
+
+
+def _shared_rt():
+    if not _rt_box:
+        from nebula_tpu.tpu import TpuRuntime, make_mesh
+        _rt_box.append(TpuRuntime(make_mesh(8)))
+    return _rt_box[0]

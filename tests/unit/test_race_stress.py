@@ -254,12 +254,14 @@ def test_repartition_under_concurrent_readers():
         ts = [threading.Thread(target=reader) for _ in range(3)]
         for t in ts:
             t.start()
-        for n in (8, 3, 16, 2):
-            moved = store.repartition("rr", n)
-            assert moved == 60, moved
-        stop.set()
-        for t in ts:
-            t.join()
+        try:
+            for n in (8, 3, 16, 2):
+                moved = store.repartition("rr", n)
+                assert moved == 60, moved
+        finally:
+            stop.set()         # a failing assert must not leave the
+            for t in ts:       # non-daemon readers spinning forever
+                t.join()
     assert not errs, errs
     rs = eng.execute(s, "GO 2 STEPS FROM 0 OVER E YIELD dst(edge) AS d")
     assert sorted(map(repr, rs.data.rows)) == settled
