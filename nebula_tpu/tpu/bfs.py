@@ -59,9 +59,9 @@ def build_bfs_fn(mesh, P: int, EB, max_steps: int,
                 ovf_e = ovf_e | ovf
                 edges = edges + total
                 if pred is not None:
-                    cols = {"_rank": rk}
+                    cols = {"_rank": rk, "_src": src, "_dst": dst}
                     for name in pred_cols:
-                        if name != "_rank":
+                        if not name.startswith("_"):
                             cols[name] = b["props"][name][0][eidx]
                     keep = pred(cols) & ve
                 else:
@@ -104,14 +104,20 @@ def build_bfs_fn_local(P: int, EB, max_steps: int,
     pids = jnp.arange(P, dtype=jnp.int32)
     ebs = _norm_ebs(EB, max_steps, False)
 
-    def one_part(block, fbm, pid, EBl):
+    def one_part(block, fbm, pid, EBl, swap_ends=False):
         src, dst, rk, eidx, ve, total, ovf = _expand_block(
             block["indptr"], block["nbr"], block["rank"], fbm, EBl, P,
             pid)
         if pred is not None:
-            cols = {"_rank": rk}
+            # $^/$$ are TRAVERSAL source/destination.  Bottom-up
+            # expands the REVERSE adjacency, so the expansion source is
+            # the traversal DESTINATION (the newly reached vertex) and
+            # the neighbor is the frontier side — swap the endpoint
+            # columns the predicate sees.
+            ps, pd = (dst, src) if swap_ends else (src, dst)
+            cols = {"_rank": rk, "_src": ps, "_dst": pd}
             for name in pred_cols:
-                if name != "_rank":
+                if not name.startswith("_"):
                     cols[name] = block["props"][name][eidx]
             keep = pred(cols) & ve
         else:
@@ -148,7 +154,7 @@ def build_bfs_fn_local(P: int, EB, max_steps: int,
             src, nb, keep, total, ov = jax.vmap(
                 lambda ip, nbr, rkk, prp, f, pd: one_part(
                     {"indptr": ip, "nbr": nbr, "rank": rkk,
-                     "props": prp}, f, pd, EBl)
+                     "props": prp}, f, pd, EBl, swap_ends=True)
             )(b["rev_indptr"], b["rev_nbr"], b["rev_rank"],
               b.get("rev_props", {}), unvis, pids)
             ovf = ovf | ov

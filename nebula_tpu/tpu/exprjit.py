@@ -18,7 +18,7 @@ interpreter's exact semantics:
 
 `compilable(expr, etypes)` is the static gate the optimizer rule uses;
 `compile_predicate(expr, block, pool)` produces the mask fn used inside
-the hop kernel.  Columns arrive as a dict: reserved keys `_rank` plus one
+the hop kernel.  Columns arrive as a dict: reserved keys `_rank`, `_src`, `_dst` (endpoint DENSE ids for id($^)/id($$)) plus one
 key per edge property name.
 """
 from __future__ import annotations
@@ -101,6 +101,22 @@ def _edge_prop_ref(e: E.Expr):
     return None
 
 
+def _vid_ref(e: E.Expr):
+    """id($$) / id($^) → the capture column holding that endpoint's
+    DENSE id ("_dst" / "_src").  Compilable only in direct comparisons
+    against literal vids (the literal translates to a dense id at
+    compile time; arbitrary arithmetic over vids cannot)."""
+    if (isinstance(e, E.FunctionCall) and e.name.lower() == "id"
+            and len(e.args) == 1 and getattr(e.args[0], "kind", "")
+            == "vertex"):
+        which = getattr(e.args[0], "which", "")
+        if which == "$$":
+            return "_dst"
+        if which == "$^":
+            return "_src"
+    return None
+
+
 def _check(e: E.Expr, etypes: Set[str]):
     if isinstance(e, E.Literal):
         v = e.value
@@ -125,6 +141,20 @@ def _check(e: E.Expr, etypes: Set[str]):
             return
         raise CannotCompile(f"unary {e.op}")
     if isinstance(e, E.Binary):
+        # endpoint-id predicate: id($$)/id($^) vs literal vid(s) only
+        lv, rv = _vid_ref(e.lhs), _vid_ref(e.rhs)
+        if lv or rv:
+            if e.op in ("==", "!=") and (
+                    (lv and isinstance(e.rhs, E.Literal))
+                    or (rv and isinstance(e.lhs, E.Literal))):
+                return
+            if e.op in ("IN", "NOT IN") and lv \
+                    and isinstance(e.rhs, (E.ListExpr, E.SetExpr)) \
+                    and all(isinstance(i, E.Literal)
+                            for i in e.rhs.items):
+                return
+            raise CannotCompile(
+                "id($$)/id($^) only compiles vs literal vids")
         if e.op in _LOGIC_OPS + _CMP_OPS + _ARITH_OPS:
             _check(e.lhs, etypes)
             _check(e.rhs, etypes)
@@ -150,12 +180,51 @@ MaskFn = Callable[[Dict[str, Any]], Any]
 
 
 def compile_predicate(e: E.Expr, prop_types: Dict[str, PropType],
-                      pool: StringPool) -> Tuple[MaskFn, List[str]]:
+                      pool: StringPool,
+                      vid_to_dense=None) -> Tuple[MaskFn, List[str]]:
     """Returns (mask_fn, needed_columns).  mask_fn(cols) -> bool array:
-    True where the predicate evaluates to (non-null) true."""
+    True where the predicate evaluates to (non-null) true.
+
+    vid_to_dense: vid → dense id (-1 unknown), required to compile
+    id($$)/id($^) comparisons — the literal vid translates to the dense
+    currency the kernel's src/dst columns carry."""
     needed: Set[str] = set()
 
+    def dense_of(v):
+        if vid_to_dense is None:
+            raise CannotCompile("no vid→dense mapping for id() predicate")
+        d = vid_to_dense(v)
+        return int(d) if d is not None else -1
+
+    def vid_cmp(col, op, values):
+        """id(endpoint) ==/!=/IN literal vid(s) → dense comparison;
+        unknown vids map to -1, which no real dense id equals."""
+        needed.add(col)
+        dv = [dense_of(v.value) for v in values]
+
+        def g(c):
+            ep = c[col]
+            m = jnp.zeros(jnp.shape(ep), bool)
+            for d in dv:
+                m = m | (ep == d)
+            if op in ("!=", "NOT IN"):
+                m = jnp.logical_not(m)
+            return (m, jnp.zeros(jnp.shape(ep), bool), "bool")
+        return g
+
     def build(x: E.Expr) -> Callable[[Dict[str, Any]], Term]:
+        if isinstance(x, E.Binary):
+            lv, rv = _vid_ref(x.lhs), _vid_ref(x.rhs)
+            if lv or rv:
+                if x.op in ("==", "!="):
+                    col = lv or rv
+                    lit = x.rhs if lv else x.lhs
+                    if not isinstance(lit, E.Literal):
+                        raise CannotCompile("id() vs non-literal")
+                    return vid_cmp(col, x.op, [lit])
+                if x.op in ("IN", "NOT IN") and lv:
+                    return vid_cmp(lv, x.op, list(x.rhs.items))
+                raise CannotCompile("id() predicate shape")
         if isinstance(x, E.Literal):
             return _lit(x.value, pool)
         ref = _edge_prop_ref(x)

@@ -244,9 +244,9 @@ def build_traverse_fn(mesh, P: int, EB, steps: int,
                 ovf_e = ovf_e | ovf
                 edges_this_hop = edges_this_hop + total
                 if pred is not None and (last or capture_hops):
-                    cols = {"_rank": rk}
+                    cols = {"_rank": rk, "_src": src, "_dst": dst}
                     for name in pred_cols:
-                        if name != "_rank":
+                        if not name.startswith("_"):
                             cols[name] = b["props"][name][0][eidx]
                     keep = pred(cols) & ve
                 else:
@@ -328,9 +328,9 @@ def build_traverse_fn_local(P: int, EB, steps: int,
         src, dst, rk, eidx, ve, total, ovf = _expand_block(
             block["indptr"], block["nbr"], block["rank"], fbm, EBh, P, pid)
         if want_pred:
-            cols = {"_rank": rk}
+            cols = {"_rank": rk, "_src": src, "_dst": dst}
             for name in pred_cols:
-                if name != "_rank":
+                if not name.startswith("_"):
                     cols[name] = block["props"][name][eidx]
             keep = pred(cols) & ve
         else:

@@ -578,7 +578,8 @@ class TpuRuntime:
             # single-etype constraint is enforced by the optimizer rule
             bl = dev.blocks[block_keys[0]]
             pred, pred_cols = compile_predicate(
-                edge_filter, bl.prop_types, dev.pool)
+                edge_filter, bl.prop_types, dev.pool,
+                vid_to_dense=sd.dense_id)
             pred_key = E.to_text(edge_filter) if hasattr(E, "to_text") else repr(edge_filter)
 
         dense = [sd.dense_id(v) for v in vids]
@@ -606,7 +607,7 @@ class TpuRuntime:
             # on host via eidx as before
             if len(yield_cols) > 4:
                 yield_cols = yield_cols[:4]
-        prop_names = {n for n in pred_cols if n != "_rank"}
+        prop_names = {n for n in pred_cols if not n.startswith("_")}
         prop_names |= set(yield_cols)
         blocks_data = tuple(
             {"indptr": dev.blocks[bk].indptr, "nbr": dev.blocks[bk].nbr,
@@ -699,7 +700,8 @@ class TpuRuntime:
         if edge_filter is not None:
             bl = dev.blocks[block_keys[0]]
             pred, pred_cols = compile_predicate(
-                edge_filter, bl.prop_types, dev.pool)
+                edge_filter, bl.prop_types, dev.pool,
+                vid_to_dense=sd.dense_id)
             pred_key = E.to_text(edge_filter) if hasattr(E, "to_text") \
                 else repr(edge_filter)
 
@@ -713,7 +715,7 @@ class TpuRuntime:
             {"indptr": dev.blocks[bk].indptr, "nbr": dev.blocks[bk].nbr,
              "rank": dev.blocks[bk].rank,
              "props": {n: dev.blocks[bk].props[n] for n in pred_cols
-                       if n != "_rank"}}
+                       if not n.startswith("_")}}
             for bk in block_keys)
 
         def build(ebs):
@@ -856,7 +858,8 @@ class TpuRuntime:
         if edge_filter is not None:
             bl = dev.blocks[block_keys[0]]
             pred, pred_cols = compile_predicate(
-                edge_filter, bl.prop_types, dev.pool)
+                edge_filter, bl.prop_types, dev.pool,
+                vid_to_dense=sd.dense_id)
             pred_key = E.to_text(edge_filter)
         dense = [sd.dense_id(v) for v in srcs]
         dense = [d for d in dense if d >= 0]
@@ -873,7 +876,7 @@ class TpuRuntime:
                     if d in rev_of]
         have_rev = (self.local_mode and len(rev_keys) == len(block_keys)
                     and all(rk in dev.blocks for rk in rev_keys))
-        pnames = [n for n in pred_cols if n != "_rank"]
+        pnames = [n for n in pred_cols if not n.startswith("_")]
 
         def _bd(bk):
             out = {"indptr": dev.blocks[bk].indptr,

@@ -136,6 +136,11 @@ def test_frontier_oracle(rt):
     "knows.w IS NOT NULL AND knows.w * 2 + 1 > 21",
     "knows.w IN [1, 2, 3, 40, 41, 42, 43, 44]",
     "rank(edge) == 1",
+    "id($$) == 9",
+    "id($$) != 9 AND knows.w > 20",
+    "id($$) IN [5, 9, 14, 999999]",
+    "id($$) NOT IN [5, 9]",
+    "id($^) == 3",
     "NOT (knows.w > 10)",
     "knows.w / 3 > 5",
 ])
@@ -158,7 +163,8 @@ def test_not_compilable():
     from nebula_tpu.query.parser import parse
     for w in ["knows.tag CONTAINS \"a\"",
               "knows.tag =~ \"a.*\"",
-              "id($$) == 3"]:
+              "id($$) + 1 == 3",
+              "id($$) == id($^)"]:
         stmt = parse(f"GO FROM 1 OVER knows WHERE {w} YIELD dst(edge)")
         assert not compilable(stmt.where.filter, ["knows"]), w
 
@@ -779,3 +785,50 @@ def test_direction_optimizing_bfs_parity_local():
         assert rs.error is None, rs.error
         got[id(eng)] = sorted(map(repr, rs.data.rows))
     assert got[id(eng_dev)] == got[id(eng_cpu)]
+
+
+def test_bottom_up_bfs_endpoint_predicate_parity():
+    """A filtered shortest path on a graph dense enough to flip the
+    direction-optimizing kernel bottom-up must still evaluate
+    id($^)/id($$) on TRAVERSAL orientation (the bottom-up expansion is
+    reversed — endpoints swap inside the kernel)."""
+    from nebula_tpu.query.parser import parse
+    st = random_store(23, n=200, avg_deg=8)
+    rt1 = TpuRuntime(make_mesh(1))
+    assert rt1.local_mode
+    for w in ("id($$) != 7", "id($^) NOT IN [3, 9]"):
+        stmt = parse(f"GO FROM 1 OVER knows WHERE {w} YIELD dst(edge)")
+        cond = stmt.where.filter
+        dist, _ = rt1.bfs(st, "g", [1, 2, 3, 4, 5, 6, 7, 8], ["knows"],
+                          "out", 5, edge_filter=cond)
+        # host oracle: level BFS honoring the same edge filter
+        import numpy as np
+        eng = QueryEngine(st)
+        s = eng.new_session()
+        eng.execute(s, "USE g")
+        frontier = {1, 2, 3, 4, 5, 6, 7, 8}
+        want = {v: 0 for v in frontier}
+        for lvl in range(1, 6):
+            nxt = set()
+            for (sv, et, rank, dv, props, sgn) in st.get_neighbors(
+                    "g", sorted(frontier), ["knows"], "out"):
+                if w == "id($$) != 7" and dv == 7:
+                    continue
+                if w == "id($^) NOT IN [3, 9]" and sv in (3, 9):
+                    continue
+                if dv not in want:
+                    nxt.add(dv)
+            for v in nxt:
+                want[v] = lvl
+            frontier = nxt
+            if not frontier:
+                break
+        got = np.asarray(dist, np.int32)
+        sd = st.space("g")
+        for vid in range(200):
+            d = sd.dense_id(vid)
+            if d < 0:
+                continue
+            exp = want.get(vid, -1)
+            assert got[d % 8, d // 8] == exp, (w, vid, exp,
+                                               int(got[d % 8, d // 8]))
