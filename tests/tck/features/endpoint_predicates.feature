@@ -1,0 +1,96 @@
+Feature: Endpoint id predicates in GO filters
+
+  Background:
+    Given having executed:
+      """
+      CREATE SPACE ep(partition_num=8, vid_type=INT64);
+      USE ep;
+      CREATE TAG P(a int);
+      CREATE EDGE E(w int);
+      INSERT VERTEX P(a) VALUES 1:(1), 2:(2), 3:(3), 4:(4), 5:(5);
+      INSERT EDGE E(w) VALUES 1->2:(10), 1->3:(20), 2->3:(30), 2->4:(40),
+        3->4:(50), 3->5:(60), 4->5:(70), 4->1:(80)
+      """
+
+  Scenario: exclude one destination
+    When executing query:
+      """
+      GO FROM 1, 2 OVER E WHERE id($$) != 3 YIELD dst(edge) AS d | ORDER BY $-.d
+      """
+    Then the result should be, in order:
+      | d |
+      | 2 |
+      | 4 |
+
+  Scenario: destination membership list
+    When executing query:
+      """
+      GO 2 STEPS FROM 1 OVER E WHERE id($$) IN [4, 5] YIELD dst(edge) AS d | ORDER BY $-.d
+      """
+    Then the result should be, in order:
+      | d |
+      | 4 |
+      | 4 |
+      | 5 |
+
+  Scenario: destination not-in list
+    When executing query:
+      """
+      GO FROM 3 OVER E WHERE id($$) NOT IN [4] YIELD dst(edge) AS d
+      """
+    Then the result should be, in order:
+      | d |
+      | 5 |
+
+  Scenario: source endpoint filter on the final hop
+    When executing query:
+      """
+      GO 2 STEPS FROM 1 OVER E WHERE id($^) == 2 YIELD src(edge) AS s, dst(edge) AS d | ORDER BY $-.d
+      """
+    Then the result should be, in order:
+      | s | d |
+      | 2 | 3 |
+      | 2 | 4 |
+
+  Scenario: endpoint filter combined with a property filter
+    When executing query:
+      """
+      GO 2 STEPS FROM 1 OVER E WHERE id($$) != 4 AND E.w >= 30 YIELD dst(edge) AS d | ORDER BY $-.d
+      """
+    Then the result should be, in order:
+      | d |
+      | 3 |
+      | 5 |
+
+  Scenario: unknown vid in the filter matches nothing
+    When executing query:
+      """
+      GO FROM 1 OVER E WHERE id($$) == 999999 YIELD dst(edge) AS d
+      """
+    Then the result should be empty
+
+  Scenario: unknown vid in a negated filter matches everything
+    When executing query:
+      """
+      GO FROM 1 OVER E WHERE id($$) != 999999 YIELD dst(edge) AS d | ORDER BY $-.d
+      """
+    Then the result should be, in order:
+      | d |
+      | 2 |
+      | 3 |
+
+  Scenario: reversely the destination is the reached neighbor
+    When executing query:
+      """
+      GO FROM 4 OVER E REVERSELY WHERE id($$) != 2 YIELD src(edge) AS s, dst(edge) AS d
+      """
+    Then the result should be, in order:
+      | s | d |
+      | 3 | 4 |
+
+  Scenario: shortest path with an endpoint-filtered edge set
+    When executing query:
+      """
+      FIND SHORTEST PATH FROM 1 TO 5 OVER E WHERE id($$) != 3 UPTO 4 STEPS YIELD path AS p
+      """
+    Then the result should not be empty
