@@ -189,7 +189,18 @@ _str1("toupper", str.upper)
 _str1("trim", str.strip)
 _str1("ltrim", str.lstrip)
 _str1("rtrim", str.rstrip)
-_str1("reverse", lambda s: s[::-1])
+
+
+@register("reverse")
+def _reverse(ctx, args):
+    """String or list reversal — the reference overloads one name."""
+    n = _nullprop(args)
+    if n is not None:
+        return n
+    v = args[0]
+    if isinstance(v, (str, list)):
+        return v[::-1]
+    return NULL_BAD_TYPE
 
 
 @register("length")
@@ -268,6 +279,17 @@ def _replace(ctx, args):
     if not all(isinstance(a, str) for a in args[:3]):
         return NULL_BAD_TYPE
     return args[0].replace(args[1], args[2])
+
+
+@register("atan2")
+def _atan2(ctx, args):
+    n = _nullprop(args)
+    if n is not None:
+        return n
+    a, b = args[0], args[1]
+    if not _num(a) or not _num(b):
+        return NULL_BAD_TYPE
+    return math.atan2(a, b)
 
 
 @register("split")

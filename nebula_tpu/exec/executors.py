@@ -461,34 +461,18 @@ def _traverse_device(node, qctx, ectx, ds, ci, sp, etypes, direction,
     last = d0[ridx]
     path: List[np.ndarray] = []       # per-hop frame indices, path-major
     pending = 0
+    from ..tpu.runtime import join_frontier_trails, trail_distinct_keep
     for h in range(max_hop):
         if ridx.size == 0:
             break
         fr = frames[h]
         if fr.n == 0:
             break
-        us, ustart, ucnt = fr.src_slices()
-        p = np.searchsorted(us, last)
-        p = np.minimum(p, us.size - 1)
-        hit = us[p] == last
-        cnt = np.where(hit, ucnt[p], 0)
-        start = np.where(hit, ustart[p], 0)
-        ends = np.cumsum(cnt)
-        total = int(ends[-1]) if cnt.size else 0
+        parent, fidx = join_frontier_trails(fr, last)
+        total = fidx.size
         if total == 0:
             break
-        k = np.arange(total, dtype=np.int64)
-        parent = np.searchsorted(ends, k, side="right")
-        within = k - (ends[parent] - cnt[parent])
-        fidx = fr.order[start[parent] + within]
-        keep = np.ones(total, bool)
-        for eh, pe in enumerate(path):
-            pf = frames[eh]
-            pidx = pe[parent]
-            keep &= ~((pf.key_et[pidx] == fr.key_et[fidx])
-                      & (pf.key_s[pidx] == fr.key_s[fidx])
-                      & (pf.key_d[pidx] == fr.key_d[fidx])
-                      & (pf.rank[pidx] == fr.rank[fidx]))
+        keep = trail_distinct_keep(frames, path, parent, fr, fidx)
         if host_check and keep.any():
             # non-vectorizable predicate: frames are a superset; re-check
             # each surviving candidate against its input row on host

@@ -29,5 +29,6 @@ jax.config.update("jax_enable_x64", True)
 from .device import DeviceSnapshot, make_mesh, pin_snapshot          # noqa: E402
 from .runtime import TpuRuntime                                      # noqa: E402
 from . import traverse                                               # noqa: E402  (registers executor+rule)
+from . import match_agg                                              # noqa: E402  (registers executor+rule)
 
 __all__ = ["DeviceSnapshot", "make_mesh", "pin_snapshot", "TpuRuntime"]

@@ -117,6 +117,27 @@ def _vid_ref(e: E.Expr):
     return None
 
 
+def _nonnull_lit(x: E.Expr) -> bool:
+    return (isinstance(x, E.Literal) and x.value is not None
+            and not isinstance(x.value, NullValue))
+
+
+def _id_pred_shape_ok(e: "E.Binary", l_ref: bool, r_ref: bool) -> bool:
+    """Shared id-vs-literal shape gate for the edge plane (id($$)/id($^))
+    and the vertex plane (id(alias)).  NULL literals are rejected: the
+    host's comparison-with-NULL answers NULL (row dropped), which a
+    dense-id compare cannot express for the negated ops ('!=' /
+    'NOT IN' would mask every row back IN)."""
+    if e.op in ("==", "!=") and ((l_ref and _nonnull_lit(e.rhs))
+                                 or (r_ref and _nonnull_lit(e.lhs))):
+        return True
+    if e.op in ("IN", "NOT IN") and l_ref \
+            and isinstance(e.rhs, (E.ListExpr, E.SetExpr)) \
+            and all(_nonnull_lit(i) for i in e.rhs.items):
+        return True
+    return False
+
+
 def _check(e: E.Expr, etypes: Set[str]):
     if isinstance(e, E.Literal):
         v = e.value
@@ -144,17 +165,10 @@ def _check(e: E.Expr, etypes: Set[str]):
         # endpoint-id predicate: id($$)/id($^) vs literal vid(s) only
         lv, rv = _vid_ref(e.lhs), _vid_ref(e.rhs)
         if lv or rv:
-            if e.op in ("==", "!=") and (
-                    (lv and isinstance(e.rhs, E.Literal))
-                    or (rv and isinstance(e.lhs, E.Literal))):
-                return
-            if e.op in ("IN", "NOT IN") and lv \
-                    and isinstance(e.rhs, (E.ListExpr, E.SetExpr)) \
-                    and all(isinstance(i, E.Literal)
-                            for i in e.rhs.items):
+            if _id_pred_shape_ok(e, bool(lv), bool(rv)):
                 return
             raise CannotCompile(
-                "id($$)/id($^) only compiles vs literal vids")
+                "id($$)/id($^) only compiles vs non-null literal vids")
         if e.op in _LOGIC_OPS + _CMP_OPS + _ARITH_OPS:
             _check(e.lhs, etypes)
             _check(e.rhs, etypes)
@@ -267,164 +281,362 @@ def compile_predicate(e: E.Expr, prop_types: Dict[str, PropType],
     return mask_fn, sorted(needed)
 
 
-def _lit(v: Any, pool: StringPool) -> Callable[[Dict[str, Any]], Term]:
-    if v is None or isinstance(v, NullValue):
-        return lambda c: (jnp.zeros((), jnp.int64), jnp.ones((), bool), "int")
-    if isinstance(v, bool):
-        return lambda c: (jnp.asarray(v), jnp.zeros((), bool), "bool")
-    if isinstance(v, int):
-        if not (-(1 << 63) <= v < (1 << 63)):
-            # host compares arbitrary-precision ints; fall back
-            raise CannotCompile("int literal outside int64")
-        return lambda c: (jnp.asarray(v, jnp.int64), jnp.zeros((), bool), "int")
-    if isinstance(v, float):
-        return lambda c: (jnp.asarray(v, jnp.float64),
-                          jnp.zeros((), bool), "float")
-    if isinstance(v, str):
-        code = pool.lookup(v)       # -2 when absent: equals nothing non-null
-        return lambda c: (jnp.asarray(code, jnp.int64),
-                          jnp.zeros((), bool), "str")
-    raise CannotCompile(f"literal {type(v)}")
+def _term_alg(xp):
+    """Build the (value, is_null, kind) term algebra over one array
+    namespace.  The SAME code compiles the in-kernel jnp mask functions
+    (hop predicate pushdown) and the host-side numpy vertex-predicate
+    masks (fused MATCH tail, match_agg.py) — jnp and np agree on every
+    op used here, so the two planes cannot drift semantically."""
 
+    def _lit(v: Any, pool: StringPool) -> Callable[[Dict[str, Any]], Term]:
+        if v is None or isinstance(v, NullValue):
+            return lambda c: (xp.zeros((), xp.int64), xp.ones((), bool),
+                              "int")
+        if isinstance(v, bool):
+            return lambda c: (xp.asarray(v), xp.zeros((), bool), "bool")
+        if isinstance(v, int):
+            if not (-(1 << 63) <= v < (1 << 63)):
+                # host compares arbitrary-precision ints; fall back
+                raise CannotCompile("int literal outside int64")
+            return lambda c: (xp.asarray(v, xp.int64), xp.zeros((), bool),
+                              "int")
+        if isinstance(v, float):
+            return lambda c: (xp.asarray(v, xp.float64),
+                              xp.zeros((), bool), "float")
+        if isinstance(v, str):
+            code = pool.lookup(v)   # -2 when absent: equals nothing non-null
+            return lambda c: (xp.asarray(code, xp.int64),
+                              xp.zeros((), bool), "str")
+        raise CannotCompile(f"literal {type(v)}")
 
-def _unary(op: str, f) -> Callable[[Dict[str, Any]], Term]:
-    def g(c):
-        v, n, k = f(c)
-        if op == "IS_NULL":
-            return (n, jnp.zeros(jnp.shape(n), bool), "bool")
-        if op == "IS_NOT_NULL":
-            return (jnp.logical_not(n), jnp.zeros(jnp.shape(n), bool), "bool")
-        if op == "NOT":
-            if k != "bool":
-                raise CannotCompile("NOT on non-bool")
-            return (jnp.logical_not(v), n, "bool")
-        if op == "-":
-            if k not in _NUMERIC:
-                raise CannotCompile("negate non-numeric")
-            return (-v, n, k)
-        if op == "+":
-            if k not in _NUMERIC:
-                raise CannotCompile("+x non-numeric")
-            return (v, n, k)
-        raise CannotCompile(f"unary {op}")
-    return g
-
-
-def _coerce_pair(av, ak, bv, bk):
-    """Numeric promotion for mixed int/float operands."""
-    if ak == bk:
-        return av, bv, ak
-    if set((ak, bk)) == {"int", "float"}:
-        return (av.astype(jnp.float64) if ak == "int" else av,
-                bv.astype(jnp.float64) if bk == "int" else bv, "float")
-    raise CannotCompile(f"type mix {ak}/{bk}")
-
-
-def _binary(op: str, fa, fb) -> Callable[[Dict[str, Any]], Term]:
-    def g(c):
-        av, an, ak = fa(c)
-        bv, bn, bk = fb(c)
-        if op in _LOGIC_OPS:
-            if ak != "bool" or bk != "bool":
-                raise CannotCompile("logic on non-bool")
-            if op == "AND":
-                is_false = (~an & ~av) | (~bn & ~bv)
-                val = ~is_false
-                null = ~is_false & (an | bn)
-                return (val & ~null, null, "bool")
-            if op == "OR":
-                is_true = (~an & av) | (~bn & bv)
-                null = ~is_true & (an | bn)
-                return (is_true, null, "bool")
-            # XOR
-            return (jnp.logical_xor(av, bv), an | bn, "bool")
-        if op in _CMP_OPS:
-            null = an | bn
-            if "str" in (ak, bk) or "bool" in (ak, bk) or "geo" in (ak, bk):
-                if ak != bk:
-                    raise CannotCompile(f"compare {ak} vs {bk}")
-                if op not in ("==", "!="):
-                    # dict codes are insertion-ordered, not value-ordered
-                    raise CannotCompile(f"ordering on {ak}")
-                val = (av == bv) if op == "==" else (av != bv)
-                return (val, null, "bool")
-            a2, b2, _ = _coerce_pair(av, ak, bv, bk)
-            val = {"==": a2 == b2, "!=": a2 != b2, "<": a2 < b2,
-                   "<=": a2 <= b2, ">": a2 > b2, ">=": a2 >= b2}[op]
-            return (val, null, "bool")
-        if op in _ARITH_OPS:
-            if ak not in _NUMERIC or bk not in _NUMERIC:
-                raise CannotCompile(f"arith on {ak}/{bk}")
-            a2, b2, k = _coerce_pair(av, ak, bv, bk)
-            null = an | bn
-            if op == "+":
-                return (a2 + b2, null, k)
+    def _unary(op: str, f) -> Callable[[Dict[str, Any]], Term]:
+        def g(c):
+            v, n, k = f(c)
+            if op == "IS_NULL":
+                return (n, xp.zeros(xp.shape(n), bool), "bool")
+            if op == "IS_NOT_NULL":
+                return (xp.logical_not(n), xp.zeros(xp.shape(n), bool),
+                        "bool")
+            if op == "NOT":
+                if k != "bool":
+                    raise CannotCompile("NOT on non-bool")
+                return (xp.logical_not(v), n, "bool")
             if op == "-":
-                return (a2 - b2, null, k)
-            if op == "*":
-                return (a2 * b2, null, k)
-            if op == "/":
+                if k not in _NUMERIC:
+                    raise CannotCompile("negate non-numeric")
+                return (-v, n, k)
+            if op == "+":
+                if k not in _NUMERIC:
+                    raise CannotCompile("+x non-numeric")
+                return (v, n, k)
+            raise CannotCompile(f"unary {op}")
+        return g
+
+    def _coerce_pair(av, ak, bv, bk):
+        """Numeric promotion for mixed int/float operands."""
+        if ak == bk:
+            return av, bv, ak
+        if set((ak, bk)) == {"int", "float"}:
+            return (av.astype(xp.float64) if ak == "int" else av,
+                    bv.astype(xp.float64) if bk == "int" else bv, "float")
+        raise CannotCompile(f"type mix {ak}/{bk}")
+
+    def _binary(op: str, fa, fb) -> Callable[[Dict[str, Any]], Term]:
+        def g(c):
+            av, an, ak = fa(c)
+            bv, bn, bk = fb(c)
+            if op in _LOGIC_OPS:
+                if ak != "bool" or bk != "bool":
+                    raise CannotCompile("logic on non-bool")
+                if op == "AND":
+                    is_false = (~an & ~av) | (~bn & ~bv)
+                    val = ~is_false
+                    null = ~is_false & (an | bn)
+                    return (val & ~null, null, "bool")
+                if op == "OR":
+                    is_true = (~an & av) | (~bn & bv)
+                    null = ~is_true & (an | bn)
+                    return (is_true, null, "bool")
+                # XOR
+                return (xp.logical_xor(av, bv), an | bn, "bool")
+            if op in _CMP_OPS:
+                null = an | bn
+                if "str" in (ak, bk) or "bool" in (ak, bk) or "geo" in (ak, bk):
+                    if ak != bk:
+                        raise CannotCompile(f"compare {ak} vs {bk}")
+                    if op not in ("==", "!="):
+                        # dict codes are insertion-ordered, not value-ordered
+                        raise CannotCompile(f"ordering on {ak}")
+                    val = (av == bv) if op == "==" else (av != bv)
+                    return (val, null, "bool")
+                a2, b2, _ = _coerce_pair(av, ak, bv, bk)
+                val = {"==": a2 == b2, "!=": a2 != b2, "<": a2 < b2,
+                       "<=": a2 <= b2, ">": a2 > b2, ">=": a2 >= b2}[op]
+                return (val, null, "bool")
+            if op in _ARITH_OPS:
+                if ak not in _NUMERIC or bk not in _NUMERIC:
+                    raise CannotCompile(f"arith on {ak}/{bk}")
+                a2, b2, k = _coerce_pair(av, ak, bv, bk)
+                null = an | bn
+                if op == "+":
+                    return (a2 + b2, null, k)
+                if op == "-":
+                    return (a2 - b2, null, k)
+                if op == "*":
+                    return (a2 * b2, null, k)
+                if op == "/":
+                    null = null | (b2 == 0)
+                    safe = xp.where(b2 == 0, xp.ones((), b2.dtype), b2)
+                    if k == "int":
+                        # host semantics: truncation toward zero
+                        q = xp.abs(a2) // xp.abs(safe)
+                        sign = xp.where((a2 >= 0) == (safe >= 0), 1, -1)
+                        return (q * sign, null, "int")
+                    return (a2 / safe, null, "float")
+                # %
                 null = null | (b2 == 0)
-                safe = jnp.where(b2 == 0, jnp.ones((), b2.dtype), b2)
+                safe = xp.where(b2 == 0, xp.ones((), b2.dtype), b2)
                 if k == "int":
-                    # host semantics: truncation toward zero
-                    q = jnp.abs(a2) // jnp.abs(safe)
-                    sign = jnp.where((a2 >= 0) == (safe >= 0), 1, -1)
-                    return (q * sign, null, "int")
-                return (a2 / safe, null, "float")
-            # %
-            null = null | (b2 == 0)
-            safe = jnp.where(b2 == 0, jnp.ones((), b2.dtype), b2)
-            if k == "int":
-                # host v_mod: sign follows the dividend (C fmod style)
-                r = jnp.abs(a2) % jnp.abs(safe)
-                return (jnp.where(a2 >= 0, r, -r), null, "int")
-            return (jnp.where(jnp.signbit(a2),
-                              -(jnp.abs(a2) % jnp.abs(safe)),
-                              jnp.abs(a2) % jnp.abs(safe)), null, "float")
-        raise CannotCompile(f"binary {op}")
-    return g
+                    # host v_mod: sign follows the dividend (C fmod style)
+                    r = xp.abs(a2) % xp.abs(safe)
+                    return (xp.where(a2 >= 0, r, -r), null, "int")
+                return (xp.where(xp.signbit(a2),
+                                 -(xp.abs(a2) % xp.abs(safe)),
+                                 xp.abs(a2) % xp.abs(safe)), null, "float")
+            raise CannotCompile(f"binary {op}")
+        return g
 
-
-def _in_list(fa, items: List[Any], pool: StringPool,
-             negate: bool) -> Callable[[Dict[str, Any]], Term]:
-    def g(c):
-        av, an, ak = fa(c)
-        any_true = jnp.zeros(jnp.shape(av), bool)
-        any_null = jnp.zeros(jnp.shape(av), bool)
-        for it in items:
-            if it is None or isinstance(it, NullValue):
-                any_null = jnp.ones(jnp.shape(av), bool)
-                continue
-            # type-mismatched items yield NULL from v_eq on the host (not
-            # False), so anything not exactly comparable must fall back
-            if isinstance(it, bool):
-                if ak != "bool":
-                    raise CannotCompile("IN bool item vs non-bool")
-                any_true = any_true | (av == it)
-            elif isinstance(it, int):
-                if ak not in _NUMERIC or not (-(1 << 63) <= it < (1 << 63)):
-                    raise CannotCompile("IN int item vs non-numeric")
-                if ak == "int":
+    def _in_list(fa, items: List[Any], pool: StringPool,
+                 negate: bool) -> Callable[[Dict[str, Any]], Term]:
+        def g(c):
+            av, an, ak = fa(c)
+            any_true = xp.zeros(xp.shape(av), bool)
+            any_null = xp.zeros(xp.shape(av), bool)
+            for it in items:
+                if it is None or isinstance(it, NullValue):
+                    any_null = xp.ones(xp.shape(av), bool)
+                    continue
+                # type-mismatched items yield NULL from v_eq on the host
+                # (not False), so anything not exactly comparable must
+                # fall back
+                if isinstance(it, bool):
+                    if ak != "bool":
+                        raise CannotCompile("IN bool item vs non-bool")
                     any_true = any_true | (av == it)
+                elif isinstance(it, int):
+                    if ak not in _NUMERIC \
+                            or not (-(1 << 63) <= it < (1 << 63)):
+                        raise CannotCompile("IN int item vs non-numeric")
+                    if ak == "int":
+                        any_true = any_true | (av == it)
+                    else:
+                        any_true = any_true | (av == float(it))
+                elif isinstance(it, float):
+                    if ak not in _NUMERIC:
+                        raise CannotCompile("IN float item vs non-numeric")
+                    any_true = any_true | (av.astype(xp.float64) == it)
+                elif isinstance(it, str):
+                    if ak != "str":
+                        raise CannotCompile("IN str item vs non-string")
+                    any_true = any_true | (av == pool.lookup(it))
                 else:
-                    any_true = any_true | (av == float(it))
-            elif isinstance(it, float):
-                if ak not in _NUMERIC:
-                    raise CannotCompile("IN float item vs non-numeric")
-                any_true = any_true | (av.astype(jnp.float64) == it)
-            elif isinstance(it, str):
-                if ak != "str":
-                    raise CannotCompile("IN str item vs non-string")
-                any_true = any_true | (av == pool.lookup(it))
-            else:
-                raise CannotCompile(f"IN item {type(it)}")
-        val = any_true
-        null = an | (~any_true & any_null)
-        if negate:
-            return (~val & ~null, null, "bool")
-        return (val & ~null, null, "bool")
-    return g
+                    raise CannotCompile(f"IN item {type(it)}")
+            val = any_true
+            null = an | (~any_true & any_null)
+            if negate:
+                return (~val & ~null, null, "bool")
+            return (val & ~null, null, "bool")
+        return g
+
+    return _lit, _unary, _coerce_pair, _binary, _in_list
+
+
+_lit, _unary, _coerce_pair, _binary, _in_list = _term_alg(jnp)
+_np_lit, _np_unary, _np_coerce_pair, _np_binary, _np_in_list = _term_alg(np)
+
+
+# ---------------------------------------------------------------------------
+# Vertex-predicate compiler (numpy, host plane)
+# ---------------------------------------------------------------------------
+#
+# The fused MATCH pipeline (tpu/match_agg.py) evaluates AppendVertices
+# filters — `_hastag(v, "Tag")`, `v.Tag.prop > x`, compositions — as ONE
+# numpy mask over the snapshot's TagTable columns instead of per-row
+# Python `Expr.eval` over built Vertex objects.  Same Term algebra as
+# the in-kernel predicate compiler (shared `_term_alg`), numpy-bound so
+# a host-side mask never dispatches to the device.
+
+
+def _vertex_ref(x: "E.Expr", alias: str):
+    """Classify a vertex-alias reference.  Returns ("prop", tag, prop) |
+    ("hastag", tag) | None; raises CannotCompile on a reference to a
+    DIFFERENT alias (the caller's filter must be single-alias)."""
+    if isinstance(x, E.LabelTagProp):
+        if x.var != alias:
+            raise CannotCompile(f"prop of other alias {x.var}")
+        return ("prop", x.tag, x.prop)
+    if (isinstance(x, E.FunctionCall) and x.name == "_hastag"
+            and len(x.args) == 2 and isinstance(x.args[0], E.LabelExpr)
+            and isinstance(x.args[1], E.Literal)
+            and isinstance(x.args[1].value, str)):
+        if x.args[0].name != alias:
+            raise CannotCompile(f"_hastag of other alias {x.args[0].name}")
+        return ("hastag", x.args[1].value)
+    return None
+
+
+def _vertex_id_ref(x: "E.Expr", alias: str) -> bool:
+    """True iff x is id(<alias>)."""
+    return (isinstance(x, E.FunctionCall) and x.name == "id"
+            and len(x.args) == 1 and isinstance(x.args[0], E.LabelExpr)
+            and x.args[0].name == alias)
+
+
+def vertex_compilable(e: "E.Expr", alias: str) -> bool:
+    """Static gate: will compile_vertex_predicate_np succeed (given the
+    snapshot has the referenced tags)?  Conservative, schema-free."""
+    try:
+        _vertex_check(e, alias)
+        return True
+    except CannotCompile:
+        return False
+
+
+def _vertex_check(e: "E.Expr", alias: str):
+    if isinstance(e, E.Literal):
+        v = e.value
+        if v is None or isinstance(v, (bool, int, float, str, NullValue)):
+            return
+        raise CannotCompile(f"literal {type(v)}")
+    if _vertex_ref(e, alias) is not None:
+        return
+    if isinstance(e, E.Unary):
+        if e.op in ("NOT", "-", "+", "IS_NULL", "IS_NOT_NULL"):
+            _vertex_check(e.operand, alias)
+            return
+        raise CannotCompile(f"unary {e.op}")
+    if isinstance(e, E.Binary):
+        li, ri = _vertex_id_ref(e.lhs, alias), _vertex_id_ref(e.rhs, alias)
+        if li or ri:
+            if _id_pred_shape_ok(e, li, ri):
+                return
+            raise CannotCompile("id(v) only compiles vs non-null "
+                                "literal vids")
+        if e.op in _LOGIC_OPS + _CMP_OPS + _ARITH_OPS:
+            _vertex_check(e.lhs, alias)
+            _vertex_check(e.rhs, alias)
+            return
+        if e.op in ("IN", "NOT IN"):
+            _vertex_check(e.lhs, alias)
+            if not isinstance(e.rhs, (E.ListExpr, E.SetExpr)):
+                raise CannotCompile("IN rhs must be a literal list")
+            for item in e.rhs.items:
+                if not isinstance(item, E.Literal):
+                    raise CannotCompile("IN item not literal")
+            return
+        raise CannotCompile(f"binary {e.op}")
+    raise CannotCompile(f"expr kind {e.kind}")
+
+
+def compile_vertex_predicate_np(e: "E.Expr", alias: str, snap,
+                                sd) -> Callable[["np.ndarray"], "np.ndarray"]:
+    """Compile a single-alias vertex predicate against CsrSnapshot tag
+    tables.  Returns mask_fn(dense_ids) -> bool array: True where the
+    predicate is (non-null) true for the vertex with that dense id.
+
+    Tag-table null currency matches the edge plane: INT_NULL sentinel in
+    int-coded columns, NaN in floats — absent-tag rows carry the fill,
+    so `v.Tag.prop` on a vertex without Tag is NULL exactly like the
+    host's per-row lookup (core/expr.py LabelTagProp)."""
+    P = snap.num_parts
+    pool = snap.pool
+
+    def dense_of(v):
+        d = sd.dense_id(v)
+        return int(d) if d is not None else -1
+
+    def vid_cmp(op, values):
+        dv = [dense_of(x.value) for x in values]
+
+        def g(c):
+            ep = c["_dense"]
+            m = np.zeros(np.shape(ep), bool)
+            for d in dv:
+                m = m | (ep == d)
+            if op in ("!=", "NOT IN"):
+                m = np.logical_not(m)
+            return (m, np.zeros(np.shape(ep), bool), "bool")
+        return g
+
+    def build(x: "E.Expr"):
+        if isinstance(x, E.Binary):
+            li, ri = _vertex_id_ref(x.lhs, alias), _vertex_id_ref(x.rhs, alias)
+            if li or ri:
+                if x.op in ("==", "!="):
+                    lit = x.rhs if li else x.lhs
+                    if not isinstance(lit, E.Literal):
+                        raise CannotCompile("id(v) vs non-literal")
+                    return vid_cmp(x.op, [lit])
+                if x.op in ("IN", "NOT IN") and li:
+                    return vid_cmp(x.op, list(x.rhs.items))
+                raise CannotCompile("id(v) predicate shape")
+        if isinstance(x, E.Literal):
+            return _np_lit(x.value, pool)
+        ref = _vertex_ref(x, alias)
+        if ref is not None:
+            if ref[0] == "hastag":
+                tt = snap.tags.get(ref[1])
+                if tt is None:
+                    return lambda c: (np.zeros(np.shape(c["_dense"]), bool),
+                                      np.zeros(np.shape(c["_dense"]), bool),
+                                      "bool")
+                pres = tt.present
+
+                def g(c, pres=pres):
+                    d = c["_dense"]
+                    m = pres[d % P, d // P]
+                    return (m, np.zeros(m.shape, bool), "bool")
+                return g
+            _, tag, pname = ref
+            tt = snap.tags.get(tag)
+            if tt is None or pname not in tt.props:
+                # unknown tag/prop → NULL (host LabelTagProp: absent)
+                return lambda c: (np.zeros(np.shape(c["_dense"]), np.int64),
+                                  np.ones(np.shape(c["_dense"]), bool),
+                                  "int")
+            kind = _kind_of(tt.prop_types[pname])
+            col = tt.props[pname]
+
+            def g(c, col=col, kind=kind):
+                d = c["_dense"]
+                raw = col[d % P, d // P]
+                if kind == "float":
+                    return (raw, np.isnan(raw), "float")
+                if kind == "bool":
+                    return (raw != 0, raw == INT_NULL, "bool")
+                return (raw, raw == INT_NULL, kind)
+            return g
+        if isinstance(x, E.Unary):
+            return _np_unary(x.op, build(x.operand))
+        if isinstance(x, E.Binary):
+            if x.op in ("IN", "NOT IN"):
+                return _np_in_list(build(x.lhs),
+                                   [it.value for it in x.rhs.items],
+                                   pool, negate=x.op == "NOT IN")
+            return _np_binary(x.op, build(x.lhs), build(x.rhs))
+        raise CannotCompile(f"expr kind {x.kind}")
+
+    term = build(e)
+
+    def mask_fn(dense):
+        val, isnull, kind = term({"_dense": dense})
+        if kind != "bool":
+            return np.zeros(np.shape(dense), bool)
+        val = np.broadcast_to(val, np.shape(dense))
+        isnull = np.broadcast_to(isnull, np.shape(dense))
+        return np.logical_and(val, np.logical_not(isnull))
+
+    return mask_fn
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,639 @@
+"""TpuMatchAgg: fused fixed-length MATCH → aggregate device pipeline.
+
+The reference executes an IC-shaped aggregate MATCH —
+
+    MATCH (p)-[:E]->(f)-[:E]->(ff) WHERE <vertex preds>
+    RETURN id(ff), count(*)
+
+— as a chain of per-hop GetNeighbors RPC fan-outs with row-at-a-time
+filter/aggregate executors above them (reference: the Traverse /
+AppendVertices / Aggregate executor stack in src/graph/executor
+[UNVERIFIED — empty mount, SURVEY §0]).  Here the whole chain collapses
+into ONE plan node (SURVEY §2 rows 22–23):
+
+  * one multi-hop device expansion (`TpuRuntime.traverse_hops`) — the
+    frontier never leaves HBM between hops;
+  * columnar trail assembly on host numpy (the same searchsorted join
+    the unfused device Traverse uses, but never decoding Edge/Vertex
+    objects at all);
+  * vertex predicates (labels, `_hastag`, `v.Tag.prop` filters)
+    evaluated as numpy masks over the snapshot's TagTable columns
+    (exprjit.compile_vertex_predicate_np) — per POSITION in the
+    pattern, pruning trails hop-by-hop;
+  * relationship-uniqueness (`_edges_distinct`) enforced by the
+    assembly's columnar canonical-key compare — the planner's Filter
+    conjunct is absorbed, not re-checked per row;
+  * the aggregate itself is a numpy lexsort group-by: count(*) /
+    count(id(v)) / count(DISTINCT id(v)) over int64 dense-id columns.
+
+Python row objects are never built: the node's output is the final
+(tiny) aggregate table.  Anything the rule cannot prove — per-hop
+edge filters, non-id group keys, cross-alias predicates, aggregates
+beyond counts — leaves the plan unfused on the general executors, and
+any device-plane failure at run time falls back to `_host_match_agg`,
+a host implementation with the exact chain semantics.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..core import expr as E
+from ..core.value import DataSet, Vertex, is_null
+from ..exec.executors import executor, _make_edge
+from ..query import optimizer as opt
+from ..query.plan import PlanNode
+from .device import TpuUnavailable
+from .exprjit import (CannotCompile, compile_vertex_predicate_np,
+                      vertex_compilable)
+
+try:
+    import jax
+    _JAX_RT_ERRORS = (jax.errors.JaxRuntimeError,)
+except (ImportError, AttributeError):
+    _JAX_RT_ERRORS = ()
+
+
+# ---------------------------------------------------------------------------
+# Plan-shape helpers
+# ---------------------------------------------------------------------------
+
+
+def _split_and(e: E.Expr) -> List[E.Expr]:
+    if isinstance(e, E.Binary) and e.op == "AND":
+        return _split_and(e.lhs) + _split_and(e.rhs)
+    return [e]
+
+
+def _and_join(conjs: List[E.Expr]) -> Optional[E.Expr]:
+    if not conjs:
+        return None
+    out = conjs[0]
+    for c in conjs[1:]:
+        out = E.Binary("AND", out, c)
+    return out
+
+
+def _is_edges_distinct(e: E.Expr, edge_aliases: List[str]) -> bool:
+    return (isinstance(e, E.FunctionCall) and e.name == "_edges_distinct"
+            and all(isinstance(a, E.LabelExpr) for a in e.args)
+            and {a.name for a in e.args} == set(edge_aliases))
+
+
+def _id_alias(e: E.Expr) -> Optional[str]:
+    """alias for `id(<alias>)`, else None."""
+    if (isinstance(e, E.FunctionCall) and e.name == "id"
+            and len(e.args) == 1 and isinstance(e.args[0], E.LabelExpr)):
+        return e.args[0].name
+    return None
+
+
+def _head_hastag_tags(cond: E.Expr, alias: str) -> Optional[List[str]]:
+    """Filter over the seed GetVertices: AND of _hastag(alias, T) only."""
+    tags = []
+    for c in _split_and(cond):
+        if (isinstance(c, E.FunctionCall) and c.name == "_hastag"
+                and len(c.args) == 2 and isinstance(c.args[0], E.LabelExpr)
+                and c.args[0].name == alias
+                and isinstance(c.args[1], E.Literal)
+                and isinstance(c.args[1].value, str)):
+            tags.append(c.args[1].value)
+            continue
+        return None
+    return tags
+
+
+# ---------------------------------------------------------------------------
+# Fusion rule
+# ---------------------------------------------------------------------------
+
+
+def _single(uses: Dict[int, int], node: PlanNode) -> bool:
+    return uses.get(node.id, 2) == 1 and len(node.deps) == 1
+
+
+def make_match_agg_rule(uses: Dict[int, int]):
+    def rule(node: PlanNode) -> Optional[PlanNode]:
+        if node.kind != "Aggregate":
+            return None
+        if len(node.deps) != 1:
+            return None
+        cur = node.dep()
+        filt_conjs: List[E.Expr] = []
+        if cur.kind == "Filter":
+            if not _single(uses, cur):
+                return None
+            filt_conjs = _split_and(cur.args["condition"])
+            cur = cur.dep()
+        if cur.kind != "AppendVertices" or not _single(uses, cur):
+            return None
+        term = cur
+        term_alias = term.args["col"]
+        sp = term.args.get("space")
+        term_labels = list(term.args.get("labels") or [])
+        term_filter = term.args.get("filter")
+        if term_filter is not None \
+                and not vertex_compilable(term_filter, term_alias):
+            return None
+        cur = term.dep()
+
+        # walk the Traverse[←AppendVertices]←Traverse chain, outermost
+        # (= terminal hop) first; record which mid positions carry an
+        # AppendVertices (the host plane only existence-checks those)
+        hops_rev: List[PlanNode] = []
+        checked_aliases = set()
+        while cur.kind == "Traverse":
+            if not _single(uses, cur):
+                return None
+            a = cur.args
+            if a.get("min_hop") != 1 or a.get("max_hop") != 1:
+                return None
+            if a.get("edge_filter") is not None:
+                return None
+            if a.get("space") != sp:
+                return None
+            hops_rev.append(cur)
+            nxt = cur.dep()
+            if nxt.kind == "AppendVertices":
+                if not _single(uses, nxt):
+                    return None
+                if nxt.args.get("filter") is not None \
+                        or nxt.args.get("labels"):
+                    return None
+                if nxt.args.get("space") != sp:
+                    return None
+                if nxt.args.get("col") != a.get("src_col"):
+                    return None
+                checked_aliases.add(a.get("src_col"))
+                nxt = nxt.dep()
+                if nxt.kind != "Traverse":
+                    return None
+            cur = nxt
+        if not hops_rev:
+            return None
+        hops = hops_rev[::-1]
+        # chain wiring + uniform expansion parameters
+        etypes = hops[0].args.get("edge_types")
+        direction = hops[0].args.get("direction")
+        for i, h in enumerate(hops):
+            if h.args.get("edge_types") != etypes \
+                    or h.args.get("direction") != direction:
+                return None
+            if i > 0 and h.args.get("src_col") != hops[i - 1].args.get(
+                    "dst_alias"):
+                return None
+        if hops[-1].args.get("dst_alias") != term_alias:
+            return None
+
+        # chain head: optional label Filter over literal-vid GetVertices
+        head = cur
+        head_tags: List[str] = []
+        src_alias = hops[0].args.get("src_col")
+        if head.kind == "Filter":
+            if not _single(uses, head):
+                return None
+            tags = _head_hastag_tags(head.args["condition"], src_alias)
+            if tags is None:
+                return None
+            head_tags = tags
+            head = head.dep()
+        if head.kind != "GetVertices":
+            return None
+        if uses.get(head.id, 2) != 1 or head.deps:
+            return None
+        ha = head.args
+        if ha.get("src_col") or ha.get("tags") or ha.get("space") != sp:
+            return None
+        if (ha.get("as_col") or (head.col_names[0] if head.col_names
+                                 else None)) != src_alias:
+            return None
+        vids = ha.get("vids") or []
+        for v in vids:
+            if isinstance(v, E.Expr) and not isinstance(v, E.Literal):
+                return None
+
+        edge_aliases = [h.args.get("edge_alias") for h in hops]
+        vertex_aliases = [src_alias] + [h.args.get("dst_alias")
+                                        for h in hops]
+        if len(set(vertex_aliases)) != len(vertex_aliases):
+            # a cyclic pattern re-binds an alias: equality join between
+            # positions — not modeled here, stay on the general path
+            return None
+        checked_aliases.add(src_alias)       # GetVertices builds vertices
+        checked_aliases.add(term_alias)      # terminal AppendVertices
+
+        # classify residual Filter conjuncts: relationship uniqueness
+        # (absorbed into assembly) or a single-alias vertex predicate
+        # (absorbed into that pattern position).  A predicate may only
+        # land on a position whose vertex the host plane materialized
+        # (an unchecked mid carries a props-less shell Vertex, whose
+        # prop reads answer NULL — different semantics).
+        edges_distinct = False
+        alias_preds: Dict[str, List[E.Expr]] = {}
+        for cj in filt_conjs:
+            if _is_edges_distinct(cj, edge_aliases):
+                edges_distinct = True
+                continue
+            placed = False
+            for al in vertex_aliases:
+                if al in checked_aliases and vertex_compilable(cj, al):
+                    alias_preds.setdefault(al, []).append(cj)
+                    placed = True
+                    break
+            if not placed:
+                return None
+        if term_filter is not None:
+            alias_preds.setdefault(term_alias, []).append(term_filter)
+
+        # aggregate surface: id(alias) group keys, count aggregates
+        group_keys = node.args.get("group_keys") or []
+        group_aliases: List[str] = []
+        for gk in group_keys:
+            al = _id_alias(gk)
+            if al is None or al not in vertex_aliases:
+                return None
+            group_aliases.append(al)
+        agg_specs: List[Tuple] = []
+        key_texts = [E.to_text(gk) for gk in group_keys]
+        for ce, _name in node.args.get("columns") or []:
+            if isinstance(ce, E.AggExpr):
+                if ce.func != "count":
+                    return None
+                if ce.arg is None:
+                    agg_specs.append(("count", None, False))
+                    continue
+                al = _id_alias(ce.arg)
+                if al is None or al not in vertex_aliases:
+                    return None
+                agg_specs.append(("count", al, bool(ce.distinct)))
+                continue
+            txt = E.to_text(ce)
+            if txt in key_texts:
+                agg_specs.append(("key", group_aliases[key_texts.index(txt)]))
+                continue
+            return None
+
+        return PlanNode(
+            "TpuMatchAgg", deps=[],
+            args={"space": sp, "vids": list(vids), "src_alias": src_alias,
+                  "etypes": list(etypes or []), "direction": direction,
+                  "steps": len(hops),
+                  "vertex_aliases": vertex_aliases,
+                  "checked_aliases": sorted(checked_aliases),
+                  "head_tags": head_tags,
+                  "term_labels": term_labels,
+                  "alias_preds": {al: _and_join(ps)
+                                  for al, ps in alias_preds.items()},
+                  "edges_distinct": edges_distinct,
+                  "group_aliases": group_aliases,
+                  "agg_specs": agg_specs},
+            col_names=list(node.col_names))
+
+    return rule
+
+
+opt.TPU_RULES.append(make_match_agg_rule)
+
+
+# ---------------------------------------------------------------------------
+# Executor — device plane
+# ---------------------------------------------------------------------------
+
+
+def _seed_vids(a: Dict[str, Any]) -> List[Any]:
+    from ..core.expr import DictContext
+    from ..core.value import hashable_key
+    out, seen = [], set()
+    for ve in a.get("vids") or []:
+        v = ve.eval(DictContext()) if isinstance(ve, E.Expr) else ve
+        if isinstance(v, Vertex):
+            v = v.vid
+        if is_null(v):
+            continue
+        k = hashable_key(v)
+        if k in seen:
+            continue
+        seen.add(k)
+        out.append(v)
+    return out
+
+
+def _exists_flat(snap) -> np.ndarray:
+    """dense-indexed 'vertex exists' mask (any tag present, mirroring
+    build_vertex returning None for tag-less vids); cached on the
+    snapshot (epoch-keyed object, so the cache dies with the epoch)."""
+    m = getattr(snap, "_exists_flat", None)
+    if m is None:
+        P = snap.num_parts
+        m = np.zeros(P * snap.vmax, bool)
+        for tt in snap.tags.values():
+            m |= tt.present.T.ravel()
+        try:
+            snap._exists_flat = m
+        except AttributeError:
+            pass
+    return m
+
+
+def _tag_flat(snap, tag: str) -> Optional[np.ndarray]:
+    tt = snap.tags.get(tag)
+    return None if tt is None else tt.present.T.ravel()
+
+
+def _position_mask(dense: np.ndarray, alias: str, a: Dict[str, Any],
+                   snap, sd) -> np.ndarray:
+    """Combined existence + label + predicate mask for one pattern
+    position.  Positions without an AppendVertices in the unfused plan
+    are never existence-checked by the host plane, so they aren't here
+    either (parity over dangling edges)."""
+    if alias in (a.get("checked_aliases") or ()):
+        m = _exists_flat(snap)[dense]
+    else:
+        m = np.ones(dense.shape, bool)
+    labels = a["term_labels"] if alias == a["vertex_aliases"][-1] else []
+    for lb in labels:
+        tf = _tag_flat(snap, lb)
+        if tf is None:
+            return np.zeros(dense.shape, bool)
+        m &= tf[dense]
+    pred = (a.get("alias_preds") or {}).get(alias)
+    if pred is not None:
+        mask_fn = compile_vertex_predicate_np(pred, alias, snap, sd)
+        m &= mask_fn(dense)
+    return m
+
+
+def _group_rows(a: Dict[str, Any], vcols: List[np.ndarray],
+                d2v: np.ndarray) -> List[List[Any]]:
+    """numpy lexsort group-by over dense-id key columns → output rows."""
+    alias_ix = {al: i for i, al in enumerate(a["vertex_aliases"])}
+    n = vcols[0].size if vcols else 0
+    group_aliases = a["group_aliases"]
+    agg_specs = a["agg_specs"]
+
+    if not group_aliases:
+        row = []
+        for spec in agg_specs:
+            if spec[1] is None or not spec[2]:
+                row.append(int(n))
+            else:
+                col = vcols[alias_ix[spec[1]]]
+                row.append(int(np.unique(col).size) if n else 0)
+        return [row]
+
+    if n == 0:
+        return []
+    keys = [vcols[alias_ix[al]] for al in group_aliases]
+    order = np.lexsort(keys[::-1])
+    sk = [k[order] for k in keys]
+    new_grp = np.zeros(n, bool)
+    new_grp[0] = True
+    for k in sk:
+        new_grp[1:] |= k[1:] != k[:-1]
+    starts = np.flatnonzero(new_grp)
+    sizes = np.diff(np.concatenate([starts, [n]]))
+    gid = np.cumsum(new_grp) - 1          # group id per sorted trail
+
+    out_cols: List[Any] = []
+    for spec in agg_specs:
+        if spec[0] == "key":
+            out_cols.append(d2v[sk[group_aliases.index(spec[1])][starts]])
+        elif spec[1] is None or not spec[2]:
+            out_cols.append(sizes)
+        else:
+            tcol = vcols[alias_ix[spec[1]]][order]
+            o2 = np.lexsort((tcol, gid))
+            g2, t2 = gid[o2], tcol[o2]
+            first = np.ones(n, bool)
+            first[1:] = (g2[1:] != g2[:-1]) | (t2[1:] != t2[:-1])
+            out_cols.append(np.bincount(g2[first],
+                                        minlength=starts.size))
+    rows = []
+    cols_py = [c.tolist() for c in out_cols]
+    for i in range(starts.size):
+        rows.append([c[i] for c in cols_py])
+    return rows
+
+
+@executor("TpuMatchAgg")
+def _tpu_match_agg(node, qctx, ectx, space):
+    a = node.args
+    rt = getattr(qctx, "tpu_runtime", None)
+    if rt is not None:
+        from ..utils.config import get_config
+        if get_config().get("tpu_match_device"):
+            try:
+                return _device_match_agg(node, qctx, ectx, a, rt)
+            except (CannotCompile, TpuUnavailable) + _JAX_RT_ERRORS as ex:
+                qctx.last_tpu_fallback = f"{type(ex).__name__}: {ex}"
+    return _host_match_agg(node, qctx, a)
+
+
+def _device_match_agg(node, qctx, ectx, a, rt):
+    from .runtime import _d2v, join_frontier_trails, trail_distinct_keep
+    sp = a["space"]
+    store = qctx.store
+    try:
+        sd = store.space(sp)
+        sd.dense_id
+    except AttributeError:
+        raise TpuUnavailable("store has no dense-id surface")
+
+    dev = rt.pin(store, sp)
+    snap = dev.host
+    steps = a["steps"]
+    src_alias = a["src_alias"]
+
+    vids = _seed_vids(a)
+    dense = np.asarray([sd.dense_id(v) for v in vids], np.int64) \
+        if vids else np.empty(0, np.int64)
+    keep_vids: List[Any] = []
+    if dense.size:
+        m = dense >= 0
+        if m.any():
+            d = dense[m]
+            pm = _exists_flat(snap)[d]
+            for tg in a.get("head_tags") or []:
+                tf = _tag_flat(snap, tg)
+                pm &= tf[d] if tf is not None else False
+            pred = (a.get("alias_preds") or {}).get(src_alias)
+            if pred is not None:
+                pm &= compile_vertex_predicate_np(pred, src_alias, snap,
+                                                  sd)(d)
+            kept = d[pm]
+            kv = np.asarray(vids, object)[m][pm]
+            keep_vids = kv.tolist()
+            dense = kept
+        else:
+            dense = np.empty(0, np.int64)
+
+    if not keep_vids:
+        return DataSet(list(node.col_names),
+                       _group_rows(a, [np.empty(0, np.int64)]
+                                   * len(a["vertex_aliases"]), None)
+                       if not a["group_aliases"] else [])
+
+    frames, stats = rt.traverse_hops(store, sp, keep_vids, a["etypes"],
+                                     a["direction"], steps)
+    qctx.last_tpu_stats = stats
+
+    vcols: List[np.ndarray] = [dense]
+    path: List[np.ndarray] = []
+    alive = True
+    for h in range(steps):
+        fr = frames[h]
+        if vcols[0].size == 0 or fr.n == 0:
+            alive = False
+            break
+        parent, fidx = join_frontier_trails(fr, vcols[-1])
+        if fidx.size == 0:
+            alive = False
+            break
+        if a["edges_distinct"] and path:
+            keep = trail_distinct_keep(frames, path, parent, fr, fidx)
+            sel = np.flatnonzero(keep)
+            parent, fidx = parent[sel], fidx[sel]
+        nxt = fr.dst[fidx]
+        al = a["vertex_aliases"][h + 1]
+        pm = _position_mask(nxt, al, a, snap, sd)
+        if pm is not None and not pm.all():
+            sel = np.flatnonzero(pm)
+            parent, fidx, nxt = parent[sel], fidx[sel], nxt[sel]
+        vcols = [c[parent] for c in vcols] + [nxt]
+        path = [pe[parent] for pe in path] + [fidx]
+        if vcols[0].size == 0:
+            alive = False
+            break
+
+    if not alive:
+        vcols = [np.empty(0, np.int64)] * len(a["vertex_aliases"])
+
+    tracker = getattr(ectx, "tracker", None)
+    if tracker is not None and vcols[0].size:
+        tracker.charge(int(vcols[0].size) * 8 * (steps + 1))
+
+    d2v = _d2v(snap)
+    return DataSet(list(node.col_names), _group_rows(a, vcols, d2v))
+
+
+# ---------------------------------------------------------------------------
+# Host fallback — exact chain semantics, no device
+# ---------------------------------------------------------------------------
+
+
+def _host_match_agg(node, qctx, a):
+    from ..core.expr import to_bool3
+    from ..core.value import hashable_key
+    from ..exec.context import RowContext
+
+    sp = a["space"]
+    store = qctx.store
+    steps = a["steps"]
+    etypes = a["etypes"]
+    etype_ids = {e: store.catalog.get_edge(sp, e).edge_type for e in etypes}
+    direction = a["direction"]
+    aliases = a["vertex_aliases"]
+    alias_preds = a.get("alias_preds") or {}
+    term_alias = aliases[-1]
+
+    vcache: Dict[Any, Optional[Vertex]] = {}
+
+    def vertex_of(vid):
+        if vid not in vcache:
+            vcache[vid] = qctx.build_vertex(sp, vid)
+        return vcache[vid]
+
+    vd_cache: Dict[Tuple[str, Any], bool] = {}
+
+    checked = set(a.get("checked_aliases") or ())
+
+    def position_ok(alias: str, vid) -> bool:
+        key = (alias, hashable_key(vid))
+        v = vd_cache.get(key)
+        if v is None:
+            if alias not in checked:
+                vd_cache[key] = v = True
+                return v
+            full = vertex_of(vid)
+            ok = full is not None
+            if ok and alias == term_alias:
+                ok = all(lb in full.tag_names()
+                         for lb in a.get("term_labels") or [])
+            if ok and alias == aliases[0]:
+                ok = all(tg in full.tag_names()
+                         for tg in a.get("head_tags") or [])
+            pred = alias_preds.get(alias)
+            if ok and pred is not None:
+                rc = RowContext(qctx, sp, {alias: full})
+                ok = to_bool3(pred.eval(rc)) is True
+            vd_cache[key] = v = ok
+        return v
+
+    groups: Dict[Tuple, Dict[str, Any]] = {}
+    order: List[Tuple] = []
+    alias_ix = {al: i for i, al in enumerate(aliases)}
+    group_aliases = a["group_aliases"]
+    agg_specs = a["agg_specs"]
+    n_trails = 0
+
+    def emit(trail_vids: List[Any]):
+        nonlocal n_trails
+        n_trails += 1
+        key = tuple(hashable_key(trail_vids[alias_ix[al]])
+                    for al in group_aliases)
+        g = groups.get(key)
+        if g is None:
+            g = groups[key] = {"vids": [trail_vids[alias_ix[al]]
+                                        for al in group_aliases],
+                               "n": 0,
+                               "sets": [set() for _ in agg_specs]}
+            order.append(key)
+        g["n"] += 1
+        for i, spec in enumerate(agg_specs):
+            if spec[0] == "count" and spec[1] is not None and spec[2]:
+                g["sets"][i].add(hashable_key(trail_vids[alias_ix[spec[1]]]))
+
+    def dfs(vid, depth: int, trail: List[Any], eseen: set):
+        if depth == steps:
+            emit(list(trail))
+            return
+        for (s, et, rank, other, props, sgn) in store.get_neighbors(
+                sp, [vid], etypes, direction):
+            e = _make_edge(s, other, et, rank, props, sgn, etype_ids[et])
+            ek = e.key()
+            if a["edges_distinct"] and ek in eseen:
+                continue
+            if not position_ok(aliases[depth + 1], other):
+                continue
+            trail.append(other)
+            if a["edges_distinct"]:
+                eseen.add(ek)
+            dfs(other, depth + 1, trail, eseen)
+            if a["edges_distinct"]:
+                eseen.discard(ek)
+            trail.pop()
+
+    for vid in _seed_vids(a):
+        if not position_ok(aliases[0], vid):
+            continue
+        dfs(vid, 0, [vid], set())
+
+    rows: List[List[Any]] = []
+    if not order and not group_aliases:
+        row = []
+        for spec in agg_specs:
+            row.append(0)
+        return DataSet(list(node.col_names), [row])
+    for key in order:
+        g = groups[key]
+        row: List[Any] = []
+        for i, spec in enumerate(agg_specs):
+            if spec[0] == "key":
+                row.append(g["vids"][group_aliases.index(spec[1])])
+            elif spec[1] is not None and spec[2]:
+                row.append(len(g["sets"][i]))
+            else:
+                row.append(g["n"])
+        rows.append(row)
+    return DataSet(list(node.col_names), rows)

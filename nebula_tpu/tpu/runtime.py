@@ -260,6 +260,48 @@ class HopFrame:
         return self._eobjs
 
 
+def join_frontier_trails(fr: "HopFrame", last: np.ndarray
+                         ) -> Tuple[np.ndarray, np.ndarray]:
+    """One searchsorted join of per-trail endpoints against a frame's
+    src index.  Returns (parent, fidx): for every (trail, edge)
+    continuation, the trail's index into `last` and the frame entry —
+    in frame CSR order within each trail.  Shared by the unfused MATCH
+    Traverse executor and the fused TpuMatchAgg assembly (single
+    source for the join's edge cases)."""
+    us, ustart, ucnt = fr.src_slices()
+    p = np.searchsorted(us, last)
+    p = np.minimum(p, max(us.size - 1, 0))
+    hit = us[p] == last
+    cnt = np.where(hit, ucnt[p], 0)
+    start = np.where(hit, ustart[p], 0)
+    ends = np.cumsum(cnt)
+    total = int(ends[-1]) if cnt.size else 0
+    if total == 0:
+        return (np.empty(0, np.int64), np.empty(0, np.int64))
+    k = np.arange(total, dtype=np.int64)
+    parent = np.searchsorted(ends, k, side="right")
+    within = k - (ends[parent] - cnt[parent])
+    fidx = fr.order[start[parent] + within]
+    return parent, fidx
+
+
+def trail_distinct_keep(frames: List["HopFrame"], path: List[np.ndarray],
+                        parent: np.ndarray, fr: "HopFrame",
+                        fidx: np.ndarray) -> np.ndarray:
+    """Relationship-uniqueness mask: for each candidate continuation,
+    compare the new edge's canonical key against every earlier hop of
+    its trail (componentwise over the frames' key columns)."""
+    keep = np.ones(fidx.size, bool)
+    for eh, pe in enumerate(path):
+        pf = frames[eh]
+        pidx = pe[parent]
+        keep &= ~((pf.key_et[pidx] == fr.key_et[fidx])
+                  & (pf.key_s[pidx] == fr.key_s[fidx])
+                  & (pf.key_d[pidx] == fr.key_d[fidx])
+                  & (pf.rank[pidx] == fr.rank[fidx]))
+    return keep
+
+
 class TpuRuntime:
     """One per process; holds the mesh and all pinned spaces."""
 

@@ -1,0 +1,148 @@
+Feature: Fixed-length MATCH with aggregates (fused device pipeline shapes)
+
+  The device leg executes these through the fused TpuMatchAgg node
+  (tpu/match_agg.py); the host leg through the general executor chain.
+  Identical tables on both legs are the parity gate for the fusion.
+
+  Background:
+    Given having executed:
+      """
+      CREATE SPACE ma(partition_num=8, vid_type=INT64);
+      USE ma;
+      CREATE TAG Person(age int, name string);
+      CREATE TAG City(pop int);
+      CREATE EDGE KNOWS(w int);
+      INSERT VERTEX Person(age, name) VALUES 1:(28, "ann"), 2:(35, "bob"), 3:(47, "cat"), 4:(19, "dan"), 5:(52, "eve"), 6:(31, "fox");
+      INSERT VERTEX City(pop) VALUES 100:(9000);
+      INSERT EDGE KNOWS(w) VALUES 1->2:(1), 1->3:(2), 2->3:(3), 2->4:(1), 3->5:(2), 4->5:(9), 5->6:(4), 6->1:(7), 3->100:(1), 2->2:(5)
+      """
+
+  Scenario: two-hop count grouped by terminal id
+    When executing query:
+      """
+      MATCH (p:Person)-[:KNOWS]->(f)-[:KNOWS]->(ff:Person)
+      WHERE id(p) IN [1, 2]
+      RETURN id(ff) AS v, count(*) AS c
+      """
+    Then the result should be, in any order:
+      | v | c |
+      | 2 | 1 |
+      | 3 | 2 |
+      | 4 | 2 |
+      | 5 | 3 |
+
+  Scenario: terminal property predicate prunes groups
+    When executing query:
+      """
+      MATCH (p:Person)-[:KNOWS]->(f)-[:KNOWS]->(ff:Person)
+      WHERE id(p) IN [1, 2] AND ff.Person.age > 30
+      RETURN id(ff) AS v, count(*) AS c
+      """
+    Then the result should be, in any order:
+      | v | c |
+      | 2 | 1 |
+      | 3 | 2 |
+      | 5 | 3 |
+
+  Scenario: global aggregate with DISTINCT over two positions
+    When executing query:
+      """
+      MATCH (p:Person)-[:KNOWS]->(f)-[:KNOWS]->(ff)
+      WHERE id(p) IN [1, 2]
+      RETURN count(*) AS c, count(DISTINCT id(ff)) AS d, count(DISTINCT id(f)) AS m
+      """
+    Then the result should be, in any order:
+      | c | d | m |
+      | 10 | 5 | 3 |
+
+  Scenario: terminal label drops the City terminal
+    When executing query:
+      """
+      MATCH (p:Person)-[:KNOWS]->(ff:Person) WHERE id(p) IN [3]
+      RETURN id(ff) AS v, count(*) AS c
+      """
+    Then the result should be, in any order:
+      | v | c |
+      | 5 | 1 |
+
+  Scenario: a self-loop edge binds only once per trail
+    When executing query:
+      """
+      MATCH (a:Person)-[:KNOWS]->(b)-[:KNOWS]->(c) WHERE id(a) IN [2]
+      RETURN id(b) AS mid, id(c) AS v, count(*) AS c
+      """
+    Then the result should be, in any order:
+      | mid | v   | c |
+      | 2   | 3   | 1 |
+      | 2   | 4   | 1 |
+      | 3   | 5   | 1 |
+      | 3   | 100 | 1 |
+      | 4   | 5   | 1 |
+
+  Scenario: source-side property predicate beyond the seed list
+    When executing query:
+      """
+      MATCH (p:Person)-[:KNOWS]->(ff)
+      WHERE id(p) IN [1, 2, 3] AND p.Person.age < 40
+      RETURN id(ff) AS v, count(*) AS c
+      """
+    Then the result should be, in any order:
+      | v | c |
+      | 2 | 2 |
+      | 3 | 2 |
+      | 4 | 1 |
+
+  Scenario: unknown and duplicate seeds collapse
+    When executing query:
+      """
+      MATCH (p:Person)-[:KNOWS]->(ff) WHERE id(p) IN [1, 1, 999]
+      RETURN id(ff) AS v, count(*) AS c
+      """
+    Then the result should be, in any order:
+      | v | c |
+      | 2 | 1 |
+      | 3 | 1 |
+
+  Scenario: empty seed set with a global count answers zero
+    When executing query:
+      """
+      MATCH (p:Person)-[:KNOWS]->(ff) WHERE id(p) IN [999]
+      RETURN count(*) AS c
+      """
+    Then the result should be, in any order:
+      | c |
+      | 0 |
+
+  Scenario: empty seed set with group keys answers no rows
+    When executing query:
+      """
+      MATCH (p:Person)-[:KNOWS]->(ff) WHERE id(p) IN [999]
+      RETURN id(ff) AS v, count(*) AS c
+      """
+    Then the result should be, in any order:
+      | v | c |
+
+  Scenario: three hops grouped by a mid-pattern vertex
+    When executing query:
+      """
+      MATCH (a:Person)-[:KNOWS]->(b)-[:KNOWS]->(c)-[:KNOWS]->(d)
+      WHERE id(a) IN [1]
+      RETURN id(c) AS v, count(*) AS c
+      """
+    Then the result should be, in any order:
+      | v | c |
+      | 2 | 2 |
+      | 3 | 2 |
+      | 4 | 1 |
+      | 5 | 1 |
+
+  Scenario: string name equality on the terminal
+    When executing query:
+      """
+      MATCH (p:Person)-[:KNOWS]->(f)-[:KNOWS]->(ff:Person)
+      WHERE id(p) IN [1, 2, 4, 6] AND ff.Person.name == "eve"
+      RETURN id(ff) AS v, count(*) AS c
+      """
+    Then the result should be, in any order:
+      | v | c |
+      | 5 | 3 |

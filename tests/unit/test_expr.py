@@ -118,6 +118,11 @@ def test_functions():
     assert ev(FunctionCall("size", [ListExpr([L(1), L(2)])])) == 2
     assert ev(FunctionCall("substr", [L("hello"), L(1), L(3)])) == "ell"
     assert ev(FunctionCall("coalesce", [Literal(NULL), L(3)])) == 3
+    assert ev(FunctionCall("reverse", [L("abc")])) == "cba"
+    assert ev(FunctionCall("reverse",
+                           [ListExpr([L(1), L(2), L(3)])])) == [3, 2, 1]
+    import math as _m
+    assert ev(FunctionCall("atan2", [L(1.0), L(2.0)])) == _m.atan2(1.0, 2.0)
     assert ev(FunctionCall("split", [L("a,b"), L(",")])) == ["a", "b"]
     assert ev(FunctionCall("round", [L(2.5)])) == 3.0
     assert ev(FunctionCall("round", [L(-2.5)])) == -3.0

@@ -1,0 +1,205 @@
+"""TpuMatchAgg: fused fixed-length MATCH → aggregate (tpu/match_agg.py).
+
+Parity contract: for every fusable shape, the fused device node, its
+host fallback, and the general (unfused) executor chain must agree on
+the multiset of result rows (MATCH aggregates are unordered).
+"""
+import numpy as np
+import pytest
+
+from nebula_tpu.exec.engine import QueryEngine
+from nebula_tpu.utils.config import get_config
+
+from test_tpu import P, random_store  # noqa: E402
+
+from nebula_tpu.tpu import TpuRuntime, make_mesh  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def rt():
+    return TpuRuntime(make_mesh(P))
+
+
+def _run(eng, s, q):
+    r = eng.execute(s, q)
+    assert r.error is None, f"{q} -> {r.error}"
+    return sorted(map(repr, r.data.rows))
+
+
+def _engines(seed, rt):
+    st = random_store(seed, n=150, avg_deg=4)
+    host = QueryEngine(st)
+    hs = host.new_session()
+    host.execute(hs, "USE g")
+    dev = QueryEngine(st, tpu_runtime=rt)
+    ds = dev.new_session()
+    dev.execute(ds, "USE g")
+    return host, hs, dev, ds
+
+
+QUERIES = [
+    # IC-shaped: terminal label + prop filter, group by terminal id
+    ("MATCH (p:person)-[:knows]->(f)-[:knows]->(ff:person) "
+     "WHERE id(p) IN [1,2,3,4] AND ff.person.age > 30 "
+     "RETURN id(ff) AS v, count(*) AS c"),
+    # global aggregate: plain + DISTINCT counts over two positions
+    ("MATCH (p:person)-[:knows]->(f)-[:knows]->(ff) "
+     "WHERE id(p) IN [0,5,6] "
+     "RETURN count(*) AS c, count(DISTINCT id(ff)) AS d, "
+     "count(DISTINCT id(f)) AS m"),
+    # single hop
+    ("MATCH (p:person)-[:knows]->(q:person) WHERE id(p) IN [2,7] "
+     "RETURN id(q) AS v, count(*) AS c"),
+    # 3 hops, group by a MID alias
+    ("MATCH (a:person)-[:knows]->(b)-[:knows]->(c)-[:knows]->(d:person) "
+     "WHERE id(a) IN [3] RETURN id(c) AS v, count(*) AS c"),
+    # string predicate on the terminal
+    ("MATCH (p:person)-[:knows]->(f)-[:knows]->(ff:person) "
+     "WHERE id(p) IN [1,2,3,4,5] AND ff.person.name == \"ann\" "
+     "RETURN id(ff) AS v, count(*) AS c"),
+    # predicate on the source beyond the seed list
+    ("MATCH (p:person)-[:knows]->(f)-[:knows]->(ff:person) "
+     "WHERE id(p) IN [1,2,3,4,5] AND p.person.age < 50 "
+     "RETURN id(ff) AS v, count(*) AS c"),
+]
+
+
+def test_fused_plan_shape(rt):
+    _, _, dev, ds = _engines(11, rt)
+    r = dev.execute(ds, "EXPLAIN " + QUERIES[0])
+    txt = r.data.rows[0][0]
+    assert "TpuMatchAgg" in txt
+    assert "steps=2" in txt
+    assert "Traverse" not in txt.replace("TpuMatchAgg", "")
+    # 3-hop chain fuses as steps=3
+    r = dev.execute(ds, "EXPLAIN " + QUERIES[3])
+    assert "steps=3" in r.data.rows[0][0]
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+@pytest.mark.parametrize("qi", range(len(QUERIES)))
+def test_device_matches_host(rt, seed, qi):
+    host, hs, dev, ds = _engines(seed, rt)
+    q = QUERIES[qi]
+    assert _run(dev, ds, q) == _run(host, hs, q)
+
+
+def test_host_fallback_matches_host(rt):
+    """Flag off: the fused node's host fallback must match the unfused
+    executor chain exactly."""
+    host, hs, dev, ds = _engines(4, rt)
+    cfg = get_config()
+    old = cfg.get("tpu_match_device")
+    try:
+        cfg.set_dynamic("tpu_match_device", False)
+        for q in QUERIES:
+            assert _run(dev, ds, q) == _run(host, hs, q)
+    finally:
+        cfg.set_dynamic("tpu_match_device", old)
+
+
+def test_unfusable_shapes_still_run(rt):
+    host, hs, dev, ds = _engines(6, rt)
+    qs = [
+        # group key is a prop, not id() — stays on the general chain
+        ("MATCH (p:person)-[:knows]->(f)-[:knows]->(ff:person) "
+         "WHERE id(p) IN [1,2] RETURN ff.person.age AS a, count(*) AS c"),
+        # per-hop edge predicate — stays on the general chain
+        ("MATCH (p:person)-[e:knows]->(ff) WHERE id(p) IN [1,2] "
+         "AND e.w > 3 RETURN id(ff) AS v, count(*) AS c"),
+        # aggregate beyond count — stays on the general chain
+        ("MATCH (p:person)-[:knows]->(ff:person) WHERE id(p) IN [1,2] "
+         "RETURN id(ff) AS v, sum(ff.person.age) AS s"),
+    ]
+    for q in qs:
+        r = dev.execute(ds, "EXPLAIN " + q)
+        assert "TpuMatchAgg" not in r.data.rows[0][0], q
+        assert _run(dev, ds, q) == _run(host, hs, q)
+
+
+def test_null_id_literal_not_fused(rt):
+    """id(x) != NULL answers NULL on the host (drops every row); the
+    dense compare can't express that, so the shape must stay unfused —
+    on BOTH planes (code-review r4 finding)."""
+    from nebula_tpu.tpu.exprjit import compilable, vertex_compilable
+    host, hs, dev, ds = _engines(8, rt)
+    q = ("MATCH (p:person)-[:knows]->(ff) WHERE id(p) IN [1,2] "
+         "AND id(ff) != NULL RETURN id(ff) AS v, count(*) AS c")
+    r = dev.execute(ds, "EXPLAIN " + q)
+    assert "TpuMatchAgg" not in r.data.rows[0][0]
+    assert _run(dev, ds, q) == _run(host, hs, q) == []
+    # edge plane: the GO endpoint-id gate refuses the same shape
+    from nebula_tpu.core import expr as E
+    ef = E.Binary("!=", E.FunctionCall("id", [E.VertexExpr("$$")]),
+                  E.Literal(None))
+    assert not compilable(ef, ["knows"])
+    assert not vertex_compilable(
+        E.Binary("!=", E.FunctionCall("id", [E.LabelExpr("v")]),
+                 E.Literal(None)), "v")
+
+
+def test_trail_semantics_with_self_loop(rt):
+    """A self-loop edge may appear once per trail, not twice — the
+    absorbed _edges_distinct conjunct."""
+    from nebula_tpu.graphstore.schema import PropDef, PropType
+    from nebula_tpu.graphstore.store import GraphStore
+    st = GraphStore()
+    st.create_space("g", partition_num=P, vid_type="INT64")
+    st.catalog.create_tag("g", "person", [PropDef("age", PropType.INT64)])
+    st.catalog.create_edge("g", "knows", [PropDef("w", PropType.INT64)])
+    for v in (1, 2):
+        st.insert_vertex("g", v, "person", {"age": 40})
+    st.insert_edge("g", 1, "knows", 1, 0, {"w": 1})   # self loop
+    st.insert_edge("g", 1, "knows", 2, 0, {"w": 1})
+    st.insert_edge("g", 2, "knows", 1, 0, {"w": 1})
+    q = ("MATCH (a:person)-[:knows]->(b)-[:knows]->(c) WHERE id(a) IN [1] "
+         "RETURN id(c) AS v, count(*) AS c")
+    host = QueryEngine(st)
+    hs = host.new_session()
+    host.execute(hs, "USE g")
+    dev = QueryEngine(st, tpu_runtime=rt)
+    ds = dev.new_session()
+    dev.execute(ds, "USE g")
+    assert _run(dev, ds, q) == _run(host, hs, q)
+
+
+def test_vertex_predicate_compiler_matches_host_eval():
+    """compile_vertex_predicate_np vs per-vertex host Expr.eval."""
+    from nebula_tpu.core import expr as E
+    from nebula_tpu.core.expr import to_bool3
+    from nebula_tpu.exec.context import RowContext
+    from nebula_tpu.graphstore.csr import build_snapshot
+    from nebula_tpu.tpu.exprjit import compile_vertex_predicate_np
+
+    st = random_store(9, n=80, avg_deg=3)
+    snap = build_snapshot(st, "g")
+    sd = st.space("g")
+    eng = QueryEngine(st)
+    s = eng.new_session()
+    eng.execute(s, "USE g")
+    qctx = eng.qctx
+
+    exprs = [
+        E.Binary(">", E.LabelTagProp("v", "person", "age"), E.Literal(40)),
+        E.Binary("==", E.LabelTagProp("v", "person", "name"),
+                 E.Literal("ann")),
+        E.Binary("AND",
+                 E.FunctionCall("_hastag", [E.LabelExpr("v"),
+                                            E.Literal("person")]),
+                 E.Binary("<=", E.LabelTagProp("v", "person", "age"),
+                          E.Literal(25))),
+        E.Unary("IS_NULL", E.LabelTagProp("v", "nosuch", "p")),
+        E.Binary("IN", E.LabelTagProp("v", "person", "name"),
+                 E.ListExpr([E.Literal("bob"), E.Literal("dee")])),
+    ]
+    dense = np.arange(60, dtype=np.int64)
+    d2v = {d: sd.dense_to_vid[d] for d in dense.tolist()}
+    for ex in exprs:
+        mask = compile_vertex_predicate_np(ex, "v", snap, sd)(dense)
+        for i, d in enumerate(dense.tolist()):
+            full = qctx.build_vertex("g", d2v[d])
+            want = False
+            if full is not None:
+                rc = RowContext(qctx, "g", {"v": full})
+                want = to_bool3(ex.eval(rc)) is True
+            assert bool(mask[i]) == want, (E.to_text(ex), d2v[d])
