@@ -39,9 +39,14 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 REPEATS = int(os.environ.get("NEBULA_BENCH_REPEATS", 3))
 
 
+_LAST_MARK = [time.perf_counter()]
+
+
 def _mark(msg):
     """Progress marker on stderr (the JSON contract owns stdout) — a
-    mid-bench stall must be attributable to a phase."""
+    mid-bench stall must be attributable to a phase.  Also pets the
+    stall watchdog: the gap between marks is the unit of progress."""
+    _LAST_MARK[0] = time.perf_counter()
     print(f"[bench +{time.perf_counter() - _T0:7.1f}s] {msg}",
           file=sys.stderr, flush=True)
 
@@ -184,6 +189,14 @@ def _ensure_live_backend():
                              or "probe exceeded deadline "
                                 "(wedged device tunnel)")
         _mark("backend probe TIMED OUT (wedged device tunnel?)")
+    _reexec_cpu_fallback("device backend unreachable")
+
+
+def _reexec_cpu_fallback(reason: str):
+    """Replace this process with the virtual-CPU fallback run (fresh
+    interpreter, axon registration disabled) so the driver always gets
+    its JSON line.  Shared by the startup probe and the mid-run stall
+    watchdog."""
     env = dict(os.environ)
     env.pop("PALLAS_AXON_POOL_IPS", None)
     # probe provenance survives the re-exec (fresh interpreter)
@@ -194,9 +207,36 @@ def _ensure_live_backend():
     flags.append("--xla_force_host_platform_device_count=8")
     env["XLA_FLAGS"] = " ".join(flags)
     env["_NEBULA_BENCH_CHILD"] = "1"
-    env["_NEBULA_BENCH_FALLBACK"] = "device backend unreachable"
-    _mark("re-exec on virtual-CPU platform")
+    env["_NEBULA_BENCH_FALLBACK"] = reason
+    _mark(f"re-exec on virtual-CPU platform ({reason})")
     os.execve(sys.executable, [sys.executable] + sys.argv, env)
+
+
+def _start_stall_watchdog():
+    """A tunnel wedge MID-RUN (e.g. during a remote compile) blocks the
+    device call forever with no exception to catch.  Watch the progress
+    marks; when nothing has moved for NEBULA_BENCH_STALL_TIMEOUT
+    seconds (default 40 min — a first-ever full-scale compile over the
+    tunnel is legitimately slow), abandon the device plane and re-exec
+    the CPU fallback.  The blocked thread dies with the execve."""
+    if os.environ.get("_NEBULA_BENCH_CHILD") == "1":
+        return
+    limit = float(os.environ.get("NEBULA_BENCH_STALL_TIMEOUT", 2400))
+    if limit <= 0:
+        return
+    import threading
+
+    def watch():
+        while True:
+            time.sleep(30)
+            idle = time.perf_counter() - _LAST_MARK[0]
+            if idle > limit:
+                _PROBE_RECORD.update(stalled_after_s=int(idle))
+                _reexec_cpu_fallback(
+                    f"device plane stalled ({int(idle)}s without a "
+                    f"progress mark — wedged tunnel mid-run)")
+
+    threading.Thread(target=watch, daemon=True, name="stall-watch").start()
 
 
 def _enable_compile_cache():
@@ -239,6 +279,7 @@ def main():
     # chip client and must not race the watch loop's own probe
     _hold_chip_lock()
     _ensure_live_backend()
+    _start_stall_watchdog()
     _enable_compile_cache()
     fallback = os.environ.get("_NEBULA_BENCH_FALLBACK")
     # On the virtual-CPU fallback the padded kernel runs ~20x slower

@@ -9,7 +9,7 @@ import time — a new rule here is exactly where the TPU rewrite plugs in.
 """
 from __future__ import annotations
 
-from typing import Any, Callable, List, Optional
+from typing import Any, Callable, List, Optional, Tuple
 
 from ..core.expr import (Binary, Expr, InputProp, join_conjuncts,
                          split_conjuncts, walk)
@@ -843,6 +843,17 @@ def push_filter_down_set_op(node: PlanNode) -> Optional[PlanNode]:
                     col_names=list(op.col_names), args=dict(op.args))
 
 
+def _planted_bound(d: PlanNode, kinds: Tuple[str, ...]) -> bool:
+    """True when a branch already carries a planted row-bound node,
+    looking THROUGH rename-only Projects: the push-through-project
+    rules rewrite a planted Limit/TopN into Project(Limit/TopN), and a
+    guard on the immediate child kind alone would re-plant every
+    fixpoint round (code-review r4 finding)."""
+    while _rename_only_project(d):
+        d = d.dep()
+    return d.kind in kinds
+
+
 @register_rule
 def push_limit_into_union_all(node: PlanNode) -> Optional[PlanNode]:
     """Limit(UNION ALL) keeps its outer cut but plants the same bound on
@@ -858,7 +869,7 @@ def push_limit_into_union_all(node: PlanNode) -> Optional[PlanNode]:
     if cnt is None or cnt < 0:
         return None
     bound = cnt + (node.args.get("offset") or 0)
-    if any(d.kind == "Limit" for d in u.deps):
+    if any(_planted_bound(d, ("Limit", "TopN")) for d in u.deps):
         return None                      # already planted (fixpoint stop)
     branches = [PlanNode("Limit", deps=[d], col_names=list(d.col_names),
                          args={"count": bound, "offset": 0})
@@ -1032,6 +1043,139 @@ def merge_limit_into_topn(node: PlanNode) -> Optional[PlanNode]:
     new_args["offset"], new_args["count"] = new_off, new_cnt
     return PlanNode("TopN", deps=list(tn.deps),
                     col_names=list(node.col_names), args=new_args)
+
+
+@register_rule
+def push_filter_down_left_join(node: PlanNode) -> Optional[PlanNode]:
+    """Filter(HashLeftJoin) conjuncts reading only LEFT-side columns
+    merge into the left branch's OWN Filter (reference:
+    PushFilterDownLeftJoinRule): filtering preserved-side rows before
+    the join is equivalent, while right-side conjuncts must stay above
+    (they'd drop null-extended rows differently).
+
+    The merge is IN PLACE into an existing left-root Filter — never a
+    new node: OPTIONAL MATCH right sides reference the left root by
+    output_var (Argument.from_var), so replacing the root would orphan
+    that linkage (code-review r4 regression).  When the left root
+    isn't a Filter the rule simply doesn't fire."""
+    if node.kind != "Filter" or not node.deps:
+        return None
+    jn = node.dep()
+    if jn.kind != "HashLeftJoin" or len(jn.deps) != 2:
+        return None
+    lroot = jn.dep(0)
+    if lroot.kind != "Filter":
+        return None
+    cond = node.args.get("condition")
+    if cond is None:
+        return None
+    left_cols = set(lroot.col_names)
+    moved, rest = [], []
+    for c in split_conjuncts(cond):
+        refs = _col_refs(c)
+        if refs and refs <= left_cols:
+            moved.append(c)
+        else:
+            rest.append(c)
+    if not moved:
+        return None
+    lroot.args["condition"] = join_conjuncts(
+        [lroot.args["condition"]] + moved)
+    if rest:
+        node.args["condition"] = join_conjuncts(rest)
+        return None
+    return jn
+
+
+@register_rule
+def merge_project_into_aggregate(node: PlanNode) -> Optional[PlanNode]:
+    """Project[rename-only](Aggregate) → Aggregate emitting the renamed
+    (possibly reordered / pruned) columns directly (reference:
+    MergeProjectWithAggregateRule analog): grouping is defined by
+    group_keys, so dropping or renaming output columns cannot change
+    the groups — and one plan node's row materialization disappears."""
+    if node.kind != "Project" or len(node.deps) != 1:
+        return None
+    if not _rename_only_project(node):
+        return None
+    agg = node.dep()
+    if agg.kind != "Aggregate":
+        return None
+    by_name = {n: e for e, n in agg.args.get("columns", [])}
+    new_cols = []
+    for e, out in node.args.get("columns", []):
+        src = by_name.get(e.name)
+        if src is None:
+            return None
+        new_cols.append((src, out))
+    new_args = dict(agg.args)
+    new_args["columns"] = new_cols
+    return PlanNode("Aggregate", deps=list(agg.deps),
+                    col_names=list(node.col_names), args=new_args)
+
+
+@register_rule
+def push_topn_into_union_all(node: PlanNode) -> Optional[PlanNode]:
+    """TopN(UNION ALL) keeps its outer cut but plants a bound-sized
+    TopN on each branch (reference: PushTopNDownUnionAllRule analog):
+    any row beyond each side's top offset+count can never make the
+    overall window."""
+    if node.kind != "TopN" or len(node.deps) != 1:
+        return None
+    u = node.dep()
+    if u.kind != "Union" or u.args.get("distinct") \
+            or not _setop_pushable(u):
+        return None
+    cnt = node.args.get("count")
+    if cnt is None or cnt < 0:
+        return None
+    bound = cnt + (node.args.get("offset") or 0)
+    if any(_planted_bound(d, ("TopN",)) for d in u.deps):
+        return None                      # already planted (fixpoint stop)
+    branches = [PlanNode("TopN", deps=[d], col_names=list(d.col_names),
+                         args={"factors": list(node.args.get("factors", [])),
+                               "count": bound, "offset": 0})
+                for d in u.deps]
+    nu = PlanNode("Union", deps=branches, col_names=list(u.col_names),
+                  args=dict(u.args))
+    return PlanNode("TopN", deps=[nu], col_names=list(node.col_names),
+                    args=dict(node.args))
+
+
+@register_rule
+def push_filter_through_unwind(node: PlanNode) -> Optional[PlanNode]:
+    """Filter(Unwind) conjuncts that don't read the unwound alias move
+    below the Unwind (reference: PushFilterDownUnwindRule analog): they
+    hold once per input row instead of once per unwound element."""
+    if node.kind != "Filter" or not node.deps:
+        return None
+    uw = node.dep()
+    if uw.kind != "Unwind" or len(uw.deps) != 1:
+        return None
+    alias = uw.args.get("alias")
+    child = uw.dep()
+    child_cols = set(child.col_names)
+    cond = node.args.get("condition")
+    if cond is None:
+        return None
+    moved, rest = [], []
+    for c in split_conjuncts(cond):
+        refs = _col_refs(c)
+        if refs and alias not in refs and refs <= child_cols:
+            moved.append(c)
+        else:
+            rest.append(c)
+    if not moved:
+        return None
+    f = PlanNode("Filter", deps=[child], col_names=list(child.col_names),
+                 args={"condition": join_conjuncts(moved),
+                       "match_row": node.args.get("match_row", False)})
+    uw.deps[0] = f
+    uw.input_vars = [d.output_var for d in uw.deps]
+    if rest:
+        node.args["condition"] = join_conjuncts(rest)
+        return None
+    return uw
 
 
 @register_explore_rule

@@ -118,8 +118,12 @@ def _vid_ref(e: E.Expr):
 
 
 def _nonnull_lit(x: E.Expr) -> bool:
+    """Literal usable in a dense-id compare.  NULL is out (comparison
+    answers NULL on the host — see _id_pred_shape_ok) and so is bool:
+    hash(True)==hash(1) would resolve a dense id for `id(v) == true`
+    while host v_eq answers False for int-vs-bool."""
     return (isinstance(x, E.Literal) and x.value is not None
-            and not isinstance(x.value, NullValue))
+            and not isinstance(x.value, (NullValue, bool)))
 
 
 def _id_pred_shape_ok(e: "E.Binary", l_ref: bool, r_ref: bool) -> bool:

@@ -60,21 +60,6 @@ except (ImportError, AttributeError):
 # ---------------------------------------------------------------------------
 
 
-def _split_and(e: E.Expr) -> List[E.Expr]:
-    if isinstance(e, E.Binary) and e.op == "AND":
-        return _split_and(e.lhs) + _split_and(e.rhs)
-    return [e]
-
-
-def _and_join(conjs: List[E.Expr]) -> Optional[E.Expr]:
-    if not conjs:
-        return None
-    out = conjs[0]
-    for c in conjs[1:]:
-        out = E.Binary("AND", out, c)
-    return out
-
-
 def _is_edges_distinct(e: E.Expr, edge_aliases: List[str]) -> bool:
     return (isinstance(e, E.FunctionCall) and e.name == "_edges_distinct"
             and all(isinstance(a, E.LabelExpr) for a in e.args)
@@ -92,7 +77,7 @@ def _id_alias(e: E.Expr) -> Optional[str]:
 def _head_hastag_tags(cond: E.Expr, alias: str) -> Optional[List[str]]:
     """Filter over the seed GetVertices: AND of _hastag(alias, T) only."""
     tags = []
-    for c in _split_and(cond):
+    for c in E.split_conjuncts(cond):
         if (isinstance(c, E.FunctionCall) and c.name == "_hastag"
                 and len(c.args) == 2 and isinstance(c.args[0], E.LabelExpr)
                 and c.args[0].name == alias
@@ -124,7 +109,7 @@ def make_match_agg_rule(uses: Dict[int, int]):
         if cur.kind == "Filter":
             if not _single(uses, cur):
                 return None
-            filt_conjs = _split_and(cur.args["condition"])
+            filt_conjs = E.split_conjuncts(cur.args["condition"])
             cur = cur.dep()
         if cur.kind != "AppendVertices" or not _single(uses, cur):
             return None
@@ -283,7 +268,7 @@ def make_match_agg_rule(uses: Dict[int, int]):
                   "checked_aliases": sorted(checked_aliases),
                   "head_tags": head_tags,
                   "term_labels": term_labels,
-                  "alias_preds": {al: _and_join(ps)
+                  "alias_preds": {al: E.join_conjuncts(ps)
                                   for al, ps in alias_preds.items()},
                   "edges_distinct": edges_distinct,
                   "group_aliases": group_aliases,

@@ -1,8 +1,8 @@
 Feature: Fixed-length MATCH with aggregates (fused device pipeline shapes)
 
-  The device leg executes these through the fused TpuMatchAgg node
-  (tpu/match_agg.py); the host leg through the general executor chain.
-  Identical tables on both legs are the parity gate for the fusion.
+  # The device leg executes these through the fused TpuMatchAgg node
+  # (tpu/match_agg.py); the host leg through the general executor chain.
+  # Identical tables on both legs are the parity gate for the fusion.
 
   Background:
     Given having executed:

@@ -93,6 +93,20 @@ Feature: MATCH paths and pattern edge cases
       | "a" | "b"  |
       | "d" | NULL |
 
+  Scenario: OPTIONAL MATCH with a WHERE over the anchor keeps Argument linkage
+    # regression (r4): pushing the anchor filter below the left join must
+    # not orphan the optional side's Argument.from_var reference
+    When executing query:
+      """
+      MATCH (a:person) OPTIONAL MATCH (a)-[:knows]->(b) WHERE a.person.age > 24 RETURN id(a) AS s, id(b) AS d
+      """
+    Then the result should be, in any order:
+      | s   | d   |
+      | "a" | "b" |
+      | "b" | "c" |
+      | "c" | "a" |
+      | "c" | "d" |
+
   Scenario: multiple labels on scan
     When executing query:
       """

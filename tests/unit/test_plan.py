@@ -522,3 +522,106 @@ def test_eliminate_dedup_after_aggregate(eng):
     dd = PlanNode("Dedup", deps=[agg], col_names=["v", "c"], args={})
     p = optimize(ExecutionPlan(dd, "t"))
     assert p.root.kind == "Aggregate"
+
+
+def test_push_filter_down_left_join(eng):
+    from nebula_tpu.core.expr import Binary, InputProp, Literal, to_text
+    from nebula_tpu.query.plan import PlanNode
+    base = PlanNode("Start", col_names=["a", "k"])
+    l = PlanNode("Filter", deps=[base], col_names=["a", "k"],
+                 args={"condition": Binary(">", InputProp("k"),
+                                           Literal(0))})
+    lvar = l.output_var
+    r = PlanNode("Start", col_names=["k", "b"])
+    jn = PlanNode("HashLeftJoin", deps=[l, r],
+                  col_names=["a", "k", "b"], args={})
+    cond = Binary("AND",
+                  Binary(">", InputProp("a"), Literal(1)),
+                  Binary(">", InputProp("b"), Literal(2)))
+    f = PlanNode("Filter", deps=[jn], col_names=["a", "k", "b"],
+                 args={"condition": cond})
+    p = optimize(ExecutionPlan(f, "t"))
+    # left-only conjunct merged into the EXISTING left Filter (same
+    # node, same output_var — Argument.from_var linkage must survive);
+    # right-side conjunct stays above
+    assert p.root.kind == "Filter"
+    assert "b" in to_text(p.root.args["condition"])
+    jn2 = p.root.dep()
+    assert jn2.kind == "HashLeftJoin"
+    lf = jn2.dep(0)
+    assert lf.kind == "Filter" and lf.output_var == lvar
+    assert "($-.a > 1)" in to_text(lf.args["condition"])
+    assert jn2.dep(1).kind == "Start"
+
+
+def test_merge_project_into_aggregate(eng):
+    from nebula_tpu.core.expr import AggExpr, InputProp
+    from nebula_tpu.query.plan import PlanNode
+    base = PlanNode("Start", col_names=["v"])
+    agg = PlanNode("Aggregate", deps=[base], col_names=["v", "c"],
+                   args={"group_keys": [InputProp("v")],
+                         "columns": [(InputProp("v"), "v"),
+                                     (AggExpr("count", None), "c")]})
+    proj = PlanNode("Project", deps=[agg], col_names=["n"],
+                    args={"columns": [(InputProp("c"), "n")]})
+    p = optimize(ExecutionPlan(proj, "t"))
+    assert p.root.kind == "Aggregate"
+    assert p.root.col_names == ["n"]
+    (e0, n0), = p.root.args["columns"]
+    assert isinstance(e0, AggExpr) and n0 == "n"
+
+
+def test_push_topn_into_union_all(eng):
+    from nebula_tpu.query.plan import PlanNode
+    l = PlanNode("Start", col_names=["v"])
+    r = PlanNode("Start", col_names=["v"])
+    u = PlanNode("Union", deps=[l, r], col_names=["v"],
+                 args={"distinct": False})
+    tn = PlanNode("TopN", deps=[u], col_names=["v"],
+                  args={"factors": [("v", True)], "offset": 1,
+                        "count": 3})
+    p = optimize(ExecutionPlan(tn, "t"))
+    assert p.root.kind == "TopN"
+    assert p.root.dep().kind == "Union"
+    assert all(d.kind == "TopN" and d.args["count"] == 4
+               and d.args["offset"] == 0
+               for d in p.root.dep().deps)
+
+
+def test_push_filter_through_unwind(eng):
+    from nebula_tpu.core.expr import Binary, InputProp, Literal, LabelExpr
+    from nebula_tpu.query.plan import PlanNode
+    base = PlanNode("Start", col_names=["row"])
+    uw = PlanNode("Unwind", deps=[base], col_names=["row", "x"],
+                  args={"alias": "x", "expr": InputProp("row")})
+    cond = Binary("AND",
+                  Binary(">", InputProp("row"), Literal(0)),
+                  Binary(">", LabelExpr("x"), Literal(5)))
+    f = PlanNode("Filter", deps=[uw], col_names=["row", "x"],
+                 args={"condition": cond})
+    p = optimize(ExecutionPlan(f, "t"))
+    # row-level conjunct moved below the Unwind; alias conjunct stays
+    assert p.root.kind == "Filter"
+    uw2 = p.root.dep()
+    assert uw2.kind == "Unwind"
+    assert uw2.dep().kind == "Filter"
+
+
+def test_planted_topn_not_replanted_through_project(eng):
+    """push_topn_down_project rewrites a planted branch TopN into
+    Project(TopN); the union-planting guard must see THROUGH that or it
+    re-plants every fixpoint round (code-review r4)."""
+    from nebula_tpu.core.expr import InputProp
+    from nebula_tpu.query.plan import PlanNode, walk_plan
+    mk = lambda: PlanNode(
+        "Project",
+        deps=[PlanNode("Start", col_names=["a"])],
+        col_names=["v"], args={"columns": [(InputProp("a"), "v")]})
+    u = PlanNode("Union", deps=[mk(), mk()], col_names=["v"],
+                 args={"distinct": False})
+    tn = PlanNode("TopN", deps=[u], col_names=["v"],
+                  args={"factors": [("v", True)], "count": 2, "offset": 0})
+    p = optimize(ExecutionPlan(tn, "t"))
+    kinds = [n.kind for n in walk_plan(p.root)]
+    # exactly one planted TopN per branch + the outer cut — no stacking
+    assert kinds.count("TopN") == 3, kinds
