@@ -1545,6 +1545,9 @@ def _show(node, qctx, ectx, space):
     if kind == "snapshots":
         from .jobs import list_snapshots
         return list_snapshots()
+    if kind == "backups":
+        from .jobs import list_backups
+        return list_backups()
     if kind == "queries":
         eng = getattr(qctx, "engine", None)
         rows = []
@@ -1850,6 +1853,24 @@ def _create_snapshot(node, qctx, ectx, space):
 def _drop_snapshot(node, qctx, ectx, space):
     from .jobs import drop_snapshot
     return drop_snapshot(qctx, node.args["name"])
+
+
+@executor("CreateBackup")
+def _create_backup(node, qctx, ectx, space):
+    from .jobs import create_backup
+    return create_backup(qctx, node.args.get("name"))
+
+
+@executor("DropBackup")
+def _drop_backup(node, qctx, ectx, space):
+    from .jobs import drop_backup
+    return drop_backup(qctx, node.args["name"])
+
+
+@executor("RestoreBackup")
+def _restore_backup(node, qctx, ectx, space):
+    from .jobs import restore_backup
+    return restore_backup(qctx, node.args["name"])
 
 
 @executor("KillQuery")

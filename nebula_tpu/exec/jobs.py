@@ -218,6 +218,109 @@ def show_jobs(node, qctx) -> DataSet:
     return DataSet(cols, rows)
 
 
+def _backup_dir() -> str:
+    from ..utils.config import get_config
+    return get_config().get("backup_dir")
+
+
+def _backup_path(name: str) -> str:
+    """backup_dir/<name>, refusing names that escape backup_dir — a
+    backquoted identifier may contain ANY character, and DROP BACKUP
+    rmtree's the resolved path (code-review r4: path traversal)."""
+    import os
+    base = _backup_dir()
+    if not name or "/" in name or os.sep in name or name in (".", ".."):
+        raise ValueError(f"invalid backup name `{name}'")
+    path = os.path.join(base, name)
+    real = os.path.realpath(path)
+    if os.path.basename(real) != name or \
+            os.path.dirname(real) != os.path.realpath(base):
+        raise ValueError(f"invalid backup name `{name}'")
+    return path
+
+
+def write_backup_meta(path: str, manifest: Dict[str, Any]) -> None:
+    """backup.json sidecar — ONE writer for the statement and the
+    offline tool so the formats cannot drift."""
+    import json
+    import os
+    with open(os.path.join(path, "backup.json"), "w") as f:
+        json.dump({"created": time.time(),
+                   "spaces": sorted(manifest["spaces"])}, f)
+
+
+def iter_backups(base: str):
+    """Yield (name, info) for every backup under `base`, skipping
+    non-backup dirs — shared by SHOW BACKUPS and the offline tool."""
+    import json
+    import os
+    if not os.path.isdir(base):
+        return
+    for name in sorted(os.listdir(base)):
+        meta = os.path.join(base, name, "backup.json")
+        if not os.path.isfile(meta):
+            continue
+        with open(meta) as f:
+            yield name, json.load(f)
+
+
+def create_backup(qctx, name: Optional[str]) -> DataSet:
+    """CREATE BACKUP [AS <name>]: a restorable full-store checkpoint
+    (catalog + every space's part states) under backup_dir — the
+    statement surface of the reference's BR backup leg.  Online-safe:
+    checkpoint() takes each space's lock for a point-in-time cut."""
+    import os
+    if not hasattr(qctx.store, "checkpoint"):
+        raise ValueError("BACKUP needs a standalone store; back up a "
+                         "cluster with the offline tool per storaged "
+                         "(tools/backup.py), like the reference's br")
+    if name is None:
+        ts = int(time.time())
+        seq = 0
+        while True:
+            name = f"BACKUP_{ts}" + (f"_{seq}" if seq else "")
+            if not os.path.isdir(os.path.join(_backup_dir(), name)):
+                break
+            seq += 1
+    path = _backup_path(name)
+    if os.path.isdir(path):
+        raise ValueError(f"backup `{name}' already exists")
+    manifest = qctx.store.checkpoint(path)
+    write_backup_meta(path, manifest)
+    return DataSet(["Name"], [[name]])
+
+
+def list_backups() -> DataSet:
+    rows = [[name, "VALID", ",".join(info.get("spaces") or []),
+             int(info.get("created", 0))]
+            for name, info in iter_backups(_backup_dir())]
+    return DataSet(["Name", "Status", "Spaces", "Create Time"], rows)
+
+
+def drop_backup(qctx, name: str) -> DataSet:
+    import os
+    import shutil
+    path = _backup_path(name)
+    if not os.path.isdir(path):
+        raise ValueError(f"backup `{name}' not found")
+    shutil.rmtree(path)
+    return DataSet()
+
+
+def restore_backup(qctx, name: str) -> DataSet:
+    import os
+    path = _backup_path(name)
+    if not os.path.isdir(path):
+        raise ValueError(f"backup `{name}' not found")
+    if not hasattr(qctx.store, "restore_backup"):
+        raise ValueError("RESTORE BACKUP needs a standalone store; "
+                         "restore a cluster offline with "
+                         "tools/backup.py per storaged, like the "
+                         "reference's br restore")
+    out = qctx.store.restore_backup(path)
+    return DataSet(["Restored Spaces"], [[",".join(out["spaces"])]])
+
+
 def create_snapshot(qctx) -> DataSet:
     """CREATE SNAPSHOT: a durable on-disk checkpoint of every space
     (catalog + per-part state + manifest) under the snapshot_dir flag."""

@@ -25,7 +25,8 @@ from ..query import ast as A
 _GLOBAL_GOD = (
     A.CreateSpaceSentence, A.CreateSpaceAsSentence, A.DropSpaceSentence, A.CreateUserSentence,
     A.DropUserSentence, A.AlterUserSentence, A.CreateSnapshotSentence,
-    A.DropSnapshotSentence, A.UpdateConfigsSentence,
+    A.DropSnapshotSentence, A.CreateBackupSentence, A.DropBackupSentence,
+    A.RestoreBackupSentence, A.UpdateConfigsSentence,
     A.AddHostsSentence, A.DropZoneSentence,
     A.DropHostsSentence, A.MergeZoneSentence, A.RenameZoneSentence,
     A.ClearSpaceSentence, A.KillSessionSentence, A.StopJobSentence,

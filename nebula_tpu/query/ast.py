@@ -261,6 +261,21 @@ class DropSnapshotSentence(Sentence):
 
 
 @dataclass
+class CreateBackupSentence(Sentence):
+    name: Optional[str] = None
+
+
+@dataclass
+class DropBackupSentence(Sentence):
+    name: str = ""
+
+
+@dataclass
+class RestoreBackupSentence(Sentence):
+    name: str = ""
+
+
+@dataclass
 class KillQuerySentence(Sentence):
     session_id: Optional[int] = None
     plan_id: Optional[int] = None

@@ -185,6 +185,7 @@ class Parser:
             "REVOKE": self.p_revoke, "CHANGE": self.p_change_password,
             "REMOVE": self.p_remove, "CLEAR": self.p_clear,
             "STOP": self.p_stop_job, "RECOVER": self.p_recover_job,
+            "RESTORE": self.p_restore_backup,
             "SIGN": self.p_sign, "MERGE": self.p_merge_zone,
             "RENAME": self.p_rename_zone, "BALANCE": self.p_balance,
             "DOWNLOAD": self.p_download, "INGEST": self.p_ingest,
@@ -241,6 +242,13 @@ class Parser:
         self.expect_kw("STOP")
         self.expect_kw("JOB")
         return A.StopJobSentence(self.expect("INT").value)
+
+    def p_restore_backup(self) -> A.RestoreBackupSentence:
+        """RESTORE BACKUP <name> — swap in a CREATE BACKUP checkpoint
+        (the statement surface of the reference's br restore)."""
+        self.expect_kw("RESTORE")
+        self.expect_kw("BACKUP")
+        return A.RestoreBackupSentence(self.ident())
 
     def p_recover_job(self) -> A.RecoverJobSentence:
         self.expect_kw("RECOVER")
@@ -548,6 +556,9 @@ class Parser:
             return A.CreateSchemaSentence(is_edge, name, props, ine, ttl_d, ttl_c, cmt)
         if self.accept_kw("SNAPSHOT"):
             return A.CreateSnapshotSentence()
+        if self.accept_kw("BACKUP"):
+            name = self.ident() if self.accept_kw("AS") else None
+            return A.CreateBackupSentence(name)
         if self.accept_kw("USER"):
             ine = self.p_if_not_exists()
             name = self.ident()
@@ -640,6 +651,8 @@ class Parser:
             return A.DropSchemaSentence(is_edge, self.ident(), ife)
         if self.accept_kw("SNAPSHOT"):
             return A.DropSnapshotSentence(self.ident())
+        if self.accept_kw("BACKUP"):
+            return A.DropBackupSentence(self.ident())
         if self.accept_kw("USER"):
             ife = self.p_if_exists()
             return A.DropUserSentence(self.ident(), ife)
@@ -708,7 +721,7 @@ class Parser:
                 return A.ShowSentence(
                     "hosts", role.value.lower() if role else None)
             if kw in ("SPACES", "PARTS", "STATS", "JOBS", "SESSIONS",
-                      "SNAPSHOTS", "QUERIES", "CONFIGS"):
+                      "SNAPSHOTS", "BACKUPS", "QUERIES", "CONFIGS"):
                 self.next()
                 if kw == "JOBS":
                     return A.ShowJobsSentence()

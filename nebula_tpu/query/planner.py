@@ -1310,6 +1310,11 @@ def _register_dispatch():
             "ShowJobs", cols=["Job Id", "Command", "Status"], job_id=s.job_id),
         A.CreateSnapshotSentence: lambda p, s: _admin("CreateSnapshot"),
         A.DropSnapshotSentence: lambda p, s: _admin("DropSnapshot", name=s.name),
+        A.CreateBackupSentence: lambda p, s: _admin(
+            "CreateBackup", cols=["Name"], name=s.name),
+        A.DropBackupSentence: lambda p, s: _admin("DropBackup", name=s.name),
+        A.RestoreBackupSentence: lambda p, s: _admin(
+            "RestoreBackup", cols=["Restored Spaces"], name=s.name),
         A.KillQuerySentence: lambda p, s: _admin(
             "KillQuery", session_id=s.session_id, plan_id=s.plan_id),
         A.KillSessionSentence: lambda p, s: _admin(

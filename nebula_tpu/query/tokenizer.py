@@ -34,6 +34,7 @@ KEYWORDS = {
     "REMOVE", "CHARSET", "COLLATION", "CLEAR", "STOP", "RECOVER", "SIGN",
     "MERGE", "RENAME", "TEXT", "SERVICE", "SEARCH", "CLIENTS", "STATUS",
     "META", "GRAPH", "STORAGE", "DOWNLOAD", "HDFS",
+    "BACKUP", "BACKUPS", "RESTORE",
     # types
     "INT", "INT64", "INT32", "INT16", "INT8", "FLOAT", "DOUBLE", "STRING",
     "FIXED_STRING", "BOOL", "TIMESTAMP", "DATE", "TIME", "DATETIME",

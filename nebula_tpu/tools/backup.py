@@ -1,0 +1,91 @@
+"""Offline backup/restore tool — the `br` binary analog.
+
+The statement surface (CREATE BACKUP / SHOW BACKUPS / DROP BACKUP /
+RESTORE BACKUP) covers the online standalone store; this tool covers
+the offline legs the reference handles with its br binary
+(reference: the br repo's backup/restore against stopped services
+[UNVERIFIED — empty mount, SURVEY §0]):
+
+    python -m nebula_tpu.tools.backup create  --data-dir D --out B
+    python -m nebula_tpu.tools.backup list    --dir BACKUPS_DIR
+    python -m nebula_tpu.tools.backup restore --data-dir D --backup B
+
+`create` opens the durable store (recovering checkpoint + journal),
+writes a restorable checkpoint to --out, and exits.  `restore` opens
+the store, swaps in the backup's state, and compacts so the data dir
+boots the restored world.  For a cluster, run restore against each
+storaged's data dir with the services stopped — the same contract the
+reference's br imposes.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+
+def _open(data_dir: str):
+    from ..graphstore.store import GraphStore
+    return GraphStore(data_dir=data_dir)
+
+
+def cmd_create(args) -> int:
+    from ..exec.jobs import write_backup_meta
+    st = _open(args.data_dir)
+    try:
+        manifest = st.checkpoint(args.out)
+        write_backup_meta(args.out, manifest)
+        print(f"backup written to {args.out} "
+              f"({len(manifest['spaces'])} spaces)")
+    finally:
+        st.close()
+    return 0
+
+
+def cmd_list(args) -> int:
+    from ..exec.jobs import iter_backups
+    n = 0
+    for name, info in iter_backups(args.dir):
+        ts = time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                           time.gmtime(info.get("created", 0)))
+        print(f"{name}\t{ts}\t{','.join(info.get('spaces') or [])}")
+        n += 1
+    if n == 0:
+        print("(no backups)")
+    return 0
+
+
+def cmd_restore(args) -> int:
+    if not os.path.isfile(os.path.join(args.backup, "manifest.json")):
+        print(f"not a backup dir: {args.backup}", file=sys.stderr)
+        return 1
+    st = _open(args.data_dir)
+    try:
+        out = st.restore_backup(args.backup)
+        print(f"restored spaces: {', '.join(out['spaces'])}")
+    finally:
+        st.close()
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="nebula_tpu.tools.backup")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    c = sub.add_parser("create", help="checkpoint a data dir to a backup")
+    c.add_argument("--data-dir", required=True)
+    c.add_argument("--out", required=True)
+    c.set_defaults(fn=cmd_create)
+    l = sub.add_parser("list", help="list backups under a directory")
+    l.add_argument("--dir", required=True)
+    l.set_defaults(fn=cmd_list)
+    r = sub.add_parser("restore", help="restore a backup into a data dir")
+    r.add_argument("--data-dir", required=True)
+    r.add_argument("--backup", required=True)
+    r.set_defaults(fn=cmd_restore)
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -168,3 +168,5 @@ define_flag("tpu_profiler_dir", "",
             "trace written under this directory (SURVEY §5 tracing)")
 define_flag("snapshot_dir", "./nebula_snapshots",
             "where CREATE SNAPSHOT checkpoints land")
+define_flag("backup_dir", "./nebula_backups",
+            "where CREATE BACKUP restorable checkpoints land")

@@ -1,0 +1,192 @@
+"""BACKUP / RESTORE surface (SURVEY §2 rows 16/18; the br-tool analog):
+statement leg (CREATE/SHOW/DROP/RESTORE BACKUP), store-level restore,
+durable round-trip, and the offline tool."""
+import os
+import tempfile
+
+import pytest
+
+from nebula_tpu.exec.engine import QueryEngine
+from nebula_tpu.graphstore.schema import PropDef, PropType
+from nebula_tpu.graphstore.store import GraphStore
+from nebula_tpu.utils.config import get_config
+
+
+@pytest.fixture()
+def bdir(monkeypatch):
+    d = tempfile.mkdtemp(prefix="nebula_bk_")
+    monkeypatch.setenv("NEBULA_BACKUP_DIR", d)
+    return d
+
+
+def _seed(eng, s):
+    for q in ["CREATE SPACE b(partition_num=4, vid_type=INT64)", "USE b",
+              "CREATE TAG Person(age int)", "CREATE EDGE knows(w int)",
+              "INSERT VERTEX Person(age) VALUES 1:(10), 2:(20), 3:(30)",
+              "INSERT EDGE knows(w) VALUES 1->2:(7), 2->3:(8)"]:
+        r = eng.execute(s, q)
+        assert r.error is None, (q, r.error)
+
+
+def _ages(eng, s):
+    r = eng.execute(s, "MATCH (v:Person) RETURN id(v), v.Person.age")
+    assert r.error is None, r.error
+    return sorted(map(tuple, r.data.rows))
+
+
+def test_backup_restore_statement_roundtrip(bdir):
+    eng = QueryEngine()
+    s = eng.new_session()
+    _seed(eng, s)
+    before = _ages(eng, s)
+
+    r = eng.execute(s, "CREATE BACKUP AS bk1")
+    assert r.error is None, r.error
+    assert r.data.rows[0][0] == "bk1"
+
+    r = eng.execute(s, "SHOW BACKUPS")
+    assert r.error is None
+    names = [row[0] for row in r.data.rows]
+    assert "bk1" in names and r.data.rows[0][1] == "VALID"
+
+    # mutate after the backup, then restore: the mutation must vanish
+    for q in ["INSERT VERTEX Person(age) VALUES 9:(99)",
+              "DELETE VERTEX 1"]:
+        assert eng.execute(s, q).error is None
+    assert _ages(eng, s) != before
+
+    r = eng.execute(s, "RESTORE BACKUP bk1")
+    assert r.error is None, r.error
+    assert "b" in r.data.rows[0][0]
+    assert _ages(eng, s) == before
+    # index state is derived and rebuilt: a fresh CREATE+rebuild works
+    r = eng.execute(s, "GO FROM 1 OVER knows YIELD dst(edge) AS d")
+    assert r.error is None and [t[0] for t in r.data.rows] == [2]
+
+    r = eng.execute(s, "DROP BACKUP bk1")
+    assert r.error is None
+    r = eng.execute(s, "SHOW BACKUPS")
+    assert "bk1" not in [row[0] for row in r.data.rows]
+    r = eng.execute(s, "RESTORE BACKUP bk1")
+    assert r.error is not None
+
+
+def test_backup_requires_god(bdir):
+    eng = QueryEngine()
+    s = eng.new_session()
+    _seed(eng, s)
+    for q in ["CREATE USER u1 WITH PASSWORD \"p\"",
+              "GRANT ROLE ADMIN ON b TO u1"]:
+        assert eng.execute(s, q).error is None
+    get_config().set_dynamic("enable_authorize", True)
+    try:
+        u = eng.new_session("u1")
+        r = eng.execute(u, "CREATE BACKUP AS nope")
+        assert r.error is not None and "permission" in r.error.lower()
+        r = eng.execute(u, "RESTORE BACKUP nope")
+        assert r.error is not None and "permission" in r.error.lower()
+    finally:
+        get_config().set_dynamic("enable_authorize", False)
+
+
+def test_restore_rebuilds_indexes_and_survives_restart(bdir):
+    data = tempfile.mkdtemp(prefix="nebula_bkdur_")
+    st = GraphStore(data_dir=data)
+    st.create_space("g", partition_num=2, vid_type="INT64")
+    st.catalog.create_tag("g", "person", [PropDef("age", PropType.INT64)])
+    st.catalog.create_index("g", "iage", "person", ["age"], is_edge=False)
+    for i in range(8):
+        st.insert_vertex("g", i, "person", {"age": 20 + i})
+    bpath = os.path.join(bdir, "dur1")
+    st.checkpoint(bpath)
+    # post-backup mutations to be rolled back
+    for i in range(8, 12):
+        st.insert_vertex("g", i, "person", {"age": 50 + i})
+    assert len(st.index_scan("g", "iage", [], None)) == 12
+    st.restore_backup(bpath)
+    assert len(st.index_scan("g", "iage", [], None)) == 8
+    st.close()
+    # a restart boots the RESTORED world (restore compacted the journal)
+    st2 = GraphStore(data_dir=data)
+    assert len(st2.index_scan("g", "iage", [], None)) == 8
+    assert st2.get_vertex("g", 9) is None
+    assert st2.get_vertex("g", 3) == {"person": {"age": 23}}
+    st2.close()
+
+
+def test_offline_tool_roundtrip(bdir):
+    from nebula_tpu.tools import backup as bk
+    data = tempfile.mkdtemp(prefix="nebula_bktool_")
+    st = GraphStore(data_dir=data)
+    st.create_space("g", partition_num=2, vid_type="INT64")
+    st.catalog.create_tag("g", "person", [PropDef("age", PropType.INT64)])
+    st.insert_vertex("g", 1, "person", {"age": 41})
+    st.close()
+    out = os.path.join(bdir, "t1")
+    assert bk.main(["create", "--data-dir", data, "--out", out]) == 0
+    st = GraphStore(data_dir=data)
+    st.insert_vertex("g", 2, "person", {"age": 52})
+    st.close()
+    assert bk.main(["list", "--dir", bdir]) == 0
+    assert bk.main(["restore", "--data-dir", data, "--backup", out]) == 0
+    st = GraphStore(data_dir=data)
+    assert st.get_vertex("g", 2) is None
+    assert st.get_vertex("g", 1) == {"person": {"age": 41}}
+    st.close()
+
+
+def test_backup_name_traversal_rejected(bdir):
+    eng = QueryEngine()
+    s = eng.new_session()
+    _seed(eng, s)
+    for q in ("DROP BACKUP `../../etc`", "RESTORE BACKUP `..`",
+              "CREATE BACKUP AS `a/b`"):
+        r = eng.execute(s, q)
+        assert r.error is not None and "invalid backup name" in r.error, q
+
+
+def test_corrupt_backup_rolls_back(bdir):
+    st = GraphStore()
+    st.create_space("g", partition_num=2, vid_type="INT64")
+    st.catalog.create_tag("g", "person", [PropDef("age", PropType.INT64)])
+    st.insert_vertex("g", 1, "person", {"age": 33})
+    bpath = os.path.join(bdir, "c1")
+    st.checkpoint(bpath)
+    # corrupt one part file: restore must fail WITHOUT touching state
+    target = None
+    for root, _dirs, files in os.walk(bpath):
+        for fn in files:
+            if fn.startswith("part_"):
+                target = os.path.join(root, fn)
+    with open(target, "wb") as f:
+        f.write(b"\x00garbage")
+    st.insert_vertex("g", 2, "person", {"age": 44})
+    with pytest.raises(Exception):
+        st.restore_backup(bpath)
+    assert st.get_vertex("g", 2) == {"person": {"age": 44}}
+    assert st.get_vertex("g", 1) == {"person": {"age": 33}}
+
+
+def test_restore_keeps_epochs_monotonic(bdir):
+    st = GraphStore()
+    st.create_space("g", partition_num=2, vid_type="INT64")
+    st.catalog.create_tag("g", "person", [PropDef("age", PropType.INT64)])
+    st.insert_vertex("g", 1, "person", {"age": 33})
+    bpath = os.path.join(bdir, "e1")
+    st.checkpoint(bpath)
+    for i in range(2, 6):
+        st.insert_vertex("g", i, "person", {"age": 30 + i})
+    before = st.space("g").epoch
+    st.restore_backup(bpath)
+    assert st.space("g").epoch > before
+
+
+def test_cluster_store_refuses_statement(bdir):
+    class FakeClusterStore:
+        pass
+    from nebula_tpu.exec import jobs
+
+    class Q:
+        store = FakeClusterStore()
+    with pytest.raises(ValueError, match="standalone"):
+        jobs.create_backup(Q(), "x")
