@@ -27,11 +27,16 @@ into ONE plan node (SURVEY §2 rows 22–23):
     count(id(v)) / count(DISTINCT id(v)) over int64 dense-id columns.
 
 Python row objects are never built: the node's output is the final
-(tiny) aggregate table.  Anything the rule cannot prove — per-hop
-edge filters, non-id group keys, cross-alias predicates, aggregates
-beyond counts — leaves the plan unfused on the general executors, and
-any device-plane failure at run time falls back to `_host_match_agg`,
-a host implementation with the exact chain semantics.
+(tiny) aggregate table.  Variable-length patterns (`-[e:E*m..M]->`,
+the Twitter-proxy benchmark shape) fuse too: one device expansion to
+M hops, with the terminal checks gating EMISSION per depth — never
+continuation — exactly like the unfused AppendVertices-after-Traverse
+ordering.  Anything the rule cannot prove — per-hop edge filters,
+non-id group keys, cross-alias predicates, aggregates beyond counts,
+unbounded `*m..` — leaves the plan unfused on the general executors,
+and any device-plane failure at run time falls back to
+`_host_match_agg`, a host implementation with the exact chain
+semantics.
 """
 from __future__ import annotations
 
@@ -132,8 +137,6 @@ def make_match_agg_rule(uses: Dict[int, int]):
             if not _single(uses, cur):
                 return None
             a = cur.args
-            if a.get("min_hop") != 1 or a.get("max_hop") != 1:
-                return None
             if a.get("edge_filter") is not None:
                 return None
             if a.get("space") != sp:
@@ -158,6 +161,22 @@ def make_match_agg_rule(uses: Dict[int, int]):
         if not hops_rev:
             return None
         hops = hops_rev[::-1]
+        # hop-count shape: either a chain of fixed 1-hop Traverses, or
+        # ONE variable-length Traverse (MATCH *m..M — config-4 shape);
+        # a var-len node inside a longer chain stays on the general path
+        if len(hops) == 1:
+            min_hop = hops[0].args.get("min_hop")
+            max_hop = hops[0].args.get("max_hop")
+            if min_hop is None or max_hop is None or max_hop < 1 \
+                    or min_hop < 0 or min_hop > max_hop:
+                return None              # unbounded (*m..) stays unfused
+            var_len = not (min_hop == 1 and max_hop == 1)
+        else:
+            if any(h.args.get("min_hop") != 1 or h.args.get("max_hop") != 1
+                   for h in hops):
+                return None
+            min_hop, max_hop = len(hops), len(hops)
+            var_len = False
         # chain wiring + uniform expansion parameters
         etypes = hops[0].args.get("edge_types")
         direction = hops[0].args.get("direction")
@@ -259,11 +278,15 @@ def make_match_agg_rule(uses: Dict[int, int]):
                 continue
             return None
 
+        if var_len:
+            # the var-len Traverse's DFS enforces distinct edges within
+            # each path internally — not via a planner Filter conjunct
+            edges_distinct = True
         return PlanNode(
             "TpuMatchAgg", deps=[],
             args={"space": sp, "vids": list(vids), "src_alias": src_alias,
                   "etypes": list(etypes or []), "direction": direction,
-                  "steps": len(hops),
+                  "steps": max_hop, "min_hop": min_hop, "var_len": var_len,
                   "vertex_aliases": vertex_aliases,
                   "checked_aliases": sorted(checked_aliases),
                   "head_tags": head_tags,
@@ -326,36 +349,56 @@ def _tag_flat(snap, tag: str) -> Optional[np.ndarray]:
     return None if tt is None else tt.present.T.ravel()
 
 
-def _position_mask(dense: np.ndarray, alias: str, a: Dict[str, Any],
-                   snap, sd) -> np.ndarray:
-    """Combined existence + label + predicate mask for one pattern
-    position.  Positions without an AppendVertices in the unfused plan
-    are never existence-checked by the host plane, so they aren't here
-    either (parity over dangling edges)."""
-    if alias in (a.get("checked_aliases") or ()):
-        m = _exists_flat(snap)[dense]
-    else:
-        m = np.ones(dense.shape, bool)
+def _position_mask_fn(alias: str, a: Dict[str, Any], snap, sd):
+    """Build the combined existence + label + predicate mask function
+    for one pattern position (compile once, evaluate per depth —
+    code-review r4).  Positions without an AppendVertices in the
+    unfused plan are never existence-checked by the host plane, so
+    they aren't here either (parity over dangling edges)."""
+    checked = alias in (a.get("checked_aliases") or ())
     labels = a["term_labels"] if alias == a["vertex_aliases"][-1] else []
+    tag_flats = []
+    dead = False
     for lb in labels:
         tf = _tag_flat(snap, lb)
         if tf is None:
-            return np.zeros(dense.shape, bool)
-        m &= tf[dense]
+            dead = True
+            break
+        tag_flats.append(tf)
     pred = (a.get("alias_preds") or {}).get(alias)
-    if pred is not None:
-        mask_fn = compile_vertex_predicate_np(pred, alias, snap, sd)
-        m &= mask_fn(dense)
-    return m
+    pred_fn = compile_vertex_predicate_np(pred, alias, snap, sd) \
+        if pred is not None else None
+    exists = _exists_flat(snap) if checked else None
+
+    def mask(dense: np.ndarray) -> np.ndarray:
+        if dead:
+            return np.zeros(dense.shape, bool)
+        m = exists[dense] if exists is not None \
+            else np.ones(dense.shape, bool)
+        for tf in tag_flats:
+            m &= tf[dense]
+        if pred_fn is not None:
+            m &= pred_fn(dense)
+        return m
+
+    return mask
 
 
-def _group_rows(a: Dict[str, Any], vcols: List[np.ndarray],
+def _position_mask(dense: np.ndarray, alias: str, a: Dict[str, Any],
+                   snap, sd) -> np.ndarray:
+    return _position_mask_fn(alias, a, snap, sd)(dense)
+
+
+def _group_rows(a: Dict[str, Any], cols: Dict[str, np.ndarray],
                 d2v: np.ndarray) -> List[List[Any]]:
-    """numpy lexsort group-by over dense-id key columns → output rows."""
-    alias_ix = {al: i for i, al in enumerate(a["vertex_aliases"])}
-    n = vcols[0].size if vcols else 0
+    """numpy lexsort group-by over emitted-trail dense-id columns (one
+    per referenced vertex alias, all equal length) → output rows."""
     group_aliases = a["group_aliases"]
     agg_specs = a["agg_specs"]
+    n = next(iter(cols.values())).size if cols else 0
+
+    def col(al):
+        return cols.get(al, np.empty(0, np.int64))
 
     if not group_aliases:
         row = []
@@ -363,13 +406,12 @@ def _group_rows(a: Dict[str, Any], vcols: List[np.ndarray],
             if spec[1] is None or not spec[2]:
                 row.append(int(n))
             else:
-                col = vcols[alias_ix[spec[1]]]
-                row.append(int(np.unique(col).size) if n else 0)
+                row.append(int(np.unique(col(spec[1])).size) if n else 0)
         return [row]
 
     if n == 0:
         return []
-    keys = [vcols[alias_ix[al]] for al in group_aliases]
+    keys = [col(al) for al in group_aliases]
     order = np.lexsort(keys[::-1])
     sk = [k[order] for k in keys]
     new_grp = np.zeros(n, bool)
@@ -387,7 +429,7 @@ def _group_rows(a: Dict[str, Any], vcols: List[np.ndarray],
         elif spec[1] is None or not spec[2]:
             out_cols.append(sizes)
         else:
-            tcol = vcols[alias_ix[spec[1]]][order]
+            tcol = col(spec[1])[order]
             o2 = np.lexsort((tcol, gid))
             g2, t2 = gid[o2], tcol[o2]
             first = np.ones(n, bool)
@@ -455,16 +497,60 @@ def _device_match_agg(node, qctx, ectx, a, rt):
 
     if not keep_vids:
         return DataSet(list(node.col_names),
-                       _group_rows(a, [np.empty(0, np.int64)]
-                                   * len(a["vertex_aliases"]), None)
+                       _group_rows(a, {}, None)
                        if not a["group_aliases"] else [])
 
     frames, stats = rt.traverse_hops(store, sp, keep_vids, a["etypes"],
                                      a["direction"], steps)
     qctx.last_tpu_stats = stats
+    tracker = getattr(ectx, "tracker", None)
+    term_alias = a["vertex_aliases"][-1]
+    min_hop = a.get("min_hop", steps)
+    d2v = _d2v(snap)
+
+    if a.get("var_len"):
+        # MATCH *m..M: terminal checks gate EMISSION at each depth in
+        # [max(m,1), M] — they never prune continuation (the unfused
+        # plan's AppendVertices filters rows AFTER the whole var-len
+        # Traverse).  Edge-distinctness always applies within a path.
+        scol, last = dense, dense
+        path: List[np.ndarray] = []
+        emit_s: List[np.ndarray] = []
+        emit_d: List[np.ndarray] = []
+        term_mask = _position_mask_fn(term_alias, a, snap, sd)
+        if min_hop == 0:
+            pm = term_mask(dense)
+            emit_s.append(dense[pm])
+            emit_d.append(dense[pm])
+        for h in range(steps):
+            fr = frames[h]
+            if scol.size == 0 or fr.n == 0:
+                break
+            parent, fidx = join_frontier_trails(fr, last)
+            if fidx.size == 0:
+                break
+            if path:
+                keep = trail_distinct_keep(frames, path, parent, fr, fidx)
+                sel = np.flatnonzero(keep)
+                parent, fidx = parent[sel], fidx[sel]
+                if fidx.size == 0:
+                    break
+            scol = scol[parent]
+            last = fr.dst[fidx]
+            path = [pe[parent] for pe in path] + [fidx]
+            if tracker is not None:
+                tracker.charge(int(fidx.size) * 8 * (h + 2))
+            if h + 1 >= max(min_hop, 1):
+                pm = term_mask(last)
+                emit_s.append(scol[pm])
+                emit_d.append(last[pm])
+        es = np.concatenate(emit_s) if emit_s else np.empty(0, np.int64)
+        ed = np.concatenate(emit_d) if emit_d else np.empty(0, np.int64)
+        cols = {a["src_alias"]: es, term_alias: ed}
+        return DataSet(list(node.col_names), _group_rows(a, cols, d2v))
 
     vcols: List[np.ndarray] = [dense]
-    path: List[np.ndarray] = []
+    path = []
     alive = True
     for h in range(steps):
         fr = frames[h]
@@ -494,12 +580,11 @@ def _device_match_agg(node, qctx, ectx, a, rt):
     if not alive:
         vcols = [np.empty(0, np.int64)] * len(a["vertex_aliases"])
 
-    tracker = getattr(ectx, "tracker", None)
     if tracker is not None and vcols[0].size:
         tracker.charge(int(vcols[0].size) * 8 * (steps + 1))
 
-    d2v = _d2v(snap)
-    return DataSet(list(node.col_names), _group_rows(a, vcols, d2v))
+    cols = {al: vcols[i] for i, al in enumerate(a["vertex_aliases"])}
+    return DataSet(list(node.col_names), _group_rows(a, cols, d2v))
 
 
 # ---------------------------------------------------------------------------
@@ -557,31 +642,28 @@ def _host_match_agg(node, qctx, a):
 
     groups: Dict[Tuple, Dict[str, Any]] = {}
     order: List[Tuple] = []
-    alias_ix = {al: i for i, al in enumerate(aliases)}
     group_aliases = a["group_aliases"]
     agg_specs = a["agg_specs"]
-    n_trails = 0
+    var_len = a.get("var_len")
+    min_hop = a.get("min_hop", steps)
+    term_alias = aliases[-1]
 
-    def emit(trail_vids: List[Any]):
-        nonlocal n_trails
-        n_trails += 1
-        key = tuple(hashable_key(trail_vids[alias_ix[al]])
-                    for al in group_aliases)
+    def emit(vals: Dict[str, Any]):
+        key = tuple(hashable_key(vals[al]) for al in group_aliases)
         g = groups.get(key)
         if g is None:
-            g = groups[key] = {"vids": [trail_vids[alias_ix[al]]
-                                        for al in group_aliases],
+            g = groups[key] = {"vids": [vals[al] for al in group_aliases],
                                "n": 0,
                                "sets": [set() for _ in agg_specs]}
             order.append(key)
         g["n"] += 1
         for i, spec in enumerate(agg_specs):
             if spec[0] == "count" and spec[1] is not None and spec[2]:
-                g["sets"][i].add(hashable_key(trail_vids[alias_ix[spec[1]]]))
+                g["sets"][i].add(hashable_key(vals[spec[1]]))
 
     def dfs(vid, depth: int, trail: List[Any], eseen: set):
         if depth == steps:
-            emit(list(trail))
+            emit({al: trail[i] for i, al in enumerate(aliases)})
             return
         for (s, et, rank, other, props, sgn) in store.get_neighbors(
                 sp, [vid], etypes, direction):
@@ -599,10 +681,32 @@ def _host_match_agg(node, qctx, a):
                 eseen.discard(ek)
             trail.pop()
 
+    def dfs_var(seed, vid, depth: int, eseen: set):
+        # emission gates on the terminal checks; continuation does not
+        # (the unfused AppendVertices filters rows AFTER the Traverse)
+        for (s, et, rank, other, props, sgn) in store.get_neighbors(
+                sp, [vid], etypes, direction):
+            e = _make_edge(s, other, et, rank, props, sgn, etype_ids[et])
+            ek = e.key()
+            if ek in eseen:
+                continue
+            if depth + 1 >= max(min_hop, 1) \
+                    and position_ok(term_alias, other):
+                emit({aliases[0]: seed, term_alias: other})
+            if depth + 1 < steps:
+                eseen.add(ek)
+                dfs_var(seed, other, depth + 1, eseen)
+                eseen.discard(ek)
+
     for vid in _seed_vids(a):
         if not position_ok(aliases[0], vid):
             continue
-        dfs(vid, 0, [vid], set())
+        if var_len:
+            if min_hop == 0 and position_ok(term_alias, vid):
+                emit({aliases[0]: vid, term_alias: vid})
+            dfs_var(vid, vid, 0, set())
+        else:
+            dfs(vid, 0, [vid], set())
 
     rows: List[List[Any]] = []
     if not order and not group_aliases:

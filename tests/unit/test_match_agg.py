@@ -61,6 +61,19 @@ QUERIES = [
     ("MATCH (p:person)-[:knows]->(f)-[:knows]->(ff:person) "
      "WHERE id(p) IN [1,2,3,4,5] AND p.person.age < 50 "
      "RETURN id(ff) AS v, count(*) AS c"),
+    # variable-length: global count (the config-4 benchmark shape)
+    ("MATCH (a:person)-[e:knows*1..3]->(b) WHERE id(a) IN [1,2] "
+     "RETURN count(*) AS c"),
+    # variable-length: terminal label + grouping across depths
+    ("MATCH (a:person)-[e:knows*1..3]->(b:person) WHERE id(a) IN [3] "
+     "RETURN id(b) AS v, count(*) AS c"),
+    # zero-hop lower bound + DISTINCT terminal
+    ("MATCH (a:person)-[e:knows*0..2]->(b) WHERE id(a) IN [1] "
+     "RETURN count(*) AS c, count(DISTINCT id(b)) AS d"),
+    # fixed m==M spelled as a var-len pattern + terminal predicate
+    ("MATCH (a:person)-[e:knows*2..2]->(b:person) "
+     "WHERE id(a) IN [1,4] AND b.person.age > 30 "
+     "RETURN id(b) AS v, count(*) AS c"),
 ]
 
 
@@ -74,6 +87,14 @@ def test_fused_plan_shape(rt):
     # 3-hop chain fuses as steps=3
     r = dev.execute(ds, "EXPLAIN " + QUERIES[3])
     assert "steps=3" in r.data.rows[0][0]
+    # var-len fuses with min_hop/var_len recorded
+    r = dev.execute(ds, "EXPLAIN " + QUERIES[6])
+    txt = r.data.rows[0][0]
+    assert "TpuMatchAgg" in txt and "var_len=True" in txt
+    # unbounded upper bound stays on the general path
+    r = dev.execute(ds, "EXPLAIN MATCH (a:person)-[e:knows*1..]->(b) "
+                    "WHERE id(a) IN [1] RETURN count(*) AS c")
+    assert "TpuMatchAgg" not in r.data.rows[0][0]
 
 
 @pytest.mark.parametrize("seed", [0, 1, 2])
