@@ -23,6 +23,7 @@ TOSS chain-write analog (single-process: both writes in one call).
 from __future__ import annotations
 
 import hashlib
+import itertools
 import threading
 from typing import Any, Dict, Iterable, List, Optional, Tuple
 
@@ -78,6 +79,8 @@ class Partition:
 class SpaceData:
     """All partitions + vid dictionary of one space."""
 
+    _uid_counter = itertools.count(1)
+
     def __init__(self, desc: SpaceDesc):
         self.desc = desc
         self.parts = [Partition(p) for p in range(desc.partition_num)]
@@ -85,6 +88,10 @@ class SpaceData:
         self.dense_to_vid: List[Any] = []
         self.part_counts = [0] * desc.partition_num
         self.epoch = 0
+        # process-unique id: distinguishes same-named spaces of DIFFERENT
+        # stores (or a dropped+recreated space) in the TpuRuntime's
+        # per-space snapshot cache, where (name, epoch) alone can collide
+        self.uid = next(SpaceData._uid_counter)
         from ..utils.racecheck import make_lock
         self.lock = make_lock("space_data")
         self.index_data: Dict[str, Any] = {}   # index name → IndexData

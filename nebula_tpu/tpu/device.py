@@ -130,6 +130,10 @@ class DeviceSnapshot:
     tags: Dict[str, DeviceTag] = field(default_factory=dict)
     pool: StringPool = field(default_factory=StringPool)
     host: Optional[CsrSnapshot] = None   # kept for vid decode / oracle
+    # uid of the SpaceData this snapshot was pinned from (None when the
+    # accessor has no uid — cluster views, prebuilt bench snapshots);
+    # guards the runtime's per-space cache across distinct stores
+    space_uid: Optional[int] = None
 
     def block(self, etype: str, direction: str = "out") -> DeviceBlock:
         return self.blocks[(etype, direction)]

@@ -832,3 +832,72 @@ def test_bottom_up_bfs_endpoint_predicate_parity():
             exp = want.get(vid, -1)
             assert got[d % 8, d // 8] == exp, (w, vid, exp,
                                                int(got[d % 8, d // 8]))
+
+
+def test_non_identity_vid_decode(rt):
+    """Spaces whose vids are NOT the dense ids must still decode through
+    the d2v gather — guards the identity fast path in runtime._d2v
+    (sequential-int-vid spaces skip the gather; scattered vids may not).
+    Covers both the GO materializer and the MATCH frame decode."""
+    from nebula_tpu.tpu.runtime import _d2v
+    rng = random.Random(5)
+    st = GraphStore()
+    st.create_space("nid", partition_num=P, vid_type="INT64")
+    st.catalog.create_tag("nid", "person", [PropDef("age", PropType.INT64)])
+    st.catalog.create_edge("nid", "knows", [PropDef("w", PropType.INT64)])
+    vids = [v * 13 + 1001 for v in range(80)]
+    rng.shuffle(vids)
+    for v in vids:
+        st.insert_vertex("nid", v, "person", {"age": v % 90})
+    for v in vids:
+        for _ in range(rng.randint(0, 6)):
+            st.insert_edge("nid", v, "knows", rng.choice(vids),
+                           rng.randint(0, 2), {"w": rng.randint(0, 99)})
+    snap = rt.pin(st, "nid").host
+    _d2v(snap)
+    assert not snap._d2v_identity
+
+    sources = vids[:3]
+    rows, _ = rt.traverse(st, "nid", sources, ["knows"], "out", 2)
+    got = sorted(norm_edge(e) for (_, e, _) in rows)
+    want = host_go(st, "nid", sources, ["knows"], "out", 2)
+    assert got == want
+    # every decoded endpoint is a real vid, not a dense id
+    vidset = set(vids)
+    for (sv, e, dv) in rows:
+        assert sv in vidset and dv in vidset
+
+    # fused-yield columnar path + MATCH frame decode, device vs host
+    src_list = ", ".join(map(str, sources))
+    for q in (f"GO 2 STEPS FROM {src_list} OVER knows "
+              f"YIELD src(edge) AS s, dst(edge) AS d, knows.w AS w",
+              f"MATCH (a:person)-[e:knows]->(b) WHERE id(a) == {sources[0]} "
+              f"RETURN id(a), id(b), e.w"):
+        out = []
+        for tpu_rt in (None, rt):
+            eng = QueryEngine(st, tpu_runtime=tpu_rt)
+            s = eng.new_session()
+            eng.execute(s, "USE nid")
+            rs = eng.execute(s, q)
+            assert rs.error is None, f"{q} -> {rs.error}"
+            out.append(sorted(map(repr, rs.data.rows)))
+        assert out[0] == out[1], q
+
+
+def test_shared_runtime_two_stores_no_cache_collision(rt):
+    """One TpuRuntime serving two DIFFERENT stores whose same-named
+    spaces share an epoch value must not serve store A's pinned graph
+    for store B's queries — the snapshot cache is keyed by space uid,
+    not just (name, epoch)."""
+    stores = [random_store(seed) for seed in (21, 22)]
+    wants = [host_go(st, "g", [3, 17], ["knows"], "out", 2)
+             for st in stores]
+    assert wants[0] != wants[1]          # distinct graphs
+    rows, _ = rt.traverse(stores[0], "g", [3, 17], ["knows"], "out", 2)
+    assert sorted(norm_edge(e) for (_, e, _) in rows) == wants[0]
+    # force the epoch COLLISION the uid guard exists for: store B's
+    # same-named space reports the exact epoch store A was pinned at
+    stores[1].space("g").epoch = stores[0].space("g").epoch
+    assert stores[1].space("g").epoch == stores[0].space("g").epoch
+    rows, _ = rt.traverse(stores[1], "g", [3, 17], ["knows"], "out", 2)
+    assert sorted(norm_edge(e) for (_, e, _) in rows) == wants[1]
