@@ -197,6 +197,10 @@ class MetaClient:
     def rename_zone(self, old: str, new: str):
         self.call("meta.rename_zone", old=old, new=new)
 
+    def divide_zone(self, zone: str, parts):
+        self.call("meta.divide_zone", zone=zone,
+                  parts=[[n, list(hs)] for n, hs in parts])
+
     def drop_hosts(self, hosts):
         self.call("meta.drop_hosts", hosts=list(hosts))
 

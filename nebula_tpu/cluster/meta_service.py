@@ -213,6 +213,34 @@ class MetaState:
             raise RpcError(f"zone `{c['new']}' already exists")
         self.zones[c["new"]] = self.zones.pop(c["old"])
 
+    def _ap_divide_zone(self, c):
+        """DIVIDE ZONE z INTO z1 (hosts) z2 (hosts): the target host
+        lists must partition z's members EXACTLY (reference semantics —
+        a divide can neither drop nor import hosts)."""
+        zone = c["zone"]
+        if zone not in self.zones:
+            raise RpcError(f"zone `{zone}' not found")
+        parts = [(n, list(hs)) for n, hs in c["parts"]]
+        names = [n for n, _ in parts]
+        if len(set(names)) != len(names):
+            raise RpcError("duplicate target zone name in DIVIDE ZONE")
+        if any(not hs for _, hs in parts):
+            raise RpcError("DIVIDE ZONE target zones must be non-empty")
+        for n in names:
+            if n != zone and n in self.zones:
+                raise RpcError(f"zone `{n}' already exists")
+        claimed: List[str] = []
+        for _, hs in parts:
+            claimed.extend(hs)
+        members = self.zones[zone]
+        if sorted(claimed) != sorted(members):
+            raise RpcError(
+                f"DIVIDE ZONE host lists must partition `{zone}' exactly "
+                f"(zone has {sorted(members)}, got {sorted(claimed)})")
+        self.zones.pop(zone)
+        for n, hs in parts:
+            self.zones[n] = list(hs)
+
     def _ap_drop_hosts(self, c):
         """DROP HOSTS: remove hosts from placement metadata.  Refused
         while any part replica still lives on the host — BALANCE DATA
@@ -495,6 +523,11 @@ class MetaService:
     def rpc_merge_zones(self, p):
         return self._propose({"op": "merge_zones", "zones": list(p["zones"]),
                               "into": p["into"]})
+
+    def rpc_divide_zone(self, p):
+        return self._propose({"op": "divide_zone", "zone": p["zone"],
+                              "parts": [[n, list(hs)]
+                                        for n, hs in p["parts"]]})
 
     def rpc_rename_zone(self, p):
         return self._propose({"op": "rename_zone", "old": p["old"],

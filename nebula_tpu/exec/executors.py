@@ -1532,6 +1532,8 @@ def _show(node, qctx, ectx, space):
         return DataSet(["Type", "Name", "Count"], rows)
     if kind == "sessions":
         cluster = getattr(qctx, "cluster", None)
+        if a.get("extra") == "local":
+            cluster = None      # SHOW LOCAL SESSIONS: this graphd only
         if cluster is not None:
             return DataSet(
                 ["SessionId", "UserName", "SpaceName", "GraphAddr"],
@@ -1549,14 +1551,36 @@ def _show(node, qctx, ectx, space):
         from .jobs import list_backups
         return list_backups()
     if kind == "queries":
+        qcols = ["SessionId", "ExecutionPlanId", "User", "Query",
+                 "Status", "GraphAddr"]
+        cluster = getattr(qctx, "cluster", None)
+        if a.get("extra") == "local":
+            cluster = None      # SHOW LOCAL QUERIES: this graphd only
+        if cluster is not None:
+            # fan out over every graphd in metad's session table — a
+            # running query always belongs to a registered session, so
+            # the addr set is complete; a dead graphd's queries died
+            # with it (skip)
+            from ..cluster.rpc import RpcClient
+            rows = []
+            for addr in sorted({s["graphd"]
+                                for s in cluster.list_sessions()
+                                if s.get("graphd")}):
+                try:
+                    got = RpcClient.from_addr(addr).call(
+                        "graph.list_queries")
+                except Exception:  # noqa: BLE001 — graphd down
+                    continue
+                rows.extend(list(r) + [addr] for r in got)
+            return DataSet(qcols, rows)
         eng = getattr(qctx, "engine", None)
         rows = []
         if eng is not None:
             for s in list(eng.sessions.values()):
                 for qid, qtext in list(s.queries.items()):
-                    rows.append([s.id, qid, s.user, qtext, "RUNNING"])
-        return DataSet(["SessionId", "ExecutionPlanId", "User", "Query",
-                        "Status"], rows)
+                    rows.append([s.id, qid, s.user, qtext, "RUNNING",
+                                 "in-process"])
+        return DataSet(qcols, rows)
     if kind == "configs":
         return DataSet(["Module", "Name", "Type", "Mode", "Value"],
                        _config_rows(qctx))
@@ -1634,6 +1658,17 @@ def _rename_zone(node, qctx, ectx, space):
     cluster = _need_cluster(qctx, "RENAME ZONE")
     try:
         cluster.rename_zone(node.args["old"], node.args["new"])
+    except RpcError as ex:
+        raise ExecError(str(ex)) from None
+    return DataSet()
+
+
+@executor("DivideZone")
+def _divide_zone(node, qctx, ectx, space):
+    from ..cluster.rpc import RpcError
+    cluster = _need_cluster(qctx, "DIVIDE ZONE")
+    try:
+        cluster.divide_zone(node.args["zone"], node.args["parts"])
     except RpcError as ex:
         raise ExecError(str(ex)) from None
     return DataSet()
@@ -1876,10 +1911,34 @@ def _restore_backup(node, qctx, ectx, space):
 @executor("KillQuery")
 def _kill_query(node, qctx, ectx, space):
     """KILL QUERY (session=sid, plan=qid): set the running query's kill
-    event — its scheduler aborts before the next plan node."""
+    event — its scheduler aborts before the next plan node.  In cluster
+    mode the kill must reach the OWNING graphd (the session's engine
+    registry lives there), routed via metad's session table."""
     eng = getattr(qctx, "engine", None)
     sid = node.args.get("session_id")
     qid = node.args.get("plan_id")
+    cluster = getattr(qctx, "cluster", None)
+    if cluster is not None:
+        from ..cluster.rpc import RpcClient
+        sessions = cluster.list_sessions()
+        if sid is not None:
+            addrs = [s["graphd"] for s in sessions if s["sid"] == sid]
+            if not addrs:
+                raise ExecError(f"session {sid} not found")
+        else:
+            addrs = sorted({s["graphd"] for s in sessions
+                            if s.get("graphd")})
+        hit = False
+        for addr in addrs:
+            try:
+                hit |= bool(RpcClient.from_addr(addr).call(
+                    "graph.kill_query", session_id=sid, plan_id=qid))
+            except Exception:  # noqa: BLE001 — owner down: nothing runs
+                continue
+        if not hit and (sid is not None or qid is not None):
+            raise ExecError(f"no running query matches "
+                            f"(session={sid}, plan={qid})")
+        return DataSet()
     if eng is None:
         return DataSet()
     targets = [s for s in list(eng.sessions.values())

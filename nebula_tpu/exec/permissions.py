@@ -29,6 +29,7 @@ _GLOBAL_GOD = (
     A.RestoreBackupSentence, A.UpdateConfigsSentence,
     A.AddHostsSentence, A.DropZoneSentence,
     A.DropHostsSentence, A.MergeZoneSentence, A.RenameZoneSentence,
+    A.DivideZoneSentence,
     A.ClearSpaceSentence, A.KillSessionSentence, A.StopJobSentence,
     A.RecoverJobSentence, A.SignInTextServiceSentence,
     A.SignOutTextServiceSentence, A.DescribeUserSentence,

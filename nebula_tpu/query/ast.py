@@ -369,6 +369,12 @@ class RenameZoneSentence(Sentence):
 
 
 @dataclass
+class DivideZoneSentence(Sentence):
+    zone: str
+    parts: list            # [(new_zone_name, [host, ...]), ...]
+
+
+@dataclass
 class DescZoneSentence(Sentence):
     zone: str
 

@@ -187,15 +187,33 @@ class Parser:
             "STOP": self.p_stop_job, "RECOVER": self.p_recover_job,
             "RESTORE": self.p_restore_backup,
             "SIGN": self.p_sign, "MERGE": self.p_merge_zone,
-            "RENAME": self.p_rename_zone, "BALANCE": self.p_balance,
+            "RENAME": self.p_rename_zone, "DIVIDE": self.p_divide_zone,
+            "BALANCE": self.p_balance,
             "DOWNLOAD": self.p_download, "INGEST": self.p_ingest,
         }.get(kw)
         if fn is None:
             raise ParseError(f"unsupported statement `{kw}' at pos {t.pos}")
         return fn()
 
+    def p_host_literal(self) -> str:
+        """A host endpoint in either spelling: `"h":9779` (the reference
+        grammar's STRING ':' port) or `"h:9779"` (one string) —
+        normalized to "host:port"."""
+        h = self.expect("STRING").value
+        if self.accept(":"):
+            h = f"{h}:{self.expect('INT').value}"
+        return h
+
+    def zone_name(self) -> str:
+        """Zone names are quoted strings in the reference grammar but
+        bare identifiers are accepted too (our TCK's original spelling)."""
+        if self.at("STRING"):
+            return self.next().value
+        return self.ident()
+
     def p_add(self) -> A.Sentence:
-        """ADD HOSTS "h:p" [, ...] INTO ZONE zname — placement zones;
+        """ADD HOSTS "h":port [, ...] [INTO [NEW] ZONE zname] — host
+        registration + optional placement zone (no zone → "default");
         ADD LISTENER ELASTICSEARCH "h:p" [, ...] — full-text sink."""
         self.expect_kw("ADD")
         if self.accept_kw("LISTENER"):
@@ -205,12 +223,15 @@ class Parser:
                 eps.append(self.expect("STRING").value)
             return A.AddListenerSentence(ltype, eps)
         self.expect_kw("HOSTS")
-        hosts = [self.expect("STRING").value]
+        hosts = [self.p_host_literal()]
         while self.accept(","):
-            hosts.append(self.expect("STRING").value)
-        self.expect_kw("INTO")
-        self.expect_kw("ZONE")
-        return A.AddHostsSentence(hosts, self.ident())
+            hosts.append(self.p_host_literal())
+        zone = "default"
+        if self.accept_kw("INTO"):
+            self.accept_kw("NEW")
+            self.expect_kw("ZONE")
+            zone = self.zone_name()
+        return A.AddHostsSentence(hosts, zone)
 
     def p_remove(self) -> A.RemoveListenerSentence:
         self.expect_kw("REMOVE")
@@ -286,18 +307,47 @@ class Parser:
     def p_merge_zone(self) -> A.MergeZoneSentence:
         self.expect_kw("MERGE")
         self.expect_kw("ZONE")
-        zones = [self.ident()]
+        zones = [self.zone_name()]
         while self.accept(","):
-            zones.append(self.ident())
+            zones.append(self.zone_name())
         self.expect_kw("INTO")
-        return A.MergeZoneSentence(zones, self.ident())
+        return A.MergeZoneSentence(zones, self.zone_name())
 
     def p_rename_zone(self) -> A.RenameZoneSentence:
         self.expect_kw("RENAME")
         self.expect_kw("ZONE")
-        old = self.ident()
+        old = self.zone_name()
         self.expect_kw("TO")
-        return A.RenameZoneSentence(old, self.ident())
+        return A.RenameZoneSentence(old, self.zone_name())
+
+    def p_divide_zone(self) -> A.DivideZoneSentence:
+        """DIVIDE ZONE z INTO z1 ("h":p [, ...]) z2 (...) [...] — split a
+        placement zone's hosts into new zones; the host lists must
+        partition the source zone exactly (meta validates)."""
+        self.expect_kw("DIVIDE")
+        self.expect_kw("ZONE")
+        zone = self.zone_name()
+        self.expect_kw("INTO")
+        parts = []
+        while True:
+            name = self.zone_name()
+            self.expect("(")
+            hosts = [self.p_host_literal()]
+            while self.accept(","):
+                hosts.append(self.p_host_literal())
+            self.expect(")")
+            parts.append((name, hosts))
+            self.accept(",")
+            if self.at(";") or self.at("EOF"):
+                break
+            # zone_name() also accepts keywords-as-identifiers (e.g.
+            # `default`) — continue on any of the three token kinds
+            if not (self.at("STRING")
+                    or self.peek().kind in ("IDENT", "KEYWORD")):
+                break
+        if len(parts) < 2:
+            raise ParseError("DIVIDE ZONE needs at least two target zones")
+        return A.DivideZoneSentence(zone, parts)
 
     def p_download(self) -> A.DownloadSentence:
         self.expect_kw("DOWNLOAD")
@@ -657,11 +707,11 @@ class Parser:
             ife = self.p_if_exists()
             return A.DropUserSentence(self.ident(), ife)
         if self.accept_kw("ZONE"):
-            return A.DropZoneSentence(self.ident())
+            return A.DropZoneSentence(self.zone_name())
         if self.accept_kw("HOSTS"):
-            hosts = [self.expect("STRING").value]
+            hosts = [self.p_host_literal()]
             while self.accept(","):
-                hosts.append(self.expect("STRING").value)
+                hosts.append(self.p_host_literal())
             return A.DropHostsSentence(hosts)
         raise ParseError(
             "expected SPACE/TAG/EDGE/SNAPSHOT/USER/ZONE/HOSTS after DROP")
@@ -720,6 +770,15 @@ class Parser:
                 role = self.accept_kw("GRAPH", "STORAGE", "META")
                 return A.ShowSentence(
                     "hosts", role.value.lower() if role else None)
+            if kw in ("LOCAL", "ALL") \
+                    and self.peek(1).kind == "KEYWORD" \
+                    and self.peek(1).value in ("SESSIONS", "QUERIES"):
+                # SHOW LOCAL SESSIONS/QUERIES: this graphd only;
+                # SHOW ALL ...: cluster-wide (the default)
+                scope = self.next().value.lower()
+                which = self.next().value.lower()
+                return A.ShowSentence(which,
+                                      scope if scope == "local" else None)
             if kw in ("SPACES", "PARTS", "STATS", "JOBS", "SESSIONS",
                       "SNAPSHOTS", "BACKUPS", "QUERIES", "CONFIGS"):
                 self.next()

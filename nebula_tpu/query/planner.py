@@ -1334,6 +1334,8 @@ def _register_dispatch():
             "MergeZone", zones=s.zones, into=s.into),
         A.RenameZoneSentence: lambda p, s: _admin(
             "RenameZone", old=s.old, new=s.new),
+        A.DivideZoneSentence: lambda p, s: _admin(
+            "DivideZone", zone=s.zone, parts=s.parts),
         A.DescZoneSentence: lambda p, s: _admin(
             "DescZone", cols=["Hosts"], zone=s.zone),
         A.ClearSpaceSentence: lambda p, s: _admin(

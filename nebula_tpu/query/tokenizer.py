@@ -32,9 +32,10 @@ KEYWORDS = {
     "USER", "USERS", "PASSWORD", "GRANT", "REVOKE", "ROLE", "ROLES",
     "ZONE", "ZONES", "INTO", "FULLTEXT", "LISTENER", "ELASTICSEARCH",
     "REMOVE", "CHARSET", "COLLATION", "CLEAR", "STOP", "RECOVER", "SIGN",
-    "MERGE", "RENAME", "TEXT", "SERVICE", "SEARCH", "CLIENTS", "STATUS",
+    "MERGE", "RENAME", "DIVIDE", "TEXT", "SERVICE", "SEARCH", "CLIENTS",
+    "STATUS",
     "META", "GRAPH", "STORAGE", "DOWNLOAD", "HDFS",
-    "BACKUP", "BACKUPS", "RESTORE",
+    "BACKUP", "BACKUPS", "RESTORE", "NEW", "LOCAL",
     # types
     "INT", "INT64", "INT32", "INT16", "INT8", "FLOAT", "DOUBLE", "STRING",
     "FIXED_STRING", "BOOL", "TIMESTAMP", "DATE", "TIME", "DATETIME",

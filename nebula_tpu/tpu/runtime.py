@@ -58,14 +58,16 @@ def _d2v(host) -> np.ndarray:
                 arr = np.asarray(d2v, dtype=object)
         else:
             arr = np.asarray(d2v, dtype=object)
-        host._d2v_arr = arr
         # sequential-int-vid spaces (LDBC-style imports, the array
         # ingest path) have dense == vid: one cached pass here lets the
         # materializers skip a multi-million-row identity gather per
-        # query (~0.65 s at north-star scale on the bench host)
+        # query (~0.65 s at north-star scale on the bench host).
+        # Identity flag is published BEFORE the array: a concurrent
+        # reader that sees the cached array must also see the flag.
         host._d2v_identity = bool(
             arr.dtype.kind == "i"
             and (arr == np.arange(len(arr), dtype=arr.dtype)).all())
+        host._d2v_arr = arr
     return arr
 
 

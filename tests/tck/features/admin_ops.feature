@@ -185,3 +185,24 @@ Feature: Cluster and operational admin statements
       SHOW HOSTS GRAPH
       """
     Then the result should not be empty
+
+  Scenario: divide zone needs cluster mode
+    When executing query:
+      """
+      DIVIDE ZONE "z" INTO "z1" ("h1":9779) "z2" ("h2":9779)
+      """
+    Then an ExecutionError should be raised
+
+  Scenario: show local sessions lists the current session
+    When executing query:
+      """
+      SHOW LOCAL SESSIONS
+      """
+    Then the result should not be empty
+
+  Scenario: show local queries lists the statement itself
+    When executing query:
+      """
+      SHOW LOCAL QUERIES
+      """
+    Then the result should contain "SHOW LOCAL QUERIES"

@@ -335,6 +335,25 @@ def test_zones_and_id_allocation(tmp_path):
         assert set(zones) == {"merged"}
         assert set(zones["merged"]) == west_set
 
+        # DIVIDE ZONE: host lists must partition the source exactly;
+        # reference spellings (quoted zone names, "host":port literals)
+        rs = client.execute(
+            f'DIVIDE ZONE "merged" INTO "m1" ("{addrs[0]}") '
+            f'"m2" ("{addrs[2]}", "{addrs[3]}")')
+        assert rs.error is None, rs.error
+        zones = meta.list_zones()
+        assert set(zones) == {"m1", "m2"}
+        assert set(zones["m2"]) == {addrs[2], addrs[3]}
+        rs = client.execute(
+            f'DIVIDE ZONE "m2" INTO "x" ("{addrs[2]}") "y" ("{addrs[0]}")')
+        assert rs.error is not None and "partition" in rs.error
+        # ADD HOSTS with no zone clause registers into "default",
+        # "host":port two-token spelling included
+        host, port = addrs[1].rsplit(":", 1)
+        rs = client.execute(f'ADD HOSTS "{host}":{port}')
+        assert rs.error is None, rs.error
+        assert addrs[1] in meta.list_zones().get("default", [])
+
         # DROP HOSTS refuses while replicas live on the host
         rs = client.execute(f'DROP HOSTS "{addrs[0]}"')
         assert rs.error is not None and "BALANCE" in rs.error, rs.error
@@ -472,5 +491,50 @@ def test_show_parts_cluster_real_map(tmp_path):
         for pid, leader, peers in r.data.rows:
             assert leader in addrs
             assert set(peers) <= addrs
+    finally:
+        c.stop()
+
+
+def test_show_and_kill_queries_cross_graphd(tmp_path):
+    """SHOW [ALL] QUERIES fans out over every graphd in metad's session
+    table, and KILL QUERY routes to the OWNING graphd (the registry
+    holding the kill event lives there)."""
+    import threading
+    from nebula_tpu.cluster.launcher import LocalCluster
+    c = LocalCluster(n_meta=1, n_storage=1, n_graph=2,
+                     data_dir=str(tmp_path))
+    try:
+        from nebula_tpu.cluster.client import GraphClient
+        addr_a = c.graph_servers[0].addr
+        addr_b = c.graph_servers[1].addr
+        ha, pa = addr_a.rsplit(":", 1)
+        hb, pb = addr_b.rsplit(":", 1)
+        ca = GraphClient(ha, int(pa)); ca.authenticate("root", "nebula")
+        cb = GraphClient(hb, int(pb)); cb.authenticate("root", "nebula")
+
+        # plant a RUNNING query in graphd B's engine registry (the
+        # execute path does exactly this around scheduler.run)
+        sess_b = c.graphds[1].engine.sessions[cb.session_id]
+        ev = threading.Event()
+        sess_b.queries[777] = "stall-on-b"
+        sess_b.running_kill[777] = ev
+        try:
+            rs = ca.execute("SHOW QUERIES")
+            assert rs.error is None, rs.error
+            hit = [r for r in rs.data.rows if r[3] == "stall-on-b"]
+            assert hit and hit[0][5] == addr_b, rs.data.rows
+            rs = ca.execute("SHOW LOCAL QUERIES")
+            assert rs.error is None
+            assert not any(r[3] == "stall-on-b" for r in rs.data.rows)
+
+            rs = ca.execute(
+                f"KILL QUERY (session={cb.session_id}, plan=777)")
+            assert rs.error is None, rs.error
+            assert ev.is_set()
+        finally:
+            sess_b.queries.pop(777, None)
+            sess_b.running_kill.pop(777, None)
+        rs = ca.execute("KILL QUERY (session=999999, plan=1)")
+        assert rs.error is not None
     finally:
         c.stop()

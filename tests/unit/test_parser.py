@@ -254,3 +254,30 @@ def test_src_dst_prop():
     f = s.where.filter
     assert isinstance(f.lhs, SrcProp)
     assert to_text(f) == "($^.person.age > $$.person.age)"
+
+
+def test_host_literal_and_zone_spellings():
+    """Reference grammar spellings: "host":port two-token literals,
+    quoted zone names, optional [INTO [NEW] ZONE], DIVIDE ZONE."""
+    s = parse('ADD HOSTS "h1":9779, "h2:9779"')
+    assert s.hosts == ["h1:9779", "h2:9779"] and s.zone == "default"
+    s = parse('ADD HOSTS "h1":9779 INTO NEW ZONE "z1"')
+    assert s.hosts == ["h1:9779"] and s.zone == "z1"
+    s = parse('DROP HOSTS "h1":9779, "h2":9780')
+    assert s.hosts == ["h1:9779", "h2:9780"]
+    s = parse('DIVIDE ZONE "z" INTO "a" ("h1":1) "b" ("h2":2, "h3":3)')
+    assert s.zone == "z"
+    assert s.parts == [("a", ["h1:1"]), ("b", ["h2:2", "h3:3"])]
+    s = parse('MERGE ZONE "a", b INTO "c"')
+    assert s.zones == ["a", "b"] and s.into == "c"
+    with pytest.raises(ParseError):
+        parse('DIVIDE ZONE "z" INTO "a" ("h1":1)')   # needs >= 2 targets
+
+
+def test_show_scope_spellings():
+    for q, extra in [("SHOW LOCAL SESSIONS", "local"),
+                     ("SHOW ALL SESSIONS", None),
+                     ("SHOW LOCAL QUERIES", "local"),
+                     ("SHOW ALL QUERIES", None)]:
+        s = parse(q)
+        assert s.kind in ("sessions", "queries") and s.extra == extra, q
