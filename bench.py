@@ -192,6 +192,33 @@ def _ensure_live_backend():
     _reexec_cpu_fallback("device backend unreachable")
 
 
+_PARTIAL_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), ".bench_partial.json")
+# run-identity token: ties a checkpoint file to THIS invocation chain —
+# the re-exec'd fallback child inherits it via env, so a stale file
+# left by an unrelated killed run can never be salvaged as "this run's"
+_RUN_TOKEN = os.environ.get("_NEBULA_BENCH_RUN_TOKEN") \
+    or f"{os.getpid()}-{int(time.time())}"
+os.environ["_NEBULA_BENCH_RUN_TOKEN"] = _RUN_TOKEN
+
+
+def _save_partial(platform: str, configs: dict):
+    """Checkpoint completed per-config results.  A tunnel wedge MID-RUN
+    triggers the CPU-fallback re-exec, which previously discarded every
+    config the real chip had already finished; the fallback child now
+    salvages them into BENCH_DETAIL as `tpu_partial_configs`.  The
+    fallback child itself never checkpoints (cpu rows are never
+    salvaged, and writing would clobber the parent's real-chip file)."""
+    if os.environ.get("_NEBULA_BENCH_FALLBACK"):
+        return
+    try:
+        with open(_PARTIAL_PATH, "w") as f:
+            json.dump({"platform": platform, "ts": time.time(),
+                       "token": _RUN_TOKEN, "configs": configs}, f)
+    except OSError:
+        pass
+
+
 def _reexec_cpu_fallback(reason: str):
     """Replace this process with the virtual-CPU fallback run (fresh
     interpreter, axon registration disabled) so the driver always gets
@@ -315,6 +342,22 @@ def main():
     platform = rt.mesh.devices.reshape(-1)[0].platform
     configs = {}
 
+    # salvage: a prior REAL-CHIP run this invocation chain (the parent
+    # that stalled mid-run and re-exec'd us) checkpointed each finished
+    # config — those are real-chip numbers; carry them into the detail
+    tpu_partial = None
+    if fallback and os.path.exists(_PARTIAL_PATH):
+        try:
+            with open(_PARTIAL_PATH) as f:
+                prev = json.load(f)
+            if prev.get("platform") != "cpu" and prev.get("configs") \
+                    and prev.get("token") == _RUN_TOKEN:
+                tpu_partial = prev
+                _mark(f"salvaged {len(prev['configs'])} real-chip "
+                      f"config results from the stalled parent run")
+        except (OSError, ValueError):
+            pass
+
     # ---- configs 1 + 2: engine E2E on the dict store (identical rows) ----
     # The small graph is built THROUGH the bulk import path (VERDICT r3
     # item 6): LDBC-SNB-shaped '|'-delimited CSVs → tools/ldbc_import
@@ -391,12 +434,14 @@ def main():
         "cfg1", store,
         f"GO 2 STEPS FROM {seed_list} OVER KNOWS YIELD dst(edge) AS d",
         seeds, rt, numpy_fn=np_cfg1, canon=canon_cfg1)
+    _save_partial(platform, configs)
     _mark("config 2: engine e2e GO 3 STEPS filtered")
     configs["2_sf30_go3_filtered"] = bench_engine_config(
         "cfg2", store,
         f"GO 3 STEPS FROM {seed_list} OVER KNOWS WHERE KNOWS.w > 50 "
         f"YIELD dst(edge) AS d, KNOWS.w AS w",
         seeds, rt, numpy_fn=np_cfg2, canon=canon_cfg2)
+    _save_partial(platform, configs)
 
     # config 2b (BASELINE row 2's OVER * shape): multi-edge-type
     # expansion — two CSR blocks per hop on device (the per-edge-type
@@ -414,6 +459,7 @@ def main():
         "cfg2b", store,
         f"GO 3 STEPS FROM {seed_list} OVER * YIELD dst(edge) AS d",
         seeds, rt, numpy_fn=np_cfg2b, canon=canon_cfg1)
+    _save_partial(platform, configs)
 
     # config 3 (BASELINE: IC5/IC9-shaped): fixed-length MATCH pattern +
     # aggregate — Traverse + Aggregate executor composition, device
@@ -438,6 +484,7 @@ def main():
         f"WHERE id(p) IN [{ic_seeds}] AND ff.Person.age > 30 "
         f"RETURN id(ff) AS v, count(*) AS c",
         seeds, rt, numpy_fn=np_cfg3, canon=canon_cfg3)
+    _save_partial(platform, configs)
     rt.unpin("snb")
 
     # config 4 (BASELINE: Twitter-2010-shaped): variable-length *1..4
@@ -468,6 +515,7 @@ def main():
         f"MATCH (a:Person)-[e:KNOWS*1..4]->(b) WHERE id(a) IN [{tw_list}] "
         f"RETURN count(*) AS paths",
         tw_seeds, rt, space="tw", numpy_fn=np_cfg4, canon=canon_cfg4)
+    _save_partial(platform, configs)
     rt.unpin("tw")
 
     # ---- north-star-scale array graph (configs 5 + 6) ----
@@ -540,6 +588,7 @@ def main():
         "identical_rows": True,
         "buckets": {"EB": st.e_cap},
     }
+    _save_partial(platform, configs)
 
     # config 5: shortest-path BFS device plane, content-checked against
     # a numpy level-synchronous BFS (VERDICT r3 weak #5: oracle)
@@ -572,6 +621,7 @@ def main():
         "numpy_p50_ms": round(np_bfs_s * 1e3, 2),
         "distances_match_numpy": True,
     }
+    _save_partial(platform, configs)
 
     # VERDICT r3 item 2: the driver tails stdout into a small buffer, so
     # the headline must be COMPACT and LAST.  Full detail goes to
@@ -594,12 +644,14 @@ def main():
         "supernode_skew": skew,
         "configs": configs,
     }
+    if tpu_partial is not None:
+        detail["tpu_partial_configs"] = tpu_partial
     detail_path = os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "BENCH_DETAIL.json")
     with open(detail_path, "w") as f:
         json.dump(detail, f, indent=1)
     _mark(f"detail written to {detail_path}")
-    headline = json.dumps({
+    hl = {
         "metric": "traversed_edges_per_sec_go3step_e2e",
         "value": round(tpu_e2e_eps, 1),
         "unit": "edges/s",
@@ -608,7 +660,16 @@ def main():
         "fallback": bool(fallback),
         "kernel_vs_cpu": round(tpu_kernel_eps / cpu_eps, 3),
         "identical_rows": True,
-    })
+    }
+    if tpu_partial is not None:
+        hl["tpu_partial"] = len(tpu_partial["configs"])
+    headline = json.dumps(hl)
+    # full run recorded in detail — the checkpoint file has served its
+    # purpose either way (salvaged or superseded)
+    try:
+        os.remove(_PARTIAL_PATH)
+    except OSError:
+        pass
     assert len(headline) <= 500, len(headline)
     print(headline, flush=True)
 

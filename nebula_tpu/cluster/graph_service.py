@@ -149,23 +149,11 @@ class GraphService:
     def rpc_list_queries(self, p):
         """This graphd's RUNNING queries (SHOW [ALL] QUERIES fans out
         over every graphd named in metad's session table)."""
-        rows = []
-        for s in list(self.engine.sessions.values()):
-            for qid, qtext in list(s.queries.items()):
-                rows.append([s.id, qid, s.user, qtext, "RUNNING"])
-        return rows
+        return self.engine.list_running_queries()
 
     def rpc_kill_query(self, p):
         """Set the kill event of a RUNNING query on THIS graphd; returns
         whether anything matched (the issuing graphd raises if no owner
         matched anywhere)."""
-        sid, qid = p.get("session_id"), p.get("plan_id")
-        hit = False
-        for s in list(self.engine.sessions.values()):
-            if sid is not None and s.id != sid:
-                continue
-            for q, ev in list(s.running_kill.items()):
-                if qid is None or q == qid:
-                    ev.set()
-                    hit = True
-        return hit
+        return self.engine.kill_running(p.get("session_id"),
+                                        p.get("plan_id"))

@@ -80,6 +80,28 @@ class QueryEngine:
         s.killed = True
         return True
 
+    def list_running_queries(self) -> list:
+        """RUNNING-query rows [sid, qid, user, text, status] — the one
+        source for SHOW [LOCAL] QUERIES and the graphd fan-out RPC."""
+        rows = []
+        for s in list(self.sessions.values()):
+            for qid, qtext in list(s.queries.items()):
+                rows.append([s.id, qid, s.user, qtext, "RUNNING"])
+        return rows
+
+    def kill_running(self, sid=None, qid=None) -> bool:
+        """Set kill events of matching RUNNING queries; True if any
+        matched (shared by KILL QUERY local path and the graphd RPC)."""
+        hit = False
+        for s in list(self.sessions.values()):
+            if sid is not None and s.id != sid:
+                continue
+            for q, ev in list(s.running_kill.items()):
+                if qid is None or q == qid:
+                    ev.set()
+                    hit = True
+        return hit
+
     @property
     def slow_query_us(self) -> int:
         """Live: UPDATE CONFIGS / PUT /flags must take effect on a

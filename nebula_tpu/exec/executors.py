@@ -1560,26 +1560,21 @@ def _show(node, qctx, ectx, space):
             # fan out over every graphd in metad's session table — a
             # running query always belongs to a registered session, so
             # the addr set is complete; a dead graphd's queries died
-            # with it (skip)
-            from ..cluster.rpc import RpcClient
+            # with it (skip).  Short timeout, no retries: one hung
+            # graphd must not stall an interactive statement.
             rows = []
             for addr in sorted({s["graphd"]
                                 for s in cluster.list_sessions()
                                 if s.get("graphd")}):
                 try:
-                    got = RpcClient.from_addr(addr).call(
-                        "graph.list_queries")
+                    got = _graphd_call(addr, "graph.list_queries")
                 except Exception:  # noqa: BLE001 — graphd down
                     continue
                 rows.extend(list(r) + [addr] for r in got)
             return DataSet(qcols, rows)
         eng = getattr(qctx, "engine", None)
-        rows = []
-        if eng is not None:
-            for s in list(eng.sessions.values()):
-                for qid, qtext in list(s.queries.items()):
-                    rows.append([s.id, qid, s.user, qtext, "RUNNING",
-                                 "in-process"])
+        rows = [r + ["in-process"]
+                for r in (eng.list_running_queries() if eng else ())]
         return DataSet(qcols, rows)
     if kind == "configs":
         return DataSet(["Module", "Name", "Type", "Mode", "Value"],
@@ -1614,6 +1609,18 @@ def _need_cluster(qctx, what: str):
         raise ExecError(f"{what} needs cluster mode "
                         "(hosts/zones are a metad placement concept)")
     return cluster
+
+
+def _graphd_call(addr: str, method: str, **params):
+    """One short-deadline, no-retry call to a peer graphd (SHOW/KILL
+    QUERY fan-out): an unreachable peer costs ≤3 s, never the RPC
+    default of 30 s × 3 attempts, and the socket is closed."""
+    from ..cluster.rpc import RpcClient
+    cl = RpcClient.from_addr(addr, timeout=3.0, retries=0)
+    try:
+        return cl.call(method, **params)
+    finally:
+        cl.close()
 
 
 @executor("AddHosts")
@@ -1919,7 +1926,6 @@ def _kill_query(node, qctx, ectx, space):
     qid = node.args.get("plan_id")
     cluster = getattr(qctx, "cluster", None)
     if cluster is not None:
-        from ..cluster.rpc import RpcClient
         sessions = cluster.list_sessions()
         if sid is not None:
             addrs = [s["graphd"] for s in sessions if s["sid"] == sid]
@@ -1931,8 +1937,8 @@ def _kill_query(node, qctx, ectx, space):
         hit = False
         for addr in addrs:
             try:
-                hit |= bool(RpcClient.from_addr(addr).call(
-                    "graph.kill_query", session_id=sid, plan_id=qid))
+                hit |= bool(_graphd_call(addr, "graph.kill_query",
+                                         session_id=sid, plan_id=qid))
             except Exception:  # noqa: BLE001 — owner down: nothing runs
                 continue
         if not hit and (sid is not None or qid is not None):
@@ -1941,15 +1947,8 @@ def _kill_query(node, qctx, ectx, space):
         return DataSet()
     if eng is None:
         return DataSet()
-    targets = [s for s in list(eng.sessions.values())
-               if sid is None or s.id == sid]
-    hit = False
-    for s in targets:
-        for q, ev in list(s.running_kill.items()):
-            if qid is None or q == qid:
-                ev.set()
-                hit = True
-    if not hit and (sid is not None or qid is not None):
+    if not eng.kill_running(sid, qid) and (sid is not None
+                                           or qid is not None):
         raise ExecError(f"no running query matches "
                         f"(session={sid}, plan={qid})")
     return DataSet()
