@@ -12,7 +12,7 @@ from __future__ import annotations
 from typing import Any, Callable, List, Optional, Tuple
 
 from ..core.expr import (Binary, Expr, InputProp, join_conjuncts,
-                         split_conjuncts, walk)
+                         split_conjuncts, to_text, walk)
 from .plan import ExecutionPlan, PlanNode, transform_plan, walk_plan
 
 Rule = Callable[[PlanNode], Optional[PlanNode]]
@@ -466,9 +466,11 @@ def merge_adjacent_limits(node: PlanNode) -> Optional[PlanNode]:
     return node
 
 
-# NOTE deliberately ABSENT: a Sort(Sort(x)) → Sort(x) collapse.  The
-# engine's Sort is stable, so the inner sort is observable through ties
-# of the outer keys — collapsing changes row order for equal keys.
+# NOTE on Sort(Sort(x)): a plain drop-the-inner-sort collapse would be
+# WRONG — the engine's Sort is stable, so the inner sort is observable
+# through ties of the outer keys.  merge_consecutive_sorts (below)
+# instead folds the inner keys in as SECONDARY factors of one Sort,
+# which is order-identical and deletes the extra O(n log n) pass.
 
 @register_rule
 def eliminate_limit_zero(node: PlanNode) -> Optional[PlanNode]:
@@ -1176,6 +1178,135 @@ def push_filter_through_unwind(node: PlanNode) -> Optional[PlanNode]:
         node.args["condition"] = join_conjuncts(rest)
         return None
     return uw
+
+
+def _plain_col_refs(e: Expr) -> Optional[set]:
+    """Column names read through PLAIN references only (input_prop /
+    var / label) — None when the expr reads anything compound
+    (var.prop, label.tag.prop, $^/$$/edge), which name-level
+    substitution cannot re-home."""
+    names = set()
+    for x in walk(e):
+        if x.kind in ("input_prop", "var", "label"):
+            names.add(x.name)
+        elif x.kind in ("var_prop", "label_tag_prop", "src_prop",
+                        "edge_prop", "dst_prop", "vertex", "edge",
+                        "attribute"):
+            return None
+    return names
+
+
+@register_rule
+def push_filter_through_aggregate(node: PlanNode) -> Optional[PlanNode]:
+    """Filter(Aggregate) conjuncts reading only group-key OUTPUT columns
+    move below the Aggregate with the key exprs substituted back in
+    (reference: PushFilterDownAggregateRule): a group key is constant
+    within its group, so pre-filtering input rows drops exactly the
+    rejected groups — and the aggregate hashes fewer rows."""
+    from ..core.expr import rewrite
+    if node.kind != "Filter" or len(node.deps) != 1:
+        return None
+    agg = node.dep()
+    if agg.kind != "Aggregate" or len(agg.deps) != 1:
+        return None
+    # a MATCH tail (Aggregate over AppendVertices/Traverse) is the
+    # TpuMatchAgg fusion shape; planting a Filter inside it would break
+    # the device fusion for a host-side win that doesn't pay for it
+    if any(n.kind in ("AppendVertices", "Traverse")
+           for n in walk_plan(agg)):
+        return None
+    keys = agg.args.get("group_keys") or []
+    if not keys:
+        return None
+    key_texts = {to_text(k) for k in keys}
+    key_cols = {}
+    for e, n in agg.args.get("columns", []):
+        if to_text(e) in key_texts \
+                and not any(x.kind == "aggregate" for x in walk(e)):
+            key_cols[n] = e
+    cond = node.args.get("condition")
+    if cond is None or not key_cols:
+        return None
+    moved, rest = [], []
+    for c in split_conjuncts(cond):
+        refs = _plain_col_refs(c)
+        if refs and refs <= set(key_cols):
+            moved.append(rewrite(
+                c, lambda x: key_cols[x.name]
+                if x.kind in ("input_prop", "var", "label")
+                and x.name in key_cols else None))
+        else:
+            rest.append(c)
+    if not moved:
+        return None
+    child = agg.dep()
+    f = PlanNode("Filter", deps=[child], col_names=list(child.col_names),
+                 args={"condition": join_conjuncts(moved),
+                       "match_row": node.args.get("match_row", False)})
+    agg.deps[0] = f
+    agg.input_vars = [d.output_var for d in agg.deps]
+    if rest:
+        # return the mutated node (not None) so the fixpoint records a
+        # change and the next pass can keep pushing the planted Filter
+        # (e.g. through a Dedup below); re-entry terminates because the
+        # remaining conjuncts no longer reference only key columns
+        node.args["condition"] = join_conjuncts(rest)
+        return node
+    return agg
+
+
+@register_rule
+def merge_consecutive_sorts(node: PlanNode) -> Optional[PlanNode]:
+    """Sort/TopN over Sort → ONE node ordering by (outer keys, inner
+    keys).  Both executors sort stably, so the outer pass over
+    inner-sorted rows IS the composite order — merging preserves
+    byte-identical output while deleting a full O(n log n) pass
+    (reference: EliminateSortRule-family analog, kept exact)."""
+    if node.kind not in ("Sort", "TopN") or len(node.deps) != 1:
+        return None
+    inner = node.dep()
+    if inner.kind != "Sort" or len(inner.deps) != 1:
+        return None
+    outer_f = list(node.args.get("factors") or [])
+    inner_f = list(inner.args.get("factors") or [])
+    seen = {to_text(e) for e, _ in outer_f}
+    merged = outer_f + [(e, d) for e, d in inner_f
+                        if to_text(e) not in seen]
+    node.args["factors"] = merged
+    node.deps[0] = inner.dep()
+    node.input_vars = [d.output_var for d in node.deps]
+    return node
+
+
+# duplicate rows cannot change these folds: min/max are idempotent
+# under repetition, collect_set and bit_and/bit_or absorb duplicates
+_DUP_INSENSITIVE_AGGS = {"min", "max", "collect_set", "bit_and", "bit_or"}
+
+
+@register_rule
+def eliminate_dedup_under_dupfree_aggregate(node: PlanNode
+                                            ) -> Optional[PlanNode]:
+    """Aggregate(Dedup(x)) → Aggregate(x) when every output column is a
+    group key or a duplicate-insensitive / DISTINCT aggregate: dup rows
+    land in the same group and cannot move any such fold (reference:
+    EliminateAggDedupRule analog)."""
+    if node.kind != "Aggregate" or len(node.deps) != 1:
+        return None
+    dd = node.dep()
+    if dd.kind != "Dedup" or len(dd.deps) != 1:
+        return None
+    key_texts = {to_text(k) for k in (node.args.get("group_keys") or [])}
+    for e, _ in node.args.get("columns", []):
+        aggs = [x for x in walk(e) if x.kind == "aggregate"]
+        if aggs:
+            if not all(x.distinct or x.func in _DUP_INSENSITIVE_AGGS
+                       for x in aggs):
+                return None
+        elif to_text(e) not in key_texts:
+            return None          # impl-picked value could change
+    node.deps[0] = dd.dep()
+    node.input_vars = [d.output_var for d in node.deps]
+    return node
 
 
 @register_explore_rule

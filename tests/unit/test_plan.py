@@ -290,13 +290,34 @@ def test_push_limit_down_fulltext_scan(eng):
     assert scan.args.get("limit") == 2
 
 
-def test_adjacent_sorts_not_collapsed(eng):
+def test_adjacent_sorts_merge_exactly(eng):
     """Sort is stable, so an inner ORDER BY is observable through ties
-    of the outer keys — the optimizer must NOT collapse Sort(Sort)."""
+    of the outer keys — merge_consecutive_sorts must keep it observable
+    by folding the inner keys in as SECONDARY factors of one Sort
+    (ordering by (outer, inner) == stable outer pass over inner-sorted
+    rows), never by dropping the inner sort."""
     q = ('GO FROM "a" OVER knows YIELD dst(edge) AS d '
          '| ORDER BY $-.d DESC | ORDER BY $-.d ASC')
     p = plan_of(eng, q)
-    assert p.root.kind_tree().count("Sort") == 2
+    assert p.root.kind_tree().count("Sort") == 1
+    # row parity with the optimizer off, ties included
+    from nebula_tpu.exec import QueryEngine
+    seed = eng.qctx.store
+    s2 = eng._sess
+    eng.execute(s2, 'INSERT VERTEX person(name, age) VALUES '
+                '"a":("a", 1), "b":("b", 2), "c":("c", 3), "d":("d", 4)')
+    eng.execute(s2, 'INSERT EDGE knows(since) VALUES "a"->"b":(7), '
+                '"a"->"c":(7), "a"->"d":(5)')
+    q2 = ('GO FROM "a" OVER knows YIELD dst(edge) AS d, '
+          'knows.since AS s | ORDER BY $-.s DESC | ORDER BY $-.s ASC')
+    plain = QueryEngine(seed, enable_optimizer=False)
+    sp = plain.new_session()
+    plain.execute(sp, "USE t")
+    want = plain.execute(sp, q2)
+    assert want.error is None, want.error
+    got = eng.execute(s2, q2)
+    assert got.error is None, got.error
+    assert got.data.rows == want.data.rows    # IN ORDER, ties intact
 
 
 def test_eliminate_limit_zero(eng):
@@ -625,3 +646,85 @@ def test_planted_topn_not_replanted_through_project(eng):
     kinds = [n.kind for n in walk_plan(p.root)]
     # exactly one planted TopN per branch + the outer cut — no stacking
     assert kinds.count("TopN") == 3, kinds
+
+
+def test_push_filter_through_aggregate(eng):
+    """Group-key predicates move below the Aggregate (substituted back
+    to the key expr); aggregate-output predicates stay above."""
+    from nebula_tpu.core.expr import (AggExpr, Binary, InputProp, Literal)
+    from nebula_tpu.query.plan import PlanNode
+    base = PlanNode("Start", col_names=["k", "v"])
+    agg = PlanNode("Aggregate", deps=[base], col_names=["k", "n"],
+                   args={"group_keys": [InputProp("k")],
+                         "columns": [(InputProp("k"), "k"),
+                                     (AggExpr("count", InputProp("v")),
+                                      "n")]})
+    cond = Binary("AND",
+                  Binary(">", InputProp("k"), Literal(3)),
+                  Binary(">", InputProp("n"), Literal(1)))
+    f = PlanNode("Filter", deps=[agg], col_names=["k", "n"],
+                 args={"condition": cond})
+    p = optimize(ExecutionPlan(f, "t"))
+    # key conjunct below the Aggregate, count conjunct above
+    assert p.root.kind == "Filter"
+    agg2 = p.root.dep()
+    assert agg2.kind == "Aggregate"
+    assert agg2.dep().kind == "Filter"
+    from nebula_tpu.core.expr import to_text
+    assert "k" in to_text(agg2.dep().args["condition"])
+
+
+def test_merge_consecutive_sorts(eng):
+    """ORDER BY piped into ORDER BY = one stable sort on (outer, inner)
+    keys."""
+    rs = eng.execute(eng._sess, "EXPLAIN YIELD 3 AS a, 1 AS b "
+                     "| ORDER BY $-.b | ORDER BY $-.a")
+    desc = rs.data.rows[0][0]
+    assert desc.count("Sort") == 1, desc
+    assert "$-.a" in desc and "$-.b" in desc   # composite factors
+
+
+def test_eliminate_dedup_under_dupfree_aggregate(eng):
+    from nebula_tpu.core.expr import AggExpr, InputProp
+    from nebula_tpu.query.plan import PlanNode
+    base = PlanNode("Start", col_names=["k", "v"])
+    for func, distinct, gone in (("min", False, True),
+                                 ("collect_set", False, True),
+                                 ("count", True, True),
+                                 ("count", False, False),
+                                 ("sum", False, False)):
+        dd = PlanNode("Dedup", deps=[base], col_names=["k", "v"], args={})
+        agg = PlanNode("Aggregate", deps=[dd], col_names=["k", "m"],
+                       args={"group_keys": [InputProp("k")],
+                             "columns": [(InputProp("k"), "k"),
+                                         (AggExpr(func, InputProp("v"),
+                                                  distinct), "m")]})
+        p = optimize(ExecutionPlan(agg, "t"))
+        kinds = p.root.kind_tree()
+        if gone:
+            assert "Dedup" not in kinds, (func, distinct, kinds)
+        else:
+            assert "Dedup" in kinds, (func, distinct, kinds)
+
+
+def test_filter_through_aggregate_keeps_pushing(eng):
+    """A partially-pushed group-key filter must keep commuting in later
+    fixpoint passes (here: through the Dedup under the Aggregate) —
+    the rule returns the mutated node so `changed` is recorded."""
+    from nebula_tpu.core.expr import AggExpr, Binary, InputProp, Literal
+    from nebula_tpu.query.plan import PlanNode
+    base = PlanNode("Start", col_names=["k", "v"])
+    dd = PlanNode("Dedup", deps=[base], col_names=["k", "v"], args={})
+    agg = PlanNode("Aggregate", deps=[dd], col_names=["k", "n"],
+                   args={"group_keys": [InputProp("k")],
+                         "columns": [(InputProp("k"), "k"),
+                                     (AggExpr("count", InputProp("v")),
+                                      "n")]})
+    cond = Binary("AND",
+                  Binary(">", InputProp("k"), Literal(3)),
+                  Binary(">", InputProp("n"), Literal(1)))
+    f = PlanNode("Filter", deps=[agg], col_names=["k", "n"],
+                 args={"condition": cond})
+    p = optimize(ExecutionPlan(f, "t"))
+    assert p.root.kind_tree() == \
+        ["Filter", "Aggregate", "Dedup", "Filter", "Start"]
