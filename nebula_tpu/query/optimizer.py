@@ -1180,19 +1180,27 @@ def push_filter_through_unwind(node: PlanNode) -> Optional[PlanNode]:
     return uw
 
 
+# expr kinds expr.rewrite() traverses AND whose column references are
+# plain names — a WHITELIST: substitution through any other kind (slice,
+# list_comprehension, reduce, compound refs, ...) either can't reach the
+# nested reference or can't re-home it, so such conjuncts never move
+_SUBSTITUTABLE_KINDS = frozenset((
+    "literal", "input_prop", "var", "label", "binary", "unary", "list",
+    "map", "function", "aggregate", "subscript", "case", "cast"))
+
+
 def _plain_col_refs(e: Expr) -> Optional[set]:
-    """Column names read through PLAIN references only (input_prop /
-    var / label) — None when the expr reads anything compound
-    (var.prop, label.tag.prop, $^/$$/edge), which name-level
-    substitution cannot re-home."""
+    """Column names read through PLAIN references only — None when the
+    expr contains ANY node kind outside the substitution whitelist
+    (rewrite() must be able to traverse to, and rename, every column
+    reference; a nested ref it can't reach would be pushed verbatim and
+    bind to the wrong input column)."""
     names = set()
     for x in walk(e):
+        if x.kind not in _SUBSTITUTABLE_KINDS:
+            return None
         if x.kind in ("input_prop", "var", "label"):
             names.add(x.name)
-        elif x.kind in ("var_prop", "label_tag_prop", "src_prop",
-                        "edge_prop", "dst_prop", "vertex", "edge",
-                        "attribute"):
-            return None
     return names
 
 

@@ -417,13 +417,30 @@ def _plan_group_by(pctx, s: A.GroupBySentence) -> PlanNode:
     if dep is None:
         raise QueryError("GROUP BY requires piped input")
     cols = [(c.expr, _col_name(c)) for c in s.yield_.columns]
+    _check_input_cols(list(s.keys) + [e for e, _ in cols], dep,
+                      "GROUP BY")
     return _plan_aggregate(dep, cols, s.keys)
+
+
+def _check_input_cols(exprs, dep, what: str):
+    """Every `$-.name` reference must name a column of the pipe input —
+    a typo'd column otherwise sorts/groups on NULL silently (reference
+    raises SemanticError at validation)."""
+    from ..core.expr import walk as _walk
+    cols = set(dep.col_names)
+    for e in exprs:
+        for x in _walk(e):
+            if x.kind == "input_prop" and x.name not in cols:
+                raise QueryError(
+                    f"`$-.{x.name}' not found in {what} input "
+                    f"(columns: {sorted(cols)})")
 
 
 def _plan_order_by(pctx, s: A.OrderBySentence) -> PlanNode:
     dep = pctx.input_node
     if dep is None:
         raise QueryError("ORDER BY requires piped input")
+    _check_input_cols([f.expr for f in s.factors], dep, "ORDER BY")
     return PlanNode("Sort", deps=[dep], col_names=list(dep.col_names),
                     args={"factors": [(f.expr, f.ascending) for f in s.factors]})
 

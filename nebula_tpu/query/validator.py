@@ -462,6 +462,89 @@ def _register_sentence_validators():
             if not _has_tag(pctx, t):
                 raise ValidationError(f"tag `{t}' not found")
 
+    _GRANTABLE = ("ADMIN", "DBA", "USER", "GUEST")
+
+    def v_role(stmt, pctx):
+        r = (stmt.role or "").upper()
+        if r == "GOD":
+            raise ValidationError(
+                "GOD role can not be granted or revoked")
+        if r not in _GRANTABLE:
+            raise ValidationError(
+                f"role `{stmt.role}' does not exist "
+                f"(one of {', '.join(_GRANTABLE)})")
+
+    _SENTENCE_VALIDATORS[A.GrantRoleSentence] = v_role
+    _SENTENCE_VALIDATORS[A.RevokeRoleSentence] = v_role
+
+    @_svalidator(A.AlterSchemaSentence)
+    def v_alter_schema(stmt, pctx):
+        """ALTER TAG/EDGE op conformance: DROP/CHANGE name an existing
+        property, ADD a new one, TTL column int/timestamp-typed and
+        present after the alter (reference: AlterSchema validators)."""
+        if not pctx.space:
+            return
+        getter = (pctx.catalog.get_edge if stmt.is_edge
+                  else pctx.catalog.get_tag)
+        try:
+            sv = getter(pctx.space, stmt.name).latest
+        except SchemaError:
+            kind = "edge" if stmt.is_edge else "tag"
+            raise ValidationError(f"{kind} `{stmt.name}' not found")
+        have = {p.name for p in sv.props}
+        for n in stmt.drops:
+            if n not in have:
+                raise ValidationError(
+                    f"`{stmt.name}' has no property `{n}' to drop")
+            if sv.ttl_col and n == sv.ttl_col and not stmt.ttl_col:
+                raise ValidationError(
+                    f"`{n}' is the TTL column of `{stmt.name}' — "
+                    f"reset TTL_COL before dropping it")
+        for p in stmt.changes:
+            if p.name not in have:
+                raise ValidationError(
+                    f"`{stmt.name}' has no property `{p.name}' to change")
+        dropped = set(stmt.drops)
+        for p in stmt.adds:
+            if p.name in have and p.name not in dropped:
+                raise ValidationError(
+                    f"property `{p.name}' already exists on "
+                    f"`{stmt.name}'")
+        if stmt.ttl_col:
+            # catalog PropDefs carry a PropType enum; AST prop defs a
+            # type_name string — normalize both to the spelled type
+            after = {p.name: p.ptype.value for p in sv.props
+                     if p.name not in dropped}
+            after.update({p.name: p.type_name
+                          for p in list(stmt.adds) + list(stmt.changes)})
+            tn = after.get(stmt.ttl_col)
+            if tn is None:
+                raise ValidationError(
+                    f"TTL column `{stmt.ttl_col}' does not exist")
+            if tn.upper() not in ("INT", "INT64", "TIMESTAMP"):
+                raise ValidationError(
+                    f"TTL column `{stmt.ttl_col}' must be "
+                    f"int/timestamp typed")
+
+    @_svalidator(A.DropSchemaSentence)
+    def v_drop_schema(stmt, pctx):
+        """Reference semantics: a schema with a live index can not be
+        dropped — the index must go first."""
+        if not pctx.space:
+            return
+        get = _has_edge if stmt.is_edge else _has_tag
+        if not get(pctx, stmt.name):
+            return               # IF EXISTS handling stays downstream
+        related = list(pctx.catalog.indexes_for(pctx.space, stmt.name,
+                                                stmt.is_edge))
+        related += list(pctx.catalog.fulltext_indexes_for(
+            pctx.space, stmt.name, stmt.is_edge))
+        if related:
+            kind = "edge" if stmt.is_edge else "tag"
+            raise ValidationError(
+                f"{kind} `{stmt.name}' has index "
+                f"`{related[0].name}' — drop the index first")
+
 
 _register_sentence_validators()
 

@@ -1,0 +1,120 @@
+Feature: Semantic error conformance — schema, roles, pipe columns
+
+  Background:
+    Given having executed:
+      """
+      CREATE SPACE sea(partition_num=2, vid_type=INT64);
+      USE sea;
+      CREATE TAG person(age int);
+      CREATE EDGE knows(w int);
+      CREATE TAG INDEX sea_age ON person(age);
+      INSERT VERTEX person(age) VALUES 1:(20), 2:(30);
+      INSERT EDGE knows(w) VALUES 1->2:(5), 2->1:(7)
+      """
+
+  Scenario: order by an unknown pipe column
+    When executing query:
+      """
+      GO FROM 1 OVER knows YIELD dst(edge) AS d | ORDER BY $-.nope
+      """
+    Then a SemanticError should be raised
+
+  Scenario: group by an unknown pipe column
+    When executing query:
+      """
+      GO FROM 1 OVER knows YIELD dst(edge) AS d
+      | GROUP BY $-.nope YIELD count(*) AS n
+      """
+    Then a SemanticError should be raised
+
+  Scenario: group-by yield referencing an unknown pipe column
+    When executing query:
+      """
+      GO FROM 1 OVER knows YIELD dst(edge) AS d
+      | GROUP BY $-.d YIELD $-.ghost AS g, count(*) AS n
+      """
+    Then a SemanticError should be raised
+
+  Scenario: order by a known column still works
+    When executing query:
+      """
+      GO FROM 1 OVER knows YIELD dst(edge) AS d | ORDER BY $-.d
+      """
+    Then the result should be, in order:
+      | d |
+      | 2 |
+
+  Scenario: god role can not be granted
+    When executing query:
+      """
+      GRANT ROLE GOD ON sea TO root
+      """
+    Then a SemanticError should be raised
+
+  Scenario: unknown role can not be granted
+    When executing query:
+      """
+      GRANT ROLE WIZARD ON sea TO root
+      """
+    Then a SemanticError should be raised
+
+  Scenario: alter drop of a missing property
+    When executing query:
+      """
+      ALTER TAG person DROP (ghost)
+      """
+    Then a SemanticError should be raised
+
+  Scenario: alter change of a missing property
+    When executing query:
+      """
+      ALTER EDGE knows CHANGE (ghost int)
+      """
+    Then a SemanticError should be raised
+
+  Scenario: alter add of an existing property
+    When executing query:
+      """
+      ALTER TAG person ADD (age int)
+      """
+    Then a SemanticError should be raised
+
+  Scenario: alter ttl on a string column
+    When executing query:
+      """
+      ALTER TAG person ADD (nick string), TTL_DURATION = 10, TTL_COL = "nick"
+      """
+    Then a SemanticError should be raised
+
+  Scenario: drop tag with a live index
+    When executing query:
+      """
+      DROP TAG person
+      """
+    Then a SemanticError should be raised
+
+  Scenario: drop tag after dropping the index
+    When executing query:
+      """
+      DROP TAG INDEX sea_age;
+      DROP TAG person;
+      SHOW TAGS
+      """
+    Then the result should be empty
+
+  Scenario: dropping the active ttl column is refused
+    When executing query:
+      """
+      CREATE TAG t2(name string, age int) TTL_DURATION = 100, TTL_COL = "age";
+      ALTER TAG t2 DROP (age)
+      """
+    Then a SemanticError should be raised
+
+  Scenario: drop tag with a live fulltext index
+    When executing query:
+      """
+      CREATE TAG t3(name string);
+      CREATE FULLTEXT TAG INDEX ft3 ON t3(name);
+      DROP TAG t3
+      """
+    Then a SemanticError should be raised

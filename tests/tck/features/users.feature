@@ -78,7 +78,7 @@ Feature: User management
       CREATE USER u7 WITH PASSWORD "x";
       GRANT ROLE GOD ON ua TO u7
       """
-    Then an ExecutionError should be raised
+    Then a SemanticError should be raised
 
   Scenario: grant on missing space errors
     When executing query:

@@ -728,3 +728,29 @@ def test_filter_through_aggregate_keeps_pushing(eng):
     p = optimize(ExecutionPlan(f, "t"))
     assert p.root.kind_tree() == \
         ["Filter", "Aggregate", "Dedup", "Filter", "Start"]
+
+
+def test_filter_through_aggregate_skips_untraversable_exprs(eng):
+    """A key-column reference nested inside an expr kind rewrite()
+    cannot traverse (here: a slice) must NOT be pushed — the verbatim
+    push would bind the name to a different input column (code-review
+    r4: wrong-results repro)."""
+    from nebula_tpu.exec import QueryEngine
+    st = eng.qctx.store
+    s = eng._sess
+    eng.execute(s, 'INSERT VERTEX person(name, age) VALUES '
+                '"a":("a", 1), "b":("b", 2)')
+    eng.execute(s, 'INSERT EDGE knows(since) VALUES "a"->"b":(5), '
+                '"b"->"a":(7)')
+    q = ('GO FROM "a", "b" OVER knows YIELD knows.since AS s, [1,2] AS k '
+         '| GROUP BY $-.s YIELD $-.s AS k, count(*) AS n '
+         '| YIELD $-.k AS k WHERE size($-.k[0..1]) >= 1')
+    plain = QueryEngine(st, enable_optimizer=False)
+    sp = plain.new_session()
+    plain.execute(sp, "USE t")
+    want = plain.execute(sp, q)
+    got = eng.execute(s, q)
+    assert want.error is None and got.error is None, \
+        (want.error, got.error)
+    assert sorted(map(repr, got.data.rows)) == \
+        sorted(map(repr, want.data.rows))
