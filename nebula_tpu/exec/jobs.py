@@ -32,19 +32,81 @@ class Job:
 
 
 class JobManager:
+    """Async admin-task manager (SURVEY §2 row 16, the AdminTaskManager
+    analog): SUBMIT returns the job id immediately; a bounded worker
+    pool (flag max_concurrent_admin_jobs) drains the QUEUE — excess
+    submissions wait their turn (task throttling), STOP JOB cancels a
+    QUEUE'd job outright and interrupts a RUNNING one at its next
+    cancel point, and wait() is the test/console convenience for the
+    reference TCK's "wait the job to finish" step."""
+
     def __init__(self):
+        import threading
         self.jobs: Dict[int, Job] = {}
         self._ids = itertools.count(1)   # per-manager: deterministic ids
+        self._lock = threading.Lock()
+        self._queue: list = []           # pending (job, qctx)
+        self._running = 0
+
+    @staticmethod
+    def _max_concurrent() -> int:
+        from ..utils.config import get_config
+        try:
+            return max(1, int(get_config().get(
+                "max_concurrent_admin_jobs")))
+        except Exception:  # noqa: BLE001 — config missing in odd embeds
+            return 2
 
     def submit(self, qctx, command: str, space: Optional[str]) -> Job:
         import threading
-        job = Job(next(self._ids), command, space=space,
-                  cancel=threading.Event())
-        self.jobs[job.job_id] = job
-        job.status = "RUNNING"
-        job.start_time = time.time()
+        with self._lock:
+            job = Job(next(self._ids), command, space=space,
+                      cancel=threading.Event())
+            self.jobs[job.job_id] = job
+            self._queue.append((job, qctx))
+            self._dispatch_locked()
+        return job
+
+    def enqueue_rerun(self, job: Job, qctx):
+        """RECOVER JOB: put a FAILED/STOPPED job back on the queue."""
+        with self._lock:
+            job.status = "QUEUE"
+            if job.cancel is not None:
+                job.cancel.clear()   # the re-run gets a LIVE cancel token
+            self._queue.append((job, qctx))
+            self._dispatch_locked()
+
+    def stop(self, job: Job):
+        """STOP JOB under the manager lock: purge the queue entry (a
+        stale tuple would re-dispatch after RECOVER — double execution)
+        and serialize against the QUEUE→RUNNING promotion; a RUNNING
+        job only gets its cancel event (aborts at its next cancel
+        point)."""
+        with self._lock:
+            self._queue = [(j, q) for (j, q) in self._queue
+                           if j is not job]
+            if job.cancel is not None:
+                job.cancel.set()
+            if job.status != "RUNNING":
+                job.status = "STOPPED"
+                job.stop_time = time.time()
+
+    def _dispatch_locked(self):
+        import threading
+        while self._queue and self._running < self._max_concurrent():
+            job, qctx = self._queue.pop(0)
+            if job.status == "STOPPED":
+                continue             # STOP JOB beat the dispatcher
+            self._running += 1
+            job.status = "RUNNING"
+            job.start_time = time.time()
+            threading.Thread(target=self._worker, args=(job, qctx),
+                             daemon=True,
+                             name=f"admin-job-{job.job_id}").start()
+
+    def _worker(self, job: Job, qctx):
         try:
-            job.result = self._run(qctx, command, space, job)
+            job.result = self._run(qctx, job.command, job.space, job)
             job.status = "FINISHED"
         except JobStopped:
             job.status = "STOPPED"
@@ -52,8 +114,26 @@ class JobManager:
         except Exception as ex:  # noqa: BLE001 - job errors are recorded
             job.status = "FAILED"
             job.result = {"error": str(ex)}
-        job.stop_time = time.time()
-        return job
+        finally:
+            job.stop_time = time.time()
+            with self._lock:
+                self._running -= 1
+                self._dispatch_locked()
+
+    def wait(self, job_id: Optional[int] = None,
+             timeout: float = 60.0) -> bool:
+        """Block until the job (or ALL jobs) leave QUEUE/RUNNING."""
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            with self._lock:
+                live = any(
+                    (job_id is None or j.job_id == job_id)
+                    and j.status in ("QUEUE", "RUNNING")
+                    for j in self.jobs.values())
+            if not live:
+                return True
+            time.sleep(0.005)
+        return False
 
     def _run(self, qctx, command: str, space: Optional[str],
              job: Optional[Job] = None) -> Dict[str, Any]:
@@ -153,28 +233,24 @@ def submit_job(node, qctx) -> DataSet:
 
 
 def stop_job(node, qctx) -> DataSet:
-    """STOP JOB <id>: single-process jobs run synchronously, so a live
-    job can't actually be interrupted — QUEUE'd jobs are cancelled and
-    anything unfinished is marked STOPPED (the reference semantics for
-    an already-finished job: an error)."""
+    """STOP JOB <id>: a QUEUE'd job is cancelled outright; a RUNNING
+    one gets its cancel event set and aborts at its next cancel point
+    (repartition: between source partitions).  Stopping a FINISHED job
+    is an error (reference semantics)."""
     jid = node.args["job_id"]
-    job = job_manager(qctx.store).jobs.get(jid)
+    mgr = job_manager(qctx.store)
+    job = mgr.jobs.get(jid)
     if job is None:
         raise ValueError(f"job {jid} not found")
     if job.status == "FINISHED":
         raise ValueError(f"job {jid} already finished")
-    if job.cancel is not None:
-        job.cancel.set()         # a RUNNING task aborts at its next
-        # cancel point (repartition: between source partitions)
-    if job.status != "RUNNING":
-        job.status = "STOPPED"
-        job.stop_time = time.time()
+    mgr.stop(job)
     return DataSet(["Result"], [["Job stopped"]])
 
 
 def recover_job(node, qctx) -> DataSet:
-    """RECOVER JOB [<id>]: re-run FAILED/STOPPED jobs (all of them when
-    no id is given); returns how many were recovered."""
+    """RECOVER JOB [<id>]: re-queue FAILED/STOPPED jobs (all of them
+    when no id is given); returns how many were re-queued."""
     mgr = job_manager(qctx.store)
     jid = node.args.get("job_id")
     targets = [j for j in mgr.jobs.values()
@@ -185,25 +261,9 @@ def recover_job(node, qctx) -> DataSet:
         if j is None:
             raise ValueError(f"job {jid} not found")
         raise ValueError(f"job {jid} is {j.status}, not recoverable")
-    n = 0
     for j in targets:
-        j.status = "RUNNING"
-        j.start_time = time.time()
-        if j.cancel is not None:
-            j.cancel.clear()     # the re-run gets a LIVE cancel token —
-            # STOP JOB on a recovered task must still work
-        try:
-            j.result = mgr._run(qctx, j.command, j.space, j)
-            j.status = "FINISHED"
-        except JobStopped:
-            j.status = "STOPPED"
-            j.result = {"stopped": True}
-        except Exception as ex:  # noqa: BLE001 — job errors are recorded
-            j.status = "FAILED"
-            j.result = {"error": str(ex)}
-        j.stop_time = time.time()
-        n += 1
-    return DataSet(["Recovered job num"], [[n]])
+        mgr.enqueue_rerun(j, qctx)
+    return DataSet(["Recovered job num"], [[len(targets)]])
 
 
 def show_jobs(node, qctx) -> DataSet:

@@ -159,6 +159,9 @@ define_flag("tpu_init_edge_budget", 2048,
             "initial per-block edge budget (power of two)")
 define_flag("scheduler_threads", 4,
             "plan-branch concurrency; 0/1 = sequential")
+define_flag("max_concurrent_admin_jobs", 2,
+            "admin-job worker slots; queued jobs wait (task throttling, "
+            "the AdminTaskManager analog)")
 define_flag("host_hb_expire_secs", 10.0,
             "heartbeat age after which a host reads as dead")
 define_flag("tpu_match_device", True,

@@ -179,6 +179,33 @@ def check_result(data, table: List[List[str]], ordered: bool) -> Optional[str]:
     return None
 
 
+_JOB_STMT = re.compile(
+    r"\b(SUBMIT\s+JOB|REBUILD\s+|BALANCE\b|RECOVER\s+JOB)", re.I)
+
+
+def _settle_jobs(eng, sess) -> None:
+    """Admin jobs are ASYNC (bounded worker pool, reference
+    AdminTaskManager semantics); the reference TCK interleaves explicit
+    'wait the job to finish' steps — this runner settles automatically
+    after any job-submitting statement so scenarios stay declarative."""
+    import time as _t
+    qctx = getattr(eng, "qctx", None)
+    if qctx is not None:
+        mgr = getattr(qctx.store, "_job_manager", None)
+        if mgr is not None:
+            assert mgr.wait(timeout=60.0), "admin jobs did not settle"
+        return
+    # cluster client: poll the statement surface
+    deadline = _t.time() + 60
+    while _t.time() < deadline:
+        rs = eng.execute(sess, "SHOW JOBS")
+        if rs.error is not None or not any(
+                r[2] in ("QUEUE", "RUNNING") for r in rs.data.rows):
+            return
+        _t.sleep(0.02)
+    raise AssertionError("admin jobs did not settle (cluster)")
+
+
 def run_scenario(scn: Scenario, make_engine) -> None:
     """Execute a scenario against a fresh engine; raises AssertionError
     with context on any mismatch."""
@@ -189,6 +216,8 @@ def run_scenario(scn: Scenario, make_engine) -> None:
         if step.kind in ("exec", "query"):
             for stmt in [s for s in step.text.split(";") if s.strip()]:
                 last = eng.execute(sess, stmt)
+                if last.error is None and _JOB_STMT.search(stmt):
+                    _settle_jobs(eng, sess)
                 if step.kind == "exec":
                     assert last.error is None, \
                         f"{where} setup failed: {stmt!r}: {last.error}"

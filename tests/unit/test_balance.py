@@ -8,6 +8,15 @@ import pytest
 
 from nebula_tpu.utils.config import get_config
 
+def _wait_jobs(cluster):
+    """Admin jobs are async (r4): settle every graphd's manager."""
+    from nebula_tpu.exec.jobs import job_manager
+    for g in cluster.graphds:
+        mgr = getattr(g.engine.qctx.store, "_job_manager", None)
+        if mgr is not None:
+            assert mgr.wait()
+
+
 
 def _setup_space(client, cluster, parts=4, rf=1):
     rs = client.execute(
@@ -53,6 +62,7 @@ def test_balance_data_expands_to_new_host(tmp_path):
         b_addr = ss_b.my_addr
         rs = client.execute("SUBMIT JOB BALANCE DATA")
         assert rs.error is None, rs.error
+        _wait_jobs(c)
 
         # the part map now spreads over both hosts, 2 + 2
         meta = c.graphds[0].meta
@@ -102,6 +112,7 @@ def test_balance_data_heals_after_host_death(tmp_path):
 
         rs = client.execute("SUBMIT JOB BALANCE DATA")
         assert rs.error is None, rs.error
+        _wait_jobs(c)
 
         meta = c.graphds[0].meta
         meta.refresh(force=True)
@@ -135,6 +146,7 @@ def test_balance_leader_spreads_leadership(tmp_path):
 
         rs = client.execute("SUBMIT JOB BALANCE LEADER")
         assert rs.error is None, rs.error
+        _wait_jobs(c)
 
         # count actual raft leaders per host: 2 + 2.  Under full-suite
         # CPU load a starved election can undo a transfer right after
@@ -153,6 +165,7 @@ def test_balance_leader_spreads_leadership(tmp_path):
                 break
             time.sleep(0.3)
             client.execute("SUBMIT JOB BALANCE LEADER")
+            _wait_jobs(c)
         assert sorted(counts.values()) == [2, 2], counts
     finally:
         c.stop()
@@ -184,6 +197,7 @@ def test_balance_heal_preserves_zone_isolation(tmp_path):
 
         rs = client.execute("SUBMIT JOB BALANCE DATA")
         assert rs.error is None, rs.error
+        _wait_jobs(c)
         meta = c.graphds[0].meta
         meta.refresh(force=True)
         za, zb = set(addrs[:2]), {addrs[3]}     # zb minus the dead host

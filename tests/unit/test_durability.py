@@ -25,6 +25,9 @@ def _populate(store):
     ]:
         rs = eng.execute(s, q)
         assert rs.error is None, (q, rs.error)
+        if "REBUILD" in q:
+            from nebula_tpu.exec.jobs import job_manager
+            assert job_manager(store).wait()    # jobs are async (r4)
     return eng, s
 
 
@@ -63,6 +66,8 @@ def test_recovery_after_compaction(tmp_path):
     eng, s = _populate(store)
     rs = eng.execute(s, "SUBMIT JOB COMPACT")
     assert rs.error is None
+    from nebula_tpu.exec.jobs import job_manager
+    assert job_manager(store).wait()        # jobs are async (r4)
     # post-compaction writes land in the fresh journal
     rs = eng.execute(s, 'INSERT VERTEX person(name, age) VALUES 9:("zed", 50)')
     assert rs.error is None

@@ -491,3 +491,84 @@ def test_kill_query_aborts_running_statement():
     # killing a nonexistent query errors
     rs = eng.execute(killer, "KILL QUERY (session=999999, plan=1)")
     assert rs.error is not None
+
+
+def test_admin_jobs_async_lifecycle():
+    """The job manager is ASYNC (AdminTaskManager analog): SUBMIT
+    returns immediately with the job QUEUE'd/RUNNING, the worker pool
+    is bounded by max_concurrent_admin_jobs (throttling), STOP JOB
+    cancels a QUEUE'd job outright, and RECOVER re-queues it."""
+    import threading
+    import time as _t
+
+    from nebula_tpu.exec.jobs import JobManager, job_manager
+    from nebula_tpu.graphstore.store import GraphStore
+    from nebula_tpu.utils.config import get_config
+
+    store = GraphStore()
+    eng = QueryEngine(store)
+    s = eng.new_session()
+    for q in ["CREATE SPACE aj(partition_num=2, vid_type=INT64)",
+              "USE aj", "CREATE TAG t(x int)"]:
+        assert eng.execute(s, q).error is None
+
+    mgr = job_manager(store)
+    gate = threading.Event()
+    orig_run = JobManager._run
+    runs_per_job = {}
+
+    def slow_run(self, qctx, command, space, job=None):
+        if command == "stats":
+            if job is not None:
+                runs_per_job[job.job_id] =                     runs_per_job.get(job.job_id, 0) + 1
+            assert gate.wait(10)
+            if job is not None and job.cancel.is_set():
+                from nebula_tpu.exec.jobs import JobStopped
+                raise JobStopped()
+        return orig_run(self, qctx, command, space, job)
+
+    JobManager._run = slow_run
+    try:
+        get_config().set_dynamic("max_concurrent_admin_jobs", 1)
+        rs = eng.execute(s, "SUBMIT JOB STATS")
+        assert rs.error is None
+        j1 = rs.data.rows[0][0]
+        rs = eng.execute(s, "SUBMIT JOB STATS")
+        j2 = rs.data.rows[0][0]
+        rs = eng.execute(s, "SUBMIT JOB STATS")
+        j3 = rs.data.rows[0][0]
+        deadline = _t.time() + 5
+        while _t.time() < deadline \
+                and mgr.jobs[j1].status != "RUNNING":
+            _t.sleep(0.01)
+        # throttled: one RUNNING, the rest QUEUE'd
+        assert mgr.jobs[j1].status == "RUNNING"
+        assert mgr.jobs[j2].status == "QUEUE"
+        assert mgr.jobs[j3].status == "QUEUE"
+        # STOP a QUEUE'd job: cancelled outright, never runs
+        rs = eng.execute(s, f"STOP JOB {j3}")
+        assert rs.error is None
+        assert mgr.jobs[j3].status == "STOPPED"
+        # STOP the RUNNING job: aborts at its cancel point
+        rs = eng.execute(s, f"STOP JOB {j1}")
+        assert rs.error is None
+        gate.set()
+        assert mgr.wait(timeout=10)
+        assert mgr.jobs[j1].status == "STOPPED"
+        assert mgr.jobs[j2].status == "FINISHED"
+        assert mgr.jobs[j3].status == "STOPPED"
+        # RECOVER re-queues the stopped jobs and they finish
+        rs = eng.execute(s, "RECOVER JOB")
+        assert rs.error is None
+        assert rs.data.rows[0][0] == 2
+        assert mgr.wait(timeout=10)
+        assert mgr.jobs[j1].status == "FINISHED"
+        assert mgr.jobs[j3].status == "FINISHED"
+        # STOP of the QUEUE'd j3 purged its queue entry: the RECOVER
+        # re-queue must be its ONLY execution (a stale tuple would
+        # double-dispatch — code-review r4)
+        assert runs_per_job.get(j3, 0) == 1, runs_per_job
+        assert runs_per_job[j2] == 1
+    finally:
+        JobManager._run = orig_run
+        get_config().set_dynamic("max_concurrent_admin_jobs", 2)

@@ -50,6 +50,9 @@ def eng():
     def run(q):
         r = e.execute(s, q)
         assert r.ok, f"{q} -> {r.error}"
+        if "REBUILD" in q.upper():
+            from nebula_tpu.exec.jobs import job_manager
+            assert job_manager(e.qctx.store).wait()   # jobs are async (r4)
         return r
 
     run('CREATE SPACE fts(partition_num=4, vid_type=INT64)')

@@ -217,6 +217,8 @@ def test_repartition_preserves_rows_and_indexes(tmp_path):
     rs = eng.execute(s, "SUBMIT JOB REPARTITION 8")
     assert rs.error is None
     jid = rs.data.rows[0][0]
+    from nebula_tpu.exec.jobs import job_manager
+    assert job_manager(store).wait(jid)     # jobs are async (r4)
     rs = eng.execute(s, f"SHOW JOB {jid}")
     assert rs.data.rows[0][2] == "FINISHED"
     assert store.space("rp").num_parts == 8

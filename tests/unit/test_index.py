@@ -13,6 +13,9 @@ def eng():
     def run(q):
         r = e.execute(s, q)
         assert r.ok, f"{q} -> {r.error}"
+        if "REBUILD" in q.upper():
+            from nebula_tpu.exec.jobs import job_manager
+            assert job_manager(e.qctx.store).wait()   # jobs are async (r4)
         return r
 
     run('CREATE SPACE ix(partition_num=4, vid_type=INT64)')
@@ -139,6 +142,10 @@ def test_cluster_lookup_uses_index():
         # must be a no-op that still reports entries)
         rs = cl.execute("REBUILD TAG INDEX i_a")
         assert rs.error is None
+        from nebula_tpu.exec.jobs import job_manager
+        for g in c.graphds:                      # jobs are async (r4)
+            mgr = getattr(g.engine.qctx.store, "_job_manager", None)
+            assert mgr is None or mgr.wait()
         rs = cl.execute("LOOKUP ON t WHERE t.a == 10 YIELD id(vertex)")
         assert rs.error is None and rs.data.rows == [[1]]
     finally:
