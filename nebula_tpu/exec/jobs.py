@@ -41,10 +41,10 @@ class JobManager:
     reference TCK's "wait the job to finish" step."""
 
     def __init__(self):
-        import threading
+        from ..utils.racecheck import make_lock
         self.jobs: Dict[int, Job] = {}
         self._ids = itertools.count(1)   # per-manager: deterministic ids
-        self._lock = threading.Lock()
+        self._lock = make_lock("job_manager")
         self._queue: list = []           # pending (job, qctx)
         self._running = 0
 

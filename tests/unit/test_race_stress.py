@@ -265,3 +265,93 @@ def test_repartition_under_concurrent_readers():
     assert not errs, errs
     rs = eng.execute(s, "GO 2 STEPS FROM 0 OVER E YIELD dst(edge) AS d")
     assert sorted(map(repr, rs.data.rows)) == settled
+
+
+def test_amplified_job_manager_lifecycle():
+    """Concurrent SUBMIT/STOP/RECOVER storms under the amplifier: the
+    worker pool must never exceed its bound, no job may execute
+    concurrently with itself, each job runs at most once per (re)queue,
+    and every status converges to a terminal one."""
+    from nebula_tpu.exec.engine import QueryEngine
+    from nebula_tpu.exec.jobs import JobManager, job_manager
+    from nebula_tpu.graphstore.store import GraphStore
+    from nebula_tpu.utils.config import get_config
+
+    store = GraphStore()
+    eng = QueryEngine(store)
+    s = eng.new_session()
+    for t in ["CREATE SPACE jr(partition_num=2, vid_type=INT64)",
+              "USE jr", "CREATE TAG P(a int)"]:
+        assert eng.execute(s, t).error is None
+    eng.execute(s, "INSERT VERTEX P(a) VALUES 1:(1)")
+
+    mgr = job_manager(store)
+    orig_run = JobManager._run
+    live = {"n": 0, "max": 0, "per_job": {}, "concurrent_self": False}
+    lk = threading.Lock()
+
+    def counting_run(self, qctx, command, space, job=None):
+        with lk:
+            live["n"] += 1
+            live["max"] = max(live["max"], live["n"])
+            if job is not None:
+                c = live["per_job"].get(job.job_id, 0) + 1
+                live["per_job"][job.job_id] = c
+                if getattr(job, "_in_run", False):
+                    live["concurrent_self"] = True
+                job._in_run = True
+        try:
+            time.sleep(0.001)
+            return orig_run(self, qctx, command, space, job)
+        finally:
+            with lk:
+                live["n"] -= 1
+                if job is not None:
+                    job._in_run = False
+
+    JobManager._run = counting_run
+    try:
+        get_config().set_dynamic("max_concurrent_admin_jobs", 2)
+        jids = []
+        jl = threading.Lock()
+
+        def submitter(k):
+            s2 = eng.new_session()
+            eng.execute(s2, "USE jr")
+            for _ in range(10):
+                rs = eng.execute(s2, "SUBMIT JOB STATS")
+                assert rs.error is None
+                with jl:
+                    jids.append(rs.data.rows[0][0])
+
+        def stopper():
+            for _ in range(30):
+                with jl:
+                    pick = list(jids[-4:])
+                for jid in pick:
+                    eng.execute(s, f"STOP JOB {jid}")
+                time.sleep(0.0005)
+
+        def recoverer():
+            for _ in range(10):
+                eng.execute(s, "RECOVER JOB")
+                time.sleep(0.002)
+
+        with racecheck.race_amplifier():
+            ts = ([threading.Thread(target=submitter, args=(k,))
+                   for k in range(3)]
+                  + [threading.Thread(target=stopper),
+                     threading.Thread(target=recoverer)])
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+        assert mgr.wait(timeout=30)
+        assert not live["concurrent_self"], "job ran concurrently with itself"
+        assert live["max"] <= 2, live["max"]
+        for j in mgr.jobs.values():
+            assert j.status in ("FINISHED", "STOPPED", "FAILED"), \
+                (j.job_id, j.status)
+    finally:
+        JobManager._run = orig_run
+        get_config().set_dynamic("max_concurrent_admin_jobs", 2)
