@@ -1,0 +1,106 @@
+Feature: CASE, list comprehension, predicates, reduce, slices, temporal arithmetic
+
+  Scenario: generic case picks the matching branch
+    When executing query:
+      """
+      YIELD CASE 2 WHEN 1 THEN "one" WHEN 2 THEN "two" ELSE "many" END AS r
+      """
+    Then the result should be, in any order:
+      | r     |
+      | "two" |
+
+  Scenario: searched case with else
+    When executing query:
+      """
+      YIELD CASE WHEN 3 > 2 THEN "gt" ELSE "le" END AS a,
+            CASE WHEN 1 > 2 THEN "gt" ELSE "le" END AS b
+      """
+    Then the result should be, in any order:
+      | a    | b    |
+      | "gt" | "le" |
+
+  Scenario: list comprehension filters and maps
+    When executing query:
+      """
+      YIELD [x IN [1,2,3,4] WHERE x % 2 == 0 | x * 10] AS r
+      """
+    Then the result should be, in any order:
+      | r        |
+      | [20, 40] |
+
+  Scenario: list predicates all any single none
+    When executing query:
+      """
+      YIELD all(x IN [2,4] WHERE x % 2 == 0) AS a,
+            any(x IN [1,3,4] WHERE x % 2 == 0) AS b,
+            single(x IN [1,2] WHERE x == 2) AS c,
+            none(x IN [1,3] WHERE x % 2 == 0) AS d
+      """
+    Then the result should be, in any order:
+      | a    | b    | c    | d    |
+      | true | true | true | true |
+
+  Scenario: reduce folds the accumulator
+    When executing query:
+      """
+      YIELD reduce(acc = 0, x IN [1,2,3] | acc + x) AS r
+      """
+    Then the result should be, in any order:
+      | r |
+      | 6 |
+
+  Scenario: list slicing and negative indexing
+    When executing query:
+      """
+      YIELD [1,2,3,4][1..3] AS mid, [1,2,3][-1] AS last, [1,2] + [3] AS cat
+      """
+    Then the result should be, in any order:
+      | mid    | last | cat       |
+      | [2, 3] | 3    | [1, 2, 3] |
+
+  Scenario: string predicates
+    When executing query:
+      """
+      YIELD "hello" STARTS WITH "he" AS a, "hello" ENDS WITH "lo" AS b,
+            "hello" CONTAINS "ell" AS c, "hello" CONTAINS "zzz" AS d
+      """
+    Then the result should be, in any order:
+      | a    | b    | c    | d     |
+      | true | true | true | false |
+
+  Scenario: map subscript and keys
+    When executing query:
+      """
+      YIELD {a: 1, b: "x"}["b"] AS r, keys({a: 1, b: 2}) AS k
+      """
+    Then the result should be, in any order:
+      | r   | k          |
+      | "x" | ["a", "b"] |
+
+  Scenario: datetime plus duration
+    When executing query:
+      """
+      YIELD datetime("2021-03-01T10:00:00") + duration({days: 1}) AS r
+      """
+    Then the result should be, in any order:
+      | r                                    |
+      | datetime("2021-03-02T10:00:00.000000") |
+
+  Scenario: date ordering and timestamp parse
+    When executing query:
+      """
+      YIELD date("2021-03-01") < date("2021-04-01") AS lt,
+            timestamp("2021-01-01T00:00:00") AS t
+      """
+    Then the result should be, in any order:
+      | lt   | t          |
+      | true | 1609459200 |
+
+  Scenario: null comparisons are three-valued
+    When executing query:
+      """
+      YIELD 5 IS NOT NULL AS a, NULL IS NULL AS b, NULL == NULL AS c
+      """
+    Then the result should be, in any order:
+      | a    | b    | c    |
+      | true | true | NULL |

@@ -1,0 +1,94 @@
+Feature: OPTIONAL MATCH, WITH pipelines, named paths, relationship uniqueness
+
+  Background:
+    Given having executed:
+      """
+      CREATE SPACE mo(partition_num=2, vid_type=INT64);
+      USE mo;
+      CREATE TAG person(name string);
+      CREATE EDGE knows(w int);
+      INSERT VERTEX person(name) VALUES 1:("a"), 2:("b"), 3:("c");
+      INSERT EDGE knows(w) VALUES 1->2:(5), 2->3:(7)
+      """
+
+  Scenario: optional match fills unmatched rows with null
+    When executing query:
+      """
+      MATCH (a:person) WHERE id(a) == 3
+      OPTIONAL MATCH (a)-[e:knows]->(b)
+      RETURN id(a) AS a, id(b) AS b
+      """
+    Then the result should be, in any order:
+      | a | b    |
+      | 3 | NULL |
+
+  Scenario: optional match keeps matched rows intact
+    When executing query:
+      """
+      MATCH (a:person) WHERE id(a) == 1
+      OPTIONAL MATCH (a)-[e:knows]->(b)
+      RETURN id(a) AS a, id(b) AS b
+      """
+    Then the result should be, in any order:
+      | a | b |
+      | 1 | 2 |
+
+  Scenario: with clause filters mid-pipeline
+    When executing query:
+      """
+      MATCH (a:person) WITH a.person.name AS n WHERE n > "a"
+      RETURN n ORDER BY n
+      """
+    Then the result should be, in order:
+      | n   |
+      | "b" |
+      | "c" |
+
+  Scenario: named path exposes length and nodes
+    When executing query:
+      """
+      MATCH p = (a:person)-[:knows]->(b) WHERE id(a) == 1
+      RETURN length(p) AS l, id(startNode(p)) AS s, id(endNode(p)) AS e
+      """
+    Then the result should be, in any order:
+      | l | s | e |
+      | 1 | 1 | 2 |
+
+  Scenario: relationship uniqueness excludes reusing one edge across patterns
+    When executing query:
+      """
+      MATCH (a:person)-[:knows]->(b), (b)<-[:knows]-(c)
+      WHERE id(a) == 1
+      RETURN id(c)
+      """
+    Then the result should be empty
+
+  Scenario: zero-hop variable length includes the source
+    When executing query:
+      """
+      MATCH (a:person)-[e:knows*0..1]->(b) WHERE id(a) == 1
+      RETURN id(b) AS b ORDER BY b
+      """
+    Then the result should be, in order:
+      | b |
+      | 1 |
+      | 2 |
+
+  Scenario: skip and limit page through ordered match output
+    When executing query:
+      """
+      MATCH (a:person) RETURN a.person.name AS n ORDER BY n SKIP 1 LIMIT 1
+      """
+    Then the result should be, in order:
+      | n   |
+      | "b" |
+
+  Scenario: two-hop chain reaches the transitive neighbor
+    When executing query:
+      """
+      MATCH (a:person)-[:knows]->()-[:knows]->(c) WHERE id(a) == 1
+      RETURN id(c) AS c
+      """
+    Then the result should be, in any order:
+      | c |
+      | 3 |
