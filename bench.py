@@ -308,6 +308,15 @@ def main():
     _ensure_live_backend()
     _start_stall_watchdog()
     _enable_compile_cache()
+    # supernode degree-split (SURVEY §7 hard-part #4): spreads each
+    # hub's adjacency across the mesh at pin time — smaller per-hop
+    # padded budgets on the Zipf tail, and the owner chip no longer
+    # serializes a supernode's expansion.  Override/disable with
+    # NEBULA_BENCH_DEGREE_SPLIT=<threshold|0>.
+    split_thr = int(os.environ.get("NEBULA_BENCH_DEGREE_SPLIT", 2048))
+    if split_thr > 0:
+        from nebula_tpu.utils.config import get_config
+        get_config().set_dynamic("tpu_degree_split_threshold", split_thr)
     fallback = os.environ.get("_NEBULA_BENCH_FALLBACK")
     # On the virtual-CPU fallback the padded kernel runs ~20x slower
     # than on a chip (one core emulating 8 mesh slots); the full

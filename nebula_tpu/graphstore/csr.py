@@ -267,6 +267,9 @@ class CsrSnapshot:
     tags: Dict[str, TagTable] = field(default_factory=dict)
     pool: StringPool = field(default_factory=StringPool)
     dense_to_vid: List[Any] = field(default_factory=list)
+    # degree_split(): dense ids of supernodes whose adjacency is split
+    # across parts as H extra "hub rows" per block (None = unsplit)
+    hub_dense: Optional[np.ndarray] = None
 
     def block(self, etype: str, direction: str = "out") -> CsrBlock:
         return self.blocks[(etype, direction)]
@@ -453,6 +456,16 @@ def _build_tag_table(sd: SpaceData, tag: str, sv: SchemaVersion,
 
 
 def neighbors_of(snap: CsrSnapshot, block: CsrBlock, dense_src: int) -> np.ndarray:
+    if snap.hub_dense is not None:
+        hi_ = np.searchsorted(snap.hub_dense, dense_src)
+        if hi_ < len(snap.hub_dense) and snap.hub_dense[hi_] == dense_src:
+            # degree-split hub: its owner-local row is empty — the
+            # adjacency lives as chunk rows vmax+hi_ across ALL parts
+            row = snap.vmax + int(hi_)
+            return np.concatenate(
+                [block.nbr[p, int(block.indptr[p, row]):
+                           int(block.indptr[p, row + 1])]
+                 for p in range(snap.num_parts)])
     p = snap.owner(dense_src)
     li = snap.local(dense_src)
     lo, hi = int(block.indptr[p, li]), int(block.indptr[p, li + 1])
@@ -469,3 +482,103 @@ def expand_frontier_host(snap: CsrSnapshot, block: CsrBlock,
     cat = np.concatenate(outs) if outs else np.zeros(0, np.int32)
     cat = cat[cat >= 0]
     return np.unique(cat).astype(np.int32)
+
+
+def degree_split(snap: CsrSnapshot, threshold: int,
+                 max_hubs: int = 1024) -> CsrSnapshot:
+    """Split supernode adjacency across parts (SURVEY §7 hard-part #4's
+    degree-split option).
+
+    A vertex whose degree exceeds `threshold` in ANY block becomes a
+    hub: each block's edge arrays are rebuilt so the hub's adjacency is
+    divided into P contiguous chunks, chunk k living in part k as one
+    of H extra "hub rows" appended after the vmax local rows (the hub's
+    original local row becomes empty).  Every part then expands ~1/P of
+    a hub's edges per hop instead of the owner expanding all of them —
+    the per-part expansion ceiling (which sizes the padded edge budget
+    EB) drops toward the mean, and supernode hops parallelize across
+    the mesh instead of serializing on the owner chip.
+
+    The transform is a pure layout change: same edges, same properties,
+    host mirror identical to the device copy (eidx decode just works).
+    Returns a NEW snapshot (hub_dense set); the input is not modified.
+    Vertex ownership — frontier bitmap, marks, dist arrays — is
+    untouched: only EXPANSION rows are added.
+    """
+    P, vmax = snap.num_parts, snap.vmax
+    # deg[local*P + p] == deg.reshape(vmax, P)[local, p] — one
+    # vectorized elementwise max per block, no scatter
+    deg2d = np.zeros((vmax, P), np.int64)
+    for b in snap.blocks.values():
+        lens = b.indptr[:, 1:] - b.indptr[:, :-1]        # (P, vmax)
+        np.maximum(deg2d, lens.T, out=deg2d)
+    deg = deg2d.reshape(-1)
+    hubs = np.nonzero(deg > threshold)[0]
+    if hubs.size == 0:
+        return snap
+    if hubs.size > max_hubs:
+        hubs = hubs[np.argsort(deg[hubs])[::-1][:max_hubs]]
+    hubs = np.sort(hubs).astype(np.int64)
+    H = int(hubs.size)
+    ho, hl = (hubs % P).astype(np.int64), (hubs // P).astype(np.int64)
+
+    def split_block(b: CsrBlock) -> CsrBlock:
+        lens = b.indptr[:, 1:] - b.indptr[:, :-1]
+        # per-hub chunk bounds into the OWNER part's edge range
+        bounds = []
+        for i in range(H):
+            s = int(b.indptr[ho[i], hl[i]])
+            e = int(b.indptr[ho[i], hl[i] + 1])
+            bounds.append(s + (e - s) * np.arange(P + 1) // P)
+        new_lens, new_cols = [], {"nbr": [], "rank": []}
+        for n in b.props:
+            new_cols[("prop", n)] = []
+        for p in range(P):
+            ep = int(b.indptr[p, -1])
+            keep = np.ones(ep, bool)
+            base = lens[p].astype(np.int64).copy()
+            for i in range(H):
+                if ho[i] == p:
+                    keep[int(b.indptr[p, hl[i]]):
+                         int(b.indptr[p, hl[i] + 1])] = False
+                    base[hl[i]] = 0
+            hub_lens = np.asarray(
+                [bounds[i][p + 1] - bounds[i][p] for i in range(H)],
+                np.int64)
+            new_lens.append(np.concatenate([base, hub_lens]))
+
+            def build(src_arr, out_key):
+                parts = [src_arr[p, :ep][keep]]
+                for i in range(H):
+                    parts.append(src_arr[ho[i],
+                                         bounds[i][p]:bounds[i][p + 1]])
+                new_cols[out_key].append(np.concatenate(parts))
+            build(b.nbr, "nbr")
+            build(b.rank, "rank")
+            for n in b.props:
+                build(b.props[n], ("prop", n))
+        emax = max(int(x.size) for x in new_cols["nbr"])
+
+        def pad(rows, fill=0):
+            out = np.full((P, emax), fill, rows[0].dtype)
+            for p, r in enumerate(rows):
+                out[p, :r.size] = r
+            return out
+        indptr = np.zeros((P, vmax + H + 1), b.indptr.dtype)
+        for p in range(P):
+            indptr[p, 1:] = np.cumsum(new_lens[p])
+        return CsrBlock(etype=b.etype, direction=b.direction,
+                        indptr=indptr, nbr=pad(new_cols["nbr"]),
+                        rank=pad(new_cols["rank"]),
+                        props={n: pad(new_cols[("prop", n)])
+                               for n in b.props},
+                        prop_types=dict(b.prop_types))
+
+    out = CsrSnapshot(space=snap.space, epoch=snap.epoch, num_parts=P,
+                      vmax=vmax, num_vertices=snap.num_vertices,
+                      blocks={k: split_block(b)
+                              for k, b in snap.blocks.items()},
+                      tags=snap.tags, pool=snap.pool,
+                      dense_to_vid=snap.dense_to_vid,
+                      hub_dense=hubs)
+    return out

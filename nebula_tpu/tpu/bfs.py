@@ -25,11 +25,13 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from .hop import _exchange_marks, _expand_block, _mark, _norm_ebs
+from .hop import (_exchange_marks, _expand_block, _extend_fbm_local,
+                  _extend_fbm_sharded, _hub_consts, _mark, _norm_ebs)
 
 
 def build_bfs_fn(mesh, P: int, EB, max_steps: int,
-                 n_blocks: int, vmax: int, pred=None, pred_cols=()):
+                 n_blocks: int, vmax: int, pred=None, pred_cols=(),
+                 hub_dense=None):
     """Sharded BFS program: (blocks_data, frontier) →
     {dist (P, vmax), ovf_expand, hop_edges (P, steps)}.
 
@@ -39,6 +41,7 @@ def build_bfs_fn(mesh, P: int, EB, max_steps: int,
     per-expansion filter."""
 
     ebs = _norm_ebs(EB, max_steps, False)
+    hubs_c, hub_owner, hub_local = _hub_consts(hub_dense, P)
 
     def kernel(blocks_data, frontier):
         fbm = frontier[0]                       # (vmax,) bool seeds
@@ -51,11 +54,13 @@ def build_bfs_fn(mesh, P: int, EB, max_steps: int,
             EBl = ebs[level - 1]
             marks = None
             edges = jnp.zeros((), jnp.int32)
+            efbm = fbm if hubs_c is None else _extend_fbm_sharded(
+                fbm, pid, hub_owner, hub_local)
             for bi in range(n_blocks):
                 b = blocks_data[bi]
                 src, dst, rk, eidx, ve, total, ovf = _expand_block(
-                    b["indptr"][0], b["nbr"][0], b["rank"][0], fbm, EBl, P,
-                    pid)
+                    b["indptr"][0], b["nbr"][0], b["rank"][0], efbm, EBl,
+                    P, pid, vmax_local=vmax, hub_dense=hubs_c)
                 ovf_e = ovf_e | ovf
                 edges = edges + total
                 if pred is not None:
@@ -86,7 +91,8 @@ def build_bfs_fn(mesh, P: int, EB, max_steps: int,
 
 def build_bfs_fn_local(P: int, EB, max_steps: int,
                        n_blocks: int, vmax: int, pred=None, pred_cols=(),
-                       have_rev: bool = False, n_phantom: int = 0):
+                       have_rev: bool = False, n_phantom: int = 0,
+                       hub_dense=None):
     """Single-chip variant (vmap over parts, OR-reduce as all_to_all).
 
     With `have_rev` (blocks_data carries each block's REVERSE-direction
@@ -103,11 +109,17 @@ def build_bfs_fn_local(P: int, EB, max_steps: int,
     the expansion itself."""
     pids = jnp.arange(P, dtype=jnp.int32)
     ebs = _norm_ebs(EB, max_steps, False)
+    hubs_c, hub_owner, hub_local = _hub_consts(hub_dense, P)
+
+    def ext(x):
+        if hubs_c is None:
+            return x
+        return _extend_fbm_local(x, hub_owner, hub_local, P)
 
     def one_part(block, fbm, pid, EBl, swap_ends=False):
         src, dst, rk, eidx, ve, total, ovf = _expand_block(
             block["indptr"], block["nbr"], block["rank"], fbm, EBl, P,
-            pid)
+            pid, vmax_local=vmax, hub_dense=hubs_c)
         if pred is not None:
             # $^/$$ are TRAVERSAL source/destination.  Bottom-up
             # expands the REVERSE adjacency, so the expansion source is
@@ -135,7 +147,7 @@ def build_bfs_fn_local(P: int, EB, max_steps: int,
                     {"indptr": ip, "nbr": nb, "rank": rkk,
                      "props": prp}, f, pd, EBl)
             )(b["indptr"], b["nbr"], b["rank"],
-              b.get("props", {}), fbm, pids)
+              b.get("props", {}), ext(fbm), pids)
             ovf = ovf | ov
             edges = edges + total
             blk_marks = jax.vmap(
@@ -156,15 +168,15 @@ def build_bfs_fn_local(P: int, EB, max_steps: int,
                     {"indptr": ip, "nbr": nbr, "rank": rkk,
                      "props": prp}, f, pd, EBl, swap_ends=True)
             )(b["rev_indptr"], b["rev_nbr"], b["rev_rank"],
-              b.get("rev_props", {}), unvis, pids)
+              b.get("rev_props", {}), ext(unvis), pids)
             ovf = ovf | ov
             edges = edges + total
             member = fbm[nb % P, nb // P] & keep       # (P, EB)
-            blk = jax.vmap(
-                lambda s, m: jnp.zeros((vmax,), bool).at[
-                    jnp.where(m, s // P, vmax)].max(m, mode="drop")
-            )(src, member)
-            cand = cand | blk
+            # route the reached vertex to its OWNER row (a degree-split
+            # hub row's src belongs to another part, so the plain
+            # local-index scatter would mis-home it)
+            blk = jax.vmap(lambda s, m: _mark(s, m, P, vmax))(src, member)
+            cand = cand | blk.any(axis=0)
         return cand, edges, ovf
 
     def fn(blocks_data, frontier):

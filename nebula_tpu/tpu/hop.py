@@ -53,13 +53,20 @@ import numpy as np
 MAXI = np.iinfo(np.int32).max
 
 
-def _expand_block(indptr, nbr, rank, fbm, EB: int, P: int, pid):
+def _expand_block(indptr, nbr, rank, fbm, EB: int, P: int, pid,
+                  vmax_local: int = 0, hub_dense=None):
     """Vectorized CSR expansion of one block from one part's frontier
     bitmap.
 
     indptr: (vmax+1,) local CSR row pointers; nbr/rank: (E,) edge
     arrays; fbm: (vmax,) bool frontier membership; pid: this part's id
     (dense id = local * P + pid).
+
+    With a degree-split snapshot (graphstore.csr.degree_split) the
+    block carries H extra HUB rows after the vmax_local local rows, and
+    fbm arrives EXTENDED to vmax_local+H (hub-active bits appended by
+    the caller); a hub row's source dense id comes from `hub_dense`
+    instead of the local-row arithmetic.
 
     Slot→source-row assignment is a cumsum-scatter, not a binary
     search: bump +1 at each frontier vertex's first slot, prefix-sum
@@ -94,7 +101,14 @@ def _expand_block(indptr, nbr, rank, fbm, EB: int, P: int, pid):
     ve = j < jnp.minimum(total, EB)
     eidx = jnp.where(ve, eidx, 0).astype(jnp.int32)
     dst = jnp.where(ve, nbr[eidx], -1)
-    src = jnp.where(ve, row * P + pid, -1)
+    if hub_dense is None:
+        src_id = row * P + pid
+    else:
+        src_id = jnp.where(
+            row < vmax_local, row * P + pid,
+            hub_dense[jnp.clip(row - vmax_local, 0,
+                               hub_dense.shape[0] - 1)])
+    src = jnp.where(ve, src_id, -1)
     rk = jnp.where(ve, rank[eidx], 0)
     return src, dst, rk, eidx, ve, total, total > EB
 
@@ -178,13 +192,44 @@ def _norm_ebs(EB, steps: int, capture_hops: bool):
     return ebs
 
 
+def _hub_consts(hub_dense, P: int):
+    """Static per-snapshot hub tables for the degree-split expansion:
+    (dense ids, owner part, owner-local index) as jnp constants, or
+    (None, None, None) for an unsplit snapshot."""
+    if hub_dense is None or len(hub_dense) == 0:
+        return None, None, None
+    hd = jnp.asarray(np.asarray(hub_dense), jnp.int32)
+    return hd, hd % P, hd // P
+
+
+def _extend_fbm_sharded(fbm, pid, hub_owner, hub_local):
+    """Append hub-active bits to one shard's expansion bitmap: each
+    hub's frontier bit lives in its OWNER's shard — OR the per-part
+    contributions over the mesh so every part expands its chunk of
+    each active hub."""
+    mine = hub_owner == pid
+    vals = jnp.where(mine, fbm[hub_local], False)
+    bits = jax.lax.psum(vals.astype(jnp.int32), "part") > 0
+    return jnp.concatenate([fbm, bits])
+
+
+def _extend_fbm_local(fbm, hub_owner, hub_local, P: int):
+    """Single-chip variant: the full (P, vmax) ownership bitmap is
+    resident — gather each hub's bit straight from its owner row and
+    replicate across the part axis."""
+    bits = fbm[hub_owner, hub_local]                       # (H,)
+    return jnp.concatenate(
+        [fbm, jnp.broadcast_to(bits, (P, bits.shape[0]))], axis=1)
+
+
 def build_traverse_fn(mesh, P: int, EB, steps: int,
                       n_blocks: int,
                       pred: Optional[Callable[[Dict[str, Any]], Any]] = None,
                       pred_cols: Sequence[str] = (),
                       capture: bool = True,
                       capture_hops: bool = False,
-                      yield_cols: Sequence[str] = ()):
+                      yield_cols: Sequence[str] = (),
+                      hub_dense=None):
     """Compile the N-step traversal program for one bucket configuration.
     EB: per-block edge budget — an int (uniform) or a per-hop sequence.
 
@@ -219,6 +264,7 @@ def build_traverse_fn(mesh, P: int, EB, steps: int,
     """
 
     ebs = _norm_ebs(EB, steps, capture_hops)
+    hubs_c, hub_owner, hub_local = _hub_consts(hub_dense, P)
 
     def kernel(blocks_data, frontier):
         fbm = frontier[0]                      # (vmax,) bool
@@ -236,11 +282,13 @@ def build_traverse_fn(mesh, P: int, EB, steps: int,
             edges_this_hop = jnp.zeros((), jnp.int32)
             caps = {"src": [], "dst": [], "rank": [], "eidx": [],
                     "kcount": []}
+            efbm = fbm if hubs_c is None else _extend_fbm_sharded(
+                fbm, pid, hub_owner, hub_local)
             for bi in range(n_blocks):
                 b = blocks_data[bi]
                 src, dst, rk, eidx, ve, total, ovf = _expand_block(
-                    b["indptr"][0], b["nbr"][0], b["rank"][0], fbm, EBh, P,
-                    pid)
+                    b["indptr"][0], b["nbr"][0], b["rank"][0], efbm, EBh,
+                    P, pid, vmax_local=vmax, hub_dense=hubs_c)
                 ovf_e = ovf_e | ovf
                 edges_this_hop = edges_this_hop + total
                 if pred is not None and (last or capture_hops):
@@ -312,7 +360,8 @@ def build_traverse_fn_local(P: int, EB, steps: int,
                             pred_cols: Sequence[str] = (),
                             capture: bool = True,
                             capture_hops: bool = False,
-                            yield_cols: Sequence[str] = ()):
+                            yield_cols: Sequence[str] = (),
+                            hub_dense=None):
     """Single-chip variant: all P partitions resident on one device, the
     per-part kernel vmapped over the part axis, and the frontier exchange
     an OR-reduce over the mark matrices (the degenerate all_to_all).
@@ -323,10 +372,12 @@ def build_traverse_fn_local(P: int, EB, steps: int,
     """
     pids = jnp.arange(P, dtype=jnp.int32)
     ebs = _norm_ebs(EB, steps, capture_hops)
+    hubs_c, hub_owner, hub_local = _hub_consts(hub_dense, P)
 
-    def one_part_expand(block, fbm, pid, want_pred, EBh):
+    def one_part_expand(block, fbm, pid, want_pred, EBh, vmax_local):
         src, dst, rk, eidx, ve, total, ovf = _expand_block(
-            block["indptr"], block["nbr"], block["rank"], fbm, EBh, P, pid)
+            block["indptr"], block["nbr"], block["rank"], fbm, EBh, P,
+            pid, vmax_local=vmax_local, hub_dense=hubs_c)
         if want_pred:
             cols = {"_rank": rk, "_src": src, "_dst": dst}
             for name in pred_cols:
@@ -352,14 +403,16 @@ def build_traverse_fn_local(P: int, EB, steps: int,
             edges = jnp.zeros((P,), jnp.int32)
             caps = {"src": [], "dst": [], "rank": [], "eidx": [],
                     "kcount": []}
+            efbm = fbm if hubs_c is None else _extend_fbm_local(
+                fbm, hub_owner, hub_local, P)
             for bi in range(n_blocks):
                 b = blocks_data[bi]
                 want_pred = pred is not None and (last or capture_hops)
                 src, dst, rk, eidx, ve, keep, total, ovf = jax.vmap(
                     lambda ip, nb, rkk, prp, f, pd: one_part_expand(
                         {"indptr": ip, "nbr": nb, "rank": rkk, "props": prp},
-                        f, pd, want_pred, EBh)
-                )(b["indptr"], b["nbr"], b["rank"], b["props"], fbm, pids)
+                        f, pd, want_pred, EBh, vmax)
+                )(b["indptr"], b["nbr"], b["rank"], b["props"], efbm, pids)
                 ovf_e = ovf_e | ovf
                 edges = edges + total
                 if capture and (last or capture_hops):

@@ -377,6 +377,7 @@ class TpuRuntime:
                     f"cluster CSR export failed: {ex}") from ex
         else:
             snap = build_snapshot(store, space)
+        snap = self._maybe_degree_split(snap)
         # HBM budget (SURVEY §2 row 5: device memory is the scarce
         # resource): refuse to pin past the limit; caller falls back to
         # the host path instead of OOMing the chip
@@ -401,9 +402,25 @@ class TpuRuntime:
                      if not (k[0] == space and k[1] != dev.epoch)}
         return dev
 
+    @staticmethod
+    def _maybe_degree_split(snap):
+        """Apply the supernode degree-split at pin time when the flag
+        is set (SURVEY §7 hard-part #4): the pinned copy AND its host
+        mirror share the split layout, so eidx decode is unchanged."""
+        from ..utils.config import get_config
+        try:
+            thr = int(get_config().get("tpu_degree_split_threshold"))
+        except Exception:  # noqa: BLE001 — config missing in odd embeds
+            thr = 0
+        if thr > 0 and getattr(snap, "hub_dense", None) is None:
+            from ..graphstore.csr import degree_split
+            snap = degree_split(snap, thr)
+        return snap
+
     def pin_prebuilt(self, snap) -> DeviceSnapshot:
         """Pin an externally-built CsrSnapshot (bulk-ingest / bench path
         — no dict store behind it)."""
+        snap = self._maybe_degree_split(snap)
         dev = pin_snapshot(snap, self.mesh)
         self.snapshots[snap.space] = dev
         from ..utils.stats import stats
@@ -685,22 +702,25 @@ class TpuRuntime:
             # versa (physical-edge orientation) — need both
             fetch_keys |= {"src", "dst"}
 
+        hub_dense = getattr(dev.host, "hub_dense", None)
+        hub_n = 0 if hub_dense is None else len(hub_dense)
+
         def build(ebs):
             if self.local_mode:
                 return build_traverse_fn_local(
                     P, ebs, steps, len(block_keys), pred=pred,
                     pred_cols=pred_cols, capture=capture,
-                    yield_cols=yield_cols)
+                    yield_cols=yield_cols, hub_dense=hub_dense)
             return build_traverse_fn(
                 self.mesh, P, ebs, steps, len(block_keys),
                 pred=pred, pred_cols=pred_cols, capture=capture,
-                yield_cols=yield_cols)
+                yield_cols=yield_cols, hub_dense=hub_dense)
 
         res = self._escalate(
             dev, dense,
             key_fn=lambda ebs: (space, dev.epoch, tuple(block_keys),
                                 steps, ebs, pred_key, capture,
-                                tuple(pred_cols), yield_cols),
+                                tuple(pred_cols), yield_cols, hub_n),
             build_fn=build,
             inputs_fn=lambda ebs: (blocks_data,),
             stats=stats, n_hops=steps, fetch_keys=fetch_keys)
@@ -777,21 +797,25 @@ class TpuRuntime:
                        if not n.startswith("_")}}
             for bk in block_keys)
 
+        hub_dense = getattr(dev.host, "hub_dense", None)
+        hub_n = 0 if hub_dense is None else len(hub_dense)
+
         def build(ebs):
             if self.local_mode:
                 return build_traverse_fn_local(
                     P, ebs, max_hop, len(block_keys), pred=pred,
-                    pred_cols=pred_cols, capture=True, capture_hops=True)
+                    pred_cols=pred_cols, capture=True, capture_hops=True,
+                    hub_dense=hub_dense)
             return build_traverse_fn(
                 self.mesh, P, ebs, max_hop, len(block_keys),
                 pred=pred, pred_cols=pred_cols, capture=True,
-                capture_hops=True)
+                capture_hops=True, hub_dense=hub_dense)
 
         res = self._escalate(
             dev, dense,
             key_fn=lambda ebs: (space, dev.epoch, "hops",
                                 tuple(block_keys), max_hop, ebs,
-                                pred_key, tuple(pred_cols)),
+                                pred_key, tuple(pred_cols), hub_n),
             build_fn=build,
             inputs_fn=lambda ebs: (blocks_data,),
             stats=stats, n_hops=max_hop, uniform=True)
@@ -965,6 +989,8 @@ class TpuRuntime:
 
         n_phantom = int(P * dev.vmax
                         - np.asarray(dev.num_vertices).sum())
+        hub_dense = getattr(dev.host, "hub_dense", None)
+        hub_n = 0 if hub_dense is None else len(hub_dense)
 
         def build(ebs):
             if self.local_mode:
@@ -972,10 +998,12 @@ class TpuRuntime:
                                           len(block_keys), dev.vmax,
                                           pred=pred, pred_cols=pred_cols,
                                           have_rev=have_rev,
-                                          n_phantom=n_phantom)
+                                          n_phantom=n_phantom,
+                                          hub_dense=hub_dense)
             return build_bfs_fn(self.mesh, P, ebs, max_steps,
                                 len(block_keys), dev.vmax,
-                                pred=pred, pred_cols=pred_cols)
+                                pred=pred, pred_cols=pred_cols,
+                                hub_dense=hub_dense)
 
         # Per-LEVEL edge budgets (like the traverse kernel's per-hop
         # buckets): a BFS's first and last levels examine orders of
@@ -988,7 +1016,8 @@ class TpuRuntime:
             dev, dense,
             key_fn=lambda ebs: (space, dev.epoch, "bfs",
                                 tuple(block_keys), max_steps, ebs,
-                                pred_key, tuple(pred_cols), have_rev),
+                                pred_key, tuple(pred_cols), have_rev,
+                                hub_n),
             build_fn=build,
             inputs_fn=lambda ebs: (blocks_data,),
             stats=stats, n_hops=max_steps)

@@ -166,6 +166,10 @@ define_flag("host_hb_expire_secs", 10.0,
             "heartbeat age after which a host reads as dead")
 define_flag("tpu_match_device", True,
             "run MATCH Traverse expansion on the device plane")
+define_flag("tpu_degree_split_threshold", 0,
+            "degree above which a supernode's adjacency is split "
+            "across parts at pin time (0 = off); drops the per-part "
+            "expansion ceiling toward the mean on skewed graphs")
 define_flag("tpu_profiler_dir", "",
             "when set, wrap every device kernel run in a jax.profiler "
             "trace written under this directory (SURVEY §5 tracing)")

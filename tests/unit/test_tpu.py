@@ -901,3 +901,121 @@ def test_shared_runtime_two_stores_no_cache_collision(rt):
     assert stores[1].space("g").epoch == stores[0].space("g").epoch
     rows, _ = rt.traverse(stores[1], "g", [3, 17], ["knows"], "out", 2)
     assert sorted(norm_edge(e) for (_, e, _) in rows) == wants[1]
+
+
+def _hubby_store(seed=2, n=120, extra=60):
+    st = random_store(seed, n=n, avg_deg=5)
+    rng = random.Random(9)
+    for _ in range(extra):
+        st.insert_edge("g", 7, "knows", rng.randrange(n),
+                       rng.randint(0, 2),
+                       {"w": rng.randint(0, 99), "f": 0.5, "tag": "ann"})
+    return st
+
+
+def test_degree_split_transform_layout():
+    """degree_split preserves every (src, nbr, rank, props) tuple while
+    spreading hub adjacency across parts as extra hub rows."""
+    from nebula_tpu.graphstore.csr import build_snapshot, degree_split
+    st = _hubby_store()
+    snap = build_snapshot(st, "g")
+    sp = degree_split(snap, threshold=16)
+    assert sp.hub_dense is not None and len(sp.hub_dense) >= 1
+    H = len(sp.hub_dense)
+    vmax = snap.vmax
+    for key in snap.blocks:
+        b0, b1 = snap.blocks[key], sp.blocks[key]
+        assert b1.indptr.shape == (P, vmax + H + 1)
+        assert b0.total_edges() == b1.total_edges(), key
+
+        def adj(b, hubs=None):
+            out = {}
+            nrows = vmax if hubs is None else vmax + len(hubs)
+            for p in range(P):
+                for r in range(nrows):
+                    s, e = int(b.indptr[p, r]), int(b.indptr[p, r + 1])
+                    if e <= s:
+                        continue
+                    dn = (r * P + p if r < vmax
+                          else int(hubs[r - vmax]))
+                    out.setdefault(dn, []).extend(
+                        zip(b.nbr[p, s:e].tolist(),
+                            b.rank[p, s:e].tolist(),
+                            b.props["w"][p, s:e].tolist()))
+            return {k: sorted(v) for k, v in out.items()}
+        assert adj(b0) == adj(b1, sp.hub_dense), key
+
+
+def test_degree_split_device_parity(rt):
+    """GO / predicate / MATCH var-len / SHORTEST PATH / SUBGRAPH give
+    identical rows with the supernode degree-split active (SURVEY §7
+    hard-part #4's split option)."""
+    from nebula_tpu.utils.config import get_config
+    get_config().set_dynamic("tpu_degree_split_threshold", 8)
+    try:
+        st = _hubby_store()
+        dev = rt.pin(st, "g", force=True)
+        assert dev.host.hub_dense is not None \
+            and len(dev.host.hub_dense) > 0
+        for steps, direction in ((1, "out"), (2, "in"), (3, "both")):
+            rows, _ = rt.traverse(st, "g", [3, 7, 44], ["knows"],
+                                  direction, steps)
+            got = sorted(norm_edge(e) for (_, e, _) in rows)
+            assert got == host_go(st, "g", [3, 7, 44], ["knows"],
+                                  direction, steps), (steps, direction)
+        eng = QueryEngine(st, tpu_runtime=rt)
+        s = eng.new_session()
+        eng.execute(s, "USE g")
+        plain = QueryEngine(st)
+        sp = plain.new_session()
+        plain.execute(sp, "USE g")
+        for q in [
+            "GO 2 STEPS FROM 7 OVER knows WHERE knows.w > 30 "
+            "YIELD src(edge), dst(edge), knows.w",
+            "MATCH (a:person)-[e:knows*1..2]->(b) WHERE id(a) == 7 "
+            "RETURN count(*)",
+            "FIND SHORTEST PATH FROM 7 TO 44 OVER knows YIELD path AS p",
+            "GET SUBGRAPH 2 STEPS FROM 7 YIELD VERTICES AS nodes",
+        ]:
+            a, b = eng.execute(s, q), plain.execute(sp, q)
+            assert a.error is None and b.error is None, \
+                (q, a.error, b.error)
+            assert sorted(map(repr, a.data.rows)) == \
+                sorted(map(repr, b.data.rows)), q
+    finally:
+        get_config().set_dynamic("tpu_degree_split_threshold", 0)
+
+
+def test_degree_split_bfs_parity(rt):
+    """Device BFS distances with hubs == host level-synchronous BFS,
+    on the sharded mesh AND the single-chip direction-optimizing
+    variant (its bottom-up probes hub rows owned by other parts)."""
+    import numpy as np
+    from nebula_tpu.utils.config import get_config
+    get_config().set_dynamic("tpu_degree_split_threshold", 8)
+    try:
+        st = _hubby_store(seed=4, n=150, extra=70)
+        want = {3: 0}
+        frontier = {3}
+        for lvl in range(1, 6):
+            nxt = set()
+            for (sv, et, rank, dv, props, sgn) in st.get_neighbors(
+                    "g", sorted(frontier), ["knows"], "out"):
+                if dv not in want:
+                    nxt.add(dv)
+            for v in nxt:
+                want[v] = lvl
+            frontier = nxt
+        sd = st.space("g")
+        for runtime in (rt, TpuRuntime(make_mesh(1))):
+            dev = runtime.pin(st, "g", force=True)
+            assert dev.host.hub_dense is not None
+            dist, _ = runtime.bfs(st, "g", [3], ["knows"], "out", 5)
+            got = np.asarray(dist, np.int32)
+            for vid in range(150):
+                d = sd.dense_id(vid)
+                if d < 0:
+                    continue
+                assert got[d % P, d // P] == want.get(vid, -1), vid
+    finally:
+        get_config().set_dynamic("tpu_degree_split_threshold", 0)
