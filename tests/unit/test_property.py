@@ -126,3 +126,29 @@ def _shared_rt():
         from nebula_tpu.tpu import TpuRuntime, make_mesh
         _rt_box.append(TpuRuntime(make_mesh(8)))
     return _rt_box[0]
+
+
+@settings(max_examples=6, deadline=None,
+          suppress_health_check=[HealthCheck.too_slow])
+@given(st.integers(0, 2**31 - 1), st.integers(1, 3),
+       st.sampled_from(["out", "in", "both"]),
+       st.integers(2, 24))
+def test_degree_split_go_matches_host_on_random_graphs(
+        seed, steps, direction, threshold):
+    """Device GO with a RANDOM degree-split threshold == host rows:
+    hub sets of every size (including empty and nearly-everything)
+    preserve exact results."""
+    from test_tpu import host_go, norm_edge, random_store
+    from nebula_tpu.utils.config import get_config
+    get_config().set_dynamic("tpu_degree_split_threshold", threshold)
+    try:
+        rt = _shared_rt()
+        st_ = random_store(seed % 1000, n=60, avg_deg=3)
+        rt.pin(st_, "g", force=True)
+        rows, _ = rt.traverse(st_, "g", [1, 5, 9], ["knows"], direction,
+                              steps)
+        got = sorted(norm_edge(e) for (_, e, _) in rows)
+        want = host_go(st_, "g", [1, 5, 9], ["knows"], direction, steps)
+        assert got == want
+    finally:
+        get_config().set_dynamic("tpu_degree_split_threshold", 0)
