@@ -285,6 +285,8 @@ def test_amplified_job_manager_lifecycle():
         assert eng.execute(s, t).error is None
     eng.execute(s, "INSERT VERTEX P(a) VALUES 1:(1)")
 
+    import time
+
     mgr = job_manager(store)
     orig_run = JobManager._run
     live = {"n": 0, "max": 0, "per_job": {}, "concurrent_self": False}
