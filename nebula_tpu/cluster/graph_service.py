@@ -151,6 +151,19 @@ class GraphService:
         over every graphd named in metad's session table)."""
         return self.engine.list_running_queries()
 
+    def rpc_stop_job(self, p):
+        """STOP JOB routed from another graphd: this one is the
+        executor named in metad's job table — stop it in the LOCAL
+        worker pool and report the resulting status."""
+        from ..exec.jobs import job_manager
+        mgr = job_manager(self.engine.qctx.store)
+        job = mgr.jobs.get(p["job_id"])
+        if job is None:
+            return None
+        if job.status != "FINISHED":
+            mgr.stop(job)
+        return job.status
+
     def rpc_kill_query(self, p):
         """Set the kill event of a RUNNING query on THIS graphd; returns
         whether anything matched (the issuing graphd raises if no owner

@@ -182,8 +182,16 @@ class MetaClient:
     def set_config(self, name: str, value: Any):
         self.call("meta.set_config", name=name, value=value)
 
-    def submit_job(self, cmd: str, space: Optional[str] = None) -> int:
-        return self.call("meta.submit_job", cmd=cmd, space=space)
+    def submit_job(self, cmd: str, space: Optional[str] = None,
+                   graphd: str = "") -> int:
+        """graphd: the submitting/executing graphd — recorded in the
+        job row at birth so STOP can always route (no window where the
+        row has no executor)."""
+        return self.call("meta.submit_job", cmd=cmd, space=space,
+                         graphd=graphd)
+
+    def update_job(self, jid: int, **fields):
+        self.call("meta.update_job", jid=jid, fields=fields)
 
     def add_hosts_to_zone(self, hosts, zone: str):
         self.call("meta.add_hosts", hosts=list(hosts), zone=zone)

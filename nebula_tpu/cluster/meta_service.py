@@ -160,7 +160,8 @@ class MetaState:
         jid = self.next_job
         self.next_job += 1
         self.jobs[jid] = {"cmd": c["cmd"], "space": c.get("space"),
-                          "status": "QUEUED", "ts": c["ts"], "result": None}
+                          "graphd": c.get("graphd", ""),
+                          "status": "QUEUE", "ts": c["ts"], "result": None}
         return jid
 
     def _ap_update_job(self, c):
@@ -484,7 +485,9 @@ class MetaService:
 
     def rpc_submit_job(self, p):
         return self._propose({"op": "add_job", "cmd": p["cmd"],
-                              "space": p.get("space"), "ts": time.time()})
+                              "space": p.get("space"),
+                              "graphd": p.get("graphd", ""),
+                              "ts": time.time()})
 
     def rpc_update_job(self, p):
         return self._propose({"op": "update_job", "jid": p["jid"],

@@ -1277,9 +1277,9 @@ def _drop_index(node, qctx, ectx, space):
 @executor("RebuildIndex")
 def _rebuild_index(node, qctx, ectx, space):
     a = node.args
-    from .jobs import job_manager
-    job = job_manager(qctx.store).submit(qctx, f"rebuild index {a['index_name']}",
-                               a["space"])
+    from .jobs import submit_tracked
+    job = submit_tracked(qctx, f"rebuild index {a['index_name']}",
+                         a["space"])
     return DataSet(["New Job Id"], [[job.job_id]])
 
 
@@ -1339,10 +1339,10 @@ def _drop_ft_index(node, qctx, ectx, space):
 @executor("RebuildFulltextIndex")
 def _rebuild_ft_index(node, qctx, ectx, space):
     a = node.args
-    from .jobs import job_manager
+    from .jobs import submit_tracked
     cmd = "rebuild fulltext" + (f" {a['index_name']}"
                                 if a.get("index_name") else "")
-    job = job_manager(qctx.store).submit(qctx, cmd, a["space"])
+    job = submit_tracked(qctx, cmd, a["space"])
     return DataSet(["New Job Id"], [[job.job_id]])
 
 
@@ -1482,6 +1482,14 @@ def _show(node, qctx, ectx, space):
                         "Partition distribution"],
                        [["127.0.0.1", 0, "ONLINE", 0, "in-process"]])
     if kind in ("tag_indexes_status", "edge_indexes_status"):
+        cluster = getattr(qctx, "cluster", None)
+        if cluster is not None:
+            # rebuild jobs live in metad's table: status is visible from
+            # every graphd, not just the one that ran the rebuild
+            rows = [[j["cmd"][len("rebuild index "):], j["status"]]
+                    for j in cluster.list_jobs()
+                    if j["cmd"].startswith("rebuild index ")]
+            return DataSet(["Name", "Index Status"], rows)
         from .jobs import job_manager
         rows = [[j.command[len("rebuild index "):], j.status]
                 for j in sorted(job_manager(qctx.store).jobs.values(),

@@ -29,6 +29,8 @@ class Job:
     result: Optional[Dict[str, Any]] = None
     space: Optional[str] = None          # RECOVER re-runs in this space
     cancel: Any = None                   # threading.Event (task lifecycle)
+    on_start: Any = None                 # fn(job) when a worker picks it up
+    on_done: Any = None                  # fn(job) after the worker ends
 
 
 class JobManager:
@@ -57,12 +59,21 @@ class JobManager:
         except Exception:  # noqa: BLE001 — config missing in odd embeds
             return 2
 
-    def submit(self, qctx, command: str, space: Optional[str]) -> Job:
+    def submit(self, qctx, command: str, space: Optional[str],
+               job_id: Optional[int] = None, on_start=None,
+               on_done=None) -> Job:
+        """Enqueue a job.  `job_id` pins the id (cluster mode: the
+        metad-allocated cluster-wide id); `on_start(job)`/`on_done(job)`
+        fire from the worker thread (cluster mode: mirror the
+        RUNNING/terminal status back to metad's replicated job table)."""
         import threading
         with self._lock:
-            job = Job(next(self._ids), command, space=space,
+            jid = job_id if job_id is not None else next(self._ids)
+            job = Job(jid, command, space=space,
                       cancel=threading.Event())
-            self.jobs[job.job_id] = job
+            job.on_start = on_start
+            job.on_done = on_done
+            self.jobs[jid] = job
             self._queue.append((job, qctx))
             self._dispatch_locked()
         return job
@@ -105,6 +116,11 @@ class JobManager:
                              name=f"admin-job-{job.job_id}").start()
 
     def _worker(self, job: Job, qctx):
+        if job.on_start is not None:
+            try:
+                job.on_start(job)
+            except Exception:  # noqa: BLE001 — mirror is best-effort
+                pass
         try:
             job.result = self._run(qctx, job.command, job.space, job)
             job.status = "FINISHED"
@@ -116,6 +132,11 @@ class JobManager:
             job.result = {"error": str(ex)}
         finally:
             job.stop_time = time.time()
+            if job.on_done is not None:
+                try:
+                    job.on_done(job)
+                except Exception:  # noqa: BLE001 — mirror is best-effort
+                    pass
             with self._lock:
                 self._running -= 1
                 self._dispatch_locked()
@@ -226,9 +247,40 @@ def job_manager(store) -> JobManager:
     return mgr
 
 
+def _wire_result(result) -> str:
+    try:
+        import json as _json
+        return _json.dumps(result)
+    except (TypeError, ValueError):
+        return str(result)
+
+
+def submit_tracked(qctx, command: str, space: Optional[str]) -> Job:
+    """Run a job through the local worker pool; in cluster mode the id
+    comes from metad's raft-replicated job table (cluster-visible SHOW
+    JOBS from any graphd — the reference's metad JobManager) and the
+    terminal status is mirrored back on completion."""
+    mgr = job_manager(qctx.store)
+    cluster = getattr(qctx, "cluster", None)
+    if cluster is None:
+        return mgr.submit(qctx, command, space)
+    # the executor graphd rides in the add_job proposal itself: the row
+    # is born with its executor, so STOP can always route
+    jid = cluster.submit_job(command, space,
+                             graphd=getattr(cluster, "my_addr", ""))
+
+    def on_start(job: Job):
+        cluster.update_job(jid, status="RUNNING")
+
+    def on_done(job: Job):
+        cluster.update_job(jid, status=job.status,
+                           result=_wire_result(job.result))
+    return mgr.submit(qctx, command, space, job_id=jid,
+                      on_start=on_start, on_done=on_done)
+
+
 def submit_job(node, qctx) -> DataSet:
-    job = job_manager(qctx.store).submit(qctx, node.args["job"],
-                                         node.args.get("space"))
+    job = submit_tracked(qctx, node.args["job"], node.args.get("space"))
     return DataSet(["New Job Id"], [[job.job_id]])
 
 
@@ -236,23 +288,98 @@ def stop_job(node, qctx) -> DataSet:
     """STOP JOB <id>: a QUEUE'd job is cancelled outright; a RUNNING
     one gets its cancel event set and aborts at its next cancel point
     (repartition: between source partitions).  Stopping a FINISHED job
-    is an error (reference semantics)."""
+    is an error (reference semantics).  In cluster mode the stop routes
+    to the EXECUTING graphd named in metad's job table."""
     jid = node.args["job_id"]
     mgr = job_manager(qctx.store)
     job = mgr.jobs.get(jid)
+    cluster = getattr(qctx, "cluster", None)
+    if job is None and cluster is not None:
+        row = next((j for j in cluster.list_jobs() if j["jid"] == jid),
+                   None)
+        if row is None:
+            raise ValueError(f"job {jid} not found")
+        if row["status"] == "FINISHED":
+            raise ValueError(f"job {jid} already finished")
+        addr = row.get("graphd")
+        status = None
+        if addr:
+            from .executors import _graphd_call
+            try:
+                status = _graphd_call(addr, "graph.stop_job", job_id=jid)
+            except Exception:  # noqa: BLE001 — executor down
+                status = None
+        # Only write a TERMINAL status from the issuer: a reachable
+        # executor's running job will mirror its own terminal state via
+        # on_done (an issuer-side "RUNNING" write could land after it
+        # and wedge the row non-terminal forever).  The STOPPED
+        # fallback marks an executor-less/unreachable row recoverable.
+        if status in (None, "STOPPED", "FAILED"):
+            cluster.update_job(jid, status=status or "STOPPED")
+        return DataSet(["Result"], [["Job stopped"]])
     if job is None:
         raise ValueError(f"job {jid} not found")
     if job.status == "FINISHED":
         raise ValueError(f"job {jid} already finished")
     mgr.stop(job)
+    if cluster is not None and job.status != "RUNNING":
+        # queued-stop never reaches a worker, so no on_done will fire —
+        # the issuer owns the terminal write; a RUNNING job's abort is
+        # mirrored by its own on_done
+        try:
+            cluster.update_job(jid, status=job.status)
+        except Exception:  # noqa: BLE001
+            pass
     return DataSet(["Result"], [["Job stopped"]])
 
 
 def recover_job(node, qctx) -> DataSet:
     """RECOVER JOB [<id>]: re-queue FAILED/STOPPED jobs (all of them
-    when no id is given); returns how many were re-queued."""
+    when no id is given); returns how many were re-queued.  In cluster
+    mode the recovery list comes from metad's table, and THIS graphd
+    becomes the executor of each re-run (a dead submitter's jobs are
+    re-homed — the reference's job-recovery semantics)."""
     mgr = job_manager(qctx.store)
     jid = node.args.get("job_id")
+    cluster = getattr(qctx, "cluster", None)
+    if cluster is not None:
+        table = cluster.list_jobs()
+        rows = [j for j in table
+                if j["status"] in ("FAILED", "STOPPED")
+                and (jid is None or j["jid"] == jid)]
+        if jid is not None and not rows:
+            known = {j["jid"]: j for j in table}
+            if jid not in known:
+                raise ValueError(f"job {jid} not found")
+            raise ValueError(
+                f"job {jid} is {known[jid]['status']}, not recoverable")
+        me = getattr(cluster, "my_addr", "")
+        n = 0
+        for row in rows:
+            local = mgr.jobs.get(row["jid"])
+            if local is not None and local.status in ("QUEUE", "RUNNING"):
+                # metad says STOPPED (e.g. an issuer's fallback write
+                # while this executor was unreachable) but the worker is
+                # still live — re-queueing would run the job twice
+                continue
+
+            def on_start(job: Job, _jid=row["jid"]):
+                cluster.update_job(_jid, status="RUNNING")
+
+            def on_done(job: Job, _jid=row["jid"]):
+                cluster.update_job(_jid, status=job.status,
+                                   result=_wire_result(job.result))
+            cluster.update_job(row["jid"], graphd=me, status="QUEUE")
+            if local is not None:
+                local.on_start = on_start
+                local.on_done = on_done
+                mgr.enqueue_rerun(local, qctx)
+            else:
+                mgr.submit(qctx, row["cmd"], row.get("space"),
+                           job_id=row["jid"], on_start=on_start,
+                           on_done=on_done)
+            n += 1
+        return DataSet(["Recovered job num"], [[n]])
     targets = [j for j in mgr.jobs.values()
                if j.status in ("FAILED", "STOPPED")
                and (jid is None or j.job_id == jid)]
@@ -269,6 +396,14 @@ def recover_job(node, qctx) -> DataSet:
 def show_jobs(node, qctx) -> DataSet:
     jid = node.args.get("job_id")
     cols = ["Job Id", "Command", "Status"]
+    cluster = getattr(qctx, "cluster", None)
+    if cluster is not None:
+        # metad's raft-replicated table: jobs are visible from EVERY
+        # graphd, not just the submitter
+        rows = [[j["jid"], j["cmd"], j["status"]]
+                for j in cluster.list_jobs()
+                if jid is None or j["jid"] == jid]
+        return DataSet(cols, rows)
     rows = []
     for j in sorted(job_manager(qctx.store).jobs.values(),
                     key=lambda x: x.job_id):

@@ -538,3 +538,57 @@ def test_show_and_kill_queries_cross_graphd(tmp_path):
         assert rs.error is not None
     finally:
         c.stop()
+
+
+def test_cluster_jobs_visible_and_recoverable_across_graphds(tmp_path):
+    """Jobs live in metad's raft-replicated table (the reference's
+    metad JobManager): SUBMIT on graphd A is visible from graphd B,
+    terminal status mirrors back, and RECOVER from B re-homes a
+    stopped job onto B as the new executor."""
+    from nebula_tpu.cluster.client import GraphClient
+    from nebula_tpu.cluster.launcher import LocalCluster
+    from nebula_tpu.exec.jobs import job_manager
+    c = LocalCluster(n_meta=1, n_storage=1, n_graph=2,
+                     data_dir=str(tmp_path))
+    try:
+        addr_a = c.graph_servers[0].addr
+        addr_b = c.graph_servers[1].addr
+        ha, pa = addr_a.rsplit(":", 1)
+        hb, pb = addr_b.rsplit(":", 1)
+        ca = GraphClient(ha, int(pa)); ca.authenticate("root", "nebula")
+        cb = GraphClient(hb, int(pb)); cb.authenticate("root", "nebula")
+        rs = ca.execute("CREATE SPACE cj(partition_num=2, "
+                        "replica_factor=1, vid_type=INT64)")
+        assert rs.error is None, rs.error
+        c.reconcile_storage()
+        ca.execute("USE cj"); cb.execute("USE cj")
+
+        rs = ca.execute("SUBMIT JOB STATS")
+        assert rs.error is None, rs.error
+        jid = rs.data.rows[0][0]
+        for g in c.graphds:
+            mgr = getattr(g.engine.qctx.store, "_job_manager", None)
+            assert mgr is None or mgr.wait()
+        # visible (with terminal status) from the OTHER graphd
+        rs = cb.execute(f"SHOW JOB {jid}")
+        assert rs.error is None and rs.data.rows, rs.error
+        assert rs.data.rows[0][0] == jid
+        assert rs.data.rows[0][2] == "FINISHED", rs.data.rows
+
+        # a job stopped on A recovers on B (B becomes the executor)
+        mgr_a = job_manager(c.graphds[0].engine.qctx.store)
+        meta = c.graphds[0].meta
+        meta.update_job(jid, status="STOPPED")
+        rs = cb.execute(f"RECOVER JOB {jid}")
+        assert rs.error is None, rs.error
+        assert rs.data.rows[0][0] == 1
+        mgr_b = job_manager(c.graphds[1].engine.qctx.store)
+        assert mgr_b.wait()
+        rs = ca.execute(f"SHOW JOB {jid}")
+        assert rs.data.rows[0][2] == "FINISHED"
+        assert jid in mgr_b.jobs          # B executed the re-run
+        # bogus ids error from any graphd
+        rs = cb.execute("STOP JOB 999999")
+        assert rs.error is not None
+    finally:
+        c.stop()
