@@ -276,9 +276,16 @@ def _plan_go(pctx, s: A.GoSentence) -> PlanNode:
     m, n = s.steps.m, s.steps.n
     if n < m or n < 0 or m < 0:
         raise QueryError(f"invalid step range {m} TO {n}")
+    go_pairs = [(c.expr, nm) for c, nm in zip(ycols, col_names)]
+    go_agg = _implicit_agg_split(go_pairs)
     if n == 0:
-        return PlanNode("Project", deps=[start], col_names=col_names,
-                        args={"columns": [], "empty": True})
+        out = PlanNode("Project", deps=[start], col_names=col_names,
+                       args={"columns": [], "empty": True})
+        if go_agg is not None:
+            # aggregate-over-empty yields its fold identity (count → 0),
+            # same as a source vertex with no edges
+            out = _plan_aggregate(out, go_agg[1], None)
+        return out
 
     carry = list(input_cols) if uses_input and src_node is not None else []
 
@@ -306,8 +313,8 @@ def _plan_go(pctx, s: A.GoSentence) -> PlanNode:
                                   col_names=list(branch.col_names),
                                   args={"condition": where_expr})
             proj = PlanNode("Project", deps=[branch], col_names=col_names,
-                            args={"columns": [(c.expr, nm) for c, nm in
-                                              zip(ycols, col_names)],
+                            args={"columns": (go_agg[0] if go_agg
+                                              else go_pairs),
                                   "go_row": True})
             branches.append(proj)
         if step < n:
@@ -331,6 +338,10 @@ def _plan_go(pctx, s: A.GoSentence) -> PlanNode:
     for b in branches[1:]:
         out = PlanNode("Union", deps=[out, b], col_names=col_names,
                        args={"distinct": False})
+    if go_agg is not None:
+        # implicit aggregation folds over ALL steps' rows (after the
+        # m-to-n union), grouped by the non-aggregate yield columns
+        out = _plan_aggregate(out, go_agg[1], None)
     if yld.distinct:
         out = PlanNode("Dedup", deps=[out], col_names=col_names)
     if s.truncate is not None:
@@ -400,6 +411,35 @@ def _plan_yield(pctx, s: A.YieldSentence) -> PlanNode:
     if s.yield_.distinct:
         out = PlanNode("Dedup", deps=[out], col_names=names)
     return out
+
+
+def _implicit_agg_split(pairs: List[Tuple[Expr, str]]):
+    """Implicit aggregation in GO/LOOKUP/FETCH YIELD (reference:
+    GoValidator/LookupValidator semantics — `GO ... YIELD count(*)`
+    folds over ALL result rows, grouped by the non-aggregate columns).
+
+    Returns None when no column aggregates; otherwise (inner, outer):
+    the per-row projection (each aggregate column replaced by its
+    argument — the fold's feed) and the Aggregate columns that fold the
+    projected values.  An aggregate nested inside a larger expression
+    is refused (same restriction as the reference)."""
+    if not any(has_aggregate(e) for e, _ in pairs):
+        return None
+    inner, outer = [], []
+    for e, nm in pairs:
+        if isinstance(e, AggExpr):
+            if e.arg is not None and has_aggregate(e.arg):
+                raise QueryError(
+                    "aggregate functions can not be nested")
+            inner.append((e.arg if e.arg is not None else Literal(1), nm))
+            outer.append((AggExpr(e.func, InputProp(nm), e.distinct), nm))
+        elif has_aggregate(e):
+            raise QueryError(
+                "an aggregate function must be the entire YIELD column")
+        else:
+            inner.append((e, nm))
+            outer.append((InputProp(nm), nm))
+    return inner, outer
 
 
 def _plan_aggregate(dep: PlanNode, cols: List[Tuple[Expr, str]],
@@ -494,8 +534,12 @@ def _plan_fetch_vertices(pctx, s: A.FetchVerticesSentence) -> PlanNode:
 
     ycols = [(rewrite(c.expr, _tagprop), _col_name(c)) for c in yld.columns]
     names = [n for _, n in ycols]
+    agg_split = _implicit_agg_split(ycols)
     out = PlanNode("Project", deps=[gv], col_names=names,
-                   args={"columns": ycols, "fetch_row": True})
+                   args={"columns": agg_split[0] if agg_split else ycols,
+                         "fetch_row": True})
+    if agg_split is not None:
+        out = _plan_aggregate(out, agg_split[1], None)
     if yld.distinct:
         out = PlanNode("Dedup", deps=[out], col_names=names)
     return out
@@ -516,8 +560,12 @@ def _plan_fetch_edges(pctx, s: A.FetchEdgesSentence) -> PlanNode:
     ycols = [(_rewrite_go_expr(pctx, c.expr, [s.etype]), _col_name(c))
              for c in yld.columns]
     names = [n for _, n in ycols]
+    agg_split = _implicit_agg_split(ycols)
     out = PlanNode("Project", deps=[ge], col_names=names,
-                   args={"columns": ycols, "fetch_row": True})
+                   args={"columns": agg_split[0] if agg_split else ycols,
+                         "fetch_row": True})
+    if agg_split is not None:
+        out = _plan_aggregate(out, agg_split[1], None)
     if yld.distinct:
         out = PlanNode("Dedup", deps=[out], col_names=names)
     return out
@@ -745,9 +793,13 @@ def _plan_lookup(pctx, s: A.LookupSentence) -> PlanNode:
             e = _rewrite_go_expr(pctx, e, [s.schema_name])
         ycols.append((e, _col_name(c)))
     names = [n for _, n in ycols]
+    agg_split = _implicit_agg_split(ycols)
     out = PlanNode("Project", deps=[scan], col_names=names,
-                   args={"columns": ycols, "lookup_row": True,
+                   args={"columns": agg_split[0] if agg_split else ycols,
+                         "lookup_row": True,
                          "schema": s.schema_name, "is_edge": is_edge})
+    if agg_split is not None:
+        out = _plan_aggregate(out, agg_split[1], None)
     if yld.distinct:
         out = PlanNode("Dedup", deps=[out], col_names=names)
     return out

@@ -154,3 +154,64 @@ Feature: Aggregates and grouping
       | n     | amt |
       | "Cat" | 30  |
       | "Cat" | 20  |
+
+  Scenario: implicit aggregation in go yield
+    When executing query:
+      """
+      GO FROM "a" OVER owes YIELD count(*) AS n
+      """
+    Then the result should be, in any order:
+      | n |
+      | 2 |
+
+  Scenario: implicit grouped aggregation in go yield
+    When executing query:
+      """
+      GO FROM "a", "b" OVER owes YIELD dst(edge) AS d, count(*) AS n
+      | ORDER BY $-.d
+      """
+    Then the result should be, in order:
+      | d   | n |
+      | "b" | 1 |
+      | "c" | 2 |
+
+  Scenario: implicit aggregation with sum and avg in go yield
+    When executing query:
+      """
+      GO FROM "a" OVER owes YIELD sum(owes.amt) AS s, avg(owes.amt) AS a
+      """
+    Then the result should be, in any order:
+      | s  | a    |
+      | 30 | 15.0 |
+
+  Scenario: implicit aggregation in fetch yield
+    When executing query:
+      """
+      FETCH PROP ON person "a", "b" YIELD count(*) AS n
+      """
+    Then the result should be, in any order:
+      | n |
+      | 2 |
+
+  Scenario: aggregate nested in a larger yield expression is refused
+    When executing query:
+      """
+      GO FROM "a" OVER owes YIELD 1 + count(*) AS n
+      """
+    Then a SemanticError should be raised
+
+  Scenario: nested aggregate is refused
+    When executing query:
+      """
+      GO FROM "a" OVER owes YIELD count(sum(owes.amt)) AS n
+      """
+    Then a SemanticError should be raised
+
+  Scenario: zero step go with an aggregate yields the fold identity
+    When executing query:
+      """
+      GO 0 STEPS FROM "a" OVER owes YIELD count(*) AS n
+      """
+    Then the result should be, in any order:
+      | n |
+      | 0 |

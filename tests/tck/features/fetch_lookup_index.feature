@@ -92,3 +92,23 @@ Feature: FETCH, LOOKUP, and index semantics
       LOOKUP ON city WHERE city.pop >= 8000 YIELD id(vertex)
       """
     Then the result should be empty
+
+  Scenario: implicit aggregation in lookup yield
+    When executing query:
+      """
+      LOOKUP ON city WHERE city.pop > 500 YIELD count(*) AS n
+      """
+    Then the result should be, in any order:
+      | n |
+      | 3 |
+
+  Scenario: implicit grouped aggregation in lookup on edges
+    When executing query:
+      """
+      LOOKUP ON road WHERE road.len > 1000 YIELD src(edge) AS s, count(*) AS n
+      | ORDER BY $-.s
+      """
+    Then the result should be, in order:
+      | s | n |
+      | 1 | 1 |
+      | 2 | 1 |
