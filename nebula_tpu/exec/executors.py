@@ -1071,11 +1071,11 @@ def _subgraph(node, qctx, ectx, space):
 @executor("InsertVertices")
 def _insert_vertices(node, qctx, ectx, space):
     a = node.args
-    for vid, props in a["rows"]:
+    for vid, per_tag in a["rows"]:
         if a["if_not_exists"] and qctx.store.get_vertex(a["space"], vid):
             continue
-        qctx.store.insert_vertex(a["space"], vid, a["tag"], props,
-                                 a["prop_names"])
+        for (tag, names), props in zip(a["tags"], per_tag):
+            qctx.store.insert_vertex(a["space"], vid, tag, props, names)
     return DataSet()
 
 

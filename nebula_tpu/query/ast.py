@@ -292,10 +292,16 @@ class VertexRowAst:
 
 @dataclass
 class InsertVerticesSentence(Sentence):
-    tag: str
-    prop_names: List[str]
+    # tag groups: [(tag_name, [prop, ...]), ...] — the reference grammar
+    # allows INSERT VERTEX t1(a, b), t2(c) VALUES v:(x, y, z) with the
+    # value list spanning the groups in order
+    tags: list
     rows: List[VertexRowAst]
     if_not_exists: bool = False
+
+    @property
+    def prop_names(self) -> List[str]:
+        return [n for _, ns in self.tags for n in ns]
 
 
 @dataclass

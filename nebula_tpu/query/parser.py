@@ -888,8 +888,12 @@ class Parser:
         self.expect_kw("INSERT")
         if self.accept_kw("VERTEX"):
             ine = self.p_if_not_exists()
-            tag = self.ident()
-            names = self.p_name_list_paren()
+            groups = []
+            while True:
+                tag = self.ident()
+                groups.append((tag, self.p_name_list_paren()))
+                if not self.accept(","):
+                    break
             self.expect_kw("VALUES")
             rows = []
             while True:
@@ -903,7 +907,7 @@ class Parser:
                 rows.append(A.VertexRowAst(vid, vals))
                 if not self.accept(","):
                     break
-            return A.InsertVerticesSentence(tag, names, rows, ine)
+            return A.InsertVerticesSentence(groups, rows, ine)
         self.expect_kw("EDGE")
         ine = self.p_if_not_exists()
         etype = self.ident()

@@ -869,8 +869,38 @@ def _plan_projection(pctx, dep: Optional[PlanNode], cols: List[A.YieldColumn],
     if distinct:
         out = PlanNode("Dedup", deps=[out], col_names=names)
     if order_by:
-        factors = [( _rewrite_match_expr(f.expr, {n: "value" for n in names}),
-                     f.ascending) for f in order_by]
+        # ORDER BY items resolve against the PROJECTED columns
+        # (openCypher scope after RETURN/WITH): a bare alias stays a
+        # column lookup, an expression that textually matches an output
+        # column (e.g. `ORDER BY id(a)` after `RETURN id(a), k`) is
+        # re-homed to that column, and anything else is an error —
+        # evaluating it against projected rows would silently sort on
+        # NULL (the pattern variables are out of scope here).
+        src_text = {to_text(e): nm for e, nm in ycols}
+        factors = []
+        for f in order_by:
+            e2 = f.expr
+            txt = to_text(e2)
+            txt_m = to_text(_rewrite_match_expr(
+                e2, aliases)) if aliases else txt
+            if isinstance(e2, LabelExpr) and e2.name in names:
+                pass                       # alias lookup — resolves as-is
+            elif txt in names:
+                e2 = LabelExpr(txt)        # same column, spelled as expr
+            elif txt_m in src_text:
+                # ORDER BY repeats a projected column's SOURCE expr
+                # (`RETURN a.p.x AS x ORDER BY a.p.x`) — same column
+                e2 = LabelExpr(src_text[txt_m])
+            else:
+                e2 = _rewrite_match_expr(
+                    e2, {n: "value" for n in names})
+                refs = {x.name for x in walk(e2)
+                        if x.kind in ("label", "input_prop")}
+                if refs and not refs <= set(names):
+                    raise QueryError(
+                        f"ORDER BY item `{txt}' must be a column of "
+                        f"the RETURN/WITH list (have {names})")
+            factors.append((e2, f.ascending))
         out = PlanNode("Sort", deps=[out], col_names=names,
                        args={"factors": factors, "match_row": True})
     if skip or (limit is not None and limit >= 0):
@@ -1185,22 +1215,28 @@ def _const_eval(e: Expr) -> Any:
 
 def _plan_insert_vertices(pctx, s: A.InsertVerticesSentence) -> PlanNode:
     space = pctx.need_space()
-    try:
-        ts = pctx.catalog.get_tag(space, s.tag)
-    except SchemaError as ex:
-        raise QueryError(str(ex)) from None
-    for n in s.prop_names:
-        if ts.latest.prop(n) is None:
-            raise QueryError(f"tag `{s.tag}' has no property `{n}'")
+    for tag, names in s.tags:
+        try:
+            ts = pctx.catalog.get_tag(space, tag)
+        except SchemaError as ex:
+            raise QueryError(str(ex)) from None
+        for n in names:
+            if ts.latest.prop(n) is None:
+                raise QueryError(f"tag `{tag}' has no property `{n}'")
+    total = len(s.prop_names)
     rows = []
     for r in s.rows:
-        if len(r.values) != len(s.prop_names):
+        if len(r.values) != total:
             raise QueryError("value count does not match prop count")
-        rows.append((_const_eval(r.vid),
-                     {n: _const_eval(v) for n, v in zip(s.prop_names, r.values)}))
+        vals = [_const_eval(v) for v in r.values]
+        per_tag, off = [], 0
+        for _tag, names in s.tags:
+            per_tag.append(dict(zip(names, vals[off:off + len(names)])))
+            off += len(names)
+        rows.append((_const_eval(r.vid), per_tag))
     return PlanNode("InsertVertices", col_names=[], args={
-        "space": space, "tag": s.tag, "rows": rows,
-        "prop_names": s.prop_names, "if_not_exists": s.if_not_exists})
+        "space": space, "tags": list(s.tags), "rows": rows,
+        "if_not_exists": s.if_not_exists})
 
 
 def _plan_insert_edges(pctx, s: A.InsertEdgesSentence) -> PlanNode:

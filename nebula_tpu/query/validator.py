@@ -361,16 +361,28 @@ def _register_sentence_validators():
                 raise ValidationError(
                     f"vertex row has {len(row.values)} values for "
                     f"{len(stmt.prop_names)} properties")
+        seen_tags = set()
+        seen_props = set()
+        for tag, names in stmt.tags:
+            if tag in seen_tags:
+                raise ValidationError(f"duplicate tag `{tag}'")
+            seen_tags.add(tag)
+            for pn in names:
+                if (tag, pn) in seen_props:
+                    raise ValidationError(
+                        f"duplicate property `{pn}' on tag `{tag}'")
+                seen_props.add((tag, pn))
         if not pctx.space:
             return
-        if not _has_tag(pctx, stmt.tag):
-            raise ValidationError(f"tag `{stmt.tag}' not found")
-        sv = pctx.catalog.get_tag(pctx.space, stmt.tag).latest
-        have = {p.name for p in sv.props}
-        for pn in stmt.prop_names:
-            if pn not in have:
-                raise ValidationError(
-                    f"tag `{stmt.tag}' has no property `{pn}'")
+        for tag, names in stmt.tags:
+            if not _has_tag(pctx, tag):
+                raise ValidationError(f"tag `{tag}' not found")
+            sv = pctx.catalog.get_tag(pctx.space, tag).latest
+            have = {p.name for p in sv.props}
+            for pn in names:
+                if pn not in have:
+                    raise ValidationError(
+                        f"tag `{tag}' has no property `{pn}'")
 
     @_svalidator(A.InsertEdgesSentence)
     def v_insert_e(stmt, pctx):

@@ -163,3 +163,22 @@ Feature: DML semantics
     Then the result should be, in order:
       | n |
       | 3 |
+
+  Scenario: multi tag insert vertex
+    When executing query:
+      """
+      CREATE TAG extra(note string);
+      INSERT VERTEX person(name, age), extra(note) VALUES 77:("Multi", 9, "both tags");
+      FETCH PROP ON extra 77 YIELD extra.note AS n
+      """
+    Then the result should be, in any order:
+      | n           |
+      | "both tags" |
+
+  Scenario: multi tag insert arity mismatch is refused
+    When executing query:
+      """
+      CREATE TAG extra2(note string);
+      INSERT VERTEX person(name, age), extra2(note) VALUES 78:("x", 1)
+      """
+    Then a SemanticError should be raised

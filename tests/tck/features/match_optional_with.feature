@@ -92,3 +92,25 @@ Feature: OPTIONAL MATCH, WITH pipelines, named paths, relationship uniqueness
     Then the result should be, in any order:
       | c |
       | 3 |
+
+  Scenario: order by a return expression spelled out
+    When executing query:
+      """
+      MATCH (a:person) UNWIND [2, 1] AS k
+      RETURN id(a), k ORDER BY id(a), k
+      """
+    Then the result should be, in order:
+      | id(a) | k |
+      | 1     | 1 |
+      | 1     | 2 |
+      | 2     | 1 |
+      | 2     | 2 |
+      | 3     | 1 |
+      | 3     | 2 |
+
+  Scenario: order by something not in the return list is refused
+    When executing query:
+      """
+      MATCH (a:person) RETURN a.person.name AS n ORDER BY a.person.name + "z"
+      """
+    Then a SemanticError should be raised

@@ -566,14 +566,25 @@ def test_cluster_jobs_visible_and_recoverable_across_graphds(tmp_path):
         rs = ca.execute("SUBMIT JOB STATS")
         assert rs.error is None, rs.error
         jid = rs.data.rows[0][0]
-        for g in c.graphds:
-            mgr = getattr(g.engine.qctx.store, "_job_manager", None)
-            assert mgr is None or mgr.wait()
+
+        def poll_status(client, want, timeout=10.0):
+            # the metad mirror is written by the worker AFTER the local
+            # status flips (eventually consistent) — poll the statement
+            # surface like an operator would
+            import time as _t
+            deadline = _t.time() + timeout
+            while _t.time() < deadline:
+                r = client.execute(f"SHOW JOB {jid}")
+                assert r.error is None, r.error
+                if r.data.rows and r.data.rows[0][2] == want:
+                    return r
+                _t.sleep(0.02)
+            raise AssertionError(f"job {jid} never reached {want}: "
+                                 f"{r.data.rows}")
+
         # visible (with terminal status) from the OTHER graphd
-        rs = cb.execute(f"SHOW JOB {jid}")
-        assert rs.error is None and rs.data.rows, rs.error
+        rs = poll_status(cb, "FINISHED")
         assert rs.data.rows[0][0] == jid
-        assert rs.data.rows[0][2] == "FINISHED", rs.data.rows
 
         # a job stopped on A recovers on B (B becomes the executor)
         mgr_a = job_manager(c.graphds[0].engine.qctx.store)
@@ -584,8 +595,7 @@ def test_cluster_jobs_visible_and_recoverable_across_graphds(tmp_path):
         assert rs.data.rows[0][0] == 1
         mgr_b = job_manager(c.graphds[1].engine.qctx.store)
         assert mgr_b.wait()
-        rs = ca.execute(f"SHOW JOB {jid}")
-        assert rs.data.rows[0][2] == "FINISHED"
+        poll_status(ca, "FINISHED")
         assert jid in mgr_b.jobs          # B executed the re-run
         # bogus ids error from any graphd
         rs = cb.execute("STOP JOB 999999")
