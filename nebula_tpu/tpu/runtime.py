@@ -441,12 +441,26 @@ class TpuRuntime:
         if not self._buckets_path:
             return
         try:
+            import ast as _ast
             import json as _json
+            import os as _os
+            # MERGE with the on-disk contents: several runtimes (one per
+            # engine) share the cache file, and a plain overwrite made
+            # the last saver clobber every other program's converged
+            # buckets (each process then re-climbed the recompile ladder
+            # — ~100 s/rung on a tunneled chip)
+            merged = {}
+            try:
+                with open(self._buckets_path) as f:
+                    merged = {_ast.literal_eval(k): tuple(v)
+                              for k, v in _json.load(f).items()}
+            except Exception:  # noqa: BLE001 — absent/corrupt file
+                merged = {}
+            merged.update(self._buckets)
             tmp = self._buckets_path + ".tmp"
             with open(tmp, "w") as f:
                 _json.dump({repr(k): list(v)
-                            for k, v in self._buckets.items()}, f)
-            import os as _os
+                            for k, v in merged.items()}, f)
             _os.replace(tmp, self._buckets_path)
         except Exception:  # noqa: BLE001 — cache is best-effort
             pass
