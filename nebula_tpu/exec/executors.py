@@ -1598,16 +1598,23 @@ def _show(node, qctx, ectx, space):
                              f"{spd.replica_factor}, vid_type = {spd.vid_type})"]])
         get = cat.get_edge if which == "edge" else cat.get_tag
         schema = get(sp, name)
+        sv = schema.latest
         parts = []
-        for p in schema.latest.props:
+        for p in sv.props:
             s = f"`{p.name}` {p.ptype.value}"
             s += " NULL" if p.nullable else " NOT NULL"
             if p.has_default:
                 s += f" DEFAULT {p.default!r}"
             parts.append(s)
         kw = "EDGE" if which == "edge" else "TAG"
+        ddl = f"CREATE {kw} `{name}` (" + ", ".join(parts) + ")"
+        if sv.ttl_col and sv.ttl_duration > 0:
+            # the emitted DDL must round-trip the FULL schema — TTL
+            # included (it was silently dropped before)
+            ddl += (f" TTL_DURATION = {sv.ttl_duration}, "
+                    f"TTL_COL = \"{sv.ttl_col}\"")
         return DataSet([kw.title(), f"Create {kw.title()}"],
-                       [[name, f"CREATE {kw} `{name}` (" + ", ".join(parts) + ")"]])
+                       [[name, ddl]])
     raise ExecError(f"unsupported SHOW {kind}")
 
 

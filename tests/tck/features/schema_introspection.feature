@@ -127,3 +127,11 @@ Feature: Schema introspection and evolution
     Then the result should be, in any order:
       | Collation  | Charset |
       | "utf8_bin" | "utf8"  |
+
+  Scenario: show create tag round-trips ttl
+    When executing query:
+      """
+      CREATE TAG ttled(age int) TTL_DURATION = 100, TTL_COL = "age";
+      SHOW CREATE TAG ttled
+      """
+    Then the result should contain "TTL_DURATION = 100"
