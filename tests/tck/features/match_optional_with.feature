@@ -114,3 +114,35 @@ Feature: OPTIONAL MATCH, WITH pipelines, named paths, relationship uniqueness
       MATCH (a:person) RETURN a.person.name AS n ORDER BY a.person.name + "z"
       """
     Then a SemanticError should be raised
+
+  Scenario: with carries a variable into a second match
+    When executing query:
+      """
+      MATCH (a:person) WITH a MATCH (a)-[e:knows]->(b)
+      RETURN id(a) AS a, id(b) AS b ORDER BY a
+      """
+    Then the result should be, in order:
+      | a | b |
+      | 1 | 2 |
+      | 2 | 3 |
+
+  Scenario: with projects and carries in one clause
+    When executing query:
+      """
+      MATCH (a:person) WITH a.person.name AS n, a
+      MATCH (a)-[:knows]->(b) RETURN n, id(b) ORDER BY n
+      """
+    Then the result should be, in order:
+      | n   | id(b) |
+      | "a" | 2     |
+      | "b" | 3     |
+
+  Scenario: with collect feeds list functions
+    When executing query:
+      """
+      MATCH (a:person) WITH collect(id(a)) AS ids
+      RETURN size(ids) AS s, head(ids) AS h
+      """
+    Then the result should be, in any order:
+      | s | h |
+      | 3 | 1 |
