@@ -480,9 +480,14 @@ class Parser:
         return A.YieldColumn(e, alias)
 
     def p_int_list(self) -> List[int]:
+        """Per-step counts — the reference spells them bracketed
+        (`LIMIT [10, 100]`); the bare form stays accepted."""
+        bracketed = self.accept("[") is not None
         out = [self.expect("INT").value]
         while self.accept(","):
             out.append(self.expect("INT").value)
+        if bracketed:
+            self.expect("]")
         return out
 
     # ---- YIELD / pipe segments ----

@@ -202,3 +202,10 @@ Feature: GO advanced forms
       | d |
       | 3 |
       | 3 |
+
+  Scenario: bracketed per-step limit counts
+    When executing query:
+      """
+      GO 2 STEPS FROM 1 OVER knows YIELD dst(edge) AS d LIMIT [1, 1]
+      """
+    Then the result should not be empty
