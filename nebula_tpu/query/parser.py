@@ -845,6 +845,8 @@ class Parser:
         if self.accept_kw("ZONE"):
             return A.DescZoneSentence(self.ident())
         kind = self.expect_kw("SPACE", "TAG", "EDGE", "INDEX").value.lower()
+        if kind in ("tag", "edge") and self.accept_kw("INDEX"):
+            kind = "index"       # reference spelling: DESC TAG/EDGE INDEX i
         return A.DescribeSentence(kind, self.ident())
 
     def p_rebuild(self) -> A.Sentence:
