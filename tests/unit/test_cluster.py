@@ -602,3 +602,55 @@ def test_cluster_jobs_visible_and_recoverable_across_graphds(tmp_path):
         assert rs.error is not None
     finally:
         c.stop()
+
+
+def test_metad_quorum_survives_leader_kill(tmp_path):
+    """3-metad quorum: killing the metad LEADER mid-flight must elect a
+    new one; DDL, session creation, and queries keep working through
+    the surviving majority (the client follows leader hints)."""
+    import time
+    from nebula_tpu.cluster.launcher import LocalCluster
+    c = LocalCluster(n_meta=3, n_storage=1, n_graph=1,
+                     data_dir=str(tmp_path))
+    try:
+        client = c.client()
+        rs = client.execute("CREATE SPACE mq(partition_num=2, "
+                            "replica_factor=1, vid_type=INT64)")
+        assert rs.error is None, rs.error
+        c.reconcile_storage()
+        for q in ["USE mq", "CREATE TAG t(x int)",
+                  "INSERT VERTEX t(x) VALUES 1:(5)"]:
+            rs = client.execute(q)
+            assert rs.error is None, (q, rs.error)
+
+        leader_i = next(i for i, ms in enumerate(c.metads)
+                        if ms.raft.is_leader())
+        c.metads[leader_i].stop()
+        c.meta_servers[leader_i].stop()
+
+        deadline = time.time() + 15
+        new_leader = None
+        while time.time() < deadline and new_leader is None:
+            new_leader = next(
+                (i for i, ms in enumerate(c.metads)
+                 if i != leader_i and ms.raft.is_leader()), None)
+            time.sleep(0.05)
+        assert new_leader is not None, "no new metad leader elected"
+
+        # DDL through the new leader (client re-discovers it)
+        deadline = time.time() + 20
+        ok = False
+        while time.time() < deadline and not ok:
+            rs = client.execute("CREATE TAG t2(y int)")
+            ok = rs.error is None
+            if not ok:
+                time.sleep(0.3)
+        assert ok, f"DDL never succeeded after failover: {rs.error}"
+        rs = client.execute("FETCH PROP ON t 1 YIELD t.x AS x")
+        assert rs.error is None and rs.data.rows == [[5]], rs.error
+        # a FRESH session authenticates against the survivors too
+        c2 = c.client()
+        rs = c2.execute("USE mq; FETCH PROP ON t 1 YIELD t.x AS x")
+        assert rs.error is None and rs.data.rows == [[5]], rs.error
+    finally:
+        c.stop()
