@@ -1019,3 +1019,42 @@ def test_degree_split_bfs_parity(rt):
                 assert got[d % P, d // P] == want.get(vid, -1), vid
     finally:
         get_config().set_dynamic("tpu_degree_split_threshold", 0)
+
+
+def test_degree_split_string_vids(rt):
+    """Degree-split + FIXED_STRING vids: the d2v decode is an OBJECT
+    array here (no identity fast path), and hub dense ids still map
+    back to string vids exactly."""
+    from nebula_tpu.utils.config import get_config
+    get_config().set_dynamic("tpu_degree_split_threshold", 4)
+    try:
+        st = GraphStore()
+        st.create_space("svh", partition_num=P,
+                        vid_type="FIXED_STRING(16)")
+        st.catalog.create_tag("svh", "person",
+                              [PropDef("name", PropType.STRING)])
+        st.catalog.create_edge("svh", "knows",
+                               [PropDef("w", PropType.INT64)])
+        rng = random.Random(3)
+        vids = [f"v{i:03d}" for i in range(60)]
+        for v in vids:
+            st.insert_vertex("svh", v, "person", {"name": v})
+        for v in vids:
+            for _ in range(rng.randint(1, 4)):
+                st.insert_edge("svh", v, "knows", rng.choice(vids), 0,
+                               {"w": rng.randint(0, 9)})
+        for i in range(25):
+            st.insert_edge("svh", "v000", "knows", rng.choice(vids), i,
+                           {"w": 1})
+        dev = rt.pin(st, "svh", force=True)
+        assert dev.host.hub_dense is not None
+        rows, _ = rt.traverse(st, "svh", ["v000", "v005"], ["knows"],
+                              "out", 2)
+        got = sorted(norm_edge(e) for (_, e, _) in rows)
+        want = host_go(st, "svh", ['"v000"', '"v005"'], ["knows"],
+                       "out", 2)
+        assert got == want
+        for (sv, e, dv) in rows:
+            assert isinstance(sv, str) and isinstance(dv, str)
+    finally:
+        get_config().set_dynamic("tpu_degree_split_threshold", 0)
