@@ -92,6 +92,9 @@ class Scheduler:
                     # per-hop expansion sizes + kernel time + buckets
                     profile.per_node[node.id]["tpu"] = {
                         "device_s": round(ts.device_s, 6),
+                        "put_s": round(ts.put_s, 6),
+                        "fetch_s": round(ts.fetch_s, 6),
+                        "mat_s": round(ts.mat_s, 6),
                         "hop_edges": ts.hop_edges,
                         "buckets": {"EB": ts.e_cap},
                         "retries": ts.retries,
