@@ -1058,3 +1058,49 @@ def test_degree_split_string_vids(rt):
             assert isinstance(sv, str) and isinstance(dv, str)
     finally:
         get_config().set_dynamic("tpu_degree_split_threshold", 0)
+
+
+def test_speculative_fetch_round_trips_and_undershoot(rt):
+    """Repeat query shapes collapse the two-phase result fetch into ONE
+    device_get (a tunnel round trip saved per query); an undershoot —
+    the kept set growing past the speculated prefix — falls back to the
+    exact refetch with identical rows."""
+    from nebula_tpu.tpu import runtime as R
+    st = GraphStore()
+    st.create_space("sf", partition_num=P, vid_type="INT64")
+    st.catalog.create_tag("sf", "person", [PropDef("a", PropType.INT64)])
+    st.catalog.create_edge("sf", "knows", [PropDef("w", PropType.INT64)])
+    for v in range(60):
+        st.insert_vertex("sf", v, "person", {"a": v})
+    st.insert_edge("sf", 1, "knows", 2, 0, {"w": 1})
+    st.insert_edge("sf", 1, "knows", 3, 0, {"w": 2})
+    for i in range(40):                    # supersized vertex 2
+        st.insert_edge("sf", 2, "knows", (i * 7) % 60, i, {"w": i})
+
+    calls = [0]
+    orig = R.jax.device_get
+
+    def counting(x):
+        calls[0] += 1
+        return orig(x)
+
+    R.jax.device_get = counting
+    try:
+        rows, _ = rt.traverse(st, "sf", [1], ["knows"], "out", 1)
+        calls[0] = 0
+        rows, _ = rt.traverse(st, "sf", [1], ["knows"], "out", 1)
+        assert calls[0] == 1, calls[0]     # speculation engaged
+        assert sorted(norm_edge(e) for (_, e, _) in rows) == \
+            host_go(st, "sf", [1], ["knows"], "out", 1)
+        # same program shape, 20x the kept set: speculated prefix is
+        # too small — exact refetch kicks in, rows still identical
+        rows, _ = rt.traverse(st, "sf", [2], ["knows"], "out", 1)
+        assert sorted(norm_edge(e) for (_, e, _) in rows) == \
+            host_go(st, "sf", [2], ["knows"], "out", 1)
+        calls[0] = 0
+        rows, _ = rt.traverse(st, "sf", [2], ["knows"], "out", 1)
+        assert calls[0] == 1               # re-armed at the larger size
+        assert sorted(norm_edge(e) for (_, e, _) in rows) == \
+            host_go(st, "sf", [2], ["knows"], "out", 1)
+    finally:
+        R.jax.device_get = orig
