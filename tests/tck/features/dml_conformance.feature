@@ -1,0 +1,69 @@
+Feature: DML conformance — WHEN guards, IF NOT EXISTS, rank addressing
+
+  Background:
+    Given having executed:
+      """
+      CREATE SPACE dc(partition_num=2, vid_type=INT64);
+      USE dc;
+      CREATE TAG p(x int);
+      CREATE EDGE r(w int);
+      INSERT VERTEX p(x) VALUES 1:(10), 2:(20);
+      INSERT EDGE r(w) VALUES 1->2:(5), 1->2@1:(6)
+      """
+
+  Scenario: update when guard blocks the write
+    When executing query:
+      """
+      UPDATE VERTEX ON p 1 SET x = 99 WHEN x > 100 YIELD x;
+      FETCH PROP ON p 1 YIELD p.x AS x
+      """
+    Then the result should be, in any order:
+      | x  |
+      | 10 |
+
+  Scenario: insert if not exists never overwrites
+    When executing query:
+      """
+      INSERT VERTEX IF NOT EXISTS p(x) VALUES 1:(777);
+      INSERT EDGE IF NOT EXISTS r(w) VALUES 1->2:(888);
+      FETCH PROP ON p 1 YIELD p.x AS x
+      """
+    Then the result should be, in any order:
+      | x  |
+      | 10 |
+
+  Scenario: rank addresses a specific parallel edge
+    When executing query:
+      """
+      FETCH PROP ON r 1->2@1 YIELD r.w AS w
+      """
+    Then the result should be, in any order:
+      | w |
+      | 6 |
+
+  Scenario: upsert edge inserts when absent
+    When executing query:
+      """
+      UPSERT EDGE ON r 5->6 SET w = 3 YIELD w
+      """
+    Then the result should be, in any order:
+      | w |
+      | 3 |
+
+  Scenario: piped delete with rank removes exactly the matched edges
+    When executing query:
+      """
+      GO FROM 1 OVER r YIELD src(edge) AS s, dst(edge) AS d, rank(edge) AS rk
+      | DELETE EDGE r $-.s -> $-.d @ $-.rk;
+      GO FROM 1 OVER r YIELD dst(edge)
+      """
+    Then the result should be empty
+
+  Scenario: update edge arithmetic references the current value
+    When executing query:
+      """
+      UPDATE EDGE ON r 1->2@1 SET w = w + 10 YIELD w
+      """
+    Then the result should be, in any order:
+      | w  |
+      | 16 |

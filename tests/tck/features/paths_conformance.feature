@@ -1,0 +1,54 @@
+Feature: FIND PATH variants — WITH PROP, multi endpoints, direction
+
+  Background:
+    Given having executed:
+      """
+      CREATE SPACE pc(partition_num=2, vid_type=INT64);
+      USE pc;
+      CREATE TAG p(x int);
+      CREATE EDGE r(w int);
+      INSERT VERTEX p(x) VALUES 1:(10), 2:(20), 3:(30), 4:(40);
+      INSERT EDGE r(w) VALUES 1->2:(5), 2->3:(7), 1->3:(9), 3->4:(1)
+      """
+
+  Scenario: shortest path with prop carries vertex properties
+    When executing query:
+      """
+      FIND SHORTEST PATH WITH PROP FROM 1 TO 3 OVER r YIELD path AS p
+      """
+    Then the result should contain "x"
+
+  Scenario: multi source and destination shortest paths
+    When executing query:
+      """
+      FIND SHORTEST PATH FROM 1, 2 TO 3, 4 OVER r YIELD path AS p
+      """
+    Then the result should not be empty
+
+  Scenario: reversed shortest path walks incoming edges
+    When executing query:
+      """
+      FIND SHORTEST PATH FROM 3 TO 1 OVER r REVERSELY YIELD path AS p
+      """
+    Then the result should not be empty
+
+  Scenario: reversed shortest path in the wrong direction is empty
+    When executing query:
+      """
+      FIND SHORTEST PATH FROM 1 TO 3 OVER r REVERSELY YIELD path AS p
+      """
+    Then the result should be empty
+
+  Scenario: bidirect shortest path ignores edge orientation
+    When executing query:
+      """
+      FIND SHORTEST PATH FROM 1 TO 4 OVER r BIDIRECT YIELD path AS p
+      """
+    Then the result should not be empty
+
+  Scenario: zero step subgraph is the source itself
+    When executing query:
+      """
+      GET SUBGRAPH 0 STEPS FROM 1 YIELD VERTICES AS nodes
+      """
+    Then the result should not be empty
