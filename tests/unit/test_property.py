@@ -152,3 +152,29 @@ def test_degree_split_go_matches_host_on_random_graphs(
         assert got == want
     finally:
         get_config().set_dynamic("tpu_degree_split_threshold", 0)
+
+
+@settings(max_examples=5, deadline=None,
+          suppress_health_check=[HealthCheck.too_slow])
+@given(st.integers(0, 2**31 - 1), st.integers(0, 1), st.integers(1, 3))
+def test_var_len_match_device_parity_on_random_graphs(seed, m_off, span):
+    """MATCH *m..n trail counting: device layered-frame assembly ==
+    host DFS on random graphs over random hop windows (the subtlest
+    device path — per-depth emission gates + edge distinctness)."""
+    from test_tpu import random_store
+    from nebula_tpu.exec.engine import QueryEngine
+    rt = _shared_rt()
+    st_ = random_store(seed % 1000, n=40, avg_deg=3)
+    m = m_off + 1
+    n = m + span - 1
+    q = (f"MATCH (a:person)-[e:knows*{m}..{n}]->(b) "
+         f"WHERE id(a) IN [1, 5, 9] RETURN count(*) AS c")
+    out = []
+    for tpu_rt in (None, rt):
+        eng = QueryEngine(st_, tpu_runtime=tpu_rt)
+        s = eng.new_session()
+        eng.execute(s, "USE g")
+        rs = eng.execute(s, q)
+        assert rs.error is None, rs.error
+        out.append(rs.data.rows)
+    assert out[0] == out[1], (m, n, out)
