@@ -161,6 +161,28 @@ class SpaceDesc:
     def vid_is_int(self) -> bool:
         return self.vid_type.strip().upper().startswith("INT")
 
+    def check_vid(self, vid) -> None:
+        """Write-path vid conformance (reference semantics: a write with
+        the wrong vid type is an error, never a silent coercion)."""
+        if self.vid_is_int():
+            if not isinstance(vid, int) or isinstance(vid, bool):
+                raise SchemaError(
+                    f"vid {vid!r} does not match vid_type "
+                    f"{self.vid_type}")
+            return
+        if not isinstance(vid, str):
+            raise SchemaError(
+                f"vid {vid!r} does not match vid_type {self.vid_type}")
+        vt = self.vid_type.strip().upper()
+        if vt.startswith("FIXED_STRING(") and vt.endswith(")"):
+            try:
+                cap = int(vt[len("FIXED_STRING("):-1])
+            except ValueError:
+                return
+            if len(vid.encode()) > cap:
+                raise SchemaError(
+                    f"vid {vid!r} exceeds {self.vid_type}")
+
 
 ROLES = ("GOD", "ADMIN", "DBA", "USER", "GUEST")
 ROLE_RANK = {r: i for i, r in enumerate(reversed(ROLES))}
@@ -590,6 +612,9 @@ def apply_defaults(sv: SchemaVersion, props: Dict[str, Any],
     for p in sv.props:
         if p.name in props:
             v = coerce(p.ptype, props[p.name])
+            if is_null(v) and not p.nullable:
+                raise SchemaError(
+                    f"prop `{p.name}' is NOT NULL")
             if not check_type(p.ptype, v):
                 raise SchemaError(
                     f"prop `{p.name}' expects {p.ptype.value}, got {type(v).__name__}")

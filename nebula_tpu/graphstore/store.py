@@ -581,6 +581,7 @@ class GraphStore:
     def insert_vertex(self, space: str, vid: Any, tag: str,
                       props: Dict[str, Any], insert_names: Optional[List[str]] = None):
         sd = self.space(space)
+        sd.desc.check_vid(vid)
         ts = self.catalog.get_tag(space, tag)
         sv = ts.latest
         row = apply_defaults(sv, props, insert_names)
@@ -598,6 +599,8 @@ class GraphStore:
                     rank: int, props: Dict[str, Any],
                     insert_names: Optional[List[str]] = None):
         sd = self.space(space)
+        sd.desc.check_vid(src)
+        sd.desc.check_vid(dst)
         es = self.catalog.get_edge(space, etype)
         sv = es.latest
         row = apply_defaults(sv, props, insert_names)

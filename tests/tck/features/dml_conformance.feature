@@ -67,3 +67,18 @@ Feature: DML conformance — WHEN guards, IF NOT EXISTS, rank addressing
     Then the result should be, in any order:
       | w  |
       | 16 |
+
+  Scenario: null into a not null column is refused
+    When executing query:
+      """
+      CREATE TAG nn(x int NOT NULL);
+      INSERT VERTEX nn(x) VALUES 9:(NULL)
+      """
+    Then an ExecutionError should be raised
+
+  Scenario: wrong vid type is refused
+    When executing query:
+      """
+      INSERT VERTEX p(x) VALUES "strvid":(1)
+      """
+    Then an ExecutionError should be raised
