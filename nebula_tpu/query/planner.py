@@ -809,7 +809,11 @@ def _plan_lookup(pctx, s: A.LookupSentence) -> PlanNode:
 
 
 def _plan_match(pctx, s: A.MatchSentence) -> PlanNode:
-    space = pctx.need_space()
+    # a pure UNWIND/WITH/RETURN pipeline touches no graph data — like
+    # YIELD, it must work before any USE (openCypher expression-only
+    # queries); the first MATCH clause still demands a space
+    if any(isinstance(c, A.MatchClauseAst) for c in s.clauses):
+        pctx.need_space()
     current: Optional[PlanNode] = pctx.input_node
     aliases: Dict[str, str] = {}
     if current is not None:

@@ -195,3 +195,25 @@ Feature: Null semantics
     Then the result should be, in any order:
       | i |
       | 1 |
+
+  Scenario: aggregates skip null inputs but count star keeps rows
+    When executing query:
+      """
+      UNWIND [5, NULL, 7] AS w
+      RETURN count(w) AS c, sum(w) AS s, avg(w) AS a, collect(w) AS col
+      """
+    Then the result should be, in any order:
+      | c | s  | a   | col    |
+      | 2 | 12 | 6.0 | [5, 7] |
+
+  Scenario: null is its own group key
+    When executing query:
+      """
+      UNWIND [5, NULL, 7, NULL] AS w
+      RETURN w, count(*) AS n
+      """
+    Then the result should be, in any order:
+      | w    | n |
+      | 5    | 1 |
+      | 7    | 1 |
+      | NULL | 2 |
