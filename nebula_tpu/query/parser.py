@@ -1235,9 +1235,11 @@ class Parser:
 
     def p_with_clause(self) -> A.WithClauseAst:
         distinct = bool(self.accept_kw("DISTINCT"))
-        cols = [self.p_yield_col()]
-        while self.accept(","):
-            cols.append(self.p_yield_col())
+        cols: Optional[List[A.YieldColumn]] = None
+        if not self.accept("*"):
+            cols = [self.p_yield_col()]
+            while self.accept(","):
+                cols.append(self.p_yield_col())
         wc = A.WithClauseAst(cols, distinct)
         wc.order_by, wc.skip, wc.limit = self.p_order_skip_limit()
         if self.accept_kw("WHERE"):

@@ -831,7 +831,13 @@ def _plan_match(pctx, s: A.MatchSentence) -> PlanNode:
                                args={"expr": e, "alias": clause.alias})
             aliases[clause.alias] = "value"
         elif isinstance(clause, A.WithClauseAst):
-            current = _plan_projection(pctx, current, clause.columns,
+            wcols = clause.columns
+            if wcols is None:      # WITH *: carry every visible alias
+                wcols = [A.YieldColumn(LabelExpr(a), a) for a in aliases
+                         if not a.startswith("_")]
+                if not wcols:
+                    raise QueryError("WITH * with nothing in scope")
+            current = _plan_projection(pctx, current, wcols,
                                        clause.distinct, clause.where,
                                        clause.order_by, clause.skip,
                                        clause.limit, aliases)

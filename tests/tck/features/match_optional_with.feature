@@ -146,3 +146,25 @@ Feature: OPTIONAL MATCH, WITH pipelines, named paths, relationship uniqueness
     Then the result should be, in any order:
       | s | h |
       | 3 | 1 |
+
+  Scenario: with star carries every alias forward
+    When executing query:
+      """
+      MATCH (a:person) WITH * MATCH (a)-[e:knows]->(b)
+      RETURN id(a) AS a, id(b) AS b ORDER BY a
+      """
+    Then the result should be, in order:
+      | a | b |
+      | 1 | 2 |
+      | 2 | 3 |
+
+  Scenario: with star where filters on a carried alias
+    When executing query:
+      """
+      MATCH (a:person) WITH * WHERE a.person.name > "a"
+      RETURN a.person.name AS n ORDER BY n
+      """
+    Then the result should be, in order:
+      | n   |
+      | "b" |
+      | "c" |
