@@ -208,3 +208,28 @@ Feature: Advanced expressions, predicates, and aggregates
     Then the result should be, in any order:
       | a                          | wrap            |
       | duration({seconds: 9000})  | time("00:30:00") |
+
+  Scenario: integer division truncates and modulo follows C semantics
+    When executing query:
+      """
+      YIELD -3 % 2 AS m, 7 / 2 AS d, 7.0 / 2 AS f
+      """
+    Then the result should be, in any order:
+      | m  | d | f   |
+      | -1 | 3 | 3.5 |
+
+  Scenario: int overflow yields the overflow null kind
+    When executing query:
+      """
+      YIELD 9223372036854775807 + 1 AS ovf
+      """
+    Then the result should contain "__OVERFLOW__"
+
+  Scenario: equality is type strict across kinds
+    When executing query:
+      """
+      YIELD 1 == 1.0 AS numeric, "1" == 1 AS mixed
+      """
+    Then the result should be, in any order:
+      | numeric | mixed |
+      | true    | false |
