@@ -123,6 +123,7 @@ class DistributedStore:
     def insert_vertex(self, space: str, vid: Any, tag: str,
                       props: Dict[str, Any],
                       insert_names: Optional[List[str]] = None):
+        self.catalog.get_space(space).check_vid(vid)
         ts = self.catalog.get_tag(space, tag)
         sv = ts.latest
         row = apply_defaults(sv, props, insert_names)
@@ -152,6 +153,9 @@ class DistributedStore:
     def insert_edge(self, space: str, src: Any, etype: str, dst: Any,
                     rank: int, props: Dict[str, Any],
                     insert_names: Optional[List[str]] = None):
+        desc = self.catalog.get_space(space)
+        desc.check_vid(src)
+        desc.check_vid(dst)
         es = self.catalog.get_edge(space, etype)
         row = apply_defaults(es.latest, props, insert_names)
         # TOSS chain: out-half first (source of truth), then in-half
