@@ -486,6 +486,34 @@ def eliminate_limit_zero(node: PlanNode) -> Optional[PlanNode]:
 
 
 @register_rule
+def eliminate_topn_zero(node: PlanNode) -> Optional[PlanNode]:
+    """TopN(count=0) → empty result: sorting zero output rows is pure
+    waste (same degenerate-plan prune as Limit 0)."""
+    if node.kind != "TopN" or not node.deps:
+        return None
+    if node.args.get("count") == 0:
+        return PlanNode("Project", deps=[],
+                        col_names=list(node.col_names),
+                        args={"empty": True})
+    return None
+
+
+@register_rule
+def eliminate_dedup_after_distinct_union(node: PlanNode
+                                         ) -> Optional[PlanNode]:
+    """Dedup(Union{distinct}) → Union{distinct}: a distinct set op
+    already emits unique rows, the outer Dedup re-hashes them for
+    nothing (UNION DISTINCT ... | YIELD DISTINCT shapes)."""
+    if node.kind != "Dedup" or len(node.deps) != 1:
+        return None
+    u = node.dep()
+    if u.kind in ("Union", "Intersect", "Minus") \
+            and u.args.get("distinct"):
+        return u
+    return None
+
+
+@register_rule
 def eliminate_noop_limit(node: PlanNode) -> Optional[PlanNode]:
     """Limit(offset=0, count=unbounded) → child."""
     if node.kind != "Limit" or not node.deps:

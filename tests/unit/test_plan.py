@@ -754,3 +754,32 @@ def test_filter_through_aggregate_skips_untraversable_exprs(eng):
         (want.error, got.error)
     assert sorted(map(repr, got.data.rows)) == \
         sorted(map(repr, want.data.rows))
+
+
+def test_eliminate_topn_zero(eng):
+    from nebula_tpu.core.expr import InputProp
+    from nebula_tpu.query.plan import PlanNode
+    base = PlanNode("Start", col_names=["x"])
+    tn = PlanNode("TopN", deps=[base], col_names=["x"],
+                  args={"factors": [(InputProp("x"), True)],
+                        "offset": 0, "count": 0})
+    p = optimize(ExecutionPlan(tn, "t"))
+    assert any(n.args.get("empty") for n in [p.root])
+
+
+def test_eliminate_dedup_after_distinct_union(eng):
+    from nebula_tpu.query.plan import PlanNode
+    a = PlanNode("Start", col_names=["x"])
+    b = PlanNode("Start", col_names=["x"])
+    u = PlanNode("Union", deps=[a, b], col_names=["x"],
+                 args={"distinct": True})
+    dd = PlanNode("Dedup", deps=[u], col_names=["x"])
+    p = optimize(ExecutionPlan(dd, "t"))
+    assert p.root.kind == "Union"
+    # UNION ALL keeps the Dedup (duplicates are possible)
+    u2 = PlanNode("Union", deps=[PlanNode("Start", col_names=["x"]),
+                                 PlanNode("Start", col_names=["x"])],
+                  col_names=["x"], args={"distinct": False})
+    dd2 = PlanNode("Dedup", deps=[u2], col_names=["x"])
+    p2 = optimize(ExecutionPlan(dd2, "t"))
+    assert p2.root.kind == "Dedup"
