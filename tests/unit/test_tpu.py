@@ -1104,3 +1104,26 @@ def test_speculative_fetch_round_trips_and_undershoot(rt):
             host_go(st, "sf", [2], ["knows"], "out", 1)
     finally:
         R.jax.device_get = orig
+
+
+def test_over_all_direction_combos_parity(rt):
+    """OVER * x REVERSELY/BIDIRECT x m-to-n: multi-block expansion in
+    every direction matches the host engine row-for-row."""
+    st = random_store(11, extra_edge_type=True)
+    eng = QueryEngine(st, tpu_runtime=rt)
+    s = eng.new_session()
+    eng.execute(s, "USE g")
+    plain = QueryEngine(st)
+    sp = plain.new_session()
+    plain.execute(sp, "USE g")
+    for q in ["GO 2 STEPS FROM 3, 7 OVER * REVERSELY "
+              "YIELD src(edge), dst(edge), rank(edge)",
+              "GO 2 STEPS FROM 3, 7 OVER * BIDIRECT "
+              "YIELD src(edge), dst(edge)",
+              "GO 1 TO 3 STEPS FROM 3 OVER * YIELD dst(edge) AS d",
+              "GO 2 STEPS FROM 3 OVER knows, likes REVERSELY "
+              "YIELD type(edge), dst(edge)"]:
+        a, b = eng.execute(s, q), plain.execute(sp, q)
+        assert a.error is None and b.error is None, (q, a.error, b.error)
+        assert sorted(map(repr, a.data.rows)) == \
+            sorted(map(repr, b.data.rows)), q
