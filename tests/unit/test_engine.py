@@ -572,3 +572,22 @@ def test_admin_jobs_async_lifecycle():
     finally:
         JobManager._run = orig_run
         get_config().set_dynamic("max_concurrent_admin_jobs", 2)
+
+
+def test_idle_sessions_reaped():
+    """session_idle_timeout_secs: an idle session is dropped from the
+    registry at the next new_session (the standalone reap path; the
+    cluster reaps through metad TTL)."""
+    from nebula_tpu.utils.config import get_config
+    eng = QueryEngine()
+    old = get_config().get("session_idle_timeout_secs")
+    try:
+        get_config().set_dynamic("session_idle_timeout_secs", 0)
+        s1 = eng.new_session()
+        import time as _t
+        _t.sleep(0.05)
+        s2 = eng.new_session()
+        assert s1.id not in eng.sessions
+        assert s2.id in eng.sessions
+    finally:
+        get_config().set_dynamic("session_idle_timeout_secs", old)
