@@ -135,3 +135,20 @@ Feature: Schema introspection and evolution
       SHOW CREATE TAG ttled
       """
     Then the result should contain "TTL_DURATION = 100"
+
+  Scenario: describe tag index reference spelling
+    When executing query:
+      """
+      CREATE TAG dti(a int);
+      CREATE TAG INDEX i_dti ON dti(a);
+      DESCRIBE TAG INDEX i_dti
+      """
+    Then the result should contain "a"
+
+  Scenario: show create edge round-trips
+    When executing query:
+      """
+      CREATE EDGE sce(w int NOT NULL DEFAULT 3);
+      SHOW CREATE EDGE sce
+      """
+    Then the result should contain "DEFAULT 3"
