@@ -769,6 +769,30 @@ class PathBuild(Expr):
         return self.items
 
 
+class PatternPredExpr(Expr):
+    """A boolean pattern predicate — `WHERE (a)-[:knows]->()` (reference:
+    MatchValidator's PatternExpression / RollUpApply planning [UNVERIFIED
+    — empty mount, SURVEY §0]).  Exists-semantics: true iff at least one
+    expansion of the pattern matches with the bound aliases fixed.
+
+    Carries the parsed `ast.PathPattern` opaquely (core stays independent
+    of the query AST) plus its canonical source text for to_text/equality.
+    The MATCH planner rewrites every occurrence into a deduplicated
+    semi-join marker column before execution, so eval() is unreachable in
+    a planned query; reaching it means a validator failed to reject a
+    pattern predicate outside MATCH/WITH WHERE.
+    """
+    __slots__ = ("pattern", "text")
+    kind = "pattern_pred"
+
+    def __init__(self, pattern: Any, text: str):
+        self.pattern, self.text = pattern, text
+
+    def eval(self, ctx):
+        raise ExprEvalError(
+            "pattern predicate is only supported in a MATCH WHERE clause")
+
+
 class ExprEvalError(Exception):
     pass
 
@@ -837,6 +861,24 @@ def rewrite(e: Expr, fn) -> Expr:
         e2 = cls([(rewrite(w, fn), rewrite(t, fn)) for w, t in e.whens],
                  rewrite(e.default, fn) if e.default else None,
                  rewrite(e.condition, fn) if e.condition else None)
+    elif isinstance(e, SetExpr):
+        e2 = cls([rewrite(x, fn) for x in e.items])
+    elif isinstance(e, Slice):
+        e2 = cls(rewrite(e.obj, fn),
+                 rewrite(e.lo, fn) if e.lo is not None else None,
+                 rewrite(e.hi, fn) if e.hi is not None else None)
+    elif isinstance(e, ListComprehension):
+        e2 = cls(e.var, rewrite(e.collection, fn),
+                 rewrite(e.where, fn) if e.where is not None else None,
+                 rewrite(e.mapping, fn) if e.mapping is not None else None)
+    elif isinstance(e, PredicateExpr):
+        e2 = cls(e.name, e.var, rewrite(e.collection, fn),
+                 rewrite(e.where, fn))
+    elif isinstance(e, Reduce):
+        e2 = cls(e.acc, rewrite(e.init, fn), e.var,
+                 rewrite(e.collection, fn), rewrite(e.mapping, fn))
+    elif isinstance(e, PathBuild):
+        e2 = cls([rewrite(x, fn) for x in e.items])
     else:
         e2 = e
     r = fn(e2)
@@ -927,4 +969,6 @@ def to_text(e: Expr) -> str:
         return f"({e.target}){to_text(e.operand)}"
     if k == "path_build":
         return " <JOIN> ".join(to_text(x) for x in e.items)
+    if k == "pattern_pred":
+        return e.text
     return f"<{k}>"

@@ -624,6 +624,47 @@ class PathPattern:
     edges: List[EdgePattern] = field(default_factory=list)
 
 
+def pattern_text(pat: "PathPattern") -> str:
+    """Canonical source rendering of a path pattern — the to_text form of
+    a pattern-predicate expression (EXPLAIN output, expr equality)."""
+    from ..core.expr import to_text
+
+    def props_text(props):
+        return "{" + ", ".join(f"{k}: {to_text(v)}" for k, v in props.items()) + "}"
+
+    def node_text(np: NodePattern) -> str:
+        s = np.alias if np.alias and not np.alias.startswith("__anon_") else ""
+        for lbl, lprops in np.labels:
+            s += f":{lbl}"
+            if lprops:
+                s += props_text(lprops)
+        if np.props:
+            s += props_text(np.props)
+        return f"({s})"
+
+    out = [node_text(pat.nodes[0])]
+    for ep, np in zip(pat.edges, pat.nodes[1:]):
+        e = ep.alias if ep.alias and not ep.alias.startswith("__anon_") else ""
+        if ep.types:
+            e += ":" + "|".join(ep.types)
+        if ep.min_hop != 1 or ep.max_hop != 1:
+            e += "*"
+            if ep.max_hop == -1:
+                e += f"{ep.min_hop}.." if ep.min_hop != 1 else ""
+            elif ep.min_hop == ep.max_hop:
+                e += str(ep.min_hop)
+            else:
+                e += f"{ep.min_hop}..{ep.max_hop}"
+        if ep.props:
+            e += props_text(ep.props)
+        body = f"[{e}]" if e else ""
+        arrow = {"out": f"-{body}->", "in": f"<-{body}-",
+                 "both": f"-{body}-"}[ep.direction]
+        out.append(arrow)
+        out.append(node_text(np))
+    return "".join(out)
+
+
 @dataclass
 class MatchClauseAst:
     patterns: List[PathPattern]

@@ -14,9 +14,9 @@ from typing import Any, Dict, List, Optional, Tuple
 from ..core.expr import (AggExpr, AttributeExpr, Binary, Case, EdgeExpr,
                          Expr, FunctionCall, InputProp, LabelExpr,
                          ListComprehension, ListExpr, Literal, MapExpr,
-                         PredicateExpr, Reduce, SetExpr, Slice, SrcProp,
-                         Subscript, Unary, VarExpr, VarProp, VertexExpr,
-                         DstProp)
+                         PatternPredExpr, PredicateExpr, Reduce, SetExpr,
+                         Slice, SrcProp, Subscript, Unary, VarExpr, VarProp,
+                         VertexExpr, DstProp)
 from ..core.expr import AGG_NAMES
 from ..core.value import NULL
 from . import ast as A
@@ -190,6 +190,7 @@ class Parser:
             "RENAME": self.p_rename_zone, "DIVIDE": self.p_divide_zone,
             "BALANCE": self.p_balance,
             "DOWNLOAD": self.p_download, "INGEST": self.p_ingest,
+            "RETURN": self.p_match,
         }.get(kw)
         if fn is None:
             raise ParseError(f"unsupported statement `{kw}' at pos {t.pos}")
@@ -1451,6 +1452,15 @@ class Parser:
                 return self.p_call(name)
             return LabelExpr(name)
         if t.kind == "(":
+            # `(a)-[:knows]->(b)` in expression position is a boolean
+            # pattern predicate (reference: MatchValidator's
+            # PatternExpression [UNVERIFIED — empty mount, SURVEY §0]).
+            # Speculative: a parenthesized arithmetic operand like
+            # `(a) - [1,2][0]` fails the pattern parse at its first
+            # non-pattern token and falls back to the expression read.
+            pe = self.try_pattern_pred()
+            if pe is not None:
+                return pe
             self.next()
             e = self.parse_expr()
             self.expect(")")
@@ -1470,6 +1480,24 @@ class Parser:
             # COUNT(*) handled in p_call; bare * invalid here
             raise ParseError(f"unexpected `*' at pos {t.pos}")
         raise ParseError(f"unexpected {t.kind}({t.value!r}) at pos {t.pos}")
+
+    def try_pattern_pred(self) -> Optional[Expr]:
+        """Attempt `(node)(edge node)+` at the cursor; backtrack and
+        return None if it is not a pattern.  A bare `(a)` stays a
+        parenthesized expression — a pattern predicate needs >=1 edge."""
+        save = self.i
+        try:
+            pat = A.PathPattern(alias=None)
+            pat.nodes.append(self.p_node_pattern())
+            if not (self.at("-") or self.at("<-") or self.at("<")):
+                raise ParseError("not a pattern")
+            while self.at("-") or self.at("<-") or self.at("<"):
+                pat.edges.append(self.p_edge_pattern())
+                pat.nodes.append(self.p_node_pattern())
+        except ParseError:
+            self.i = save
+            return None
+        return PatternPredExpr(pat, A.pattern_text(pat))
 
     def p_call(self, name: str) -> Expr:
         lname = name.lower()
@@ -1508,6 +1536,8 @@ class Parser:
         if lname == "exists":
             arg = self.parse_expr()
             self.expect(")")
+            if isinstance(arg, PatternPredExpr):
+                return arg               # exists((a)-->(b)) ≡ (a)-->(b)
             return FunctionCall("_exists", [arg])
         args: List[Expr] = []
         while not self.accept(")"):

@@ -21,12 +21,13 @@ from __future__ import annotations
 
 from typing import Any, Dict, List, Optional, Tuple
 
-from ..core.expr import (AggExpr, AttributeExpr, Binary, DictContext, Expr,
-                         FunctionCall, InputProp, LabelExpr, LabelTagProp,
-                         Literal, Unary, VarExpr, VarProp, EdgeProp,
-                         VertexExpr, EdgeExpr, has_aggregate,
+from ..core.expr import (AggExpr, AttributeExpr, Binary, Case, DictContext,
+                         Expr, FunctionCall, InputProp, LabelExpr,
+                         LabelTagProp, Literal, Unary, VarExpr, VarProp,
+                         EdgeProp, VertexExpr, EdgeExpr, has_aggregate,
                          join_conjuncts, rewrite, split_conjuncts, to_text,
                          walk)
+from ..core.value import NULL
 from ..graphstore.schema import SchemaError
 from . import ast as A
 from .plan import ExecutionPlan, PlanNode
@@ -605,6 +606,126 @@ def _lookup_field_cond(c: Expr, schema: str, is_edge: bool):
     return None
 
 
+_GEO_REGION_FNS = ("st_intersects", "st_covers", "st_coveredby")
+
+
+def _geo_field_of(x: Expr, schema: str, is_edge: bool,
+                  alias: Optional[str] = None):
+    """<schema>.<field> in LOOKUP spelling, or <alias>.<schema>.<field>
+    (LabelTagProp) in MATCH spelling when `alias` is given."""
+    if is_edge and isinstance(x, EdgeProp) and x.edge == schema \
+            and not x.name.startswith("_"):
+        return x.name
+    if not is_edge and isinstance(x, AttributeExpr) \
+            and isinstance(x.obj, LabelExpr) and x.obj.name == schema:
+        return x.attr
+    if not is_edge and isinstance(x, LabelTagProp) and x.tag == schema \
+            and (alias is None or x.var == alias):
+        return x.prop
+    return None
+
+
+def _const_geography(e: Expr):
+    """Constant-fold to a Geography (WKT strings coerce); raise else."""
+    from ..core.geo import Geography, from_wkt
+    v = _const_eval(e)
+    if isinstance(v, str):
+        v = from_wkt(v)
+    if not isinstance(v, Geography):
+        raise QueryError("not a geography constant")
+    return v
+
+
+def _lookup_geo_cond(c: Expr, schema: str, is_edge: bool,
+                     alias: Optional[str] = None):
+    """Conjunct a geo index can serve (reference: the storage geo index's
+    predicate→cover extraction [UNVERIFIED — empty mount, SURVEY §0 row
+    15]) → (field, covering token ranges); else None.  Shapes:
+
+      ST_Intersects|ST_Covers|ST_CoveredBy(<schema>.<f>, <const geo>)
+        (either argument order)
+      ST_DWithin(<schema>.<f>, <const geo>, <const meters>)
+      ST_Distance(<schema>.<f>, <const geo>) < r  (<=; either side)
+
+    The cover is a bbox superset, so the caller must keep the ORIGINAL
+    predicate as a residual filter — the index only prunes."""
+    from ..core.geo import covering_ranges
+
+    def dist_parts(fc):
+        """st_distance(field, const) in either arg order → (field, geog)."""
+        if not (isinstance(fc, FunctionCall) and fc.name == "st_distance"
+                and len(fc.args) == 2):
+            return None
+        for a, b in ((fc.args[0], fc.args[1]), (fc.args[1], fc.args[0])):
+            f = _geo_field_of(a, schema, is_edge, alias)
+            if f is not None:
+                try:
+                    return f, _const_geography(b)
+                except Exception:  # noqa: BLE001 — non-constant operand
+                    return None
+        return None
+
+    if isinstance(c, FunctionCall) and c.name in _GEO_REGION_FNS \
+            and len(c.args) == 2:
+        for a, b in ((c.args[0], c.args[1]), (c.args[1], c.args[0])):
+            f = _geo_field_of(a, schema, is_edge, alias)
+            if f is not None:
+                try:
+                    g = _const_geography(b)
+                except Exception:  # noqa: BLE001 — non-constant operand
+                    return None
+                return f, covering_ranges(g)
+        return None
+    if isinstance(c, FunctionCall) and c.name == "st_dwithin" \
+            and len(c.args) == 3:
+        m = dist_parts(FunctionCall("st_distance", c.args[:2]))
+        if m is None:
+            return None
+        try:
+            r = _const_eval(c.args[2])
+        except Exception:  # noqa: BLE001 — non-constant radius
+            return None
+        if not isinstance(r, (int, float)) or isinstance(r, bool) or r < 0:
+            return None
+        return m[0], covering_ranges(m[1], pad_m=float(r))
+    if isinstance(c, Binary) and c.op in ("<", "<=", ">", ">="):
+        # normalize to st_distance(...) <-upper-bound- r
+        for lhs, rhs, op in ((c.lhs, c.rhs, c.op),
+                             (c.rhs, c.lhs, _REV_OP.get(c.op, c.op))):
+            if op not in ("<", "<="):
+                continue
+            m = dist_parts(lhs)
+            if m is None:
+                continue
+            try:
+                r = _const_eval(rhs)
+            except Exception:  # noqa: BLE001 — non-constant bound
+                return None
+            if not isinstance(r, (int, float)) or isinstance(r, bool) \
+                    or r < 0:
+                return None
+            return m[0], covering_ranges(m[1], pad_m=float(r))
+    return None
+
+
+def _geo_index_for(pctx, space: str, schema: str, is_edge: bool,
+                   field: str):
+    """The geo (cell-token-keyed) index over `schema.field`, if any."""
+    from ..graphstore.schema import PropType
+    try:
+        sv = (pctx.catalog.get_edge(space, schema).latest if is_edge
+              else pctx.catalog.get_tag(space, schema).latest)
+        p = sv.prop(field)
+    except SchemaError:
+        return None
+    if p is None or p.ptype != PropType.GEOGRAPHY:
+        return None
+    for d in pctx.catalog.indexes_for(space, schema, is_edge):
+        if d.fields == [field]:
+            return d
+    return None
+
+
 _TEXT_OPS = ("PREFIX", "WILDCARD", "REGEXP", "FUZZY")
 
 
@@ -765,6 +886,30 @@ def _plan_lookup(pctx, s: A.LookupSentence) -> PlanNode:
             raise QueryError(
                 f"no fulltext index on `{s.schema_name}.{field}' "
                 f"({op} requires one; CREATE FULLTEXT INDEX first)")
+    geo = None
+    if filt is not None and text is None:
+        # ST_ predicate over a cell-token geo index: scan the covering
+        # ranges, keep the WHOLE predicate as residual (cover ⊇ region).
+        # An equality/range binding on a B-tree index beats the bbox
+        # cover (code-review: the geo branch must not preempt a more
+        # selective probe), so the geo path only runs when the generic
+        # hint extraction binds nothing.
+        generic_binds = False
+        try:
+            _nm, eq_h, rng_h, _res = _choose_index(
+                pctx, space, s.schema_name, is_edge, filt)
+            generic_binds = bool(eq_h) or rng_h is not None
+        except QueryError:
+            pass                      # no B-tree index at all
+        if not generic_binds:
+            for c in split_conjuncts(filt):
+                m = _lookup_geo_cond(c, s.schema_name, is_edge)
+                if m is not None:
+                    d = _geo_index_for(pctx, space, s.schema_name,
+                                       is_edge, m[0])
+                    if d is not None:
+                        geo = (d.name, m[1])
+                        break
     if text is not None:
         op, field, pat = text
         scan = PlanNode("FulltextIndexScan", deps=[],
@@ -773,6 +918,12 @@ def _plan_lookup(pctx, s: A.LookupSentence) -> PlanNode:
                               "is_edge": is_edge, "filter": residual_t,
                               "index": ft_pick.name, "op": op,
                               "pattern": pat})
+    elif geo is not None:
+        scan = PlanNode("IndexScan", deps=[],
+                        col_names=["_matched"],
+                        args={"space": space, "schema": s.schema_name,
+                              "is_edge": is_edge, "filter": filt,
+                              "index": geo[0], "geo_ranges": geo[1]})
     else:
         index_name, eq, rng, residual = _choose_index(
             pctx, space, s.schema_name, is_edge, filt)
@@ -962,9 +1113,93 @@ def _plan_match_clause(pctx, mc: A.MatchClauseAst, current: Optional[PlanNode],
                             col_names=current.col_names + node.col_names)
     if mc.where is not None:
         w = _rewrite_match_expr(mc.where, aliases)
+        node, w, hidden = _apply_pattern_preds(pctx, node, w, aliases)
         node = PlanNode("Filter", deps=[node], col_names=list(node.col_names),
                         args={"condition": w, "match_row": True})
+        if hidden:
+            keep = [c for c in node.col_names if c not in hidden]
+            node = PlanNode("Project", deps=[node], col_names=keep,
+                            args={"columns": [(LabelExpr(c), c)
+                                              for c in keep],
+                                  "match_row": True})
     return node
+
+
+def _apply_pattern_preds(pctx, node: PlanNode, w: Expr,
+                         aliases: Dict[str, str]):
+    """WHERE (a)-[:e]->() — exists-semantics pattern predicates
+    (reference: MatchValidator's PatternExpression planned as a
+    RollUpApply semi-join [UNVERIFIED — empty mount, SURVEY §0]).
+
+    Each distinct pattern becomes a deduplicated semi-join branch: plan
+    the pattern seeded from a bound alias (Argument over the current
+    rows), project the bound alias columns plus a TRUE marker, left-join
+    back on the bound aliases, and rewrite the predicate occurrence into
+    `CASE WHEN <any bound alias IS NULL> THEN NULL ELSE marker IS NOT
+    NULL END` — NULL bound variables (OPTIONAL MATCH misses) make the
+    predicate NULL per openCypher 3VL; otherwise it is a two-valued
+    boolean so NOT/AND/OR compose correctly.
+    Returns (node, rewritten_where, hidden_cols)."""
+    import copy
+
+    markers: Dict[str, str] = {}
+    pats = []
+    for x in walk(w):
+        if x.kind == "pattern_pred" and x.text not in markers:
+            markers[x.text] = ""
+            pats.append(x)
+    if not pats:
+        return node, w, []
+    hidden: List[str] = []
+    for pe in pats:
+        n = getattr(pctx, "_pe_counter", 0)
+        pctx._pe_counter = n + 1
+        marker = f"__pe_{n}"
+        pat = copy.deepcopy(pe.pattern)
+        named = [np.alias for np in pat.nodes if np.alias is not None]
+        # bound = present in the incoming rows, whatever the alias kind:
+        # a vertex carried through WITH/UNWIND is typed "value" but its
+        # runtime column holds the vertex, which is all the semi-join
+        # seed needs (code-review: rejecting those as "new variables"
+        # refused valid openCypher)
+        bound = [a for a in dict.fromkeys(named) if a in node.col_names]
+        fresh = sorted(set(named) - set(bound))
+        if fresh:
+            raise QueryError(
+                "pattern predicate must not introduce new variables: "
+                + ", ".join(fresh))
+        if not bound:
+            raise QueryError(
+                "pattern predicate must use at least one bound variable")
+        for ep in pat.edges:
+            if ep.alias is not None:
+                raise QueryError(
+                    f"pattern predicate must not name its edges "
+                    f"(`{ep.alias}')")
+        scratch = {a: "vertex" for a in bound}
+        sub = _plan_pattern(pctx, pat, None, scratch, node)
+        cols = [(LabelExpr(a), a) for a in bound] + [(Literal(True), marker)]
+        sub = PlanNode("Project", deps=[sub], col_names=bound + [marker],
+                       args={"columns": cols, "match_row": True})
+        sub = PlanNode("Dedup", deps=[sub], col_names=bound + [marker])
+        node = PlanNode("HashLeftJoin", deps=[node, sub],
+                        col_names=list(node.col_names) + [marker],
+                        args={"keys": bound})
+        markers[pe.text] = (marker, bound)
+        hidden.append(marker)
+
+    def fn(x: Expr):
+        if x.kind == "pattern_pred":
+            marker, bound = markers[x.text]
+            found = Unary("IS_NOT_NULL", LabelExpr(marker))
+            null_check = None
+            for a in bound:
+                c = Unary("IS_NULL", LabelExpr(a))
+                null_check = c if null_check is None \
+                    else Binary("OR", null_check, c)
+            return Case([(null_check, Literal(NULL))], found, None)
+        return None
+    return node, rewrite(w, fn), hidden
 
 
 def _anon_names(pctx):

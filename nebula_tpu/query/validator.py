@@ -331,8 +331,43 @@ def _register_sentence_validators():
 
     @_svalidator(A.MatchSentence)
     def v_match(stmt, pctx):
+        # Pattern predicates `WHERE (a)-[:e]->()` are legal only in a
+        # MATCH clause's WHERE; their patterns get the same structural
+        # checks as inline patterns.  Anywhere else (WITH WHERE, RETURN
+        # columns) they are a semantic error (reference: MatchValidator
+        # rejects PatternExpression outside a filter [UNVERIFIED —
+        # empty mount, SURVEY §0]).
+        def preds_in(e):
+            return [x for x in E.walk(e) if x.kind == "pattern_pred"] \
+                if e is not None else []
+
+        def screen(e):
+            if preds_in(e):
+                raise ValidationError(
+                    "pattern predicate is only supported in a MATCH "
+                    "WHERE clause")
+
+        def screen_proj(cl):
+            for c in getattr(cl, "columns", None) or []:
+                screen(c.expr)
+            for f in getattr(cl, "order_by", None) or []:
+                screen(f.expr)
+
         for cl in getattr(stmt, "clauses", ()) or ():
-            pat = getattr(cl, "patterns", None)
+            if isinstance(cl, A.MatchClauseAst):
+                continue
+            if isinstance(cl, A.UnwindClauseAst):
+                screen(cl.expr)
+                continue
+            screen(getattr(cl, "where", None))
+            screen_proj(cl)
+        ret = getattr(stmt, "return_", None)
+        if ret is not None:
+            screen_proj(ret)
+        for cl in getattr(stmt, "clauses", ()) or ():
+            pat = list(getattr(cl, "patterns", None) or ())
+            for pe in preds_in(getattr(cl, "where", None)):
+                pat.append(pe.pattern)
             for pp in pat or ():
                 for ep in getattr(pp, "edges", ()) or ():
                     if ep.min_hop < 0:
@@ -619,6 +654,11 @@ def validate(stmt, pctx) -> None:
         edge_types = tuple(stmt.over.edges or ())
     scope = Scope(pctx, edge_types=edge_types)
     for (_where, ex) in _exprs_of(stmt):
+        if not isinstance(stmt, A.MatchSentence) and any(
+                x.kind == "pattern_pred" for x in E.walk(ex)):
+            raise ValidationError(
+                "pattern predicate is only supported in a MATCH "
+                "WHERE clause")
         try:
             deduce(ex, scope)
         except ValidationError:

@@ -281,3 +281,49 @@ def test_show_scope_spellings():
                      ("SHOW ALL QUERIES", None)]:
         s = parse(q)
         assert s.kind in ("sessions", "queries") and s.extra == extra, q
+
+
+def test_standalone_return():
+    """RETURN as a statement head (VERDICT r4 item 3): MatchSentence with
+    zero clauses; composes with UNION via the normal set-op grammar."""
+    s = parse("RETURN 1 AS x, 2 + 3 AS y")
+    assert isinstance(s, A.MatchSentence) and s.clauses == []
+    assert [c.alias for c in s.return_.columns] == ["x", "y"]
+    s = parse("RETURN 1 AS x UNION RETURN 2 AS x")
+    assert isinstance(s, A.SetOpSentence)
+    assert isinstance(s.left, A.MatchSentence) and s.left.clauses == []
+    assert isinstance(s.right, A.MatchSentence)
+    s = parse("RETURN DISTINCT 1 AS x ORDER BY x LIMIT 1")
+    assert s.return_.distinct and s.return_.limit == 1
+
+
+def test_pattern_predicate_parse():
+    """(a)-[:knows]->() in expression position is a PatternPredExpr;
+    parenthesized arithmetic backtracks to the expression read."""
+    from nebula_tpu.core.expr import PatternPredExpr, Unary
+    s = parse("MATCH (a:person) WHERE (a)-[:knows]->() RETURN id(a)")
+    w = s.clauses[0].where
+    assert isinstance(w, PatternPredExpr)
+    assert w.text == "(a)-[:knows]->()"
+    assert len(w.pattern.nodes) == 2 and len(w.pattern.edges) == 1
+    # negated + incoming + var-len + both-direction spellings
+    s = parse("MATCH (a) WHERE NOT (a)<-[:likes]-() RETURN id(a)")
+    w = s.clauses[0].where
+    assert isinstance(w, Unary) and w.op == "NOT"
+    assert isinstance(w.operand, PatternPredExpr)
+    assert w.operand.pattern.edges[0].direction == "in"
+    s = parse("MATCH (a) WHERE (a)-[:e*2..4]->(:t{p: 1}) RETURN id(a)")
+    ep = s.clauses[0].where.pattern.edges[0]
+    assert (ep.min_hop, ep.max_hop) == (2, 4)
+    assert s.clauses[0].where.text == "(a)-[:e*2..4]->(:t{p: 1})"
+    s = parse("MATCH (a) WHERE (a)--(b) RETURN id(a)")
+    assert s.clauses[0].where.pattern.edges[0].direction == "both"
+    # exists() collapses to the bare pattern predicate
+    s = parse("MATCH (a) WHERE exists((a)-[:knows]->()) RETURN id(a)")
+    assert isinstance(s.clauses[0].where, PatternPredExpr)
+    # arithmetic stays arithmetic
+    s = parse("RETURN (1)-(2) AS d")
+    e = s.return_.columns[0].expr
+    assert isinstance(e, Binary) and e.op == "-"
+    s = parse("MATCH (a) WHERE (a.person.age)-(1) > 0 RETURN id(a)")
+    assert isinstance(s.clauses[0].where, Binary)
