@@ -339,12 +339,33 @@ class DistributedStore:
                 out.append(tuple(v) if isinstance(v, list) else v)
         return out
 
+    def index_scan_geo(self, space: str, index_name: str,
+                       ranges: List[tuple],
+                       parts: Optional[List[int]] = None):
+        """Geo token-range scan fan-out; ranges are plain int pairs
+        (wire-safe as JSON lists)."""
+        pids = list(parts) if parts is not None else self.sc.all_parts(space)
+        out: List[Any] = []
+        for pid, ents in self.sc.fanout(
+                space, {p: {"index": index_name,
+                            "ranges": [list(r) for r in ranges]}
+                        for p in pids},
+                "storage.index_scan_geo"):
+            for e in ents:
+                v = from_wire(e)
+                out.append(tuple(v) if isinstance(v, list) else v)
+        return out
+
     def rebuild_index(self, space: str, index_name: str,
                       parts: Optional[List[int]] = None) -> int:
         pids = list(parts) if parts is not None else self.sc.all_parts(space)
         total = 0
+        # cat_ver: the issuer validated the index against ITS catalog —
+        # a storaged with an older cache must refresh before the rebuild
+        # or apply fails "index not found" (same contract as writes)
         for pid, n in self.sc.fanout(
-                space, {p: {"index": index_name} for p in pids},
+                space, {p: {"index": index_name,
+                            "cat_ver": self.meta.version} for p in pids},
                 "storage.rebuild_index"):
             total += n
         return total

@@ -437,11 +437,28 @@ class StorageService:
         return [to_wire(list(e) if isinstance(e, tuple) else e)
                 for e in ents]
 
+    def rpc_index_scan_geo(self, p):
+        self._leader_part(p["space"], p["part"])
+        ents = self.store.index_scan_geo(
+            p["space"], p["index"], [tuple(r) for r in p["ranges"]],
+            parts=[p["part"]])
+        return [to_wire(list(e) if isinstance(e, tuple) else e)
+                for e in ents]
+
     def rpc_rebuild_index(self, p):
         # rebuild rides the part's raft log so replicas backfill too —
-        # followers must serve identical index state after failover
+        # followers must serve identical index state after failover.
+        # Version-stamped like rpc_write: the issuer has just seen the
+        # CREATE INDEX DDL, so a storaged whose catalog cache predates
+        # it must refresh BEFORE applying or the rebuild raises "index
+        # not found" inside apply (swallowed) and the job reports
+        # FINISHED over an empty index.
+        cat_ver = p.get("cat_ver", -1)
+        if cat_ver > self.meta.version:
+            self.meta.refresh(force=True)
         part = self._leader_part(p["space"], p["part"])
-        data = wire.dumps(("rebuild_index", p["index"], p["part"]))
+        data = wire.dumps(("v", max(cat_ver, self.meta.version),
+                           ["rebuild_index", p["index"], p["part"]]))
         if part.propose(data) is None:
             raise RpcError("part_leader_changed: rebuild not committed")
         sd = self.store.space(p["space"])
@@ -477,7 +494,11 @@ class StorageService:
     def rpc_rebuild_fulltext(self, p):
         part = self._leader_part(p["space"], p["part"])
         self._ft_catalog_sync(p)
-        data = wire.dumps(("rebuild_fulltext", p["index"], p["part"]))
+        # version-stamped for the same follower-staleness reason as
+        # rpc_rebuild_index (the _ft_catalog_sync above only fixes the
+        # leader's cache)
+        data = wire.dumps(("v", self.meta.version,
+                           ["rebuild_fulltext", p["index"], p["part"]]))
         if part.propose(data) is None:
             raise RpcError("part_leader_changed: rebuild not committed")
         sd = self.store.space(p["space"])

@@ -273,6 +273,107 @@ def _on_boundary(p, g: Geography, eps_m: float = 0.5) -> bool:
     return any(_pt_seg_m(p, s, e) < eps_m for (s, e) in _segments(g))
 
 
+def _interleave31(x: int, y: int) -> int:
+    out = 0
+    for i in range(31):
+        out |= ((x >> i) & 1) << (2 * i)
+        out |= ((y >> i) & 1) << (2 * i + 1)
+    return out
+
+
+def _pad_boxes(g: Geography, pad_m: float) -> List[Tuple[float, float,
+                                                         float, float]]:
+    """(lng_lo, lng_hi, lat_lo, lat_hi) boxes covering `g`'s bbox padded
+    by pad_m meters — split in two when the pad crosses the antimeridian,
+    widened to the full longitude band when it crosses a pole or the
+    longitude pad degenerates near one (cos→0)."""
+    pts = g.points()
+    lngs = [p[0] for p in pts]
+    lats = [p[1] for p in pts]
+    dlat = pad_m / 111320.0 if pad_m else 0.0
+    lat_lo_raw, lat_hi_raw = min(lats) - dlat, max(lats) + dlat
+    lat_lo, lat_hi = max(-90.0, lat_lo_raw), min(90.0, lat_hi_raw)
+    dlng = 0.0
+    full_lng = lat_hi_raw > 90.0 or lat_lo_raw < -90.0
+    if pad_m and not full_lng:
+        max_abs_lat = min(89.999, max(abs(lat_lo), abs(lat_hi)))
+        dlng = pad_m / (111320.0 * math.cos(math.radians(max_abs_lat)))
+        if dlng >= 180.0:
+            full_lng = True
+    lng_lo_raw, lng_hi_raw = min(lngs) - dlng, max(lngs) + dlng
+    if full_lng or lng_hi_raw - lng_lo_raw >= 360.0:
+        return [(-180.0, 180.0, lat_lo, lat_hi)]
+    if lng_lo_raw < -180.0:
+        return [(-180.0, lng_hi_raw, lat_lo, lat_hi),
+                (lng_lo_raw + 360.0, 180.0, lat_lo, lat_hi)]
+    if lng_hi_raw > 180.0:
+        return [(lng_lo_raw, 180.0, lat_lo, lat_hi),
+                (-180.0, lng_hi_raw - 360.0, lat_lo, lat_hi)]
+    return [(lng_lo_raw, lng_hi_raw, lat_lo, lat_hi)]
+
+
+def covering_cells(g: Geography, pad_m: float = 0.0,
+                   max_cells: int = 64) -> List[Tuple[int, int]]:
+    """Level-aligned Morton cells covering `g`'s (padded) bounding box —
+    the S2RegionCoverer analog (reference: storage geo index cover
+    computation [UNVERIFIED — empty mount, SURVEY §0 row 15]).
+
+    Works in the same quantized lng/lat space as cell_token(): a level-L
+    cell fixes the top L bits of both 31-bit axes, so each cell is one
+    contiguous token interval [base, base + 4^(31-L)).  The level is
+    coarsened until the boxes need <= max_cells cells; the cover is a
+    bbox superset of the region, so consumers must re-check the exact
+    predicate.  Returns [(base_token, level)].
+    """
+    boxes = _pad_boxes(g, pad_m)
+    q = (1 << 31) - 1
+
+    def qbox(b):
+        lng_lo, lng_hi, lat_lo, lat_hi = b
+        return (int((lng_lo + 180.0) / 360.0 * q),
+                int((lng_hi + 180.0) / 360.0 * q),
+                int((lat_lo + 90.0) / 180.0 * q),
+                int((lat_hi + 90.0) / 180.0 * q))
+
+    qboxes = [qbox(b) for b in boxes]
+    level = 30
+    while level > 0:
+        shift = 31 - level
+        n = sum(((xh >> shift) - (xl >> shift) + 1)
+                * ((yh >> shift) - (yl >> shift) + 1)
+                for xl, xh, yl, yh in qboxes)
+        if n <= max_cells:
+            break
+        level -= 1
+    shift = 31 - level
+    cells = set()
+    for xl, xh, yl, yh in qboxes:
+        for cx in range((xl >> shift), (xh >> shift) + 1):
+            for cy in range((yl >> shift), (yh >> shift) + 1):
+                cells.add(_interleave31(cx << shift, cy << shift))
+    return sorted((base, level) for base in cells)
+
+
+def cell_width(level: int) -> int:
+    """Token-interval width of one level-`level` cell."""
+    return 1 << (2 * (31 - level))
+
+
+def covering_ranges(g: Geography, pad_m: float = 0.0,
+                    max_cells: int = 64) -> List[Tuple[int, int]]:
+    """covering_cells flattened to sorted, merged, INCLUSIVE (lo, hi)
+    token ranges — the query-side shape the geo index scans."""
+    ranges = sorted((base, base + cell_width(level) - 1)
+                    for base, level in covering_cells(g, pad_m, max_cells))
+    merged = [list(ranges[0])]
+    for lo, hi in ranges[1:]:
+        if lo <= merged[-1][1] + 1:
+            merged[-1][1] = max(merged[-1][1], hi)
+        else:
+            merged.append([lo, hi])
+    return [(lo, hi) for lo, hi in merged]
+
+
 def cell_token(g: Geography, level: int = 30) -> int:
     """64-bit Morton cell id of a point (lng/lat quantization) — the
     S2_CellIdFromPoint analog: equal points share ids and nearby points

@@ -284,13 +284,27 @@ def _index_scan(node, qctx, ectx, space):
 
 def _index_scan_indexed(node, qctx, sp, schema, filt, a):
     """LOOKUP via secondary index: prefix/range scan → entity fetch →
-    residual filter (SURVEY §2 row 15)."""
-    entities = qctx.store.index_scan(sp, a["index"], a.get("eq") or [],
-                                     a.get("range"))
+    residual filter (SURVEY §2 row 15).  geo_ranges (cell-token
+    intervals from covering_ranges) route to the geo index scan; the
+    exact ST_ predicate stays in `filt` because the cover is a bbox
+    superset of the query region."""
+    if a.get("geo_ranges"):
+        entities = qctx.store.index_scan_geo(sp, a["index"],
+                                             a["geo_ranges"])
+    else:
+        entities = qctx.store.index_scan(sp, a["index"], a.get("eq") or [],
+                                         a.get("range"))
     rows = []
     if a["is_edge"]:
         etype_id = qctx.store.catalog.get_edge(sp, schema).edge_type
+        seen_e = set()
         for (src, rank, dst) in entities:
+            # a multi-cell geo entry yields its entity once per cell
+            # when the scan crosses parts or rides the generic path
+            ek = (hashable_key(src), rank, hashable_key(dst))
+            if ek in seen_e:
+                continue
+            seen_e.add(ek)
             props = qctx.store.get_edge(sp, src, schema, dst, rank)
             if props is None:
                 continue

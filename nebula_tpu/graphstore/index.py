@@ -123,3 +123,111 @@ class IndexData:
             i = bisect.bisect_left(lst, lo_probe)
             j = bisect.bisect_left(lst, hi_probe)
             return [lst[t][2] for t in range(i, j)]
+
+
+class GeoIndexData(IndexData):
+    """Geo index over ONE geography column (reference: S2-cell-keyed geo
+    index records [UNVERIFIED — empty mount, SURVEY §0 row 15]).
+
+    A point is keyed by its level-30 Morton cell token; a LINESTRING /
+    POLYGON is keyed by EVERY cell of a capped covering of its bbox
+    (one entry per cell, possibly coarse) — single-centroid keying would
+    silently drop shapes whose centroid falls outside the query cover
+    (code-review repro).  scan_geo matches a query range two ways:
+    entries whose base token lies inside the range (equal-or-finer
+    cells), plus exact probes at each ANCESTOR base of the range's low
+    end (coarser covering cells; at most 31 probes).  Both directions
+    may over-match (shared base tokens across levels, bbox covers) —
+    callers re-check the exact ST_ predicate as a residual, so a false
+    positive costs a filter eval, never a wrong row.  NULL /
+    non-geography values are keyed by the plain normalized value — they
+    sort outside every token probe and are never produced by scan_geo."""
+
+    __slots__ = ()
+
+    def _cells_of(self, row) -> Optional[List[int]]:
+        from ..core.geo import Geography, cell_token, covering_cells
+        v = row.get(self.fields[0])
+        if isinstance(v, str):
+            # geography columns accept WKT text on write; index the
+            # same shape reads serve
+            from ..core.geo import from_wkt
+            try:
+                v = from_wkt(v)
+            except Exception:  # noqa: BLE001 — malformed stays unkeyed
+                return None
+        if not isinstance(v, Geography):
+            return None
+        if v.kind == "point":
+            return [cell_token(v)]
+        return [base for base, _lvl in covering_cells(v, max_cells=16)]
+
+    def key_of(self, row):
+        cells = self._cells_of(row)
+        if cells is None:
+            return (norm(row.get(self.fields[0])),)
+        return (norm(cells[0]),)
+
+    def add(self, part: int, row, entity: Any):
+        cells = self._cells_of(row)
+        if cells is None:
+            super().add(part, row, entity)
+            return
+        en = norm(entity)
+        with self.lock:
+            lst = self.parts[part]
+            for c in cells:
+                k = (norm(c),)
+                i = bisect.bisect_left(lst, (k, en))
+                if i < len(lst) and lst[i][0] == k and lst[i][1] == en:
+                    lst[i] = (k, en, entity)
+                else:
+                    lst.insert(i, (k, en, entity))
+
+    def remove(self, part: int, row, entity: Any):
+        cells = self._cells_of(row)
+        if cells is None:
+            super().remove(part, row, entity)
+            return
+        en = norm(entity)
+        with self.lock:
+            lst = self.parts[part]
+            for c in cells:
+                k = (norm(c),)
+                i = bisect.bisect_left(lst, (k, en))
+                if i < len(lst) and lst[i][0] == k and lst[i][1] == en:
+                    del lst[i]
+
+    def scan_geo(self, part: int, ranges: List[Tuple[int, int]]) -> List[Any]:
+        """Entities with an entry cell overlapping any INCLUSIVE
+        (lo, hi) token range (covering_ranges output), deduplicated
+        (multi-cell shapes would otherwise emit duplicate rows)."""
+        out: List[Any] = []
+        seen = set()
+
+        def emit(t):
+            _k, en, ent = t
+            if en not in seen:
+                seen.add(en)
+                out.append(ent)
+
+        with self.lock:
+            lst = self.parts[part]
+            for lo, hi in ranges:
+                i = bisect.bisect_left(lst, ((norm(lo),),))
+                j = bisect.bisect_left(lst, ((norm(hi), MAX),))
+                for t in range(i, j):
+                    emit(lst[t])
+                # coarser covering cells: every ancestor-aligned base of
+                # `lo` (zeroing the low 2s bits) may key a cell that
+                # contains this range
+                for s in range(1, 32):
+                    a = lo & ~((1 << (2 * s)) - 1)
+                    if a == lo:
+                        continue         # already covered by the bisect
+                    k = (norm(a),)
+                    i = bisect.bisect_left(lst, (k,))
+                    while i < len(lst) and lst[i][0] == k:
+                        emit(lst[i])
+                        i += 1
+        return out

@@ -342,9 +342,28 @@ class GraphStore:
     # replicas maintain identical index state; CREATE INDEX starts empty
     # (reference semantics) — rebuild_index() backfills.
 
+    def _make_index_data(self, space: str, d, num_parts: int):
+        """IndexData for a descriptor; a single-column index over a
+        GEOGRAPHY prop is automatically cell-token-keyed (GeoIndexData) —
+        the reference keys geo index records by S2 cell with no separate
+        DDL spelling (SURVEY §2 row 15)."""
+        from .index import GeoIndexData, IndexData
+        from .schema import PropType
+        cls = IndexData
+        if len(d.fields) == 1:
+            try:
+                sv = (self.catalog.get_edge(space, d.schema_name).latest
+                      if d.is_edge else
+                      self.catalog.get_tag(space, d.schema_name).latest)
+                p = sv.prop(d.fields[0])
+                if p is not None and p.ptype == PropType.GEOGRAPHY:
+                    cls = GeoIndexData
+            except SchemaError:
+                pass
+        return cls(d.name, d.fields, d.is_edge, num_parts, d.index_id)
+
     def _index_list(self, sd: SpaceData, space: str, schema: str,
                     is_edge: bool):
-        from .index import IndexData
         descs = self.catalog.indexes_for(space, schema, is_edge)
         out = []
         for d in descs:
@@ -353,8 +372,8 @@ class GraphStore:
                     idx.index_id != d.index_id:
                 # new creation (possibly after a DROP of a same-named
                 # index) — starts empty, never resurrects old entries
-                idx = sd.index_data[d.name] = IndexData(
-                    d.name, d.fields, d.is_edge, sd.num_parts, d.index_id)
+                idx = sd.index_data[d.name] = self._make_index_data(
+                    space, d, sd.num_parts)
             out.append(idx)
         return out
 
@@ -528,12 +547,11 @@ class GraphStore:
             raise StoreError(f"index `{index_name}' not found")
         if parts is None:
             self._log("rebuild_index", space, index_name)
-        from .index import IndexData
         idx = sd.index_data.get(index_name)
         if idx is None or idx.fields != d.fields or \
                 idx.index_id != d.index_id:
-            idx = sd.index_data[index_name] = IndexData(
-                d.name, d.fields, d.is_edge, sd.num_parts, d.index_id)
+            idx = sd.index_data[index_name] = self._make_index_data(
+                space, d, sd.num_parts)
         sv = (self.catalog.get_edge(space, d.schema_name).latest
               if d.is_edge else
               self.catalog.get_tag(space, d.schema_name).latest)
@@ -575,6 +593,28 @@ class GraphStore:
         out: List[Any] = []
         for pid in part_ids:
             out.extend(idx.scan(pid, eq_prefix, range_hint))
+        return out
+
+    def index_scan_geo(self, space: str, index_name: str,
+                       ranges: List[tuple],
+                       parts: Optional[List[int]] = None) -> List[Any]:
+        """Entities whose geography cell token falls in any of the
+        inclusive (lo, hi) token ranges (covering_ranges output); the
+        caller re-checks the exact ST_ predicate as a residual filter."""
+        from .index import GeoIndexData
+        sd = self.space(space)
+        idx = sd.index_data.get(index_name)
+        d = next((x for x in self.catalog.indexes(space)
+                  if x.name == index_name), None)
+        if idx is None or d is None or idx.fields != d.fields or \
+                idx.index_id != d.index_id or \
+                not isinstance(idx, GeoIndexData):
+            return []               # dropped/recreated → stale data is dead
+        part_ids = list(parts) if parts is not None \
+            else list(range(sd.num_parts))
+        out: List[Any] = []
+        for pid in part_ids:
+            out.extend(idx.scan_geo(pid, ranges))
         return out
 
     # ---- mutate ----

@@ -68,6 +68,9 @@ def est_rows(node: PlanNode, child_rows: List[float]) -> float:
         sel = _EQ_SELECTIVITY ** len(node.args.get("eq") or ())
         if node.args.get("range"):
             sel *= _RANGE_SELECTIVITY
+        if node.args.get("geo_ranges"):
+            # covering-cell scan ≈ a range binding (bbox-selective)
+            sel *= _RANGE_SELECTIVITY
         return max(_BASE_ROWS / sel, 1.0)
     if k == "Filter":
         return inp / 4.0
@@ -1402,3 +1405,42 @@ def index_seed_for_match_scan(node: PlanNode, pctx) -> List[PlanNode]:
         filt.output_var = node.output_var
         alts.append(filt)
     return alts
+
+
+@register_explore_rule
+def geo_index_seed_for_match_scan(node: PlanNode, pctx) -> List[PlanNode]:
+    """MATCH (a:T) WHERE ST_Intersects(a.T.g, <const>) ...: offer
+    Filter(IndexScan geo_ranges) over the cell-token geo index as an
+    alternative to Filter(ScanVertices) (reference: the geo variant of
+    OptimizeTagIndexScanByFilterRule [UNVERIFIED — empty mount, SURVEY
+    §0 row 15]).  The full filter stays on top — the covering ranges
+    are a bbox superset, so rows are identical."""
+    if node.kind != "Filter" or len(node.deps) != 1:
+        return []
+    scan = node.dep()
+    if scan.kind != "ScanVertices" or not scan.args.get("tag"):
+        return []
+    tag = scan.args["tag"]
+    alias = scan.args.get("as_col") or scan.col_names[0]
+    space = scan.args["space"]
+    cond = node.args.get("condition")
+    if cond is None:
+        return []
+    from .planner import _geo_index_for, _lookup_geo_cond
+    for c in split_conjuncts(cond):
+        m = _lookup_geo_cond(c, tag, False, alias=alias)
+        if m is None:
+            continue
+        d = _geo_index_for(pctx, space, tag, False, m[0])
+        if d is None:
+            continue
+        iscan = PlanNode("IndexScan", deps=[], col_names=[alias],
+                         args={"space": space, "schema": tag,
+                               "is_edge": False, "index": d.name,
+                               "geo_ranges": m[1]})
+        filt = PlanNode("Filter", deps=[iscan],
+                        col_names=list(node.col_names),
+                        args=dict(node.args))
+        filt.output_var = node.output_var
+        return [filt]
+    return []

@@ -170,3 +170,150 @@ def test_index_on_alter_added_default_column(eng):
     eng._run('UPDATE VERTEX ON q 10 SET name = "renamed"')
     assert ids(eng, 'LOOKUP ON q WHERE q.score == 5 YIELD id(vertex)') \
         == [10, 11]
+
+
+# ---- geo index (VERDICT r4 item 4: cell_token → covering-cell index) ----
+
+
+def test_covering_ranges_contains_cell_tokens():
+    """Property: every point inside a region's bbox has its cell token
+    inside the region's covering ranges (the geo index's correctness
+    contract — the cover may over-approximate, never under)."""
+    import random
+    from nebula_tpu.core.geo import (Geography, cell_token,
+                                     covering_ranges, from_wkt)
+    rnd = random.Random(7)
+    poly = from_wkt("POLYGON((-3 -2, 9 -2, 9 7, -3 7, -3 -2))")
+    rs = covering_ranges(poly)
+    assert rs == sorted(rs) and all(lo <= hi for lo, hi in rs)
+    for _ in range(500):
+        p = Geography("point", (rnd.uniform(-3, 9), rnd.uniform(-2, 7)))
+        t = cell_token(p)
+        assert any(lo <= t <= hi for lo, hi in rs), p.coords
+    # distance pad: points within r meters stay covered
+    ctr = Geography("point", (20.0, 40.0))
+    rs2 = covering_ranges(ctr, pad_m=50_000)
+    import math
+    for _ in range(300):
+        ang = rnd.uniform(0, 2 * math.pi)
+        d_deg = rnd.uniform(0, 50_000 / 111_320.0)
+        p = Geography("point", (20.0 + d_deg * math.cos(ang) /
+                                math.cos(math.radians(40.0)),
+                                40.0 + d_deg * math.sin(ang)))
+        t = cell_token(p)
+        assert any(lo <= t <= hi for lo, hi in rs2), p.coords
+
+
+def test_geo_index_lookup_and_maintenance(eng):
+    eng._run('CREATE TAG place(name string, loc geography)')
+    eng._run('CREATE TAG INDEX ploc ON place(loc)')
+    eng._run('INSERT VERTEX place(name, loc) VALUES '
+             '20:("a", ST_Point(1.0, 1.0)), 21:("b", ST_Point(5.0, 5.0)), '
+             '22:("c", ST_Point(50.0, 50.0)), 23:("n", NULL)')
+    q = ('LOOKUP ON place WHERE ST_Intersects(place.loc, '
+         'ST_GeogFromText("POLYGON((0 0, 10 0, 10 10, 0 10, 0 0))")) '
+         'YIELD id(vertex)')
+    assert ids(eng, q) == [20, 21]
+    # update moves the entry between cells
+    eng._run('UPDATE VERTEX ON place 22 SET loc = ST_Point(2.0, 2.0)')
+    assert ids(eng, q) == [20, 21, 22]
+    # delete removes it
+    eng._run('DELETE VERTEX 21')
+    assert ids(eng, q) == [20, 22]
+    # distance predicates (both spellings) ride the same index
+    assert ids(eng, 'LOOKUP ON place WHERE ST_Distance(place.loc, '
+                    'ST_Point(1.0, 1.0)) < 1000 YIELD id(vertex)') == [20]
+    assert ids(eng, 'LOOKUP ON place WHERE ST_DWithin(place.loc, '
+                    'ST_Point(2.0, 2.0), 1000) YIELD id(vertex)') == [22]
+
+
+def test_geo_index_is_cell_keyed(eng):
+    """The index object is the GeoIndexData subclass (cell-token keys),
+    and REBUILD backfills it for rows written before CREATE INDEX."""
+    from nebula_tpu.graphstore.index import GeoIndexData
+    eng._run('CREATE TAG spot(loc geography)')
+    eng._run('INSERT VERTEX spot(loc) VALUES 30:(ST_Point(2.0, 2.0)), '
+             '31:(ST_Point(80.0, 10.0))')
+    eng._run('CREATE TAG INDEX sloc ON spot(loc)')
+    eng._run('REBUILD TAG INDEX sloc')
+    st = eng.qctx.store
+    idx = st.space("ix").index_data["sloc"]
+    assert isinstance(idx, GeoIndexData)
+    assert idx.count() == 2
+    assert ids(eng, 'LOOKUP ON spot WHERE ST_DWithin(spot.loc, '
+                    'ST_Point(2.0, 2.0), 5000) YIELD id(vertex)') == [30]
+
+
+def test_geo_plan_uses_covering_ranges(eng):
+    eng._run('CREATE TAG park(loc geography)')
+    eng._run('CREATE TAG INDEX parkloc ON park(loc)')
+    r = eng._run('EXPLAIN LOOKUP ON park WHERE ST_Intersects(park.loc, '
+                 'ST_Point(1.0, 1.0)) YIELD id(vertex)')
+    txt = "\n".join(str(c) for row in r.data.rows for c in row)
+    assert "geo_ranges" in txt and "IndexScan" in txt
+    # MATCH seeds from the geo index through the exploration rule
+    r = eng._run('EXPLAIN MATCH (a:park) WHERE ST_DWithin(a.park.loc, '
+                 'ST_Point(1.0, 1.0), 500) RETURN id(a)')
+    txt = "\n".join(str(c) for row in r.data.rows for c in row)
+    assert "geo_ranges" in txt
+
+
+def test_geo_index_non_point_shapes(eng):
+    """LINESTRING/POLYGON values are keyed by EVERY covering cell —
+    single-centroid keying silently dropped shapes whose centroid falls
+    outside the query cover (code-review repro: creating the index
+    changed query results)."""
+    eng._run('CREATE TAG road(loc geography)')
+    eng._run('CREATE TAG INDEX rloc ON road(loc)')
+    eng._run('INSERT VERTEX road(loc) VALUES '
+             '40:(ST_GeogFromText("LINESTRING(0 0, 100 0)")), '
+             '41:(ST_Point(2.0, 2.0))')
+    # centroid of 40 is (50, 0) — outside this region; the line itself
+    # crosses it
+    q = ('LOOKUP ON road WHERE ST_Intersects(road.loc, '
+         'ST_GeogFromText("POLYGON((-1 -1, 5 -1, 5 5, -1 5, -1 -1))")) '
+         'YIELD id(vertex)')
+    assert ids(eng, q) == [40, 41]
+    # no duplicate rows from the multi-cell entries
+    assert len(rows(eng, q)) == 2
+    # maintenance removes every cell entry
+    eng._run('DELETE VERTEX 40')
+    assert ids(eng, q) == [41]
+
+
+def test_covering_ranges_antimeridian_and_pole():
+    """Distance pads that cross the antimeridian or degenerate near a
+    pole must stay supersets of the true disc (code-review repro)."""
+    from nebula_tpu.core.geo import Geography, cell_token, covering_ranges
+
+    def covered(rs, lng, lat):
+        t = cell_token(Geography("point", (lng, lat)))
+        return any(lo <= t <= hi for lo, hi in rs)
+
+    rs = covering_ranges(Geography("point", (179.9, 0.0)), pad_m=50_000)
+    assert covered(rs, -179.9, 0.0)        # 22 km across the seam
+    rs = covering_ranges(Geography("point", (-179.95, 10.0)), pad_m=30_000)
+    assert covered(rs, 179.9, 10.0)
+    rs = covering_ranges(Geography("point", (0.0, 89.5)), pad_m=50_000)
+    assert covered(rs, 30.0, 89.5)         # 29 km around the pole cap
+    rs = covering_ranges(Geography("point", (0.0, 89.98)), pad_m=50_000)
+    assert covered(rs, 180.0, 89.99)       # pad crosses the pole
+
+
+def test_lookup_prefers_eq_index_over_geo(eng):
+    """An equality binding on a B-tree index is more selective than the
+    bbox cover; the geo branch must not preempt it (code-review)."""
+    eng._run('CREATE TAG shop(city string, loc geography)')
+    eng._run('CREATE TAG INDEX shopcity ON shop(city)')
+    eng._run('CREATE TAG INDEX shoploc ON shop(loc)')
+    eng._run('INSERT VERTEX shop(city, loc) VALUES '
+             '50:("sf", ST_Point(1.0, 1.0)), 51:("nyc", ST_Point(1.0, 1.0))')
+    r = eng._run('EXPLAIN LOOKUP ON shop WHERE shop.city == "sf" AND '
+                 'ST_Intersects(shop.loc, ST_Point(1.0, 1.0)) '
+                 'YIELD id(vertex)')
+    txt = "\n".join(str(c) for row in r.data.rows for c in row)
+    assert "shopcity" in txt and "geo_ranges" not in txt
+    # and the rows are still exact
+    assert ids(eng, 'LOOKUP ON shop WHERE shop.city == "sf" AND '
+                    'ST_Intersects(shop.loc, ST_Point(1.0, 1.0)) '
+                    'YIELD id(vertex)') == [50]
