@@ -47,15 +47,68 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
 
 
 def _send_frame(sock: socket.socket, obj: Any):
-    data = json.dumps(obj, separators=(",", ":")).encode()
-    sock.sendall(_LEN.pack(len(data)) + data)
+    """One frame: 4-byte length + payload.
+
+    Payload is plain JSON, or — when the object carries raw byte
+    buffers (columnar result columns, SURVEY §2 row 25) — the binary
+    form: NUL + u32 blob-count + u32 blob-lengths + u32 json-length +
+    json (buffers replaced by {"@t":"blobref","bi":i}) + blob bytes.
+    JSON text can never start with NUL, so receivers distinguish the
+    two without version negotiation."""
+    blobs: list = []
+
+    def default(o):
+        if isinstance(o, (bytes, bytearray, memoryview)):
+            blobs.append(o if isinstance(o, bytes) else bytes(o))
+            return {"@t": "blobref", "bi": len(blobs) - 1}
+        raise TypeError(f"not JSON-serializable: {type(o).__name__}")
+
+    data = json.dumps(obj, separators=(",", ":"), default=default).encode()
+    if not blobs:
+        sock.sendall(_LEN.pack(len(data)) + data)
+        return
+    header = b"\x00" + _LEN.pack(len(blobs)) + b"".join(
+        _LEN.pack(len(b)) for b in blobs) + _LEN.pack(len(data))
+    total = len(header) + len(data) + sum(len(b) for b in blobs)
+    # piecewise sendall: no 100MB+ join copy for big columnar results
+    sock.sendall(_LEN.pack(total) + header + data)
+    for b in blobs:
+        sock.sendall(b)
+
+
+def _graft_blobs(j: Any, blobs: list) -> Any:
+    """Replace {"@t":"blobref","bi":i} placeholders with the out-of-band
+    buffers.  In blob mode the JSON part is small (bulk data IS the
+    blobs), so the walk is cheap."""
+    if isinstance(j, dict):
+        if j.get("@t") == "blobref":
+            return blobs[j["bi"]]
+        return {k: _graft_blobs(v, blobs) for k, v in j.items()}
+    if isinstance(j, list):
+        return [_graft_blobs(x, blobs) for x in j]
+    return j
 
 
 def _recv_frame(sock: socket.socket) -> Any:
     (n,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
     if n > MAX_FRAME:
         raise RpcConnError(f"frame too large: {n}")
-    return json.loads(_recv_exact(sock, n))
+    payload = _recv_exact(sock, n)
+    if not payload or payload[0] != 0:
+        return json.loads(payload)
+    mv = memoryview(payload)
+    off = 1
+    (nb,) = _LEN.unpack(mv[off:off + 4]); off += 4
+    lens = []
+    for _ in range(nb):
+        (ln,) = _LEN.unpack(mv[off:off + 4]); off += 4
+        lens.append(ln)
+    (jn,) = _LEN.unpack(mv[off:off + 4]); off += 4
+    j = json.loads(bytes(mv[off:off + jn])); off += jn
+    blobs = []
+    for ln in lens:
+        blobs.append(mv[off:off + ln]); off += ln   # zero-copy views
+    return _graft_blobs(j, blobs)
 
 
 class RpcServer:

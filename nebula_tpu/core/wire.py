@@ -15,8 +15,9 @@ from __future__ import annotations
 import json
 from typing import Any
 
-from .value import (DataSet, Date, DateTime, Duration, Edge, EmptyValue,
-                    NullKind, NullValue, Path, Step, Tag, Time, Vertex)
+from .value import (ColumnarDataSet, DataSet, Date, DateTime, Duration,
+                    Edge, EmptyValue, NullKind, NullValue, Path, Step, Tag,
+                    Time, Vertex)
 
 
 def to_wire(v: Any) -> Any:
@@ -54,6 +55,26 @@ def to_wire(v: Any) -> Any:
     if isinstance(v, Path):
         return {"@t": "path", "src": to_wire(v.src),
                 "steps": [to_wire(s) for s in v.steps]}
+    if isinstance(v, ColumnarDataSet) and v._cols is not None:
+        # device-plane results stay columnar THROUGH the wire (SURVEY §2
+        # row 25 / VERDICT r4 item 2): numeric columns ship as RAW
+        # buffers — the RPC layer hoists the bytes into out-of-band
+        # binary frames (zero copy into JSON), file/raft serialization
+        # falls back to base64 — and the client decodes straight back
+        # into numpy with no per-row object cost; object columns
+        # (strings, vertices) use per-value encoding.  Materialized ones
+        # (something already touched .rows) ship as a plain dataset.
+        import numpy as np
+        data = []
+        for c in v._cols:
+            c = np.asarray(c)
+            if c.dtype.kind in "biuf":
+                data.append({"dt": c.dtype.str,
+                             "b": np.ascontiguousarray(c).tobytes()})
+            else:
+                data.append({"v": [to_wire(x) for x in c.tolist()]})
+        return {"@t": "coldataset", "cols": list(v.column_names),
+                "data": data}
     if isinstance(v, DataSet):
         return {"@t": "dataset", "cols": list(v.column_names),
                 "rows": [[to_wire(c) for c in r] for r in v.rows]}
@@ -114,6 +135,22 @@ def from_wire(j: Any) -> Any:
     if t == "dataset":
         return DataSet(list(j["cols"]),
                        [[from_wire(c) for c in r] for r in j["rows"]])
+    if t == "coldataset":
+        import numpy as np
+        arrs = []
+        for cj in j["data"]:
+            b = cj.get("b")
+            if isinstance(b, dict):          # base64 fallback (files)
+                b = from_wire(b)
+            if b is not None:
+                arrs.append(np.frombuffer(b, dtype=np.dtype(cj["dt"])))
+            else:
+                arrs.append(np.array([from_wire(x) for x in cj["v"]],
+                                     dtype=object))
+        return ColumnarDataSet(list(j["cols"]), arrs)
+    if t == "b64":
+        import base64
+        return base64.b64decode(j["v"])
     if t == "list":
         return [from_wire(x) for x in j["v"]]
     if t == "set":
@@ -131,9 +168,19 @@ def from_wire(j: Any) -> Any:
     raise TypeError(f"unknown wire tag {t!r}")
 
 
+def b64_default(o):
+    """json.dumps default for wire objects: raw bytes (columnar buffers)
+    degrade to tagged base64 when no binary framing is available."""
+    if isinstance(o, (bytes, bytearray, memoryview)):
+        import base64
+        return {"@t": "b64", "v": base64.b64encode(bytes(o)).decode()}
+    raise TypeError(f"not JSON-serializable: {type(o).__name__}")
+
+
 def dumps(v: Any) -> bytes:
     """Wire-encode + JSON-serialize (raft entries, snapshots, files)."""
-    return json.dumps(to_wire(v), separators=(",", ":")).encode()
+    return json.dumps(to_wire(v), separators=(",", ":"),
+                      default=b64_default).encode()
 
 
 def loads(data: bytes) -> Any:

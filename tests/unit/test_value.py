@@ -130,3 +130,48 @@ def test_dataset():
     assert ds.column("b") == [2, 4]
     assert len(ds) == 2
     assert type_name(ds) == "dataset"
+
+
+def test_columnar_wire_roundtrip():
+    """Device-plane results ship columnar through the wire (SURVEY §2
+    row 25 / VERDICT r4 item 2): numeric columns as raw buffers hoisted
+    into binary RPC frames, base64 when serialized to a file/raft entry,
+    object columns per-value; materialized sets fall back to row form."""
+    import numpy as np
+
+    from nebula_tpu.core import wire
+    from nebula_tpu.core.value import ColumnarDataSet
+
+    d = np.arange(1000, dtype=np.int64) * 7
+    w = np.linspace(0, 1, 1000)
+    s = np.array([f"s{i}" for i in range(1000)], dtype=object)
+    ds = ColumnarDataSet(["d", "w", "s"], [d, w, s])
+    # file/raft serialization: base64 fallback
+    back = wire.loads(wire.dumps(ds))
+    assert isinstance(back, ColumnarDataSet)
+    assert np.array_equal(np.asarray(back._cols[0]), d)
+    assert np.allclose(np.asarray(back._cols[1]), w)
+    assert list(back._cols[2]) == list(s)
+    # rpc framing: raw buffers ride out-of-band binary frames
+    from nebula_tpu.cluster.rpc import RpcClient, RpcServer
+    srv = RpcServer()
+    srv.register("q", lambda p: {"data": wire.to_wire(
+        ColumnarDataSet(["d", "w"], [d, w])), "note": "x"})
+    srv.start()
+    try:
+        cl = RpcClient(srv.host, srv.port)
+        r = cl.call("q")
+        assert r["note"] == "x"          # plain JSON fields intact
+        got = wire.from_wire(r["data"])
+        assert isinstance(got, ColumnarDataSet)
+        assert np.array_equal(np.asarray(got._cols[0]), d)
+        assert np.allclose(np.asarray(got._cols[1]), w)
+        # non-blob calls still use the plain JSON frame
+        srv.register("plain", lambda p: {"v": [1, 2, 3]})
+        assert cl.call("plain") == {"v": [1, 2, 3]}
+    finally:
+        srv.stop()
+    # materialized → plain dataset tag (rows already exist)
+    ds2 = ColumnarDataSet(["v"], [np.arange(3)])
+    _ = ds2.rows
+    assert wire.to_wire(ds2)["@t"] == "dataset"
