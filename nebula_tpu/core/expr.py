@@ -323,6 +323,10 @@ class LabelTagProp(Expr):
         v = ctx.get_var(self.var)
         if isinstance(v, Vertex):
             return v.prop(self.tag, self.prop)
+        if is_null(v):
+            # property access on a NULL variable (OPTIONAL MATCH miss)
+            # is NULL, not a type error (openCypher)
+            return NULL
         return NULL_BAD_TYPE
 
 

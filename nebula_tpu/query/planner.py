@@ -1098,6 +1098,42 @@ def _plan_match_clause(pctx, mc: A.MatchClauseAst, current: Optional[PlanNode],
         node = PlanNode("Filter", deps=[node],
                         col_names=list(node.col_names),
                         args={"condition": cond, "match_row": True})
+    where = mc.where
+    if where is not None and mc.optional and current is not None:
+        # OPTIONAL MATCH ... WHERE filters DURING matching (openCypher):
+        # a row failing the predicate is a non-match that null-extends,
+        # not a dropped output row — so conjuncts whose references
+        # (including any pattern predicate's node aliases) live entirely
+        # in the pattern branch filter it BEFORE the left join.
+        # Conjuncts reaching outer aliases fall through to the normal
+        # above-join path, where their pattern predicates resolve
+        # against the JOINED columns (legacy drop placement — they
+        # cannot be evaluated inside the branch).
+        w = _rewrite_match_expr(where, aliases)
+        right_cols = set(node.col_names)
+        pre, post = [], []
+        for c in split_conjuncts(w):
+            refs = {x.name for x in walk(c) if isinstance(x, LabelExpr)} \
+                | {x.var for x in walk(c) if isinstance(x, LabelTagProp)}
+            for x in walk(c):
+                if x.kind == "pattern_pred":
+                    refs |= {np_.alias for np_ in x.pattern.nodes
+                             if np_.alias is not None}
+            (pre if refs <= right_cols else post).append(c)
+        if pre:
+            wpre = join_conjuncts(pre)
+            node, wpre, hidden_o = _apply_pattern_preds(
+                pctx, node, wpre, aliases)
+            node = PlanNode("Filter", deps=[node],
+                            col_names=list(node.col_names),
+                            args={"condition": wpre, "match_row": True})
+            if hidden_o:
+                keep = [c for c in node.col_names if c not in hidden_o]
+                node = PlanNode("Project", deps=[node], col_names=keep,
+                                args={"columns": [(LabelExpr(c), c)
+                                                  for c in keep],
+                                      "match_row": True})
+        where = join_conjuncts(post) if post else None
     if current is not None:
         shared = [c for c in current.col_names if c in node.col_names]
         join_kind = "HashLeftJoin" if mc.optional else "HashInnerJoin"
@@ -1106,13 +1142,21 @@ def _plan_match_clause(pctx, mc: A.MatchClauseAst, current: Optional[PlanNode],
                             col_names=current.col_names
                             + [c for c in node.col_names if c not in current.col_names],
                             args={"keys": shared})
+        elif mc.optional:
+            # no shared aliases: openCypher semantics are a cartesian
+            # product, degrading to one all-NULL row for the pattern's
+            # columns when it matched nothing — exactly a hash left
+            # join on the EMPTY key (every row shares the () key)
+            node = PlanNode("HashLeftJoin", deps=[current, node],
+                            col_names=current.col_names
+                            + [c for c in node.col_names
+                               if c not in current.col_names],
+                            args={"keys": []})
         else:
-            if mc.optional:
-                raise QueryError("OPTIONAL MATCH without shared aliases unsupported")
             node = PlanNode("CrossJoin", deps=[current, node],
                             col_names=current.col_names + node.col_names)
-    if mc.where is not None:
-        w = _rewrite_match_expr(mc.where, aliases)
+    if where is not None:
+        w = _rewrite_match_expr(where, aliases)
         node, w, hidden = _apply_pattern_preds(pctx, node, w, aliases)
         node = PlanNode("Filter", deps=[node], col_names=list(node.col_names),
                         args={"condition": w, "match_row": True})

@@ -94,18 +94,49 @@ Feature: MATCH paths and pattern edge cases
       | "d" | NULL |
 
   Scenario: OPTIONAL MATCH with a WHERE over the anchor keeps Argument linkage
-    # regression (r4): pushing the anchor filter below the left join must
-    # not orphan the optional side's Argument.from_var reference
+    # r4 regression guard (Argument.from_var linkage) updated for r5:
+    # OPTIONAL MATCH ... WHERE filters DURING matching (openCypher), so
+    # an anchor failing the predicate null-extends instead of dropping —
+    # Dan (19) keeps his row with d = NULL
     When executing query:
       """
       MATCH (a:person) OPTIONAL MATCH (a)-[:knows]->(b) WHERE a.person.age > 24 RETURN id(a) AS s, id(b) AS d
       """
     Then the result should be, in any order:
-      | s   | d   |
-      | "a" | "b" |
-      | "b" | "c" |
-      | "c" | "a" |
-      | "c" | "d" |
+      | s   | d    |
+      | "a" | "b"  |
+      | "b" | "c"  |
+      | "c" | "a"  |
+      | "c" | "d"  |
+      | "d" | NULL |
+
+  Scenario: OPTIONAL MATCH WHERE null-extends on a pattern-side miss
+    When executing query:
+      """
+      MATCH (a:person) WHERE id(a) == "a" OPTIONAL MATCH (a)-[:knows]->(b) WHERE b.person.age > 99 RETURN id(a) AS s, id(b) AS d
+      """
+    Then the result should be, in any order:
+      | s   | d    |
+      | "a" | NULL |
+
+  Scenario: disjoint OPTIONAL MATCH is a cartesian product
+    When executing query:
+      """
+      MATCH (a:person) WHERE id(a) == "a" OPTIONAL MATCH (c:city) RETURN id(a) AS s, c.city.pop AS p
+      """
+    Then the result should be, in any order:
+      | s   | p   |
+      | "a" | 100 |
+      | "a" | 200 |
+
+  Scenario: disjoint OPTIONAL MATCH null-extends when empty
+    When executing query:
+      """
+      MATCH (a:person) WHERE id(a) == "a" OPTIONAL MATCH (c:city) WHERE c.city.pop > 999 RETURN id(a) AS s, c.city.pop AS p
+      """
+    Then the result should be, in any order:
+      | s   | p    |
+      | "a" | NULL |
 
   Scenario: multiple labels on scan
     When executing query:
