@@ -37,7 +37,7 @@ while true; do
       if [ -e "$FLAG" ]; then
         rm -f "$FLAG"
         echo "$TS AUTO-LAUNCH full-scale bench.py" >> "$LOG"
-        (cd /root/repo && nohup python bench.py > bench_r4_tpu_auto.log 2>&1 &)
+        (cd /root/repo && nohup python bench.py > bench_r5_tpu_auto.log 2>&1 &)
         sleep 120   # let the bench take the chip lock before re-probing
       fi
     else
