@@ -579,6 +579,64 @@ def _traverse(node, qctx, ectx, space):
     ef_pushed = edge_filter is not None and pushable(edge_filter, etypes)
     push_filter = edge_filter if ef_pushed else None
 
+    if not var_len:
+        # single-hop fast path: ONE storage call over the distinct
+        # sources instead of one per input row — multi-clause MATCH
+        # repeats sources heavily (IC5's membership clause spent 100 ms
+        # in per-row get_neighbors calls; batched it is one pass)
+        per_src: Dict[Any, List] = {}
+        order: List[Any] = []
+        for r in ds.rows:
+            sv = r[ci]
+            svid = sv.vid if isinstance(sv, Vertex) else sv
+            if is_null(svid):
+                continue
+            k = hashable_key(svid)
+            if k not in per_src:
+                per_src[k] = []
+                order.append(svid)
+        for (s, et, rank, other, props, sd) in store.get_neighbors(
+                sp, order, etypes, direction, edge_filter=push_filter):
+            e = _make_edge(s, other, et, rank, props, sd, etype_ids[et])
+            per_src[hashable_key(s)].append((e, other))
+            # staging holds real Edge objects — charge DURING the build
+            # so a runaway frontier is killed before it allocates, same
+            # invariant as the DFS path below (SURVEY §2 row 5)
+            pending += 200
+            if tracker is not None and pending > (1 << 20):
+                tracker.charge(pending)
+                pending = 0
+        eval_filter = edge_filter is not None and not ef_pushed
+        for r in ds.rows:
+            sv = r[ci]
+            svid = sv.vid if isinstance(sv, Vertex) else sv
+            if is_null(svid):
+                continue
+            edges = per_src.get(hashable_key(svid), ())
+            if not edges:
+                continue
+            if eval_filter:
+                # one context per ROW; only the edge slot mutates per
+                # edge (a fresh RowContext + row_dict per edge dominated
+                # the IC5 membership clause)
+                extra = {filter_alias: None, "__edge__": None}
+                rc = RowContext(qctx, sp, row_dict(ds, r),
+                                extra_vars=extra)
+            for (e, other) in edges:
+                if eval_filter:
+                    extra[filter_alias] = e
+                    extra["__edge__"] = e
+                    if to_bool3(edge_filter.eval(rc)) is not True:
+                        continue
+                rows.append(list(r) + [e, Vertex(other)])
+                pending += 224
+                if tracker is not None and pending > (1 << 20):
+                    tracker.charge(pending)
+                    pending = 0
+        if tracker is not None and pending:
+            tracker.charge(pending)
+        return DataSet(out_cols, rows)
+
     for r in ds.rows:
         sv = r[ci]
         svid = sv.vid if isinstance(sv, Vertex) else sv

@@ -992,7 +992,18 @@ def _plan_match(pctx, s: A.MatchSentence) -> PlanNode:
                                        clause.distinct, clause.where,
                                        clause.order_by, clause.skip,
                                        clause.limit, aliases)
-            aliases = {c: "value" for c in current.col_names}
+            # a bare alias carried through WITH keeps its kind: a later
+            # clause can then Argument-seed a pattern from a projected
+            # vertex instead of scanning every vertex and joining
+            # (IC5-shaped multi-clause MATCH was scan-bound without this)
+            carried = {}
+            for c in wcols:
+                if isinstance(c.expr, LabelExpr):
+                    k = aliases.get(c.expr.name)
+                    if k is not None:
+                        carried[_col_name(c)] = k
+            aliases = {c: carried.get(c, "value")
+                       for c in current.col_names}
         else:
             raise QueryError(f"unsupported MATCH clause {type(clause).__name__}")
 

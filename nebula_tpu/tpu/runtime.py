@@ -324,8 +324,11 @@ class TpuRuntime:
         # speculative single-phase result fetch (one device round trip
         # instead of two for repeat query shapes); in-memory only
         self._kmax: Dict[Tuple, int] = {}
-        # (seed program key, pad bucket) pairs already compiled — the
-        # warm call runs outside put_s so the metric stays transfer-only
+        # seed-bitmap builder programs (bounded separately from _fns:
+        # space-keyed pruning does not reach these target/vmax keys) and
+        # the (key, pad bucket) pairs already compiled — the warm call
+        # runs outside put_s so the metric stays transfer-only
+        self._seed_fns: Dict[Tuple, Any] = {}
         self._seed_warm: set = set()
         # program → last converged (0, EB): repeat queries start AT the
         # converged bucket instead of re-climbing the escalation ladder
@@ -503,7 +506,7 @@ class TpuRuntime:
         if d:
             pad[:len(d)] = d
         key = ("seedfr", target, P, vmax)
-        fn = self._fns.get(key)
+        fn = self._seed_fns.get(key)
         if fn is None:
             if not isinstance(target, jax.sharding.Sharding):
                 sh = jax.sharding.SingleDeviceSharding(target)
@@ -517,7 +520,15 @@ class TpuRuntime:
                 fr = jnp.zeros((P, vmax), bool)
                 return fr.at[rows, cols].max(valid)
 
-            fn = self._fns[key] = jax.jit(build, out_shardings=sh)
+            fn = self._seed_fns[key] = jax.jit(build, out_shardings=sh)
+            # bounded: the key embeds the sharding target and snapshot
+            # vmax, so a long-lived server re-pinning growing snapshots
+            # must not accumulate executables for the process lifetime
+            while len(self._seed_fns) > 32:
+                old = next(iter(self._seed_fns))
+                self._seed_fns.pop(old)
+                self._seed_warm = {w for w in self._seed_warm
+                                   if w[0] != old}
         wk = (key, cap)
         if wk not in self._seed_warm:
             jax.block_until_ready(fn(pad))   # compile outside the timer
