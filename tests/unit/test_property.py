@@ -178,3 +178,61 @@ def test_var_len_match_device_parity_on_random_graphs(seed, m_off, span):
         assert rs.error is None, rs.error
         out.append(rs.data.rows)
     assert out[0] == out[1], (m, n, out)
+
+
+# -- pattern predicates: host/device parity + brute-force oracle ------------
+
+@settings(max_examples=8, deadline=None,
+          suppress_health_check=[HealthCheck.too_slow])
+@given(st.integers(0, 2**31 - 1), st.integers(1, 2),
+       st.booleans(), st.booleans())
+def test_pattern_predicate_matches_bruteforce(seed, plen, negate, incoming):
+    """WHERE (a)-[:knows*1..k]->() (optionally negated / incoming) on a
+    random graph agrees with a brute-force adjacency oracle, and the
+    host and device planes agree with each other (r5 feature)."""
+    from test_tpu import random_store
+    from nebula_tpu.exec.engine import QueryEngine
+
+    st_ = random_store(seed % 1000, n=50, avg_deg=3)
+    arrow = "<-[:knows*1..%d]-" % plen if incoming \
+        else "-[:knows*1..%d]->" % plen
+    pred = f"(a){arrow}()"
+    if negate:
+        pred = f"NOT {pred}"
+    q = f"MATCH (a:person) WHERE {pred} RETURN id(a) AS v"
+
+    # brute-force oracle over the raw adjacency
+    sd = st_.space("g")
+    adj = {}
+    for p in sd.parts:
+        for src, per in p.out_edges.items():
+            for (rank, dst) in per.get("knows", {}):
+                adj.setdefault(src, set()).add(dst)
+    radj = {}
+    for s_, ds_ in adj.items():
+        for d_ in ds_:
+            radj.setdefault(d_, set()).add(s_)
+    step = radj if incoming else adj
+    all_persons = {vid for p in sd.parts for vid in p.vertices}
+    reach = set()
+    for v in all_persons:
+        frontier = {v}
+        for _ in range(plen):
+            frontier = set().union(*(step.get(x, set())
+                                     for x in frontier)) if frontier \
+                else set()
+            if frontier:
+                reach.add(v)
+                break
+    want = sorted(all_persons - reach) if negate else sorted(reach)
+
+    outs = []
+    for rt in (None, _shared_rt()):
+        eng = QueryEngine(st_, tpu_runtime=rt)
+        ss = eng.new_session()
+        eng.execute(ss, "USE g")
+        r = eng.execute(ss, q)
+        assert r.error is None, (q, r.error)
+        outs.append(sorted(x[0] for x in r.data.rows))
+    assert outs[0] == want, f"host diverges from oracle for {q}"
+    assert outs[1] == want, f"device diverges from oracle for {q}"
