@@ -870,7 +870,16 @@ def _aggregate(node, qctx, ectx, space):
 
     groups: Dict[Tuple, Dict[str, Any]] = {}
     order: List[Tuple] = []
-    for r in ds.rows:
+    src_rows = ds.rows
+    if not ds.column_names and not src_rows:
+        # constant aggregate with no input (standalone `RETURN max(5)`,
+        # incl. mixed `RETURN 1 AS a, count(*) AS c` where the constant
+        # becomes a derived group key): one implicit row, same contract
+        # as the Project executor's constant-YIELD case — 0 rows would
+        # report the empty-input aggregate identities (NULL/0/[])
+        # instead of folding the value
+        src_rows = [[]]
+    for r in src_rows:
         rc = RowContext(qctx, space, row_dict(ds, r))
         key_vals = [k.eval(rc) for k in group_keys]
         key = tuple(hashable_key(v) for v in key_vals)
@@ -888,7 +897,7 @@ def _aggregate(node, qctx, ectx, space):
                 g["agg_inputs"][i].append([e.eval(rc)])
 
     rows = []
-    if not ds.rows and not group_keys:
+    if not groups and not group_keys:
         # aggregates over empty input: one row (COUNT→0, SUM→0, others NULL)
         out = []
         for e, _ in cols:

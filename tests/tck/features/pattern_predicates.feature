@@ -35,6 +35,33 @@ Feature: Pattern predicates and standalone RETURN
       | n |
       | 3 |
 
+  Scenario: standalone RETURN folds constant aggregates over one row
+    When executing query:
+      """
+      RETURN count(*) AS c, max(5) AS m, sum(2) AS t, collect(7) AS l
+      """
+    Then the result should be, in order:
+      | c | m | t | l   |
+      | 1 | 5 | 2 | [7] |
+
+  Scenario: constant column mixed with an aggregate still folds one row
+    When executing query:
+      """
+      RETURN 1 AS a, count(*) AS c
+      """
+    Then the result should be, in order:
+      | a | c |
+      | 1 | 1 |
+
+  Scenario: aggregates over an empty MATCH keep their identities
+    When executing query:
+      """
+      MATCH (a:person) WHERE id(a) == "zzz" RETURN count(*) AS c, max(id(a)) AS m
+      """
+    Then the result should be, in order:
+      | c | m    |
+      | 0 | NULL |
+
   Scenario: RETURN UNION RETURN
     When executing query:
       """
