@@ -190,7 +190,7 @@ class Parser:
             "RENAME": self.p_rename_zone, "DIVIDE": self.p_divide_zone,
             "BALANCE": self.p_balance,
             "DOWNLOAD": self.p_download, "INGEST": self.p_ingest,
-            "RETURN": self.p_match,
+            "RETURN": self.p_match, "WITH": self.p_match,
         }.get(kw)
         if fn is None:
             raise ParseError(f"unsupported statement `{kw}' at pos {t.pos}")
@@ -1146,7 +1146,7 @@ class Parser:
         if self.accept_kw("RETURN"):
             ret = self.p_return_clause()
             return A.MatchSentence(clauses, ret)
-        raise ParseError("MATCH requires RETURN")
+        raise ParseError("query must end with RETURN")
 
     def p_match_clause(self, optional: bool) -> A.MatchClauseAst:
         pats = [self.p_path_pattern()]

@@ -973,6 +973,15 @@ def _plan_match(pctx, s: A.MatchSentence) -> PlanNode:
 
     for clause in s.clauses:
         if isinstance(clause, A.MatchClauseAst):
+            if clause.optional and current is None:
+                # leading OPTIONAL MATCH: one implicit input row, so a
+                # miss null-extends to a single all-NULL row instead of
+                # an empty result (openCypher).  A zero-column one-row
+                # Project is the unit for the empty-key left join.
+                current = PlanNode("Project", deps=[PlanNode("Start")],
+                                  col_names=[],
+                                  args={"columns": [],
+                                        "match_row": True})
             current = _plan_match_clause(pctx, clause, current, aliases)
         elif isinstance(clause, A.UnwindClauseAst):
             e = _rewrite_match_expr(clause.expr, aliases)

@@ -62,6 +62,44 @@ Feature: Pattern predicates and standalone RETURN
       | c | m    |
       | 0 | NULL |
 
+  Scenario: leading OPTIONAL MATCH null-extends to one row on a miss
+    When executing query:
+      """
+      OPTIONAL MATCH (a:person) WHERE id(a) == "zzz" RETURN id(a) AS v, count(a) AS c
+      """
+    Then the result should be, in order:
+      | v    | c |
+      | NULL | 0 |
+
+  Scenario: leading OPTIONAL MATCH behaves as MATCH when it matches
+    When executing query:
+      """
+      OPTIONAL MATCH (a:person) WHERE id(a) == "a" RETURN a.person.name AS n
+      """
+    Then the result should be, in order:
+      | n     |
+      | "Ann" |
+
+  Scenario: WITH as a statement head
+    When executing query:
+      """
+      WITH 3 AS x RETURN x + 1 AS y
+      """
+    Then the result should be, in order:
+      | y |
+      | 4 |
+
+  Scenario: WITH head feeding UNWIND
+    When executing query:
+      """
+      WITH [1,2,3] AS l UNWIND l AS x RETURN x
+      """
+    Then the result should be, in order:
+      | x |
+      | 1 |
+      | 2 |
+      | 3 |
+
   Scenario: RETURN UNION RETURN
     When executing query:
       """
