@@ -389,7 +389,21 @@ class Unary(Expr):
         return (self.operand,)
 
 
-_ARITH = {"+": v_add, "-": v_sub, "*": v_mul, "/": v_div, "%": v_mod}
+def _bitop(fn):
+    def op(a, b):
+        if is_null(a) or is_null(b):
+            return NULL
+        if isinstance(a, bool) or isinstance(b, bool) \
+                or not isinstance(a, int) or not isinstance(b, int):
+            return NULL_BAD_TYPE
+        return fn(a, b)
+    return op
+
+
+_ARITH = {"+": v_add, "-": v_sub, "*": v_mul, "/": v_div, "%": v_mod,
+          "&": _bitop(lambda a, b: a & b),
+          "|": _bitop(lambda a, b: a | b),
+          "^": _bitop(lambda a, b: a ^ b)}
 _REL = {"==": v_eq, "!=": v_ne, "<": v_lt, "<=": v_le, ">": v_gt, ">=": v_ge}
 
 

@@ -54,6 +54,9 @@ class Parser:
         except LexError as e:
             raise ParseError(str(e)) from None
         self.i = 0
+        # >0 while parsing inside a bracketed expression context —
+        # gates the bit-or operator (see p_bitor)
+        self.bracket = 0
 
     # ---- token helpers ----
     def peek(self, off=0) -> Token:
@@ -1211,6 +1214,7 @@ class Parser:
             while self.accept(":"):
                 ep.types.append(self.ident())
                 while self.accept("|"):
+                    self.accept(":")     # both `|t` and `|:t` spellings
                     ep.types.append(self.ident())
             if self.accept("*"):
                 ep.min_hop, ep.max_hop = 1, -1
@@ -1278,6 +1282,24 @@ class Parser:
     # Expressions (Pratt)
     # ======================================================================
 
+    def parse_expr_br(self) -> Expr:
+        """parse_expr inside a bracketed context (enables bit-or)."""
+        self.bracket += 1
+        try:
+            return self.parse_expr()
+        finally:
+            self.bracket -= 1
+
+    def parse_expr_nopipe(self) -> Expr:
+        """parse_expr with the bit-or gate OFF — comprehension and
+        reduce collections are followed by a STRUCTURAL `|` that the
+        operator must not consume even inside parens."""
+        saved, self.bracket = self.bracket, 0
+        try:
+            return self.parse_expr()
+        finally:
+            self.bracket = saved
+
     def parse_expr(self) -> Expr:
         return self.p_or()
 
@@ -1303,26 +1325,26 @@ class Parser:
         return self.p_relational()
 
     def p_relational(self) -> Expr:
-        left = self.p_additive()
+        left = self.p_bitor()
         while True:
             t = self.peek()
             if t.kind in ("==", "!=", "<=", ">=", "=~") or t.kind in ("<", ">"):
                 op = self.next().kind
-                left = Binary(op, left, self.p_additive())
+                left = Binary(op, left, self.p_bitor())
             elif self.at_kw("IN"):
                 self.next()
-                left = Binary("IN", left, self.p_additive())
+                left = Binary("IN", left, self.p_bitor())
             elif self.at_kw("CONTAINS"):
                 self.next()
-                left = Binary("CONTAINS", left, self.p_additive())
+                left = Binary("CONTAINS", left, self.p_bitor())
             elif self.at_kw("STARTS"):
                 self.next()
                 self.expect_kw("WITH")
-                left = Binary("STARTS WITH", left, self.p_additive())
+                left = Binary("STARTS WITH", left, self.p_bitor())
             elif self.at_kw("ENDS"):
                 self.next()
                 self.expect_kw("WITH")
-                left = Binary("ENDS WITH", left, self.p_additive())
+                left = Binary("ENDS WITH", left, self.p_bitor())
             elif self.at_kw("NOT"):
                 nxt = self.peek(1)
                 if nxt.kind == "KEYWORD" and nxt.value in ("IN", "CONTAINS", "STARTS", "ENDS"):
@@ -1330,9 +1352,9 @@ class Parser:
                     w = self.next().value
                     if w in ("STARTS", "ENDS"):
                         self.expect_kw("WITH")
-                        left = Binary(f"NOT {w} WITH", left, self.p_additive())
+                        left = Binary(f"NOT {w} WITH", left, self.p_bitor())
                     else:
-                        left = Binary(f"NOT {w}", left, self.p_additive())
+                        left = Binary(f"NOT {w}", left, self.p_bitor())
                 else:
                     break
             elif self.at_kw("IS"):
@@ -1345,6 +1367,25 @@ class Parser:
                 break
         return left
 
+    def p_bitor(self) -> Expr:
+        """Bitwise OR — reference/MySQL precedence (below comparisons,
+        above &).  `|` doubles as the statement pipe and the pattern
+        type separator, so the operator form only binds inside a
+        bracketed context (parens, call args, subscripts, map values) —
+        the reference disambiguates the same way in practice."""
+        left = self.p_bitand()
+        while self.bracket > 0 and self.at("|"):
+            self.next()
+            left = Binary("|", left, self.p_bitand())
+        return left
+
+    def p_bitand(self) -> Expr:
+        left = self.p_additive()
+        while self.at("&"):
+            self.next()
+            left = Binary("&", left, self.p_additive())
+        return left
+
     def p_additive(self) -> Expr:
         left = self.p_multiplicative()
         while self.at("+") or self.at("-"):
@@ -1353,10 +1394,18 @@ class Parser:
         return left
 
     def p_multiplicative(self) -> Expr:
-        left = self.p_unary()
+        left = self.p_xor()
         while self.at("*") or self.at("/") or self.at("%"):
             op = self.next().kind
-            left = Binary(op, left, self.p_unary())
+            left = Binary(op, left, self.p_xor())
+        return left
+
+    def p_xor(self) -> Expr:
+        # ^ binds tighter than * (reference/MySQL precedence)
+        left = self.p_unary()
+        while self.at("^"):
+            self.next()
+            left = Binary("^", left, self.p_unary())
         return left
 
     def p_unary(self) -> Expr:
@@ -1374,13 +1423,13 @@ class Parser:
             if self.at("["):
                 self.next()
                 if self.accept(".."):
-                    hi = None if self.at("]") else self.parse_expr()
+                    hi = None if self.at("]") else self.parse_expr_br()
                     self.expect("]")
                     e = Slice(e, None, hi)
                     continue
-                idx = self.parse_expr()
+                idx = self.parse_expr_br()
                 if self.accept(".."):
-                    hi = None if self.at("]") else self.parse_expr()
+                    hi = None if self.at("]") else self.parse_expr_br()
                     self.expect("]")
                     e = Slice(e, idx, hi)
                 else:
@@ -1462,7 +1511,7 @@ class Parser:
             if pe is not None:
                 return pe
             self.next()
-            e = self.parse_expr()
+            e = self.parse_expr_br()
             self.expect(")")
             return e
         if t.kind == "[":
@@ -1473,7 +1522,7 @@ class Parser:
             while not self.accept("}"):
                 k = self.ident() if not self.at("STRING") else self.next().value
                 self.expect(":")
-                items.append((k, self.parse_expr()))
+                items.append((k, self.parse_expr_br()))
                 self.accept(",")
             return MapExpr(items)
         if t.kind == "*":
@@ -1528,7 +1577,7 @@ class Parser:
             self.expect(",")
             var = self.ident()
             self.expect_kw("IN")
-            coll = self.parse_expr()
+            coll = self.parse_expr_nopipe()
             self.expect("|")
             mapping = self.parse_expr()
             self.expect(")")
@@ -1541,7 +1590,7 @@ class Parser:
             return FunctionCall("_exists", [arg])
         args: List[Expr] = []
         while not self.accept(")"):
-            args.append(self.parse_expr())
+            args.append(self.parse_expr_br())
             self.accept(",")
         return FunctionCall(lname, args)
 
@@ -1573,11 +1622,11 @@ class Parser:
                 and self.peek(1).value == "IN"):
             var = self.ident()
             self.next()  # IN
-            coll = self.parse_expr()
+            coll = self.parse_expr_nopipe()
             where = None
             mapping = None
             if self.accept_kw("WHERE"):
-                where = self.parse_expr()
+                where = self.parse_expr_nopipe()
             if self.accept("|"):
                 mapping = self.parse_expr()
             self.expect("]")

@@ -1,5 +1,14 @@
 Feature: Expressions and null semantics
 
+  Scenario: bitwise operators with reference precedence
+    When executing query:
+      """
+      YIELD 6 & 3 AS a, (6 | 3) AS o, 6 ^ 3 AS x, 2 ^ 10 * 2 AS p, (1 | 2) == 3 AS c, NULL & 1 AS n
+      """
+    Then the result should be, in order:
+      | a | o | x | p  | c    | n    |
+      | 2 | 7 | 5 | 16 | true | NULL |
+
   Scenario: arithmetic and precedence
     When executing query:
       """

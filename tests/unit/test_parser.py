@@ -327,3 +327,31 @@ def test_pattern_predicate_parse():
     assert isinstance(e, Binary) and e.op == "-"
     s = parse("MATCH (a) WHERE (a.person.age)-(1) > 0 RETURN id(a)")
     assert isinstance(s.clauses[0].where, Binary)
+
+
+def test_bitwise_operators():
+    """&/^ everywhere, | inside bracketed contexts only (it is the
+    statement pipe / pattern-type separator elsewhere); reference/MySQL
+    precedence: ^ above *, & above comparisons via additive, | lowest."""
+    e = parse("RETURN 6 & 3 AS a").return_.columns[0].expr
+    assert to_text(e) == "(6 & 3)"
+    e = parse("RETURN (6 | 3) AS o").return_.columns[0].expr
+    assert to_text(e) == "(6 | 3)"
+    e = parse("RETURN 2 ^ 10 * 2 AS x").return_.columns[0].expr
+    assert to_text(e) == "((2 ^ 10) * 2)"          # ^ binds above *
+    e = parse("RETURN 1 + 2 & 3 AS x").return_.columns[0].expr
+    assert to_text(e) == "((1 + 2) & 3)"           # & below additive
+    e = parse("RETURN (1 | 2) == 3 AS c").return_.columns[0].expr
+    assert to_text(e) == "((1 | 2) == 3)"
+    # structural pipes survive: comprehension, reduce, statement pipe
+    s = parse("RETURN [x IN [1,2] WHERE x > 0 | x * 2] AS l")
+    assert s.return_.columns[0].alias == "l"
+    s = parse("RETURN (reduce(acc = 0, x IN [1,2] | acc + x)) AS r")
+    assert s.return_.columns[0].alias == "r"
+    s = parse("YIELD 1 AS v | YIELD $-.v AS w")
+    assert isinstance(s, A.PipedSentence)
+    # multi-type patterns keep both spellings
+    s = parse("MATCH (a)-[e:x|y]->(b) RETURN 1")
+    assert s.clauses[0].patterns[0].edges[0].types == ["x", "y"]
+    s = parse("MATCH (a)-[e:x|:y]->(b) RETURN 1")
+    assert s.clauses[0].patterns[0].edges[0].types == ["x", "y"]
