@@ -1344,7 +1344,8 @@ def _drop_schema(node, qctx, ectx, space):
 def _create_index(node, qctx, ectx, space):
     a = node.args
     qctx.catalog.create_index(a["space"], a["index_name"], a["schema_name"],
-                              a["fields"], a["is_edge"], a["if_not_exists"])
+                              a["fields"], a["is_edge"], a["if_not_exists"],
+                              field_lens=a.get("field_lens"))
     return DataSet()
 
 
@@ -1392,7 +1393,8 @@ def _create_space_as(node, qctx, ectx, space):
                         ttl_col=sv.ttl_col, ttl_duration=sv.ttl_duration)
     for d in cat.indexes(src):
         cat.create_index(a["name"], d.name, d.schema_name, d.fields,
-                         d.is_edge, if_not_exists=ine)
+                         d.is_edge, if_not_exists=ine,
+                         field_lens=getattr(d, "field_lens", None))
     for d in cat.fulltext_indexes(src):
         cat.create_fulltext_index(a["name"], d.name, d.schema_name,
                                   d.fields[0], d.is_edge,
@@ -1463,10 +1465,14 @@ def _describe(node, qctx, ectx, space):
                             f"in space `{space_name}'")
         schema = (cat.get_edge if d.is_edge else cat.get_tag)(
             space_name, d.schema_name)
+        lens = list(getattr(d, "field_lens", None) or [])
+        lens += [0] * (len(d.fields) - len(lens))
         return DataSet(
             ["Field", "Type"],
-            [[f, (p.ptype.value if (p := schema.latest.prop(f))
-                  else "(dropped)")] for f in d.fields])
+            [[(f"{f}({ln})" if ln else f),
+              (p.ptype.value if (p := schema.latest.prop(f))
+               else "(dropped)")]
+             for f, ln in zip(d.fields, lens)])
     get = cat.get_edge if a["kind"] == "edge" else cat.get_tag
     schema = get(space_name, a["name"])
     rows = []
@@ -1508,9 +1514,14 @@ def _show(node, qctx, ectx, space):
         sp = a.get("space")
         want_edge = kind == "edge_indexes"
         idx = [d for d in cat.indexes(sp) if d.is_edge == want_edge]
+        def _cols(d):
+            lens = list(getattr(d, "field_lens", None) or [])
+            lens += [0] * (len(d.fields) - len(lens))
+            return [f"{f}({ln})" if ln else f
+                    for f, ln in zip(d.fields, lens)]
         return DataSet(["Index Name", "By Tag" if not want_edge else "By Edge",
                         "Columns"],
-                       [[d.name, d.schema_name, d.fields] for d in idx])
+                       [[d.name, d.schema_name, _cols(d)] for d in idx])
     if kind == "charset":
         return DataSet(
             ["Charset", "Description", "Default collation", "Maxlen"],

@@ -58,20 +58,34 @@ class IndexData:
     comparison gives prefix-range semantics directly.
     """
 
-    __slots__ = ("name", "fields", "is_edge", "index_id", "parts", "lock")
+    __slots__ = ("name", "fields", "is_edge", "index_id", "parts", "lock",
+                 "field_lens")
 
     def __init__(self, name: str, fields: List[str], is_edge: bool,
-                 num_parts: int, index_id: int = 0):
+                 num_parts: int, index_id: int = 0,
+                 field_lens: Optional[List[int]] = None):
         self.name = name
         self.fields = list(fields)
         self.is_edge = is_edge
         self.index_id = index_id
+        self.field_lens = list(field_lens) if field_lens \
+            else [0] * len(self.fields)
         self.parts: List[List[Tuple]] = [[] for _ in range(num_parts)]
         from ..utils.racecheck import make_lock
         self.lock = make_lock("index_data")
 
     def key_of(self, row: Dict[str, Any]) -> Tuple:
-        return tuple(norm(row.get(f)) for f in self.fields)
+        out = []
+        for f, ln in zip(self.fields, self.field_lens):
+            v = row.get(f)
+            if ln and isinstance(v, str):
+                # string prefix index (reference: name(10) truncates the
+                # key); the LOOKUP planner keeps the full predicate as a
+                # residual for truncated indexes, so a shared prefix can
+                # never surface a wrong row
+                v = v[:ln]
+            out.append(norm(v))
+        return tuple(out)
 
     def add(self, part: int, row: Dict[str, Any], entity: Any):
         k = self.key_of(row)

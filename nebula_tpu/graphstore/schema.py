@@ -471,7 +471,8 @@ class Catalog:
 
     # -- secondary indexes --
     def create_index(self, space: str, index_name: str, schema_name: str,
-                     fields: List[str], is_edge: bool, if_not_exists=False) -> "IndexDesc":
+                     fields: List[str], is_edge: bool, if_not_exists=False,
+                     field_lens: Optional[List[int]] = None) -> "IndexDesc":
         sp = self.get_space(space)
         idxs = self._indexes[sp.space_id]
         if index_name in idxs:
@@ -481,11 +482,21 @@ class Catalog:
         # validate target schema + fields exist
         schema = (self.get_edge(space, schema_name) if is_edge
                   else self.get_tag(space, schema_name))
-        for f in fields:
-            if schema.latest.prop(f) is None:
+        lens = list(field_lens) if field_lens else [0] * len(fields)
+        if len(lens) != len(fields):
+            raise SchemaError("index field/length count mismatch")
+        for f, ln in zip(fields, lens):
+            p = schema.latest.prop(f)
+            if p is None:
                 raise SchemaError(f"prop `{f}' not in `{schema_name}'")
+            if ln:
+                if p.ptype not in (PropType.STRING, PropType.FIXED_STRING):
+                    raise SchemaError(
+                        f"prefix length only applies to string props "
+                        f"(`{f}' is {p.ptype.value})")
         d = IndexDesc(index_name, schema_name, list(fields), is_edge,
-                      index_id=self._alloc_id(sp.space_id))
+                      index_id=self._alloc_id(sp.space_id),
+                      field_lens=lens)
         idxs[index_name] = d
         self.version += 1
         return d
@@ -579,6 +590,9 @@ class IndexDesc:
     index_id: int = 0
     # full-text (ES-listener-backed in the reference) vs secondary B-tree
     fulltext: bool = False
+    # per-field string prefix length, 0 = full value (reference:
+    # CREATE TAG INDEX i ON t(name(10)) truncates the key)
+    field_lens: List[int] = field(default_factory=list)
 
 
 def fill_row(sv: SchemaVersion, row: Dict[str, Any]) -> Dict[str, Any]:

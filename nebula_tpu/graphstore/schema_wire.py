@@ -43,7 +43,7 @@ def to_jso(v: Any) -> Any:
     if isinstance(v, IndexDesc):
         return {"@t": "indexdesc", "n": v.name, "sn": v.schema_name,
                 "f": list(v.fields), "e": v.is_edge, "id": v.index_id,
-                "ft": v.fulltext}
+                "ft": v.fulltext, "fl": list(v.field_lens or [])}
     if isinstance(v, UserDesc):
         return {"@t": "userdesc", "n": v.name, "p": v.pwd_hash,
                 "r": dict(v.roles)}
@@ -93,7 +93,7 @@ def from_jso(j: Any) -> Any:
         return SpaceDesc(j["n"], j["id"], j["pn"], j["rf"], j["vt"], j["c"])
     if t == "indexdesc":
         return IndexDesc(j["n"], j["sn"], list(j["f"]), j["e"], j["id"],
-                         j.get("ft", False))
+                         j.get("ft", False), list(j.get("fl") or []))
     if t == "userdesc":
         return UserDesc(j["n"], j["p"], j["r"])
     if t == "catalog":

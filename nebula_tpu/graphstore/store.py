@@ -360,7 +360,8 @@ class GraphStore:
                     cls = GeoIndexData
             except SchemaError:
                 pass
-        return cls(d.name, d.fields, d.is_edge, num_parts, d.index_id)
+        return cls(d.name, d.fields, d.is_edge, num_parts, d.index_id,
+                   field_lens=getattr(d, "field_lens", None))
 
     def _index_list(self, sd: SpaceData, space: str, schema: str,
                     is_edge: bool):

@@ -187,6 +187,9 @@ class CreateIndexSentence(Sentence):
     schema_name: str
     fields: List[str]
     if_not_exists: bool = False
+    # per-field string prefix length, 0 = full value (reference:
+    # CREATE TAG INDEX i ON t(name(10)))
+    field_lens: List[int] = field(default_factory=list)
 
 
 @dataclass

@@ -1389,6 +1389,11 @@ def index_seed_for_match_scan(node: PlanNode, pctx) -> List[PlanNode]:
     from .planner import score_index_hints
     alts = []
     for d in indexes:
+        if any(getattr(d, "field_lens", None) or []):
+            # string-prefix indexes need value truncation + a full
+            # residual; the LOOKUP planner handles that — this scan
+            # alternative would probe with untruncated values and miss
+            continue
         best = score_index_hints([d], conds)
         if best is None:
             continue

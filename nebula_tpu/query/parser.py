@@ -591,10 +591,22 @@ class Parser:
                 sname = self.ident()
                 self.expect("(")
                 fields = []
+                field_lens = []
                 while not self.accept(")"):
                     fields.append(self.ident())
+                    ln = 0
+                    if self.accept("("):
+                        # string columns index a fixed prefix in the
+                        # reference: CREATE TAG INDEX i ON t(name(10))
+                        ln = self.expect("INT").value
+                        if ln <= 0:
+                            raise ParseError(
+                                "index prefix length must be positive")
+                        self.expect(")")
+                    field_lens.append(ln)
                     self.accept(",")
-                return A.CreateIndexSentence(is_edge, iname, sname, fields, ine)
+                return A.CreateIndexSentence(is_edge, iname, sname, fields,
+                                             ine, field_lens)
             ine = self.p_if_not_exists()
             name = self.ident()
             props: List[A.PropDefAst] = []

@@ -826,6 +826,30 @@ def _choose_index(pctx, space: str, schema: str, is_edge: bool,
         if m is not None:
             conds.setdefault(m[0], []).append((m[1], m[2], i))
     _, name, eq, rng, used = score_index_hints(indexes, conds)
+    d = next(x for x in indexes if x.name == name)
+    lens = list(getattr(d, "field_lens", None) or [])
+    if any(lens):
+        # string prefix index (name(10)): stored keys are truncated, so
+        # probe values truncate the same way, bounds widen to inclusive
+        # (a cut bound excludes keys whose full values qualify), and the
+        # WHOLE predicate stays as residual — prefix hits over-match
+        eq = [v[:lens[i]] if i < len(lens) and lens[i]
+              and isinstance(v, str) else v for i, v in enumerate(eq)]
+        if rng is not None:
+            lo, hi, lo_inc, hi_inc = rng
+            nf = len(eq)
+            ln = lens[nf] if nf < len(lens) else 0
+            if ln:
+                # an exclusive lo of length >= ln collides with keys
+                # truncated TO lo (value "alexander" > lo "alex" stores
+                # key "alex") — widen to inclusive; hi only over-matches
+                # when actually cut
+                if isinstance(lo, str) and len(lo) >= ln:
+                    lo, lo_inc = lo[:ln], True
+                if isinstance(hi, str) and len(hi) > ln:
+                    hi, hi_inc = hi[:ln], True
+            rng = (lo, hi, lo_inc, hi_inc)
+        return name, eq, rng, filt
     residual = join_conjuncts(
         [c for i, c in enumerate(conjs) if i not in used])
     return name, eq, rng, residual
@@ -1695,6 +1719,7 @@ def _register_dispatch():
         A.CreateIndexSentence: lambda p, s: _admin(
             "CreateIndex", is_edge=s.is_edge, index_name=s.index_name,
             schema_name=s.schema_name, fields=s.fields,
+            field_lens=getattr(s, "field_lens", None) or None,
             if_not_exists=s.if_not_exists, space=p.need_space()),
         A.DropIndexSentence: lambda p, s: _admin(
             "DropIndex", is_edge=s.is_edge, index_name=s.index_name,

@@ -317,3 +317,44 @@ def test_lookup_prefers_eq_index_over_geo(eng):
     assert ids(eng, 'LOOKUP ON shop WHERE shop.city == "sf" AND '
                     'ST_Intersects(shop.loc, ST_Point(1.0, 1.0)) '
                     'YIELD id(vertex)') == [50]
+
+
+def test_string_prefix_index(eng):
+    """CREATE TAG INDEX i ON t(name(4)) — reference string-prefix
+    spelling: keys truncate, probes truncate to match, bounds widen to
+    inclusive, and the full predicate stays residual so shared prefixes
+    never surface wrong rows."""
+    eng._run('CREATE TAG u(name string, age int)')
+    eng._run('CREATE TAG INDEX uname ON u(name(4))')
+    eng._run('INSERT VERTEX u(name, age) VALUES 60:("alexander", 30), '
+             '61:("alexis", 25), 62:("bob", 40), 63:("alex", 20)')
+    assert ids(eng, 'LOOKUP ON u WHERE u.name == "alexander" '
+                    'YIELD id(vertex)') == [60]
+    assert ids(eng, 'LOOKUP ON u WHERE u.name == "alex" '
+                    'YIELD id(vertex)') == [63]
+    assert ids(eng, 'LOOKUP ON u WHERE u.name > "alexb" '
+                    'YIELD id(vertex)') == [61, 62]
+    # exclusive lo exactly at the prefix length collides with truncated
+    # keys — must widen to inclusive + residual (code-review repro)
+    assert ids(eng, 'LOOKUP ON u WHERE u.name > "alex" '
+                    'YIELD id(vertex)') == [60, 61, 62]
+    assert ids(eng, 'LOOKUP ON u WHERE u.name >= "alexander" '
+                    'YIELD id(vertex)') == [60, 61, 62]
+    # maintenance respects truncation
+    eng._run('UPDATE VERTEX ON u 62 SET name = "alexzzz"')
+    assert ids(eng, 'LOOKUP ON u WHERE u.name == "alexzzz" '
+                    'YIELD id(vertex)') == [62]
+    # rebuild keeps the prefix keys
+    eng._run('REBUILD TAG INDEX uname')
+    assert ids(eng, 'LOOKUP ON u WHERE u.name == "alexis" '
+                    'YIELD id(vertex)') == [61]
+    # introspection shows the prefix length
+    r = eng._run('DESC TAG INDEX uname')
+    assert r.data.rows[0][0] == "name(4)"
+    # non-string prop with a length / zero length are errors
+    s2 = eng.new_session()
+    assert eng.execute(s2, 'USE ix').ok
+    rs = eng.execute(s2, 'CREATE TAG INDEX bad ON u(age(4))')
+    assert not rs.ok and "string" in rs.error.lower()
+    rs = eng.execute(s2, 'CREATE TAG INDEX bad2 ON u(name(0))')
+    assert not rs.ok
