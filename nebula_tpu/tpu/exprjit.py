@@ -41,6 +41,7 @@ class CannotCompile(Exception):
 _NUMERIC = ("int", "float")
 _CMP_OPS = ("==", "!=", "<", "<=", ">", ">=")
 _ARITH_OPS = ("+", "-", "*", "/", "%")
+_BIT_OPS = ("&", "|", "^")          # int-only; others refuse
 _LOGIC_OPS = ("AND", "OR", "XOR")
 
 
@@ -173,7 +174,7 @@ def _check(e: E.Expr, etypes: Set[str]):
                 return
             raise CannotCompile(
                 "id($$)/id($^) only compiles vs non-null literal vids")
-        if e.op in _LOGIC_OPS + _CMP_OPS + _ARITH_OPS:
+        if e.op in _LOGIC_OPS + _CMP_OPS + _ARITH_OPS + _BIT_OPS:
             _check(e.lhs, etypes)
             _check(e.rhs, etypes)
             return
@@ -407,6 +408,15 @@ def _term_alg(xp):
                 return (xp.where(xp.signbit(a2),
                                  -(xp.abs(a2) % xp.abs(safe)),
                                  xp.abs(a2) % xp.abs(safe)), null, "float")
+            if op in _BIT_OPS:
+                # host gives BAD_TYPE (row-dropping) for non-int
+                # operands incl. bools/floats — only the int/int shape
+                # compiles; everything else falls back
+                if ak != "int" or bk != "int":
+                    raise CannotCompile(f"bitwise on {ak}/{bk}")
+                null = an | bn
+                val = {"&": av & bv, "|": av | bv, "^": av ^ bv}[op]
+                return (val, null, "int")
             raise CannotCompile(f"binary {op}")
         return g
 
@@ -526,7 +536,7 @@ def _vertex_check(e: "E.Expr", alias: str):
                 return
             raise CannotCompile("id(v) only compiles vs non-null "
                                 "literal vids")
-        if e.op in _LOGIC_OPS + _CMP_OPS + _ARITH_OPS:
+        if e.op in _LOGIC_OPS + _CMP_OPS + _ARITH_OPS + _BIT_OPS:
             _vertex_check(e.lhs, alias)
             _vertex_check(e.rhs, alias)
             return

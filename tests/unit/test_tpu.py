@@ -143,6 +143,9 @@ def test_frontier_oracle(rt):
     "id($^) == 3",
     "NOT (knows.w > 10)",
     "knows.w / 3 > 5",
+    "(knows.w & 1) == 0",
+    "(knows.w ^ 3) > 40",
+    "(knows.w | 8) < 60",
 ])
 def test_predicate_parity(rt, where):
     st = random_store(5)
