@@ -525,6 +525,27 @@ def main():
         t0 = time.perf_counter()
         got = _wire.from_wire(_cl.call("result")["data"])
         client_lat.append(time.perf_counter() - t0)
+    # deterministic work counters (ISSUE 1 / VERDICT weak #8): the
+    # noise-immune regression signal.  Two probe runs of the north-star
+    # traverse + one client wire round-trip must agree BYTE-FOR-BYTE —
+    # work counts are stable across noisy VMs even when timings are not.
+    # Probes run post-warmup (converged buckets), so dispatch counts and
+    # frontier sizes are reproducible; diff these across rounds instead
+    # of eps when the VM is suspect (docs/OBSERVABILITY.md).
+    _mark("config 6: deterministic work-counter probes")
+    from nebula_tpu.utils.stats import WorkCounters, use_work
+
+    def _work_probe():
+        wc = WorkCounters()
+        with use_work(wc):
+            rt.traverse(sstore, "ns", big_seeds, ["KNOWS"], "out", 3,
+                        yields=yields)
+            _wire.from_wire(_cl.call("result")["data"])
+        return wc.as_dict()
+
+    work1, work2 = _work_probe(), _work_probe()
+    assert json.dumps(work1) == json.dumps(work2), \
+        f"work counters not deterministic: {work1} != {work2}"
     _srv.stop()
     cg = np.asarray(got.column_array("d"), np.int64)
     assert cg.shape[0] == len(rows) and \
@@ -557,6 +578,8 @@ def main():
                           round(max(lat) * 1e3, 1)],
         "identical_rows": True,
         "buckets": {"EB": st.e_cap},
+        "work_counters": work1,
+        "work_counters_identical": True,
     }
     _save_partial(platform, configs)
 
@@ -855,6 +878,8 @@ def main():
         "fallback": bool(fallback),
         "kernel_vs_cpu": round(tpu_kernel_eps / cpu_eps, 3),
         "identical_rows": True,
+        # noise-immune regression signal (full schema in detail JSON)
+        "work_edges": work1["edges_traversed"],
     }
     if tpu_partial is not None:
         hl["tpu_partial"] = len(tpu_partial["configs"])

@@ -302,6 +302,15 @@ class DistributedStore:
              for pid, pvids in by_part.items()},
             "storage.get_neighbors"))
         # merge preserving input vid order: index rows per (vid, dir)
+        from ..utils.stats import current_work
+        wc = current_work()
+        if wc is not None:
+            # edges shipped over the wire = edges this hop examined
+            # post-pushdown: the cluster host path's deterministic
+            # edges-traversed work count
+            n_rows = sum(len(rows) for rows in results.values())
+            wc.add("edges_traversed", n_rows)
+            wc.add("storage_rows", n_rows)
         per_vid: Dict[Any, List] = {}
         for pid, rows in results.items():
             for (src, et, rank, other, props, sd) in rows:

@@ -37,6 +37,7 @@ class GraphService:
         # enable_authorize=false with root/nebula)
         self.users = users if users is not None else {"root": "nebula"}
         self._users_explicit = users is not None
+        server.service_role = "graphd"
         server.register_service(self, prefix="graph.")
         self._reaper = threading.Thread(target=self._reap_idle, daemon=True)
         self._reaper_stop = threading.Event()

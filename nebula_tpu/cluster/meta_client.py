@@ -93,9 +93,11 @@ class MetaClient:
     # -- cache ------------------------------------------------------------
 
     def refresh(self, force: bool = False):
+        from ..utils import trace as _trace
         with self.lock:
             ver = None if force else self.version
-        r = self.call("meta.get_catalog", version=ver)
+        with _trace.span("meta:refresh", force=force):
+            r = self.call("meta.get_catalog", version=ver)
         changed = r["catalog"] is not None
         with self.lock:
             if changed:

@@ -298,6 +298,7 @@ class MetaService:
 
         self.server = server
         if server is not None:
+            server.service_role = "metad"
             server.register_service(self, prefix="meta.")
 
     # -- raft plumbing ----------------------------------------------------

@@ -547,6 +547,7 @@ class RaftPart:
         """Append + replicate + wait for commit.  Returns the entry's log
         index (truthy) on commit; None if not leader or timed out (caller
         retries against the current leader)."""
+        from ..utils.stats import stats as _metrics
         with self.lock:
             if not self.alive or self.state != LEADER:
                 return None
@@ -555,6 +556,7 @@ class RaftPart:
             if not self.peers:
                 self.commit_index = idx
                 self.commit_cv.notify_all()
+        _metrics().inc("raft_appends")
         self._replicate_all()
         deadline = time.monotonic() + timeout
         with self.lock:
@@ -565,6 +567,7 @@ class RaftPart:
                 self.commit_cv.wait(left)
         # serve-after-commit: apply before returning so leader reads see it
         self._apply_committed()
+        _metrics().inc("raft_commits")
         return idx
 
     # -- RPC handlers -----------------------------------------------------
@@ -624,6 +627,7 @@ class RaftPart:
                     self.wal.truncate_from(prev_idx)
                     return {"term": self.current_term, "ok": False,
                             "hint": max(self.snap_index, prev_idx - 1)}
+            appended = 0
             for (idx, term, d64) in p["entries"]:
                 have = self.wal.term_of(idx)
                 if have is not None:
@@ -634,6 +638,10 @@ class RaftPart:
                 if idx <= self.snap_index:
                     continue
                 self.wal.append(idx, term, _unb64(d64))
+                appended += 1
+            if appended:
+                from ..utils.stats import stats as _metrics
+                _metrics().inc("raft_appends", appended)
             if p["leader_commit"] > self.commit_index:
                 self.commit_index = min(p["leader_commit"],
                                         self.wal.last_index())

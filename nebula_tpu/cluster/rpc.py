@@ -13,6 +13,16 @@ Reply: u32 length | utf-8 JSON {"ok": bool, "result"|"error": ...}
 
 Values use the JSON-safe encoding of core.value (value_to_json /
 value_from_json) at the service layer.
+
+Observability (ISSUE 1): when the calling thread has an active trace,
+the request frame carries `"trace": [trace_id, parent_span_id]`; the
+server adopts it around the handler, and the spans produced while
+handling come back in the reply's `"spans"` list, which the client
+grafts into its trace — the coordinator ends up holding one stitched
+tree across processes.  Every call also feeds the per-op latency
+histograms (`rpc_client_latency_us` / `rpc_server_latency_us`,
+labeled by op) and — when a WorkCounters target is installed via
+utils.stats.use_work — the deterministic call/byte work counters.
 """
 from __future__ import annotations
 
@@ -22,7 +32,10 @@ import socketserver
 import struct
 import threading
 import time
-from typing import Any, Callable, Dict, Optional
+from typing import Any, Callable, Dict, Optional, Tuple
+
+from ..utils import trace as _trace
+from ..utils.stats import current_work, stats as _stats
 
 _LEN = struct.Struct("<I")
 MAX_FRAME = 1 << 30
@@ -46,8 +59,9 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
     return bytes(buf)
 
 
-def _send_frame(sock: socket.socket, obj: Any):
-    """One frame: 4-byte length + payload.
+def _send_frame(sock: socket.socket, obj: Any) -> int:
+    """One frame: 4-byte length + payload.  Returns bytes written
+    (wire-byte work counters).
 
     Payload is plain JSON, or — when the object carries raw byte
     buffers (columnar result columns, SURVEY §2 row 25) — the binary
@@ -66,7 +80,7 @@ def _send_frame(sock: socket.socket, obj: Any):
     data = json.dumps(obj, separators=(",", ":"), default=default).encode()
     if not blobs:
         sock.sendall(_LEN.pack(len(data)) + data)
-        return
+        return _LEN.size + len(data)
     header = b"\x00" + _LEN.pack(len(blobs)) + b"".join(
         _LEN.pack(len(b)) for b in blobs) + _LEN.pack(len(data))
     total = len(header) + len(data) + sum(len(b) for b in blobs)
@@ -74,6 +88,7 @@ def _send_frame(sock: socket.socket, obj: Any):
     sock.sendall(_LEN.pack(total) + header + data)
     for b in blobs:
         sock.sendall(b)
+    return _LEN.size + total
 
 
 def _graft_blobs(j: Any, blobs: list) -> Any:
@@ -89,13 +104,15 @@ def _graft_blobs(j: Any, blobs: list) -> Any:
     return j
 
 
-def _recv_frame(sock: socket.socket) -> Any:
+def _recv_frame(sock: socket.socket) -> Tuple[Any, int]:
+    """-> (decoded frame, bytes read)."""
     (n,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
     if n > MAX_FRAME:
         raise RpcConnError(f"frame too large: {n}")
+    nbytes = _LEN.size + n
     payload = _recv_exact(sock, n)
     if not payload or payload[0] != 0:
-        return json.loads(payload)
+        return json.loads(payload), nbytes
     mv = memoryview(payload)
     off = 1
     (nb,) = _LEN.unpack(mv[off:off + 4]); off += 4
@@ -108,7 +125,7 @@ def _recv_frame(sock: socket.socket) -> Any:
     blobs = []
     for ln in lens:
         blobs.append(mv[off:off + ln]); off += ln   # zero-copy views
-    return _graft_blobs(j, blobs)
+    return _graft_blobs(j, blobs), nbytes
 
 
 class RpcServer:
@@ -121,6 +138,9 @@ class RpcServer:
     def __init__(self, host: str = "127.0.0.1", port: int = 0):
         self.handlers: Dict[str, Callable[[Dict[str, Any]], Any]] = {}
         self.hooks: list = []           # fault-injection: fn(method) -> None|Exception
+        # which daemon this server fronts ("graphd"/"storaged"/"metad");
+        # stamped on the spans its handlers produce
+        self.service_role = "unknown"
         outer = self
 
         class Handler(socketserver.BaseRequestHandler):
@@ -129,7 +149,7 @@ class RpcServer:
                 sock.settimeout(300)
                 try:
                     while True:
-                        req = _recv_frame(sock)
+                        req, _ = _recv_frame(sock)
                         _send_frame(sock, outer._dispatch(req))
                 except (RpcConnError, socket.timeout, OSError,
                         json.JSONDecodeError):
@@ -153,19 +173,54 @@ class RpcServer:
                 self.register(prefix + name[4:], getattr(obj, name))
 
     def _dispatch(self, req: Any) -> Dict[str, Any]:
+        method = req.get("method") if isinstance(req, dict) else None
+        if not method:
+            return {"ok": False, "error": "malformed request frame"}
+        params = req.get("params", {})
+        wire_trace = req.get("trace")
+        spans = None
+        t0 = time.perf_counter()
         try:
-            method = req["method"]
-            params = req.get("params", {})
             for hook in self.hooks:
                 hook(method)
             fn = self.handlers.get(method)
             if fn is None:
                 return {"ok": False, "error": f"unknown method `{method}'"}
+            if wire_trace:
+                # adopt the caller's trace: handler spans go to a fresh
+                # sink shipped back in the reply (the coordinator owns
+                # the trace; nothing is stored on this side)
+                with _trace.adopt_remote(wire_trace[0], wire_trace[1],
+                                         self.service_role) as rg:
+                    spans = rg.spans
+                    with _trace.span(f"rpc.server:{method}"):
+                        result = fn(params)
+                return {"ok": True, "result": result, "spans": spans}
             return {"ok": True, "result": fn(params)}
         except RpcError as ex:
-            return {"ok": False, "error": str(ex)}
+            reply = {"ok": False, "error": str(ex)}
+            if spans:
+                # the error-path spans (incl. the rpc.server span with
+                # its error attr) are precisely what a failing query's
+                # trace needs — ship them like the success path does
+                reply["spans"] = spans
+            return reply
         except Exception as ex:  # noqa: BLE001 — server must not die
-            return {"ok": False, "error": f"{type(ex).__name__}: {ex}"}
+            reply = {"ok": False, "error": f"{type(ex).__name__}: {ex}"}
+            if spans:
+                reply["spans"] = spans
+            return reply
+        finally:
+            # observe error-path latencies too: a histogram that only
+            # sees successes understates the tail it exists to expose.
+            # REGISTERED methods only — labeling by a client-supplied
+            # unknown name would let garbage frames grow one permanent
+            # histogram row per bogus method (unbounded cardinality)
+            if method in self.handlers:
+                _stats().observe("rpc_server_latency_us",
+                                 (time.perf_counter() - t0) * 1e6,
+                                 {"op": method,
+                                  "role": self.service_role})
 
     @property
     def addr(self) -> str:
@@ -206,30 +261,52 @@ class RpcClient:
 
     def call(self, method: str, **params) -> Any:
         last_err: Optional[Exception] = None
-        for attempt in range(self.retries + 1):
-            try:
-                with self._lock:
-                    if self._sock is None:
-                        self._connect()
-                    _send_frame(self._sock, {"method": method,
-                                             "params": params})
-                    reply = _recv_frame(self._sock)
-                if reply.get("ok"):
-                    return reply.get("result")
-                raise RpcError(reply.get("error", "unknown error"))
-            except RpcError:
-                raise
-            except (OSError, RpcConnError, json.JSONDecodeError) as ex:
-                last_err = ex
-                with self._lock:
-                    if self._sock is not None:
-                        try:
-                            self._sock.close()
-                        except OSError:
-                            pass
-                        self._sock = None
-                if attempt < self.retries:
-                    time.sleep(0.05 * (attempt + 1))
+        with _trace.span(f"rpc:{method}", peer=f"{self.host}:{self.port}"):
+            for attempt in range(self.retries + 1):
+                try:
+                    # per-attempt timer: a success after a reconnect
+                    # must not record the dead attempt + backoff sleep
+                    # as op latency (the rpc:<method> span still covers
+                    # the whole call, retries included)
+                    t_call = time.perf_counter()
+                    req = {"method": method, "params": params}
+                    tctx = _trace.wire_context()
+                    if tctx is not None:
+                        req["trace"] = list(tctx)
+                    with self._lock:
+                        if self._sock is None:
+                            self._connect()
+                        sent = _send_frame(self._sock, req)
+                        reply, recvd = _recv_frame(self._sock)
+                    us = (time.perf_counter() - t_call) * 1e6
+                    _stats().observe("rpc_client_latency_us", us,
+                                     {"op": method})
+                    wc = current_work()
+                    if wc is not None:
+                        wc.add_rpc(sent, recvd)
+                    # remote spans come back on error replies too — a
+                    # failing branch's storaged subtree must still land
+                    # in the coordinator's trace
+                    _trace.graft(reply.get("spans") or [])
+                    if reply.get("ok"):
+                        return reply.get("result")
+                    _stats().inc_labeled("rpc_client_errors",
+                                         {"op": method})
+                    raise RpcError(reply.get("error", "unknown error"))
+                except RpcError:
+                    raise
+                except (OSError, RpcConnError,
+                        json.JSONDecodeError) as ex:
+                    last_err = ex
+                    with self._lock:
+                        if self._sock is not None:
+                            try:
+                                self._sock.close()
+                            except OSError:
+                                pass
+                            self._sock = None
+                    if attempt < self.retries:
+                        time.sleep(0.05 * (attempt + 1))
         raise RpcConnError(f"rpc to {self.host}:{self.port} failed: {last_err}")
 
     def close(self):

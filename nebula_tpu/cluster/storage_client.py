@@ -16,6 +16,8 @@ from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ..graphstore.store import stable_vid_hash
+from ..utils import trace as _trace
+from ..utils.stats import current_work, use_work
 from .meta_client import MetaClient
 from .rpc import RpcClient, RpcConnError, RpcError
 
@@ -88,9 +90,21 @@ class StorageClient:
 
     def fanout(self, space: str, by_part: Dict[int, Dict[str, Any]],
                method: str) -> List[Tuple[int, Any]]:
-        """Concurrent per-part calls; returns [(pid, result)] sorted."""
-        futs = {pid: self._pool.submit(self._call_part, space, pid,
-                                       method, params)
+        """Concurrent per-part calls; returns [(pid, result)] sorted.
+
+        The submitting thread's trace context and work-counter target
+        are re-established on each pool thread, so per-part spans and
+        RPC/wire-byte counts attribute to the query that fanned out."""
+        tctx = _trace.current_ctx()
+        wc = current_work()
+
+        def run(pid, params):
+            with _trace.use_ctx(tctx), use_work(wc), \
+                    _trace.span(f"storage:{method}", part=pid,
+                                space=space):
+                return self._call_part(space, pid, method, params)
+
+        futs = {pid: self._pool.submit(run, pid, params)
                 for pid, params in by_part.items()}
         return [(pid, f.result()) for pid, f in sorted(futs.items())]
 

@@ -20,6 +20,7 @@ from typing import Any, Dict, List, Optional, Tuple
 from ..core import wire
 from ..core.wire import from_wire, to_wire
 from ..graphstore.store import GraphStore
+from ..utils import trace as _trace
 from .meta_client import MetaClient
 from .raft import RaftPart
 from .rpc import RpcError, RpcRaftTransport, RpcServer
@@ -63,6 +64,7 @@ class StorageService:
         self._apply_errors: Dict[Tuple[str, int], str] = {}
         self.transport = RpcRaftTransport()
         self.server = server
+        server.service_role = "storaged"
         server.register_service(self, prefix="storage.")
         # raft traffic for all my part groups rides the same server
         from .rpc import serve_raft_parts
@@ -351,7 +353,8 @@ class StorageService:
             decoded = _validate_cmd(cmd)
             stamped = ("v", max(cat_ver, self.meta.version),
                        list(decoded))
-            idx = part.propose(wire.dumps(stamped))
+            with _trace.span("raft:propose", group=part.group):
+                idx = part.propose(wire.dumps(stamped))
             if idx is None:
                 raise RpcError("part_leader_changed: write not committed")
             err = self._apply_errors.pop((part.group, idx), None)
@@ -372,19 +375,27 @@ class StorageService:
         vids = from_wire(p["vids"])
         edge_filter = filter_from_wire(p.get("filter"))
         limit = p.get("limit_per_src")
-        it = self.store.get_neighbors(
-            space, vids, p.get("edge_types"), p.get("direction", "out"))
-        if edge_filter is not None or limit is not None:
-            etypes = p.get("edge_types") or sorted(
-                e.name for e in self.store.catalog.edges(space))
-            etype_ids = {et: self.store.catalog.get_edge(space, et).edge_type
-                         for et in etypes}
-            it = apply_edge_filter(it, space, edge_filter, etype_ids,
-                                   limit, stats_prefix="storage_pushdown")
-        rows = []
-        for (src, et, rank, other, props, sd) in it:
-            rows.append([to_wire(src), et, rank, to_wire(other),
-                         {k: to_wire(v) for k, v in props.items()}, sd])
+        with _trace.span("store:get_neighbors", space=space, part=pid,
+                         vids=len(vids)) as sp_rec:
+            it = self.store.get_neighbors(
+                space, vids, p.get("edge_types"),
+                p.get("direction", "out"))
+            if edge_filter is not None or limit is not None:
+                etypes = p.get("edge_types") or sorted(
+                    e.name for e in self.store.catalog.edges(space))
+                etype_ids = {et: self.store.catalog.get_edge(space,
+                                                             et).edge_type
+                             for et in etypes}
+                it = apply_edge_filter(it, space, edge_filter, etype_ids,
+                                       limit,
+                                       stats_prefix="storage_pushdown")
+            rows = []
+            for (src, et, rank, other, props, sd) in it:
+                rows.append([to_wire(src), et, rank, to_wire(other),
+                             {k: to_wire(v) for k, v in props.items()},
+                             sd])
+            if sp_rec is not None:
+                sp_rec.setdefault("attrs", {})["rows"] = len(rows)
         return rows
 
     def rpc_get_vertex(self, p):

@@ -5,6 +5,10 @@ mount, SURVEY §0]):
 
     GET /status          liveness + role + git-describe-ish version
     GET /stats           metrics text (`?format=json` for JSON)
+    GET /metrics         Prometheus text exposition format (ISSUE 1)
+    GET /traces          recent trace summaries (`?id=<tid>` for one
+                         trace's spans; add `&format=text` for the
+                         indented tree rendering)
     GET /flags           all flag values (`?format=json`)
     PUT /flags           body `name=value` (or JSON object) — live update
 
@@ -21,6 +25,7 @@ from urllib.parse import parse_qsl, urlparse
 
 from ..utils.config import ConfigError, get_config
 from ..utils.stats import stats
+from ..utils.trace import render_tree, trace_store
 
 
 class WebService:
@@ -58,6 +63,27 @@ class WebService:
                                    "application/json")
                     else:
                         self._send(200, stats().to_text())
+                elif u.path == "/metrics":
+                    self._send(200, stats().to_prometheus(),
+                               "text/plain; version=0.0.4; "
+                               "charset=utf-8")
+                elif u.path == "/traces":
+                    tid = q.get("id")
+                    if tid:
+                        entry = trace_store().get(tid)
+                        if entry is None:
+                            self._send(404, f"no trace `{tid}'")
+                        elif q.get("format") == "text":
+                            self._send(200, render_tree(entry))
+                        else:
+                            self._send(200, json.dumps(entry,
+                                                       default=str),
+                                       "application/json")
+                    else:
+                        self._send(200,
+                                   json.dumps(trace_store().list(),
+                                              default=str),
+                                   "application/json")
                 elif u.path == "/flags":
                     vals = get_config().all_values()
                     if as_json:

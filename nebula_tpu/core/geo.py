@@ -281,6 +281,18 @@ def _interleave31(x: int, y: int) -> int:
     return out
 
 
+# meters per degree of great-circle arc, derived from the SAME radius
+# distance_m uses — the old hardcoded 111320 (WGS84 equatorial
+# circumference / 360) exceeds it by ~0.11%, so the padded bbox
+# under-covered and ST_DWithin points near the boundary got cell tokens
+# OUTSIDE covering_ranges (ADVICE high: 44 misses in a 3000-trial
+# fuzz); the residual filter can't recover rows the cover never
+# surfaces.  A small safety factor over-covers instead — extra cells
+# only cost re-checks of the exact predicate.
+_M_PER_DEG = math.radians(1.0) * EARTH_RADIUS_M
+_PAD_SAFETY = 1.005
+
+
 def _pad_boxes(g: Geography, pad_m: float) -> List[Tuple[float, float,
                                                          float, float]]:
     """(lng_lo, lng_hi, lat_lo, lat_hi) boxes covering `g`'s bbox padded
@@ -290,14 +302,15 @@ def _pad_boxes(g: Geography, pad_m: float) -> List[Tuple[float, float,
     pts = g.points()
     lngs = [p[0] for p in pts]
     lats = [p[1] for p in pts]
-    dlat = pad_m / 111320.0 if pad_m else 0.0
+    pad_m = pad_m * _PAD_SAFETY if pad_m else 0.0
+    dlat = pad_m / _M_PER_DEG if pad_m else 0.0
     lat_lo_raw, lat_hi_raw = min(lats) - dlat, max(lats) + dlat
     lat_lo, lat_hi = max(-90.0, lat_lo_raw), min(90.0, lat_hi_raw)
     dlng = 0.0
     full_lng = lat_hi_raw > 90.0 or lat_lo_raw < -90.0
     if pad_m and not full_lng:
         max_abs_lat = min(89.999, max(abs(lat_lo), abs(lat_hi)))
-        dlng = pad_m / (111320.0 * math.cos(math.radians(max_abs_lat)))
+        dlng = pad_m / (_M_PER_DEG * math.cos(math.radians(max_abs_lat)))
         if dlng >= 180.0:
             full_lng = True
     lng_lo_raw, lng_hi_raw = min(lngs) - dlng, max(lngs) + dlng

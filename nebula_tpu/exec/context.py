@@ -138,6 +138,13 @@ class ExecutionContext:
             from ..utils.memtracker import MemoryTracker
             tracker = MemoryTracker()
         self.tracker = tracker
+        # deterministic per-statement work counts (edges traversed, RPC
+        # calls, wire bytes, device dispatches...) — the scheduler
+        # installs this as the thread's counting target around every
+        # executor run, so RPC/runtime layers attribute to the right
+        # statement even on pool threads (docs/OBSERVABILITY.md)
+        from ..utils.stats import WorkCounters
+        self.work = WorkCounters()
 
     def set_result(self, var: str, ds: DataSet):
         if self.tracker is not None and ds is not None:

@@ -63,9 +63,12 @@ class QueryEngine:
         from ..utils.config import get_config
         ttl = float(get_config().get("session_idle_timeout_secs"))
         now = time.time()
-        for sid in [sid for sid, ss in self.sessions.items()
-                    if now - ss.last_used > ttl]:
-            self.sessions.pop(sid, None)
+        # list() snapshots atomically under the GIL — a comprehension
+        # runs bytecode per item and races concurrent new_session
+        # inserts ("dictionary changed size during iteration")
+        for sid, ss in list(self.sessions.items()):
+            if now - ss.last_used > ttl:
+                self.sessions.pop(sid, None)
         s = Session(user)
         self.sessions[s.id] = s
         return s
@@ -140,21 +143,47 @@ class QueryEngine:
             return res
         return self._execute_parsed(session, stmt, text, t0)
 
+    @staticmethod
+    def _stmt_kind(stmt: A.Sentence) -> str:
+        """Statement kind label for metrics/traces: `GoSentence` → `Go`
+        (EXPLAIN/PROFILE report the INNER statement's kind)."""
+        if isinstance(stmt, A.ExplainSentence):
+            stmt = stmt.stmt
+        name = type(stmt).__name__
+        return name[:-len("Sentence")] if name.endswith("Sentence") \
+            else name
+
     def _execute_parsed(self, session: Session, stmt: A.Sentence,
                         text: str, t0: float) -> ResultSet:
-        """Metrics wrapper: every statement outcome (incl. semantic and
-        execution errors) is visible in /stats."""
+        """Metrics + tracing wrapper: every statement outcome (incl.
+        semantic and execution errors) is visible in /stats; every
+        statement produces one trace in the trace store, queryable via
+        /traces and SHOW TRACES."""
+        from ..utils import trace
+        from ..utils.config import get_config
         from ..utils.stats import stats
-        res = self._execute_inner(session, stmt, text, t0)
+        kind = self._stmt_kind(stmt)
+        tg = None
+        if get_config().get("enable_query_tracing"):
+            tg = trace.start_trace(f"query:{kind}", service="graphd",
+                                   stmt=text[:200], session=session.id)
+        if tg is not None:
+            with tg:
+                res = self._execute_inner(session, stmt, text, t0)
+        else:
+            res = self._execute_inner(session, stmt, text, t0)
         us = int((time.perf_counter() - t0) * 1e6)
         stats().inc("num_queries")
         stats().add_value("query_latency_us", us)
+        stats().observe("query_latency_us_hist", us, {"kind": kind})
         if not res.ok:
             stats().inc("num_query_errors")
         elif us > self.slow_query_us:
             stats().inc("num_slow_queries")
             self.slow_log.append({"stmt": text, "latency_us": us,
-                                  "ts": time.time()})
+                                  "ts": time.time(),
+                                  "trace_id": tg.trace_id
+                                  if tg is not None else None})
         return res
 
     def _execute_inner(self, session: Session, stmt: A.Sentence,
@@ -229,6 +258,14 @@ class QueryEngine:
         finally:
             session.queries.pop(qid, None)
             session.running_kill.pop(qid, None)
+            # fold the statement's deterministic work counts into a
+            # caller-installed probe (bench / regression harnesses wrap
+            # execute() in use_work; the scheduler re-targets counting
+            # at stmt_ectx.work inside executors)
+            from ..utils.stats import current_work
+            outer_wc = current_work()
+            if outer_wc is not None and outer_wc is not stmt_ectx.work:
+                outer_wc.merge(stmt_ectx.work)
         session.ectx.results.update({k: v for k, v in stmt_ectx.results.items()
                                      if k.startswith("$")})
 

@@ -1522,6 +1522,14 @@ def _show(node, qctx, ectx, space):
         return DataSet(["Index Name", "By Tag" if not want_edge else "By Edge",
                         "Columns"],
                        [[d.name, d.schema_name, _cols(d)] for d in idx])
+    if kind == "traces":
+        # newest first; the running SHOW TRACES statement's own trace is
+        # still open (stored at statement end), so it never lists itself
+        from ..utils.trace import trace_store
+        return DataSet(
+            ["Trace Id", "Name", "Spans", "Latency (us)"],
+            [[t["tid"], t["name"], t["spans"], t["dur_us"]]
+             for t in trace_store().list()])
     if kind == "charset":
         return DataSet(
             ["Charset", "Description", "Default collation", "Maxlen"],

@@ -72,6 +72,13 @@ class Scheduler:
 
         topo(plan.root)
 
+        # snapshot the submitting thread's trace context once: parallel
+        # branches run exec_one on pool threads, which must attribute
+        # their spans and work counts to the SAME statement
+        from ..utils import trace
+        from ..utils.stats import use_work
+        tctx = trace.current_ctx()
+
         def exec_one(node: PlanNode):
             kill = getattr(ectx, "kill_event", None)
             if kill is not None and kill.is_set():
@@ -80,7 +87,12 @@ class Scheduler:
             t0 = time.perf_counter()
             if profile is not None:
                 self.qctx.last_tpu_stats = None
-            ds = run_node(node, self.qctx, ectx, plan.space)
+            with trace.use_ctx(tctx), \
+                    use_work(getattr(ectx, "work", None)), \
+                    trace.span(f"exec:{node.kind}", node=node.id) as rec:
+                ds = run_node(node, self.qctx, ectx, plan.space)
+                if rec is not None and ds is not None:
+                    rec.setdefault("attrs", {})["rows"] = len(ds.rows)
             us = int((time.perf_counter() - t0) * 1e6)
             ectx.set_result(node.output_var, ds)
             done[node.id] = ds

@@ -801,7 +801,8 @@ class Parser:
                 return A.ShowSentence(which,
                                       scope if scope == "local" else None)
             if kw in ("SPACES", "PARTS", "STATS", "JOBS", "SESSIONS",
-                      "SNAPSHOTS", "BACKUPS", "QUERIES", "CONFIGS"):
+                      "SNAPSHOTS", "BACKUPS", "QUERIES", "CONFIGS",
+                      "TRACES"):
                 self.next()
                 if kw == "JOBS":
                     return A.ShowJobsSentence()

@@ -1166,8 +1166,7 @@ def _plan_match_clause(pctx, mc: A.MatchClauseAst, current: Optional[PlanNode],
             (pre if refs <= right_cols else post).append(c)
         if pre:
             wpre = join_conjuncts(pre)
-            node, wpre, hidden_o = _apply_pattern_preds(
-                pctx, node, wpre, aliases)
+            node, wpre, hidden_o = _apply_pattern_preds(pctx, node, wpre)
             node = PlanNode("Filter", deps=[node],
                             col_names=list(node.col_names),
                             args={"condition": wpre, "match_row": True})
@@ -1201,7 +1200,7 @@ def _plan_match_clause(pctx, mc: A.MatchClauseAst, current: Optional[PlanNode],
                             col_names=current.col_names + node.col_names)
     if where is not None:
         w = _rewrite_match_expr(where, aliases)
-        node, w, hidden = _apply_pattern_preds(pctx, node, w, aliases)
+        node, w, hidden = _apply_pattern_preds(pctx, node, w)
         node = PlanNode("Filter", deps=[node], col_names=list(node.col_names),
                         args={"condition": w, "match_row": True})
         if hidden:
@@ -1213,8 +1212,7 @@ def _plan_match_clause(pctx, mc: A.MatchClauseAst, current: Optional[PlanNode],
     return node
 
 
-def _apply_pattern_preds(pctx, node: PlanNode, w: Expr,
-                         aliases: Dict[str, str]):
+def _apply_pattern_preds(pctx, node: PlanNode, w: Expr):
     """WHERE (a)-[:e]->() — exists-semantics pattern predicates
     (reference: MatchValidator's PatternExpression planned as a
     RollUpApply semi-join [UNVERIFIED — empty mount, SURVEY §0]).

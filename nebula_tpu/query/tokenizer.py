@@ -35,7 +35,7 @@ KEYWORDS = {
     "MERGE", "RENAME", "DIVIDE", "TEXT", "SERVICE", "SEARCH", "CLIENTS",
     "STATUS",
     "META", "GRAPH", "STORAGE", "DOWNLOAD", "HDFS",
-    "BACKUP", "BACKUPS", "RESTORE", "NEW", "LOCAL",
+    "BACKUP", "BACKUPS", "RESTORE", "NEW", "LOCAL", "TRACES",
     # types
     "INT", "INT64", "INT32", "INT16", "INT8", "FLOAT", "DOUBLE", "STRING",
     "FIXED_STRING", "BOOL", "TIMESTAMP", "DATE", "TIME", "DATETIME",

@@ -1,0 +1,84 @@
+"""metrics-dump — scrape a running daemon's /metrics + /traces.
+
+Observability CLI (ISSUE 1): fetch the Prometheus exposition text and
+the recent-trace list from a daemon's webservice port, pretty-print a
+chosen trace as an indented span tree.  Useful both interactively and
+as the round-over-round diff source (work counters + counter metrics
+are deterministic where timings are not; docs/OBSERVABILITY.md).
+
+    python -m nebula_tpu.tools.metrics_dump --addr 127.0.0.1:10669
+    python -m nebula_tpu.tools.metrics_dump --addr ... --traces
+    python -m nebula_tpu.tools.metrics_dump --addr ... --trace <tid>
+    python -m nebula_tpu.tools.metrics_dump --addr ... --grep rpc_
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import urllib.request
+
+
+def _fetch(addr: str, path: str) -> str:
+    with urllib.request.urlopen(f"http://{addr}{path}", timeout=10) as r:
+        return r.read().decode()
+
+
+def dump_metrics(addr: str, grep: str = "") -> int:
+    text = _fetch(addr, "/metrics")
+    n = 0
+    for ln in text.splitlines():
+        if grep and grep not in ln:
+            continue
+        print(ln)
+        if not ln.startswith("#"):
+            n += 1
+    return n
+
+
+def dump_trace_list(addr: str) -> int:
+    traces = json.loads(_fetch(addr, "/traces"))
+    for t in traces:
+        print(f"{t['tid']}  {t['name']:<28} spans={t['spans']:<4} "
+              f"{t['dur_us']}us")
+    return len(traces)
+
+
+def dump_trace(addr: str, tid: str):
+    print(_fetch(addr, f"/traces?id={tid}&format=text"))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="metrics-dump")
+    ap.add_argument("--addr", required=True,
+                    help="webservice host:port of any daemon")
+    ap.add_argument("--traces", action="store_true",
+                    help="list recent traces instead of metrics")
+    ap.add_argument("--trace", default="",
+                    help="print one trace's span tree by id "
+                         "('latest' = newest recorded trace)")
+    ap.add_argument("--grep", default="",
+                    help="only metric lines containing this substring")
+    args = ap.parse_args(argv)
+    try:
+        if args.trace:
+            tid = args.trace
+            if tid == "latest":
+                traces = json.loads(_fetch(args.addr, "/traces"))
+                if not traces:
+                    print("no traces recorded", file=sys.stderr)
+                    return 1
+                tid = traces[0]["tid"]
+            dump_trace(args.addr, tid)
+        elif args.traces:
+            dump_trace_list(args.addr)
+        else:
+            dump_metrics(args.addr, args.grep)
+    except OSError as ex:
+        print(f"scrape failed: {ex}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -83,9 +83,11 @@ def build_bfs_fn(mesh, P: int, EB, max_steps: int,
                 "ovf_expand": ovf_e[None]}
 
     from jax.sharding import PartitionSpec
+
+    from .device import shard_map as _shard_map
     spec = PartitionSpec("part")
-    smapped = jax.shard_map(kernel, mesh=mesh,
-                            in_specs=(spec, spec), out_specs=spec)
+    smapped = _shard_map(kernel, mesh=mesh,
+                         in_specs=(spec, spec), out_specs=spec)
     return jax.jit(smapped)
 
 

@@ -18,6 +18,13 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 from ..graphstore.csr import CsrSnapshot, StringPool
 from ..graphstore.schema import PropType
 
+# jax moved shard_map out of experimental at ~0.6; export the resolved
+# callable so every kernel module (hop, bfs, future ones) shares ONE
+# version shim instead of re-probing
+shard_map = getattr(jax, "shard_map", None)
+if shard_map is None:
+    from jax.experimental.shard_map import shard_map
+
 
 class TpuUnavailable(Exception):
     """The device plane cannot serve this space/config; callers fall back

@@ -50,6 +50,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .device import shard_map as _shard_map
+
 MAXI = np.iinfo(np.int32).max
 
 
@@ -271,11 +273,13 @@ def build_traverse_fn(mesh, P: int, EB, steps: int,
         vmax = fbm.shape[0]
         pid = jax.lax.axis_index("part").astype(jnp.int32)
         hop_edges: List[Any] = []
+        frontier_sizes: List[Any] = []         # popcount entering each hop
         ovf_e = jnp.zeros((), bool)
         cap_out = None
         hop_caps: List[Dict[str, Any]] = []
 
         for hop in range(steps):
+            frontier_sizes.append(jnp.sum(fbm, dtype=jnp.int32))
             last = hop == steps - 1
             EBh = ebs[hop]
             marks = None
@@ -340,6 +344,9 @@ def build_traverse_fn(mesh, P: int, EB, steps: int,
             "frontier": fbm[None],
             "fcount": jnp.sum(fbm, dtype=jnp.int32)[None],
             "hop_edges": jnp.stack(hop_edges)[None],
+            # deterministic work counter (ISSUE 1): per-hop frontier
+            # size, this shard's members only — host sums over parts
+            "frontier_sizes": jnp.stack(frontier_sizes)[None],
             "ovf_expand": ovf_e[None],
         }
         if capture:
@@ -349,8 +356,8 @@ def build_traverse_fn(mesh, P: int, EB, steps: int,
 
     from jax.sharding import PartitionSpec
     spec = PartitionSpec("part")
-    smapped = jax.shard_map(kernel, mesh=mesh,
-                            in_specs=(spec, spec), out_specs=spec)
+    smapped = _shard_map(kernel, mesh=mesh,
+                         in_specs=(spec, spec), out_specs=spec)
     return jax.jit(smapped)
 
 
@@ -392,11 +399,13 @@ def build_traverse_fn_local(P: int, EB, steps: int,
         fbm = frontier                     # (P, vmax) bool
         vmax = fbm.shape[1]
         hop_edges = []
+        frontier_sizes = []                # popcount entering each hop
         ovf_e = jnp.zeros((P,), bool)
         cap_out = None
         hop_caps = []
 
         for hop in range(steps):
+            frontier_sizes.append(jnp.sum(fbm, axis=1, dtype=jnp.int32))
             last = hop == steps - 1
             EBh = ebs[hop]
             marks = None                   # (P_src, P_dst, vmax) bool
@@ -465,6 +474,7 @@ def build_traverse_fn_local(P: int, EB, steps: int,
             "frontier": fbm,
             "fcount": jnp.sum(fbm, axis=1, dtype=jnp.int32),
             "hop_edges": jnp.stack(hop_edges, axis=1),      # (P, steps)
+            "frontier_sizes": jnp.stack(frontier_sizes, axis=1),
             "ovf_expand": ovf_e,
         }
         if capture:

@@ -137,12 +137,13 @@ def _cat_prefix(arr, bi, pids, kc, dtype=None):
 
 
 class TraverseStats:
-    __slots__ = ("hop_edges", "result_edges", "f_cap", "e_cap",
-                 "retries", "device_s", "steps",
+    __slots__ = ("hop_edges", "frontier_sizes", "result_edges", "f_cap",
+                 "e_cap", "retries", "device_s", "steps",
                  "pin_s", "put_s", "fetch_s", "mat_s", "total_s")
 
     def __init__(self):
         self.hop_edges: List[int] = []
+        self.frontier_sizes: List[int] = []   # popcount entering each hop
         self.result_edges = 0
         self.f_cap = 0
         self.e_cap = 0
@@ -602,6 +603,8 @@ class TpuRuntime:
         # frontier was truncated), so in the worst case each attempt
         # finalizes only one more hop's bucket — the retry budget must
         # scale with the hop count
+        from ..utils.stats import current_work
+        wc = current_work()
         for attempt in range(max(self.max_retries, n_hops + 3)):
             stats.retries = attempt
             ebs = tuple(EBs)
@@ -609,6 +612,8 @@ class TpuRuntime:
             fn = self._fns.get(key)
             if fn is None:
                 fn = self._fns[key] = build_fn(ebs)
+            if wc is not None:
+                wc.add("device_dispatches")
             t0 = time.perf_counter()
             from ..utils.config import get_config
             prof_dir = get_config().get("tpu_profiler_dir")
@@ -691,6 +696,10 @@ class TpuRuntime:
                     self._save_buckets()
                 stats.hop_edges = [int(x)
                                    for x in res["hop_edges"].sum(axis=0)]
+                if "frontier_sizes" in res:
+                    stats.frontier_sizes = [
+                        int(x) for x in
+                        np.asarray(res["frontier_sizes"]).sum(axis=0)]
                 if cap_dev is not None:
                     tf = time.perf_counter()
                     kc = np.asarray(res["kcount"])
@@ -714,6 +723,17 @@ class TpuRuntime:
                 _metrics().inc("tpu_edges_traversed",
                                stats.edges_traversed())
                 _metrics().add_value("tpu_kernel_s", stats.device_s)
+                if wc is not None:
+                    wc.add("edges_traversed", stats.edges_traversed())
+                    wc.extend_frontier(stats.frontier_sizes)
+                # device-plane trace phases (ISSUE 1): the runtime
+                # timed them itself — emit as leaf spans of whatever
+                # executor span is driving this kernel
+                from ..utils import trace as _t
+                _t.record_phase("device:put", stats.put_s)
+                _t.record_phase("device:dispatch", stats.device_s,
+                                eb=list(EBs), retries=stats.retries)
+                _t.record_phase("device:fetch", stats.fetch_s)
                 return res
         raise TpuUnavailable("bucket escalation did not converge")
 

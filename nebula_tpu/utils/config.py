@@ -170,6 +170,11 @@ define_flag("tpu_degree_split_threshold", 0,
             "degree above which a supernode's adjacency is split "
             "across parts at pin time (0 = off); drops the per-part "
             "expansion ceiling toward the mean on skewed graphs")
+define_flag("enable_query_tracing", True,
+            "record a distributed trace per statement (SHOW TRACES / "
+            "GET /traces); off = no spans ride the RPC envelope, which "
+            "also makes wire-byte work counters deterministic for "
+            "regression probes")
 define_flag("tpu_profiler_dir", "",
             "when set, wrap every device kernel run in a jax.profiler "
             "trace written under this directory (SURVEY §5 tracing)")

@@ -73,6 +73,22 @@ Feature: Observability surface
       """
     Then an ExecutionError should be raised
 
+  Scenario: show traces surfaces the per-statement trace store
+    When executing query:
+      """
+      GO FROM 1 OVER E YIELD dst(edge) AS d;
+      SHOW TRACES
+      """
+    Then the result should contain "query:Go"
+
+  Scenario: traces carry executor span counts
+    When executing query:
+      """
+      GO 2 STEPS FROM 1 OVER E YIELD dst(edge) AS d;
+      SHOW TRACES
+      """
+    Then the result should not be empty
+
   Scenario: show charset and collation answer
     When executing query:
       """

@@ -204,6 +204,61 @@ def test_covering_ranges_contains_cell_tokens():
         assert any(lo <= t <= hi for lo, hi in rs2), p.coords
 
 
+def _gc_dest(lng: float, lat: float, bearing_deg: float, d_m: float):
+    """Great-circle destination point (sphere, EARTH_RADIUS_M) — the
+    exact inverse of the haversine distance_m uses."""
+    import math
+    from nebula_tpu.core.geo import EARTH_RADIUS_M
+    br = math.radians(bearing_deg)
+    la1 = math.radians(lat)
+    lo1 = math.radians(lng)
+    dr = d_m / EARTH_RADIUS_M
+    la2 = math.asin(math.sin(la1) * math.cos(dr)
+                    + math.cos(la1) * math.sin(dr) * math.cos(br))
+    lo2 = lo1 + math.atan2(math.sin(br) * math.sin(dr) * math.cos(la1),
+                           math.cos(dr) - math.sin(la1) * math.sin(la2))
+    lng2 = math.degrees(lo2)
+    if lng2 > 180.0:
+        lng2 -= 360.0
+    if lng2 < -180.0:
+        lng2 += 360.0
+    return lng2, math.degrees(la2)
+
+
+def test_geo_pad_boundary_shell():
+    """Regression for the geo pad under-coverage (ADVICE high,
+    core/geo.py): the old 111320 m/deg conversion exceeded the
+    EARTH_RADIUS_M-derived ~111195 m/deg, so the padded bbox was ~0.11%
+    too small and points at distance just under r fell OUTSIDE the
+    covering ranges (44/3000 fuzz misses, e.g. dist 299997 m for
+    r=300000).  Walk a shell of points at 0.9990r..0.9999r around
+    centers at several latitudes and assert every one lands inside the
+    cover — the geo index must never under-approximate ST_DWithin."""
+    from nebula_tpu.core.geo import (Geography, _pad_boxes, cell_token,
+                                     covering_ranges, distance_m)
+    for (clng, clat) in [(0.0, 0.0), (20.0, 40.0), (-70.0, -33.0),
+                         (150.0, 60.0)]:
+        ctr = Geography("point", (clng, clat))
+        for r in (5_000.0, 300_000.0):
+            boxes = _pad_boxes(ctr, r)
+            rs = covering_ranges(ctr, pad_m=r)
+            for bearing in range(0, 360, 15):
+                for frac in (0.9990, 0.9999):
+                    p = Geography("point",
+                                  _gc_dest(clng, clat, bearing, r * frac))
+                    assert distance_m(ctr, p) <= r, (ctr, p)
+                    # the RAW padded box must contain the point — cell
+                    # rounding usually masked the old under-coverage,
+                    # so assert below the quantization too
+                    px, py = p.coords
+                    assert any(lo <= px <= hi and la <= py <= lb
+                               for (lo, hi, la, lb) in boxes), \
+                        (ctr.coords, r, bearing, frac, p.coords, boxes)
+                    t = cell_token(p)
+                    assert any(lo <= t <= hi for lo, hi in rs), \
+                        (ctr.coords, r, bearing, frac, p.coords)
+
+
 def test_geo_index_lookup_and_maintenance(eng):
     eng._run('CREATE TAG place(name string, loc geography)')
     eng._run('CREATE TAG INDEX ploc ON place(loc)')
