@@ -2,6 +2,15 @@
 
 The nebula-python analog: authenticate once, then execute statements,
 receiving ResultSet-shaped replies (wire-decoded DataSet).
+
+Bulk results arrive columnar (ISSUE 2): numeric result columns ride
+the RPC frame as typed blobs and decode into a lazy ColumnarDataSet —
+`rs.data.column_array(name)` is the numpy column straight off the
+wire buffer; per-row Python lists are built only if `.rows` is
+touched.  Int columns may arrive TRANSPORT-NARROWED (int8/16/32 when
+the value range fits — value-exact, `.rows`/`column()` still yield
+plain Python ints); cast with `np.asarray(col, np.int64)` before
+doing overflow-prone numpy arithmetic on the raw column.
 """
 from __future__ import annotations
 

@@ -25,6 +25,44 @@ from .meta_client import MetaClient
 from .storage_client import StorageClient, StorageError
 
 
+def _decode_neighbors_columnar(r, edge_svs):
+    """Decode a columnar get_neighbors reply (storage_service
+    `_neighbors_columnar`) into the (src, et, rank, other, props, sd)
+    row tuples the executor contract expects.  Schema-upgrade fill
+    (fill_row) hoists out of the row loop: the reply's prop-key set is
+    uniform, so the missing-prop defaults are per-reply constants."""
+    et = r["et"]
+    sv = edge_svs.get(et)
+    if sv is None:
+        return                        # edge type dropped: rows invisible
+    from ..core.wire import decode_column
+    srcs = decode_column(r["src"]).tolist()
+    ranks = decode_column(r["rank"]).tolist()
+    dsts = decode_column(r["dst"]).tolist()
+    sds = decode_column(r["sd"]).tolist()
+    pnames = list(r["props"])
+    plists = []
+    for c in r["props"].values():
+        if c.get("b") is not None:
+            plists.append(decode_column(c).tolist())
+        else:
+            plists.append([from_wire(x) for x in c["v"]])
+    fill = fill_row(sv, dict.fromkeys(pnames, None))
+    extra = [(k, v) for k, v in fill.items() if k not in r["props"]]
+    if plists:
+        for src, rank, dst, sd, *pv in zip(srcs, ranks, dsts, sds,
+                                           *plists):
+            props = dict(zip(pnames, pv))
+            if extra:
+                props.update(extra)
+            yield (src, et, rank, dst, props, sd)
+    else:
+        props0 = dict(extra)
+        for src, rank, dst, sd in zip(srcs, ranks, dsts, sds):
+            yield (src, et, rank, dst, dict(props0) if extra else {},
+                   sd)
+
+
 class CatalogProxy:
     """Reads hit the local catalog replica; DDL mutations route to metad
     (so `qctx.catalog.create_tag(...)` in a DDL executor works unchanged
@@ -308,11 +346,19 @@ class DistributedStore:
             # edges shipped over the wire = edges this hop examined
             # post-pushdown: the cluster host path's deterministic
             # edges-traversed work count
-            n_rows = sum(len(rows) for rows in results.values())
+            n_rows = sum(rows["n"] if isinstance(rows, dict)
+                         else len(rows) for rows in results.values())
             wc.add("edges_traversed", n_rows)
             wc.add("storage_rows", n_rows)
         per_vid: Dict[Any, List] = {}
         for pid, rows in results.items():
+            if isinstance(rows, dict):
+                # columnar reply (ISSUE 2): typed blobs decode straight
+                # to numpy and materialize with C-level tolist()s — no
+                # per-cell from_wire, no per-row fill_row
+                for row in _decode_neighbors_columnar(rows, edge_svs):
+                    per_vid.setdefault(repr(row[0]), []).append(row)
+                continue
             for (src, et, rank, other, props, sd) in rows:
                 src_v = from_wire(src)
                 sv = edge_svs.get(et)

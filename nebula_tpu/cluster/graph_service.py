@@ -136,6 +136,9 @@ class GraphService:
             "error": rs.error,
             "space": rs.space,
             "latency_us": rs.latency_us,
+            # bulk numeric results leave here as typed column blobs
+            # (core/wire.py columnar fast path) — the RPC layer ships
+            # them out-of-band of the JSON, zero-copy
             "data": to_wire(rs.data) if rs.data is not None else None,
             "plan_desc": rs.plan_desc,
         }

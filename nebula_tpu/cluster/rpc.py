@@ -8,37 +8,73 @@ those IDLs is preserved by the services built on top (SURVEY §2 row 6);
 only the encoding differs.  Data-plane traffic (frontier exchange) never
 rides this — it's XLA collectives (SURVEY §5, two-plane rule).
 
-Frame: u32 length | utf-8 JSON {"method": str, "params": {...}}
-Reply: u32 length | utf-8 JSON {"ok": bool, "result"|"error": ...}
+Frame grammar (ISSUE 2: pipelined hot path):
+  u32 length | body
+  body = JSON                                  (plain request/reply)
+       | 0x00 blob-layout                      (columnar payload)
+       | 0x01 u32 rid (JSON | 0x00 blob-layout)  (pipelined)
+  blob-layout = u32 nblobs | u32 lens[nblobs] | u32 jsonlen | json
+              | blob bytes...
+JSON text can never start with 0x00/0x01, so receivers distinguish the
+three without version negotiation.  The request id is fixed-width and
+OUTSIDE the JSON so wire-byte work counters stay deterministic across
+runs (ids monotonically grow; their digit count must not leak into the
+counted bytes).
 
-Values use the JSON-safe encoding of core.value (value_to_json /
-value_from_json) at the service layer.
+Concurrency model (ISSUE 2 tentpole): `RpcClient` is a small per-peer
+POOL of connections, each multiplexing concurrent in-flight requests by
+request id with one reader thread; the server dispatches pipelined
+requests to a per-connection worker pool and writes replies as they
+finish (out-of-order).  Concurrent calls to the same peer genuinely
+overlap instead of serializing on one socket.
 
-Observability (ISSUE 1): when the calling thread has an active trace,
-the request frame carries `"trace": [trace_id, parent_span_id]`; the
-server adopts it around the handler, and the spans produced while
-handling come back in the reply's `"spans"` list, which the client
-grafts into its trace — the coordinator ends up holding one stitched
-tree across processes.  Every call also feeds the per-op latency
-histograms (`rpc_client_latency_us` / `rpc_server_latency_us`,
-labeled by op) and — when a WorkCounters target is installed via
-utils.stats.use_work — the deterministic call/byte work counters.
+Retry safety: automatic re-send after a connection died mid-call is
+gated on a per-method idempotency registry (`is_idempotent`) — reads
+and raft messages retry, writes surface `RpcConnError` to the caller
+(at-least-once double-apply hazard; the caller owns the decision).
+`RpcNeverSentError` marks failures that provably never reached the
+wire (connect refused, connection dead at entry) so higher-level
+retry loops (StorageClient's replica walk) can keep retrying those
+for ANY method without risking a double apply.
+
+MAX_FRAME is enforced SYMMETRICALLY: oversized frames are rejected on
+the send path with a clear `FrameTooLarge` before any byte hits the
+socket, and the receive path sanity-checks the blob header (count /
+lengths must tile the frame exactly) instead of feeding garbage offsets
+downstream.
+
+Observability: spans ride the envelope as before (`"trace"` in the
+request JSON, `"spans"` in the reply); per-op latency histograms
+(`rpc_client_latency_us` / `rpc_server_latency_us`), labeled error
+counters, deterministic call/byte work counters, and the pool gauges
+`rpc_pool_size` (open client connections, process-wide) and
+`rpc_inflight` (requests currently awaiting a reply).
 """
 from __future__ import annotations
 
+import itertools
 import json
 import socket
 import socketserver
 import struct
 import threading
 import time
+from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Callable, Dict, Optional, Tuple
 
 from ..utils import trace as _trace
+from ..utils.config import define_flag, get_config
 from ..utils.stats import current_work, stats as _stats
 
 _LEN = struct.Struct("<I")
 MAX_FRAME = 1 << 30
+
+define_flag("rpc_pool_size", 2,
+            "connections per peer in the pipelined client pool (each "
+            "multiplexes concurrent requests; >1 adds parallel byte "
+            "streams for large concurrent results)")
+define_flag("rpc_server_workers", 8,
+            "per-connection worker threads serving pipelined requests")
 
 
 class RpcError(Exception):
@@ -49,43 +85,67 @@ class RpcConnError(Exception):
     """Transport failure (connect/timeout/framing)."""
 
 
-def _recv_exact(sock: socket.socket, n: int) -> bytes:
-    buf = bytearray()
-    while len(buf) < n:
-        chunk = sock.recv(n - len(buf))
-        if not chunk:
+class FrameTooLarge(RpcConnError):
+    """Send-path MAX_FRAME violation — raised before any byte is sent,
+    so the connection stays usable."""
+
+
+def _nbytes(b) -> int:
+    return b.nbytes if isinstance(b, memoryview) else len(b)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytearray:
+    """Read exactly n bytes into ONE preallocated buffer (recv_into —
+    no per-chunk bytes objects, no quadratic joins on 100MB results)."""
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
+        r = sock.recv_into(view[got:])
+        if r == 0:
             raise RpcConnError("connection closed")
-        buf += chunk
-    return bytes(buf)
+        got += r
+    return buf
 
 
-def _send_frame(sock: socket.socket, obj: Any) -> int:
-    """One frame: 4-byte length + payload.  Returns bytes written
-    (wire-byte work counters).
-
-    Payload is plain JSON, or — when the object carries raw byte
-    buffers (columnar result columns, SURVEY §2 row 25) — the binary
-    form: NUL + u32 blob-count + u32 blob-lengths + u32 json-length +
-    json (buffers replaced by {"@t":"blobref","bi":i}) + blob bytes.
-    JSON text can never start with NUL, so receivers distinguish the
-    two without version negotiation."""
+def _encode_body(obj: Any) -> Tuple[bytes, list]:
+    """-> (header+json bytes, blobs).  Raw buffers (columnar result
+    columns, SURVEY §2 row 25) are hoisted out of the JSON as blob
+    references and shipped out-of-band, WITHOUT copying — memoryviews
+    (numpy column buffers) ride to sendall as-is."""
     blobs: list = []
 
     def default(o):
         if isinstance(o, (bytes, bytearray, memoryview)):
-            blobs.append(o if isinstance(o, bytes) else bytes(o))
+            if isinstance(o, memoryview) and o.format != "B":
+                o = o.cast("B")
+            blobs.append(o)
             return {"@t": "blobref", "bi": len(blobs) - 1}
         raise TypeError(f"not JSON-serializable: {type(o).__name__}")
 
     data = json.dumps(obj, separators=(",", ":"), default=default).encode()
     if not blobs:
-        sock.sendall(_LEN.pack(len(data)) + data)
-        return _LEN.size + len(data)
+        return data, blobs
     header = b"\x00" + _LEN.pack(len(blobs)) + b"".join(
-        _LEN.pack(len(b)) for b in blobs) + _LEN.pack(len(data))
-    total = len(header) + len(data) + sum(len(b) for b in blobs)
+        _LEN.pack(_nbytes(b)) for b in blobs) + _LEN.pack(len(data))
+    return header + data, blobs
+
+
+def _send_frame(sock: socket.socket, obj: Any,
+                rid: Optional[int] = None) -> int:
+    """One frame: 4-byte length + body (+ fixed-width request id when
+    pipelined).  Returns bytes written (wire-byte work counters).
+    Callers sharing a socket must hold its send lock across the WHOLE
+    call — the blob loop is several sendall()s."""
+    head, blobs = _encode_body(obj)
+    prefix = b"" if rid is None else b"\x01" + _LEN.pack(rid)
+    total = len(prefix) + len(head) + sum(_nbytes(b) for b in blobs)
+    if total > MAX_FRAME:
+        raise FrameTooLarge(
+            f"frame too large to send: {total} > MAX_FRAME={MAX_FRAME} "
+            f"(split the result or raise MAX_FRAME)")
     # piecewise sendall: no 100MB+ join copy for big columnar results
-    sock.sendall(_LEN.pack(total) + header + data)
+    sock.sendall(_LEN.pack(total) + prefix + head)
     for b in blobs:
         sock.sendall(b)
     return _LEN.size + total
@@ -104,28 +164,101 @@ def _graft_blobs(j: Any, blobs: list) -> Any:
     return j
 
 
-def _recv_frame(sock: socket.socket) -> Tuple[Any, int]:
-    """-> (decoded frame, bytes read)."""
-    (n,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
-    if n > MAX_FRAME:
-        raise RpcConnError(f"frame too large: {n}")
-    nbytes = _LEN.size + n
-    payload = _recv_exact(sock, n)
-    if not payload or payload[0] != 0:
-        return json.loads(payload), nbytes
-    mv = memoryview(payload)
+def _decode_body(mv: memoryview) -> Any:
+    if not mv or mv[0] != 0:
+        return json.loads(bytes(mv))
+    n = len(mv)
     off = 1
+    if n < off + 4:
+        raise RpcConnError("malformed blob frame: truncated header")
     (nb,) = _LEN.unpack(mv[off:off + 4]); off += 4
+    # blob-count sanity BEFORE trusting it as a loop bound: the header
+    # (counts + lengths) must fit inside the frame
+    if nb < 0 or off + 4 * (nb + 1) > n:
+        raise RpcConnError(f"malformed blob frame: {nb} blobs cannot "
+                           f"fit a {n}-byte frame")
     lens = []
     for _ in range(nb):
         (ln,) = _LEN.unpack(mv[off:off + 4]); off += 4
         lens.append(ln)
     (jn,) = _LEN.unpack(mv[off:off + 4]); off += 4
+    if off + jn + sum(lens) != n:
+        raise RpcConnError(
+            f"malformed blob frame: declared sizes (json={jn}, "
+            f"blobs={sum(lens)}) do not tile the {n}-byte frame")
     j = json.loads(bytes(mv[off:off + jn])); off += jn
     blobs = []
     for ln in lens:
         blobs.append(mv[off:off + ln]); off += ln   # zero-copy views
-    return _graft_blobs(j, blobs), nbytes
+    return _graft_blobs(j, blobs)
+
+
+def _recv_frame(sock: socket.socket
+                ) -> Tuple[Any, int, Optional[int]]:
+    """-> (decoded frame, bytes read, request id | None)."""
+    (n,) = _LEN.unpack(bytes(_recv_exact(sock, _LEN.size)))
+    if n > MAX_FRAME:
+        raise RpcConnError(f"frame too large: {n}")
+    nbytes = _LEN.size + n
+    payload = _recv_exact(sock, n)
+    mv = memoryview(payload)
+    rid = None
+    if mv and mv[0] == 1:
+        if n < 5:
+            raise RpcConnError("malformed pipelined frame: no id")
+        (rid,) = _LEN.unpack(mv[1:5])
+        mv = mv[5:]
+    return _decode_body(mv), nbytes, rid
+
+
+# -- idempotency registry (satellite: retry-unsafe writes) ------------------
+
+# Exact method names + prefixes whose handlers are safe to re-deliver:
+# pure reads, overwrite-idempotent state pushes (heartbeat), and raft
+# messages (the protocol itself dedups by term/index).  Everything else
+# — writes, DDL, session/id allocation — must NOT be silently re-sent
+# after a connection died mid-reply: the first send may have applied.
+_IDEMPOTENT_METHODS = {
+    "raft", "meta.ready", "meta.heartbeat", "meta.part_map",
+    "storage.reconcile",
+}
+_IDEMPOTENT_PREFIXES = (
+    "storage.get_", "storage.scan_", "storage.index_scan",
+    "storage.fulltext_search", "storage.part_", "storage.export_",
+    "storage.rebuild_",   # rebuilding an index twice = rebuilding once
+    "meta.get_", "meta.list_", "graph.list_",
+)
+
+
+def mark_idempotent(*methods: str):
+    """Register additional retry-safe methods (services owning custom
+    read ops call this at registration time)."""
+    _IDEMPOTENT_METHODS.update(methods)
+
+
+def is_idempotent(method: str) -> bool:
+    return method in _IDEMPOTENT_METHODS or \
+        method.startswith(_IDEMPOTENT_PREFIXES)
+
+
+# -- pool gauges ------------------------------------------------------------
+
+_gauge_lock = threading.Lock()
+_open_conns = 0
+_inflight = 0
+
+
+def _gauge_delta(conns: int = 0, inflight: int = 0):
+    global _open_conns, _inflight
+    with _gauge_lock:
+        _open_conns += conns
+        _inflight += inflight
+        c, i = _open_conns, _inflight
+    st = _stats()
+    if conns:
+        st.gauge("rpc_pool_size", c)
+    if inflight:
+        st.gauge("rpc_inflight", i)
 
 
 class RpcServer:
@@ -133,6 +266,11 @@ class RpcServer:
 
     handler(params: dict) -> jsonable result; raising RpcError (or any
     exception) returns an error reply instead of killing the connection.
+
+    Pipelined requests (frames carrying a request id) dispatch to a
+    small per-connection worker pool and reply OUT OF ORDER as handlers
+    finish — a slow fanout partition no longer blocks its siblings on
+    the same socket.  Id-less frames keep the old serial semantics.
     """
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0):
@@ -147,13 +285,31 @@ class RpcServer:
             def handle(self):
                 sock = self.request
                 sock.settimeout(300)
+                wlock = threading.Lock()
+                pool: Optional[ThreadPoolExecutor] = None
                 try:
                     while True:
-                        req, _ = _recv_frame(sock)
-                        _send_frame(sock, outer._dispatch(req))
+                        req, _, rid = _recv_frame(sock)
+                        if rid is None:
+                            outer._serve_one(sock, wlock, None, req)
+                            continue
+                        if pool is None:
+                            try:
+                                workers = int(get_config().get(
+                                    "rpc_server_workers"))
+                            except Exception:  # noqa: BLE001
+                                workers = 8
+                            pool = ThreadPoolExecutor(
+                                max_workers=max(1, workers),
+                                thread_name_prefix="rpc-srv")
+                        pool.submit(outer._serve_one, sock, wlock,
+                                    rid, req)
                 except (RpcConnError, socket.timeout, OSError,
-                        json.JSONDecodeError):
+                        json.JSONDecodeError, ValueError):
                     pass
+                finally:
+                    if pool is not None:
+                        pool.shutdown(wait=False)
 
         class Server(socketserver.ThreadingTCPServer):
             daemon_threads = True
@@ -162,6 +318,21 @@ class RpcServer:
         self._server = Server((host, port), Handler)
         self.host, self.port = self._server.server_address
         self._thread: Optional[threading.Thread] = None
+
+    def _serve_one(self, sock, wlock, rid, req):
+        reply = self._dispatch(req)
+        try:
+            try:
+                with wlock:
+                    _send_frame(sock, reply, rid)
+            except FrameTooLarge as ex:
+                # symmetric MAX_FRAME: the peer gets a diagnosable
+                # application error, not an opaque disconnect
+                with wlock:
+                    _send_frame(sock, {"ok": False, "error": str(ex)},
+                                rid)
+        except (OSError, RpcConnError):
+            pass                      # peer went away; nothing to tell it
 
     def register(self, method: str, fn: Callable[[Dict[str, Any]], Any]):
         self.handlers[method] = fn
@@ -237,86 +408,290 @@ class RpcServer:
         self._server.server_close()
 
 
+class RpcNeverSentError(RpcConnError):
+    """The request provably never reached the wire (connect failure or
+    connection already dead at entry) — retry is safe for ANY method,
+    idempotent or not.  Higher-level retry loops (StorageClient's
+    replica walk) key off this to stay double-apply-safe."""
+
+
+class _Pending:
+    __slots__ = ("event", "reply", "nbytes", "error")
+
+    def __init__(self):
+        self.event = threading.Event()
+        self.reply = None
+        self.nbytes = 0
+        self.error: Optional[Exception] = None
+
+
+class _Conn:
+    """One pipelined connection: send lock + reader thread + pending map
+    keyed by request id.  Death (socket error, malformed frame, close)
+    fails every waiter at once."""
+
+    __slots__ = ("sock", "send_lock", "pending", "plock", "_ids",
+                 "dead", "inflight", "last_rx", "_reader")
+
+    def __init__(self, host: str, port: int, timeout: float):
+        sock = socket.create_connection((host, port), timeout=timeout)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        # the socket KEEPS its timeout: a peer that stops reading must
+        # not hang sendall() forever while it holds send_lock — the
+        # reader tolerates idle timeouts between frames (below), so
+        # pooled connections still survive quiet periods
+        self.sock = sock
+        self.send_lock = threading.Lock()
+        self.pending: Dict[int, _Pending] = {}
+        self.plock = threading.Lock()
+        self._ids = itertools.count(1)
+        self.dead: Optional[Exception] = None
+        self.inflight = 0
+        self.last_rx = time.monotonic()   # any frame received
+        _gauge_delta(conns=1)
+        self._reader = threading.Thread(
+            target=self._read_loop, daemon=True,
+            name=f"rpc-reader-{host}:{port}")
+        self._reader.start()
+
+    def _read_loop(self):
+        hdr = bytearray(_LEN.size)
+        view = memoryview(hdr)
+        try:
+            while True:
+                # idle-tolerant length read: a socket timeout BETWEEN
+                # frames just means no traffic — only a timeout
+                # mid-frame (or mid-payload below) is a dead peer
+                got = 0
+                while got < _LEN.size:
+                    try:
+                        r = self.sock.recv_into(view[got:])
+                    except socket.timeout:
+                        if got == 0:
+                            continue
+                        raise RpcConnError("timeout mid-frame")
+                    if r == 0:
+                        raise RpcConnError("connection closed")
+                    got += r
+                (n,) = _LEN.unpack(hdr)
+                if n > MAX_FRAME:
+                    raise RpcConnError(f"frame too large: {n}")
+                nbytes = _LEN.size + n
+                mv = memoryview(_recv_exact(self.sock, n))
+                rid = None
+                if mv and mv[0] == 1:
+                    if n < 5:
+                        raise RpcConnError("malformed pipelined frame")
+                    (rid,) = _LEN.unpack(mv[1:5])
+                    mv = mv[5:]
+                reply = _decode_body(mv)
+                self.last_rx = time.monotonic()
+                with self.plock:
+                    p = self.pending.pop(rid, None)
+                if p is not None:       # late reply after timeout: drop
+                    p.reply = reply
+                    p.nbytes = nbytes
+                    p.event.set()
+        except Exception as ex:  # noqa: BLE001 — any framing/socket death
+            self.die(ex)
+
+    def die(self, ex: Exception):
+        with self.plock:
+            if self.dead is not None:
+                return              # pending already failed by first death
+            self.dead = ex
+            waiters = list(self.pending.values())
+            self.pending.clear()
+        _gauge_delta(conns=-1)
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+        for p in waiters:
+            p.error = ex
+            p.event.set()
+
+    def request(self, req: Dict[str, Any], timeout: float
+                ) -> Tuple[Any, int, int]:
+        """-> (reply, sent bytes, received bytes).  Raises RpcConnError
+        on transport failure; the caller decides whether a retry is
+        idempotency-safe."""
+        p = _Pending()
+        with self.plock:
+            if self.dead is not None:
+                raise RpcNeverSentError(str(self.dead))
+            rid = next(self._ids)
+            self.pending[rid] = p
+            self.inflight += 1
+        _gauge_delta(inflight=1)
+        try:
+            try:
+                with self.send_lock:
+                    sent = _send_frame(self.sock, req, rid)
+            except FrameTooLarge:
+                with self.plock:
+                    self.pending.pop(rid, None)
+                raise                 # connection untouched, no retry
+            except OSError as ex:
+                self.die(ex)
+                raise RpcConnError(f"send failed: {ex}") from None
+            if not p.event.wait(timeout):
+                with self.plock:
+                    self.pending.pop(rid, None)
+                if time.monotonic() - self.last_rx >= timeout:
+                    # the peer has been COMPLETELY silent for a full
+                    # timeout window: treat the connection as dead so
+                    # the pool stops queueing onto a zombie socket
+                    # (fast failure detection for dead hosts)
+                    self.die(RpcConnError(
+                        f"peer silent for {timeout}s"))
+                else:
+                    # the connection is demonstrably alive (frames
+                    # arrived recently) — fail ONLY this request; rid
+                    # matching makes its late reply harmlessly
+                    # droppable, and sibling in-flight calls (possibly
+                    # non-idempotent, non-retryable) must not be
+                    # collaterally aborted by one slow handler
+                    pass
+                raise RpcConnError(f"rpc timeout after {timeout}s")
+            if p.error is not None:
+                raise RpcConnError(str(p.error))
+            return p.reply, sent, p.nbytes
+        finally:
+            with self.plock:
+                self.inflight -= 1
+            _gauge_delta(inflight=-1)
+
+
 class RpcClient:
-    """One connection, auto-reconnect, thread-safe (serialized calls)."""
+    """Per-peer pipelined connection pool.
+
+    Concurrent call()s multiplex over pooled connections by request id —
+    they overlap in flight instead of serializing behind one socket lock
+    (`StorageClient.fanout` to N partitions on one host is now wall-time
+    ≈ max(partition), not sum).  Auto-reconnects; automatic retry after
+    a mid-call connection death only for idempotent methods (see
+    `is_idempotent`)."""
 
     def __init__(self, host: str, port: int, timeout: float = 30.0,
-                 retries: int = 2):
+                 retries: int = 2, pool_size: Optional[int] = None):
         self.host, self.port = host, port
         self.timeout = timeout
         self.retries = retries
-        self._sock: Optional[socket.socket] = None
+        if pool_size is None:
+            try:
+                pool_size = int(get_config().get("rpc_pool_size"))
+            except Exception:  # noqa: BLE001 — config not initialized
+                pool_size = 2
+        self.pool_size = max(1, pool_size)
+        self._conns: list = []
         self._lock = threading.Lock()
+        self._closed = False
 
     @classmethod
     def from_addr(cls, addr: str, **kw) -> "RpcClient":
         host, port = addr.rsplit(":", 1)
         return cls(host, int(port), **kw)
 
-    def _connect(self):
-        s = socket.create_connection((self.host, self.port),
-                                     timeout=self.timeout)
-        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        self._sock = s
+    def _pick(self) -> _Conn:
+        """Least-loaded live connection; grow the pool while every
+        existing connection is busy and the cap allows.  The blocking
+        connect happens OUTSIDE the pool lock — one unreachable-peer
+        connect must not stall callers that could ride an existing
+        live connection."""
+        with self._lock:
+            if self._closed:
+                raise RpcNeverSentError("client closed")
+            live = [c for c in self._conns if c.dead is None]
+            self._conns = live
+            best = min(live, key=lambda c: c.inflight, default=None)
+            if best is not None and (best.inflight == 0
+                                     or len(live) >= self.pool_size):
+                return best
+        try:
+            c = _Conn(self.host, self.port, self.timeout)
+        except OSError as ex:
+            raise RpcNeverSentError(
+                f"connect to {self.host}:{self.port} failed: {ex}"
+            ) from None
+        with self._lock:
+            if self._closed:
+                c.die(RpcConnError("client closed"))
+                raise RpcNeverSentError("client closed")
+            live = [x for x in self._conns if x.dead is None]
+            if len(live) >= self.pool_size:
+                # a racing caller filled the pool meanwhile — keep the
+                # cap: drop the extra socket, ride the least-loaded
+                c.die(RpcConnError("pool full"))
+                return min(live, key=lambda x: x.inflight)
+            self._conns.append(c)
+            return c
 
     def call(self, method: str, **params) -> Any:
         last_err: Optional[Exception] = None
         with _trace.span(f"rpc:{method}", peer=f"{self.host}:{self.port}"):
             for attempt in range(self.retries + 1):
+                # per-attempt timer: a success after a reconnect must
+                # not record the dead attempt + backoff sleep as op
+                # latency (the rpc:<method> span still covers the whole
+                # call, retries included)
+                t_call = time.perf_counter()
+                req = {"method": method, "params": params}
+                tctx = _trace.wire_context()
+                if tctx is not None:
+                    req["trace"] = list(tctx)
+                sent_any = False
                 try:
-                    # per-attempt timer: a success after a reconnect
-                    # must not record the dead attempt + backoff sleep
-                    # as op latency (the rpc:<method> span still covers
-                    # the whole call, retries included)
-                    t_call = time.perf_counter()
-                    req = {"method": method, "params": params}
-                    tctx = _trace.wire_context()
-                    if tctx is not None:
-                        req["trace"] = list(tctx)
-                    with self._lock:
-                        if self._sock is None:
-                            self._connect()
-                        sent = _send_frame(self._sock, req)
-                        reply, recvd = _recv_frame(self._sock)
-                    us = (time.perf_counter() - t_call) * 1e6
-                    _stats().observe("rpc_client_latency_us", us,
-                                     {"op": method})
-                    wc = current_work()
-                    if wc is not None:
-                        wc.add_rpc(sent, recvd)
-                    # remote spans come back on error replies too — a
-                    # failing branch's storaged subtree must still land
-                    # in the coordinator's trace
-                    _trace.graft(reply.get("spans") or [])
-                    if reply.get("ok"):
-                        return reply.get("result")
-                    _stats().inc_labeled("rpc_client_errors",
-                                         {"op": method})
-                    raise RpcError(reply.get("error", "unknown error"))
-                except RpcError:
+                    conn = self._pick()
+                    sent_any = True     # bytes may be on the wire now
+                    reply, sent, recvd = conn.request(req, self.timeout)
+                except FrameTooLarge:
                     raise
+                except RpcNeverSentError as ex:
+                    last_err = ex       # provably never sent: retryable
+                    if attempt < self.retries:
+                        time.sleep(0.05 * (attempt + 1))
+                    continue
                 except (OSError, RpcConnError,
                         json.JSONDecodeError) as ex:
                     last_err = ex
-                    with self._lock:
-                        if self._sock is not None:
-                            try:
-                                self._sock.close()
-                            except OSError:
-                                pass
-                            self._sock = None
+                    # connect failures never reached the peer — always
+                    # retryable; mid-call deaths may have applied the
+                    # request, so only idempotent methods auto-retry
+                    if sent_any and not is_idempotent(method):
+                        raise RpcConnError(
+                            f"rpc {method} to {self.host}:{self.port} "
+                            f"failed mid-call and is not idempotent "
+                            f"(not retried): {ex}") from None
                     if attempt < self.retries:
                         time.sleep(0.05 * (attempt + 1))
-        raise RpcConnError(f"rpc to {self.host}:{self.port} failed: {last_err}")
+                    continue
+                us = (time.perf_counter() - t_call) * 1e6
+                _stats().observe("rpc_client_latency_us", us,
+                                 {"op": method})
+                wc = current_work()
+                if wc is not None:
+                    wc.add_rpc(sent, recvd)
+                # remote spans come back on error replies too — a
+                # failing branch's storaged subtree must still land in
+                # the coordinator's trace
+                _trace.graft(reply.get("spans") or [])
+                if reply.get("ok"):
+                    return reply.get("result")
+                _stats().inc_labeled("rpc_client_errors", {"op": method})
+                raise RpcError(reply.get("error", "unknown error"))
+        # preserve the never-sent distinction through the final raise so
+        # higher-level retry loops stay double-apply-safe
+        kind = RpcNeverSentError if isinstance(last_err, RpcNeverSentError) \
+            else RpcConnError
+        raise kind(f"rpc to {self.host}:{self.port} failed: {last_err}")
 
     def close(self):
         with self._lock:
-            if self._sock is not None:
-                try:
-                    self._sock.close()
-                except OSError:
-                    pass
-                self._sock = None
+            self._closed = True
+            conns, self._conns = self._conns, []
+        for c in conns:
+            c.die(RpcConnError("client closed"))
 
 
 class RpcRaftTransport:
@@ -336,7 +711,7 @@ class RpcRaftTransport:
             c = self._clients.get(peer)
             if c is None:
                 c = self._clients[peer] = RpcClient.from_addr(
-                    peer, timeout=2.0, retries=0)
+                    peer, timeout=2.0, retries=0, pool_size=1)
             return c
 
     def send(self, peer, group, method, payload):

@@ -6,7 +6,10 @@ partition of its vids (stable hash, same function the store uses),
 sends each shard to that part's leader from the cached part map,
 retries on leader-change / connection errors after re-pulling the map,
 and merges responses.  Fan-out is a thread pool (the folly-futures
-analog); per-hop data-plane traffic does NOT ride this in TPU mode
+analog) over PIPELINED per-peer clients (ISSUE 2): partitions hosted on
+the same storaged multiplex over the pooled connection by request id,
+so N-partition fan-out to one host is wall-time ≈ max(partition), not
+sum.  Per-hop data-plane traffic does NOT ride this in TPU mode
 (SURVEY §5 two-plane rule).
 """
 from __future__ import annotations
@@ -19,7 +22,8 @@ from ..graphstore.store import stable_vid_hash
 from ..utils import trace as _trace
 from ..utils.stats import current_work, use_work
 from .meta_client import MetaClient
-from .rpc import RpcClient, RpcConnError, RpcError
+from .rpc import (RpcClient, RpcConnError, RpcError, RpcNeverSentError,
+                  is_idempotent)
 
 
 class StorageError(Exception):
@@ -35,6 +39,9 @@ class StorageClient:
                                         thread_name_prefix="storage-fanout")
 
     def _client(self, addr: str) -> RpcClient:
+        # retries=0: _call_part owns retry (replica walk + map refresh);
+        # the pooled client multiplexes concurrent per-part calls to
+        # this peer over its connections by request id
         with self._lock:
             c = self._clients.get(addr)
             if c is None:
@@ -79,9 +86,22 @@ class StorageClient:
                             "not hosted here" in str(ex):
                         continue
                     raise StorageError(str(ex)) from None
+                except RpcNeverSentError as ex:
+                    last = ex           # never reached the peer: walk on
+                    continue
                 except RpcConnError as ex:
                     last = ex
-                    continue
+                    # the request MAY have applied before the connection
+                    # died — walking replicas / retrying would re-send
+                    # it, so only idempotent methods keep going (the
+                    # same at-least-once gate RpcClient.call applies,
+                    # one layer up where the replica walk lives)
+                    if is_idempotent(method):
+                        continue
+                    raise StorageError(
+                        f"{method} to part {pid} of `{space}' failed "
+                        f"mid-call; not retried (non-idempotent): {ex}"
+                    ) from None
             # election / part creation may be in flight — back off briefly
             import time
             time.sleep(0.1 * (attempt + 1))

@@ -46,6 +46,43 @@ def _validate_cmd(cmd) -> tuple:
     return decoded
 
 
+def _neighbors_columnar(raw) -> Optional[Dict[str, Any]]:
+    """Columnar wire form of a get_neighbors reply (ISSUE 2): when the
+    scan is single-edge-type with int vids and schema-uniform prop rows
+    — the GO/MATCH bulk shape — ship src/rank/dst/sd and each prop as
+    ONE typed blob instead of one JSON row per edge.  Row order is
+    preserved column-wise.  Returns None for small or mixed replies
+    (legacy row encoding)."""
+    n = len(raw)
+    if n < 64:
+        return None
+    from ..core.wire import encode_column
+    et0 = raw[0][1]
+    keys0 = tuple(raw[0][4])
+    for (_, et, _, _, props, _) in raw:
+        if et is not et0 and et != et0:
+            return None
+        if tuple(props) != keys0:
+            return None                   # mixed schema versions: rows
+    src = encode_column([r[0] for r in raw])
+    dst = encode_column([r[3] for r in raw])
+    if src is None or dst is None or src["dt"] != "<i8" \
+            or dst["dt"] != "<i8":
+        return None                       # string vids: legacy rows
+    rank = encode_column([r[2] for r in raw])
+    sd = encode_column([r[5] for r in raw])
+    if rank is None or sd is None:
+        return None
+    pcols: Dict[str, Any] = {}
+    for i, k in enumerate(keys0):
+        col = [r[4][k] for r in raw]
+        enc = encode_column(col)
+        pcols[k] = enc if enc is not None \
+            else {"v": [to_wire(x) for x in col]}
+    return {"cols": True, "n": n, "et": et0, "src": src, "rank": rank,
+            "dst": dst, "sd": sd, "props": pcols}
+
+
 class StorageService:
     def __init__(self, my_addr: str, meta: MetaClient, data_dir: str,
                  server: RpcServer):
@@ -389,8 +426,14 @@ class StorageService:
                 it = apply_edge_filter(it, space, edge_filter, etype_ids,
                                        limit,
                                        stats_prefix="storage_pushdown")
+            raw = list(it)
+            cols = _neighbors_columnar(raw)
+            if cols is not None:
+                if sp_rec is not None:
+                    sp_rec.setdefault("attrs", {})["rows"] = cols["n"]
+                return cols
             rows = []
-            for (src, et, rank, other, props, sd) in it:
+            for (src, et, rank, other, props, sd) in raw:
                 rows.append([to_wire(src), et, rank, to_wire(other),
                              {k: to_wire(v) for k, v in props.items()},
                              sd])

@@ -9,8 +9,10 @@ registry.
 from __future__ import annotations
 
 import itertools
+import threading
 import time
-from typing import Any, Dict, Optional
+from collections import OrderedDict
+from typing import Any, Dict, Optional, Tuple
 
 from ..core.value import DataSet
 from ..graphstore.store import GraphStore
@@ -23,6 +25,82 @@ from .scheduler import ProfileStats, Scheduler
 
 _session_ids = itertools.count(1)
 _query_ids = itertools.count(1)
+
+from ..utils.config import define_flag as _define_flag
+
+_define_flag("plan_cache_size", 128,
+             "parsed-plan LRU entries per engine (0 disables); keyed by "
+             "(statement text, space, schema epoch) — DDL bumps the "
+             "epoch, so stale plans can never hit")
+
+# read-only statement kinds whose plans are reusable verbatim: planning
+# depends only on (text, space, catalog) for these.  DML/DDL/admin
+# statements are cheap to plan and carry side-effect nodes — never
+# cached.
+_CACHEABLE_KINDS = frozenset({
+    "Go", "Match", "Lookup", "FetchVertices", "FetchEdges", "Yield",
+    "FindPath", "GetSubgraph", "GroupBy", "Unwind"})
+
+
+class PlanCache:
+    """LRU of (statement text, space, schema epoch, device flag) →
+    (parsed stmt, optimized plan).  Plans are reusable because nothing
+    mutates PlanNodes after optimize() (executors read args; all
+    per-run state lives in the ExecutionContext), and the schema epoch
+    in the key makes DDL invalidation automatic — ALTER/CREATE TAG or
+    index DDL bumps the catalog version, so every cached plan built
+    against the old schema simply stops matching and ages out of the
+    LRU.  `plan_cache_hits` / `plan_cache_misses` counters and the
+    `plan_cache_entries` gauge land in /metrics (docs/OBSERVABILITY.md).
+    """
+
+    def __init__(self):
+        self._map: "OrderedDict[Tuple, Tuple[Any, Any]]" = OrderedDict()
+        self._lock = threading.Lock()
+
+    @staticmethod
+    def capacity() -> int:
+        from ..utils.config import get_config
+        try:
+            return int(get_config().get("plan_cache_size"))
+        except Exception:  # noqa: BLE001 — config not initialized
+            return 0
+
+    def get(self, key: Tuple):
+        from ..utils.stats import stats
+        with self._lock:
+            ent = self._map.get(key)
+            if ent is not None:
+                self._map.move_to_end(key)
+        if ent is not None:
+            stats().inc("plan_cache_hits")
+        return ent
+
+    def put(self, key: Tuple, stmt, plan):
+        cap = self.capacity()
+        if cap <= 0:
+            return
+        from ..utils.stats import stats
+        # a put IS the miss: counting at insert time keeps the miss
+        # counter scoped to CACHEABLE statements — bulk INSERT/DDL
+        # traffic (looked up, never inserted) must not read as a bad
+        # hit rate in /metrics
+        stats().inc("plan_cache_misses")
+        with self._lock:
+            self._map[key] = (stmt, plan)
+            self._map.move_to_end(key)
+            while len(self._map) > cap:
+                self._map.popitem(last=False)
+            n = len(self._map)
+        stats().gauge("plan_cache_entries", n)
+
+    def clear(self):
+        with self._lock:
+            self._map.clear()
+
+    def __len__(self):
+        with self._lock:
+            return len(self._map)
 
 
 class Session:
@@ -55,6 +133,9 @@ class QueryEngine:
         self._slow_override = (params or {}).get("slow_query_threshold_us")
         self.slow_log: list = []
         self.sessions: Dict[int, Session] = {}
+        # parse/plan LRU (ISSUE 2): repeated statements skip
+        # parse → validate → plan → optimize entirely
+        self.plan_cache = PlanCache()
 
     def new_session(self, user: str = "root") -> Session:
         # reap idle sessions so a long-lived embedded engine doesn't
@@ -114,6 +195,21 @@ class QueryEngine:
         from ..utils.config import get_config
         return int(get_config().get("slow_query_threshold_us"))
 
+    def _cache_key(self, session: Session, text: str) -> Optional[tuple]:
+        """Plan-cache key for this statement in this session's context,
+        or None when caching cannot apply: $var state makes planning
+        session-dependent, and a zero-capacity cache is disabled.  The
+        schema epoch (catalog version — bumped by EVERY DDL, including
+        ALTER/CREATE TAG and index DDL) and the live device flag are
+        part of the key, so invalidation is structural, not evented."""
+        if PlanCache.capacity() <= 0 or session.var_cols:
+            return None
+        from ..utils.config import get_config
+        tpu_on = self.qctx.tpu_runtime is not None and \
+            bool(get_config().get("tpu_enable"))
+        epoch = getattr(self.qctx.catalog, "version", 0)
+        return (text, session.space, epoch, tpu_on)
+
     def execute(self, session: Session, text: str,
                 params: Optional[Dict[str, Any]] = None) -> ResultSet:
         t0 = time.perf_counter()
@@ -123,6 +219,13 @@ class QueryEngine:
             return rs
         session.last_used = time.time()
         from ..utils.stats import stats
+        key = self._cache_key(session, text)
+        if key is not None:
+            ent = self.plan_cache.get(key)
+            if ent is not None:
+                stmt, plan = ent
+                return self._execute_parsed(session, stmt, text, t0,
+                                            cached_plan=plan)
         try:
             stmt = parse(text)
         except ParseError as ex:
@@ -141,7 +244,8 @@ class QueryEngine:
                 if not res.ok:
                     return res
             return res
-        return self._execute_parsed(session, stmt, text, t0)
+        return self._execute_parsed(session, stmt, text, t0,
+                                    cache_key=key)
 
     @staticmethod
     def _stmt_kind(stmt: A.Sentence) -> str:
@@ -154,7 +258,8 @@ class QueryEngine:
             else name
 
     def _execute_parsed(self, session: Session, stmt: A.Sentence,
-                        text: str, t0: float) -> ResultSet:
+                        text: str, t0: float, cached_plan=None,
+                        cache_key: Optional[tuple] = None) -> ResultSet:
         """Metrics + tracing wrapper: every statement outcome (incl.
         semantic and execution errors) is visible in /stats; every
         statement produces one trace in the trace store, queryable via
@@ -169,9 +274,11 @@ class QueryEngine:
                                    stmt=text[:200], session=session.id)
         if tg is not None:
             with tg:
-                res = self._execute_inner(session, stmt, text, t0)
+                res = self._execute_inner(session, stmt, text, t0,
+                                          cached_plan, cache_key)
         else:
-            res = self._execute_inner(session, stmt, text, t0)
+            res = self._execute_inner(session, stmt, text, t0,
+                                      cached_plan, cache_key)
         us = int((time.perf_counter() - t0) * 1e6)
         stats().inc("num_queries")
         stats().add_value("query_latency_us", us)
@@ -187,7 +294,8 @@ class QueryEngine:
         return res
 
     def _execute_inner(self, session: Session, stmt: A.Sentence,
-                       text: str, t0: float) -> ResultSet:
+                       text: str, t0: float, cached_plan=None,
+                       cache_key: Optional[tuple] = None) -> ResultSet:
         from ..utils.config import get_config
         if get_config().get("enable_authorize"):
             from .permissions import check as _perm_check
@@ -212,25 +320,39 @@ class QueryEngine:
         else:
             inner = stmt
 
-        try:
-            pctx = PlannerContext(self.qctx, session.space)
-            pctx.var_cols.update(session.var_cols)
-            from ..query.validator import ValidationError, validate
+        pctx = None
+        if cached_plan is not None:
+            # plan-cache hit: parse/validate/plan/optimize all skipped;
+            # the plan is read-only at execution time (per-run state
+            # lives in the statement's ExecutionContext), so reuse is
+            # verbatim
+            plan = cached_plan
+        else:
             try:
-                validate(inner, pctx)
-            except ValidationError as ex:
+                pctx = PlannerContext(self.qctx, session.space)
+                pctx.var_cols.update(session.var_cols)
+                from ..query.validator import ValidationError, validate
+                try:
+                    validate(inner, pctx)
+                except ValidationError as ex:
+                    return ResultSet(error=f"SemanticError: {ex}")
+                from ..query.planner import _plan
+                root = _plan(pctx, inner)
+                from ..query.plan import ExecutionPlan
+                plan = ExecutionPlan(root, pctx.space)
+                from ..utils.config import get_config
+                plan = optimize(plan, enable=self.enable_optimizer,
+                                tpu=self.qctx.tpu_runtime is not None
+                                and bool(get_config().get("tpu_enable")),
+                                pctx=pctx)
+            except QueryError as ex:
                 return ResultSet(error=f"SemanticError: {ex}")
-            from ..query.planner import _plan
-            root = _plan(pctx, inner)
-            from ..query.plan import ExecutionPlan
-            plan = ExecutionPlan(root, pctx.space)
-            from ..utils.config import get_config
-            plan = optimize(plan, enable=self.enable_optimizer,
-                            tpu=self.qctx.tpu_runtime is not None
-                            and bool(get_config().get("tpu_enable")),
-                            pctx=pctx)
-        except QueryError as ex:
-            return ResultSet(error=f"SemanticError: {ex}")
+            if cache_key is not None and not explain_only \
+                    and profile_stats is None and not pctx.var_cols \
+                    and self._stmt_kind(stmt) in _CACHEABLE_KINDS:
+                # the parsed stmt rides along for the per-execute
+                # permission check and the metrics kind label
+                self.plan_cache.put(cache_key, stmt, plan)
 
         if explain_only:
             us = int((time.perf_counter() - t0) * 1e6)
@@ -270,7 +392,8 @@ class QueryEngine:
                                      if k.startswith("$")})
 
         session.space = plan.space
-        session.var_cols.update(pctx.var_cols)
+        if pctx is not None:
+            session.var_cols.update(pctx.var_cols)
         us = int((time.perf_counter() - t0) * 1e6)
         plan_desc = None
         if profile_stats is not None:

@@ -798,6 +798,8 @@ def _filter(node, qctx, ectx, space):
 
 @executor("Project")
 def _project(node, qctx, ectx, space):
+    from ..core.expr import InputProp, LabelExpr
+    from ..core.value import ColumnarDataSet
     a = node.args
     ds = _input(node, ectx)
     if a.get("empty"):
@@ -805,6 +807,22 @@ def _project(node, qctx, ectx, space):
     cols: List[Tuple[Expr, str]] = a["columns"]
     names = [n for _, n in cols]
     schema_alias = a.get("schema") if a.get("lookup_row") else None
+    if isinstance(ds, ColumnarDataSet) and ds._cols is not None \
+            and schema_alias is None:
+        # bare column selection over a columnar input stays columnar —
+        # the GO/MATCH bulk path never boxes per-row values just to
+        # rename/reorder columns (RowContext would return row[name]
+        # verbatim for these expression shapes)
+        sel = []
+        for e, _ in cols:
+            if isinstance(e, (InputProp, LabelExpr)) \
+                    and e.name in ds.column_names:
+                sel.append(ds._cols[ds.col_index(e.name)])
+            else:
+                sel = None
+                break
+        if sel is not None:
+            return ColumnarDataSet(names, sel)
     rows = []
     src_rows = ds.rows
     if not ds.column_names and not ds.rows:
@@ -975,9 +993,16 @@ def _topn(node, qctx, ectx, space):
 
 @executor("Limit")
 def _limit(node, qctx, ectx, space):
+    from ..core.value import ColumnarDataSet
     ds = _input(node, ectx)
     off = node.args.get("offset", 0)
     cnt = node.args.get("count", -1)
+    if isinstance(ds, ColumnarDataSet) and ds._cols is not None:
+        # columnar input (device GO results): slice the numpy columns —
+        # LIMIT over a million-row result never boxes the dropped rows
+        end = None if cnt is None or cnt < 0 else off + cnt
+        return ColumnarDataSet(list(ds.column_names),
+                               [c[off:end] for c in ds._cols])
     rows = ds.rows[off:] if cnt is None or cnt < 0 else ds.rows[off:off + cnt]
     return DataSet(list(ds.column_names), rows)
 

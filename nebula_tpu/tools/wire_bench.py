@@ -1,0 +1,109 @@
+"""wire-bench — microbenchmark of the columnar wire codec (ISSUE 2).
+
+Times the three legs a columnar result pays between the engine and a
+client, on synthetic data shaped like the north-star GO result (int64
+dst + int64 w columns):
+
+  encode      to_wire(ColumnarDataSet) — must be O(1) per numeric
+              column (a memoryview of the numpy buffer, no copy)
+  decode      from_wire of the encoded form (np.frombuffer, zero-copy)
+  roundtrip   a real RPC round trip over localhost through the
+              pipelined client (frame build, socket, recv_into, blob
+              graft) — the `client_wire_ms` of bench.py config 6, in
+              isolation
+
+Also times the row-form DataSet columnar fast path (type-scan +
+np.array) against the per-cell JSON encoding it replaces, so a
+regression in either path shows up as a ratio, not a feeling.
+
+    python -m nebula_tpu.tools.wire_bench [--rows 2000000] [--repeat 5]
+
+Emits one JSON object on stdout (CI-diffable, like bench.py's
+BENCH_DETAIL).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import statistics
+import time
+
+
+def _t(fn, repeat: int) -> float:
+    """Median seconds of fn() over `repeat` runs (first run warms)."""
+    fn()
+    lat = []
+    for _ in range(repeat):
+        t0 = time.perf_counter()
+        fn()
+        lat.append(time.perf_counter() - t0)
+    return statistics.median(lat)
+
+
+def run(rows: int, repeat: int) -> dict:
+    import numpy as np
+
+    from ..cluster.rpc import RpcClient, RpcServer
+    from ..core import wire
+    from ..core.value import ColumnarDataSet, DataSet
+
+    d = (np.arange(rows, dtype=np.int64) * 2654435761) & 0x7FFFFFFF
+    w = np.arange(rows, dtype=np.int64) % 100
+    cds = ColumnarDataSet(["d", "w"], [d, w])
+    nbytes = int(d.nbytes + w.nbytes)
+
+    enc_s = _t(lambda: wire.to_wire(
+        ColumnarDataSet(["d", "w"], [d, w])), repeat)
+    encoded = wire.to_wire(cds)
+    dec_s = _t(lambda: wire.from_wire(encoded), repeat)
+
+    srv = RpcServer()
+    srv.register("result", lambda p: {"data": wire.to_wire(
+        ColumnarDataSet(["d", "w"], [d, w]))})
+    srv.start()
+    cl = RpcClient(srv.host, srv.port, timeout=120.0)
+    try:
+        rt_s = _t(lambda: wire.from_wire(cl.call("result")["data"]),
+                  repeat)
+    finally:
+        cl.close()
+        srv.stop()
+
+    # row-form fast path vs the per-cell encoding it replaces
+    row_rows = min(rows, 200_000)
+    ds_rows = [[int(a), int(b)] for a, b in
+               zip(d[:row_rows].tolist(), w[:row_rows].tolist())]
+    rowds = DataSet(["d", "w"], ds_rows)
+    col_s = _t(lambda: wire.to_wire(rowds), repeat)
+    percell_s = _t(lambda: {"@t": "dataset", "cols": ["d", "w"],
+                            "rows": [[wire.to_wire(c) for c in r]
+                                     for r in ds_rows]}, repeat)
+
+    got = wire.from_wire(wire.to_wire(cds))
+    assert np.array_equal(np.asarray(got.column_array("d")), d)
+
+    return {
+        "rows": rows,
+        "payload_mb": round(nbytes / 1e6, 1),
+        "encode_ms": round(enc_s * 1e3, 3),
+        "decode_ms": round(dec_s * 1e3, 3),
+        "roundtrip_ms": round(rt_s * 1e3, 2),
+        "roundtrip_gbps": round(nbytes / rt_s / 1e9, 2),
+        "rowform_rows": row_rows,
+        "rowform_columnar_ms": round(col_s * 1e3, 2),
+        "rowform_percell_ms": round(percell_s * 1e3, 2),
+        "rowform_speedup": round(percell_s / col_s, 2) if col_s else None,
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--rows", type=int, default=2_000_000)
+    ap.add_argument("--repeat", type=int, default=5)
+    args = ap.parse_args(argv)
+    print(json.dumps(run(args.rows, args.repeat), indent=1))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,176 @@
+"""ISSUE 2 plan cache: repeated statements skip parse → validate →
+plan → optimize; DDL (schema + index) bumps the schema epoch and makes
+every stale plan unreachable."""
+import pytest
+
+from nebula_tpu.exec.engine import QueryEngine, quick_engine
+from nebula_tpu.utils.stats import stats
+
+
+def _counts():
+    snap = stats().snapshot()
+    return (snap.get("plan_cache_hits", 0),
+            snap.get("plan_cache_misses", 0))
+
+
+@pytest.fixture()
+def eng_sess():
+    eng, s = quick_engine()
+    for q in ("CREATE SPACE pc(partition_num=2, vid_type=INT64)",
+              "USE pc", "CREATE TAG Person(age int)",
+              "CREATE EDGE KNOWS(w int)"):
+        r = eng.execute(s, q)
+        assert r.error is None, (q, r.error)
+    r = eng.execute(s, "INSERT VERTEX Person(age) VALUES "
+                       "1:(30), 2:(25), 3:(41), 4:(19)")
+    assert r.error is None, r.error
+    r = eng.execute(s, "INSERT EDGE KNOWS(w) VALUES 1->2:(5), 2->3:(50), "
+                       "3->4:(9), 1->3:(80)")
+    assert r.error is None, r.error
+    return eng, s
+
+
+def test_hit_skips_parse_and_plan(eng_sess, monkeypatch):
+    eng, s = eng_sess
+    q = "GO FROM 1 OVER KNOWS YIELD dst(edge) AS d, KNOWS.w AS w"
+    r1 = eng.execute(s, q)
+    assert r1.error is None
+    h0, _ = _counts()
+
+    # a cache hit must not touch the parser or the planner at all
+    import nebula_tpu.exec.engine as E
+
+    def bomb(*a, **kw):
+        raise AssertionError("parse() called on a plan-cache hit")
+
+    monkeypatch.setattr(E, "parse", bomb)
+    r2 = eng.execute(s, q)
+    h1, _ = _counts()
+    assert r2.error is None
+    assert h1 == h0 + 1
+    assert sorted(map(tuple, r2.data.rows)) == \
+        sorted(map(tuple, r1.data.rows))
+
+
+def test_ddl_bumps_epoch_and_invalidates(eng_sess):
+    eng, s = eng_sess
+    q = "GO FROM 1 OVER KNOWS YIELD dst(edge) AS d"
+    eng.execute(s, q)
+    eng.execute(s, q)
+    h0, _ = _counts()
+
+    # ALTER TAG is DDL: schema epoch bumps, the cached plan goes stale
+    ver0 = eng.qctx.catalog.version
+    r = eng.execute(s, "ALTER TAG Person ADD (name string)")
+    assert r.error is None
+    assert eng.qctx.catalog.version > ver0
+    eng.execute(s, q)                   # must be a MISS (replan)
+    h1, _ = _counts()
+    assert h1 == h0, "stale plan served after ALTER TAG"
+    eng.execute(s, q)                   # fresh entry hits again
+    h2, _ = _counts()
+    assert h2 == h1 + 1
+
+    # CREATE TAG and index DDL bump too
+    for ddl in ("CREATE TAG Post(ts int)",
+                "CREATE TAG INDEX i_age ON Person(age)",
+                "REBUILD TAG INDEX i_age"):
+        before = eng.qctx.catalog.version
+        r = eng.execute(s, ddl)
+        assert r.error is None, (ddl, r.error)
+        if "REBUILD" not in ddl:
+            assert eng.qctx.catalog.version > before, ddl
+        eng.execute(s, q)               # miss after each DDL epoch bump
+    h3, _ = _counts()
+    assert h3 == h2 + 1                 # only the pre-CREATE hit above
+
+
+def test_stale_plan_regression_index_ddl(eng_sess):
+    """The stale-plan failure mode index DDL can cause: a LOOKUP planned
+    before CREATE INDEX must not keep serving the index-less plan after
+    the index exists — the epoch key forces a replan that picks the
+    index up."""
+    eng, s = eng_sess
+    q = "MATCH (p:Person) WHERE p.Person.age > 24 " \
+        "RETURN id(p) AS v ORDER BY v"
+    r1 = eng.execute(s, q)
+    assert r1.error is None
+    key_before = [k for k in eng.plan_cache._map if k[0] == q]
+    assert key_before, "read-only MATCH was not cached"
+    plan_before = eng.plan_cache._map[key_before[0]][1]
+
+    for ddl in ("CREATE TAG INDEX i_age2 ON Person(age)",
+                "REBUILD TAG INDEX i_age2"):
+        r = eng.execute(s, ddl)
+        assert r.error is None, (ddl, r.error)
+    r2 = eng.execute(s, q)
+    assert r2.error is None
+    assert r2.data.rows == r1.data.rows == [[1], [2], [3]]
+    key_after = [k for k in eng.plan_cache._map if k[0] == q
+                 and k not in key_before]
+    assert key_after, "post-DDL execution did not create a fresh entry"
+    plan_after = eng.plan_cache._map[key_after[0]][1]
+    # the fresh plan uses the index the stale one could not know about
+    assert "IndexScan" in plan_after.root.kind_tree()
+    assert plan_after is not plan_before
+
+
+def test_non_cacheable_statements(eng_sess):
+    eng, s = eng_sess
+    n0 = len(eng.plan_cache)
+    # DML/DDL/compound/EXPLAIN never enter the cache
+    assert eng.execute(
+        s, "INSERT VERTEX Person(age) VALUES 9:(9)").error is None
+    assert eng.execute(
+        s, "EXPLAIN GO FROM 1 OVER KNOWS YIELD dst(edge)").error is None
+    assert eng.execute(
+        s, "YIELD 1 AS a; YIELD 2 AS b").error is None
+    assert len(eng.plan_cache) == n0
+
+    # $var sessions bypass the cache entirely (plans become
+    # session-dependent the moment var state exists)
+    r = eng.execute(s, "$v = GO FROM 1 OVER KNOWS YIELD dst(edge) AS d; "
+                       "GO FROM $v.d OVER KNOWS YIELD dst(edge) AS d2")
+    assert r.error is None
+    assert s.var_cols
+    h0, _ = _counts()
+    q = "GO FROM 2 OVER KNOWS YIELD dst(edge) AS d"
+    eng.execute(s, q)
+    eng.execute(s, q)
+    h1, _ = _counts()
+    assert h1 == h0, "cached despite live $var session state"
+
+
+def test_cache_disabled_by_flag(eng_sess, monkeypatch):
+    from nebula_tpu.utils.config import get_config
+    eng, s = eng_sess
+    get_config().set_dynamic("plan_cache_size", 0)
+    try:
+        q = "GO FROM 1 OVER KNOWS YIELD dst(edge) AS d"
+        h0, _ = _counts()
+        eng.execute(s, q)
+        eng.execute(s, q)
+        h1, _ = _counts()
+        assert h1 == h0
+        assert len(eng.plan_cache) == 0
+    finally:
+        get_config().set_dynamic("plan_cache_size", 128)
+
+
+def test_space_isolation(eng_sess):
+    """Same text in a different space must not hit the other space's
+    plan (space is part of the key)."""
+    eng, s = eng_sess
+    q = "GO FROM 1 OVER KNOWS YIELD dst(edge) AS d"
+    r1 = eng.execute(s, q)
+    assert r1.error is None
+    for ddl in ("CREATE SPACE pc2(partition_num=2, vid_type=INT64)",
+                "USE pc2", "CREATE TAG Person(age int)",
+                "CREATE EDGE KNOWS(w int)",
+                "INSERT VERTEX Person(age) VALUES 1:(1), 7:(7)",
+                "INSERT EDGE KNOWS(w) VALUES 1->7:(1)"):
+        r = eng.execute(s, ddl)
+        assert r.error is None, (ddl, r.error)
+    r2 = eng.execute(s, q)
+    assert r2.error is None
+    assert sorted(r[0] for r in r2.data.rows) == [7]
