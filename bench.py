@@ -830,11 +830,28 @@ def main():
             "vertices": wn, "edges": int(wsrc.size),
             "vertex_inserts_per_s": round(wn / v_s, 1),
             "edge_inserts_per_s": round(wsrc.size / e_s, 1),
+            # the BENCH headline for the write path (ISSUE 3): all
+            # inserted rows over the whole cluster write-path wall time
+            "insert_rows_per_sec": round((wn + int(wsrc.size))
+                                         / (v_s + e_s), 1),
             "batch_rows": B, "readback_rows": len(got_pairs),
             "identical_rows": True,
         }
     finally:
         wc.stop()
+    # group-commit A/B (ISSUE 3): per-command vs grouped proposals at
+    # the same durability (sync WAL, 3-node raft) — the isolated
+    # consensus-layer speedup behind insert_rows_per_sec
+    _mark("config write: group-commit A/B (write_bench)")
+    from nebula_tpu.tools.write_bench import run as _write_bench
+    wb = _write_bench(entries=256, n_nodes=3)
+    configs["write_raft_toss"].update({
+        "percmd_proposals_per_s": wb["per_command_eps"],
+        "grouped64_proposals_per_s": wb["grouped_64_eps"],
+        "grouped_vs_percmd_64": wb["grouped_64_speedup"],
+        "grouped_vs_percmd_512": wb["grouped_512_speedup"],
+        "wal_batch_speedup": wb["wal_batch_speedup"],
+    })
     _save_partial(platform, configs)
 
     # VERDICT r3 item 2: the driver tails stdout into a small buffer, so

@@ -20,9 +20,23 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from ..utils.config import define_flag, get_config
 from .wal import Wal
 
 FOLLOWER, CANDIDATE, LEADER = "follower", "candidate", "leader"
+
+define_flag("raft_max_batch", 64,
+            "max entries per append_entries round (also the per-round "
+            "unit of transfer_leadership catch-up); the group-commit "
+            "replication batch ceiling")
+
+# raft_commit_latency_ms buckets (milliseconds — consensus rounds, not
+# the µs RPC scale of LATENCY_BUCKETS_US)
+COMMIT_LATENCY_BUCKETS_MS = (0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0,
+                             100.0, 250.0, 500.0, 1_000.0, 5_000.0)
+# raft_replication_batch_size buckets (entries per append_entries round)
+REPL_BATCH_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0,
+                      256.0, 512.0, 1_024.0)
 
 
 class RaftTransport:
@@ -345,7 +359,7 @@ class RaftPart:
                 # the transport at full speed)
                 if ok and self.alive and self.state == LEADER and \
                         self.next_index.get(peer, 1 << 62) <= \
-                        self.wal.last_index():
+                        self.wal.synced_index():
                     continue
                 self._repl_cv.wait(self.hb)
 
@@ -363,9 +377,18 @@ class RaftPart:
                 prev_term = self.snap_term
             else:
                 prev_term = self.wal.term_of(prev_idx) or 0
+            max_batch = max(1, int(get_config().get("raft_max_batch")))
+            # clamp to the durable index: a follower must never hold an
+            # entry this leader could still lose to a crash (group
+            # commit defers the leader's fsync; see Wal.sync_to)
+            end = min(nxt + max_batch - 1, self.wal.synced_index())
             entries = [(i, t, _b64(d)) for (i, t, d)
-                       in self.wal.read_range(nxt, nxt + 63)]
+                       in self.wal.read_range(nxt, end)]
             commit = self.commit_index
+        if entries:
+            from ..utils.stats import stats as _metrics
+            _metrics().observe("raft_replication_batch_size",
+                               len(entries), buckets=REPL_BATCH_BUCKETS)
         t_send = time.monotonic()
         r = self.transport.send(peer, self.group, "append_entries", {
             "_from": self.node_id, "term": term, "leader": self.node_id,
@@ -417,7 +440,14 @@ class RaftPart:
         with self.lock:
             if self.state != LEADER:
                 return
-            for n in range(self.wal.last_index(), self.commit_index, -1):
+            # never past the DURABLE index: the leader's own vote only
+            # counts for fsynced entries (with peers the match_index
+            # cap enforces this implicitly — replication is clamped to
+            # synced_index — but a no-peers group has no such cap, and
+            # a sibling proposer's flushed-but-unsynced tail must not
+            # commit off the heartbeat tick)
+            top = min(self.wal.last_index(), self.wal.synced_index())
+            for n in range(top, self.commit_index, -1):
                 if self.wal.term_of(n) != self.current_term:
                     break               # §5.4.2: only current-term entries
                 cnt = 1 + sum(1 for p in self.peers
@@ -498,7 +528,12 @@ class RaftPart:
             if self.state != LEADER or target not in self.peers:
                 return False
             term = self.current_term
-        for _ in range(64):
+        # bounded catch-up with a CONSTANT entry budget (~4096, the
+        # pre-knob 64×64): rounds scale inversely with raft_max_batch
+        # so tuning the batch size down doesn't quietly shrink how far
+        # behind a transfer target may be
+        mb = max(1, int(get_config().get("raft_max_batch")))
+        for _ in range(max(8, (4096 + mb - 1) // mb)):
             self._replicate_one(target)
             with self.lock:
                 if self.state != LEADER or self.current_term != term:
@@ -547,28 +582,71 @@ class RaftPart:
         """Append + replicate + wait for commit.  Returns the entry's log
         index (truthy) on commit; None if not leader or timed out (caller
         retries against the current leader)."""
+        idxs = self.propose_batch([data], timeout=timeout)
+        return idxs[-1] if idxs else None
+
+    def propose_batch(self, datas: List[bytes],
+                      timeout: float = 5.0) -> Optional[List[int]]:
+        """Group commit: append ALL entries under one lock hold, pay one
+        (coalesced) WAL sync and one replication wake for the whole
+        batch, and wait for the last entry's commit.  Returns the log
+        indices on commit; None if not leader or timed out (caller
+        retries against the current leader — per-entry apply outcomes
+        are the state machine's business, see storage_service).
+
+        Concurrent callers coalesce twice: the WAL group sync
+        (Wal.sync_to — one fsync covers every batch flushed before it
+        started) and the replication round (followers receive all
+        pending entries of all callers in one append_entries, capped by
+        raft_max_batch).  Commit waiters wake by index off commit_cv."""
         from ..utils.stats import stats as _metrics
+        if not datas:
+            return []
+        t0 = time.monotonic()
         with self.lock:
             if not self.alive or self.state != LEADER:
                 return None
-            idx = self.wal.last_index() + 1
-            self.wal.append(idx, self.current_term, data)
-            if not self.peers:
-                self.commit_index = idx
-                self.commit_cv.notify_all()
-        _metrics().inc("raft_appends")
+            term = self.current_term
+            idx0 = self.wal.last_index() + 1
+            entries = [(idx0 + j, term, d) for j, d in enumerate(datas)]
+            # buffered write only — the fsync happens OUTSIDE the part
+            # lock so sibling proposers can stage entries meanwhile
+            self.wal.append_batch(entries, sync=False)
+            last = entries[-1][0]
+        self.wal.sync_to(last)          # group fsync (shared with siblings)
+        with self.lock:
+            if not self.peers and self.state == LEADER:
+                # single-node group: durable == committed — advance to
+                # the SYNCED index only (a sibling's flushed-but-not-
+                # fsynced tail must not commit off our fsync)
+                durable = self.wal.synced_index()
+                if self.commit_index < durable:
+                    self.commit_index = durable
+                    self.commit_cv.notify_all()
+        _metrics().inc("raft_appends", len(entries))
+        _metrics().inc("raft_propose_batches")
         self._replicate_all()
         deadline = time.monotonic() + timeout
         with self.lock:
-            while self.commit_index < idx:
+            while self.commit_index < last:
                 left = deadline - time.monotonic()
                 if left <= 0 or not self.alive or self.state != LEADER:
                     return None
                 self.commit_cv.wait(left)
+            # a deposal + truncation + foreign recommit can land while
+            # waiting (the loop tolerates losing-then-regaining
+            # leadership — the entry survives in OUR log across that):
+            # ack only if the tail index still holds OUR term's entry
+            t_last = self.wal.term_of(last)
+            if t_last is not None and t_last != term:
+                return None
         # serve-after-commit: apply before returning so leader reads see it
         self._apply_committed()
-        _metrics().inc("raft_commits")
-        return idx
+        _metrics().inc("raft_commits", len(entries))
+        _metrics().observe("raft_commit_latency_ms",
+                           (time.monotonic() - t0) * 1e3,
+                           buckets=COMMIT_LATENCY_BUCKETS_MS)
+        return [i for (i, _, _) in entries]
 
     # -- RPC handlers -----------------------------------------------------
 
@@ -627,8 +705,16 @@ class RaftPart:
                     self.wal.truncate_from(prev_idx)
                     return {"term": self.current_term, "ok": False,
                             "hint": max(self.snap_index, prev_idx - 1)}
-            appended = 0
+            # collect the suffix to append, then write it as ONE batch
+            # (one buffered write + one fsync — the follower half of
+            # group commit; `append` per entry was one fsync each).
+            # Entries are contiguous ascending, so once the first new
+            # index is found nothing after it can already exist.
+            to_append: List[Tuple[int, int, bytes]] = []
             for (idx, term, d64) in p["entries"]:
+                if to_append:
+                    to_append.append((idx, term, _unb64(d64)))
+                    continue
                 have = self.wal.term_of(idx)
                 if have is not None:
                     if have != term:
@@ -637,11 +723,11 @@ class RaftPart:
                         continue
                 if idx <= self.snap_index:
                     continue
-                self.wal.append(idx, term, _unb64(d64))
-                appended += 1
-            if appended:
+                to_append.append((idx, term, _unb64(d64)))
+            if to_append:
+                self.wal.append_batch(to_append)
                 from ..utils.stats import stats as _metrics
-                _metrics().inc("raft_appends", appended)
+                _metrics().inc("raft_appends", len(to_append))
             if p["leader_commit"] > self.commit_index:
                 self.commit_index = min(p["leader_commit"],
                                         self.wal.last_index())

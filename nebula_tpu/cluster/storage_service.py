@@ -31,6 +31,44 @@ _STORAGE_OPS = frozenset({
     "chain_mark", "chain_done", "batch", "clear_part"})
 
 
+class BoundedErrorMap:
+    """(group, idx) → apply-error string, bounded with insertion-order
+    eviction.
+
+    The consumer contract is pop-on-ack (rpc_write claims its indices'
+    errors after propose returns), but a propose that TIMES OUT returns
+    None while its entry can still commit and fail apply later — that
+    error is never claimed.  An unbounded dict therefore leaks one
+    entry per timed-out-then-failed write for the life of the process
+    (ISSUE 3 satellite); this map evicts the oldest records past `cap`
+    instead."""
+
+    def __init__(self, cap: int = 1024):
+        from collections import OrderedDict
+        self.cap = cap
+        self._d: "OrderedDict[Tuple[str, int], str]" = OrderedDict()
+        self._lock = threading.Lock()
+
+    def record(self, key: Tuple[str, int], err: str):
+        with self._lock:
+            self._d.pop(key, None)
+            self._d[key] = err
+            while len(self._d) > self.cap:
+                self._d.popitem(last=False)
+
+    def pop(self, key: Tuple[str, int], default=None):
+        with self._lock:
+            return self._d.pop(key, default)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._d)
+
+    def __contains__(self, key) -> bool:
+        with self._lock:
+            return key in self._d
+
+
 def _validate_cmd(cmd) -> tuple:
     """Decode-check a client write command BEFORE it reaches consensus —
     a malformed entry must be rejected at the RPC boundary, never
@@ -97,8 +135,9 @@ class StorageService:
         self._resume_thread: Optional[threading.Thread] = None
         # (group, idx) → error string for entries whose apply failed;
         # checked by rpc_write so a client is never acked for a write
-        # that did not actually land
-        self._apply_errors: Dict[Tuple[str, int], str] = {}
+        # that did not actually land.  Bounded: a timed-out propose
+        # never claims its error (see BoundedErrorMap).
+        self._apply_errors = BoundedErrorMap()
         self.transport = RpcRaftTransport()
         self.server = server
         server.service_role = "storaged"
@@ -236,10 +275,7 @@ class StorageService:
             except Exception as ex:      # noqa: BLE001
                 from ..utils.stats import stats
                 stats().inc("storage_apply_errors")
-                self._apply_errors[(group, idx)] = str(ex)
-                if len(self._apply_errors) > 4096:
-                    for k in sorted(self._apply_errors)[:2048]:
-                        self._apply_errors.pop(k, None)
+                self._apply_errors.record((group, idx), str(ex))
         return apply
 
     def _apply_cmd(self, space: str, cmd: Tuple):
@@ -318,7 +354,15 @@ class StorageService:
     def _resume_chains(self):
         """Finish TOSS chains whose graphd died between the two halves:
         the out-half part leader re-drives the recorded in-half to the
-        dst part, then retires the journal entry through its own log."""
+        dst part, then retires the journal entry through its own log.
+
+        Batched chains (ISSUE 3: dstore coalesces one chain per
+        (src_pid, dst_pid) pair) journal their in-half as a single
+        `batch` command covering every edge of the pair — re-driving it
+        is idempotent per edge (same-row overwrite), so a chain the
+        graphd actually finished, or a janitor pass that raced another
+        replica's, converges to the same state.  The chain_done
+        retirements for one part ride ONE batched proposal."""
         import time as _t
         from .storage_client import StorageClient
         with self.parts_lock:
@@ -332,6 +376,7 @@ class StorageService:
                           if sp.space_id == sid), None)
             if space is None:
                 continue
+            done = []
             for cid, entry in self.store.pending_chains(space, pid).items():
                 if now - entry.get("ts", 0.0) < self.CHAIN_GRACE_S:
                     continue
@@ -342,7 +387,11 @@ class StorageService:
                 sc._call_part(space, entry["part"], "storage.write",
                               {"cmds": [to_wire(list(entry["cmd"]))],
                                "cat_ver": self.meta.version})
-                part.propose(wire.dumps(("chain_done", pid, cid)))
+                done.append(wire.dumps(("chain_done", pid, cid)))
+            if done:
+                part.propose_batch(done)
+                from ..utils.stats import stats
+                stats().inc("toss_chains_resumed", len(done))
 
     # -- helpers ----------------------------------------------------------
 
@@ -380,23 +429,32 @@ class StorageService:
             # is maintained against the schema the writer validated on
             self.meta.refresh(force=True)
         part = self._leader_part(space, pid, lease=False)
-        for cmd in p["cmds"]:
-            # cmds arrive wire-encoded; decode-validate BEFORE propose
-            # (a malformed command must fail here, not poison the log),
-            # then the raft entry stores the canonical wire form —
-            # version-stamped so FOLLOWERS apply against a catalog at
-            # least as new as the issuer's (the leader-only RPC check
-            # would leave replica index state stale until failover)
-            decoded = _validate_cmd(cmd)
-            stamped = ("v", max(cat_ver, self.meta.version),
-                       list(decoded))
-            with _trace.span("raft:propose", group=part.group):
-                idx = part.propose(wire.dumps(stamped))
-            if idx is None:
-                raise RpcError("part_leader_changed: write not committed")
-            err = self._apply_errors.pop((part.group, idx), None)
-            if err is not None:
-                raise RpcError(f"write apply failed: {err}")
+        # cmds arrive wire-encoded; decode-validate ALL of them BEFORE
+        # propose (a malformed command must fail the whole request up
+        # front, not poison the log or land after committed siblings),
+        # then the raft entries store the canonical wire form —
+        # version-stamped so FOLLOWERS apply against a catalog at
+        # least as new as the issuer's (the leader-only RPC check
+        # would leave replica index state stale until failover)
+        ver = max(cat_ver, self.meta.version)
+        stamped = [wire.dumps(("v", ver, list(_validate_cmd(cmd))))
+                   for cmd in p["cmds"]]
+        # ONE batched proposal for the request: one WAL sync + one
+        # replication wake for N commands (group commit, ISSUE 3)
+        with _trace.span("raft:propose_batch", group=part.group,
+                         entries=len(stamped)):
+            idxs = part.propose_batch(stamped)
+        if idxs is None:
+            raise RpcError("part_leader_changed: write not committed")
+        # per-entry apply semantics are unchanged: any command whose
+        # apply failed fails the request — a client is never acked for
+        # a write that did not actually land
+        errs = [e for e in (self._apply_errors.pop((part.group, i))
+                            for i in idxs) if e is not None]
+        if errs:
+            raise RpcError(f"write apply failed: {errs[0]}"
+                           + (f" (+{len(errs) - 1} more)"
+                              if len(errs) > 1 else ""))
         return len(p["cmds"])
 
     # -- read RPCs (leader reads) ----------------------------------------

@@ -1,0 +1,157 @@
+"""write-bench — microbenchmark of the group-commit write path (ISSUE 3;
+the write-path mirror of wire_bench.py).
+
+A/B of per-command vs grouped raft proposals over an in-process raft
+group with a SYNCHRONOUS WAL (identical durability on both sides — the
+comparison is fsync/replication amortization, not fsync removal):
+
+  per_command      propose() once per entry — one WAL sync + one
+                   replication round each (the pre-ISSUE-3 rpc_write
+                   loop shape)
+  grouped@B        propose_batch() in chunks of B entries — one lock
+                   hold, one (coalesced) fsync, one replication wake
+                   per chunk, for B in 1/8/64/512
+
+Also times the WAL legs in isolation (append-per-entry vs append_batch,
+both fsynced) so a regression in the log layer shows up separately
+from consensus.
+
+    python -m nebula_tpu.tools.write_bench [--entries 384] [--nodes 3]
+                                           [--payload 64] [--repeat 1]
+
+Emits one JSON object on stdout (CI-diffable, like wire_bench);
+bench.py folds the headline ratio into its `write_raft_toss` config.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import tempfile
+import time
+
+BATCH_SIZES = (1, 8, 64, 512)
+
+
+def _mk_cluster(tmp: str, n_nodes: int):
+    from ..cluster.raft import LoopbackTransport, RaftPart
+
+    tr = LoopbackTransport()
+    nodes = [f"n{i}" for i in range(n_nodes)]
+    parts = []
+    for nid in nodes:
+        parts.append(RaftPart(
+            "wb", nid, nodes, tr, os.path.join(tmp, nid),
+            apply_cb=lambda i, d: None,
+            election_timeout=(0.05, 0.12), heartbeat_interval=0.02,
+            wal_sync=True))
+    for p in parts:
+        p.start()
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        leaders = [p for p in parts if p.is_leader()]
+        if len(leaders) == 1:
+            return parts, leaders[0]
+        time.sleep(0.01)
+    raise RuntimeError("no leader elected")
+
+
+def _drive(parts, leader, payloads, batch: int) -> float:
+    """Seconds to commit all payloads at the given proposal batch size
+    (batch=0 → propose() per entry, the per-command baseline).  Retries
+    against the current leader on deposal (the propose contract) — an
+    election mid-run costs time, which is honest, not a crash."""
+    def commit(chunk):
+        nonlocal leader
+        deadline = time.monotonic() + 60
+        while True:
+            r = (leader.propose(chunk[0], timeout=30.0) if batch == 0
+                 else leader.propose_batch(chunk, timeout=30.0))
+            if r:
+                return
+            if time.monotonic() > deadline:
+                raise RuntimeError("no stable leader")
+            leader = next((p for p in parts if p.is_leader()), leader)
+            time.sleep(0.01)
+
+    t0 = time.perf_counter()
+    step = 1 if batch <= 1 else batch
+    for lo in range(0, len(payloads), step):
+        commit(payloads[lo:lo + step])
+    return time.perf_counter() - t0
+
+
+def _wal_legs(tmp: str, entries: int, payload: bytes) -> dict:
+    from ..cluster.wal import Wal
+
+    w1 = Wal(os.path.join(tmp, "percmd.wal"), sync=True)
+    t0 = time.perf_counter()
+    for i in range(1, entries + 1):
+        w1.append(i, 1, payload)
+    per_s = time.perf_counter() - t0
+    w1.close()
+    w2 = Wal(os.path.join(tmp, "batch.wal"), sync=True)
+    t0 = time.perf_counter()
+    w2.append_batch([(i, 1, payload) for i in range(1, entries + 1)])
+    batch_s = time.perf_counter() - t0
+    w2.close()
+    return {
+        "wal_append_per_entry_ms": round(per_s * 1e3, 2),
+        "wal_append_batch_ms": round(batch_s * 1e3, 2),
+        "wal_batch_speedup": round(per_s / batch_s, 1) if batch_s else None,
+    }
+
+
+def run(entries: int = 384, n_nodes: int = 3, payload: int = 64,
+        repeat: int = 1, batch_sizes=BATCH_SIZES) -> dict:
+    """One A/B pass; `repeat` keeps the best (min) wall time per mode —
+    consensus timings on a shared VM are noisy upward only."""
+    data = os.urandom(max(1, payload))
+    payloads = [data] * entries
+    out = {"entries": entries, "nodes": n_nodes, "payload_bytes": payload}
+
+    def best(fn) -> float:
+        return min(fn() for _ in range(max(1, repeat)))
+
+    tmp = tempfile.mkdtemp(prefix="nebula_write_bench_")
+    try:
+        out.update(_wal_legs(tmp, entries, data))
+
+        def timed(batch):
+            d = tempfile.mkdtemp(dir=tmp)
+            parts, leader = _mk_cluster(d, n_nodes)
+            try:
+                return _drive(parts, leader, payloads, batch)
+            finally:
+                for p in parts:
+                    p.stop()
+
+        per_cmd_s = best(lambda: timed(0))
+        out["per_command_s"] = round(per_cmd_s, 3)
+        out["per_command_eps"] = round(entries / per_cmd_s, 1)
+        for b in batch_sizes:
+            s = best(lambda b=b: timed(b))
+            out[f"grouped_{b}_s"] = round(s, 3)
+            out[f"grouped_{b}_eps"] = round(entries / s, 1)
+            out[f"grouped_{b}_speedup"] = round(per_cmd_s / s, 2)
+        out["headline_speedup_64"] = out.get("grouped_64_speedup")
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--entries", type=int, default=384)
+    ap.add_argument("--nodes", type=int, default=3)
+    ap.add_argument("--payload", type=int, default=64)
+    ap.add_argument("--repeat", type=int, default=1)
+    args = ap.parse_args(argv)
+    print(json.dumps(run(args.entries, args.nodes, args.payload,
+                         args.repeat), indent=1))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -162,14 +162,17 @@ def test_leader_failover_and_catchup(tmp_path):
     tr, parts, apps = make_cluster(tmp_path)
     try:
         leader = wait_leader(parts)
-        assert leader.propose(b"a")
+        # generous timeout: on a starved 2-core VM under full-suite
+        # load a commit can exceed the 5s default while still in
+        # flight — timing out would retry and double-apply
+        assert leader.propose(b"a", timeout=20)
         wait_applied(apps, [b"a"])
         # kill the leader; a new one takes over and accepts writes
         dead = parts.index(leader)
         leader.alive = False
         rest = [p for p in parts if p is not leader]
         new_leader = wait_leader(rest)
-        assert new_leader.propose(b"b", timeout=5)
+        assert new_leader.propose(b"b", timeout=20)
         wait_applied(apps, [b"a", b"b"], exclude=(dead,))
         # old leader rejoins as follower and catches up
         parts[dead].state = "follower"
@@ -215,7 +218,8 @@ def test_restart_replays_from_wal(tmp_path):
     try:
         leader = wait_leader(parts)
         for i in range(5):
-            assert leader.propose(f"v{i}".encode())
+            # starved-VM tolerance: see test_leader_failover_and_catchup
+            assert leader.propose(f"v{i}".encode(), timeout=20)
         want = [f"v{i}".encode() for i in range(5)]
         wait_applied(apps, want)
     finally:
@@ -243,10 +247,13 @@ def test_full_group_restart_recommits(tmp_path):
         leader = wait_leader(parts)
         # a CPU-starved election may depose the leader mid-loop under
         # full-suite load: follow the new leader instead of failing
-        deadline = time.monotonic() + 15
+        deadline = time.monotonic() + 30
         i = 0
         while i < 3:
-            if leader.propose(f"r{i}".encode()):
+            # long per-propose timeout: a timed-out-but-committed
+            # propose would be retried here and double-apply, making
+            # the exact wait_applied below unreachable
+            if leader.propose(f"r{i}".encode(), timeout=20):
                 i += 1
             else:
                 assert time.monotonic() < deadline, "no stable leader"

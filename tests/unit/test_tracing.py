@@ -286,16 +286,20 @@ def test_cluster_trace_stitches_services_and_device(traced_cluster):
 
 
 def test_cluster_insert_trace_has_raft_span(traced_cluster):
-    """Write path: the storaged-side raft propose span rides back too."""
+    """Write path: the storaged-side raft propose span rides back too
+    (group commit renamed it raft:propose_batch; the `entries` attr
+    carries the batch size)."""
     for t in trace.trace_store().list():
         if t["name"] in ("query:Insert", "query:InsertEdge",
                          "query:InsertVertex", "query:InsertEdges",
                          "query:InsertVertices"):
             entry = trace.trace_store().get(t["tid"])
-            names = {s["name"] for s in entry["spans"]}
-            if "raft:propose" in names:
-                return
-    raise AssertionError("no insert trace carries a raft:propose span")
+            for s in entry["spans"]:
+                if s["name"] == "raft:propose_batch":
+                    assert s.get("attrs", {}).get("entries", 0) >= 1
+                    return
+    raise AssertionError(
+        "no insert trace carries a raft:propose_batch span")
 
 
 def test_traces_endpoint_and_metrics_dump(traced_cluster, capsys):
