@@ -92,7 +92,7 @@ def _gc_settle():
 
 
 def bench_engine_config(name, store, query, seeds_note, rt, space="snb",
-                        numpy_fn=None, canon=None):
+                        numpy_fn=None, canon=None, repeats=None):
     """Engine-E2E wall time, device plane OFF vs ON, identical rows.
 
     `numpy_fn` (VERDICT r2 item 2) is the HONEST CPU comparator: a
@@ -104,6 +104,7 @@ def bench_engine_config(name, store, query, seeds_note, rt, space="snb",
     Python engine is never quoted as "CPU" in a headline."""
     from nebula_tpu.exec.engine import QueryEngine
 
+    n_rep = REPEATS if repeats is None else repeats
     out = {}
     rows_by_mode = {}
     for mode, runtime in (("cpu", None), ("tpu", rt)):
@@ -114,7 +115,7 @@ def bench_engine_config(name, store, query, seeds_note, rt, space="snb",
         assert rs.error is None, f"{name}: {rs.error}"
         _gc_settle()
         lat = []
-        for _ in range(REPEATS):
+        for _ in range(n_rep):
             t0 = time.perf_counter()
             rs = eng.execute(s, query)
             lat.append(time.perf_counter() - t0)
@@ -494,6 +495,7 @@ def main():
             snap, big_seeds, 3, materialize=True)
         cpu_lat.append(time.perf_counter() - t0)
     edges = st.edges_traversed()
+    cfg6_st = st               # pinned for the regression block below
     cpu_s = _median(cpu_lat)
     assert cpu_total == edges, (cpu_total, edges)
     assert cpu_kept == len(rows)
@@ -676,19 +678,34 @@ def main():
 
     # config 4 (BASELINE: Twitter-2010-shaped): variable-length *1..4
     # MATCH — path explosion + trail dedup; device layered-frame capture
-    # + host assembly vs pure host DFS.  Degree is kept moderate so the
-    # host baseline finishes inside driver budget; the Zipf tail keeps
-    # the supernode skew the config exists to stress.
-    _mark("building twitter-proxy graph (config 4)")
-    tw_n = int(os.environ.get("NEBULA_BENCH_TW_PERSONS",
-                              8_000 if fallback else 30_000))
-    tw = make_social_graph(n_persons=tw_n, avg_degree=6, parts=parts,
+    # + host assembly vs pure host DFS.  VERDICT r5 weak #4: the old
+    # 8k-person/8-seed slice traversed 9,949 edges per run; it now runs
+    # at two scales:
+    #   4_twitter_var_len  — denser A/B slice (~200k traversed edges,
+    #       ~400k trails): device vs HOST ENGINE vs numpy, identical
+    #       rows on all three.
+    #   4b_twitter_stress  — the ≥1M-traversed-edges explosion slice
+    #       (~2.7M trails): device vs the numpy trail-join oracle,
+    #       identical rows.  The HOST ROW PLANE sits this one out, and
+    #       that exclusion IS the stated ceiling: ~2.7M emitted rows
+    #       × ~512B of per-path Python lists ≈ 1.4 GB intermediates
+    #       (over the 1 GiB default query_memory_limit_bytes) and one
+    #       get_neighbors call per expansion ≈ 10+ min/run on the bench
+    #       VM — the row-at-a-time plane cannot execute this config
+    #       inside budget, which is exactly the cliff the columnar
+    #       plane exists to remove.
+    _mark("building twitter-proxy graph (config 4 A/B slice)")
+    tw_n = int(os.environ.get("NEBULA_BENCH_TW_PERSONS", 30_000))
+    tw_deg = int(os.environ.get("NEBULA_BENCH_TW_DEGREE", 12))
+    tw_nseeds = int(os.environ.get("NEBULA_BENCH_TW_SEEDS", 16))
+    tw = make_social_graph(n_persons=tw_n, avg_degree=tw_deg, parts=parts,
                            seed=11, space="tw")
-    tw_seeds = pick_seeds(tw, "tw", 8, min_degree=3)
+    tw_seeds = pick_seeds(tw, "tw", tw_nseeds, min_degree=3)
     tw_list = ", ".join(str(s) for s in tw_seeds)
     snap_tw = build_snapshot(tw, "tw")
     sd_tw = tw.space("tw")
     dense_tw = [sd_tw.dense_id(v) for v in tw_seeds]
+    n_paths = host_trail_paths(snap_tw, dense_tw, 4)
 
     def np_cfg4():
         return (np.int64(host_trail_paths(snap_tw, dense_tw, 4)),)
@@ -696,14 +713,70 @@ def main():
     def canon_cfg4(ds):
         return (np.int64(ds.rows[0][0]),)
 
-    _mark("config 4: engine e2e MATCH *1..4")
+    _mark(f"config 4: engine e2e MATCH *1..4 ({n_paths} trails)")
     configs["4_twitter_var_len"] = bench_engine_config(
         "cfg4", tw,
         f"MATCH (a:Person)-[e:KNOWS*1..4]->(b) WHERE id(a) IN [{tw_list}] "
         f"RETURN count(*) AS paths",
         tw_seeds, rt, space="tw", numpy_fn=np_cfg4, canon=canon_cfg4)
+    configs["4_twitter_var_len"].update({
+        "persons": tw_n, "avg_degree": tw_deg, "seeds": tw_nseeds,
+        "trail_paths": int(n_paths)})
     _save_partial(platform, configs)
     rt.unpin("tw")
+
+    # ---- config 4b: the ≥1M-edge explosion slice (device + numpy) ----
+    _mark("building twitter-proxy graph (config 4b stress slice)")
+    twb_n = int(os.environ.get("NEBULA_BENCH_TWB_PERSONS", 150_000))
+    twb_nseeds = int(os.environ.get("NEBULA_BENCH_TWB_SEEDS", 1_792))
+    twb = make_social_graph(n_persons=twb_n, avg_degree=6, parts=parts,
+                            seed=11, space="twb")
+    twb_seeds = pick_seeds(twb, "twb", twb_nseeds, min_degree=3)
+    snap_twb = build_snapshot(twb, "twb")
+    sd_twb = twb.space("twb")
+    dense_twb = [sd_twb.dense_id(v) for v in twb_seeds]
+    t0 = time.perf_counter()
+    twb_paths = host_trail_paths(snap_twb, dense_twb, 4)
+    twb_np_s = time.perf_counter() - t0
+    _mark(f"config 4b: device MATCH *1..4 ({twb_paths} trails)")
+    from nebula_tpu.exec.engine import QueryEngine as _QE
+    _e4b = _QE(twb, tpu_runtime=rt)
+    _s4b = _e4b.new_session()
+    _e4b.execute(_s4b, "USE twb")
+    twb_q = (f"MATCH (a:Person)-[e:KNOWS*1..4]->(b) WHERE id(a) IN "
+             f"[{', '.join(str(s) for s in twb_seeds)}] "
+             f"RETURN count(*) AS paths")
+    r4b = _e4b.execute(_s4b, twb_q)          # warmup + correctness
+    assert r4b.error is None, r4b.error
+    assert int(r4b.data.rows[0][0]) == int(twb_paths), \
+        "config 4b: device trail count diverges from the numpy oracle"
+    _gc_settle()
+    lat4b = []
+    for _ in range(REPEATS):
+        t0 = time.perf_counter()
+        r4b = _e4b.execute(_s4b, twb_q)
+        lat4b.append(time.perf_counter() - t0)
+    st4b = _e4b.qctx.last_tpu_stats
+    edges4b = st4b.edges_traversed() if st4b is not None else 0
+    configs["4b_twitter_stress"] = {
+        "persons": twb_n, "avg_degree": 6, "seeds": twb_nseeds,
+        "trail_paths": int(twb_paths),
+        "edges_per_run": int(edges4b),
+        "device_p50_ms": round(_median(lat4b) * 1e3, 2),
+        "numpy_p50_ms": round(twb_np_s * 1e3, 2),
+        "speedup_vs_numpy": round(twb_np_s / _median(lat4b), 3),
+        "identical_rows": True,
+        "snapshot_bytes": snap_twb.hbm_bytes(),
+        "host_row_plane": "excluded — RAM/time ceiling: ~2.7M rows x "
+                          "~512B path lists ≈ 1.4GB > 1GiB default "
+                          "query_memory_limit_bytes, and one "
+                          "get_neighbors call per expansion ≈ 10+ "
+                          "min/run; the columnar plane runs it in "
+                          "seconds (this exclusion is the config's "
+                          "point)",
+    }
+    _save_partial(platform, configs)
+    rt.unpin("twb")
 
     # ---- configs ic5 + ic9 (VERDICT r4 item 6): the published LDBC
     # interactive query text verbatim (tie-breaks adapted to title/id
@@ -712,8 +785,11 @@ def main():
     from nebula_tpu.bench.datagen import (ic5_numpy, ic9_numpy,
                                           make_snb_interactive)
     _mark("building SNB interactive slice (ic5/ic9)")
-    ic_n = int(os.environ.get("NEBULA_BENCH_IC_PERSONS",
-                              1_500 if fallback else 6_000))
+    # VERDICT r5 weak #3 / ISSUE 4: the IC slice runs at 6,000 persons
+    # on the fallback too — the fused columnar pipeline is expected to
+    # WIN here (acceptance: device ≥2× host), so toy scale no longer
+    # hides the tail cost
+    ic_n = int(os.environ.get("NEBULA_BENCH_IC_PERSONS", 6_000))
     ic_store, ic_arr = make_snb_interactive(ic_n, parts=parts)
     ic_root, ic_min, ic_max = 5, 17_000, 19_000
     ic5_q = (
@@ -764,10 +840,18 @@ def main():
     configs["ic5"] = {"persons": ic_n, "rows": len(want5),
                       "host_p50_ms": round(ic5_ms["host"] * 1e3, 2),
                       "device_p50_ms": round(ic5_ms["device"] * 1e3, 2),
+                      "device_vs_host": round(ic5_ms["host"]
+                                              / ic5_ms["device"], 3),
+                      "oracle": "numpy ic5_numpy, rows asserted equal "
+                                "on BOTH planes",
                       "identical_rows": True}
     configs["ic9"] = {"persons": ic_n, "rows": len(want9),
                       "host_p50_ms": round(ic9_ms["host"] * 1e3, 2),
                       "device_p50_ms": round(ic9_ms["device"] * 1e3, 2),
+                      "device_vs_host": round(ic9_ms["host"]
+                                              / ic9_ms["device"], 3),
+                      "oracle": "numpy ic9_numpy, rows asserted equal "
+                                "on BOTH planes",
                       "identical_rows": True}
     _save_partial(platform, configs)
 
@@ -867,7 +951,54 @@ def main():
         "plan_cache_hits": _snap.get("plan_cache_hits", 0),
         "plan_cache_misses": _snap.get("plan_cache_misses", 0),
         "rpc_pool_size": _snap.get("rpc_pool_size", 0),
+        # ISSUE 4 observability: how often the columnar MATCH pipeline
+        # fused vs bailed (labeled reasons live in /metrics)
+        "match_pipeline_fused": _snap.get("match_pipeline_fused", 0),
+        "match_pipeline_fused_plans":
+            _snap.get("match_pipeline_fused_plans", 0),
+        "match_pipeline_fallback": sum(
+            v for k, v in _snap.items()
+            if k.startswith("match_pipeline_fallback")),
     }
+    detail_path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "BENCH_DETAIL.json")
+    # ---- pinned, noise-immune regression block (VERDICT r5 weak #8 /
+    # ISSUE 4 satellite): fixed-seed graph, converged (pinned) padded
+    # buckets, kernel-only per-hop op counts from the DETERMINISTIC work
+    # counters (byte-identical across runs, asserted above) alongside
+    # the noisy edges/s — r6-vs-r5 diffs these counts to tell a real
+    # regression from VM weather.  The previous round's block is carried
+    # one deep so the comparison ships in-band.
+    prev_reg = None
+    try:
+        with open(detail_path) as f:
+            prev_reg = json.load(f).get("regression")
+            if prev_reg is not None:
+                prev_reg.pop("previous", None)
+    except (OSError, ValueError):
+        pass
+    regression = {
+        "schema": 1,
+        "inputs": {"persons": n_persons, "avg_degree": degree,
+                   "parts": parts, "datagen_seed": 7, "hops": 3,
+                   "seeds": n_seeds, "platform": platform},
+        "buckets": {"EB": cfg6_st.e_cap},
+        "per_hop_edges": [int(x) for x in cfg6_st.hop_edges],
+        "per_hop_frontier": [int(x) for x in cfg6_st.frontier_sizes],
+        "work_counters": work1,
+        "work_counters_identical": True,
+        "edges_per_run": edges,
+        "kernel_p50_ms": round(_median(klat) * 1e3, 2),
+        "kernel_eps": round(tpu_kernel_eps, 1),
+    }
+    if prev_reg is not None:
+        regression["previous"] = prev_reg
+        same = (prev_reg.get("inputs") == regression["inputs"]
+                and prev_reg.get("per_hop_edges")
+                == regression["per_hop_edges"]
+                and prev_reg.get("work_counters")
+                == regression["work_counters"])
+        regression["work_identical_to_previous"] = bool(same)
     detail = {
         "platform": platform,
         "hot_path": hot_path,
@@ -886,12 +1017,11 @@ def main():
         "kernel_vs_cpu": round(tpu_kernel_eps / cpu_eps, 3),
         "device_hbm_bytes": ns_hbm_bytes,
         "supernode_skew": skew,
+        "regression": regression,
         "configs": configs,
     }
     if tpu_partial is not None:
         detail["tpu_partial_configs"] = tpu_partial
-    detail_path = os.path.join(
-        os.path.dirname(os.path.abspath(__file__)), "BENCH_DETAIL.json")
     with open(detail_path, "w") as f:
         json.dump(detail, f, indent=1)
     _mark(f"detail written to {detail_path}")
@@ -910,6 +1040,9 @@ def main():
         "identical_rows": True,
         # noise-immune regression signal (full schema in detail JSON)
         "work_edges": work1["edges_traversed"],
+        # fused-pipeline IC A/B (ISSUE 4): host_p50/device_p50 per config
+        "ic_dev_x": [configs["ic5"]["device_vs_host"],
+                     configs["ic9"]["device_vs_host"]],
     }
     if tpu_partial is not None:
         hl["tpu_partial"] = len(tpu_partial["configs"])

@@ -148,7 +148,17 @@ class ExecutionContext:
 
     def set_result(self, var: str, ds: DataSet):
         if self.tracker is not None and ds is not None:
-            self.tracker.charge_rows(ds.rows)
+            from ..core.value import ColumnarDataSet
+            if isinstance(ds, ColumnarDataSet) and ds._cols is not None:
+                # charge from the numpy buffers: touching .rows here
+                # would materialize per-row Python lists for EVERY
+                # columnar result (device GO results, fused MATCH
+                # pipelines) — the exact cost the lazy result boundary
+                # exists to avoid
+                from ..utils.memtracker import approx_columnar_bytes
+                self.tracker.charge(approx_columnar_bytes(ds._cols))
+            else:
+                self.tracker.charge_rows(ds.rows)
         self.results.setdefault(var, []).append(ds)
 
     def get_result(self, var: str) -> DataSet:

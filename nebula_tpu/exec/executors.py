@@ -81,9 +81,17 @@ def _set_variable(node, qctx, ectx, space):
 
 @executor("Argument")
 def _argument(node, qctx, ectx, space):
+    from ..core.value import ColumnarDataSet
     src = ectx.get_result(node.args["from_var"])
     col = node.args["col"]
     i = src.col_index(col)
+    if isinstance(src, ColumnarDataSet) and src._cols is not None \
+            and src._cols[i].dtype != object:
+        # columnar input (device results): first-occurrence distinct
+        # without boxing the rows
+        c = src._cols[i]
+        _, idx = np.unique(c, return_index=True)
+        return ColumnarDataSet([col], [c[np.sort(idx)]])
     seen, rows = set(), []
     for r in src.rows:
         k = hashable_key(r[i])

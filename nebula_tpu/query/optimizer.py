@@ -28,8 +28,12 @@ ExploreRule = Callable[[PlanNode, Any], List[PlanNode]]
 EXPLORE_RULES: List[ExploreRule] = []
 
 # TPU fusion rule factories: each is called per-pass with a {node_id:
-# parent_count} map and returns a Rule.  Populated by nebula_tpu.tpu
-# (kept here so query/ has no jax dependency).
+# parent_count} map AND the plan root (pipeline fusion must see
+# by-name Argument references that dep edges don't carry) and returns
+# a Rule.  Populated by nebula_tpu.tpu (kept here so query/ has no jax
+# dependency).  Order matters: the first factory whose rule matches a
+# node wins it, so specialized single-chain fusions register before
+# the general pipeline fusion.
 TPU_RULES: List = []
 
 
@@ -167,7 +171,7 @@ def optimize(plan: ExecutionPlan, enable: bool = True,
         for n in walk_plan(plan.root):
             for d in n.deps:
                 uses[d.id] = uses.get(d.id, 0) + 1
-        rules = [factory(uses) for factory in TPU_RULES]
+        rules = [factory(uses, plan.root) for factory in TPU_RULES]
         memo: dict = {}
 
         def rec(node: PlanNode) -> PlanNode:

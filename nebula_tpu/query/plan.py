@@ -62,7 +62,12 @@ class PlanNode:
             elif isinstance(v, list) and v and isinstance(v[0], Expr):
                 bits.append(f"{k}=[{', '.join(to_text(x) for x in v)}]")
             else:
-                bits.append(f"{k}={v!r}")
+                r = repr(v)
+                if len(r) > 160:
+                    # compiled-program args (TpuMatchPipeline segment
+                    # lists) would swamp EXPLAIN — elide the body
+                    r = r[:150] + f"…+{len(r) - 150}ch"
+                bits.append(f"{k}={r}")
         line = f"{pad}{self.kind}#{self.id}"
         if bits:
             line += " {" + ", ".join(bits) + "}"

@@ -30,5 +30,6 @@ from .device import DeviceSnapshot, make_mesh, pin_snapshot          # noqa: E40
 from .runtime import TpuRuntime                                      # noqa: E402
 from . import traverse                                               # noqa: E402  (registers executor+rule)
 from . import match_agg                                              # noqa: E402  (registers executor+rule)
+from . import pipeline                                               # noqa: E402  (registers executor+rule; MUST follow match_agg — rule order)
 
 __all__ = ["DeviceSnapshot", "make_mesh", "pin_snapshot", "TpuRuntime"]

@@ -483,12 +483,19 @@ _np_lit, _np_unary, _np_coerce_pair, _np_binary, _np_in_list = _term_alg(np)
 
 def _vertex_ref(x: "E.Expr", alias: str):
     """Classify a vertex-alias reference.  Returns ("prop", tag, prop) |
-    ("hastag", tag) | None; raises CannotCompile on a reference to a
-    DIFFERENT alias (the caller's filter must be single-alias)."""
+    ("attr", prop) | ("hastag", tag) | None; raises CannotCompile on a
+    reference to a DIFFERENT alias (the caller's filter must be
+    single-alias)."""
     if isinstance(x, E.LabelTagProp):
         if x.var != alias:
             raise CannotCompile(f"prop of other alias {x.var}")
         return ("prop", x.tag, x.prop)
+    if isinstance(x, E.AttributeExpr) and isinstance(x.obj, E.LabelExpr):
+        # tag-less `v.prop`: get_attribute over the MERGED tag props
+        # (later tag in sorted order wins on a name collision)
+        if x.obj.name != alias:
+            raise CannotCompile(f"attr of other alias {x.obj.name}")
+        return ("attr", x.attr)
     if (isinstance(x, E.FunctionCall) and x.name == "_hastag"
             and len(x.args) == 2 and isinstance(x.args[0], E.LabelExpr)
             and isinstance(x.args[1], E.Literal)
@@ -598,6 +605,8 @@ def compile_vertex_predicate_np(e: "E.Expr", alias: str, snap,
             return _np_lit(x.value, pool)
         ref = _vertex_ref(x, alias)
         if ref is not None:
+            if ref[0] == "attr":
+                return _attr_term(snap, P, ref[1])
             if ref[0] == "hastag":
                 tt = snap.tags.get(ref[1])
                 if tt is None:
@@ -651,6 +660,56 @@ def compile_vertex_predicate_np(e: "E.Expr", alias: str, snap,
         return np.logical_and(val, np.logical_not(isnull))
 
     return mask_fn
+
+
+def merged_attr_columns(snap, prop: str):
+    """(present, raw, kind) per tag whose schema carries `prop`, in the
+    snapshot's sorted-tag order — the columnar mirror of
+    Vertex.properties()'s dict merge (later tag wins).  Raises when the
+    participating columns disagree on the value kind (a per-row merge
+    of mixed encodings has no single columnar type)."""
+    parts = []
+    for tt in snap.tags.values():          # insertion = sorted tag order
+        if prop in tt.props:
+            parts.append((tt.present, tt.props[prop],
+                          _kind_of(tt.prop_types[prop]),
+                          tt.prop_types[prop]))
+    kinds = {k for _, _, k, _ in parts}
+    if len(kinds) > 1:
+        raise CannotCompile(f"attr {prop} mixes value kinds across tags")
+    return parts
+
+
+def merged_attr_raw(snap, parts, dense: "np.ndarray"):
+    """Merged raw column for `parts` at `dense` (sentinel nulls)."""
+    P = snap.num_parts
+    kind = parts[0][2]
+    if kind == "float":
+        val = np.full(np.shape(dense), np.nan)
+    else:
+        val = np.full(np.shape(dense), INT_NULL, np.int64)
+    p_, li = dense % P, dense // P
+    for pres, col, _, _ in parts:
+        pm = pres[p_, li]
+        val = np.where(pm, col[p_, li], val)
+    return val
+
+
+def _attr_term(snap, P, prop: str):
+    parts = merged_attr_columns(snap, prop)
+    if not parts:
+        return lambda c: (np.zeros(np.shape(c["_dense"]), np.int64),
+                          np.ones(np.shape(c["_dense"]), bool), "int")
+    kind = parts[0][2]
+
+    def g(c):
+        raw = merged_attr_raw(snap, parts, c["_dense"])
+        if kind == "float":
+            return (raw, np.isnan(raw), "float")
+        if kind == "bool":
+            return (raw != 0, raw == INT_NULL, "bool")
+        return (raw, raw == INT_NULL, kind)
+    return g
 
 
 # ---------------------------------------------------------------------------

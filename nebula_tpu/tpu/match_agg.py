@@ -103,7 +103,7 @@ def _single(uses: Dict[int, int], node: PlanNode) -> bool:
     return uses.get(node.id, 2) == 1 and len(node.deps) == 1
 
 
-def make_match_agg_rule(uses: Dict[int, int]):
+def make_match_agg_rule(uses: Dict[int, int], root=None):
     def rule(node: PlanNode) -> Optional[PlanNode]:
         if node.kind != "Aggregate":
             return None

@@ -82,9 +82,10 @@ def _match_frontier_chain(final: PlanNode, uses: Dict[int, int]
         cur = nxt
 
 
-def make_tpu_rule(uses: Dict[int, int]):
+def make_tpu_rule(uses: Dict[int, int], root=None):
     """Rule closure for one optimize() pass; `uses` maps node id → number
-    of parents in the plan DAG."""
+    of parents in the plan DAG (`root` is unused here — the pipeline
+    fusion needs it for by-name Argument references)."""
 
     def rule(node: PlanNode) -> Optional[PlanNode]:
         # Preferred match: Project(go_row) over the chain — the YIELD

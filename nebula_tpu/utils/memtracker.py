@@ -55,6 +55,32 @@ def approx_dataset_bytes(rows: List[List[Any]]) -> int:
     return 64 + (sampled * n) // k
 
 
+def approx_columnar_bytes(cols) -> int:
+    """Charge a columnar result WITHOUT touching `.rows` (which would
+    materialize per-row Python lists — the exact cost lazy columnar
+    results exist to avoid).  Numeric columns price at their buffer
+    size; object columns sample like approx_dataset_bytes."""
+    total = 64
+    for c in cols:
+        dt = getattr(c, "dtype", None)
+        if dt is None:
+            n = len(c)
+            if n:
+                k = min(n, 32)
+                total += (approx_row_bytes(list(c)[:k]) * n) // k
+            continue
+        if dt != object:
+            total += int(c.nbytes)
+            continue
+        n = int(c.size)
+        if n == 0:
+            continue
+        k = min(n, 32)
+        sampled = approx_row_bytes([c[i] for i in range(k)])
+        total += (sampled * n) // k
+    return total
+
+
 class MemoryTracker:
     """One per query execution.  charge() is cumulative: intermediates
     are versioned and kept for $vars/PROFILE, so releases are rare and

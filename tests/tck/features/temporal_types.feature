@@ -77,3 +77,40 @@ Feature: Temporal types and accessors
     Then the result should be, in order:
       | bad  |
       | true |
+
+  Scenario: date_format and time_format render the reference subset
+    When executing query:
+      """
+      YIELD date_format(date("2024-03-09"), "%Y/%m/%d") AS ymd,
+            date_format(datetime("2024-03-09T13:05:07"), "%F %T") AS ft,
+            time_format(time("13:05:07"), "%H-%M-%S") AS hms,
+            date_format(date("2024-03-09"), "%j") AS doy
+      """
+    Then the result should be, in order:
+      | ymd        | ft                  | hms      | doy |
+      | "2024/03/09" | "2024-03-09 13:05:07" | "13-05-07" | "069" |
+
+  Scenario: date_format refuses missing components and unknown specifiers
+    When executing query:
+      """
+      YIELD time_format(date("2024-01-01"), "%H") IS NULL AS no_time,
+            date_format(time("13:05:07"), "%Y") IS NULL AS no_date,
+            date_format(date("2024-03-09"), "%Q") IS NULL AS unknown,
+            date_format(NULL, "%Y") IS NULL AS nullin
+      """
+    Then the result should be, in order:
+      | no_time | no_date | unknown | nullin |
+      | true    | true    | true    | true   |
+
+  Scenario: two-timestamp duration overload equals t2 - t1
+    When executing query:
+      """
+      YIELD duration(timestamp("2024-01-01T00:00:00"),
+                     timestamp("2024-01-02T03:00:00")) == duration({hours: 27}) AS eq,
+            duration(datetime("2024-01-01T00:00:00"),
+                     datetime("2024-01-01T01:00:00")) == duration({minutes: 60}) AS dt,
+            duration(NULL, timestamp()) IS NULL AS nullin
+      """
+    Then the result should be, in order:
+      | eq   | dt   | nullin |
+      | true | true | true   |
