@@ -66,23 +66,40 @@ def _find_leader(sc, hosts: List[str], space: str, pid: int
 
 
 def _wait_caught_up(sc, host: str, leader: str, space: str, pid: int,
-                    timeout: float = CATCHUP_TIMEOUT_S):
+                    timeout: float = CATCHUP_TIMEOUT_S,
+                    hosts: Optional[List[str]] = None):
     """Poll the new replica until its applied index reaches the leader's
     commit index as of entry.  The leader's index MUST be known — a
     transient RPC failure must not degrade the target to 0, or an empty
     replica reads as caught up and the shrink phase drops the only full
-    copy."""
+    copy.
+
+    The leader may DIE mid-catchup (ISSUE 5 satellite): instead of
+    aborting the data move, re-discover the new leader among `hosts`
+    and resume — a freshly elected leader's commit index covers every
+    entry the dead one had committed, so re-anchoring the target on it
+    never lowers the bar below already-committed state."""
     dl = time.monotonic() + timeout
-    li = None
-    while li is None and time.monotonic() < dl:
-        li = _raft_info(sc, leader, space, pid)
-        if li is None:
+    # the catch-up target itself stays a candidate: raft log-
+    # completeness can make the NEW replica win the post-crash
+    # election, and anchoring on its own commit index is equally safe
+    cands = list(hosts or []) or [leader]
+    cur: Optional[str] = leader
+    target = None
+    while target is None and time.monotonic() < dl:
+        li = _raft_info(sc, cur, space, pid) if cur else None
+        if li is not None and li.get("is_leader", True):
+            target = li["commit_index"]
+            break
+        # named leader dead/deposed: walk the replica set for its
+        # successor (an election in flight keeps returning None — poll)
+        cur = _find_leader(sc, cands, space, pid)
+        if cur is None:
             time.sleep(0.05)
-    if li is None:
+    if target is None:
         raise BalanceError(
-            f"leader {leader} of {space}/{pid} unreachable; cannot "
-            f"establish a catch-up target")
-    target = li["commit_index"]
+            f"no reachable leader for {space}/{pid} (last tried "
+            f"{cur or leader}); cannot establish a catch-up target")
     while time.monotonic() < dl:
         info = _raft_info(sc, host, space, pid)
         if info and info["last_applied"] >= target:
@@ -249,7 +266,7 @@ def _add_replica(meta, sc, space: str, pid: int, replicas: List[str],
         leader = _find_leader(sc, live, space, pid)
     if leader is None:
         raise BalanceError(f"no leader for {space}/{pid} during add")
-    _wait_caught_up(sc, tgt, leader, space, pid)
+    _wait_caught_up(sc, tgt, leader, space, pid, hosts=live)
 
 
 def balance_leader(store, space: Optional[str] = None) -> Dict[str, Any]:

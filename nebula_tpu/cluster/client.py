@@ -18,14 +18,33 @@ from typing import Optional
 
 from ..core.wire import from_wire
 from ..exec.context import ResultSet
-from .rpc import RpcClient, RpcError
+from ..utils.config import get_config
+from .rpc import RpcClient, RpcConnError, RpcError
+
+#: how much longer the client waits than the server's statement budget:
+#: graphd's own deadline (query_timeout_secs, ISSUE 5) should expire
+#: FIRST and return a proper E_QUERY_TIMEOUT reply — the client-side
+#: cutoff only catches a graphd that stopped answering entirely
+CLIENT_TIMEOUT_GRACE_S = 10.0
+
+
+def _statement_timeout() -> float:
+    """The configured statement timeout (0/unset → legacy 300s)."""
+    try:
+        t = float(get_config().get("query_timeout_secs"))
+    except Exception:  # noqa: BLE001 — config not initialized
+        t = 0.0
+    return t if t > 0 else 300.0
 
 
 class GraphClient:
-    def __init__(self, host: str, port: int):
+    def __init__(self, host: str, port: int,
+                 timeout: Optional[float] = None):
         # retries=0: a statement may be non-idempotent; re-sending after a
         # dropped reply could execute it twice (at-least-once hazard)
-        self.rpc = RpcClient(host, port, timeout=300.0, retries=0)
+        self.timeout = (timeout if timeout is not None
+                        else _statement_timeout() + CLIENT_TIMEOUT_GRACE_S)
+        self.rpc = RpcClient(host, port, timeout=self.timeout, retries=0)
         self.session_id: Optional[int] = None
 
     def authenticate(self, user: str = "root", password: str = "nebula"):
@@ -36,8 +55,21 @@ class GraphClient:
     def execute(self, stmt: str) -> ResultSet:
         if self.session_id is None:
             raise RpcError("not authenticated")
-        r = self.rpc.call("graph.execute", session_id=self.session_id,
-                          stmt=stmt)
+        try:
+            r = self.rpc.call("graph.execute", session_id=self.session_id,
+                              stmt=stmt)
+        except RpcConnError as ex:
+            if "rpc timeout" in str(ex):
+                # the statement outlived even the grace window (graphd
+                # wedged / unreachable mid-statement): a clean timeout
+                # result, not a raw transport traceback (ISSUE 5
+                # satellite).  NOTE the statement may still be running —
+                # same contract as any client-side cancel.
+                return ResultSet(
+                    error=f"E_QUERY_TIMEOUT: no reply within "
+                          f"{self.timeout:g}s (statement budget "
+                          f"{_statement_timeout():g}s + grace)")
+            raise
         data = from_wire(r["data"]) if r["data"] is not None else None
         return ResultSet(data=data, space=r["space"],
                          latency_us=r["latency_us"],

@@ -15,12 +15,15 @@ row 14).
 """
 from __future__ import annotations
 
+import itertools
+import uuid
 from typing import Any, Dict, Iterable, List, Optional
 
 from ..core.wire import from_wire, to_wire
 from ..graphstore.schema import (SchemaError, apply_defaults,
                                   fill_row)
 from ..graphstore.store import stable_vid_hash
+from ..utils.failpoints import fail
 from .meta_client import MetaClient
 from .storage_client import StorageClient, StorageError
 
@@ -116,6 +119,15 @@ class DistributedStore:
         # space → (epoch, vid_to_dense, dense_to_vid) from the last CSR
         # export; serves _SpaceView.dense_id for the device drivers
         self._dense_cache: Dict[str, Any] = {}
+        # exactly-once write identity (ISSUE 5): every storage.write
+        # request carries (writer_id, seq); storaged's raft-replicated
+        # dedup window recognizes a re-sent request and returns its
+        # recorded outcome instead of double-applying
+        self.writer_id = uuid.uuid4().hex[:16]
+        self._wseq = itertools.count(1)
+
+    def _token(self) -> List[Any]:
+        return [self.writer_id, next(self._wseq)]
 
     @property
     def catalog(self):
@@ -154,9 +166,13 @@ class DistributedStore:
         # DDL refreshes BEFORE applying — otherwise a write landing in
         # the lag window applies without the new index/fulltext/TTL
         # schema state (silently missing derived entries)
+        # the token is minted ONCE per logical request: replica-walk
+        # retries re-send the same (writer_id, seq), which is what the
+        # dedup window keys on
         self.sc._call_part(space, pid, "storage.write",
                            {"cmds": [to_wire(list(c)) for c in cmds],
-                            "cat_ver": self.meta.version})
+                            "cat_ver": self.meta.version,
+                            "token": self._token()})
 
     def _write_many(self, space: str, by_part: Dict[int, List[tuple]]):
         """One rpc_write per part — each part's command list becomes ONE
@@ -171,7 +187,8 @@ class DistributedStore:
         self.sc.fanout(
             space,
             {pid: {"cmds": [to_wire(list(c)) for c in cmds],
-                   "cat_ver": self.meta.version}
+                   "cat_ver": self.meta.version,
+                   "token": self._token()}
              for pid, cmds in by_part.items()},
             "storage.write")
 
@@ -215,8 +232,13 @@ class DistributedStore:
         # mark + out-half ride ONE raft entry: the journal must never
         # commit without the out-half it promises to mirror
         mark = ["chain_mark", src_pid, cid, dst_pid, in_cmd, _t.time()]
+        fail.hit("toss:pre_out")
         self._write(space, src_pid, ("batch", [mark, list(out_cmd)]))
+        # the torn-chain window: a crash here leaves the journal + out-
+        # half committed with the in-half owed — the resume janitor's job
+        fail.hit("toss:pre_in")
         self._write(space, dst_pid, tuple(in_cmd))
+        fail.hit("toss:pre_done")
         self._write(space, src_pid, ("chain_done", src_pid, cid))
 
     def insert_edge(self, space: str, src: Any, etype: str, dst: Any,
@@ -278,9 +300,15 @@ class DistributedStore:
             dones.setdefault(src_pid, []).append(
                 ("chain_done", src_pid, cid))
         # out-halves (with journals) first — the source of truth — then
-        # the in-halves, then the retirements
+        # the in-halves, then the retirements.  The failpoints bracket
+        # the two crash windows a batched TOSS chain has: after the
+        # journaled out-halves (janitor re-drives the in-halves) and
+        # after the in-halves (janitor retires stale journals)
+        fail.hit("toss:pre_out")
         self._write_many(space, by_src)
+        fail.hit("toss:pre_in")
         self._write_many(space, by_dst)
+        fail.hit("toss:pre_done")
         self._write_many(space, dones)
 
     def delete_vertex(self, space: str, vid: Any, with_edges: bool = True):

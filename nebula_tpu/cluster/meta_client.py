@@ -13,8 +13,10 @@ import time
 from typing import Any, Dict, List, Optional
 
 from ..graphstore.schema import Catalog
+from ..utils import cancel as _cancel
 from .meta_service import _pk, _unpk
-from .rpc import RpcClient, RpcConnError, RpcError
+from .rpc import (RpcClient, RpcConnError, RpcError, deadline_sleep,
+                  retry_backoff)
 
 
 class MetaError(Exception):
@@ -57,7 +59,11 @@ class MetaClient:
     def call(self, method: str, _retries: int = 6, **params) -> Any:
         """Call the metad leader, following leader hints / re-probing."""
         last = None
-        for _ in range(_retries):
+        for attempt in range(_retries):
+            # deadline budget: a statement-scoped meta call must stop
+            # walking when the budget is spent (heartbeat threads carry
+            # no context — check() is a no-op there)
+            _cancel.check()
             addrs = ([self._leader] if self._leader else []) + \
                 [a for a in self.meta_addrs if a != self._leader]
             for addr in addrs:
@@ -69,7 +75,13 @@ class MetaClient:
                     last = ex
                     msg = str(ex)
                     if msg.startswith("not leader"):
-                        hint = msg.split("=", 1)[-1].strip()
+                        # hint grammar: "not leader; leader=<addr>".  A
+                        # reply with NO "=" (or an empty hint — election
+                        # in flight) must clear the cache and re-probe,
+                        # never adopt the message text as an address
+                        halves = msg.split("=", 1)
+                        hint = halves[1].strip() if len(halves) == 2 \
+                            else ""
                         self._leader = hint or None
                         continue
                     raise MetaError(msg) from None
@@ -77,7 +89,12 @@ class MetaClient:
                     last = ex
                     self._leader = None
                     continue
-            time.sleep(0.2)
+            # all metads down / electing: jittered exponential backoff
+            # (deadline-clamped) instead of a fixed-step herd
+            if attempt < _retries - 1:
+                from ..utils.stats import stats as _stats
+                _stats().inc("meta_leader_walk_retries")
+                deadline_sleep(retry_backoff(attempt, base=0.1, cap=1.0))
         raise MetaError(f"no metad leader reachable: {last}")
 
     def wait_ready(self, timeout: float = 15.0):

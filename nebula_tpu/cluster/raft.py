@@ -21,6 +21,7 @@ import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ..utils.config import define_flag, get_config
+from ..utils.failpoints import FailpointError, fail
 from .wal import Wal
 
 FOLLOWER, CANDIDATE, LEADER = "follower", "candidate", "leader"
@@ -389,6 +390,13 @@ class RaftPart:
             from ..utils.stats import stats as _metrics
             _metrics().observe("raft_replication_batch_size",
                                len(entries), buckets=REPL_BATCH_BUCKETS)
+        try:
+            # armed raise == this append_entries round lost to the
+            # network (peer partitioned); the caller treats it exactly
+            # like a transport no-reply
+            fail.hit("raft:replicate", key=self.group)
+        except FailpointError:
+            return False
         t_send = time.monotonic()
         r = self.transport.send(peer, self.group, "append_entries", {
             "_from": self.node_id, "term": term, "leader": self.node_id,
@@ -613,7 +621,11 @@ class RaftPart:
             # lock so sibling proposers can stage entries meanwhile
             self.wal.append_batch(entries, sync=False)
             last = entries[-1][0]
+        # pre/post bracket the durability point: a crash armed BEFORE
+        # loses the batch, one armed AFTER loses only the ack
+        fail.hit("raft:pre_fsync", key=self.group)
         self.wal.sync_to(last)          # group fsync (shared with siblings)
+        fail.hit("raft:post_fsync", key=self.group)
         with self.lock:
             if not self.peers and self.state == LEADER:
                 # single-node group: durable == committed — advance to
@@ -626,6 +638,7 @@ class RaftPart:
         _metrics().inc("raft_appends", len(entries))
         _metrics().inc("raft_propose_batches")
         self._replicate_all()
+        fail.hit("raft:pre_commit", key=self.group)
         deadline = time.monotonic() + timeout
         with self.lock:
             while self.commit_index < last:

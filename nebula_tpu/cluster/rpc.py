@@ -54,6 +54,7 @@ from __future__ import annotations
 
 import itertools
 import json
+import random
 import socket
 import socketserver
 import struct
@@ -62,8 +63,10 @@ import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Callable, Dict, Optional, Tuple
 
+from ..utils import cancel as _cancel
 from ..utils import trace as _trace
 from ..utils.config import define_flag, get_config
+from ..utils.failpoints import ConnectionKilled, FailpointError, fail
 from ..utils.stats import current_work, stats as _stats
 
 _LEN = struct.Struct("<I")
@@ -75,6 +78,13 @@ define_flag("rpc_pool_size", 2,
             "streams for large concurrent results)")
 define_flag("rpc_server_workers", 8,
             "per-connection worker threads serving pipelined requests")
+define_flag("breaker_failure_threshold", 5,
+            "consecutive connection failures to one peer before its "
+            "circuit breaker opens (calls then fail fast instead of "
+            "re-timing-out against a dead host)")
+define_flag("breaker_reset_secs", 2.0,
+            "how long an open breaker waits before letting ONE "
+            "half-open probe through")
 
 
 class RpcError(Exception):
@@ -88,6 +98,13 @@ class RpcConnError(Exception):
 class FrameTooLarge(RpcConnError):
     """Send-path MAX_FRAME violation — raised before any byte is sent,
     so the connection stays usable."""
+
+
+class RpcTimeoutError(RpcConnError):
+    """Per-request timeout on a demonstrably-ALIVE connection (frames
+    arrived recently; only this request is slow).  No transport verdict
+    on the peer — the circuit breaker must not count it, or a slow-but-
+    healthy follower gets cut out of quorum by its own fsync stalls."""
 
 
 def _nbytes(b) -> int:
@@ -241,6 +258,128 @@ def is_idempotent(method: str) -> bool:
         method.startswith(_IDEMPOTENT_PREFIXES)
 
 
+# -- retry backoff + per-peer circuit breakers (ISSUE 5) --------------------
+
+
+def retry_backoff(attempt: int, base: float = 0.05, cap: float = 2.0,
+                  rng=random) -> float:
+    """Equal-jitter exponential backoff: d/2 + uniform(0, d/2) for
+    d = min(cap, base·2^attempt).  The random half de-synchronizes the
+    retry herd a leader crash creates; the deterministic half
+    guarantees real wait time per attempt (full jitter can draw ~0
+    repeatedly and burn every retry before an election settles).
+    Callers clamp the sleep to their remaining deadline budget."""
+    d = min(cap, base * (2.0 ** attempt))
+    return d / 2.0 + rng.uniform(0.0, d / 2.0)
+
+
+def deadline_sleep(delay: float):
+    """Sleep `delay`, clamped so a budgeted caller never sleeps past
+    its deadline; a KILL QUERY fired mid-sleep wakes it immediately
+    (the caller's loop-top `_cancel.check()` turns it into QueryKilled
+    instead of waiting out the full jittered backoff)."""
+    rem = _cancel.remaining()
+    if rem is not None:
+        delay = min(delay, max(rem, 0.0))
+    if delay <= 0:
+        return
+    ev = _cancel.current_kill()
+    if ev is not None:
+        ev.wait(delay)
+    else:
+        time.sleep(delay)
+
+
+class CircuitBreaker:
+    """Per-peer connection-failure breaker: closed → (K consecutive
+    failures) → open → (reset_secs) → half-open, where ONE probe is
+    admitted; probe success closes, failure re-opens.  Only transport
+    failures count — an application error proves the peer alive."""
+
+    def __init__(self, peer: str):
+        self.peer = peer
+        self.lock = threading.Lock()
+        self.failures = 0
+        self.state = "closed"
+        self.opened_at = 0.0
+        self._probing = False
+
+    def allow(self) -> bool:
+        with self.lock:
+            if self.state == "closed":
+                return True
+            try:
+                reset = float(get_config().get("breaker_reset_secs"))
+            except Exception:  # noqa: BLE001 — config not initialized
+                reset = 2.0
+            if time.monotonic() - self.opened_at < reset:
+                _stats().inc("rpc_breaker_short_circuits")
+                return False
+            if self._probing:
+                _stats().inc("rpc_breaker_short_circuits")
+                return False
+            # half-open: admit exactly one probe
+            self.state = "half_open"
+            self._probing = True
+            _stats().inc("rpc_breaker_probes")
+            return True
+
+    def record_success(self):
+        with self.lock:
+            if self.state != "closed":
+                _stats().inc_labeled("rpc_breaker_transitions",
+                                     {"to": "closed"})
+            self.state = "closed"
+            self.failures = 0
+            self._probing = False
+
+    def release_probe(self):
+        """Relinquish a half-open probe slot without a verdict: the
+        admitted call exited via a non-transport path (killed/timed-out
+        statement, oversized frame) and proved nothing about the peer.
+        The breaker stays half-open, so the NEXT caller is admitted as
+        a fresh probe — without this, an abandoned probe would leave
+        `_probing` latched and short-circuit the peer forever."""
+        with self.lock:
+            if self.state == "half_open":
+                self._probing = False
+
+    def record_failure(self):
+        with self.lock:
+            self.failures += 1
+            self._probing = False
+            try:
+                k = int(get_config().get("breaker_failure_threshold"))
+            except Exception:  # noqa: BLE001
+                k = 5
+            if self.state == "half_open" or \
+                    (self.state == "closed" and self.failures >= k):
+                if self.state != "open":
+                    _stats().inc("rpc_breaker_trips")
+                    _stats().inc_labeled("rpc_breaker_transitions",
+                                         {"to": "open"})
+                self.state = "open"
+                self.opened_at = time.monotonic()
+
+
+_breakers: Dict[str, CircuitBreaker] = {}
+_breakers_lock = threading.Lock()
+
+
+def breaker_for(peer: str) -> CircuitBreaker:
+    with _breakers_lock:
+        br = _breakers.get(peer)
+        if br is None:
+            br = _breakers[peer] = CircuitBreaker(peer)
+        return br
+
+
+def reset_breakers():
+    """Drop all breaker state (test isolation)."""
+    with _breakers_lock:
+        _breakers.clear()
+
+
 # -- pool gauges ------------------------------------------------------------
 
 _gauge_lock = threading.Lock()
@@ -322,6 +461,30 @@ class RpcServer:
     def _serve_one(self, sock, wlock, rid, req):
         reply = self._dispatch(req)
         try:
+            # the ack-lost window: the handler HAS run (possibly a
+            # committed write) but the reply never reaches the client —
+            # the hazard exactly-once dedup exists for.  The key carries
+            # method + reply disposition ("storage.write|ok" vs "|err")
+            # so schedules can target exactly the acked-write replies
+            # (killing an error reply injects a different, weaker fault)
+            method = req.get("method") if isinstance(req, dict) else None
+            ok = reply.get("ok") if isinstance(reply, dict) else None
+            fail.hit("rpc:server_reply",
+                     key=f"{method}|{'ok' if ok else 'err'}")
+        except FailpointError:
+            try:
+                # shutdown(), not close(): the connection's read-loop
+                # thread is blocked in recv() on this socket, and its
+                # in-flight syscall keeps the kernel socket alive past
+                # close() — no FIN would go out until that recv returns.
+                # shutdown() tears the connection down immediately, so
+                # the client sees the mid-call death NOW.
+                sock.shutdown(socket.SHUT_RDWR)
+                sock.close()
+            except OSError:
+                pass
+            return
+        try:
             try:
                 with wlock:
                     _send_frame(sock, reply, rid)
@@ -352,11 +515,28 @@ class RpcServer:
         spans = None
         t0 = time.perf_counter()
         try:
+            fail.hit("rpc:server_dispatch", key=method)
             for hook in self.hooks:
                 hook(method)
             fn = self.handlers.get(method)
             if fn is None:
                 return {"ok": False, "error": f"unknown method `{method}'"}
+            dl = req.get("dl")
+            if dl is not None:
+                # deadline budget rides the envelope as REMAINING
+                # seconds (fixed-width decimal string — see the client
+                # side); re-anchor on this hop's clock so nested RPCs
+                # issued by the handler inherit a decremented budget
+                dl = float(dl)
+                if dl <= 0:
+                    return {"ok": False,
+                            "error": "E_QUERY_TIMEOUT: deadline "
+                                     "exhausted before dispatch"}
+                inner, dl_abs = fn, time.monotonic() + float(dl)
+
+                def fn(p, _inner=inner, _dl=dl_abs):
+                    with _cancel.use_cancel(deadline=_dl):
+                        return _inner(p)
             if wire_trace:
                 # adopt the caller's trace: handler spans go to a fresh
                 # sink shipped back in the reply (the coordinator owns
@@ -431,9 +611,10 @@ class _Conn:
     fails every waiter at once."""
 
     __slots__ = ("sock", "send_lock", "pending", "plock", "_ids",
-                 "dead", "inflight", "last_rx", "_reader")
+                 "dead", "inflight", "last_rx", "_reader", "timeout")
 
     def __init__(self, host: str, port: int, timeout: float):
+        fail.hit("rpc:connect")     # raise here == connect refused
         sock = socket.create_connection((host, port), timeout=timeout)
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         # the socket KEEPS its timeout: a peer that stops reading must
@@ -441,6 +622,7 @@ class _Conn:
         # reader tolerates idle timeouts between frames (below), so
         # pooled connections still survive quiet periods
         self.sock = sock
+        self.timeout = timeout      # the BASE transport window
         self.send_lock = threading.Lock()
         self.pending: Dict[int, _Pending] = {}
         self.plock = threading.Lock()
@@ -485,6 +667,9 @@ class _Conn:
                     (rid,) = _LEN.unpack(mv[1:5])
                     mv = mv[5:]
                 reply = _decode_body(mv)
+                # armed kill_conn here == the connection dies with
+                # replies (possibly not ours) in flight
+                fail.hit("rpc:recv")
                 self.last_rx = time.monotonic()
                 with self.plock:
                     p = self.pending.pop(rid, None)
@@ -526,34 +711,72 @@ class _Conn:
         _gauge_delta(inflight=1)
         try:
             try:
+                # a FIRED action here kills the live connection: the
+                # request may or may not have hit the wire — the
+                # mid-call at-least-once hazard, NOT a never-sent
+                fail.hit("rpc:send", key=req.get("method"))
                 with self.send_lock:
                     sent = _send_frame(self.sock, req, rid)
             except FrameTooLarge:
                 with self.plock:
                     self.pending.pop(rid, None)
                 raise                 # connection untouched, no retry
+            except FailpointError as ex:
+                self.die(ex)
+                raise RpcConnError(f"send failed: {ex}") from None
             except OSError as ex:
                 self.die(ex)
                 raise RpcConnError(f"send failed: {ex}") from None
-            if not p.event.wait(timeout):
+            # kill-aware reply wait (ISSUE 5): when the calling thread
+            # carries a cancel context (statement-scoped call), wait in
+            # slices and poll it — KILL QUERY must interrupt an
+            # in-flight hop (e.g. a write stalled on a slow fsync), not
+            # ride out the transport timeout.  Context-free callers
+            # (heartbeats, replication) keep the single cheap wait.
+            if _cancel.current_kill() is None and \
+                    _cancel.current_deadline() is None:
+                got = p.event.wait(timeout)
+            else:
+                got, wait_dl = False, time.monotonic() + timeout
+                while not got:
+                    rem = wait_dl - time.monotonic()
+                    if rem <= 0:
+                        break
+                    got = p.event.wait(min(rem, 0.05))
+                    if not got:
+                        try:
+                            _cancel.check()
+                        except Exception:
+                            # abandoned mid-flight: rid matching makes
+                            # the late reply harmlessly droppable
+                            with self.plock:
+                                self.pending.pop(rid, None)
+                            raise
+            if not got:
                 with self.plock:
                     self.pending.pop(rid, None)
-                if time.monotonic() - self.last_rx >= timeout:
+                if time.monotonic() - self.last_rx >= \
+                        max(timeout, self.timeout):
                     # the peer has been COMPLETELY silent for a full
-                    # timeout window: treat the connection as dead so
-                    # the pool stops queueing onto a zombie socket
-                    # (fast failure detection for dead hosts)
+                    # BASE transport window: treat the connection as
+                    # dead so the pool stops queueing onto a zombie
+                    # socket (fast failure detection for dead hosts).
+                    # Judged against self.timeout, not the per-request
+                    # wait: a deadline-clamped request can time out in
+                    # milliseconds, which says nothing about the
+                    # connection — killing it would collaterally abort
+                    # sibling in-flight (possibly non-idempotent) calls
                     self.die(RpcConnError(
-                        f"peer silent for {timeout}s"))
-                else:
-                    # the connection is demonstrably alive (frames
-                    # arrived recently) — fail ONLY this request; rid
-                    # matching makes its late reply harmlessly
-                    # droppable, and sibling in-flight calls (possibly
-                    # non-idempotent, non-retryable) must not be
-                    # collaterally aborted by one slow handler
-                    pass
-                raise RpcConnError(f"rpc timeout after {timeout}s")
+                        f"peer silent for {max(timeout, self.timeout)}s"))
+                    raise RpcConnError(
+                        f"rpc timeout after {timeout}s (peer silent)")
+                # the connection is demonstrably alive (frames arrived
+                # recently) — fail ONLY this request; rid matching makes
+                # its late reply harmlessly droppable, and sibling
+                # in-flight calls (possibly non-idempotent,
+                # non-retryable) must not be collaterally aborted by
+                # one slow handler
+                raise RpcTimeoutError(f"rpc timeout after {timeout}s")
             if p.error is not None:
                 raise RpcConnError(str(p.error))
             return p.reply, sent, p.nbytes
@@ -610,7 +833,7 @@ class RpcClient:
                 return best
         try:
             c = _Conn(self.host, self.port, self.timeout)
-        except OSError as ex:
+        except (OSError, FailpointError) as ex:
             raise RpcNeverSentError(
                 f"connect to {self.host}:{self.port} failed: {ex}"
             ) from None
@@ -629,32 +852,83 @@ class RpcClient:
 
     def call(self, method: str, **params) -> Any:
         last_err: Optional[Exception] = None
+        br = breaker_for(f"{self.host}:{self.port}")
         with _trace.span(f"rpc:{method}", peer=f"{self.host}:{self.port}"):
             for attempt in range(self.retries + 1):
+                # deadline budget: no attempt (or backoff sleep) may
+                # outlive the statement's remaining budget — raises
+                # DeadlineExceeded/QueryKilled into the caller, which
+                # surfaces as E_QUERY_TIMEOUT at the graphd boundary
+                _cancel.check()
                 # per-attempt timer: a success after a reconnect must
                 # not record the dead attempt + backoff sleep as op
                 # latency (the rpc:<method> span still covers the whole
                 # call, retries included)
                 t_call = time.perf_counter()
                 req = {"method": method, "params": params}
+                timeout = self.timeout
+                rem = _cancel.remaining()
+                if rem is not None:
+                    # stamp the REMAINING seconds into the envelope (the
+                    # server re-anchors on its own clock — clock-skew-
+                    # free relative propagation) and clamp the transport
+                    # wait to the budget.  Fixed-width so identical
+                    # queries produce byte-identical frames regardless
+                    # of how much budget happens to remain (the wire-
+                    # byte work counters are a documented regression
+                    # probe — docs/OBSERVABILITY.md)
+                    req["dl"] = f"{min(max(rem, 0.001), 1e8):013.3f}"
+                    timeout = min(timeout, max(rem, 0.001))
                 tctx = _trace.wire_context()
                 if tctx is not None:
                     req["trace"] = list(tctx)
+                if not br.allow():
+                    # open breaker: fail fast, provably never sent.
+                    # Checked OUTSIDE the try: a short-circuit is not a
+                    # peer failure — recording it would clear another
+                    # thread's half-open probe and re-trip the breaker
+                    # on a call that never left the process
+                    last_err = RpcNeverSentError(
+                        f"circuit open to {self.host}:{self.port}")
+                    if attempt < self.retries:
+                        deadline_sleep(retry_backoff(attempt))
+                    continue
                 sent_any = False
                 try:
                     conn = self._pick()
                     sent_any = True     # bytes may be on the wire now
-                    reply, sent, recvd = conn.request(req, self.timeout)
+                    reply, sent, recvd = conn.request(req, timeout)
                 except FrameTooLarge:
+                    br.release_probe()
                     raise
                 except RpcNeverSentError as ex:
                     last_err = ex       # provably never sent: retryable
+                    br.record_failure()
                     if attempt < self.retries:
-                        time.sleep(0.05 * (attempt + 1))
+                        _stats().inc_labeled("rpc_client_retries",
+                                             {"op": method})
+                        deadline_sleep(retry_backoff(attempt))
+                    continue
+                except RpcTimeoutError as ex:
+                    # one slow request on an alive connection: breaker-
+                    # neutral (see RpcTimeoutError) — free any probe
+                    # slot, keep the mid-call idempotency gate below
+                    last_err = ex
+                    br.release_probe()
+                    if sent_any and not is_idempotent(method):
+                        raise RpcConnError(
+                            f"rpc {method} to {self.host}:{self.port} "
+                            f"failed mid-call and is not idempotent "
+                            f"(not retried): {ex}") from None
+                    if attempt < self.retries:
+                        _stats().inc_labeled("rpc_client_retries",
+                                             {"op": method})
+                        deadline_sleep(retry_backoff(attempt))
                     continue
                 except (OSError, RpcConnError,
                         json.JSONDecodeError) as ex:
                     last_err = ex
+                    br.record_failure()
                     # connect failures never reached the peer — always
                     # retryable; mid-call deaths may have applied the
                     # request, so only idempotent methods auto-retry
@@ -664,8 +938,19 @@ class RpcClient:
                             f"failed mid-call and is not idempotent "
                             f"(not retried): {ex}") from None
                     if attempt < self.retries:
-                        time.sleep(0.05 * (attempt + 1))
+                        _stats().inc_labeled("rpc_client_retries",
+                                             {"op": method})
+                        deadline_sleep(retry_backoff(attempt))
                     continue
+                except BaseException:
+                    # non-transport exit (QueryKilled/DeadlineExceeded
+                    # from the kill-aware reply wait): no verdict on
+                    # the peer — free the probe slot and re-raise
+                    br.release_probe()
+                    raise
+                # ANY reply proves the peer alive — an application
+                # error is not a transport failure
+                br.record_success()
                 us = (time.perf_counter() - t_call) * 1e6
                 _stats().observe("rpc_client_latency_us", us,
                                  {"op": method})
@@ -679,7 +964,17 @@ class RpcClient:
                 if reply.get("ok"):
                     return reply.get("result")
                 _stats().inc_labeled("rpc_client_errors", {"op": method})
-                raise RpcError(reply.get("error", "unknown error"))
+                err = reply.get("error", "unknown error")
+                if isinstance(err, str) and \
+                        ("E_QUERY_TIMEOUT" in err or
+                         err.startswith("DeadlineExceeded")):
+                    # the remote hop's re-anchored budget expired first
+                    # (sub-ms race with our own clock): surface the
+                    # SAME exception the local deadline check raises so
+                    # the engine boundary counts and reports timeouts
+                    # identically whichever side's clock wins
+                    raise _cancel.DeadlineExceeded(err)
+                raise RpcError(err)
         # preserve the never-sent distinction through the final raise so
         # higher-level retry loops stay double-apply-safe
         kind = RpcNeverSentError if isinstance(last_err, RpcNeverSentError) \

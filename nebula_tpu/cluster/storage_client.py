@@ -15,15 +15,16 @@ sum.  Per-hop data-plane traffic does NOT ride this in TPU mode
 from __future__ import annotations
 
 import threading
-from concurrent.futures import ThreadPoolExecutor
+from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor, wait
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ..graphstore.store import stable_vid_hash
+from ..utils import cancel as _cancel
 from ..utils import trace as _trace
-from ..utils.stats import current_work, use_work
+from ..utils.stats import current_work, stats as _stats, use_work
 from .meta_client import MetaClient
 from .rpc import (RpcClient, RpcConnError, RpcError, RpcNeverSentError,
-                  is_idempotent)
+                  deadline_sleep, is_idempotent, retry_backoff)
 
 
 class StorageError(Exception):
@@ -70,41 +71,75 @@ class StorageClient:
         return out
 
     def _call_part(self, space: str, pid: int, method: str,
-                   params: Dict[str, Any], retries: int = 4) -> Any:
+                   params: Dict[str, Any], retries: int = 6) -> Any:
         last: Optional[Exception] = None
+        # a (writer_id, seq) idempotency token makes re-sending safe for
+        # ANY method: storaged's raft-replicated dedup window returns the
+        # recorded outcome instead of double-applying — the mid-call
+        # abort below flips into a replica-walk retry (ISSUE 5)
+        resendable = is_idempotent(method) or \
+            (isinstance(params, dict) and params.get("token") is not None)
         for attempt in range(retries):
+            # between attempts the statement's deadline/kill budget is
+            # the authority — a killed query must not keep walking
+            _cancel.check()
             pm = self.meta.parts_of(space)
-            replicas = pm[pid]
-            # leader first, then the rest (covers stale maps)
-            for addr in replicas:
+            # leader first, then the rest (covers stale maps); a
+            # "part_leader_changed: <addr>" hint extends the walk — a
+            # fresh post-failover leader is reachable THIS attempt, long
+            # before the heartbeat → metad → refresh pipeline reorders
+            # the part map (the upstream storage client's leader walk)
+            queue = list(pm[pid])
+            tried = set()
+            qi = 0
+            while qi < len(queue):
+                addr = queue[qi]
+                qi += 1
+                if addr in tried:
+                    continue
+                tried.add(addr)
                 try:
                     return self._client(addr).call(
                         method, space=space, part=pid, **params)
                 except RpcError as ex:
                     last = ex
-                    if "part_leader_changed" in str(ex) or \
-                            "not hosted here" in str(ex):
+                    msg = str(ex)
+                    if "part_leader_changed" in msg or \
+                            "not hosted here" in msg:
+                        hint = msg.rsplit(": ", 1)[-1].strip()
+                        if ":" in hint and hint not in tried:
+                            queue.append(hint)
+                        _stats().inc_labeled("storage_replica_walk_retries",
+                                             {"op": method})
                         continue
-                    raise StorageError(str(ex)) from None
+                    raise StorageError(msg) from None
                 except RpcNeverSentError as ex:
                     last = ex           # never reached the peer: walk on
+                    _stats().inc_labeled("storage_replica_walk_retries",
+                                         {"op": method})
                     continue
                 except RpcConnError as ex:
                     last = ex
                     # the request MAY have applied before the connection
                     # died — walking replicas / retrying would re-send
-                    # it, so only idempotent methods keep going (the
-                    # same at-least-once gate RpcClient.call applies,
-                    # one layer up where the replica walk lives)
-                    if is_idempotent(method):
+                    # it, so only idempotent methods and tokened
+                    # (dedup-protected) writes keep going; everything
+                    # else surfaces the at-least-once hazard to the
+                    # caller (same gate RpcClient.call applies, one
+                    # layer up where the replica walk lives)
+                    if resendable:
+                        _stats().inc_labeled("storage_replica_walk_retries",
+                                             {"op": method})
                         continue
                     raise StorageError(
                         f"{method} to part {pid} of `{space}' failed "
                         f"mid-call; not retried (non-idempotent): {ex}"
                     ) from None
-            # election / part creation may be in flight — back off briefly
-            import time
-            time.sleep(0.1 * (attempt + 1))
+            # election / part creation may be in flight — jittered
+            # exponential backoff, clamped to the remaining deadline
+            # budget (a herd of retriers after a leader crash must not
+            # resynchronize on fixed sleeps)
+            deadline_sleep(retry_backoff(attempt, base=0.1))
             self.meta.refresh(force=True)
         raise StorageError(f"part {pid} of `{space}' unreachable: {last}")
 
@@ -117,15 +152,39 @@ class StorageClient:
         RPC/wire-byte counts attribute to the query that fanned out."""
         tctx = _trace.current_ctx()
         wc = current_work()
+        kill = _cancel.current_kill()
+        dl = _cancel.current_deadline()
 
         def run(pid, params):
+            # cancel context rides to the pool thread like trace/work do:
+            # the per-part call clamps its RPC timeouts and backoff to
+            # the statement budget, and stops walking when killed
             with _trace.use_ctx(tctx), use_work(wc), \
+                    _cancel.use_cancel(kill=kill, deadline=dl), \
                     _trace.span(f"storage:{method}", part=pid,
                                 space=space):
                 return self._call_part(space, pid, method, params)
 
         futs = {pid: self._pool.submit(run, pid, params)
                 for pid, params in by_part.items()}
+        # kill-aware wait (ISSUE 5 satellite): KILL QUERY during the
+        # fan-out must not block on a stalled partition until its RPC
+        # timeout — poll the cancel context while waiting.  Context-
+        # free callers (admin/balance paths) keep the single cheap
+        # blocking collect instead of a 20Hz poll loop
+        if kill is None and dl is None:
+            return [(pid, f.result()) for pid, f in sorted(futs.items())]
+        pending = set(futs.values())
+        try:
+            while pending:
+                done, pending = wait(pending, timeout=0.05,
+                                     return_when=FIRST_COMPLETED)
+                if pending:
+                    _cancel.check()
+        except (_cancel.QueryKilled, _cancel.DeadlineExceeded):
+            for f in pending:
+                f.cancel()          # unstarted parts never dispatch
+            raise
         return [(pid, f.result()) for pid, f in sorted(futs.items())]
 
     def all_parts(self, space: str) -> List[int]:

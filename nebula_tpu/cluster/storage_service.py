@@ -21,6 +21,7 @@ from ..core import wire
 from ..core.wire import from_wire, to_wire
 from ..graphstore.store import GraphStore
 from ..utils import trace as _trace
+from ..utils.failpoints import fail
 from .meta_client import MetaClient
 from .raft import RaftPart
 from .rpc import RpcError, RpcRaftTransport, RpcServer
@@ -287,6 +288,16 @@ class StorageService:
             # in-half whose out-half never landed
             for sub in cmd[1]:
                 self._apply_cmd(space, tuple(sub))
+        elif op == "dbatch":
+            # exactly-once apply gate (ISSUE 5): a tokened write request
+            # rides the log as ONE entry; a duplicate proposal of the
+            # same (writer, seq) — client re-send after a lost reply,
+            # racing the original's commit under a new leader — is
+            # recognized HERE, deterministically on every replica, and
+            # skipped.  This is what makes the mid-call-abort →
+            # replica-walk-retry flip safe.
+            _, pid, writer, seq, cmds = cmd
+            self._apply_dbatch(space, pid, writer, seq, cmds)
         elif op == "vertex":
             _, vid, tag, ver, row = cmd
             st.apply_vertex(space, vid, tag, ver, row)
@@ -322,6 +333,36 @@ class StorageService:
             st.apply_chain_done(space, cmd[1], cmd[2])
         else:
             raise ValueError(f"unknown storage op {op!r}")
+
+    def _apply_dbatch(self, space: str, pid: int, writer: str, seq: int,
+                      cmds):
+        from ..utils.stats import stats
+        rec = self.store.dedup_seen(space, pid, writer, seq)
+        if rec is not None:
+            # already applied (the original proposal committed despite
+            # the client's lost reply): exact-once means NO re-apply —
+            # and the skip must report the SAME outcome the original
+            # recorded, including its failure (silently succeeding here
+            # would ack the retry of a write whose apply FAILED)
+            stats().inc("storage_write_dedup_apply_skips")
+            if rec.get("err"):
+                raise ValueError(rec["err"])
+            return
+        errs = []
+        for sub in cmds:
+            try:
+                self._apply_cmd(space, tuple(sub))
+            except Exception as ex:      # noqa: BLE001
+                errs.append(str(ex))
+        # the outcome (including a per-command apply failure) is part of
+        # the record: a deduped retry must report the SAME result the
+        # original would have
+        self.store.dedup_record(space, pid, writer, seq,
+                                {"n": len(cmds),
+                                 "err": errs[0] if errs else None})
+        if errs:
+            raise ValueError(errs[0] + (f" (+{len(errs) - 1} more)"
+                                        if len(errs) > 1 else ""))
 
     def start(self):
         self.meta.start_heartbeat(parts_fn=self.owned_parts)
@@ -437,8 +478,35 @@ class StorageService:
         # least as new as the issuer's (the leader-only RPC check
         # would leave replica index state stale until failover)
         ver = max(cat_ver, self.meta.version)
-        stamped = [wire.dumps(("v", ver, list(_validate_cmd(cmd))))
-                   for cmd in p["cmds"]]
+        tok = p.get("token")
+        if tok is not None:
+            # exactly-once (ISSUE 5): the request's (writer_id, seq)
+            # token gates a fast-path ack — if the ORIGINAL send already
+            # applied (reply lost, client walked to us), return its
+            # recorded outcome instead of re-proposing.  The window is
+            # replicated state (written in dbatch apply), so this check
+            # is correct on a freshly-failed-over leader too; the
+            # _apply_committed() brings the window up to this leader's
+            # commit index first.  Even a miss here is safe: the dbatch
+            # apply gate skips duplicates deterministically.
+            writer, seq = tok[0], int(tok[1])
+            part._apply_committed()
+            rec = self.store.dedup_seen(space, pid, writer, seq)
+            if rec is not None:
+                from ..utils.stats import stats
+                stats().inc("storage_write_dedup_hits")
+                if rec.get("err"):
+                    raise RpcError(f"write apply failed: {rec['err']}")
+                return rec.get("n", len(p["cmds"]))
+            stamped = [wire.dumps(
+                ("v", ver, ["dbatch", pid, writer, seq,
+                            [list(_validate_cmd(c)) for c in p["cmds"]]]))]
+        else:
+            stamped = [wire.dumps(("v", ver, list(_validate_cmd(cmd))))
+                       for cmd in p["cmds"]]
+        # chaos hook: the leader-kill-mid-batch schedule arms a crash
+        # callable here — the request is validated but not yet proposed
+        fail.hit("storage:pre_propose", key=part.group)
         # ONE batched proposal for the request: one WAL sync + one
         # replication wake for N commands (group commit, ISSUE 3)
         with _trace.span("raft:propose_batch", group=part.group,

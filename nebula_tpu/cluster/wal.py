@@ -152,6 +152,12 @@ class Wal:
         with self._sync_mu:
             if self._synced_upto >= index:
                 return                 # a sibling's fsync covered us
+            # armed `delay` = an fsync stall (held under _sync_mu, so it
+            # stalls the whole group-commit sync like a slow disk does);
+            # armed `raise` = a disk fault — propagates like a real
+            # fsync error would
+            from ..utils.failpoints import fail as _fail
+            _fail.hit("wal:pre_fsync", key=self.path)
             with self.lock:
                 flushed = (self._entries[-1][0] if self._entries
                            else self._first_index - 1)

@@ -373,8 +373,32 @@ class QueryEngine:
         stmt_ectx.kill_event = _threading.Event()
         session.queries[qid] = text
         session.running_kill[qid] = stmt_ectx.kill_event
+        # statement deadline budget (ISSUE 5): the timeout becomes an
+        # absolute monotonic deadline in the thread-local cancel
+        # context; the RPC client clamps every hop to the remaining
+        # budget and ships it in the envelope, so graphd → storaged →
+        # metad hops all run under ONE decremented budget
+        from ..utils import cancel as _cancel
+        timeout_s = 0.0
         try:
-            data = self.scheduler.run(plan, stmt_ectx, profile_stats)
+            timeout_s = float(get_config().get("query_timeout_secs"))
+        except Exception:  # noqa: BLE001 — config not initialized
+            pass
+        dl = (time.monotonic() + timeout_s) if timeout_s > 0 else None
+        try:
+            with _cancel.use_cancel(kill=stmt_ectx.kill_event,
+                                    deadline=dl):
+                data = self.scheduler.run(plan, stmt_ectx, profile_stats)
+        except _cancel.DeadlineExceeded:
+            from ..utils.stats import stats
+            stats().inc("query_deadline_exceeded")
+            return ResultSet(
+                error=f"E_QUERY_TIMEOUT: statement exceeded "
+                      f"query_timeout_secs={timeout_s:g}",
+                space=plan.space)
+        except _cancel.QueryKilled:
+            return ResultSet(error="ExecutionError: query was killed",
+                             space=plan.space)
         except Exception as ex:  # noqa: BLE001 — runtime errors go to client
             return ResultSet(error=f"ExecutionError: {ex}", space=plan.space)
         finally:

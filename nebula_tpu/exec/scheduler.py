@@ -75,9 +75,15 @@ class Scheduler:
         # snapshot the submitting thread's trace context once: parallel
         # branches run exec_one on pool threads, which must attribute
         # their spans and work counts to the SAME statement
+        from ..utils import cancel as _cancel
         from ..utils import trace
         from ..utils.stats import use_work
         tctx = trace.current_ctx()
+        # snapshot the statement's cancel context once, like the trace
+        # context: parallel branches run on pool threads, and their RPC
+        # hops must clamp to the SAME deadline budget
+        c_kill = _cancel.current_kill()
+        c_dl = _cancel.current_deadline()
 
         def exec_one(node: PlanNode):
             kill = getattr(ectx, "kill_event", None)
@@ -88,8 +94,12 @@ class Scheduler:
             if profile is not None:
                 self.qctx.last_tpu_stats = None
             with trace.use_ctx(tctx), \
+                    _cancel.use_cancel(kill=c_kill, deadline=c_dl), \
                     use_work(getattr(ectx, "work", None)), \
                     trace.span(f"exec:{node.kind}", node=node.id) as rec:
+                # deadline check between plan nodes: a budget spent in
+                # an earlier node must not start the next one
+                _cancel.check()
                 ds = run_node(node, self.qctx, ectx, plan.space)
                 if rec is not None and ds is not None:
                     rec.setdefault("attrs", {})["rows"] = len(ds.rows)

@@ -25,6 +25,7 @@ from __future__ import annotations
 import hashlib
 import itertools
 import threading
+from collections import OrderedDict
 from typing import Any, Dict, Iterable, List, Optional, Tuple
 
 from ..core.value import NULL, is_null
@@ -53,11 +54,17 @@ def stable_vid_hash(vid: Any) -> int:
     raise TypeError(f"unsupported vid type {type(vid).__name__}")
 
 
+#: per-part exactly-once dedup window size (ISSUE 5): (writer, seq)
+#: records evicted in insertion order — DETERMINISTIC, because eviction
+#: happens inside raft apply, so every replica evicts identically
+DEDUP_WINDOW = 1024
+
+
 class Partition:
     """One shard: vertices + out/in adjacency, dict-backed."""
 
     __slots__ = ("part_id", "vertices", "out_edges", "in_edges",
-                 "pending_chains")
+                 "pending_chains", "applied_writes")
 
     def __init__(self, part_id: int):
         self.part_id = part_id
@@ -71,6 +78,13 @@ class Partition:
         # (the out-half part remembers the in-half it owes the dst part
         # until the chain is confirmed — SURVEY §2 row 14)
         self.pending_chains: Dict[str, Dict[str, Any]] = {}
+        # exactly-once dedup window (ISSUE 5): (writer_id, seq) →
+        # {"n": cmd count, "err": first apply error or None}.  Written
+        # ONLY inside raft apply (dbatch), so it is replicated state —
+        # a re-proposed request is recognized on every replica and on
+        # any post-failover leader.  Part of the part-state snapshot.
+        self.applied_writes: "OrderedDict[Tuple[str, int], Dict[str, Any]]" \
+            = OrderedDict()
 
     def edge_count(self) -> int:
         return sum(len(m) for per in self.out_edges.values() for m in per.values())
@@ -881,6 +895,31 @@ class GraphStore:
         with sd.lock:
             return dict(sd.parts[pid].pending_chains)
 
+    # ---- exactly-once write dedup (ISSUE 5) ----
+
+    def dedup_seen(self, space: str, pid: int, writer: str,
+                   seq: int) -> Optional[Dict[str, Any]]:
+        """The recorded outcome of an already-applied (writer, seq)
+        write request, or None.  Checked by the leader's rpc_write
+        fast path AND by dbatch apply (the replicated, race-free
+        gate)."""
+        sd = self.space(space)
+        with sd.lock:
+            return sd.parts[pid].applied_writes.get((writer, int(seq)))
+
+    def dedup_record(self, space: str, pid: int, writer: str, seq: int,
+                     outcome: Dict[str, Any]):
+        """Record a write request's outcome in the part's dedup window.
+        Called ONLY from dbatch apply — replicas call it in identical
+        commit order, so window contents and eviction are identical
+        everywhere."""
+        sd = self.space(space)
+        with sd.lock:
+            aw = sd.parts[pid].applied_writes
+            aw[(writer, int(seq))] = outcome
+            while len(aw) > DEDUP_WINDOW:
+                aw.popitem(last=False)
+
     # ---- part state snapshot (raft snapshot + checkpoint payload) ----
 
     def part_state_payload(self, space: str, pid: int) -> Dict[str, Any]:
@@ -900,6 +939,10 @@ class GraphStore:
                 "dense": {v: d for v, d in sd.vid_to_dense.items()
                           if d % sd.num_parts == pid},
                 "chains": p.pending_chains,
+                # ordered list form: JSON keys must be strings, and the
+                # WINDOW ORDER (eviction order) is itself state
+                "writes": [[w, s, rec]
+                           for (w, s), rec in p.applied_writes.items()],
             }
 
     def export_part_state(self, space: str, pid: int) -> bytes:
@@ -921,6 +964,8 @@ class GraphStore:
             p.out_edges = st["out_edges"]
             p.in_edges = st["in_edges"]
             p.pending_chains = st.get("chains", {})
+            p.applied_writes = OrderedDict(
+                ((w, int(s)), rec) for w, s, rec in st.get("writes", []))
             sd.part_counts[pid] = st["part_count"]
             sd.install_dense(st["dense"])
             sd.epoch += 1
@@ -944,6 +989,7 @@ class GraphStore:
             p.out_edges = {}
             p.in_edges = {}
             p.pending_chains = {}
+            p.applied_writes = OrderedDict()
             sd.part_counts[pid] = 0
             for v, d in list(sd.vid_to_dense.items()):
                 if d % sd.num_parts == pid:

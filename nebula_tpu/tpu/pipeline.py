@@ -51,7 +51,9 @@ from ..graphstore.csr import INT_NULL
 from ..graphstore.schema import PropType
 from ..query import optimizer as opt
 from ..query.plan import PlanNode, walk_plan
+from ..utils import cancel as _cancel
 from ..utils import trace
+from ..utils.failpoints import FailpointError, fail
 from ..utils.config import define_flag, get_config
 from ..utils.stats import stats
 from .device import TpuUnavailable
@@ -667,6 +669,11 @@ class _Runner:
     def run(self, ops: List[Dict[str, Any]]):
         out = None
         for op in ops:
+            # KILL QUERY / deadline between segments (ISSUE 5
+            # satellite): a fused pipeline used to be uninterruptible
+            # until the result boundary — a kill now lands at the next
+            # segment instead of after the whole program
+            _cancel.check()
             out = getattr(self, "_x_" + op["op"])(op)
             if isinstance(out, ColumnarFrame):
                 self.regs.append(out)
@@ -760,6 +767,10 @@ class _Runner:
         steps = op["steps"]
         hops = op["hops"]
         if n_seeds:
+            # chaos site: an armed raise here == the device rejected
+            # the dispatch (OOM, resets); the executor's fallback path
+            # runs the stashed row subplan — never wrong, only absent
+            fail.hit("tpu:dispatch", key=self.space)
             vids = [self.d2v[d] for d in seed_dense.tolist()]
             frames, st = self.rt.traverse_hops(
                 self.store, self.space, vids, op["etypes"],
@@ -1177,7 +1188,13 @@ def _tpu_match_pipeline(node, qctx, ectx, space):
             qctx.last_tpu_stats = runner.stats
             stats().inc("match_pipeline_fused")
             return ds
-        except (CannotCompile, TpuUnavailable) + _JAX_RT_ERRORS as ex:
+        except (CannotCompile, TpuUnavailable, FailpointError) \
+                + _JAX_RT_ERRORS as ex:
+            # FailpointError here is the injected device-dispatch
+            # failure (chaos schedule 5): same contract as a real
+            # runtime fault — fall back to the stashed row subplan.
+            # QueryKilled/DeadlineExceeded are NOT in this tuple: a
+            # killed statement must die, not fall back.
             qctx.last_tpu_fallback = f"{type(ex).__name__}: {ex}"
             reason = f"runtime:{type(ex).__name__}"
     elif rt is not None:

@@ -148,6 +148,10 @@ define_flag("slow_query_threshold_us", 500_000,
             "queries slower than this land in the slow log")
 define_flag("heartbeat_interval_secs", 1.0,
             "meta heartbeat period for graphd/storaged")
+define_flag("query_timeout_secs", 300.0,
+            "statement deadline budget: propagated (and decremented) "
+            "across every RPC hop of the statement; exceeding it "
+            "surfaces E_QUERY_TIMEOUT.  0 disables")
 define_flag("session_idle_timeout_secs", 28800,
             "idle sessions are reaped after this")
 define_flag("max_match_hops", 12, "safety cap for unbounded MATCH *")

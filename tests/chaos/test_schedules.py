@@ -1,0 +1,235 @@
+"""Seeded fault schedules over a live 3-replica cluster (ISSUE 5).
+
+Five distinct schedules — leader kill mid-batch, fsync stall storm,
+torn TOSS chains, partitioned metad, device dispatch failure — plus a
+reply-loss storm, each asserting the acked-write-exactly-once and
+replica-convergence invariants.  Marked `chaos` + `slow`: NOT part of
+the tier-1 gate.  Reproduce any failure with the seed in its header:
+
+    python -m nebula_tpu.tools.chaos_bench --schedule <name> --seed <n>
+"""
+import threading
+import time
+
+import pytest
+
+from nebula_tpu.utils.failpoints import FaultSchedule, fail
+from nebula_tpu.utils.stats import stats
+
+from harness import (ChaosCluster, assert_acked_exactly_once,
+                     counter_value, counter_workload, mixed_workload)
+
+pytestmark = [pytest.mark.chaos, pytest.mark.slow]
+
+
+def _batched_insert(n: int, base: int = 5000) -> str:
+    vals = ", ".join(f'{base + i}:("b{base + i}",{(i * 7) % 97 + 1})'
+                     for i in range(n))
+    return f"INSERT VERTEX Person(name, age) VALUES {vals}"
+
+
+# -- schedule 1: leader kill mid-batch (the acceptance scenario) ------------
+
+
+def test_leader_kill_mid_batch(tmp_path):
+    """SEED=101.  One batched INSERT; the storaged leading the most
+    parts is hard-killed after the schedule's chosen propose.  The
+    statement must still ack (tokened replica-walk retry), and the
+    final store state must equal the fault-free twin's."""
+    ref = ChaosCluster(data_dir=str(tmp_path / "ref"))
+    try:
+        ref.ok(_batched_insert(120))
+        ref.wait_replicas_converged(require=3)
+        want = ref.logical_state()
+    finally:
+        ref.stop()
+
+    cc = ChaosCluster(data_dir=str(tmp_path / "chaos"))
+    try:
+        kill_at = 2                     # the schedule: 3rd propose dies
+        trigger = threading.Event()
+        done = threading.Event()
+
+        def decide(idx, key):
+            if idx == kill_at:
+                trigger.set()
+                done.wait(5.0)          # hold THIS propose till the kill
+            return None
+
+        def killer():
+            trigger.wait(30.0)
+            cc.kill_storaged(cc.leader_of_most_parts())
+            done.set()
+
+        fail.arm_callable("storage:pre_propose", decide)
+        kt = threading.Thread(target=killer)
+        kt.start()
+        r = cc.run(_batched_insert(120))
+        kt.join()
+        fail.disarm("storage:pre_propose")
+        assert trigger.is_set(), "kill never triggered — nothing proven"
+        assert r.error is None, f"batched INSERT died with the leader: " \
+                                f"{r.error}"
+        retries = sum(v for k, v in stats().snapshot().items()
+                      if k.startswith("storage_replica_walk_retries"))
+        assert retries >= 1, "no replica-walk retry happened"
+        cc.wait_replicas_converged(require=2)
+        assert cc.logical_state() == want, \
+            "chaos run diverged from the fault-free twin"
+    finally:
+        cc.stop()
+
+
+# -- schedule 2: fsync stall storm ------------------------------------------
+
+
+def test_fsync_stall_storm(tmp_path):
+    """SEED=202.  Random 80ms WAL fsync stalls on the storage plane
+    under a mixed workload: every acked write survives, replicas
+    re-converge byte-identically."""
+    cc = ChaosCluster(data_dir=str(tmp_path / "c"))
+    try:
+        sched = FaultSchedule(202, [
+            {"fp": "wal:pre_fsync", "action": "delay", "arg": 0.08,
+             "p": 0.35, "key": "storage", "max": 25},
+        ]).arm(fail)
+        led = mixed_workload(cc, seed=202, n_writes=60)
+        sched.disarm(fail)
+        assert sched.fired.get("wal:pre_fsync", 0) >= 5, \
+            f"storm too weak: {sched.fired}"
+        assert not led.failed, f"writes failed under stalls: {led.failed}"
+        assert_acked_exactly_once(cc, led)
+        cc.wait_replicas_converged(require=3)
+    finally:
+        cc.stop()
+
+
+# -- schedule 3: torn TOSS chains -------------------------------------------
+
+
+def test_torn_toss_chain(tmp_path):
+    """SEED=303.  Edge inserts with the chain torn between the
+    journaled out-half and the in-half: failed statements are allowed,
+    but the janitor must re-drive every journaled chain — no pending
+    journals, both halves present, replicas converged."""
+    cc = ChaosCluster(data_dir=str(tmp_path / "c"))
+    try:
+        cc.ok(_batched_insert(40, base=9000))
+        sched = FaultSchedule(303, [
+            {"fp": "toss:pre_in", "action": "raise", "p": 0.5, "max": 4},
+        ]).arm(fail)
+        acked_edges = []
+        for k in range(24):
+            s, d = 9000 + k, 9000 + (k + 1) % 40
+            r = cc.run(f"INSERT EDGE KNOWS(w) VALUES {s}->{d}:({k})")
+            if r.error is None:
+                acked_edges.append((s, d, k))
+        sched.disarm(fail)
+        assert sched.fired.get("toss:pre_in", 0) >= 1, "no chain torn"
+        # janitor drains every journaled chain, replicas converge
+        cc.wait_no_pending_chains()
+        cc.wait_replicas_converged(require=3)
+        # every ACKED edge serves from BOTH planes (out-half + in-half)
+        for s, d, w in acked_edges:
+            r = cc.ok(f"GO FROM {s} OVER KNOWS YIELD dst(edge) AS d, "
+                      f"KNOWS.w AS w")
+            assert [d, w] in r.data.rows, f"out-half lost {s}->{d}"
+            r = cc.ok(f"GO FROM {d} OVER KNOWS REVERSELY YIELD "
+                      f"src(edge) AS s, KNOWS.w AS w")
+            assert [s, w] in r.data.rows, f"in-half lost {s}->{d}"
+    finally:
+        cc.stop()
+
+
+# -- schedule 4: partitioned metad ------------------------------------------
+
+
+def test_partitioned_metad(tmp_path):
+    """SEED=404.  A 3-metad quorum with half its replication rounds
+    dropped: writes (which heartbeat/refresh through metad) keep
+    acking via the jittered leader walk, and the data plane converges."""
+    cc = ChaosCluster(n_meta=3, data_dir=str(tmp_path / "c"))
+    try:
+        sched = FaultSchedule(404, [
+            {"fp": "raft:replicate", "action": "raise", "p": 0.5,
+             "key": "meta", "max": 60},
+        ]).arm(fail)
+        led = mixed_workload(cc, seed=404, n_writes=40, vid_base=2000)
+        sched.disarm(fail)
+        assert sched.fired.get("raft:replicate", 0) >= 10, \
+            f"partition too weak: {sched.fired}"
+        assert not led.failed, f"writes failed: {led.failed}"
+        assert_acked_exactly_once(cc, led)
+        cc.wait_replicas_converged(require=3)
+    finally:
+        cc.stop()
+
+
+# -- schedule 5: device dispatch failure ------------------------------------
+
+
+def test_device_dispatch_failure(tmp_path):
+    """SEED=505.  Fused MATCH pipelines with half their device
+    dispatches failing: every query answers with the host plane's
+    exact rows (stashed-subplan fallback — never wrong, only absent)."""
+    from nebula_tpu.tpu.device import make_mesh
+    from nebula_tpu.tpu.runtime import TpuRuntime
+    cc = ChaosCluster(data_dir=str(tmp_path / "c"), parts=8,
+                      tpu_runtime=TpuRuntime(make_mesh()))
+    try:
+        cc.ok(_batched_insert(40, base=100))
+        for k in range(60):
+            s, d = 100 + (k * 3) % 40, 100 + (k * 7 + 1) % 40
+            if s != d:
+                cc.run(f"INSERT EDGE KNOWS(w) VALUES {s}->{d}:({k})")
+        q = ("MATCH (a:Person)-[:KNOWS]->(b:Person) "
+             "WHERE id(a) IN [100,101,102,103,104,105] "
+             "WITH DISTINCT b MATCH (b)-[:KNOWS]->(c:Person) "
+             "RETURN id(b) AS x, id(c) AS y ORDER BY x, y")
+        want = cc.ok(q).data.rows       # warm, fault-free answer
+        sched = FaultSchedule(505, [
+            {"fp": "tpu:dispatch", "action": "raise", "p": 0.5},
+        ]).arm(fail)
+        for _ in range(10):
+            r = cc.ok(q)
+            assert r.data.rows == want, "fallback changed the answer"
+        sched.disarm(fail)
+        assert sched.fired.get("tpu:dispatch", 0) >= 2, \
+            f"dispatch faults never fired: {sched.fired}"
+    finally:
+        cc.stop()
+
+
+# -- schedule 6: reply-loss storm (the dedup machinery under fire) ----------
+
+
+def test_reply_loss_storm(tmp_path):
+    """SEED=606.  Acked storage.write replies killed at random under a
+    sequential counter workload: every acked increment lands exactly
+    once (final value == acked count when nothing failed), and the
+    dedup machinery demonstrably engaged."""
+    cc = ChaosCluster(data_dir=str(tmp_path / "c"))
+    try:
+        sched = FaultSchedule(606, [
+            {"fp": "rpc:server_reply", "action": "raise", "p": 0.4,
+             "key": "storage.write|ok", "max": 8},
+        ]).arm(fail)
+        acked, failed = counter_workload(cc, seed=606, n=30)
+        led = mixed_workload(cc, seed=606, n_writes=30, vid_base=3000)
+        sched.disarm(fail)
+        assert sched.fired.get("rpc:server_reply", 0) >= 3, \
+            f"storm too weak: {sched.fired}"
+        snap = stats().snapshot()
+        dedup = snap.get("storage_write_dedup_hits", 0) + \
+            snap.get("storage_write_dedup_apply_skips", 0)
+        assert dedup >= 1, "re-sends were never deduplicated"
+        n = counter_value(cc)
+        if failed == 0:
+            assert n == acked, \
+                f"exactly-once violated: {n} != {acked} acked"
+        else:
+            assert acked <= n <= acked + failed, (n, acked, failed)
+        assert_acked_exactly_once(cc, led)
+        cc.wait_replicas_converged(require=3)
+    finally:
+        cc.stop()

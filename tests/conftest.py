@@ -18,3 +18,11 @@ except Exception:
     pass
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: excluded from the tier-1 gate (-m 'not slow')")
+    config.addinivalue_line(
+        "markers", "chaos: seeded fault-schedule tests over a live "
+        "cluster (tests/chaos/; always also marked slow)")

@@ -1,0 +1,285 @@
+"""Failpoint registry + retry/backoff/breaker primitives (ISSUE 5).
+
+The deterministic fault-injection layer everything in tests/chaos/
+stands on: spec parsing, action chains, seeded schedules, the cancel
+context, equal-jitter backoff, and the per-peer circuit breaker.
+"""
+import random
+import threading
+import time
+
+import pytest
+
+from nebula_tpu.cluster.rpc import (CircuitBreaker, deadline_sleep,
+                                    retry_backoff)
+from nebula_tpu.utils import cancel
+from nebula_tpu.utils.failpoints import (ConnectionKilled, FailpointError,
+                                         FailpointRegistry, FaultSchedule,
+                                         _parse_spec)
+
+
+# -- spec parsing -----------------------------------------------------------
+
+
+def test_parse_spec_chain():
+    assert _parse_spec("2*off->1*raise(boom)") == \
+        [[2, "off", None], [1, "raise", "boom"]]
+    assert _parse_spec("delay(0.25)") == [[1, "delay", 0.25]]
+    assert _parse_spec("delay") == [[1, "delay", 0.05]]
+    assert _parse_spec("-1*kill_conn") == [[-1, "kill_conn", None]]
+
+
+@pytest.mark.parametrize("bad", ["", "nope", "2*", "raise(", "3*frob"])
+def test_parse_spec_rejects_garbage(bad):
+    with pytest.raises(ValueError):
+        _parse_spec(bad)
+
+
+# -- registry behavior ------------------------------------------------------
+
+
+def test_unarmed_hit_is_noop():
+    reg = FailpointRegistry()
+    reg.hit("never:armed")          # no raise, no counter
+    assert reg.hit_count("never:armed") == 0
+
+
+def test_chain_counts_and_exhaustion():
+    reg = FailpointRegistry()
+    reg.arm("x", "2*off->1*raise(boom)")
+    reg.hit("x")
+    reg.hit("x")                    # two skipped
+    with pytest.raises(FailpointError, match="boom"):
+        reg.hit("x")
+    # chain exhausted → site disarmed, further hits are no-ops
+    reg.hit("x")
+    assert "x" not in reg.armed()
+    assert reg.hit_count("x") == 3  # the post-disarm hit doesn't count
+
+
+def test_forever_term_never_exhausts():
+    reg = FailpointRegistry()
+    reg.arm("x", "-1*raise")
+    for _ in range(5):
+        with pytest.raises(FailpointError):
+            reg.hit("x")
+    assert "x" in reg.armed()
+
+
+def test_kill_conn_raises_connection_killed():
+    reg = FailpointRegistry()
+    reg.arm("x", "kill_conn")
+    with pytest.raises(ConnectionKilled):
+        reg.hit("x")
+
+
+def test_delay_sleeps():
+    reg = FailpointRegistry()
+    reg.arm("x", "delay(0.05)")
+    t0 = time.monotonic()
+    reg.hit("x")
+    assert time.monotonic() - t0 >= 0.04
+
+
+def test_scoped_restores_armed_set():
+    reg = FailpointRegistry()
+    reg.arm("keep", "-1*off")
+    with reg.scoped():
+        reg.arm("temp", "-1*raise")
+        reg.disarm("keep")
+        assert reg.armed() == ["temp"]
+    assert reg.armed() == ["keep"]
+
+
+def test_env_arming(monkeypatch):
+    monkeypatch.setenv("NEBULA_FAILPOINTS",
+                       "a:b=raise(x); c:d=2*off->delay(0.1)")
+    reg = FailpointRegistry()
+    assert reg.armed() == ["a:b", "c:d"]
+    with pytest.raises(FailpointError, match="x"):
+        reg.hit("a:b")
+
+
+# -- seeded schedules -------------------------------------------------------
+
+
+def _fire_pattern(seed, hits=200, p=0.25):
+    reg = FailpointRegistry()
+    FaultSchedule(seed, [{"fp": "s", "action": "raise", "p": p}]).arm(reg)
+    pat = []
+    for _ in range(hits):
+        try:
+            reg.hit("s")
+            pat.append(0)
+        except FailpointError:
+            pat.append(1)
+    return pat
+
+
+def test_schedule_is_deterministic_per_seed():
+    a, b = _fire_pattern(7), _fire_pattern(7)
+    assert a == b
+    assert sum(a) > 0              # it does fire
+    assert _fire_pattern(8) != a   # and the seed matters
+
+
+def test_schedule_after_and_max():
+    reg = FailpointRegistry()
+    sched = FaultSchedule(1, [{"fp": "s", "action": "raise",
+                               "p": 1.0, "after": 3, "max": 2}])
+    sched.arm(reg)
+    fired = 0
+    for _ in range(10):
+        try:
+            reg.hit("s")
+        except FailpointError:
+            fired += 1
+    assert fired == 2
+    assert sched.fired == {"s": 2}
+
+
+def test_schedule_key_filter():
+    reg = FailpointRegistry()
+    FaultSchedule(1, [{"fp": "s", "action": "raise", "p": 1.0,
+                       "key": "meta"}]).arm(reg)
+    reg.hit("s", key="storage/p3")          # filtered out
+    with pytest.raises(FailpointError):
+        reg.hit("s", key="meta")
+    # the decision stream stays aligned with the hit index: the
+    # filtered hit consumed draw #0, the firing one draw #1
+    assert reg.hit_count("s") == 2
+
+
+def test_schedule_disarm():
+    reg = FailpointRegistry()
+    sched = FaultSchedule(1, [{"fp": "s", "action": "raise", "p": 1.0}])
+    sched.arm(reg)
+    sched.disarm(reg)
+    reg.hit("s")                    # disarmed: no raise
+
+
+# -- backoff + deadline sleep -----------------------------------------------
+
+
+def test_retry_backoff_equal_jitter_bounds():
+    rng = random.Random(3)
+    for attempt in range(8):
+        d = min(2.0, 0.05 * (2 ** attempt))
+        for _ in range(50):
+            v = retry_backoff(attempt, rng=rng)
+            assert d / 2 <= v <= d
+
+
+def test_deadline_sleep_clamps_to_budget():
+    with cancel.use_cancel(deadline=time.monotonic() + 0.05):
+        t0 = time.monotonic()
+        deadline_sleep(5.0)
+        assert time.monotonic() - t0 < 0.5
+
+
+# -- cancel context ---------------------------------------------------------
+
+
+def test_cancel_check_noop_without_context():
+    cancel.check()
+    assert cancel.remaining() is None
+
+
+def test_cancel_deadline_and_kill():
+    with cancel.use_cancel(deadline=time.monotonic() - 1):
+        with pytest.raises(cancel.DeadlineExceeded):
+            cancel.check()
+    ev = threading.Event()
+    with cancel.use_cancel(kill=ev):
+        cancel.check()
+        ev.set()
+        with pytest.raises(cancel.QueryKilled):
+            cancel.check()
+
+
+def test_cancel_nesting_inner_never_loosens():
+    outer = time.monotonic() + 1.0
+    with cancel.use_cancel(deadline=outer):
+        with cancel.use_cancel(deadline=outer + 100):
+            assert cancel.current_deadline() == outer
+        with cancel.use_cancel(deadline=outer - 0.5):
+            assert cancel.current_deadline() == outer - 0.5
+        assert cancel.current_deadline() == outer
+    assert cancel.current_deadline() is None
+
+
+# -- circuit breaker --------------------------------------------------------
+
+
+def test_breaker_trips_after_k_failures_and_half_opens():
+    from nebula_tpu.utils.config import get_config
+    get_config().set_dynamic("breaker_failure_threshold", 3)
+    get_config().set_dynamic("breaker_reset_secs", 0.05)
+    try:
+        br = CircuitBreaker("peer")
+        for _ in range(3):
+            assert br.allow()
+            br.record_failure()
+        assert br.state == "open"
+        assert not br.allow()               # short-circuit while open
+        time.sleep(0.06)
+        assert br.allow()                   # ONE half-open probe
+        assert not br.allow()               # second caller short-circuits
+        br.record_failure()                 # probe failed → re-open
+        assert br.state == "open"
+        time.sleep(0.06)
+        assert br.allow()
+        br.record_success()                 # probe ok → closed
+        assert br.state == "closed" and br.failures == 0
+        assert br.allow()
+    finally:
+        get_config().set_dynamic("breaker_failure_threshold", 5)
+        get_config().set_dynamic("breaker_reset_secs", 2.0)
+
+
+def test_breaker_success_resets_failure_streak():
+    br = CircuitBreaker("peer")
+    for _ in range(4):
+        br.record_failure()
+    br.record_success()
+    br.record_failure()
+    assert br.state == "closed"     # streak broken: 1 < K
+
+
+def test_breaker_abandoned_probe_releases_slot():
+    """A half-open probe that exits via a non-transport path (killed
+    statement, FrameTooLarge) must free the probe slot — a latched
+    `_probing` would short-circuit the peer forever."""
+    br = CircuitBreaker("peer")
+    br.state, br.opened_at = "open", time.monotonic() - 10
+    assert br.allow()               # admitted as THE probe
+    assert not br.allow()           # slot taken
+    br.release_probe()              # abandoned without a verdict
+    assert br.state == "half_open"
+    assert br.allow()               # fresh probe admitted
+    br.record_success()
+    assert br.state == "closed"
+
+
+def test_breaker_short_circuit_does_not_record_failure():
+    """A call denied by an open/probing breaker never left the process:
+    it must not count as a peer failure (that would clear another
+    thread's in-flight probe and re-trip the breaker on nothing)."""
+    from nebula_tpu.cluster.rpc import (RpcClient, RpcNeverSentError,
+                                        breaker_for, reset_breakers)
+    from nebula_tpu.utils.config import get_config
+    reset_breakers()
+    get_config().set_dynamic("breaker_reset_secs", 0.01)
+    try:
+        cl = RpcClient("127.0.0.1", 9, retries=0)   # nothing listens
+        br = breaker_for("127.0.0.1:9")
+        br.state, br.opened_at = "open", time.monotonic() - 1.0
+        assert br.allow()           # this thread holds the probe
+        assert br.state == "half_open" and br._probing
+        with pytest.raises(RpcNeverSentError, match="circuit open"):
+            cl.call("meta.ready")   # denied: probe in flight
+        # the in-flight probe and breaker state are untouched
+        assert br.state == "half_open" and br._probing
+    finally:
+        reset_breakers()
+        get_config().set_dynamic("breaker_reset_secs", 2.0)

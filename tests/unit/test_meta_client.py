@@ -1,0 +1,102 @@
+"""MetaClient leader-hint walk (ISSUE 5 satellite).
+
+The hint grammar is "not leader; leader=<addr>".  A garbled or empty
+hint (election in flight, truncated message) must clear the cached
+leader and re-probe — never adopt free text as an address.  When every
+metad is down the walk backs off with jittered exponential sleeps.
+"""
+import time
+
+import pytest
+
+from nebula_tpu.cluster.meta_client import MetaClient, MetaError
+from nebula_tpu.cluster.rpc import RpcConnError, RpcError
+from nebula_tpu.utils import cancel
+from nebula_tpu.utils.stats import stats
+
+
+class FakeRpc:
+    """Scripted RpcClient stand-in: each call pops the next behavior
+    (exception to raise, or value to return; the last repeats)."""
+
+    def __init__(self, *script):
+        self.script = list(script)
+        self.calls = 0
+
+    def call(self, method, **params):
+        self.calls += 1
+        b = self.script.pop(0) if len(self.script) > 1 else self.script[0]
+        if isinstance(b, Exception):
+            raise b
+        return b
+
+
+def _mc(fakes):
+    mc = MetaClient(sorted(fakes), heartbeat_interval=1.0)
+    mc._clients = dict(fakes)
+    return mc
+
+
+def test_leader_hint_followed():
+    mc = _mc({"a:1": FakeRpc(RpcError("not leader; leader=c:3")),
+              "b:2": FakeRpc(RpcError("not leader; leader=c:3")),
+              "c:3": FakeRpc({"v": 1})})
+    assert mc.call("meta.x") == {"v": 1}
+    assert mc._leader == "c:3"
+    # subsequent calls go straight to the cached leader
+    mc.call("meta.x")
+    assert mc._clients["c:3"].calls == 2
+
+
+@pytest.mark.parametrize("reply", [
+    "not leader",                      # no '=' at all (garbled)
+    "not leader; leader=",             # empty hint (election in flight)
+])
+def test_garbled_hint_clears_cache_and_reprobes(reply):
+    mc = _mc({"a:1": FakeRpc(RpcError(reply)),
+              "b:2": FakeRpc({"v": 2}),
+              "c:3": FakeRpc(RpcError(reply))})
+    assert mc.call("meta.x") == {"v": 2}
+    assert mc._leader == "b:2"
+    # the old bug: split("=", 1)[-1] on a hint-less message adopted the
+    # whole message text as an address; no such "client" may appear
+    assert set(mc._clients) == {"a:1", "b:2", "c:3"}
+
+
+def test_non_leader_error_is_not_hint():
+    mc = _mc({"a:1": FakeRpc(RpcError("space `x' not found"))})
+    with pytest.raises(MetaError, match="not found"):
+        mc.call("meta.x")
+
+
+def test_all_metads_down_backoff_timing():
+    mc = _mc({"a:1": FakeRpc(RpcConnError("refused")),
+              "b:2": FakeRpc(RpcConnError("refused"))})
+    before = stats().snapshot().get("meta_leader_walk_retries", 0)
+    t0 = time.monotonic()
+    with pytest.raises(MetaError, match="no metad leader reachable"):
+        mc.call("meta.x", _retries=3)
+    elapsed = time.monotonic() - t0
+    after = stats().snapshot().get("meta_leader_walk_retries", 0)
+    assert after - before == 2          # sleeps BETWEEN attempts only
+    # equal-jitter exponential, base 0.1: attempts 0,1 sleep at least
+    # d/2 = 0.05 + 0.10, at most d = 0.10 + 0.20 (plus walk overhead)
+    assert 0.14 <= elapsed <= 1.0, elapsed
+
+
+def test_deadline_stops_the_walk():
+    mc = _mc({"a:1": FakeRpc(RpcConnError("refused"))})
+    with cancel.use_cancel(deadline=time.monotonic() - 0.001):
+        t0 = time.monotonic()
+        with pytest.raises(cancel.DeadlineExceeded):
+            mc.call("meta.x")
+        assert time.monotonic() - t0 < 0.5
+
+
+def test_conn_error_clears_cached_leader():
+    fakes = {"a:1": FakeRpc(RpcConnError("refused"), {"v": 3}),
+             "b:2": FakeRpc({"v": 9})}
+    mc = _mc(fakes)
+    mc._leader = "a:1"
+    assert mc.call("meta.x") == {"v": 9}
+    assert mc._leader == "b:2"

@@ -3,8 +3,11 @@ random graphs (hypothesis)') — hypothesis drives the input spaces and
 shrinks failures; each property states an invariant two independent
 implementations must share."""
 import numpy as np
-from hypothesis import HealthCheck, given, settings
-from hypothesis import strategies as st
+import pytest
+
+pytest.importorskip("hypothesis")        # container without it: skip module
+from hypothesis import HealthCheck, given, settings  # noqa: E402
+from hypothesis import strategies as st  # noqa: E402
 
 _slow = settings(max_examples=25, deadline=None,
                  suppress_health_check=[HealthCheck.too_slow])
