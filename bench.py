@@ -960,6 +960,56 @@ def main():
             v for k, v in _snap.items()
             if k.startswith("match_pipeline_fallback")),
     }
+    # ---- observability block (ISSUE 8): flight-recorder overhead A/B
+    # (sampling ON at rate 1.0 — every statement retained — vs OFF) on
+    # a small host-path statement where fixed per-statement cost is
+    # most visible.  Medians over enough repeats to beat VM noise; the
+    # acceptance bar is ≤ 2% on the north-star config, where the
+    # per-statement work dwarfs the recorder's dict inserts.
+    _mark("config obs: flight recorder overhead A/B")
+    from nebula_tpu.exec.engine import QueryEngine as _ObsQE
+    from nebula_tpu.utils.config import get_config as _obs_cfg
+    from nebula_tpu.utils.flight import flight_recorder as _obs_fr
+    from nebula_tpu.utils.slo import slo_engine as _obs_slo
+    obs_eng = _ObsQE(store)
+    obs_sess = obs_eng.new_session()
+    obs_eng.execute(obs_sess, "USE snb")
+    obs_q = (f"GO FROM {seed_list} OVER KNOWS YIELD dst(edge) AS d")
+    obs_rep = 40
+
+    def _obs_p50(rate: float) -> float:
+        _obs_cfg().set_dynamic("flight_sample_rate", rate)
+        obs_eng.execute(obs_sess, obs_q)          # warm
+        ol = []
+        for _ in range(obs_rep):
+            t0 = time.perf_counter()
+            rs = obs_eng.execute(obs_sess, obs_q)
+            ol.append(time.perf_counter() - t0)
+            assert rs.error is None, rs.error
+        return _median(ol)
+
+    try:
+        off_p50 = _obs_p50(0.0)
+        on_p50 = _obs_p50(1.0)
+    finally:
+        _obs_cfg().dynamic_layer.pop("flight_sample_rate", None)
+    obs_overhead = max((on_p50 - off_p50) / off_p50, 0.0) \
+        if off_p50 > 0 else 0.0
+    slo_rows = _obs_slo().burn_rates()
+    observability = {
+        "flight_off_p50_ms": round(off_p50 * 1e3, 3),
+        "flight_on_p50_ms": round(on_p50 * 1e3, 3),
+        "flight_overhead_pct": round(obs_overhead * 100.0, 2),
+        "flight_entries": len(_obs_fr().list(limit=10_000)),
+        "slo_burn_1h": {
+            f"{r['objective']}": r["burn"] for r in slo_rows
+            if r["window"] == "1h"},
+        "scheduler_parallel_plans":
+            _stats().snapshot().get("scheduler_parallel_plans", 0),
+        "flight_records": sum(
+            v for k, v in _stats().snapshot().items()
+            if k.startswith("flight_records")),
+    }
     # ---- fault_recovery block (ISSUE 5 satellite): two seeded chaos
     # schedules over a live 3-replica cluster — the highest-impact crash
     # (leader kill mid-workload) and the dedup window's home turf (acked
@@ -1045,6 +1095,7 @@ def main():
         "supernode_skew": skew,
         "regression": regression,
         "fault_recovery": fault_recovery,
+        "observability": observability,
         "configs": configs,
     }
     if tpu_partial is not None:

@@ -78,13 +78,33 @@ def main(argv=None):
             svc = GraphService(args.addr, mc, server=server, tpu_runtime=rt)
 
     server.start()
-    svc.start()
     web = None
+    fed = None
     if args.ws_port != 0:
         from .webservice import WebService
         ws_port = args.ws_port if args.ws_port > 0 else int(port) + 1000
         web = WebService(role=args.role, host=host, port=ws_port)
+        if args.role == "metad":
+            # metric federation (ISSUE 8): this metad scrapes every
+            # daemon's /metrics (addresses ride the heartbeats) into
+            # one labeled /cluster_metrics view
+            from .federation import MetricFederator
+            fed = MetricFederator(svc, self_ws=web.addr)
+            web.providers["/cluster_metrics"] = lambda q: (
+                200, fed.render(),
+                "text/plain; version=0.0.4; charset=utf-8")
+            import json as _json
+            web.providers["/federation"] = lambda q: (
+                200, _json.dumps(fed.scrape_status(), default=str),
+                "application/json")
+        else:
+            # tell metad where to scrape us (rides the heartbeat) —
+            # set BEFORE svc.start() so the first heartbeat carries it
+            mc.ws_addr = web.addr
         web.start()
+    svc.start()
+    if fed is not None:
+        fed.start()
     # startup object graph (services, raft parts, jax runtime) is
     # permanent — freeze it out of the GC scan set; periodic gen-2
     # collections over a loaded jax runtime stall queries by ~250 ms
@@ -99,6 +119,8 @@ def main(argv=None):
     signal.signal(signal.SIGINT, lambda *a: stop.set())
     while not stop.is_set():
         time.sleep(0.5)
+    if fed is not None:
+        fed.stop()
     svc.stop()
     server.stop()
     if web is not None:

@@ -30,6 +30,10 @@ class MetaClient:
         self.meta_addrs = list(meta_addrs)
         self.my_addr = my_addr
         self.role = role
+        # this daemon's HTTP admin (webservice) address, carried in the
+        # heartbeat so metad's metric federation knows where to scrape
+        # (empty = no webservice / not scrapable)
+        self.ws_addr = ""
         if heartbeat_interval is None:
             from ..utils.config import get_config
             heartbeat_interval = float(
@@ -127,7 +131,7 @@ class MetaClient:
     def heartbeat_once(self) -> Dict[str, Any]:
         parts = self._hb_parts_fn() if self._hb_parts_fn else {}
         r = self.call("meta.heartbeat", host=self.my_addr, role=self.role,
-                      parts=parts)
+                      parts=parts, ws=self.ws_addr)
         if r["version"] != self.version:
             self.refresh(force=True)
         return r

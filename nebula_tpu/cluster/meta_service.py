@@ -357,7 +357,9 @@ class MetaService:
         host, role = p["host"], p["role"]
         self.active_hosts[host] = {
             "role": role, "last_hb": time.monotonic(),
-            "parts": p.get("parts", {})}
+            "parts": p.get("parts", {}),
+            # webservice addr for metric federation scrapes (ISSUE 8)
+            "ws": p.get("ws", "")}
         with self.state_lock:
             return {"version": self.state.version,
                     "leader": self.raft.is_leader()}
@@ -367,7 +369,7 @@ class MetaService:
         exp = _hb_expire_s()
         return [{"addr": a, "role": h["role"],
                  "alive": now - h["last_hb"] < exp,
-                 "parts": h["parts"]}
+                 "parts": h["parts"], "ws": h.get("ws", "")}
                 for a, h in sorted(self.active_hosts.items())]
 
     def storage_hosts(self) -> List[str]:

@@ -67,7 +67,8 @@ from ..utils import cancel as _cancel
 from ..utils import trace as _trace
 from ..utils.config import define_flag, get_config
 from ..utils.failpoints import ConnectionKilled, FailpointError, fail
-from ..utils.stats import current_work, stats as _stats
+from ..utils.stats import (CostRecorder, current_cost, current_work,
+                           stats as _stats, use_cost)
 
 _LEN = struct.Struct("<I")
 MAX_FRAME = 1 << 30
@@ -322,16 +323,24 @@ class CircuitBreaker:
             self.state = "half_open"
             self._probing = True
             _stats().inc("rpc_breaker_probes")
-            return True
+        # trace coverage (ISSUE 8 satellite): breaker state changes land
+        # in the statement's trace tree with the peer labeled
+        _trace.record_phase("rpc:breaker", 0.0, peer=self.peer,
+                            to="half_open")
+        return True
 
     def record_success(self):
         with self.lock:
-            if self.state != "closed":
+            reopened = self.state != "closed"
+            if reopened:
                 _stats().inc_labeled("rpc_breaker_transitions",
                                      {"to": "closed"})
             self.state = "closed"
             self.failures = 0
             self._probing = False
+        if reopened:
+            _trace.record_phase("rpc:breaker", 0.0, peer=self.peer,
+                                to="closed")
 
     def release_probe(self):
         """Relinquish a half-open probe slot without a verdict: the
@@ -345,6 +354,7 @@ class CircuitBreaker:
                 self._probing = False
 
     def record_failure(self):
+        tripped = False
         with self.lock:
             self.failures += 1
             self._probing = False
@@ -358,8 +368,12 @@ class CircuitBreaker:
                     _stats().inc("rpc_breaker_trips")
                     _stats().inc_labeled("rpc_breaker_transitions",
                                          {"to": "open"})
+                    tripped = True
                 self.state = "open"
                 self.opened_at = time.monotonic()
+        if tripped:
+            _trace.record_phase("rpc:breaker", 0.0, peer=self.peer,
+                                to="open")
 
 
 _breakers: Dict[str, CircuitBreaker] = {}
@@ -513,6 +527,31 @@ class RpcServer:
         params = req.get("params", {})
         wire_trace = req.get("trace")
         spans = None
+        # cost attribution (ISSUE 8 tentpole): when the caller flagged
+        # the request ("c"), the handler runs under a fresh CostRecorder
+        # — the service layers (storage reads, WAL fsyncs, dedup hits,
+        # nested RPCs) fold their per-hop costs into it, and the record
+        # rides back in the reply envelope for per-plan-node
+        # attribution on the coordinator.  The handler time is shipped
+        # as a FIXED-WIDTH decimal so reply byte counts stay
+        # deterministic for the wire-byte regression probes.
+        crec = CostRecorder() if req.get("c") else None
+
+        def _cost_of(reply: Dict[str, Any]) -> Dict[str, Any]:
+            if crec is not None:
+                # timing fields merged from NESTED replies (plain ints,
+                # e.g. remote_us of a TOSS in-half hop) must not ship
+                # upward: their digit count varies run-to-run, which
+                # would break the wire-byte determinism the fixed-width
+                # `us` exists for — and this handler's wall time below
+                # already covers nested handler time (the nested call
+                # ran inside it)
+                c = {k: v for k, v in crec.as_dict().items()
+                     if not k.endswith("_us")}
+                c["us"] = f"{min(int((time.perf_counter() - t0) * 1e6), 10 ** 9 - 1):09d}"
+                reply["cost"] = c
+            return reply
+
         t0 = time.perf_counter()
         try:
             fail.hit("rpc:server_dispatch", key=method)
@@ -544,10 +583,14 @@ class RpcServer:
                 with _trace.adopt_remote(wire_trace[0], wire_trace[1],
                                          self.service_role) as rg:
                     spans = rg.spans
-                    with _trace.span(f"rpc.server:{method}"):
+                    with _trace.span(f"rpc.server:{method}"), \
+                            use_cost(crec):
                         result = fn(params)
-                return {"ok": True, "result": result, "spans": spans}
-            return {"ok": True, "result": fn(params)}
+                return _cost_of({"ok": True, "result": result,
+                                 "spans": spans})
+            with use_cost(crec):
+                result = fn(params)
+            return _cost_of({"ok": True, "result": result})
         except RpcError as ex:
             reply = {"ok": False, "error": str(ex)}
             if spans:
@@ -555,12 +598,12 @@ class RpcServer:
                 # its error attr) are precisely what a failing query's
                 # trace needs — ship them like the success path does
                 reply["spans"] = spans
-            return reply
+            return _cost_of(reply)
         except Exception as ex:  # noqa: BLE001 — server must not die
             reply = {"ok": False, "error": f"{type(ex).__name__}: {ex}"}
             if spans:
                 reply["spans"] = spans
-            return reply
+            return _cost_of(reply)
         finally:
             # observe error-path latencies too: a histogram that only
             # sees successes understates the tail it exists to expose.
@@ -852,7 +895,18 @@ class RpcClient:
 
     def call(self, method: str, **params) -> Any:
         last_err: Optional[Exception] = None
-        br = breaker_for(f"{self.host}:{self.port}")
+        peer = f"{self.host}:{self.port}"
+        br = breaker_for(peer)
+        cc = current_cost()
+
+        def note_retry(ex: Exception, attempt: int):
+            _stats().inc_labeled("rpc_client_retries", {"op": method})
+            # trace coverage (ISSUE 8 satellite): every retry attempt
+            # is a leaf in the statement's trace with the peer labeled
+            _trace.record_phase("rpc:retry", 0.0, peer=peer, op=method,
+                                attempt=attempt,
+                                error=type(ex).__name__)
+
         with _trace.span(f"rpc:{method}", peer=f"{self.host}:{self.port}"):
             for attempt in range(self.retries + 1):
                 # deadline budget: no attempt (or backoff sleep) may
@@ -882,6 +936,10 @@ class RpcClient:
                 tctx = _trace.wire_context()
                 if tctx is not None:
                     req["trace"] = list(tctx)
+                if cc is not None:
+                    # ask the peer for a cost record in the reply
+                    # envelope (per-plan-node attribution, ISSUE 8)
+                    req["c"] = 1
                 if not br.allow():
                     # open breaker: fail fast, provably never sent.
                     # Checked OUTSIDE the try: a short-circuit is not a
@@ -891,6 +949,13 @@ class RpcClient:
                     last_err = RpcNeverSentError(
                         f"circuit open to {self.host}:{self.port}")
                     if attempt < self.retries:
+                        # trace only — the breaker short-circuit never
+                        # re-sent anything, so the rpc_client_retries
+                        # counter (an internal-re-send measure feeding
+                        # retry_amplification) must not move
+                        _trace.record_phase(
+                            "rpc:retry", 0.0, peer=peer, op=method,
+                            attempt=attempt, error="CircuitOpen")
                         deadline_sleep(retry_backoff(attempt))
                     continue
                 sent_any = False
@@ -905,8 +970,7 @@ class RpcClient:
                     last_err = ex       # provably never sent: retryable
                     br.record_failure()
                     if attempt < self.retries:
-                        _stats().inc_labeled("rpc_client_retries",
-                                             {"op": method})
+                        note_retry(ex, attempt)
                         deadline_sleep(retry_backoff(attempt))
                     continue
                 except RpcTimeoutError as ex:
@@ -921,8 +985,7 @@ class RpcClient:
                             f"failed mid-call and is not idempotent "
                             f"(not retried): {ex}") from None
                     if attempt < self.retries:
-                        _stats().inc_labeled("rpc_client_retries",
-                                             {"op": method})
+                        note_retry(ex, attempt)
                         deadline_sleep(retry_backoff(attempt))
                     continue
                 except (OSError, RpcConnError,
@@ -938,8 +1001,7 @@ class RpcClient:
                             f"failed mid-call and is not idempotent "
                             f"(not retried): {ex}") from None
                     if attempt < self.retries:
-                        _stats().inc_labeled("rpc_client_retries",
-                                             {"op": method})
+                        note_retry(ex, attempt)
                         deadline_sleep(retry_backoff(attempt))
                     continue
                 except BaseException:
@@ -957,6 +1019,17 @@ class RpcClient:
                 wc = current_work()
                 if wc is not None:
                     wc.add_rpc(sent, recvd)
+                if cc is not None:
+                    # fold the peer's cost record (success AND error
+                    # replies — a failing node's costs still land in
+                    # PROFILE / the flight recorder) plus our own
+                    # call/byte counts into the active node's sink
+                    rcost = reply.get("cost")
+                    if isinstance(rcost, dict):
+                        cc.merge_reply(rcost)
+                    cc.add("calls", 1)
+                    cc.add("bytes_sent", sent)
+                    cc.add("bytes_recv", recvd)
                 # remote spans come back on error replies too — a
                 # failing branch's storaged subtree must still land in
                 # the coordinator's trace

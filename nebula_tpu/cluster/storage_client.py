@@ -21,7 +21,8 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 from ..graphstore.store import stable_vid_hash
 from ..utils import cancel as _cancel
 from ..utils import trace as _trace
-from ..utils.stats import current_work, stats as _stats, use_work
+from ..utils.stats import (current_cost, current_work, stats as _stats,
+                           use_cost, use_work)
 from .meta_client import MetaClient
 from .rpc import (RpcClient, RpcConnError, RpcError, RpcNeverSentError,
                   deadline_sleep, is_idempotent, retry_backoff)
@@ -152,14 +153,17 @@ class StorageClient:
         RPC/wire-byte counts attribute to the query that fanned out."""
         tctx = _trace.current_ctx()
         wc = current_work()
+        cc = current_cost()
         kill = _cancel.current_kill()
         dl = _cancel.current_deadline()
 
         def run(pid, params):
-            # cancel context rides to the pool thread like trace/work do:
-            # the per-part call clamps its RPC timeouts and backoff to
-            # the statement budget, and stops walking when killed
-            with _trace.use_ctx(tctx), use_work(wc), \
+            # cancel context rides to the pool thread like trace/work/
+            # cost do: the per-part call clamps its RPC timeouts and
+            # backoff to the statement budget, stops walking when
+            # killed, and attributes reply-envelope cost records to the
+            # plan node that fanned out
+            with _trace.use_ctx(tctx), use_work(wc), use_cost(cc), \
                     _cancel.use_cancel(kill=kill, deadline=dl), \
                     _trace.span(f"storage:{method}", part=pid,
                                 space=space):

@@ -493,8 +493,17 @@ class StorageService:
             part._apply_committed()
             rec = self.store.dedup_seen(space, pid, writer, seq)
             if rec is not None:
-                from ..utils.stats import stats
+                from ..utils.stats import current_cost, stats
                 stats().inc("storage_write_dedup_hits")
+                # trace + cost coverage (ISSUE 8 satellite): the fast-
+                # path hit is a zero-duration leaf in the statement's
+                # trace (shipped back in the reply spans) and a
+                # `dedup_hits` field in the reply cost record
+                _trace.record_phase("storage:dedup_hit", 0.0, part=pid,
+                                    writer=writer, seq=seq)
+                cc = current_cost()
+                if cc is not None:
+                    cc.add("dedup_hits", 1)
                 if rec.get("err"):
                     raise RpcError(f"write apply failed: {rec['err']}")
                 return rec.get("n", len(p["cmds"]))
@@ -553,6 +562,10 @@ class StorageService:
                                        limit,
                                        stats_prefix="storage_pushdown")
             raw = list(it)
+            # per-hop cost record (ISSUE 8): the reply envelope tells
+            # the coordinator how many rows this part produced — the
+            # remote half of PROFILE's per-node attribution
+            self._cost_rows(len(raw))
             cols = _neighbors_columnar(raw)
             if cols is not None:
                 if sp_rec is not None:
@@ -584,6 +597,13 @@ class StorageService:
             return None
         return {k: to_wire(v) for k, v in row.items()}
 
+    @staticmethod
+    def _cost_rows(n: int):
+        from ..utils.stats import current_cost
+        cc = current_cost()
+        if cc is not None:
+            cc.add("rows", n)
+
     def rpc_scan_vertices(self, p):
         self._leader_part(p["space"], p["part"])
         out = []
@@ -591,6 +611,7 @@ class StorageService:
                 p["space"], p.get("tag"), parts=[p["part"]]):
             out.append([to_wire(vid), tag,
                         {k: to_wire(v) for k, v in row.items()}])
+        self._cost_rows(len(out))
         return out
 
     def rpc_scan_edges(self, p):
@@ -600,6 +621,7 @@ class StorageService:
                 p["space"], p.get("etype"), parts=[p["part"]]):
             out.append([to_wire(src), et, rank, to_wire(dst),
                         {k: to_wire(v) for k, v in row.items()}])
+        self._cost_rows(len(out))
         return out
 
     def rpc_index_scan(self, p):
@@ -614,6 +636,7 @@ class StorageService:
         ents = self.store.index_scan(p["space"], p["index"],
                                      from_wire(p["eq"]), rng,
                                      parts=[p["part"]])
+        self._cost_rows(len(ents))
         return [to_wire(list(e) if isinstance(e, tuple) else e)
                 for e in ents]
 

@@ -183,10 +183,16 @@ class Wal:
                 raise
             covered = flushed - self._synced_upto
             self._synced_upto = flushed
-            from ..utils.stats import stats
+            from ..utils.stats import current_cost, stats
             stats().inc("wal_fsync_total")
             if covered > 0:
                 stats().inc("wal_fsync_batch_entries", covered)
+            # cost attribution (ISSUE 8): the request whose thread ran
+            # the group fsync carries it in its reply cost record
+            # (coalesced siblings ride free — documented approximation)
+            cc = current_cost()
+            if cc is not None:
+                cc.add("wal_fsyncs", 1)
 
     def last_index(self) -> int:
         with self.lock:

@@ -9,8 +9,18 @@ mount, SURVEY §0]):
     GET /traces          recent trace summaries (`?id=<tid>` for one
                          trace's spans; add `&format=text` for the
                          indented tree rendering)
+    GET /flight          flight-recorder summaries (`?id=<n>` for one
+                         entry's full per-operator breakdown) (ISSUE 8)
+    GET /kernels         device kernel ledger: recent dispatches with
+                         shape bucket / compile-vs-cache / µs / HBM
+    GET /slo             multi-window SLO burn rates (availability +
+                         latency objectives)
     GET /flags           all flag values (`?format=json`)
     PUT /flags           body `name=value` (or JSON object) — live update
+
+Role-specific endpoints (metad's `/cluster_metrics` federation view)
+are mounted through the `providers` dict: path → fn(query_dict) →
+(status, body, content_type).
 
 Plus TPU-build extras under /stats: device gauges (HBM bytes pinned,
 last hop stats) fed through the same StatsManager.
@@ -20,18 +30,32 @@ from __future__ import annotations
 import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Optional
+from typing import Callable, Dict, Optional, Tuple
 from urllib.parse import parse_qsl, urlparse
 
 from ..utils.config import ConfigError, get_config
+from ..utils.flight import flight_recorder, kernel_ledger
+from ..utils.slo import slo_engine
 from ..utils.stats import stats
 from ..utils.trace import render_tree, trace_store
+
+# provider signature: fn(query: dict) -> (http status, body, ctype)
+Provider = Callable[[dict], Tuple[int, str, str]]
+
+
+def _int_q(q: dict, key: str, default: int) -> int:
+    try:
+        return int(q.get(key, default))
+    except (TypeError, ValueError):
+        return default
 
 
 class WebService:
     def __init__(self, role: str = "unknown", host: str = "127.0.0.1",
-                 port: int = 0):
+                 port: int = 0,
+                 providers: Optional[Dict[str, Provider]] = None):
         self.role = role
+        self.providers: Dict[str, Provider] = dict(providers or {})
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -64,6 +88,15 @@ class WebService:
                     else:
                         self._send(200, stats().to_text())
                 elif u.path == "/metrics":
+                    # refresh this process's slo_burn_* gauges on every
+                    # scrape: the objectives measure THIS daemon's
+                    # statement traffic, and without this a federated
+                    # view would carry stale/absent burn rates for the
+                    # graphds — the processes whose burn matters
+                    try:
+                        slo_engine().burn_rates()
+                    except Exception:  # noqa: BLE001 — gauges best-effort
+                        pass
                     self._send(200, stats().to_prometheus(),
                                "text/plain; version=0.0.4; "
                                "charset=utf-8")
@@ -84,6 +117,40 @@ class WebService:
                                    json.dumps(trace_store().list(),
                                               default=str),
                                    "application/json")
+                elif u.path == "/flight":
+                    # flight recorder (ISSUE 8): the per-operator
+                    # breakdown of sampled/slow/failed statements,
+                    # retrievable after the fact
+                    fid = q.get("id")
+                    if fid:
+                        try:
+                            entry = flight_recorder().get(int(fid))
+                        except ValueError:
+                            entry = None
+                        if entry is None:
+                            self._send(404, f"no flight entry `{fid}'")
+                        else:
+                            self._send(200, json.dumps(entry,
+                                                       default=str),
+                                       "application/json")
+                    else:
+                        limit = _int_q(q, "limit", 50)
+                        self._send(200,
+                                   json.dumps(
+                                       flight_recorder().list(limit),
+                                       default=str),
+                                   "application/json")
+                elif u.path == "/kernels":
+                    limit = _int_q(q, "limit", 100)
+                    self._send(200,
+                               json.dumps(kernel_ledger().list(limit),
+                                          default=str),
+                               "application/json")
+                elif u.path == "/slo":
+                    self._send(200,
+                               json.dumps(slo_engine().burn_rates(),
+                                          default=str),
+                               "application/json")
                 elif u.path == "/flags":
                     vals = get_config().all_values()
                     if as_json:
@@ -92,6 +159,12 @@ class WebService:
                     else:
                         self._send(200, "\n".join(
                             f"{k}={vals[k]}" for k in sorted(vals)))
+                elif u.path in outer.providers:
+                    try:
+                        code, body, ctype = outer.providers[u.path](q)
+                    except Exception as ex:  # noqa: BLE001 — 500, not death
+                        code, body, ctype = 500, str(ex), "text/plain"
+                    self._send(code, body, ctype)
                 else:
                     self._send(404, "not found")
 

@@ -32,6 +32,10 @@ _define_flag("plan_cache_size", 128,
              "parsed-plan LRU entries per engine (0 disables); keyed by "
              "(statement text, space, schema epoch) — DDL bumps the "
              "epoch, so stale plans can never hit")
+_define_flag("slow_log_capacity", 256,
+             "slow-log entries retained per engine (ring buffer; the "
+             "old unbounded list leaked one dict per slow query for "
+             "the life of the process)")
 
 # read-only statement kinds whose plans are reusable verbatim: planning
 # depends only on (text, space, catalog) for these.  DML/DDL/admin
@@ -131,7 +135,15 @@ class QueryEngine:
         self.scheduler = Scheduler(self.qctx)
         self.enable_optimizer = enable_optimizer
         self._slow_override = (params or {}).get("slow_query_threshold_us")
-        self.slow_log: list = []
+        # bounded ring (ISSUE 8 satellite): the capacity flag is read at
+        # engine construction; a deque drops the oldest entry itself
+        from collections import deque
+        try:
+            from ..utils.config import get_config as _gc
+            _cap = int(_gc().get("slow_log_capacity"))
+        except Exception:  # noqa: BLE001 — config not initialized
+            _cap = 256
+        self.slow_log: "deque" = deque(maxlen=max(_cap, 1))
         self.sessions: Dict[int, Session] = {}
         # parse/plan LRU (ISSUE 2): repeated statements skip
         # parse → validate → plan → optimize entirely
@@ -231,7 +243,17 @@ class QueryEngine:
         except ParseError as ex:
             stats().inc("num_queries")
             stats().inc("num_query_errors")
-            return ResultSet(error=f"SyntaxError: {ex}")
+            err = f"SyntaxError: {ex}"
+            # forced capture covers parse errors too (ISSUE 8): a flood
+            # of malformed statements burns SLO availability budget and
+            # must leave flight-recorder evidence, not just counters
+            from ..utils.flight import flight_recorder
+            flight_recorder().record(
+                stmt=text, kind="Parse",
+                latency_us=int((time.perf_counter() - t0) * 1e6),
+                error=err, trace_id=None, session=session.id,
+                operators=[], slow_us=self.slow_query_us)
+            return ResultSet(error=err)
         if isinstance(stmt, A.SeqSentence):
             # `a; b; c` executes sequentially — each statement plans only
             # after the previous ran, so DDL/USE side effects are visible
@@ -263,7 +285,8 @@ class QueryEngine:
         """Metrics + tracing wrapper: every statement outcome (incl.
         semantic and execution errors) is visible in /stats; every
         statement produces one trace in the trace store, queryable via
-        /traces and SHOW TRACES."""
+        /traces and SHOW TRACES — and a per-operator profile that the
+        flight recorder retains for sampled/slow/failed statements."""
         from ..utils import trace
         from ..utils.config import get_config
         from ..utils.stats import stats
@@ -272,30 +295,44 @@ class QueryEngine:
         if get_config().get("enable_query_tracing"):
             tg = trace.start_trace(f"query:{kind}", service="graphd",
                                    stmt=text[:200], session=session.id)
+        # always-on observation (ISSUE 8): per-node timings/rows/remote
+        # cost are collected for EVERY statement — PROFILE renders them,
+        # the flight recorder retains them for the queries that matter
+        obs = ProfileStats()
         if tg is not None:
             with tg:
                 res = self._execute_inner(session, stmt, text, t0,
-                                          cached_plan, cache_key)
+                                          cached_plan, cache_key, obs)
         else:
             res = self._execute_inner(session, stmt, text, t0,
-                                      cached_plan, cache_key)
+                                      cached_plan, cache_key, obs)
         us = int((time.perf_counter() - t0) * 1e6)
         stats().inc("num_queries")
         stats().add_value("query_latency_us", us)
         stats().observe("query_latency_us_hist", us, {"kind": kind})
+        slow_us = self.slow_query_us
         if not res.ok:
             stats().inc("num_query_errors")
-        elif us > self.slow_query_us:
+        elif us > slow_us:
             stats().inc("num_slow_queries")
             self.slow_log.append({"stmt": text, "latency_us": us,
                                   "ts": time.time(),
                                   "trace_id": tg.trace_id
                                   if tg is not None else None})
+        from ..utils.flight import flight_recorder
+        flight_recorder().record(
+            stmt=text, kind=kind, latency_us=us, error=res.error,
+            trace_id=tg.trace_id if tg is not None else None,
+            session=session.id,
+            operators=obs.operators,
+            work=(obs.work.as_dict if obs.work is not None else None),
+            slow_us=slow_us)
         return res
 
     def _execute_inner(self, session: Session, stmt: A.Sentence,
                        text: str, t0: float, cached_plan=None,
-                       cache_key: Optional[tuple] = None) -> ResultSet:
+                       cache_key: Optional[tuple] = None,
+                       obs: Optional[ProfileStats] = None) -> ResultSet:
         from ..utils.config import get_config
         if get_config().get("enable_authorize"):
             from .permissions import check as _perm_check
@@ -303,7 +340,12 @@ class QueryEngine:
                               session.space)
             if msg:
                 return ResultSet(error=f"PermissionError: {msg}")
-        profile_stats: Optional[ProfileStats] = None
+        # `obs` collects per-node stats for EVERY run (flight recorder
+        # substrate); `want_profile` only controls whether the reply
+        # renders them — profiled execution is otherwise identical to
+        # the real run (same schedule, same result rows)
+        profile_stats = obs if obs is not None else ProfileStats()
+        want_profile = False
         explain_only = False
         plan_fmt = "row"
         if isinstance(stmt, A.ExplainSentence):
@@ -313,7 +355,7 @@ class QueryEngine:
                                        f"format `{stmt.fmt}' "
                                        f"(row | dot)")
             if stmt.profile:
-                profile_stats = ProfileStats()
+                want_profile = True
             else:
                 explain_only = True
             inner = stmt.stmt
@@ -348,7 +390,7 @@ class QueryEngine:
             except QueryError as ex:
                 return ResultSet(error=f"SemanticError: {ex}")
             if cache_key is not None and not explain_only \
-                    and profile_stats is None and not pctx.var_cols \
+                    and not want_profile and not pctx.var_cols \
                     and self._stmt_kind(stmt) in _CACHEABLE_KINDS:
                 # the parsed stmt rides along for the per-execute
                 # permission check and the metrics kind label
@@ -404,6 +446,10 @@ class QueryEngine:
         finally:
             session.queries.pop(qid, None)
             session.running_kill.pop(qid, None)
+            # the flight recorder reads the statement's work counts off
+            # the observer (even for failed statements, which return
+            # from the except arms above)
+            profile_stats.work = stmt_ectx.work
             # fold the statement's deterministic work counts into a
             # caller-installed probe (bench / regression harnesses wrap
             # execute() in use_work; the scheduler re-targets counting
@@ -420,14 +466,16 @@ class QueryEngine:
             session.var_cols.update(pctx.var_cols)
         us = int((time.perf_counter() - t0) * 1e6)
         plan_desc = None
-        if profile_stats is not None:
+        if want_profile:
             if plan_fmt == "dot":
                 # DOT rendering carries the DAG shape; per-node timing
                 # stays in the row format (reference-compatible subset)
                 plan_desc = plan.describe_dot()
             else:
                 plan_desc = profile_stats.describe(plan)
-            data = DataSet(["plan"], [[plan_desc]])
+            # PROFILE parity (ISSUE 8): `data` stays the QUERY's rows —
+            # byte-identical to the unprofiled run — and the per-node
+            # breakdown rides separately in plan_desc
         return ResultSet(data, space=plan.space, latency_us=us,
                          plan_desc=plan_desc)
 

@@ -1593,6 +1593,24 @@ def _show(node, qctx, ectx, space):
             ["Trace Id", "Name", "Spans", "Latency (us)"],
             [[t["tid"], t["name"], t["spans"], t["dur_us"]]
              for t in trace_store().list()])
+    if kind == "flight_recorder":
+        # newest first; like SHOW TRACES, the running statement itself
+        # is not recorded yet (it records on completion)
+        from ..utils.flight import flight_recorder
+        return DataSet(
+            ["Id", "Status", "Kind", "Latency (us)", "Operators",
+             "Trace Id", "Statement"],
+            [[e["id"], e["status"], e["kind"], e["latency_us"],
+              e["operators"], e["trace_id"], e["stmt"]]
+             for e in flight_recorder().list()])
+    if kind == "slo":
+        from ..utils.slo import slo_engine
+        return DataSet(
+            ["Objective", "Window", "Target", "Total", "Bad",
+             "Bad Ratio", "Burn Rate"],
+            [[r["objective"], r["window"], r["target"], r["total"],
+              r["bad"], r["bad_ratio"], r["burn"]]
+             for r in slo_engine().burn_rates()])
     if kind == "charset":
         return DataSet(
             ["Charset", "Description", "Default collation", "Maxlen"],

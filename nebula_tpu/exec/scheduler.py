@@ -9,10 +9,18 @@ Independent branches run CONCURRENTLY on a thread pool (ready-queue
 dispatch, the notify-based scheduler's shape) whenever the plan actually
 branches and the node work can overlap: cluster-mode executors block on
 storage RPCs (socket waits release the GIL), and device-plane nodes
-block in jax dispatch.  Chain-shaped plans and PROFILE runs use the
-sequential path (profiling attributes device stats through
-qctx.last_tpu_stats, which parallel branches would race on).  The
-`scheduler_threads` flag bounds the pool; 0 forces sequential.
+block in jax dispatch.  Chain-shaped plans use the sequential path.
+PROFILE runs the SAME schedule as unprofiled runs (ISSUE 8: a profile
+taken under a different concurrency regime is not a profile of the
+production query) — `qctx.last_tpu_stats` is thread-local, so parallel
+branches attribute device stats to their own node, and ProfileStats
+writes are per-node-keyed dict inserts.  The `scheduler_threads` flag
+bounds the pool; 0 forces sequential.
+
+Every run also collects an always-on per-node profile (ProfileStats is
+cheap: one dict insert per node) plus a per-node CostRecorder that the
+RPC layer fills from reply-envelope cost records — the substrate the
+flight recorder (utils/flight.py) and cluster-wide PROFILE read.
 """
 from __future__ import annotations
 
@@ -27,11 +35,32 @@ from .executors import run_node
 
 
 class ProfileStats:
+    """Per-plan-node execution stats.  Safe under the parallel schedule:
+    each node runs exactly once, so concurrent record() calls write
+    DISTINCT keys (single dict-item writes are atomic under the GIL).
+
+    Besides wall time and rows, a node's row may carry:
+      * `remote` — aggregated reply-envelope cost records from every
+        RPC the node issued (`remote_us`, `rows`, `bytes_*`,
+        `wal_fsyncs`, `dedup_hits`, per-part call counts) — the
+        cluster-wide half of PROFILE;
+      * `tpu` — the device-plane phase breakdown, plus per-SEGMENT
+        rows for fused TpuMatchPipeline nodes (each segment's op,
+        wall µs and device dispatch µs individually, not one opaque
+        fused node)."""
+
     def __init__(self):
         self.per_node: Dict[int, Dict] = {}
+        self.work = None          # the statement's WorkCounters (engine)
 
     def record(self, node: PlanNode, us: int, rows: int):
         self.per_node[node.id] = {"kind": node.kind, "exec_us": us, "rows": rows}
+
+    def operators(self) -> List[Dict]:
+        """Flight-recorder form: per-operator dicts, plan order not
+        guaranteed (keyed rows carry the node id)."""
+        return [dict(st, id=nid)
+                for nid, st in sorted(self.per_node.items())]
 
     def describe(self, plan: ExecutionPlan) -> str:
         lines = []
@@ -41,9 +70,20 @@ class ProfileStats:
             extra = ""
             if st:
                 extra = f"  [rows={st['rows']} time={st['exec_us']}us]"
+                if "remote" in st:
+                    rc = st["remote"]
+                    parts = " ".join(f"{k}={rc[k]}" for k in sorted(rc))
+                    extra += f" remote={{{parts}}}"
                 if "tpu" in st:
                     extra += f" tpu={st['tpu']}"
             lines.append("  " * depth + f"{n.kind}#{n.id}{extra}")
+            if st and "segments" in st:
+                for seg in st["segments"]:
+                    lines.append("  " * (depth + 1)
+                                 + f"segment:{seg['op']}"
+                                 f"  [rows={seg.get('rows', 0)}"
+                                 f" time={seg['us']}us"
+                                 f" device={seg.get('device_us', 0)}us]")
             for d in n.deps:
                 visit(d, depth + 1)
 
@@ -91,25 +131,53 @@ class Scheduler:
                 from .executors import ExecError
                 raise ExecError("query was killed")
             t0 = time.perf_counter()
-            if profile is not None:
-                self.qctx.last_tpu_stats = None
-            with trace.use_ctx(tctx), \
-                    _cancel.use_cancel(kill=c_kill, deadline=c_dl), \
-                    use_work(getattr(ectx, "work", None)), \
-                    trace.span(f"exec:{node.kind}", node=node.id) as rec:
-                # deadline check between plan nodes: a budget spent in
-                # an earlier node must not start the next one
-                _cancel.check()
-                ds = run_node(node, self.qctx, ectx, plan.space)
-                if rec is not None and ds is not None:
-                    rec.setdefault("attrs", {})["rows"] = len(ds.rows)
+            # snapshot the thread-local device-stats slot by IDENTITY:
+            # a node that dispatched installs a fresh TraverseStats, so
+            # `is not prev` attributes it to this node — without
+            # clearing the slot, which external consumers (bench, the
+            # device-engagement tests) read after the statement
+            prev_ts = getattr(self.qctx, "last_tpu_stats", None) \
+                if profile is not None else None
+            # per-node cost sink: the RPC client folds reply-envelope
+            # cost records (and its own call/byte counts) into this
+            # while the node's executor runs — even when the node fails,
+            # the costs collected so far reach the flight recorder
+            from ..utils.stats import CostRecorder, use_cost
+            node_cost = CostRecorder() if profile is not None else None
+            try:
+                with trace.use_ctx(tctx), \
+                        _cancel.use_cancel(kill=c_kill, deadline=c_dl), \
+                        use_work(getattr(ectx, "work", None)), \
+                        use_cost(node_cost), \
+                        trace.span(f"exec:{node.kind}", node=node.id) as rec:
+                    # deadline check between plan nodes: a budget spent
+                    # in an earlier node must not start the next one
+                    _cancel.check()
+                    ds = run_node(node, self.qctx, ectx, plan.space)
+                    if rec is not None and ds is not None:
+                        # len(ds), not len(ds.rows): a ColumnarDataSet
+                        # answers len() from its column buffers without
+                        # materializing per-row Python lists (the lazy
+                        # result boundary PR4 built)
+                        rec.setdefault("attrs", {})["rows"] = len(ds)
+            except BaseException:
+                if profile is not None:
+                    us = int((time.perf_counter() - t0) * 1e6)
+                    profile.record(node, us, 0)
+                    if node_cost:
+                        profile.per_node[node.id]["remote"] = \
+                            node_cost.as_dict()
+                raise
             us = int((time.perf_counter() - t0) * 1e6)
             ectx.set_result(node.output_var, ds)
             done[node.id] = ds
             if profile is not None:
-                profile.record(node, us, len(ds.rows) if ds is not None else 0)
+                profile.record(node, us, len(ds) if ds is not None else 0)
+                if node_cost:
+                    profile.per_node[node.id]["remote"] = \
+                        node_cost.as_dict()
                 ts = getattr(self.qctx, "last_tpu_stats", None)
-                if ts is not None:
+                if ts is not None and ts is not prev_ts:
                     # device-plane profile fields (SURVEY §5 tracing):
                     # per-hop expansion sizes + kernel time + buckets
                     profile.per_node[node.id]["tpu"] = {
@@ -120,7 +188,14 @@ class Scheduler:
                         "hop_edges": ts.hop_edges,
                         "buckets": {"EB": ts.e_cap},
                         "retries": ts.retries,
+                        "compiles": getattr(ts, "compiles", 0),
+                        "hbm_bytes": getattr(ts, "hbm_bytes", 0),
                     }
+                    segs = getattr(ts, "segments", None)
+                    if segs:
+                        # fused TpuMatchPipeline: each segment's cost
+                        # individually, not one opaque node (ISSUE 8)
+                        profile.per_node[node.id]["segments"] = segs
 
         threads = self._pool_size()
         branchy = any(len(n.deps) > 1 for n in order)
@@ -128,7 +203,11 @@ class Scheduler:
         # edge between prev and next subtrees) — parallel dispatch would
         # break them, so such plans stay sequential
         has_seq = any(n.kind == "Sequence" for n in order)
-        if threads > 1 and branchy and not has_seq and profile is None:
+        if threads > 1 and branchy and not has_seq:
+            # PROFILE runs take this path too (ISSUE 8): the profile
+            # must record the schedule real runs use
+            from ..utils.stats import stats as _metrics
+            _metrics().inc("scheduler_parallel_plans")
             self._run_parallel(order, exec_one, threads)
         else:
             for node in order:

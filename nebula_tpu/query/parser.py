@@ -807,6 +807,15 @@ class Parser:
                 if kw == "JOBS":
                     return A.ShowJobsSentence()
                 return A.ShowSentence(kw.lower())
+            if kw == "FLIGHT":
+                # SHOW FLIGHT RECORDER (ISSUE 8): the always-on ring of
+                # sampled/slow/failed statement profiles
+                self.next()
+                self.expect_kw("RECORDER")
+                return A.ShowSentence("flight_recorder")
+            if kw == "SLO":
+                self.next()
+                return A.ShowSentence("slo")
             if kw == "TEXT":
                 self.next()
                 self.expect_kw("SEARCH")

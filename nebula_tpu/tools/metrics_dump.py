@@ -1,22 +1,35 @@
-"""metrics-dump — scrape a running daemon's /metrics + /traces.
+"""metrics-dump — scrape one daemon or a whole cluster's telemetry.
 
-Observability CLI (ISSUE 1): fetch the Prometheus exposition text and
-the recent-trace list from a daemon's webservice port, pretty-print a
-chosen trace as an indented span tree.  Useful both interactively and
-as the round-over-round diff source (work counters + counter metrics
-are deterministic where timings are not; docs/OBSERVABILITY.md).
+Observability CLI (ISSUE 1, grown cluster-wide in ISSUE 8): fetch
+Prometheus exposition text / recent traces / flight-recorder entries
+from daemon webservice ports, pretty-print a chosen trace as an
+indented span tree, and diff counters over time.
 
+    # one daemon
     python -m nebula_tpu.tools.metrics_dump --addr 127.0.0.1:10669
     python -m nebula_tpu.tools.metrics_dump --addr ... --traces
-    python -m nebula_tpu.tools.metrics_dump --addr ... --trace <tid>
+    python -m nebula_tpu.tools.metrics_dump --addr ... --trace <tid|latest>
     python -m nebula_tpu.tools.metrics_dump --addr ... --grep rpc_
+    python -m nebula_tpu.tools.metrics_dump --addr ... --flight
+
+    # whole cluster: per-host sections + a merged (counters summed) view
+    python -m nebula_tpu.tools.metrics_dump \
+        --addrs 127.0.0.1:10669,127.0.0.1:10779,127.0.0.1:10559
+
+    # delta mode: re-scrape every N seconds, print only changed counters
+    python -m nebula_tpu.tools.metrics_dump --addrs ... --watch 5
+
+A metad's federated view (`/cluster_metrics`) can be scraped like any
+single target with `--addr <metad-ws> --path /cluster_metrics`.
 """
 from __future__ import annotations
 
 import argparse
 import json
 import sys
+import time
 import urllib.request
+from typing import Dict, List, Tuple
 
 
 def _fetch(addr: str, path: str) -> str:
@@ -24,8 +37,22 @@ def _fetch(addr: str, path: str) -> str:
         return r.read().decode()
 
 
-def dump_metrics(addr: str, grep: str = "") -> int:
-    text = _fetch(addr, "/metrics")
+def _parse_samples(text: str) -> Dict[str, float]:
+    """name{labels} → value for every sample line (comments skipped)."""
+    out: Dict[str, float] = {}
+    for ln in text.splitlines():
+        if not ln or ln.startswith("#"):
+            continue
+        head, _, val = ln.rpartition(" ")
+        try:
+            out[head] = float(val)
+        except ValueError:
+            continue
+    return out
+
+
+def dump_metrics(addr: str, grep: str = "", path: str = "/metrics") -> int:
+    text = _fetch(addr, path)
     n = 0
     for ln in text.splitlines():
         if grep and grep not in ln:
@@ -34,6 +61,78 @@ def dump_metrics(addr: str, grep: str = "") -> int:
         if not ln.startswith("#"):
             n += 1
     return n
+
+
+def scrape_cluster(addrs: List[str], path: str = "/metrics"
+                   ) -> Tuple[Dict[str, Dict[str, float]],
+                              Dict[str, float]]:
+    """-> (per-host samples, merged samples).  Merging SUMS values per
+    sample key — correct for counters and histogram rows (the common
+    cross-host question is 'how much in total'); gauges are better read
+    per host, which the per-host map preserves.  Unreachable hosts are
+    reported on stderr and skipped."""
+    per_host: Dict[str, Dict[str, float]] = {}
+    merged: Dict[str, float] = {}
+    for addr in addrs:
+        try:
+            samples = _parse_samples(_fetch(addr, path))
+        except OSError as ex:
+            print(f"scrape of {addr} failed: {ex}", file=sys.stderr)
+            continue
+        per_host[addr] = samples
+        for k, v in samples.items():
+            merged[k] = merged.get(k, 0.0) + v
+    return per_host, merged
+
+
+def _fmt_val(v: float) -> str:
+    return str(int(v)) if float(v).is_integer() else repr(v)
+
+
+def dump_cluster(addrs: List[str], grep: str = "",
+                 path: str = "/metrics") -> int:
+    per_host, merged = scrape_cluster(addrs, path)
+    for addr in sorted(per_host):
+        print(f"== {addr} ({len(per_host[addr])} samples)")
+        for k in sorted(per_host[addr]):
+            if grep and grep not in k:
+                continue
+            print(f"  {k} {_fmt_val(per_host[addr][k])}")
+    print(f"== merged ({len(per_host)}/{len(addrs)} hosts)")
+    n = 0
+    for k in sorted(merged):
+        if grep and grep not in k:
+            continue
+        print(f"  {k} {_fmt_val(merged[k])}")
+        n += 1
+    return n
+
+
+def watch_cluster(addrs: List[str], interval: float, grep: str = "",
+                  iterations: int = 0, path: str = "/metrics") -> int:
+    """Delta mode: print only samples whose MERGED value changed since
+    the previous scrape (plus the first full baseline count).
+    iterations=0 runs until interrupted."""
+    _, prev = scrape_cluster(addrs, path)
+    print(f"baseline: {len(prev)} samples from {len(addrs)} target(s)")
+    i = 0
+    while iterations <= 0 or i < iterations:
+        i += 1
+        try:
+            time.sleep(interval)
+        except KeyboardInterrupt:
+            break
+        _, cur = scrape_cluster(addrs, path)
+        changed = [(k, prev.get(k, 0.0), v) for k, v in sorted(cur.items())
+                   if v != prev.get(k, 0.0) and (not grep or grep in k)]
+        stamp = time.strftime("%H:%M:%S")
+        if not changed:
+            print(f"[{stamp}] no change")
+        for k, old, new in changed:
+            print(f"[{stamp}] {k} {_fmt_val(old)} -> {_fmt_val(new)} "
+                  f"(+{_fmt_val(new - old)})")
+        prev = cur
+    return 0
 
 
 def dump_trace_list(addr: str) -> int:
@@ -48,32 +147,80 @@ def dump_trace(addr: str, tid: str):
     print(_fetch(addr, f"/traces?id={tid}&format=text"))
 
 
+def dump_flight(addr: str, entry_id: str = "") -> int:
+    if entry_id:
+        print(_fetch(addr, f"/flight?id={entry_id}"))
+        return 1
+    entries = json.loads(_fetch(addr, "/flight"))
+    for e in entries:
+        print(f"#{e['id']:<5} {e['status']:<9} {e['kind']:<10} "
+              f"{e['latency_us']}us ops={e['operators']:<3} "
+              f"{e['stmt'][:60]}")
+    return len(entries)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="metrics-dump")
-    ap.add_argument("--addr", required=True,
-                    help="webservice host:port of any daemon")
+    ap.add_argument("--addr", default="",
+                    help="webservice host:port of one daemon")
+    ap.add_argument("--addrs", default="",
+                    help="comma-separated webservice addrs of the whole "
+                         "cluster (per-host + merged output)")
+    ap.add_argument("--path", default="/metrics",
+                    help="metrics path to scrape (e.g. /cluster_metrics "
+                         "on a metad)")
     ap.add_argument("--traces", action="store_true",
                     help="list recent traces instead of metrics")
     ap.add_argument("--trace", default="",
                     help="print one trace's span tree by id "
                          "('latest' = newest recorded trace)")
+    ap.add_argument("--flight", action="store_true",
+                    help="list flight-recorder entries")
+    ap.add_argument("--flight-id", default="",
+                    help="print one flight entry's full per-operator "
+                         "breakdown")
     ap.add_argument("--grep", default="",
                     help="only metric lines containing this substring")
+    ap.add_argument("--watch", type=float, default=0.0,
+                    help="re-scrape every N seconds and print only "
+                         "counters that changed (delta mode)")
+    ap.add_argument("--iterations", type=int, default=0,
+                    help="watch iterations before exiting (0 = forever; "
+                         "for scripted use)")
     args = ap.parse_args(argv)
+    addrs = [a for a in args.addrs.split(",") if a]
+    if not addrs and args.addr:
+        addrs = [args.addr]
+    if not addrs:
+        ap.error("need --addr or --addrs")
+    one = addrs[0]
+    if len(addrs) > 1 and (args.trace or args.traces or args.flight
+                           or args.flight_id):
+        # traces/flight entries are per-process state, not mergeable
+        # samples — be explicit about which host answers
+        print(f"note: --traces/--trace/--flight query a single host; "
+              f"using {one}", file=sys.stderr)
     try:
         if args.trace:
             tid = args.trace
             if tid == "latest":
-                traces = json.loads(_fetch(args.addr, "/traces"))
+                traces = json.loads(_fetch(one, "/traces"))
                 if not traces:
                     print("no traces recorded", file=sys.stderr)
                     return 1
                 tid = traces[0]["tid"]
-            dump_trace(args.addr, tid)
+            dump_trace(one, tid)
         elif args.traces:
-            dump_trace_list(args.addr)
+            dump_trace_list(one)
+        elif args.flight or args.flight_id:
+            dump_flight(one, args.flight_id)
+        elif args.watch > 0:
+            watch_cluster(addrs, args.watch, args.grep,
+                          args.iterations, args.path)
+        elif len(addrs) > 1:
+            dump_cluster(addrs, args.grep, args.path)
         else:
-            dump_metrics(args.addr, args.grep)
+            dump_metrics(one, args.grep, args.path)
     except OSError as ex:
         print(f"scrape failed: {ex}", file=sys.stderr)
         return 1

@@ -667,6 +667,7 @@ class _Runner:
     # -- ops -------------------------------------------------------------
 
     def run(self, ops: List[Dict[str, Any]]):
+        import time as _time
         out = None
         for op in ops:
             # KILL QUERY / deadline between segments (ISSUE 5
@@ -674,9 +675,27 @@ class _Runner:
             # until the result boundary — a kill now lands at the next
             # segment instead of after the whole program
             _cancel.check()
+            # per-SEGMENT attribution (ISSUE 8 tentpole): each segment
+            # records its own wall time, output rows and device-
+            # dispatch delta, so PROFILE breaks the fused node down
+            # instead of reporting one opaque TpuMatchPipeline row
+            t0 = _time.perf_counter()
+            dev0 = self.stats.device_s
             out = getattr(self, "_x_" + op["op"])(op)
+            seg = {"op": op["op"],
+                   "us": int((_time.perf_counter() - t0) * 1e6)}
+            dev_us = int((self.stats.device_s - dev0) * 1e6)
+            if dev_us:
+                seg["device_us"] = dev_us
             if isinstance(out, ColumnarFrame):
                 self.regs.append(out)
+                seg["rows"] = out.n
+            elif out is not None and hasattr(out, "rows"):
+                try:
+                    seg["rows"] = len(out)
+                except TypeError:
+                    pass
+            self.stats.segments.append(seg)
         return out
 
     def _frame(self, op, key="in") -> ColumnarFrame:
@@ -1134,6 +1153,8 @@ class _Runner:
         s.retries += st.retries
         s.f_cap = st.f_cap          # bucket shapes: report the last chain's
         s.e_cap = st.e_cap
+        s.compiles += getattr(st, "compiles", 0)
+        s.hbm_bytes = max(s.hbm_bytes, getattr(st, "hbm_bytes", 0))
         for ph in ("pin_s", "put_s", "fetch_s", "mat_s", "device_s",
                    "total_s"):
             setattr(s, ph, getattr(s, ph) + getattr(st, ph))

@@ -139,7 +139,8 @@ def _cat_prefix(arr, bi, pids, kc, dtype=None):
 class TraverseStats:
     __slots__ = ("hop_edges", "frontier_sizes", "result_edges", "f_cap",
                  "e_cap", "retries", "device_s", "steps",
-                 "pin_s", "put_s", "fetch_s", "mat_s", "total_s")
+                 "pin_s", "put_s", "fetch_s", "mat_s", "total_s",
+                 "compiles", "hbm_bytes", "segments")
 
     def __init__(self):
         self.hop_edges: List[int] = []
@@ -156,6 +157,13 @@ class TraverseStats:
         self.fetch_s = 0.0
         self.mat_s = 0.0
         self.total_s = 0.0
+        # kernel-ledger fields (ISSUE 8): fresh XLA compiles this run
+        # paid for (vs jit-cache hits) and the HBM high-water at
+        # dispatch time; `segments` carries per-segment rows for fused
+        # pipelines (tpu/pipeline.py fills it)
+        self.compiles = 0
+        self.hbm_bytes = 0
+        self.segments: List[dict] = []
 
     def edges_traversed(self) -> int:
         return int(sum(self.hop_edges))
@@ -550,7 +558,8 @@ class TpuRuntime:
                   key_fn, build_fn, inputs_fn, stats: "TraverseStats",
                   n_hops: int = 1, uniform: bool = False,
                   min_eb: Optional[int] = None,
-                  fetch_keys: Optional[set] = None):
+                  fetch_keys: Optional[set] = None,
+                  kernel: str = "traverse"):
         """Shared power-of-two bucket escalation driver for all device
         programs (traverse, bfs): seed bitmap layout, jit cache, one
         batched fetch, overflow-driven retry (SURVEY §7 hard-part #1).
@@ -605,13 +614,21 @@ class TpuRuntime:
         # scale with the hop count
         from ..utils.stats import current_work
         wc = current_work()
+        rungs: List[Tuple[int, bool]] = []   # (dispatch_us, compiled)
         for attempt in range(max(self.max_retries, n_hops + 3)):
             stats.retries = attempt
             ebs = tuple(EBs)
             key = key_fn(ebs)
             fn = self._fns.get(key)
-            if fn is None:
+            compiled = fn is None
+            if compiled:
                 fn = self._fns[key] = build_fn(ebs)
+                stats.compiles += 1
+            # per-rung bookkeeping stays PLAIN-PYTHON here (ints and a
+            # list append on locals): the dispatch neighborhood is
+            # timing-sensitive under concurrent serve-while-repin (a
+            # latent jaxlib CPU race); all metric/ledger emission for
+            # the rungs happens once after convergence below
             if wc is not None:
                 wc.add("device_dispatches")
             t0 = time.perf_counter()
@@ -635,6 +652,7 @@ class TpuRuntime:
                 jax.block_until_ready(res)
             t1 = time.perf_counter()
             stats.device_s = t1 - t0
+            rungs.append((int((t1 - t0) * 1e6), compiled))
             # two-phase fetch: capture arrays stay on device while the
             # small meta (counters/overflow flags) comes back first; the
             # EB-padded capture rows are then fetched as [:kmax] slices —
@@ -726,6 +744,42 @@ class TpuRuntime:
                 if wc is not None:
                     wc.add("edges_traversed", stats.edges_traversed())
                     wc.extend_frontier(stats.frontier_sizes)
+                # device kernel ledger (ISSUE 8 tentpole): per-RUNG
+                # dispatch µs and compile-vs-cache dispositions were
+                # accumulated as plain locals in the loop (every
+                # escalation rung is a real dispatch — counting only
+                # the converged run would skew the ratios under
+                # retries); emit them to histograms/counters/cost HERE,
+                # outside the timing-sensitive dispatch neighborhood
+                from ..utils.stats import current_cost as _cc
+                cc = _cc()
+                for r_us, r_compiled in rungs:
+                    _metrics().observe("tpu_dispatch_us", r_us,
+                                       {"kernel": kernel})
+                    if r_compiled:
+                        _metrics().inc_labeled("tpu_kernel_compiles",
+                                               {"kernel": kernel})
+                    else:
+                        _metrics().inc_labeled("tpu_kernel_cache_hits",
+                                               {"kernel": kernel})
+                if cc is not None:
+                    cc.add("device_us", sum(r for r, _ in rungs))
+                    cc.add("device_dispatches", len(rungs))
+                    if stats.compiles:
+                        cc.add("device_compiles", stats.compiles)
+                dispatch_us = int(stats.device_s * 1e6)
+                hbm = self.hbm_bytes()
+                stats.hbm_bytes = hbm
+                self._hbm_high_water = max(
+                    getattr(self, "_hbm_high_water", 0), hbm)
+                _metrics().gauge("tpu_hbm_high_water_bytes",
+                                 float(self._hbm_high_water))
+                from ..utils.flight import kernel_ledger
+                kernel_ledger().record(
+                    kernel=kernel, shape=list(EBs), steps=n_hops,
+                    compiled=bool(stats.compiles),
+                    dispatch_us=dispatch_us, hbm_bytes=hbm,
+                    retries=stats.retries)
                 # device-plane trace phases (ISSUE 1): the runtime
                 # timed them itself — emit as leaf spans of whatever
                 # executor span is driving this kernel
@@ -838,7 +892,8 @@ class TpuRuntime:
                                 tuple(pred_cols), yield_cols, hub_n),
             build_fn=build,
             inputs_fn=lambda ebs: (blocks_data,),
-            stats=stats, n_hops=steps, fetch_keys=fetch_keys)
+            stats=stats, n_hops=steps, fetch_keys=fetch_keys,
+            kernel="traverse")
         if not capture:
             stats.total_s = time.perf_counter() - t_start
             return [], stats
@@ -933,7 +988,7 @@ class TpuRuntime:
                                 pred_key, tuple(pred_cols), hub_n),
             build_fn=build,
             inputs_fn=lambda ebs: (blocks_data,),
-            stats=stats, n_hops=max_hop, uniform=True)
+            stats=stats, n_hops=max_hop, uniform=True, kernel="hops")
 
         t_mat = time.perf_counter()
         frames = self._build_frames(store, space, dev, block_keys,
@@ -1135,7 +1190,7 @@ class TpuRuntime:
                                 hub_n),
             build_fn=build,
             inputs_fn=lambda ebs: (blocks_data,),
-            stats=stats, n_hops=max_steps)
+            stats=stats, n_hops=max_steps, kernel="bfs")
         return res["dist"], stats
 
     # -- host materialization --------------------------------------------

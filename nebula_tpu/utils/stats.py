@@ -270,6 +270,22 @@ class StatsManager:
                              f"{_prom_num(row[-1])}")
         return "\n".join(lines) + "\n"
 
+    def hist_totals(self, name: str) -> Optional[Tuple[Tuple[float, ...],
+                                                       List[float]]]:
+        """(buckets, per-bucket counts summed over label sets, plus the
+        trailing [count, sum]) — the SLO engine's raw-histogram surface
+        (utils/slo.py reads `query_latency_us_hist` through this)."""
+        h = self.histograms.get(name)
+        if h is None:
+            return None
+        with h.lock:
+            rows = [list(r) for r in h.per_label.values()]
+        total = [0.0] * (len(h.buckets) + 2)
+        for r in rows:
+            for i, v in enumerate(r):
+                total[i] += v
+        return h.buckets, total
+
     def reset(self):
         with self.lock:
             self.counters.clear()
@@ -353,6 +369,81 @@ class WorkCounters:
                 "device_dispatches": self.device_dispatches,
                 "storage_rows": self.storage_rows,
             }
+
+
+class CostRecorder:
+    """Per-plan-node cost sink (ISSUE 8 tentpole): while a node's
+    executor runs, this thread-local recorder accumulates the cost
+    records remote services return in the RPC reply envelope
+    (`remote_us`, `rows`, `wal_fsyncs`, `dedup_hits`) plus the client
+    side's own call/byte counts and the device runtime's dispatch cost
+    (`device_us`, `device_dispatches`, `device_compiles`).  The
+    scheduler attaches the result to the node's PROFILE row and the
+    flight-recorder entry — cluster-wide cost attribution per plan
+    node, not graphd-local wall time."""
+
+    __slots__ = ("data", "_lock")
+
+    def __init__(self):
+        self.data: Dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    def add(self, field: str, n: int = 1):
+        with self._lock:
+            self.data[field] = self.data.get(field, 0) + int(n)
+
+    def merge_reply(self, cost: Dict[str, Any]):
+        """Fold a reply-envelope cost record in.  The remote side ships
+        its handler time as a FIXED-WIDTH decimal string ("us") so
+        reply byte counts stay deterministic run-to-run (the wire-byte
+        work counters are a regression probe); everything else is plain
+        deterministic ints."""
+        with self._lock:
+            for k, v in cost.items():
+                key = "remote_us" if k == "us" else k
+                try:
+                    self.data[key] = self.data.get(key, 0) + int(float(v))
+                except (TypeError, ValueError):
+                    continue
+
+    def as_dict(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(sorted(self.data.items()))
+
+    def __bool__(self) -> bool:
+        with self._lock:
+            return bool(self.data)
+
+
+_cost_tls = threading.local()
+
+
+def current_cost() -> Optional[CostRecorder]:
+    return getattr(_cost_tls, "cost", None)
+
+
+class _CostGuard:
+    __slots__ = ("_rec", "_prev")
+
+    def __init__(self, rec: Optional[CostRecorder]):
+        self._rec = rec
+
+    def __enter__(self):
+        self._prev = getattr(_cost_tls, "cost", None)
+        _cost_tls.cost = self._rec
+        return self._rec
+
+    def __exit__(self, *exc):
+        _cost_tls.cost = self._prev
+        return False
+
+
+def use_cost(rec: Optional[CostRecorder]) -> _CostGuard:
+    """Install `rec` as this thread's cost-attribution target (None
+    keeps attribution disabled; the guard still restores correctly).
+    Mirrors use_work: fan-out pool threads re-install the submitting
+    thread's recorder so per-part costs attribute to the right node."""
+    return _CostGuard(rec)
 
 
 _work_tls = threading.local()

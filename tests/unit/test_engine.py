@@ -279,8 +279,12 @@ def test_show_describe(eng):
 def test_explain_and_profile(eng):
     r = eng._run('EXPLAIN GO FROM "a" OVER knows')
     assert "ExpandAll" in r.data.rows[0][0]
+    # PROFILE parity (ISSUE 8): data carries the QUERY's rows, the
+    # per-node breakdown rides in plan_desc
     r2 = eng._run('PROFILE GO FROM "a" OVER knows')
-    assert "rows=" in r2.data.rows[0][0]
+    assert "rows=" in r2.plan_desc
+    plain = eng._run('GO FROM "a" OVER knows')
+    assert r2.data.rows == plain.data.rows
 
 
 def test_index_ddl_and_jobs(eng):

@@ -1,0 +1,250 @@
+"""Flight recorder + device kernel ledger (ISSUE 8): forced capture on
+error/slow, deterministic sampling, bounded rings, the /flight and
+/kernels endpoints, SHOW FLIGHT RECORDER, and the bounded slow log."""
+import json
+import urllib.request
+
+import pytest
+
+from nebula_tpu.exec.engine import QueryEngine
+from nebula_tpu.utils.config import get_config
+from nebula_tpu.utils.flight import (FlightRecorder, KernelLedger,
+                                     flight_recorder, kernel_ledger)
+
+
+@pytest.fixture()
+def recorder():
+    fr = flight_recorder()
+    fr.clear()
+    yield fr
+    fr.clear()
+    get_config().dynamic_layer.pop("flight_sample_rate", None)
+    get_config().dynamic_layer.pop("flight_recorder_capacity", None)
+
+
+def _mk(fr, error=None, latency_us=10, slow_us=0, stmt="YIELD 1",
+        ops=()):
+    return fr.record(stmt=stmt, kind="Yield", latency_us=latency_us,
+                     error=error, trace_id=None, session=1,
+                     operators=list(ops), slow_us=slow_us)
+
+
+def test_forced_capture_reasons():
+    fr = FlightRecorder()
+    assert _mk(fr, error="ExecutionError: boom")["status"] == "error"
+    assert _mk(fr, error="ExecutionError: query was killed"
+               )["status"] == "killed"
+    assert _mk(fr, error="E_QUERY_TIMEOUT: statement exceeded"
+               )["status"] == "timeout"
+    assert _mk(fr, error="FailpointError: rpc:send"
+               )["status"] == "failpoint"
+    assert _mk(fr, latency_us=900, slow_us=500)["status"] == "slow"
+    # structured matching: statement fragments quoted in ordinary
+    # errors must not trigger the killed/timeout/failpoint statuses
+    assert _mk(fr, error="SemanticError: unknown prop `killed'"
+               )["status"] == "error"
+    assert _mk(fr, error='SyntaxError: near "E_QUERY_TIMEOUT"'
+               )["status"] == "error"
+
+
+def test_sampling_is_deterministic(recorder):
+    get_config().set_dynamic("flight_sample_rate", 0.5)
+    fr = FlightRecorder()
+    kept = [e for e in (_mk(fr) for _ in range(10)) if e is not None]
+    assert len(kept) == 5, "rate 0.5 must retain exactly every 2nd"
+    get_config().set_dynamic("flight_sample_rate", 0.0)
+    fr2 = FlightRecorder()
+    assert all(_mk(fr2) is None for _ in range(5))
+    # forced capture ignores the rate
+    assert _mk(fr2, error="x") is not None
+
+
+def test_ring_is_bounded(recorder):
+    get_config().set_dynamic("flight_recorder_capacity", 4)
+    fr = FlightRecorder()
+    for i in range(10):
+        _mk(fr, error=f"e{i}")
+    lst = fr.list()
+    assert len(lst) == 4
+    # newest first, oldest evicted
+    assert lst[0]["id"] == 10 and lst[-1]["id"] == 7
+
+
+def test_lazy_operator_materialization(recorder):
+    """Dropped statements must not pay operator-list construction."""
+    get_config().set_dynamic("flight_sample_rate", 0.0)
+    fr = FlightRecorder()
+    calls = {"n": 0}
+
+    def ops():
+        calls["n"] += 1
+        return [{"kind": "Start"}]
+
+    assert fr.record(stmt="q", kind="Yield", latency_us=1, error=None,
+                     trace_id=None, session=1, operators=ops) is None
+    assert calls["n"] == 0
+    e = fr.record(stmt="q", kind="Yield", latency_us=1, error="x",
+                  trace_id=None, session=1, operators=ops)
+    assert calls["n"] == 1 and e["operators"] == [{"kind": "Start"}]
+
+
+def test_engine_failed_statement_forced_into_recorder(recorder):
+    get_config().set_dynamic("flight_sample_rate", 0.0)
+    eng = QueryEngine()
+    s = eng.new_session()
+    eng.execute(s, "USE nosuchspace")        # semantic error → forced
+    entries = recorder.list()
+    assert entries and entries[0]["status"] == "error"
+    assert "nosuchspace" in entries[0]["stmt"]
+
+
+def test_parse_error_forced_into_recorder(recorder):
+    """Syntax errors burn SLO budget — they must leave flight evidence
+    like every other error, despite never reaching the scheduler."""
+    get_config().set_dynamic("flight_sample_rate", 0.0)
+    eng = QueryEngine()
+    s = eng.new_session()
+    eng.execute(s, "GOGO 1 NONSENSE")
+    entries = recorder.list()
+    assert entries and entries[0]["status"] == "error"
+    assert entries[0]["kind"] == "Parse"
+    assert "GOGO 1 NONSENSE" in entries[0]["stmt"]
+
+
+def test_engine_sampled_entry_has_operator_breakdown(recorder):
+    get_config().set_dynamic("flight_sample_rate", 1.0)
+    eng = QueryEngine()
+    s = eng.new_session()
+    for q in ['CREATE SPACE fl(partition_num=2, vid_type=INT64)',
+              'USE fl', 'CREATE EDGE e(w int)',
+              'INSERT EDGE e(w) VALUES 1->2:(1), 2->3:(2)']:
+        r = eng.execute(s, q)
+        assert r.error is None, f"{q} -> {r.error}"
+    r = eng.execute(s, "GO FROM 1 OVER e YIELD dst(edge) AS d")
+    assert r.ok
+    newest = recorder.list()[0]
+    full = recorder.get(newest["id"])
+    assert full["status"] == "sampled"
+    kinds = {op["kind"] for op in full["operators"]}
+    assert kinds, "no per-operator breakdown recorded"
+    assert all("exec_us" in op and "rows" in op
+               for op in full["operators"])
+    assert "work" in full and "rpc_calls" in full["work"]
+
+
+def test_show_flight_recorder(recorder):
+    get_config().set_dynamic("flight_sample_rate", 0.0)
+    eng = QueryEngine()
+    s = eng.new_session()
+    eng.execute(s, "USE nosuch1")
+    eng.execute(s, "USE nosuch2")
+    r = eng.execute(s, "SHOW FLIGHT RECORDER")
+    assert r.ok, r.error
+    assert r.data.column_names[0] == "Id"
+    stmts = [row[6] for row in r.data.rows]
+    assert any("nosuch2" in t for t in stmts)
+    assert any("nosuch1" in t for t in stmts)
+    statuses = {row[1] for row in r.data.rows}
+    assert statuses == {"error"}
+
+
+def test_flight_endpoint(recorder):
+    from nebula_tpu.cluster.webservice import WebService
+    get_config().set_dynamic("flight_sample_rate", 0.0)
+    eng = QueryEngine()
+    s = eng.new_session()
+    eng.execute(s, "USE nosuchspace")
+    ws = WebService(role="graphd")
+    ws.start()
+    try:
+        lst = json.loads(urllib.request.urlopen(
+            f"http://{ws.addr}/flight").read())
+        assert lst and lst[0]["status"] == "error"
+        full = json.loads(urllib.request.urlopen(
+            f"http://{ws.addr}/flight?id={lst[0]['id']}").read())
+        assert full["error"] and "operators" in full
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(f"http://{ws.addr}/flight?id=999999")
+    finally:
+        ws.stop()
+
+
+# -- kernel ledger ----------------------------------------------------------
+
+
+def test_kernel_ledger_bounded_and_served():
+    from nebula_tpu.cluster.webservice import WebService
+    led = KernelLedger()
+    for i in range(5):
+        led.record(kernel="traverse", shape=[2048], steps=3,
+                   compiled=(i == 0), dispatch_us=100 + i,
+                   hbm_bytes=1 << 20)
+    lst = led.list()
+    assert len(lst) == 5 and lst[0]["dispatch_us"] == 104
+    assert lst[-1]["compiled"] and not lst[0]["compiled"]
+    # the process-wide ledger is what /kernels serves
+    kernel_ledger().record(kernel="bfs", shape=[4096, 4096], steps=5,
+                           compiled=True, dispatch_us=777,
+                           hbm_bytes=123)
+    ws = WebService(role="graphd")
+    ws.start()
+    try:
+        rows = json.loads(urllib.request.urlopen(
+            f"http://{ws.addr}/kernels").read())
+        assert any(r["kernel"] == "bfs" and r["dispatch_us"] == 777
+                   for r in rows)
+    finally:
+        ws.stop()
+
+
+def test_device_dispatch_feeds_ledger_and_profile():
+    """A device GO records its dispatches in the kernel ledger (shape
+    bucket, compile-vs-cache, HBM) and its PROFILE row carries the
+    compile/HBM fields."""
+    from nebula_tpu.tpu.device import make_mesh
+    from nebula_tpu.tpu.runtime import TpuRuntime
+
+    kernel_ledger().clear()
+    eng = QueryEngine(tpu_runtime=TpuRuntime(make_mesh()))
+    s = eng.new_session()
+    for q in ["CREATE SPACE kl(partition_num=8, vid_type=INT64)",
+              "USE kl", "CREATE EDGE e(w int)",
+              "INSERT EDGE e(w) VALUES 1->2:(1), 2->3:(2), 1->3:(3)"]:
+        r = eng.execute(s, q)
+        assert r.error is None, f"{q} -> {r.error}"
+    r = eng.execute(s, "PROFILE GO 2 STEPS FROM 1 OVER e "
+                       "YIELD dst(edge) AS d")
+    assert r.error is None
+    recs = kernel_ledger().list()
+    assert recs, "device dispatch left no ledger record"
+    assert recs[0]["kernel"] == "traverse"
+    assert recs[0]["shape"] and recs[0]["hbm_bytes"] > 0
+    assert "'compiles':" in r.plan_desc \
+        and "'hbm_bytes':" in r.plan_desc, r.plan_desc
+    snap = stats_snapshot()
+    assert any(k.startswith("tpu_dispatch_us") for k in snap)
+    assert snap.get("tpu_hbm_high_water_bytes", 0) > 0
+
+
+def stats_snapshot():
+    from nebula_tpu.utils.stats import stats
+    return stats().snapshot()
+
+
+# -- bounded slow log -------------------------------------------------------
+
+
+def test_slow_log_is_bounded():
+    get_config().set_dynamic("slow_log_capacity", 3)
+    get_config().set_dynamic("slow_query_threshold_us", 0)
+    try:
+        eng = QueryEngine()
+        s = eng.new_session()
+        for i in range(8):
+            eng.execute(s, f"YIELD {i}")
+        assert len(eng.slow_log) == 3
+        # newest retained
+        assert eng.slow_log[-1]["stmt"] == "YIELD 7"
+    finally:
+        get_config().dynamic_layer.pop("slow_log_capacity", None)
+        get_config().dynamic_layer.pop("slow_query_threshold_us", None)

@@ -73,6 +73,78 @@ def test_storage_perf_smoke(capsys):
     assert "getNeighbors" in out and "op/s" in out
 
 
+def test_metrics_dump_cluster_scrape(capsys):
+    """--addrs scrapes every host, prints per-host sections and a
+    merged (counters summed) view (ISSUE 8 satellite)."""
+    from nebula_tpu.cluster.webservice import WebService
+    from nebula_tpu.tools import metrics_dump
+    from nebula_tpu.utils.stats import stats
+
+    stats().inc("md_cluster_probe", 3)
+    ws1 = WebService(role="graphd")
+    ws2 = WebService(role="storaged")
+    ws1.start()
+    ws2.start()
+    try:
+        rc = metrics_dump.main(
+            ["--addrs", f"{ws1.addr},{ws2.addr}",
+             "--grep", "md_cluster_probe"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert f"== {ws1.addr}" in out and f"== {ws2.addr}" in out
+        # both webservices front the same in-process registry, so the
+        # merged view sums the sample across hosts: 3 + 3
+        assert "== merged (2/2 hosts)" in out
+        assert "md_cluster_probe 6" in out
+    finally:
+        ws1.stop()
+        ws2.stop()
+
+
+def test_metrics_dump_watch_deltas(capsys):
+    from nebula_tpu.cluster.webservice import WebService
+    from nebula_tpu.tools import metrics_dump
+    from nebula_tpu.utils.stats import stats
+
+    ws = WebService(role="graphd")
+    ws.start()
+    try:
+        import threading
+
+        def bump():
+            stats().inc("md_watch_probe", 5)
+        t = threading.Timer(0.1, bump)
+        t.start()
+        rc = metrics_dump.main(["--addrs", ws.addr, "--watch", "0.3",
+                                "--iterations", "1",
+                                "--grep", "md_watch_probe"])
+        t.join()
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "baseline:" in out
+        assert "md_watch_probe" in out and "(+5)" in out
+    finally:
+        ws.stop()
+
+
+def test_metrics_dump_unreachable_host(capsys):
+    """In cluster mode a dead host is reported and skipped — the rest
+    of the scrape still merges (single-addr mode stays fatal)."""
+    from nebula_tpu.cluster.webservice import WebService
+    from nebula_tpu.tools import metrics_dump
+
+    ws = WebService(role="graphd")
+    ws.start()
+    try:
+        rc = metrics_dump.main(["--addrs", f"127.0.0.1:1,{ws.addr}"])
+        assert rc == 0
+        cap = capsys.readouterr()
+        assert "scrape of 127.0.0.1:1 failed" in cap.err
+        assert "== merged (1/2 hosts)" in cap.out
+    finally:
+        ws.stop()
+
+
 def test_meta_dump_data_dir(tmp_path, capsys):
     from nebula_tpu.exec import QueryEngine
     from nebula_tpu.graphstore.store import GraphStore
