@@ -938,6 +938,165 @@ def main():
     })
     _save_partial(platform, configs)
 
+    # ---- concurrency block (ISSUE 9): ≥64 concurrent small GO/MATCH
+    # statements against a live 3-replica cluster — p50/p95/p99 + QPS
+    # with the queue-wait share of total latency, the baseline number
+    # ROADMAP item 2 (admission control / device batching) must beat.
+    _mark("config concurrency: 64-way small-query latency/QPS")
+    import threading as _threading
+
+    from nebula_tpu.utils.stats import stats as _cstats
+    cn = int(os.environ.get("NEBULA_BENCH_CONC_PERSONS", 2_000))
+    cdeg = 6
+    cthreads = int(os.environ.get("NEBULA_BENCH_CONC_THREADS", 64))
+    creps = int(os.environ.get("NEBULA_BENCH_CONC_REPS", 6))
+    ctmp = tempfile.mkdtemp(prefix="nebula_bench_conc_")
+    conc_cluster = LocalCluster(n_meta=1, n_storage=3, n_graph=1,
+                                data_dir=ctmp, tpu_runtime=rt)
+    try:
+        ccl = conc_cluster.client()
+        assert ccl.execute(
+            "CREATE SPACE conc(partition_num=8, replica_factor=3, "
+            "vid_type=INT64)").error is None
+        conc_cluster.reconcile_storage()
+        for q in ("USE conc", "CREATE TAG Person(age int)",
+                  "CREATE EDGE KNOWS(w int)"):
+            assert ccl.execute(q).error is None, q
+        rng_c = np.random.default_rng(29)
+        B = 400
+        for lo in range(0, cn, B):
+            vals = ", ".join(f"{v}:({v % 90})"
+                             for v in range(lo, min(lo + B, cn)))
+            r = ccl.execute(f"INSERT VERTEX Person(age) VALUES {vals}")
+            assert r.error is None, r.error
+        csrc = rng_c.integers(0, cn, cn * cdeg)
+        cdst = rng_c.integers(0, cn, cn * cdeg)
+        keepc = csrc != cdst
+        csrc, cdst = csrc[keepc], cdst[keepc]
+        for lo in range(0, csrc.size, B):
+            vals = ", ".join(
+                f"{s}->{d}:({int(s + d) % 100})"
+                for s, d in zip(csrc[lo:lo + B].tolist(),
+                                cdst[lo:lo + B].tolist()))
+            r = ccl.execute(f"INSERT EDGE KNOWS(w) VALUES {vals}")
+            assert r.error is None, r.error
+
+        def _conc_stmt(i, j):
+            # alternating small GO / MATCH — thousands of SMALL
+            # statements is the admission-control workload shape, not
+            # one big traversal
+            seed = (i * 131 + j * 17) % cn
+            if (i + j) % 2:
+                return (f"MATCH (a:Person)-[e:KNOWS]->(b) "
+                        f"WHERE id(a) == {seed} RETURN id(b)")
+            return f"GO FROM {seed} OVER KNOWS YIELD dst(edge) AS d"
+
+        warm = conc_cluster.client()
+        warm.execute("USE conc")
+        warm.execute(_conc_stmt(0, 0))
+        warm.execute(_conc_stmt(0, 1))
+
+        def _qwait_us(snap):
+            # all kernels' dispatch-gate wait, µs (histogram sums)
+            return sum(v for k, v in snap.items()
+                       if k.startswith("tpu_dispatch_queue_us")
+                       and k.endswith(".sum"))
+
+        snap0 = _cstats().snapshot()
+        conc_lats: list = []
+        lat_lock = _threading.Lock()
+        conc_errs: list = []
+
+        def _conc_worker(i):
+            try:
+                cl = conc_cluster.client()
+                cl.execute("USE conc")
+                mine = []
+                for j in range(creps):
+                    t0 = time.perf_counter()
+                    r = cl.execute(_conc_stmt(i, j))
+                    dt = time.perf_counter() - t0
+                    if r.error is not None:
+                        conc_errs.append(r.error)
+                        return
+                    mine.append(dt)
+                with lat_lock:
+                    conc_lats.extend(mine)
+            except Exception as ex:  # noqa: BLE001
+                conc_errs.append(repr(ex))
+
+        t0 = time.perf_counter()
+        ths = [_threading.Thread(target=_conc_worker, args=(i,))
+               for i in range(cthreads)]
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join()
+        conc_wall = time.perf_counter() - t0
+        assert not conc_errs, conc_errs[:3]
+        snap1 = _cstats().snapshot()
+        conc_lats.sort()
+        ncl = len(conc_lats)
+
+        def _pq(p):
+            return conc_lats[min(ncl - 1, int(ncl * p / 100))]
+
+        conc_queue_us = _qwait_us(snap1) - _qwait_us(snap0)
+        conc_total_us = sum(conc_lats) * 1e6
+        concurrency = {
+            "threads": cthreads,
+            "stmts": ncl,
+            "statement_mix": "alternating 1-hop GO / 1-hop MATCH",
+            "persons": cn,
+            "replica_factor": 3,
+            "p50_ms": round(_pq(50) * 1e3, 2),
+            "p95_ms": round(_pq(95) * 1e3, 2),
+            "p99_ms": round(_pq(99) * 1e3, 2),
+            "qps": round(ncl / conc_wall, 1),
+            "wall_s": round(conc_wall, 2),
+            # the wait-vs-run decomposition item 2 is judged by: how
+            # much of the summed statement latency was spent QUEUED on
+            # the device dispatch gate
+            "queue_wait_us_total": int(conc_queue_us),
+            "queue_wait_share": round(conc_queue_us / conc_total_us, 4)
+            if conc_total_us else 0.0,
+        }
+    finally:
+        conc_cluster.stop()
+    # watchdog + live-registry overhead A/B on the north-star
+    # single-query config (workload_plane_enabled off = register
+    # nothing; the watchdog thread keeps scanning either way) —
+    # acceptance bar: <= 2%
+    from nebula_tpu.exec.engine import QueryEngine as _WlQE
+    from nebula_tpu.utils.config import get_config as _wl_cfg
+    wl_eng = _WlQE(store)
+    wl_sess = wl_eng.new_session()
+    wl_eng.execute(wl_sess, "USE snb")
+    wl_q = f"GO FROM {seed_list} OVER KNOWS YIELD dst(edge) AS d"
+
+    def _wl_p50(enabled: bool) -> float:
+        _wl_cfg().set_dynamic("workload_plane_enabled", enabled)
+        wl_eng.execute(wl_sess, wl_q)             # warm
+        ol = []
+        for _ in range(40):
+            t0 = time.perf_counter()
+            r = wl_eng.execute(wl_sess, wl_q)
+            ol.append(time.perf_counter() - t0)
+            assert r.error is None, r.error
+        return _median(ol)
+
+    try:
+        wl_off = _wl_p50(False)
+        wl_on = _wl_p50(True)
+    finally:
+        _wl_cfg().dynamic_layer.pop("workload_plane_enabled", None)
+    concurrency["workload_off_p50_ms"] = round(wl_off * 1e3, 3)
+    concurrency["workload_on_p50_ms"] = round(wl_on * 1e3, 3)
+    concurrency["workload_overhead_pct"] = round(
+        max((wl_on - wl_off) / wl_off, 0.0) * 100.0, 2) \
+        if wl_off > 0 else 0.0
+    _save_partial(platform, configs)
+
     # VERDICT r3 item 2: the driver tails stdout into a small buffer, so
     # the headline must be COMPACT and LAST.  Full detail goes to
     # BENCH_DETAIL.json next to this script.
@@ -1096,6 +1255,7 @@ def main():
         "regression": regression,
         "fault_recovery": fault_recovery,
         "observability": observability,
+        "concurrency": concurrency,
         "configs": configs,
     }
     if tpu_partial is not None:

@@ -97,6 +97,11 @@ def main(argv=None):
             web.providers["/federation"] = lambda q: (
                 200, _json.dumps(fed.scrape_status(), default=str),
                 "application/json")
+            # live workload federation (ISSUE 9): one endpoint answers
+            # "what is the whole cluster running right now"
+            web.providers["/cluster_queries"] = lambda q: (
+                200, _json.dumps(fed.cluster_queries(), default=str),
+                "application/json")
         else:
             # tell metad where to scrape us (rides the heartbeat) —
             # set BEFORE svc.start() so the first heartbeat carries it

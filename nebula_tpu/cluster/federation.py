@@ -102,47 +102,51 @@ class MetricFederator:
 
     # -- scraping ---------------------------------------------------------
 
-    def _fetch(self, ws: str) -> str:
+    def _fetch(self, ws: str, path: str = "/metrics") -> str:
         try:
             timeout = float(get_config().get(
                 "metric_federation_timeout_secs"))
         except Exception:  # noqa: BLE001
             timeout = 3.0
-        with urllib.request.urlopen(f"http://{ws}/metrics",
+        with urllib.request.urlopen(f"http://{ws}{path}",
                                     timeout=timeout) as r:
             return r.read().decode()
 
+    def _fan_out(self, path: str):
+        """Concurrently fetch `path` from every alive target — the ONE
+        fan-out used by both /cluster_metrics and /cluster_queries, so
+        timeout/error handling cannot diverge between them.  Targets
+        are fetched concurrently: a rolling restart can leave several
+        heartbeat-alive-but-unreachable daemons, and a serial walk
+        would stack their timeouts into a tens-of-seconds round
+        exactly when the cluster view matters most.  Returns
+        [(target, body-or-OSError, seconds)]."""
+        from concurrent.futures import ThreadPoolExecutor
+        targets = self.targets()
+
+        def fetch_one(tgt):
+            t0 = time.monotonic()
+            try:
+                return tgt, self._fetch(tgt[2], path), \
+                    time.monotonic() - t0
+            except OSError as ex:
+                return tgt, ex, time.monotonic() - t0
+
+        if not targets:
+            return []
+        with ThreadPoolExecutor(max_workers=min(len(targets), 8),
+                                thread_name_prefix="fed-scrape") as pool:
+            return list(pool.map(fetch_one, targets))
+
     def scrape_once(self) -> str:
         """One full scrape round → the merged labeled exposition text.
-        Targets are fetched CONCURRENTLY: a rolling restart can leave
-        several heartbeat-alive-but-unreachable daemons, and a serial
-        walk would stack their timeouts into a tens-of-seconds round
-        exactly when the cluster view matters most.  (metad's own SLO
-        gauges refresh via its /metrics handler like every daemon's —
-        see webservice.py.)"""
-        from concurrent.futures import ThreadPoolExecutor
+        (metad's own SLO gauges refresh via its /metrics handler like
+        every daemon's — see webservice.py.)"""
         slo_engine().burn_rates()
         lines: List[str] = []
         seen_types: set = set()
         status: Dict[str, Dict] = {}
-        targets = self.targets()
-
-        def fetch_one(tgt):
-            addr, role, ws = tgt
-            t0 = time.monotonic()
-            try:
-                return tgt, self._fetch(ws), time.monotonic() - t0
-            except OSError as ex:
-                return tgt, ex, time.monotonic() - t0
-
-        if targets:
-            with ThreadPoolExecutor(
-                    max_workers=min(len(targets), 8),
-                    thread_name_prefix="fed-scrape") as pool:
-                results = list(pool.map(fetch_one, targets))
-        else:
-            results = []
-        for (addr, role, ws), text, dt in results:
+        for (addr, role, ws), text, dt in self._fan_out("/metrics"):
             if isinstance(text, OSError):
                 stats().inc("federation_scrape_errors")
                 status[addr] = {"role": role, "ws": ws, "ok": False,
@@ -168,6 +172,27 @@ class MetricFederator:
             self._status = status
             self._last_scrape = time.monotonic()
         return merged
+
+    def cluster_queries(self) -> Dict[str, Dict]:
+        """Live workload federation (ISSUE 9): fan /queries out over
+        every alive daemon and return the per-instance in-flight
+        statements + dispatch tables, instance/role attached — served
+        at metad's GET /cluster_queries.  Always scraped on demand
+        (live state is worthless stale), through the same fan-out as
+        /cluster_metrics."""
+        import json as _json
+        out: Dict[str, Dict] = {}
+        for (addr, role, ws), body, _dt in self._fan_out("/queries"):
+            if not isinstance(body, OSError):
+                try:
+                    out[addr] = {"role": role, "ok": True,
+                                 **_json.loads(body)}
+                    continue
+                except ValueError as ex:
+                    body = ex
+            stats().inc("federation_scrape_errors")
+            out[addr] = {"role": role, "ok": False, "error": str(body)}
+        return out
 
     def render(self) -> str:
         """The merged view, re-scraped on demand when stale (covers the

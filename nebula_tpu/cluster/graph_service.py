@@ -151,9 +151,19 @@ class GraphService:
         return True
 
     def rpc_list_queries(self, p):
-        """This graphd's RUNNING queries (SHOW [ALL] QUERIES fans out
-        over every graphd named in metad's session table)."""
+        """This graphd's RUNNING queries with live per-operator
+        progress (SHOW [ALL] QUERIES fans out over every graphd named
+        in metad's session table) — row shape documented at
+        QueryEngine.list_running_queries."""
         return self.engine.list_running_queries()
+
+    def rpc_session_live(self, p):
+        """The live half of SHOW SESSIONS (ISSUE 9): metad's replicated
+        table knows user/space/created, but last-used time and the
+        in-flight statement count only exist on the owning graphd."""
+        with self.lock:
+            items = list(self.sessions.items())
+        return {sid: [s.last_used, len(s.queries)] for sid, s in items}
 
     def rpc_stop_job(self, p):
         """STOP JOB routed from another graphd: this one is the

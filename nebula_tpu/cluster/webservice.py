@@ -11,6 +11,12 @@ mount, SURVEY §0]):
                          indented tree rendering)
     GET /flight          flight-recorder summaries (`?id=<n>` for one
                          entry's full per-operator breakdown) (ISSUE 8)
+    GET /queries         live workload plane (ISSUE 9): in-flight
+                         statements with per-operator progress, plus
+                         the device dispatch table (queued/running)
+    GET /stalls          stall-watchdog captures (`?id=<n>` for one
+                         capture's full thread stacks / dispatch table
+                         / kernel-ledger tail)
     GET /kernels         device kernel ledger: recent dispatches with
                          shape bucket / compile-vs-cache / µs / HBM
     GET /slo             multi-window SLO burn rates (availability +
@@ -138,6 +144,37 @@ class WebService:
                         self._send(200,
                                    json.dumps(
                                        flight_recorder().list(limit),
+                                       default=str),
+                                   "application/json")
+                elif u.path == "/queries":
+                    # live workload plane (ISSUE 9): what is running
+                    # RIGHT NOW on this daemon, with per-operator
+                    # progress and the device dispatch queue
+                    from ..utils.workload import (dispatch_table,
+                                                  live_registry)
+                    self._send(200, json.dumps(
+                        {"queries": live_registry().snapshot(),
+                         "dispatches": dispatch_table().snapshot()},
+                        default=str), "application/json")
+                elif u.path == "/stalls":
+                    from ..utils.workload import stall_watchdog
+                    sid = q.get("id")
+                    if sid:
+                        try:
+                            entry = stall_watchdog().get(int(sid))
+                        except ValueError:
+                            entry = None
+                        if entry is None:
+                            self._send(404, f"no stall entry `{sid}'")
+                        else:
+                            self._send(200, json.dumps(entry,
+                                                       default=str),
+                                       "application/json")
+                    else:
+                        limit = _int_q(q, "limit", 20)
+                        self._send(200,
+                                   json.dumps(
+                                       stall_watchdog().list(limit),
                                        default=str),
                                    "application/json")
                 elif u.path == "/kernels":

@@ -145,6 +145,11 @@ class ExecutionContext:
         # statement even on pool threads (docs/OBSERVABILITY.md)
         from ..utils.stats import WorkCounters
         self.work = WorkCounters()
+        # the statement's live workload-registry row (ISSUE 9), or None
+        # when the plane is disabled / the context is internal — the
+        # scheduler updates it per plan node, the device runtime adds
+        # queue/dispatch time through the use_live() thread-local
+        self.live = None
 
     def set_result(self, var: str, ds: DataSet):
         if self.tracker is not None and ds is not None:

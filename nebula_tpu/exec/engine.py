@@ -148,6 +148,10 @@ class QueryEngine:
         # parse/plan LRU (ISSUE 2): repeated statements skip
         # parse → validate → plan → optimize entirely
         self.plan_cache = PlanCache()
+        # stall watchdog (ISSUE 9): idempotent start of the process-wide
+        # scan thread; gated by stall_watchdog_interval_secs
+        from ..utils.workload import stall_watchdog
+        stall_watchdog().ensure_started()
 
     def new_session(self, user: str = "root") -> Session:
         # reap idle sessions so a long-lived embedded engine doesn't
@@ -177,17 +181,32 @@ class QueryEngine:
         return True
 
     def list_running_queries(self) -> list:
-        """RUNNING-query rows [sid, qid, user, text, status] — the one
-        source for SHOW [LOCAL] QUERIES and the graphd fan-out RPC."""
+        """RUNNING-query rows with live progress (ISSUE 9) — the one
+        source for SHOW [LOCAL] QUERIES and the graphd fan-out RPC.
+        Row shape: [sid, qid, user, text, status, operator, rows,
+        duration_us, queue_us, device_us, host_us, memory_bytes]."""
+        from ..utils.workload import live_registry
         rows = []
         for s in list(self.sessions.values()):
             for qid, qtext in list(s.queries.items()):
-                rows.append([s.id, qid, s.user, qtext, "RUNNING"])
+                lq = live_registry().get(qid)
+                if lq is not None:
+                    p = lq.snapshot()
+                    rows.append([s.id, qid, s.user, qtext, p["status"],
+                                 p["operator"], p["rows"],
+                                 p["duration_us"], p["queue_us"],
+                                 p["device_us"], p["host_us"],
+                                 p["memory_bytes"]])
+                else:
+                    # workload plane disabled: identity columns only
+                    rows.append([s.id, qid, s.user, qtext, "RUNNING",
+                                 "", 0, 0, 0, 0, 0, 0])
         return rows
 
     def kill_running(self, sid=None, qid=None) -> bool:
         """Set kill events of matching RUNNING queries; True if any
         matched (shared by KILL QUERY local path and the graphd RPC)."""
+        from ..utils.workload import live_registry
         hit = False
         for s in list(self.sessions.values()):
             if sid is not None and s.id != sid:
@@ -195,6 +214,11 @@ class QueryEngine:
             for q, ev in list(s.running_kill.items()):
                 if qid is None or q == qid:
                     ev.set()
+                    lq = live_registry().get(q)
+                    if lq is not None:
+                        # SHOW QUERIES reports KILLED while the victim
+                        # drains toward its next cancellation check
+                        lq.killed = True
                     hit = True
         return hit
 
@@ -427,6 +451,16 @@ class QueryEngine:
         except Exception:  # noqa: BLE001 — config not initialized
             pass
         dl = (time.monotonic() + timeout_s) if timeout_s > 0 else None
+        # live workload registration (ISSUE 9): the statement is visible
+        # in SHOW QUERIES / GET /queries with live per-operator progress
+        # from HERE until the finally below; the deadline rides along so
+        # the stall watchdog can derive this statement's stall threshold
+        from ..utils.workload import live_registry
+        live = live_registry().register(
+            qid=qid, session=session.id, user=session.user, stmt=text,
+            kind=self._stmt_kind(stmt), deadline=dl,
+            tracker=stmt_ectx.tracker)
+        stmt_ectx.live = live
         try:
             with _cancel.use_cancel(kill=stmt_ectx.kill_event,
                                     deadline=dl):
@@ -446,6 +480,8 @@ class QueryEngine:
         finally:
             session.queries.pop(qid, None)
             session.running_kill.pop(qid, None)
+            if live is not None:
+                live_registry().deregister(qid)
             # the flight recorder reads the statement's work counts off
             # the observer (even for failed statements, which return
             # from the except arms above)

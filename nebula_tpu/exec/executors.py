@@ -1603,6 +1603,20 @@ def _show(node, qctx, ectx, space):
             [[e["id"], e["status"], e["kind"], e["latency_us"],
               e["operators"], e["trace_id"], e["stmt"]]
              for e in flight_recorder().list()])
+    if kind == "stalls":
+        # stall-watchdog captures (ISSUE 9) — summaries only; the full
+        # thread stacks / dispatch table / kernel ledger of one capture
+        # are served by GET /stalls?id=<n>
+        from ..utils.workload import stall_watchdog
+        rows = []
+        for e in stall_watchdog().list(limit=50):
+            subj = e["subject"]
+            rows.append([e["id"], e["kind"],
+                         subj.get("stmt") or subj.get("kernel", ""),
+                         e["elapsed_s"], e["threshold_s"],
+                         e["threads"]])
+        return DataSet(["Id", "Kind", "Subject", "Elapsed (s)",
+                        "Threshold (s)", "Threads"], rows)
     if kind == "slo":
         from ..utils.slo import slo_engine
         return DataSet(
@@ -1720,19 +1734,42 @@ def _show(node, qctx, ectx, space):
                  ["Space", "edges", det["total_edges"]]]
         return DataSet(["Type", "Name", "Count"], rows)
     if kind == "sessions":
+        scols = ["SessionId", "UserName", "SpaceName", "CreateTime",
+                 "UpdateTime", "ActiveQueries", "GraphAddr"]
         cluster = getattr(qctx, "cluster", None)
         if a.get("extra") == "local":
             cluster = None      # SHOW LOCAL SESSIONS: this graphd only
         if cluster is not None:
-            return DataSet(
-                ["SessionId", "UserName", "SpaceName", "GraphAddr"],
-                [[s["sid"], s["user"], s.get("space"), s["graphd"]]
-                 for s in cluster.list_sessions()])
+            # metad's replicated table has user/space/created; the LIVE
+            # half (last-used time, in-flight statement count) lives on
+            # each owning graphd — one short fan-out fills it in, a
+            # dead graphd's sessions just show blanks (ISSUE 9)
+            sess = cluster.list_sessions()
+            live = {}
+            for addr in sorted({s["graphd"] for s in sess
+                                if s.get("graphd")}):
+                try:
+                    got = _graphd_call(addr, "graph.session_live")
+                except Exception:  # noqa: BLE001 — graphd down
+                    continue
+                for k, v in got.items():
+                    live[int(k)] = v
+            rows = []
+            for s in sess:
+                lu = live.get(s["sid"])
+                # None (rendered blank), never 0: a dead graphd's
+                # sessions must not read as epoch-1970 idle sessions
+                rows.append([s["sid"], s["user"], s.get("space"),
+                             int(s.get("created", 0)),
+                             int(lu[0]) if lu else None,
+                             int(lu[1]) if lu else None,
+                             s["graphd"]])
+            return DataSet(scols, rows)
         eng = getattr(qctx, "engine", None)
-        rows = [[s.id, s.user, s.space, "in-process"]
+        rows = [[s.id, s.user, s.space, int(s.created),
+                 int(s.last_used), len(s.queries), "in-process"]
                 for s in (list(eng.sessions.values()) if eng else ())]
-        return DataSet(["SessionId", "UserName", "SpaceName", "GraphAddr"],
-                       sorted(rows))
+        return DataSet(scols, sorted(rows))
     if kind == "snapshots":
         from .jobs import list_snapshots
         return list_snapshots()
@@ -1740,8 +1777,12 @@ def _show(node, qctx, ectx, space):
         from .jobs import list_backups
         return list_backups()
     if kind == "queries":
+        # live workload rows (ISSUE 9): current plan node, rows so far,
+        # queue-wait vs device vs host µs, memory charged — the columns
+        # come straight from the engine's WorkloadRegistry rows
         qcols = ["SessionId", "ExecutionPlanId", "User", "Query",
-                 "Status", "GraphAddr"]
+                 "Status", "Operator", "Rows", "DurationUs", "QueueUs",
+                 "DeviceUs", "HostUs", "MemoryBytes", "GraphAddr"]
         cluster = getattr(qctx, "cluster", None)
         if a.get("extra") == "local":
             cluster = None      # SHOW LOCAL QUERIES: this graphd only

@@ -125,6 +125,10 @@ class Scheduler:
         c_kill = _cancel.current_kill()
         c_dl = _cancel.current_deadline()
 
+        from ..utils.failpoints import fail as _fail
+        from ..utils.workload import use_live
+        live = getattr(ectx, "live", None)
+
         def exec_one(node: PlanNode):
             kill = getattr(ectx, "kill_event", None)
             if kill is not None and kill.is_set():
@@ -149,10 +153,19 @@ class Scheduler:
                         _cancel.use_cancel(kill=c_kill, deadline=c_dl), \
                         use_work(getattr(ectx, "work", None)), \
                         use_cost(node_cost), \
+                        use_live(live), \
                         trace.span(f"exec:{node.kind}", node=node.id) as rec:
                     # deadline check between plan nodes: a budget spent
                     # in an earlier node must not start the next one
                     _cancel.check()
+                    if live is not None:
+                        # live workload row (ISSUE 9): SHOW QUERIES
+                        # shows WHICH plan node is running right now
+                        live.node_start(node.kind, node.id)
+                    # failpoint: delay/fail any statement at a chosen
+                    # plan-node kind (stall-watchdog and live-progress
+                    # tests arm `exec:node` with key=<kind>)
+                    _fail.hit("exec:node", key=node.kind)
                     ds = run_node(node, self.qctx, ectx, plan.space)
                     if rec is not None and ds is not None:
                         # len(ds), not len(ds.rows): a ColumnarDataSet
@@ -171,6 +184,8 @@ class Scheduler:
             us = int((time.perf_counter() - t0) * 1e6)
             ectx.set_result(node.output_var, ds)
             done[node.id] = ds
+            if live is not None:
+                live.node_done(len(ds) if ds is not None else 0)
             if profile is not None:
                 profile.record(node, us, len(ds) if ds is not None else 0)
                 if node_cost:
@@ -182,6 +197,7 @@ class Scheduler:
                     # per-hop expansion sizes + kernel time + buckets
                     profile.per_node[node.id]["tpu"] = {
                         "device_s": round(ts.device_s, 6),
+                        "queue_s": round(getattr(ts, "queue_s", 0.0), 6),
                         "put_s": round(ts.put_s, 6),
                         "fetch_s": round(ts.fetch_s, 6),
                         "mat_s": round(ts.mat_s, 6),

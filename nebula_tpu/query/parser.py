@@ -802,7 +802,7 @@ class Parser:
                                       scope if scope == "local" else None)
             if kw in ("SPACES", "PARTS", "STATS", "JOBS", "SESSIONS",
                       "SNAPSHOTS", "BACKUPS", "QUERIES", "CONFIGS",
-                      "TRACES"):
+                      "TRACES", "STALLS"):
                 self.next()
                 if kw == "JOBS":
                     return A.ShowJobsSentence()

@@ -19,6 +19,16 @@ indented span tree, and diff counters over time.
     # delta mode: re-scrape every N seconds, print only changed counters
     python -m nebula_tpu.tools.metrics_dump --addrs ... --watch 5
 
+    # live workload + stall dumps (ISSUE 9)
+    python -m nebula_tpu.tools.metrics_dump --addr ... --queries
+    python -m nebula_tpu.tools.metrics_dump --addr ... --stalls
+
+    # Perfetto: every trace tree (+ stall captures) as Chrome
+    # trace-event JSON, one track per daemon/service, device spans
+    # included — open the file at https://ui.perfetto.dev
+    python -m nebula_tpu.tools.metrics_dump --addrs a,b,c \
+        --perfetto /tmp/cluster.trace.json
+
 A metad's federated view (`/cluster_metrics`) can be scraped like any
 single target with `--addr <metad-ws> --path /cluster_metrics`.
 """
@@ -159,6 +169,150 @@ def dump_flight(addr: str, entry_id: str = "") -> int:
     return len(entries)
 
 
+def dump_queries(addr: str) -> int:
+    """Live workload rows (GET /queries): in-flight statements with
+    per-operator progress, then the device dispatch table."""
+    got = json.loads(_fetch(addr, "/queries"))
+    qs = got.get("queries", [])
+    for e in qs:
+        print(f"q{e['qid']:<5} sess={e['session']:<4} "
+              f"{e['status']:<8} {e['operator']:<24} "
+              f"rows={e['rows']:<8} dur={e['duration_us']}us "
+              f"queue={e['queue_us']}us dev={e['device_us']}us "
+              f"host={e['host_us']}us  {e['stmt'][:50]}")
+    for d in got.get("dispatches", []):
+        print(f"dispatch#{d['seq']} {d['kernel']:<10} {d['state']:<8} "
+              f"wait={d['wait_us']}us run={d['run_us']}us "
+              f"qid={d.get('qid')}")
+    return len(qs)
+
+
+def dump_stalls(addr: str, entry_id: str = "") -> int:
+    if entry_id:
+        print(_fetch(addr, f"/stalls?id={entry_id}"))
+        return 1
+    entries = json.loads(_fetch(addr, "/stalls"))
+    for e in entries:
+        subj = e.get("subject", {})
+        what = subj.get("stmt") or subj.get("kernel") or ""
+        print(f"#{e['id']:<4} {e['kind']:<10} "
+              f"elapsed={e['elapsed_s']}s thr={e['threshold_s']}s "
+              f"threads={e['threads']:<3} {str(what)[:60]}")
+    return len(entries)
+
+
+# -- Perfetto / Chrome trace-event export (ISSUE 9 satellite) ---------------
+
+
+def to_perfetto(per_addr_traces: Dict[str, List[dict]],
+                per_addr_stalls: "Dict[str, List[dict]] | None" = None
+                ) -> dict:
+    """Convert trace-store entries (each `{tid, name, spans}`) and
+    stall captures into the Chrome trace-event JSON Perfetto loads.
+
+    Track layout: one PROCESS per scraped daemon (its webservice addr)
+    and one THREAD per service role that emitted spans there — so a
+    stitched cluster trace renders graphd / storaged / metad / device
+    spans on separate tracks, remote spans under the daemon that
+    produced them.  Span attrs ride in `args`; stall captures become
+    global instant events carrying their thread-stack summary."""
+    events: List[dict] = []
+    pids: Dict[str, int] = {}
+    tids: Dict[tuple, int] = {}
+
+    def pid_of(addr: str) -> int:
+        if addr not in pids:
+            pids[addr] = len(pids) + 1
+            events.append({"ph": "M", "name": "process_name",
+                           "pid": pids[addr],
+                           "args": {"name": addr}})
+        return pids[addr]
+
+    def tid_of(addr: str, svc: str) -> int:
+        key = (addr, svc)
+        if key not in tids:
+            tids[key] = len(tids) + 1
+            events.append({"ph": "M", "name": "thread_name",
+                           "pid": pid_of(addr), "tid": tids[key],
+                           "args": {"name": svc}})
+        return tids[key]
+
+    for addr, traces in sorted(per_addr_traces.items()):
+        for entry in traces:
+            for s in entry.get("spans", []):
+                svc = str(s.get("svc") or "unknown")
+                if s.get("remote"):
+                    svc += " [remote]"
+                ev = {"name": s.get("name", "?"), "cat": svc,
+                      "ph": "X",
+                      "ts": float(s.get("t0", 0.0)) * 1e6,
+                      "dur": int(s.get("dur_us", 0)),
+                      "pid": pid_of(addr), "tid": tid_of(addr, svc),
+                      "args": {"trace": s.get("tid"),
+                               **(s.get("attrs") or {})}}
+                events.append(ev)
+    for addr, stalls in sorted((per_addr_stalls or {}).items()):
+        for e in stalls:
+            subj = e.get("subject", {})
+            events.append({
+                "name": f"stall:{e.get('kind', '?')}",
+                "cat": "stall", "ph": "i", "s": "g",
+                "ts": float(e.get("ts", 0.0)) * 1e6,
+                "pid": pid_of(addr), "tid": tid_of(addr, "watchdog"),
+                "args": {"elapsed_s": e.get("elapsed_s"),
+                         "threshold_s": e.get("threshold_s"),
+                         "subject": {k: v for k, v in subj.items()
+                                     if k != "stacks"},
+                         "threads": sorted(e.get("stacks", {}))}})
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def _collect_traces(addr: str) -> List[dict]:
+    out = []
+    for t in json.loads(_fetch(addr, "/traces")):
+        try:
+            out.append(json.loads(_fetch(addr,
+                                         f"/traces?id={t['tid']}")))
+        except (OSError, ValueError):
+            continue
+    return out
+
+
+def _collect_stalls(addr: str) -> List[dict]:
+    out = []
+    try:
+        summaries = json.loads(_fetch(addr, "/stalls"))
+    except (OSError, ValueError):
+        return out
+    for s in summaries:
+        try:
+            out.append(json.loads(_fetch(addr,
+                                         f"/stalls?id={s['id']}")))
+        except (OSError, ValueError):
+            continue
+    return out
+
+
+def dump_perfetto(addrs: List[str], out_path: str) -> int:
+    """Scrape every addr's traces + stall captures and write one
+    Perfetto-loadable trace-event file.  Returns the event count."""
+    traces: Dict[str, List[dict]] = {}
+    stalls: Dict[str, List[dict]] = {}
+    for addr in addrs:
+        try:
+            traces[addr] = _collect_traces(addr)
+        except OSError as ex:
+            print(f"scrape of {addr} failed: {ex}", file=sys.stderr)
+            continue
+        stalls[addr] = _collect_stalls(addr)
+    doc = to_perfetto(traces, stalls)
+    with open(out_path, "w") as f:
+        json.dump(doc, f)
+    n = len(doc["traceEvents"])
+    print(f"wrote {n} events from {len(traces)} host(s) to {out_path}")
+    return n
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="metrics-dump")
     ap.add_argument("--addr", default="",
@@ -179,6 +333,19 @@ def main(argv=None) -> int:
     ap.add_argument("--flight-id", default="",
                     help="print one flight entry's full per-operator "
                          "breakdown")
+    ap.add_argument("--queries", action="store_true",
+                    help="live workload rows: in-flight statements "
+                         "with per-operator progress + the device "
+                         "dispatch table (GET /queries)")
+    ap.add_argument("--stalls", action="store_true",
+                    help="stall-watchdog captures (GET /stalls)")
+    ap.add_argument("--stall-id", default="",
+                    help="print one stall capture in full (thread "
+                         "stacks, dispatch table, kernel ledger)")
+    ap.add_argument("--perfetto", default="",
+                    help="write every scraped trace tree (+ stall "
+                         "captures) to FILE as Chrome trace-event "
+                         "JSON loadable in Perfetto")
     ap.add_argument("--grep", default="",
                     help="only metric lines containing this substring")
     ap.add_argument("--watch", type=float, default=0.0,
@@ -195,13 +362,20 @@ def main(argv=None) -> int:
         ap.error("need --addr or --addrs")
     one = addrs[0]
     if len(addrs) > 1 and (args.trace or args.traces or args.flight
-                           or args.flight_id):
-        # traces/flight entries are per-process state, not mergeable
-        # samples — be explicit about which host answers
-        print(f"note: --traces/--trace/--flight query a single host; "
-              f"using {one}", file=sys.stderr)
+                           or args.flight_id or args.queries
+                           or args.stalls or args.stall_id):
+        # traces/flight/workload entries are per-process state, not
+        # mergeable samples — be explicit about which host answers
+        print(f"note: --traces/--trace/--flight/--queries/--stalls "
+              f"query a single host; using {one}", file=sys.stderr)
     try:
-        if args.trace:
+        if args.perfetto:
+            dump_perfetto(addrs, args.perfetto)
+        elif args.queries:
+            dump_queries(one)
+        elif args.stalls or args.stall_id:
+            dump_stalls(one, args.stall_id)
+        elif args.trace:
             tid = args.trace
             if tid == "latest":
                 traces = json.loads(_fetch(one, "/traces"))

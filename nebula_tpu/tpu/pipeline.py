@@ -681,6 +681,13 @@ class _Runner:
             # instead of reporting one opaque TpuMatchPipeline row
             t0 = _time.perf_counter()
             dev0 = self.stats.device_s
+            # live workload row (ISSUE 9): finer-than-node progress —
+            # SHOW QUERIES shows WHICH fused segment is running, not
+            # just the opaque TpuMatchPipeline node
+            from ..utils.workload import current_live
+            lv = current_live()
+            if lv is not None:
+                lv.set_operator(f"TpuMatchPipeline/{op['op']}")
             out = getattr(self, "_x_" + op["op"])(op)
             seg = {"op": op["op"],
                    "us": int((_time.perf_counter() - t0) * 1e6)}
@@ -1156,8 +1163,8 @@ class _Runner:
         s.compiles += getattr(st, "compiles", 0)
         s.hbm_bytes = max(s.hbm_bytes, getattr(st, "hbm_bytes", 0))
         for ph in ("pin_s", "put_s", "fetch_s", "mat_s", "device_s",
-                   "total_s"):
-            setattr(s, ph, getattr(s, ph) + getattr(st, ph))
+                   "total_s", "queue_s"):
+            setattr(s, ph, getattr(s, ph) + getattr(st, ph, 0.0))
 
 
 # ---------------------------------------------------------------------------

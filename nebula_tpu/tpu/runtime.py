@@ -13,6 +13,7 @@ a predicate needs them.
 """
 from __future__ import annotations
 
+import threading
 import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -136,11 +137,68 @@ def _cat_prefix(arr, bi, pids, kc, dtype=None):
     return _cat_parts([arr[p, bi, :kc[p]] for p in pids], dtype)
 
 
+class _DispatchGate:
+    """Read-write gate serializing device dispatch against snapshot
+    re-pin (ISSUE 9 satellite: the serve-while-repin fix).
+
+    jaxlib's CPU client has a latent race where concurrent jitted
+    dispatches can deadlock against a device_put re-pinning a bumped
+    epoch (CHANGES.md PR 6 note: both reader threads blocked inside
+    the jitted call, no Python-level locks held).  Dispatches are
+    READERS — they share, so concurrent queries still overlap on the
+    chip — and a re-pin is the WRITER: it waits for in-flight
+    dispatches to drain and excludes new ones while the put runs.
+    Writer preference (a waiting writer blocks NEW readers) so a
+    steady dispatch stream cannot starve the epoch bump forever.
+
+    acquire_* returns the seconds spent waiting — the dispatch side's
+    wait is the statement's queue time (tpu_dispatch_queue_us)."""
+
+    __slots__ = ("_cond", "_readers", "_writer", "_writers_waiting")
+
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._readers = 0
+        self._writer = False
+        self._writers_waiting = 0
+
+    def acquire_read(self) -> float:
+        t0 = time.perf_counter()
+        with self._cond:
+            while self._writer or self._writers_waiting:
+                self._cond.wait()
+            self._readers += 1
+        return time.perf_counter() - t0
+
+    def release_read(self):
+        with self._cond:
+            self._readers -= 1
+            if self._readers == 0:
+                self._cond.notify_all()
+
+    def acquire_write(self) -> float:
+        t0 = time.perf_counter()
+        with self._cond:
+            self._writers_waiting += 1
+            try:
+                while self._writer or self._readers:
+                    self._cond.wait()
+                self._writer = True
+            finally:
+                self._writers_waiting -= 1
+        return time.perf_counter() - t0
+
+    def release_write(self):
+        with self._cond:
+            self._writer = False
+            self._cond.notify_all()
+
+
 class TraverseStats:
     __slots__ = ("hop_edges", "frontier_sizes", "result_edges", "f_cap",
                  "e_cap", "retries", "device_s", "steps",
                  "pin_s", "put_s", "fetch_s", "mat_s", "total_s",
-                 "compiles", "hbm_bytes", "segments")
+                 "compiles", "hbm_bytes", "segments", "queue_s")
 
     def __init__(self):
         self.hop_edges: List[int] = []
@@ -164,6 +222,9 @@ class TraverseStats:
         self.compiles = 0
         self.hbm_bytes = 0
         self.segments: List[dict] = []
+        # dispatch-gate wait before the kernel could run (ISSUE 9):
+        # the queue-wait half of the wait-vs-run decomposition
+        self.queue_s = 0.0
 
     def edges_traversed(self) -> int:
         return int(sum(self.hop_edges))
@@ -363,6 +424,9 @@ class TpuRuntime:
             except Exception:  # noqa: BLE001 — absent/corrupt cache
                 self._buckets = {}
         self.max_retries = 10
+        # dispatch-vs-repin gate (ISSUE 9): dispatches share, re-pins
+        # exclude — see _DispatchGate
+        self._gate = _DispatchGate()
         from ..utils.config import get_config
         # the bitmap frontier (round-4 redesign) has no size bucket;
         # the only escalating budget left is the per-block edge budget
@@ -410,15 +474,25 @@ class TpuRuntime:
                 raise TpuUnavailable(
                     f"snapshot needs {est:,}B HBM; {others:,}B already "
                     f"pinned, limit {limit:,} (flag tpu_hbm_limit_bytes)")
-        dev = pin_snapshot(snap, self.mesh)
-        dev.space_uid = getattr(sd, "uid", None)
-        self.snapshots[space] = dev
+        # the device_put runs under the WRITE side of the dispatch
+        # gate: in-flight dispatches drain first, new ones wait — the
+        # jaxlib serve-while-repin race window is closed, and the
+        # exclusive wait itself is telemetry (how long an epoch bump
+        # waited on the serving plane)
         from ..utils.stats import stats
+        wait_s = self._gate.acquire_write()
+        try:
+            dev = pin_snapshot(snap, self.mesh)
+            dev.space_uid = getattr(sd, "uid", None)
+            self.snapshots[space] = dev
+            # stale-epoch jitted fns are keyed by epoch; drop them
+            self._fns = {k: v for k, v in self._fns.items()
+                         if not (k[0] == space and k[1] != dev.epoch)}
+        finally:
+            self._gate.release_write()
+        stats().observe("tpu_repin_wait_us", int(wait_s * 1e6))
         stats().inc("tpu_pins")
         stats().gauge("tpu_hbm_bytes_pinned", float(self.hbm_bytes()))
-        # stale-epoch jitted fns are keyed by epoch; drop them
-        self._fns = {k: v for k, v in self._fns.items()
-                     if not (k[0] == space and k[1] != dev.epoch)}
         return dev
 
     @staticmethod
@@ -440,20 +514,30 @@ class TpuRuntime:
         """Pin an externally-built CsrSnapshot (bulk-ingest / bench path
         — no dict store behind it)."""
         snap = self._maybe_degree_split(snap)
-        dev = pin_snapshot(snap, self.mesh)
-        self.snapshots[snap.space] = dev
+        wait_s = self._gate.acquire_write()
+        try:
+            dev = pin_snapshot(snap, self.mesh)
+            self.snapshots[snap.space] = dev
+        finally:
+            self._gate.release_write()
         from ..utils.stats import stats
+        stats().observe("tpu_repin_wait_us", int(wait_s * 1e6))
         stats().inc("tpu_pins")
         stats().gauge("tpu_hbm_bytes_pinned", float(self.hbm_bytes()))
         return dev
 
     def unpin(self, space: str):
-        self.snapshots.pop(space, None)
-        self._fns = {k: v for k, v in self._fns.items() if k[0] != space}
-        self._kmax = {k: v for k, v in self._kmax.items()
-                      if k[0] != space}
-        self._buckets = {k: v for k, v in self._buckets.items()
-                         if k[0][0] != space}
+        self._gate.acquire_write()
+        try:
+            self.snapshots.pop(space, None)
+            self._fns = {k: v for k, v in self._fns.items()
+                         if k[0] != space}
+            self._kmax = {k: v for k, v in self._kmax.items()
+                          if k[0] != space}
+            self._buckets = {k: v for k, v in self._buckets.items()
+                             if k[0][0] != space}
+        finally:
+            self._gate.release_write()
 
     def hbm_bytes(self) -> int:
         return sum(s.hbm_bytes() for s in self.snapshots.values())
@@ -560,6 +644,57 @@ class TpuRuntime:
                   min_eb: Optional[int] = None,
                   fetch_keys: Optional[set] = None,
                   kernel: str = "traverse"):
+        """Dispatch-queue wrapper around _escalate_locked (ISSUE 9).
+
+        Every device program passes through here: the dispatch
+        registers in the live DispatchTable (queued → running → done,
+        feeding the tpu_dispatch_queue_depth gauge and the stall
+        watchdog), waits on the READ side of the dispatch-vs-repin
+        gate, and the wait lands in `tpu_dispatch_queue_us{kernel}`,
+        the statement's cost sink (`queue_us`) and its live-registry
+        row — the wait-vs-run decomposition the admission-control work
+        (ROADMAP item 2) will be specified against.  The failpoint
+        site `tpu:dispatch_gate` stalls a dispatch while it is still
+        QUEUED (stall-watchdog and queue-accounting tests)."""
+        from ..utils.failpoints import fail as _fail
+        from ..utils.stats import current_cost
+        from ..utils.stats import stats as _metrics
+        from ..utils.workload import current_live, dispatch_table
+        tok = dispatch_table().enter(kernel)
+        acquired = False
+        try:
+            # inside the try: a `raise` action must still exit the
+            # token, or GET /queries shows a phantom forever-queued
+            # dispatch and the depth gauge sticks at 1
+            _fail.hit("tpu:dispatch_gate", key=kernel)
+            self._gate.acquire_read()
+            acquired = True
+            wait_us = dispatch_table().mark_running(tok)
+            stats.queue_s = wait_us / 1e6
+            _metrics().observe("tpu_dispatch_queue_us", wait_us,
+                               {"kernel": kernel})
+            cc = current_cost()
+            if cc is not None:
+                cc.add("queue_us", wait_us)
+            lv = current_live()
+            if lv is not None:
+                lv.add("queue_us", wait_us)
+            return self._escalate_locked(
+                dev, dense, key_fn, build_fn, inputs_fn, stats,
+                n_hops=n_hops, uniform=uniform, min_eb=min_eb,
+                fetch_keys=fetch_keys, kernel=kernel)
+        finally:
+            if acquired:
+                self._gate.release_read()
+            dispatch_table().exit(tok)
+
+    def _escalate_locked(self, dev: DeviceSnapshot, dense: Sequence[int],
+                         key_fn, build_fn, inputs_fn,
+                         stats: "TraverseStats",
+                         n_hops: int = 1, uniform: bool = False,
+                         min_eb: Optional[int] = None,
+                         fetch_keys: Optional[set] = None,
+                         kernel: str = "traverse"):
         """Shared power-of-two bucket escalation driver for all device
         programs (traverse, bfs): seed bitmap layout, jit cache, one
         batched fetch, overflow-driven retry (SURVEY §7 hard-part #1).
@@ -767,6 +902,13 @@ class TpuRuntime:
                     cc.add("device_dispatches", len(rungs))
                     if stats.compiles:
                         cc.add("device_compiles", stats.compiles)
+                # live workload row (ISSUE 9): SHOW QUERIES reports the
+                # statement's device time while it is still running
+                from ..utils.workload import current_live as _cl
+                lv = _cl()
+                if lv is not None:
+                    lv.add("device_us", sum(r for r, _ in rungs))
+                    lv.add("dispatches", len(rungs))
                 dispatch_us = int(stats.device_s * 1e6)
                 hbm = self.hbm_bytes()
                 stats.hbm_bytes = hbm

@@ -107,16 +107,20 @@ class FlightRecorder:
                error: Optional[str], trace_id: Optional[str],
                session: Optional[int], operators,
                work: Optional[Dict[str, Any]] = None,
-               slow_us: int = 0) -> Optional[dict]:
+               slow_us: int = 0,
+               force: Optional[str] = None) -> Optional[dict]:
         """Retain one completed statement if forced or sampled.
         Returns the stored entry (or None when dropped).  `operators`
         (and `work`) may be zero-arg callables — they are only invoked
         AFTER the retain decision, so a dropped statement pays nothing
-        beyond the decision itself (the ≤2% overhead budget)."""
+        beyond the decision itself (the ≤2% overhead budget).
+        `force` retains unconditionally under that status — the stall
+        watchdog records a still-RUNNING statement this way (ISSUE 9),
+        which classify() cannot see from the outcome alone."""
         cap = self._capacity()
         if cap <= 0:
             return None
-        forced = self.classify(error, latency_us, slow_us)
+        forced = force or self.classify(error, latency_us, slow_us)
         if forced is None and not self._admit_sample():
             return None
         if callable(operators):

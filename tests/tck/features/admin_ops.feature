@@ -206,3 +206,10 @@ Feature: Cluster and operational admin statements
       SHOW LOCAL QUERIES
       """
     Then the result should contain "SHOW LOCAL QUERIES"
+
+  Scenario: show queries reports the live operator column
+    When executing query:
+      """
+      SHOW QUERIES
+      """
+    Then the result should contain "Show"

@@ -432,3 +432,47 @@ def test_metric_catalogue_lint():
         f"{sorted(undocumented)}"
     assert not stale, \
         f"catalogued metrics no code emits: {sorted(stale)}"
+
+
+# -- span catalogue lint (ISSUE 9 satellite) --------------------------------
+
+
+def _emitted_span_names():
+    """Every span / phase / root-trace name the source tree emits,
+    with dynamic f-string segments (`{node.kind}`) normalized to `*`
+    so `exec:{node.kind}` and the catalogue's `exec:*` compare equal."""
+    pat = re.compile(
+        r'(?:trace|_trace|_t)\.(?:span|record_phase|start_trace)\(\s*'
+        r'(f?)["\']([^"\']+)["\']')
+    names = set()
+    for p in (REPO / "nebula_tpu").rglob("*.py"):
+        for isf, name in pat.findall(p.read_text()):
+            if isf:
+                name = re.sub(r"\{[^}]*\}", "*", name)
+            names.add(name)
+    return names
+
+
+def _catalogued_span_names():
+    doc = (REPO / "docs/OBSERVABILITY.md").read_text()
+    section = doc.split("## Span catalogue", 1)
+    assert len(section) == 2, "OBSERVABILITY.md lost its span catalogue"
+    body = section[1].split("\n## ", 1)[0]
+    return set(re.findall(r"^- `([A-Za-z0-9_.:*]+)`", body,
+                          re.MULTILINE))
+
+
+def test_span_catalogue_lint():
+    """Every span/phase name the source emits is documented and every
+    documented span name is emitted — so a renamed span cannot
+    silently orphan dashboards or the Perfetto export."""
+    emitted = _emitted_span_names()
+    documented = _catalogued_span_names()
+    assert emitted, "span scan found nothing — the regex rotted"
+    undocumented = emitted - documented
+    stale = documented - emitted
+    assert not undocumented, \
+        f"spans missing from docs/OBSERVABILITY.md span catalogue: " \
+        f"{sorted(undocumented)}"
+    assert not stale, \
+        f"catalogued spans no code emits: {sorted(stale)}"

@@ -127,6 +127,87 @@ def test_metrics_dump_watch_deltas(capsys):
         ws.stop()
 
 
+def test_metrics_dump_perfetto_export(tmp_path, capsys):
+    """--perfetto exports scraped trace trees + stall captures as
+    Chrome trace-event JSON (ISSUE 9 satellite): one process track per
+    daemon, one thread track per service, device spans included,
+    stalls as instant events."""
+    import json
+
+    from nebula_tpu.cluster.webservice import WebService
+    from nebula_tpu.tools import metrics_dump
+    from nebula_tpu.utils import trace
+    from nebula_tpu.utils.workload import stall_watchdog
+
+    # a stitched trace with host + device + remote-ish spans
+    with trace.start_trace("query:Go", service="graphd", stmt="GO ..."):
+        with trace.span("exec:ExpandAll", node=7):
+            trace.record_phase("device:dispatch", 0.003, eb=[256])
+        trace.graft([{"tid": "t1", "sid": "r1", "psid": "x",
+                      "name": "store:get_neighbors", "svc": "storaged",
+                      "t0": 1.0, "dur_us": 42}])
+    stall_watchdog().clear()
+    stall_watchdog()._capture(
+        "dispatch", {"kernel": "traverse", "state": "queued"},
+        1.5, 0.5)
+    ws = WebService(role="graphd")
+    ws.start()
+    out_path = tmp_path / "cluster.trace.json"
+    try:
+        rc = metrics_dump.main(["--addr", ws.addr,
+                                "--perfetto", str(out_path)])
+        assert rc == 0
+        doc = json.loads(out_path.read_text())
+        evs = doc["traceEvents"]
+        spans = [e for e in evs if e["ph"] == "X"]
+        names = {e["name"] for e in spans}
+        assert {"query:Go", "exec:ExpandAll",
+                "device:dispatch"} <= names
+        # remote span rides on its own service track
+        remote = next(e for e in spans
+                      if e["name"] == "store:get_neighbors")
+        assert "[remote]" in remote["cat"]
+        for e in spans:
+            assert e["pid"] and e["tid"] and "ts" in e and "dur" in e
+        # process/thread metadata names the tracks
+        meta = {e["name"] for e in evs if e["ph"] == "M"}
+        assert {"process_name", "thread_name"} <= meta
+        # the stall capture lands as a global instant event
+        stall = next(e for e in evs if e["ph"] == "i")
+        assert stall["name"] == "stall:dispatch"
+        assert stall["args"]["subject"]["kernel"] == "traverse"
+        # --stalls lists the capture too
+        rc = metrics_dump.main(["--addr", ws.addr, "--stalls"])
+        assert rc == 0
+        assert "dispatch" in capsys.readouterr().out
+    finally:
+        ws.stop()
+        stall_watchdog().clear()
+
+
+def test_metrics_dump_queries_listing(capsys):
+    """--queries prints the live workload rows from GET /queries."""
+    from nebula_tpu.cluster.webservice import WebService
+    from nebula_tpu.tools import metrics_dump
+    from nebula_tpu.utils.workload import live_registry
+
+    lq = live_registry().register(
+        qid=990001, session=7, user="root",
+        stmt="GO FROM 1 OVER E", kind="Go")
+    assert lq is not None
+    lq.node_start("ExpandAll", 3)
+    ws = WebService(role="graphd")
+    ws.start()
+    try:
+        rc = metrics_dump.main(["--addr", ws.addr, "--queries"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "q990001" in out and "ExpandAll#3" in out
+    finally:
+        ws.stop()
+        live_registry().deregister(990001)
+
+
 def test_metrics_dump_unreachable_host(capsys):
     """In cluster mode a dead host is reported and skipped — the rest
     of the scrape still merges (single-addr mode stays fatal)."""

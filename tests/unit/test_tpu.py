@@ -509,8 +509,17 @@ def test_serve_while_repin_stress(rt):
     the epoch and forces a re-pin).  Every result must be internally
     consistent — a traversal may serve the pre- or post-write snapshot,
     but never a torn mix, and the final settled result must equal the
-    host oracle."""
+    host oracle.
+
+    The jaxlib CPU race this used to flake on (CHANGES.md PR 6 note:
+    concurrent jitted dispatches deadlocking against a device_put,
+    2/20 runs) is closed by TpuRuntime's dispatch-vs-repin read-write
+    gate (ISSUE 9): dispatches share, a re-pin drains and excludes
+    them.  ALARM-GUARDED: the workers are daemon threads joined with a
+    timeout, so a regression fails in seconds with the live thread
+    stacks instead of wedging the whole 870 s tier-1 budget."""
     import threading
+    import time as _time
 
     st = random_store(31)
     errs = []
@@ -533,17 +542,159 @@ def test_serve_while_repin_stress(rt):
         except Exception as ex:  # noqa: BLE001
             errs.append(ex)
 
-    ts = [threading.Thread(target=writer)] + \
-        [threading.Thread(target=reader) for _ in range(2)]
+    ts = [threading.Thread(target=writer, daemon=True)] + \
+        [threading.Thread(target=reader, daemon=True) for _ in range(2)]
     for t in ts:
         t.start()
+    deadline = _time.monotonic() + 120.0
+    stuck = []
     for t in ts:
-        t.join()
+        t.join(timeout=max(deadline - _time.monotonic(), 0.1))
+        if t.is_alive():
+            stuck.append(t.name)
+    if stuck:
+        from nebula_tpu.utils.workload import _thread_stacks
+        dump = "\n".join(f"--- {k}\n" + "\n".join(v[-4:])
+                         for k, v in _thread_stacks().items())
+        pytest.fail(f"serve-while-repin deadlock: {stuck} still alive "
+                    f"after 120s\n{dump}")
     assert not errs, errs
     # settled: device result equals host oracle exactly
     rows, _ = rt.traverse(st, "g", [3], ["knows"], "out", 2)
     got = sorted(norm_edge(e) for (_, e, _) in rows)
     assert got == host_go(st, "g", [3], ["knows"], "out", 2)
+
+
+def test_dispatch_gate_semantics(rt):
+    """The dispatch-vs-repin gate (ISSUE 9): readers share; a writer
+    excludes readers AND blocks new ones while waiting (writer
+    preference, so a dispatch stream cannot starve an epoch bump)."""
+    import threading
+    import time as _time
+
+    from nebula_tpu.tpu.runtime import _DispatchGate
+    g = _DispatchGate()
+    log = []
+    r1_in = threading.Event()
+    release_r1 = threading.Event()
+
+    def reader1():
+        g.acquire_read()
+        log.append("r1+")
+        r1_in.set()
+        release_r1.wait(5)
+        log.append("r1-")
+        g.release_read()
+
+    def writer():
+        r1_in.wait(5)
+        log.append("w?")
+        g.acquire_write()          # blocks until r1 releases
+        log.append("w+")
+        g.release_write()
+
+    t1 = threading.Thread(target=reader1, daemon=True)
+    tw = threading.Thread(target=writer, daemon=True)
+    t1.start()
+    tw.start()
+    r1_in.wait(5)
+    # wait until the writer is REGISTERED as waiting (polling the
+    # gate's own counter — a blind sleep races thread scheduling on a
+    # loaded test VM)
+    t0 = _time.monotonic()
+    while g._writers_waiting == 0 and _time.monotonic() - t0 < 5.0:
+        _time.sleep(0.005)
+    assert g._writers_waiting == 1, "writer never queued"
+    got2 = []
+
+    def reader2():
+        g.acquire_read()           # writer waiting → must block
+        got2.append(True)
+        g.release_read()
+
+    t2 = threading.Thread(target=reader2, daemon=True)
+    t2.start()
+    _time.sleep(0.1)
+    assert not got2, "reader overtook a waiting writer"
+    release_r1.set()
+    tw.join(5)
+    t2.join(5)
+    assert log[-1] == "w+" or "w+" in log
+    assert got2 == [True]
+    t1.join(5)
+
+
+def test_failpoint_delayed_dispatch_stall_dump(rt):
+    """Acceptance shape (ISSUE 9): a failpoint-delayed device dispatch
+    produces a stall capture — thread stacks + the in-flight dispatch
+    table + the kernel-ledger tail — while the query's rows stay
+    byte-identical to an uninstrumented run (the watchdog observes,
+    never touches)."""
+    import threading
+    import time as _time
+
+    from nebula_tpu.utils.config import get_config
+    from nebula_tpu.utils.failpoints import fail
+    from nebula_tpu.utils.workload import stall_watchdog
+
+    st = random_store(62)
+    want, _ = rt.traverse(st, "g", [3], ["knows"], "out", 2)
+    want = sorted(norm_edge(e) for (_, e, _) in want)
+    stall_watchdog().clear()
+    get_config().set_dynamic("stall_threshold_secs", 0.05)
+    fail.arm("tpu:dispatch_gate", "1*delay(0.4)")
+    try:
+        box = {}
+
+        def run():
+            box["rows"], _ = rt.traverse(st, "g", [3], ["knows"],
+                                         "out", 2)
+
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+        t0 = _time.monotonic()
+        found = []
+        while _time.monotonic() - t0 < 5.0 and not found:
+            # poll the RING, not scan_once()'s return — the engine's
+            # background watchdog may win the capture race
+            stall_watchdog().scan_once()
+            found = [e for e in stall_watchdog().list()
+                     if e["kind"] == "dispatch"]
+            _time.sleep(0.02)
+        t.join(30)
+        assert len(found) == 1, "delayed dispatch was never captured"
+        summ = found[0]
+        full = stall_watchdog().get(summ["id"])
+        assert full["stacks"], "no thread stacks in the stall dump"
+        assert isinstance(full["kernels"], list)
+        assert full["subject"]["state"] == "queued"
+        got = sorted(norm_edge(e) for (_, e, _) in box["rows"])
+        assert got == want, "stall capture perturbed the result rows"
+    finally:
+        fail.reset()
+        stall_watchdog().clear()
+        get_config().dynamic_layer.pop("stall_threshold_secs", None)
+
+
+def test_dispatch_queue_accounting(rt):
+    """Every device dispatch reports its wait-vs-run decomposition:
+    tpu_dispatch_queue_us{kernel} moves, TraverseStats carries queue_s,
+    the queue-depth gauge settles back to zero, and the dispatch table
+    is empty once the statement finishes (ISSUE 9)."""
+    from nebula_tpu.utils.stats import stats as _stats
+    from nebula_tpu.utils.workload import dispatch_table
+
+    st = random_store(61)
+    before = _stats().snapshot().get(
+        "tpu_dispatch_queue_us{kernel=traverse}.count", 0)
+    rows, tstats = rt.traverse(st, "g", [3], ["knows"], "out", 2)
+    assert rows
+    assert tstats.queue_s >= 0.0
+    snap = _stats().snapshot()
+    assert snap.get("tpu_dispatch_queue_us{kernel=traverse}.count",
+                    0) > before
+    assert snap.get("tpu_dispatch_queue_depth", 0) == 0
+    assert len(dispatch_table()) == 0
 
 
 SUBGRAPH_QS = [

@@ -1,0 +1,505 @@
+"""Live workload plane (ISSUE 9): SHOW QUERIES/SESSIONS with live
+per-operator progress, the stall watchdog (ring + forced flight
+capture + /stalls), concurrent per-statement attribution (CostRecorder
+/ flight entries / live rows, including under KILL QUERY), and the
+federated /queries surface."""
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from nebula_tpu.cluster.webservice import WebService
+from nebula_tpu.exec.engine import QueryEngine
+from nebula_tpu.utils.config import get_config
+from nebula_tpu.utils.failpoints import fail
+from nebula_tpu.utils.flight import flight_recorder
+from nebula_tpu.utils.stats import WorkCounters, use_work
+from nebula_tpu.utils.workload import (LiveQuery, StallWatchdog,
+                                       dispatch_table, live_registry,
+                                       stall_watchdog)
+
+
+@pytest.fixture()
+def clean():
+    fail.reset()
+    stall_watchdog().clear()
+    yield
+    fail.reset()
+    stall_watchdog().clear()
+    for k in ("stall_threshold_secs", "workload_plane_enabled",
+              "flight_sample_rate", "stall_default_secs"):
+        get_config().dynamic_layer.pop(k, None)
+
+
+def small_engine(n=30, deg=3):
+    eng = QueryEngine()
+    s = eng.new_session()
+    for q in ("CREATE SPACE wl(partition_num=2, vid_type=INT64)",
+              "USE wl", "CREATE TAG P(x int)", "CREATE EDGE E(w int)"):
+        r = eng.execute(s, q)
+        assert r.error is None, f"{q} -> {r.error}"
+    vals = ", ".join(f"{v}:({v})" for v in range(n))
+    assert eng.execute(s, f"INSERT VERTEX P(x) VALUES {vals}").ok
+    edges = ", ".join(f"{v}->{(v * k + 1) % n}:({v + k})"
+                      for v in range(n) for k in range(1, deg + 1))
+    assert eng.execute(s, f"INSERT EDGE E(w) VALUES {edges}").ok
+    return eng, s
+
+
+def _delay_nodes(kind, secs):
+    """Delay only plan nodes of `kind` (GO plans carry ExpandAll; SHOW
+    / KILL statements don't), so probing statements run undelayed."""
+    fail.arm_callable(
+        "exec:node",
+        lambda i, key: ("delay", secs) if key == kind else None)
+
+
+def _run_async(eng, sess, stmt):
+    box = {}
+
+    def run():
+        box["rs"] = eng.execute(sess, stmt)
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    return t, box
+
+
+def _wait_for(pred, timeout=5.0, msg="condition"):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        got = pred()
+        if got:
+            return got
+        time.sleep(0.01)
+    pytest.fail(f"timed out waiting for {msg}")
+
+
+# -- live progress ----------------------------------------------------------
+
+
+def test_show_queries_live_progress(clean):
+    """A second session sees the in-flight statement's current plan
+    node, live duration and status — and the row disappears once the
+    statement completes."""
+    eng, s = small_engine()
+    _delay_nodes("ExpandAll", 0.1)
+    t, box = _run_async(eng, s, "GO 2 STEPS FROM 1 OVER E "
+                                "YIELD dst(edge) AS d")
+    s2 = eng.new_session()
+    row = _wait_for(
+        lambda: next((r for r in eng.list_running_queries()
+                      if r[3].startswith("GO 2 STEPS")), None),
+        msg="GO statement in SHOW QUERIES")
+    sid, qid, user, text, status, operator = row[:6]
+    assert sid == s.id and status == "RUNNING" and user == "root"
+    assert operator, "no live operator reported"
+    assert row[7] > 0, "duration_us must be live"
+    # the SHOW QUERIES statement surface carries the same row
+    rs = eng.execute(s2, "SHOW QUERIES")
+    assert rs.ok
+    assert rs.data.column_names[:8] == [
+        "SessionId", "ExecutionPlanId", "User", "Query", "Status",
+        "Operator", "Rows", "DurationUs"]
+    t.join(10)
+    fail.reset()
+    assert box["rs"].error is None
+    assert not any(r[3].startswith("GO 2 STEPS")
+                   for r in eng.list_running_queries())
+    assert live_registry().get(qid) is None
+
+
+def test_kill_query_lands_and_flight_records_killed(clean):
+    eng, s = small_engine()
+    _delay_nodes("ExpandAll", 0.1)
+    t, box = _run_async(eng, s, "GO 3 STEPS FROM 2 OVER E "
+                                "YIELD dst(edge) AS d")
+    row = _wait_for(
+        lambda: next((r for r in eng.list_running_queries()
+                      if r[3].startswith("GO 3 STEPS")), None),
+        msg="victim in SHOW QUERIES")
+    qid = row[1]
+    s2 = eng.new_session()
+    rs = eng.execute(s2, f"KILL QUERY (session={s.id}, plan={qid})")
+    assert rs.error is None, rs.error
+    # between the kill event and the next cancellation check the live
+    # row reports KILLED (the victim is draining, not gone)
+    lq = live_registry().get(qid)
+    if lq is not None:
+        assert lq.snapshot()["status"] == "KILLED"
+    t.join(10)
+    fail.reset()
+    assert box["rs"].error == "ExecutionError: query was killed"
+    ent = next(e for e in flight_recorder().list(limit=20)
+               if e["stmt"].startswith("GO 3 STEPS"))
+    assert ent["status"] == "killed"
+
+
+def test_show_sessions_live_columns(clean):
+    eng, s = small_engine()
+    rs = eng.execute(s, "SHOW SESSIONS")
+    assert rs.ok
+    assert rs.data.column_names == [
+        "SessionId", "UserName", "SpaceName", "CreateTime",
+        "UpdateTime", "ActiveQueries", "GraphAddr"]
+    mine = next(r for r in rs.data.rows if r[0] == s.id)
+    assert mine[1] == "root" and mine[2] == "wl"
+    assert mine[3] > 0 and mine[4] >= mine[3]
+    # the probing session is itself mid-execute: one active query
+    assert mine[5] == 1
+
+
+def test_workload_plane_disabled_registers_nothing(clean):
+    get_config().set_dynamic("workload_plane_enabled", False)
+    eng, s = small_engine()
+    _delay_nodes("ExpandAll", 0.1)
+    t, box = _run_async(eng, s, "GO FROM 1 OVER E YIELD dst(edge)")
+    row = _wait_for(
+        lambda: next((r for r in eng.list_running_queries()
+                      if r[3].startswith("GO FROM 1")), None),
+        msg="row with plane disabled")
+    # identity columns still served; live columns blank
+    assert row[4] == "RUNNING" and row[5] == "" and row[7] == 0
+    assert live_registry().get(row[1]) is None
+    t.join(10)
+    fail.reset()
+    assert box["rs"].error is None
+
+
+# -- stall watchdog ---------------------------------------------------------
+
+
+def test_stall_watchdog_statement_capture(clean):
+    """A statement stuck past its threshold yields exactly ONE capture:
+    thread stacks + dispatch table + kernel-ledger tail + live rows in
+    the ring, a forced flight-recorder entry, SHOW STALLS row."""
+    eng, s = small_engine()
+    get_config().set_dynamic("stall_threshold_secs", 0.05)
+    _delay_nodes("ExpandAll", 0.4)
+    t, box = _run_async(eng, s, "GO 2 STEPS FROM 3 OVER E "
+                                "YIELD dst(edge) AS d")
+    _wait_for(lambda: len(live_registry()) > 0, msg="registration")
+    time.sleep(0.15)
+    # assert on RING CONTENTS, not scan_once()'s return: the engine's
+    # background watchdog thread may legitimately win the capture race
+    # — the contract is "captured exactly once", by whoever scans first
+    stall_watchdog().scan_once()
+    stmts = [e for e in stall_watchdog().list()
+             if e["kind"] == "statement"]
+    assert len(stmts) == 1, stmts
+    # rescan: STILL exactly one capture (no duplicates)
+    stall_watchdog().scan_once()
+    stmts = [e for e in stall_watchdog().list()
+             if e["kind"] == "statement"]
+    assert len(stmts) == 1, stmts
+    summ = stmts[0]
+    assert summ["subject"]["stmt"].startswith("GO 2 STEPS")
+    full = stall_watchdog().get(summ["id"])
+    assert full["stacks"], "no thread stacks captured"
+    assert any("delay" in ln or "sleep" in ln
+               for frames in full["stacks"].values() for ln in frames), \
+        "stacks must show the stalled frame"
+    assert isinstance(full["dispatches"], list)
+    assert isinstance(full["kernels"], list)
+    assert full["live"] and full["live"][0]["stmt"].startswith("GO 2")
+    # forced flight capture of the still-running statement
+    ent = next(e for e in flight_recorder().list(limit=20)
+               if e["status"] == "stalled")
+    assert ent["stmt"].startswith("GO 2 STEPS")
+    # SHOW STALLS surfaces the ring
+    t.join(10)
+    fail.reset()
+    rs = eng.execute(s, "SHOW STALLS")
+    assert rs.ok and rs.data.rows
+    assert rs.data.rows[0][1] == "statement"
+    # statement itself completed unharmed — pure observation
+    assert box["rs"].error is None
+
+
+def test_stall_watchdog_dispatch_capture(clean):
+    """A device dispatch stuck in the table (queued or running) past
+    the threshold is captured as kind=dispatch."""
+    get_config().set_dynamic("stall_threshold_secs", 0.02)
+    tok = dispatch_table().enter("traverse")
+    try:
+        time.sleep(0.05)
+        stall_watchdog().scan_once()
+        disp = [e for e in stall_watchdog().list()
+                if e["kind"] == "dispatch"]
+        assert len(disp) == 1, disp
+        summ = disp[0]
+        assert summ["subject"]["kernel"] == "traverse"
+        assert summ["subject"]["state"] == "queued"
+        # rescan while still in flight: no duplicate capture
+        stall_watchdog().scan_once()
+        assert len([e for e in stall_watchdog().list()
+                    if e["kind"] == "dispatch"]) == 1
+    finally:
+        dispatch_table().exit(tok)
+
+
+def test_stall_threshold_derivation(clean):
+    """stall_threshold_secs=0 derives the threshold from the deadline
+    budget (stall_deadline_fraction); unbudgeted statements use
+    stall_default_secs; a flat threshold overrides both."""
+    lq = LiveQuery(qid=1, session=1, user="u", stmt="x", kind="Go",
+                   deadline=time.monotonic() + 10.0)
+    thr = StallWatchdog.stmt_threshold_s(lq)
+    assert 4.0 < thr < 6.0          # 0.5 × ~10 s budget
+    lq2 = LiveQuery(qid=2, session=1, user="u", stmt="x", kind="Go")
+    assert StallWatchdog.stmt_threshold_s(lq2) == pytest.approx(20.0)
+    get_config().set_dynamic("stall_threshold_secs", 0.25)
+    assert StallWatchdog.stmt_threshold_s(lq) == pytest.approx(0.25)
+    assert StallWatchdog.stmt_threshold_s(lq2) == pytest.approx(0.25)
+
+
+# -- concurrent attribution -------------------------------------------------
+
+
+GO_TMPL = "GO 2 STEPS FROM {seed} OVER E YIELD dst(edge) AS d"
+
+
+def _sequential_truth(eng, seeds):
+    truth = {}
+    for seed in seeds:
+        s = eng.new_session()
+        eng.execute(s, "USE wl")
+        wc = WorkCounters()
+        with use_work(wc):
+            rs = eng.execute(s, GO_TMPL.format(seed=seed))
+        assert rs.error is None
+        truth[seed] = (sorted(map(repr, rs.data.rows)), wc.as_dict())
+    return truth
+
+
+def test_concurrent_attribution_no_bleed(clean):
+    """N statements running simultaneously keep flight-recorder
+    entries, work counters and rows strictly per-statement: each
+    concurrent run's rows and deterministic work counts equal its own
+    sequential run — no cross-query bleed (ISSUE 9 satellite)."""
+    eng, _ = small_engine(n=40, deg=4)
+    seeds = [1, 2, 3, 5, 7, 11]
+    truth = _sequential_truth(eng, seeds)
+    flight_recorder().clear()
+    get_config().set_dynamic("flight_sample_rate", 1.0)
+
+    results = {}
+    counters = {}
+
+    def run(seed):
+        s = eng.new_session()
+        eng.execute(s, "USE wl")
+        wc = WorkCounters()
+        with use_work(wc):
+            rs = eng.execute(s, GO_TMPL.format(seed=seed))
+        results[seed] = rs
+        counters[seed] = wc.as_dict()
+
+    ts = [threading.Thread(target=run, args=(seed,), daemon=True)
+          for seed in seeds]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(30)
+    for seed in seeds:
+        rs = results[seed]
+        assert rs.error is None, rs.error
+        rows, work = truth[seed]
+        assert sorted(map(repr, rs.data.rows)) == rows, \
+            f"seed {seed}: rows bled across concurrent statements"
+        assert counters[seed] == work, \
+            f"seed {seed}: work counters bled across statements"
+    # every concurrent statement left its OWN flight entry (rate 1.0),
+    # whose recorded work matches the sequential truth
+    ents = flight_recorder().list(limit=100)
+    for seed in seeds:
+        stmt = GO_TMPL.format(seed=seed)
+        ent = next(e for e in ents if e["stmt"] == stmt[:120])
+        full = flight_recorder().get(ent["id"])
+        assert full["work"]["edges_traversed"] == \
+            truth[seed][1]["edges_traversed"], \
+            f"seed {seed}: flight work attribution bled"
+        assert full["operators"], "per-operator breakdown missing"
+
+
+def test_concurrent_attribution_under_kill(clean):
+    """A KILL QUERY on one of N concurrent statements takes down only
+    the victim: survivors' rows/attribution stay exact, the victim's
+    flight entry is `killed`."""
+    eng, _ = small_engine(n=40, deg=4)
+    seeds = [2, 3, 5]
+    truth = _sequential_truth(eng, seeds)
+    flight_recorder().clear()
+    get_config().set_dynamic("flight_sample_rate", 1.0)
+    # only the victim's statement shape is delayed: survivors run clean
+    victim_sess = eng.new_session()
+    eng.execute(victim_sess, "USE wl")
+    # every ExpandAll (victim AND survivors) is delayed — the victim
+    # stays killable, the survivors' work counters are time-immune
+    _delay_nodes("ExpandAll", 0.1)
+    t_victim, box = _run_async(eng, victim_sess,
+                               "GO 3 STEPS FROM 13 OVER E "
+                               "YIELD dst(edge) AS d")
+    row = _wait_for(
+        lambda: next((r for r in eng.list_running_queries()
+                      if r[3].startswith("GO 3 STEPS")), None),
+        msg="victim visible")
+
+    results = {}
+
+    def run(seed):
+        s = eng.new_session()
+        eng.execute(s, "USE wl")
+        results[seed] = eng.execute(s, GO_TMPL.format(seed=seed))
+
+    ts = [threading.Thread(target=run, args=(seed,), daemon=True)
+          for seed in seeds]
+    for t in ts:
+        t.start()
+    killer = eng.new_session()
+    rs = eng.execute(killer,
+                     f"KILL QUERY (session={victim_sess.id}, "
+                     f"plan={row[1]})")
+    assert rs.error is None, rs.error
+    for t in ts:
+        t.join(30)
+    t_victim.join(30)
+    fail.reset()
+    assert box["rs"].error == "ExecutionError: query was killed"
+    for seed in seeds:
+        assert results[seed].error is None
+        assert sorted(map(repr, results[seed].data.rows)) == \
+            truth[seed][0], f"survivor {seed} corrupted by the kill"
+    ent = next(e for e in flight_recorder().list(limit=100)
+               if e["stmt"].startswith("GO 3 STEPS"))
+    assert ent["status"] == "killed"
+
+
+def test_cluster_show_queries_live_and_kill(clean, tmp_path):
+    """The acceptance shape (ISSUE 9) on a live cluster: SHOW QUERIES
+    from a second session shows the in-flight statement's current
+    operator and live duration/queue/device/host µs columns; KILL
+    QUERY on it lands."""
+    from nebula_tpu.cluster.launcher import LocalCluster
+
+    c = LocalCluster(n_meta=1, n_storage=1, n_graph=1,
+                     data_dir=str(tmp_path))
+    try:
+        cl = c.client()
+        assert cl.execute("CREATE SPACE cw(partition_num=2, "
+                          "vid_type=INT64)").error is None
+        c.reconcile_storage()
+        for q in ("USE cw", "CREATE TAG P(x int)",
+                  "CREATE EDGE E(w int)"):
+            assert cl.execute(q).error is None, q
+        verts = ", ".join(f"{v}:({v})" for v in range(20))
+        assert cl.execute(
+            f"INSERT VERTEX P(x) VALUES {verts}").error is None
+        edges = ", ".join(f"{v}->{(v + 1) % 20}:({v})"
+                          for v in range(20))
+        assert cl.execute(
+            f"INSERT EDGE E(w) VALUES {edges}").error is None
+        _delay_nodes("ExpandAll", 0.15)
+        cl2 = c.client()
+        cl2.execute("USE cw")
+        box = {}
+        t = threading.Thread(
+            target=lambda: box.update(rs=cl.execute(
+                "GO 3 STEPS FROM 1 OVER E YIELD dst(edge) AS d")),
+            daemon=True)
+        t.start()
+
+        def probe():
+            rs = cl2.execute("SHOW QUERIES")
+            assert rs.error is None, rs.error
+            return next((r for r in rs.data.rows
+                         if str(r[3]).startswith("GO 3 STEPS")), None)
+
+        row = _wait_for(probe, timeout=10.0,
+                        msg="in-flight row via cluster SHOW QUERIES")
+        # [sid, qid, user, text, status, operator, rows, duration_us,
+        #  queue_us, device_us, host_us, memory_bytes, graph_addr]
+        assert row[4] == "RUNNING"
+        assert row[5], "no live operator over the cluster fan-out"
+        assert row[7] > 0 and row[10] >= 0
+        rs = cl2.execute(f"KILL QUERY (session={row[0]}, "
+                         f"plan={row[1]})")
+        assert rs.error is None, rs.error
+        t.join(15)
+        fail.reset()
+        assert box["rs"].error == "ExecutionError: query was killed"
+    finally:
+        c.stop()
+
+
+# -- HTTP surfaces ----------------------------------------------------------
+
+
+def test_queries_and_stalls_endpoints(clean):
+    eng, s = small_engine()
+    get_config().set_dynamic("stall_threshold_secs", 0.05)
+    ws = WebService(role="graphd")
+    ws.start()
+    try:
+        base = f"http://{ws.addr}"
+        _delay_nodes("ExpandAll", 0.3)
+        t, box = _run_async(eng, s, "GO 2 STEPS FROM 1 OVER E "
+                                    "YIELD dst(edge) AS d")
+        _wait_for(lambda: len(live_registry()) > 0, msg="registration")
+        got = json.loads(urllib.request.urlopen(
+            base + "/queries").read())
+        assert got["queries"] and \
+            got["queries"][0]["stmt"].startswith("GO 2 STEPS")
+        assert got["queries"][0]["operator"]
+        assert "dispatches" in got
+        time.sleep(0.1)
+        stall_watchdog().scan_once()
+        stalls = json.loads(urllib.request.urlopen(
+            base + "/stalls").read())
+        assert stalls and stalls[0]["kind"] == "statement"
+        full = json.loads(urllib.request.urlopen(
+            base + f"/stalls?id={stalls[0]['id']}").read())
+        assert full["stacks"]
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(base + "/stalls?id=99999")
+        t.join(10)
+        fail.reset()
+        assert box["rs"].error is None
+        got = json.loads(urllib.request.urlopen(
+            base + "/queries").read())
+        assert got["queries"] == []
+    finally:
+        ws.stop()
+
+
+def test_federated_cluster_queries(clean):
+    """metad's /cluster_queries view: the federator fans /queries out
+    over the heartbeat-alive daemons and labels each instance."""
+    from nebula_tpu.cluster.federation import MetricFederator
+
+    eng, s = small_engine()
+    ws = WebService(role="graphd")
+    ws.start()
+    try:
+        class _Meta:
+            my_addr = "meta:1"
+            active_hosts = {"g1:9669": {"ws": ws.addr, "role": "graph",
+                                        "last_hb": time.monotonic()}}
+
+        fed = MetricFederator(_Meta(), self_ws="")
+        _delay_nodes("ExpandAll", 0.3)
+        t, box = _run_async(eng, s, "GO 2 STEPS FROM 1 OVER E "
+                                    "YIELD dst(edge) AS d")
+        _wait_for(lambda: len(live_registry()) > 0, msg="registration")
+        got = fed.cluster_queries()
+        assert got["g1:9669"]["ok"] and \
+            got["g1:9669"]["role"] == "graphd"
+        assert got["g1:9669"]["queries"][0]["stmt"].startswith("GO 2")
+        t.join(10)
+        fail.reset()
+        assert box["rs"].error is None
+    finally:
+        ws.stop()
