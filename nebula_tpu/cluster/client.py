@@ -14,6 +14,8 @@ doing overflow-prone numpy arithmetic on the raw column.
 """
 from __future__ import annotations
 
+import random
+import time
 from typing import Optional
 
 from ..core.wire import from_wire
@@ -53,27 +55,65 @@ class GraphClient:
         return self.session_id
 
     def execute(self, stmt: str) -> ResultSet:
+        """Execute one statement.  An E_OVERLOAD shed (graphd admission
+        queue full, or the daemon's RPC inbox bounded out) is retried
+        honoring its retry-after hint, but only within the statement's
+        remaining deadline budget (ISSUE 10 satellite): the client
+        never turns bounded shedding into an unbounded retry storm.
+        When the budget is spent the overload comes back STRUCTURED —
+        `rs.error` keeps the full E_OVERLOAD text and
+        `rs.retry_after_ms` carries the parsed hint."""
         if self.session_id is None:
             raise RpcError("not authenticated")
-        try:
-            r = self.rpc.call("graph.execute", session_id=self.session_id,
-                              stmt=stmt)
-        except RpcConnError as ex:
-            if "rpc timeout" in str(ex):
-                # the statement outlived even the grace window (graphd
-                # wedged / unreachable mid-statement): a clean timeout
-                # result, not a raw transport traceback (ISSUE 5
-                # satellite).  NOTE the statement may still be running —
-                # same contract as any client-side cancel.
-                return ResultSet(
-                    error=f"E_QUERY_TIMEOUT: no reply within "
-                          f"{self.timeout:g}s (statement budget "
-                          f"{_statement_timeout():g}s + grace)")
-            raise
-        data = from_wire(r["data"]) if r["data"] is not None else None
-        return ResultSet(data=data, space=r["space"],
-                         latency_us=r["latency_us"],
-                         plan_desc=r["plan_desc"], error=r["error"])
+        from ..utils.admission import is_overload, parse_retry_after
+        deadline = time.monotonic() + _statement_timeout()
+        while True:
+            err: Optional[str] = None
+            try:
+                r = self.rpc.call("graph.execute",
+                                  session_id=self.session_id, stmt=stmt)
+            except RpcError as ex:
+                # the daemon's bounded RPC inbox shed the request (the
+                # handler provably never ran) — same structured surface
+                # as an admission-level shed, not a raw transport error
+                if not is_overload(str(ex)):
+                    raise
+                err = str(ex)
+            except RpcConnError as ex:
+                if "rpc timeout" in str(ex):
+                    # the statement outlived even the grace window
+                    # (graphd wedged / unreachable mid-statement): a
+                    # clean timeout result, not a raw transport
+                    # traceback (ISSUE 5 satellite).  NOTE the
+                    # statement may still be running — same contract
+                    # as any client-side cancel.
+                    return ResultSet(
+                        error=f"E_QUERY_TIMEOUT: no reply within "
+                              f"{self.timeout:g}s (statement budget "
+                              f"{_statement_timeout():g}s + grace)")
+                raise
+            if err is None:
+                if not is_overload(r["error"]):
+                    data = from_wire(r["data"]) \
+                        if r["data"] is not None else None
+                    return ResultSet(data=data, space=r["space"],
+                                     latency_us=r["latency_us"],
+                                     plan_desc=r["plan_desc"],
+                                     error=r["error"])
+                err = r["error"]
+            hint = parse_retry_after(err)
+            # jittered hint: clients shed in the same burst get the
+            # same retry_after_ms — sleeping it verbatim re-arrives
+            # the herd in one pulse and re-sheds most of it
+            hint_s = (hint if hint is not None else 0.25) \
+                * random.uniform(0.5, 1.5)
+            if time.monotonic() + hint_s >= deadline:
+                # budget exhausted: hand the structured overload back
+                rs = ResultSet(error=err)
+                if hint is not None:
+                    rs.retry_after_ms = int(hint * 1000)
+                return rs
+            time.sleep(hint_s)
 
     def signout(self):
         if self.session_id is not None:

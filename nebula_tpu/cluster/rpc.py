@@ -87,6 +87,28 @@ define_flag("breaker_reset_secs", 2.0,
             "how long an open breaker waits before letting ONE "
             "half-open probe through")
 
+# rpc_server_inbox_capacity is defined in utils/admission.py with the
+# rest of the overload-survival flags
+from ..utils.admission import (DrainEstimator, is_overload,  # noqa: E402
+                               overload_error, parse_retry_after)
+
+#: methods the bounded server inbox may NEVER shed: raft keeps the
+#: cluster consistent, meta.* keeps it discoverable, and graph.* rides
+#: the engine's AdmissionController instead — graph.execute carries
+#: control statements (SHOW/KILL — the operator's way back into a
+#: saturated cluster) that only the engine's priority lane can tell
+#: apart from data statements; the inbox shedding them blind would
+#: defeat the point of shedding everything else.  The inbox is the
+#: STORAGED-shaped gate (uniform read/write RPCs, all sheddable).
+_INBOX_EXEMPT_METHODS = frozenset({"raft"})
+_INBOX_EXEMPT_PREFIXES = ("meta.", "graph.")
+
+
+def _inbox_exempt(method) -> bool:
+    return not isinstance(method, str) or \
+        method in _INBOX_EXEMPT_METHODS or \
+        method.startswith(_INBOX_EXEMPT_PREFIXES)
+
 
 class RpcError(Exception):
     """Remote raised an application error."""
@@ -432,6 +454,14 @@ class RpcServer:
         # which daemon this server fronts ("graphd"/"storaged"/"metad");
         # stamped on the spans its handlers produce
         self.service_role = "unknown"
+        # bounded dispatch inbox (ISSUE 10): pipelined requests in
+        # flight across ALL this server's connections; beyond
+        # rpc_server_inbox_capacity new ones are rejected with
+        # E_OVERLOAD + a drain-rate-derived retry-after instead of
+        # queuing unboundedly on the worker pools
+        self._inbox = 0
+        self._inbox_mu = threading.Lock()
+        self._inbox_drain = DrainEstimator()
         outer = self
 
         class Handler(socketserver.BaseRequestHandler):
@@ -446,6 +476,14 @@ class RpcServer:
                         if rid is None:
                             outer._serve_one(sock, wlock, None, req)
                             continue
+                        shed = outer._inbox_enter(req)
+                        if shed is not None:
+                            try:
+                                with wlock:
+                                    _send_frame(sock, shed, rid)
+                            except (OSError, RpcConnError):
+                                pass
+                            continue
                         if pool is None:
                             try:
                                 workers = int(get_config().get(
@@ -455,7 +493,7 @@ class RpcServer:
                             pool = ThreadPoolExecutor(
                                 max_workers=max(1, workers),
                                 thread_name_prefix="rpc-srv")
-                        pool.submit(outer._serve_one, sock, wlock,
+                        pool.submit(outer._serve_pooled, sock, wlock,
                                     rid, req)
                 except (RpcConnError, socket.timeout, OSError,
                         json.JSONDecodeError, ValueError):
@@ -471,6 +509,52 @@ class RpcServer:
         self._server = Server((host, port), Handler)
         self.host, self.port = self._server.server_address
         self._thread: Optional[threading.Thread] = None
+
+    def _inbox_enter(self, req) -> Optional[Dict[str, Any]]:
+        """Admit a pipelined request into the dispatch inbox, or return
+        the E_OVERLOAD reply to send instead.  Exempt methods (raft,
+        meta.*, graph control ops) always enter; the `rpc:server_inbox`
+        failpoint force-sheds a request (raise) or stalls the check
+        (delay) for tests."""
+        try:
+            cap = int(get_config().get("rpc_server_inbox_capacity"))
+        except Exception:  # noqa: BLE001 — config not initialized
+            cap = 0
+        method = req.get("method") if isinstance(req, dict) else None
+        if cap <= 0 or _inbox_exempt(method):
+            with self._inbox_mu:
+                self._inbox += 1
+            return None
+        forced = False
+        try:
+            fail.hit("rpc:server_inbox", key=method)
+        except FailpointError:
+            forced = True
+        with self._inbox_mu:
+            depth = self._inbox
+            if not forced and depth < cap:
+                self._inbox += 1
+                return None
+        retry = self._inbox_drain.retry_after_s(max(depth - cap, 0) + 1)
+        _stats().inc_labeled("overload_server_rejections",
+                             {"op": str(method), "role": self.service_role})
+        return {"ok": False, "error": overload_error(
+            retry, f"{self.service_role}:rpc_inbox",
+            f"server inbox full (inflight={depth}, capacity={cap})")}
+
+    def _serve_pooled(self, sock, wlock, rid, req):
+        try:
+            self._serve_one(sock, wlock, rid, req)
+        finally:
+            with self._inbox_mu:
+                self._inbox = max(self._inbox - 1, 0)
+            method = req.get("method") if isinstance(req, dict) else None
+            if not _inbox_exempt(method):
+                # the retry-after hint prices how fast SHEDDABLE work
+                # drains — exempt traffic (raft, heartbeats) is often
+                # fast and frequent and would inflate the rate,
+                # teaching shed clients to retry far too early
+                self._inbox_drain.note_done()
 
     def _serve_one(self, sock, wlock, rid, req):
         reply = self._dispatch(req)
@@ -1038,6 +1122,33 @@ class RpcClient:
                     return reply.get("result")
                 _stats().inc_labeled("rpc_client_errors", {"op": method})
                 err = reply.get("error", "unknown error")
+                if is_overload(err):
+                    # the peer SHED this request before its handler ran
+                    # (bounded inbox / admission): retrying is safe for
+                    # ANY method, and breaker-neutral — the reply
+                    # itself proves the peer alive (record_success
+                    # already ran above).  Honor the retry-after hint
+                    # inside the deadline-budgeted backoff: the sleep
+                    # is clamped to the statement's remaining budget
+                    # and wakes on KILL QUERY like every other backoff.
+                    last_err = RpcError(err)
+                    if attempt < self.retries:
+                        _stats().inc_labeled("overload_client_retries",
+                                             {"op": method})
+                        _trace.record_phase(
+                            "rpc:retry", 0.0, peer=peer, op=method,
+                            attempt=attempt, error="Overload")
+                        hint = parse_retry_after(err)
+                        # jitter the hint: every client shed in one
+                        # saturation burst sees the same depth and the
+                        # same hint — sleeping it verbatim re-arrives
+                        # the whole herd in one pulse
+                        deadline_sleep(
+                            hint * random.uniform(0.5, 1.5)
+                            if hint is not None
+                            else retry_backoff(attempt))
+                        continue
+                    raise RpcError(err)
                 if isinstance(err, str) and \
                         ("E_QUERY_TIMEOUT" in err or
                          err.startswith("DeadlineExceeded")):

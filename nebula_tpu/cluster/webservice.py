@@ -14,6 +14,9 @@ mount, SURVEY §0]):
     GET /queries         live workload plane (ISSUE 9): in-flight
                          statements with per-operator progress, plus
                          the device dispatch table (queued/running)
+    GET /admission       overload plane (ISSUE 10): admission slots,
+                         queue depth by session, watermark memory,
+                         observed drain rate
     GET /stalls          stall-watchdog captures (`?id=<n>` for one
                          capture's full thread stacks / dispatch table
                          / kernel-ledger tail)
@@ -156,6 +159,13 @@ class WebService:
                         {"queries": live_registry().snapshot(),
                          "dispatches": dispatch_table().snapshot()},
                         default=str), "application/json")
+                elif u.path == "/admission":
+                    # overload plane (ISSUE 10): slots, queue depth,
+                    # per-session backlog, watermark memory, drain rate
+                    from ..utils.admission import admission
+                    self._send(200, json.dumps(admission().snapshot(),
+                                               default=str),
+                               "application/json")
                 elif u.path == "/stalls":
                     from ..utils.workload import stall_watchdog
                     sid = q.get("id")
@@ -219,13 +229,10 @@ class WebService:
                         updates = dict(
                             ln.split("=", 1) for ln in body.splitlines()
                             if ln.strip())
-                    cfg = get_config()
                     # validate ALL keys before applying ANY — a 400 must
-                    # mean nothing changed
-                    parsed = {k.strip(): cfg.check(k.strip(), v)
-                              for k, v in updates.items()}
-                    for k, v in parsed.items():
-                        cfg.set_dynamic(k, v)
+                    # mean nothing changed (the atomic multi-key path)
+                    get_config().set_dynamic_many(
+                        {k.strip(): v for k, v in updates.items()})
                     self._send(200, "ok")
                 except (ConfigError, ValueError) as ex:
                     self._send(400, str(ex))

@@ -298,7 +298,8 @@ def row_dict(ds: DataSet, row: List[Any]) -> Dict[str, Any]:
 class ResultSet:
     """What a statement returns to the client."""
 
-    __slots__ = ("data", "space", "latency_us", "plan_desc", "error", "comment")
+    __slots__ = ("data", "space", "latency_us", "plan_desc", "error",
+                 "comment", "retry_after_ms")
 
     def __init__(self, data: Optional[DataSet] = None, space: Optional[str] = None,
                  latency_us: int = 0, plan_desc: Optional[str] = None,
@@ -309,6 +310,10 @@ class ResultSet:
         self.plan_desc = plan_desc
         self.error = error
         self.comment = comment
+        # structured overload surface (ISSUE 10): set by GraphClient
+        # when an E_OVERLOAD error carries a retry-after hint the
+        # caller may honor (None for every other outcome)
+        self.retry_after_ms: Optional[int] = None
 
     @property
     def ok(self) -> bool:

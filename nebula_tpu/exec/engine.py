@@ -178,6 +178,12 @@ class QueryEngine:
         if s is None:
             return False
         s.killed = True
+        # in-flight AND admission-queued statements of the session die
+        # with it: the kill event is what the scheduler checks between
+        # plan nodes and what the admission wait loop polls (a queued
+        # statement leaves the queue without ever taking a slot)
+        for ev in list(s.running_kill.values()):
+            ev.set()
         return True
 
     def list_running_queries(self) -> list:
@@ -461,10 +467,33 @@ class QueryEngine:
             kind=self._stmt_kind(stmt), deadline=dl,
             tracker=stmt_ectx.tracker)
         stmt_ectx.live = live
+        # admission control (ISSUE 10): a bounded-slot gate in front of
+        # the scheduler — control statements bypass (priority lane),
+        # data statements may wait QUEUED (visible in SHOW QUERIES) or
+        # be shed with E_OVERLOAD + retry-after when the queue is full.
+        # max_running_queries=0 (the default sentinel) makes acquire()
+        # a no-op, byte-identical to the pre-admission engine.
+        from ..utils import admission as _adm
+        ticket = None
         try:
             with _cancel.use_cancel(kill=stmt_ectx.kill_event,
                                     deadline=dl):
+                ticket = _adm.admission().acquire(
+                    qid=qid, session=session.id,
+                    kind=self._stmt_kind(stmt), live=live,
+                    tracker=stmt_ectx.tracker)
+                if ticket is not None and ticket.queue_wait_us:
+                    # pseudo-operator: the admission wait reaches the
+                    # flight recorder next to the real plan nodes
+                    # (node id -1 — PROFILE's plan walk never shows it)
+                    profile_stats.per_node[-1] = {
+                        "kind": "Admission",
+                        "exec_us": ticket.queue_wait_us, "rows": 0}
                 data = self.scheduler.run(plan, stmt_ectx, profile_stats)
+        except _adm.OverloadError as ex:
+            # shed: never took a slot; the flight recorder force-
+            # captures it (classify → "shed") from the E_OVERLOAD error
+            return ResultSet(error=str(ex), space=plan.space)
         except _cancel.DeadlineExceeded:
             from ..utils.stats import stats
             stats().inc("query_deadline_exceeded")
@@ -478,6 +507,8 @@ class QueryEngine:
         except Exception as ex:  # noqa: BLE001 — runtime errors go to client
             return ResultSet(error=f"ExecutionError: {ex}", space=plan.space)
         finally:
+            if ticket is not None:
+                ticket.release()
             session.queries.pop(qid, None)
             session.running_kill.pop(qid, None)
             if live is not None:

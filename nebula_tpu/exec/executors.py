@@ -2102,9 +2102,15 @@ def _update_configs(node, qctx, ectx, space):
     from ..core.expr import DictContext
     from ..utils.config import ConfigError, get_config
     a = node.args
-    value = a["value"].eval(DictContext())
+    updates = {name: vexpr.eval(DictContext())
+               for name, vexpr in a["updates"]}
     try:
-        get_config().set_dynamic(a["name"], value)
+        # atomic multi-key (ISSUE 10 satellite): every key validates
+        # before any applies — UPDATE CONFIGS max_running_queries = 8,
+        # admission_queue_capacity = 128 either fully lands (and the
+        # admission drain listener wakes the waiting queue) or fully
+        # fails; no half-applied overload tuning
+        get_config().set_dynamic_many(updates)
     except ConfigError as ex:
         raise ExecError(str(ex)) from None
     return DataSet()

@@ -495,8 +495,9 @@ class RevokeRoleSentence(Sentence):
 
 @dataclass
 class UpdateConfigsSentence(Sentence):
-    name: str
-    value: Expr
+    # [(name, value_expr), ...] — UPDATE CONFIGS a = 1, b = 2 applies
+    # atomically through Config.set_dynamic_many (all-or-nothing)
+    updates: list
 
 
 @dataclass

@@ -1017,13 +1017,19 @@ class Parser:
         kw = self.expect_kw("UPDATE", "UPSERT").value
         insertable = kw == "UPSERT"
         if not insertable and self.accept_kw("CONFIGS"):
-            # UPDATE CONFIGS [module:]name = value (gflags live mutation)
-            name = self.ident()
-            if self.accept(":"):
-                name = self.ident()     # module prefix ignored (one proc)
-            self.expect("=")
-            value = self.parse_expr()
-            return A.UpdateConfigsSentence(name, value)
+            # UPDATE CONFIGS [module:]name = value [, name = value ...]
+            # (gflags live mutation; multi-key batches apply atomically
+            # — all keys validate or nothing changes)
+            updates = []
+            while True:
+                name = self.ident()
+                if self.accept(":"):
+                    name = self.ident()  # module prefix ignored (one proc)
+                self.expect("=")
+                updates.append((name, self.parse_expr()))
+                if not self.accept(","):
+                    break
+            return A.UpdateConfigsSentence(updates)
         is_edge = self.expect_kw("VERTEX", "EDGE").value == "EDGE"
         self.expect_kw("ON")
         schema = self.ident()

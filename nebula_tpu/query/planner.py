@@ -1757,7 +1757,7 @@ def _register_dispatch():
         A.KillSessionSentence: lambda p, s: _admin(
             "KillSession", session_id=s.session_id),
         A.UpdateConfigsSentence: lambda p, s: _admin(
-            "UpdateConfigs", name=s.name, value=s.value),
+            "UpdateConfigs", updates=s.updates),
         A.GetConfigsSentence: lambda p, s: _admin(
             "GetConfigs", cols=["Module", "Name", "Type", "Mode", "Value"],
             name=s.name),

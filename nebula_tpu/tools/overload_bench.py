@@ -1,0 +1,329 @@
+"""overload-bench — goodput-vs-offered-load curve over a live
+3-replica cluster (ISSUE 10; the overload mirror of chaos_bench.py).
+
+The headline question of admission control: when offered load exceeds
+capacity, does goodput COLLAPSE (every statement times out together)
+or DEGRADE (admitted statements finish near peak rate, the excess is
+shed fast with a structured `E_OVERLOAD` + retry-after, and control
+statements still answer)?
+
+Method: stand up a LocalCluster (1 metad / 3 storaged / 1 graphd),
+calibrate 1× capacity with a closed-loop probe, then sweep offered
+load at 1× / 2× / 4× via concurrency multiplication (each level runs
+`calibration threads × level` closed-loop workers — the standard way
+to push a blocking client past saturation).  Admission is armed for
+the sweep (`max_running_queries`, `admission_queue_capacity`,
+`rpc_server_inbox_capacity`); a control thread issues SHOW QUERIES
+throughout and its latency is reported separately (the priority lane's
+proof).  Per level:
+
+  goodput_qps      statements that returned rows, per second
+  shed             E_OVERLOAD results + admission/inbox shed counters
+  admitted_p99_ms  latency of successful statements
+  control_p99_ms   SHOW QUERIES latency DURING the level's saturation
+  hints_ok         every observed E_OVERLOAD carried retry_after_ms
+
+Usage:
+    python -m nebula_tpu.tools.overload_bench
+    python -m nebula_tpu.tools.overload_bench --persons 4000 --duration 5
+
+Emits one JSON object on stdout; bench.py folds the curve into its
+`overload` block (goodput_4x_vs_1x is the acceptance number: ≥ 0.7).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import shutil
+import tempfile
+import threading
+import time
+from typing import Dict, List, Optional
+
+
+def _percentile(sorted_xs: List[float], p: float) -> float:
+    if not sorted_xs:
+        return 0.0
+    return sorted_xs[min(len(sorted_xs) - 1,
+                         int(len(sorted_xs) * p / 100.0))]
+
+
+def _stat_totals(prefixes) -> Dict[str, float]:
+    from nebula_tpu.utils.stats import stats
+    snap = stats().snapshot()
+    out = {}
+    for pfx in prefixes:
+        out[pfx] = sum(v for k, v in snap.items()
+                       if k.startswith(pfx) and not k.endswith("_us")
+                       and ".sum" not in k and ".count" not in k
+                       and ".bucket" not in k)
+    return out
+
+
+_SHED_COUNTERS = ("admission_shed", "overload_server_rejections")
+
+
+class _LevelResult:
+    def __init__(self):
+        self.lats: List[float] = []
+        self.ok = 0
+        self.shed_results = 0
+        self.errors: List[str] = []
+        self.hints_missing = 0
+        self.lock = threading.Lock()
+
+
+def _worker(cluster, space: str, stmt_of, duration_s: float, wid: int,
+            res: _LevelResult):
+    from nebula_tpu.utils.admission import is_overload, parse_retry_after
+    try:
+        cl = cluster.client()
+        cl.execute(f"USE {space}")
+    except Exception as ex:  # noqa: BLE001 — saturation may refuse conns
+        with res.lock:
+            res.errors.append(f"connect: {ex!r}")
+        return
+    end = time.monotonic() + duration_s
+    j = 0
+    while time.monotonic() < end:
+        t0 = time.perf_counter()
+        try:
+            r = cl.execute(stmt_of(wid, j))
+        except Exception as ex:  # noqa: BLE001
+            with res.lock:
+                res.errors.append(repr(ex))
+            break
+        dt = time.perf_counter() - t0
+        with res.lock:
+            if r.error is None:
+                res.ok += 1
+                res.lats.append(dt)
+            elif is_overload(r.error):
+                res.shed_results += 1
+                if parse_retry_after(r.error) is None:
+                    res.hints_missing += 1
+            else:
+                res.errors.append(r.error)
+        j += 1
+    try:
+        cl.close()
+    except Exception:  # noqa: BLE001
+        pass
+
+
+def _control_probe(cluster, stop: threading.Event, out: Dict):
+    """SHOW QUERIES every 50ms on its own session — the priority lane
+    must answer while the data plane saturates."""
+    lats: List[float] = []
+    errs = 0
+    try:
+        cl = cluster.client()
+    except Exception:  # noqa: BLE001
+        out["control_errors"] = -1
+        return
+    while not stop.wait(0.05):
+        t0 = time.perf_counter()
+        try:
+            r = cl.execute("SHOW LOCAL QUERIES")
+            if r.error is not None:
+                errs += 1
+            else:
+                lats.append(time.perf_counter() - t0)
+        except Exception:  # noqa: BLE001
+            errs += 1
+    try:
+        cl.close()
+    except Exception:  # noqa: BLE001
+        pass
+    lats.sort()
+    out["control_p50_ms"] = round(_percentile(lats, 50) * 1e3, 2)
+    out["control_p99_ms"] = round(_percentile(lats, 99) * 1e3, 2)
+    out["control_probes"] = len(lats)
+    out["control_errors"] = errs
+
+
+def run_sweep(persons: int = 1200, degree: int = 5,
+              cal_threads: int = 6, duration_s: float = 3.0,
+              levels=(1, 2, 4), slots: Optional[int] = None,
+              queue_capacity: Optional[int] = None,
+              inbox_capacity: int = 0,
+              tpu_runtime=None, data_dir: Optional[str] = None) -> dict:
+    import numpy as np
+
+    from nebula_tpu.cluster.launcher import LocalCluster
+    from nebula_tpu.utils.admission import admission
+    from nebula_tpu.utils.config import get_config
+
+    space = "ovld"
+    tmp = data_dir or tempfile.mkdtemp(prefix="nebula_overload_")
+    cluster = LocalCluster(n_meta=1, n_storage=3, n_graph=1,
+                           data_dir=tmp, tpu_runtime=tpu_runtime)
+    cfg = get_config()
+    dyn_keys = ("max_running_queries", "admission_queue_capacity",
+                "rpc_server_inbox_capacity", "query_timeout_secs")
+    try:
+        cl = cluster.client()
+        assert cl.execute(
+            f"CREATE SPACE {space}(partition_num=8, replica_factor=3, "
+            f"vid_type=INT64)").error is None
+        cluster.reconcile_storage()
+        for q in (f"USE {space}", "CREATE TAG Person(age int)",
+                  "CREATE EDGE KNOWS(w int)"):
+            assert cl.execute(q).error is None, q
+        rng = np.random.default_rng(31)
+        B = 400
+        for lo in range(0, persons, B):
+            vals = ", ".join(f"{v}:({v % 90})"
+                             for v in range(lo, min(lo + B, persons)))
+            r = cl.execute(f"INSERT VERTEX Person(age) VALUES {vals}")
+            assert r.error is None, r.error
+        src = rng.integers(0, persons, persons * degree)
+        dst = rng.integers(0, persons, persons * degree)
+        keep = src != dst
+        src, dst = src[keep], dst[keep]
+        for lo in range(0, src.size, B):
+            vals = ", ".join(
+                f"{s}->{d}:({int(s + d) % 100})"
+                for s, d in zip(src[lo:lo + B].tolist(),
+                                dst[lo:lo + B].tolist()))
+            r = cl.execute(f"INSERT EDGE KNOWS(w) VALUES {vals}")
+            assert r.error is None, r.error
+
+        def stmt_of(wid: int, j: int) -> str:
+            seed = (wid * 131 + j * 17) % persons
+            return f"GO FROM {seed} OVER KNOWS YIELD dst(edge) AS d"
+
+        # warm the plan cache / device plane before calibrating
+        warm = cluster.client()
+        warm.execute(f"USE {space}")
+        warm.execute(stmt_of(0, 0))
+        warm.close()
+
+        # ---- calibrate 1× capacity: closed loop, admission OFF ------
+        cal = _LevelResult()
+        ths = [threading.Thread(target=_worker,
+                                args=(cluster, space, stmt_of,
+                                      duration_s, i, cal))
+               for i in range(cal_threads)]
+        t0 = time.perf_counter()
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join()
+        cal_wall = time.perf_counter() - t0
+        qps_1x = cal.ok / cal_wall if cal_wall > 0 else 0.0
+        assert not cal.errors, cal.errors[:3]
+
+        # ---- arm the overload plane for the sweep -------------------
+        n_slots = slots if slots is not None else max(cal_threads, 2)
+        n_cap = queue_capacity if queue_capacity is not None \
+            else 2 * n_slots
+        cfg.set_dynamic_many({
+            "max_running_queries": n_slots,
+            "admission_queue_capacity": n_cap,
+            "rpc_server_inbox_capacity": inbox_capacity,
+            # bounded budgets keep a saturated level from running away:
+            # queued statements are deadline-evicted, client overload
+            # retries stay inside this budget
+            "query_timeout_secs": max(duration_s * 2, 5.0),
+        })
+
+        out_levels: Dict[str, dict] = {}
+        for level in levels:
+            res = _LevelResult()
+            shed0 = _stat_totals(_SHED_COUNTERS)
+            stop = threading.Event()
+            ctl: Dict = {}
+            ctl_t = threading.Thread(target=_control_probe,
+                                     args=(cluster, stop, ctl))
+            ctl_t.start()
+            n_workers = cal_threads * level
+            ths = [threading.Thread(target=_worker,
+                                    args=(cluster, space, stmt_of,
+                                          duration_s, i, res))
+                   for i in range(n_workers)]
+            t0 = time.perf_counter()
+            for t in ths:
+                t.start()
+            for t in ths:
+                t.join()
+            wall = time.perf_counter() - t0
+            stop.set()
+            ctl_t.join()
+            shed1 = _stat_totals(_SHED_COUNTERS)
+            res.lats.sort()
+            attempts = res.ok + res.shed_results + len(res.errors)
+            row = {
+                "workers": n_workers,
+                "wall_s": round(wall, 2),
+                "attempted_qps": round(attempts / wall, 1) if wall else 0,
+                "goodput_qps": round(res.ok / wall, 1) if wall else 0,
+                "ok": res.ok,
+                "shed_results": res.shed_results,
+                "shed_counters": {
+                    k: int(shed1[k] - shed0[k]) for k in shed1},
+                "other_errors": len(res.errors),
+                "error_sample": res.errors[:3],
+                "admitted_p50_ms": round(
+                    _percentile(res.lats, 50) * 1e3, 2),
+                "admitted_p99_ms": round(
+                    _percentile(res.lats, 99) * 1e3, 2),
+                # the E_OVERLOAD contract: every shed carries a hint
+                "hints_ok": res.hints_missing == 0,
+            }
+            row.update(ctl)
+            out_levels[f"{level}x"] = row
+
+        g1 = out_levels[f"{levels[0]}x"]["goodput_qps"]
+        g4 = out_levels[f"{levels[-1]}x"]["goodput_qps"]
+        return {
+            "persons": persons,
+            "degree": degree,
+            "replica_factor": 3,
+            "statement": "1-hop GO (small-query admission shape)",
+            "calibration": {"threads": cal_threads,
+                            "qps": round(qps_1x, 1),
+                            "p50_ms": round(
+                                _percentile(sorted(cal.lats), 50) * 1e3,
+                                2)},
+            "slots": n_slots,
+            "queue_capacity": n_cap,
+            "inbox_capacity": inbox_capacity,
+            "duration_per_level_s": duration_s,
+            "levels": out_levels,
+            # the acceptance number: offered 4×, goodput vs the 1× level
+            "goodput_4x_vs_1x": round(g4 / g1, 3) if g1 else None,
+        }
+    finally:
+        with cfg.lock:
+            for k in dyn_keys:
+                cfg.dynamic_layer.pop(k, None)
+        admission().reset()
+        cluster.stop()
+        if data_dir is None:
+            shutil.rmtree(tmp, ignore_errors=True)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--persons", type=int, default=1200)
+    ap.add_argument("--degree", type=int, default=5)
+    ap.add_argument("--threads", type=int, default=6,
+                    help="calibration (1×) closed-loop threads")
+    ap.add_argument("--duration", type=float, default=3.0,
+                    help="seconds per load level")
+    ap.add_argument("--slots", type=int, default=None,
+                    help="max_running_queries for the sweep")
+    ap.add_argument("--queue-capacity", type=int, default=None)
+    ap.add_argument("--inbox-capacity", type=int, default=0)
+    args = ap.parse_args(argv)
+    print(json.dumps(run_sweep(
+        persons=args.persons, degree=args.degree,
+        cal_threads=args.threads, duration_s=args.duration,
+        slots=args.slots, queue_capacity=args.queue_capacity,
+        inbox_capacity=args.inbox_capacity), indent=1))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

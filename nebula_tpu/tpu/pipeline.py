@@ -51,6 +51,8 @@ from ..graphstore.csr import INT_NULL
 from ..graphstore.schema import PropType
 from ..query import optimizer as opt
 from ..query.plan import PlanNode, walk_plan
+from ..utils import admission as _admission  # noqa: F401 — defines the
+# overload flags (tpu_dispatch_queue_cap) before any config lookup
 from ..utils import cancel as _cancel
 from ..utils import trace
 from ..utils.failpoints import FailpointError, fail
@@ -1201,11 +1203,33 @@ def _run_subplan(root: PlanNode, qctx, ectx, space):
     return ds
 
 
+def _dispatch_overloaded() -> bool:
+    """Device dispatch-queue depth cap (ISSUE 10): beyond
+    `tpu_dispatch_queue_cap` queued dispatches, fused pipelines degrade
+    to their stashed host subplan instead of piling onto the device —
+    never wrong, only slower.  0 (the default) disables the cap."""
+    try:
+        cap = int(get_config().get("tpu_dispatch_queue_cap"))
+    except Exception:  # noqa: BLE001 — config not initialized
+        return False
+    if cap <= 0:
+        return False
+    from ..utils.workload import dispatch_table
+    if dispatch_table().queued_depth() < cap:
+        return False
+    stats().inc("tpu_dispatch_queue_shed")
+    return True
+
+
 @executor("TpuMatchPipeline")
 def _tpu_match_pipeline(node, qctx, ectx, space):
     a = node.args
     rt = getattr(qctx, "tpu_runtime", None)
     reason = "no-runtime"
+    if rt is not None and get_config().get("tpu_match_device") \
+            and _dispatch_overloaded():
+        reason = "overload"
+        rt = None       # fall through to the stashed host subplan
     if rt is not None and get_config().get("tpu_match_device"):
         try:
             with trace.span("tpu:match_pipeline",

@@ -1,0 +1,534 @@
+"""Admission control + overload survival (ISSUE 10 tentpole).
+
+Nothing used to stand between a thundering herd and the engine: every
+statement grabbed a scheduler slot, charged memory and dispatched to
+the device unconditionally, so saturation meant collapse (unbounded
+queues, OOM kills, deadline blowouts) instead of bounded degradation.
+This module is the graphd-side half of the overload plane:
+
+  * `AdmissionController` — a bounded set of concurrency slots
+    (`max_running_queries`; 0 = the disabled sentinel, byte-identical
+    to the pre-admission engine) in front of `scheduler.run`, with a
+    capped wait queue drained by DEFICIT-WEIGHTED round-robin across
+    sessions (`admission_session_weights`) so no session can starve
+    another, and a memory watermark
+    (`admission_memory_watermark_bytes`) gating new admissions against
+    the process-wide total of per-statement MemoryTracker charges.
+
+  * a PRIORITY LANE: control-plane statements (KILL QUERY/SESSION,
+    SHOW *, UPDATE CONFIGS, admin introspection) bypass the queue
+    entirely — the cluster stays operable at saturation, which is the
+    whole point of shedding load instead of timing out uniformly.
+
+  * structured SHEDDING: a full queue fails the statement immediately
+    with `E_OVERLOAD` carrying a `retry_after_ms` hint derived from
+    the observed drain rate (`DrainEstimator`), instead of letting it
+    queue toward a guaranteed deadline blowout.  Deadline-aware queue
+    EVICTION: a statement whose PR5 budget expires while queued is
+    failed with E_QUERY_TIMEOUT without ever taking a slot, and a
+    KILL QUERY / KILL SESSION of a queued statement removes it from
+    the queue immediately (slot never consumed).
+
+The cluster-wide halves live elsewhere and share this module's
+`overload_error` / `parse_retry_after` contract: the RPC server's
+bounded inbox (`rpc_server_inbox_capacity`, cluster/rpc.py) rejects
+overflow with E_OVERLOAD + retry-after instead of queuing unboundedly,
+the RPC client honors the hint inside the PR5 deadline-budgeted
+backoff (overload is breaker-neutral — the reply proves the peer
+alive), and the device dispatch gate caps queue depth
+(`tpu_dispatch_queue_cap`, tpu/pipeline.py) beyond which fused
+pipelines degrade to their stashed host subplan — never wrong, only
+slower.  Semantics matrix: docs/ROBUSTNESS.md §7.
+"""
+from __future__ import annotations
+
+import re
+import threading
+import time
+from collections import OrderedDict, deque
+from typing import Dict, Optional
+
+from . import cancel as _cancel
+from .config import define_flag, get_config
+
+define_flag("max_running_queries", 0,
+            "admission-control concurrency slots per process; 0 is the "
+            "DISABLED sentinel (no queueing, no shedding — byte-"
+            "identical to the pre-admission engine, keeping the wire/"
+            "work-counter regression probes deterministic)")
+define_flag("admission_queue_capacity", 64,
+            "statements allowed to WAIT for a slot before new arrivals "
+            "are shed with E_OVERLOAD + retry-after")
+define_flag("admission_memory_watermark_bytes", 0,
+            "process-wide memory watermark: while the summed "
+            "MemoryTracker charge of RUNNING statements is at or above "
+            "this, new admissions wait in the queue (0 disables; one "
+            "statement is always admitted when nothing runs, so the "
+            "gate can never deadlock the drain)")
+define_flag("admission_session_weights", "",
+            "per-session DWRR weights as `sid:weight[,sid:weight...]` "
+            "(unlisted sessions weigh 1); runtime-updatable via "
+            "UPDATE CONFIGS so an operator can deprioritize a noisy "
+            "tenant without a restart")
+define_flag("rpc_server_inbox_capacity", 0,
+            "bounded RPC-server dispatch inbox: pipelined requests "
+            "beyond this many in flight per server are rejected with "
+            "E_OVERLOAD + retry-after instead of queuing unboundedly "
+            "(0 = unbounded, today's behavior); raft, meta.* and graph "
+            "control methods are exempt so cluster health never sheds")
+define_flag("tpu_dispatch_queue_cap", 0,
+            "device dispatch-queue depth beyond which fused MATCH "
+            "pipelines degrade to their stashed host subplan instead "
+            "of piling onto the device (0 = off); never wrong, only "
+            "slower")
+
+#: wire prefix of every shed/overload error — the one string clients,
+#: the RPC client retry loop and the flight recorder key off
+OVERLOAD_PREFIX = "E_OVERLOAD"
+
+_RETRY_AFTER_RE = re.compile(r"retry_after_ms=(\d+)")
+
+
+def overload_error(retry_after_s: float, where: str, detail: str) -> str:
+    """The one E_OVERLOAD wire shape: prefix, human detail, shedding
+    site, machine-parseable retry-after hint (milliseconds)."""
+    ms = max(int(retry_after_s * 1000), 1)
+    return (f"{OVERLOAD_PREFIX}: {detail} [{where}]; "
+            f"retry_after_ms={ms}")
+
+
+def is_overload(err: Optional[str]) -> bool:
+    return isinstance(err, str) and err.startswith(OVERLOAD_PREFIX)
+
+
+def parse_retry_after(err: Optional[str]) -> Optional[float]:
+    """retry-after hint in SECONDS from an E_OVERLOAD error string, or
+    None when absent/malformed (callers fall back to their backoff)."""
+    if not isinstance(err, str):
+        return None
+    m = _RETRY_AFTER_RE.search(err)
+    if m is None:
+        return None
+    return int(m.group(1)) / 1000.0
+
+
+class OverloadError(Exception):
+    """Shed at admission: the statement never took a slot.  str() is
+    the full E_OVERLOAD wire error (retry_after_ms included)."""
+
+    def __init__(self, retry_after_s: float, where: str, detail: str):
+        super().__init__(overload_error(retry_after_s, where, detail))
+        self.retry_after_s = retry_after_s
+        self.where = where
+
+
+class DrainEstimator:
+    """Observed drain rate → retry-after hints.
+
+    A sliding window of completion timestamps prices how long a queue
+    of depth N will take to drain; the hint is that estimate clamped to
+    [50ms, 5s] so a cold estimator can neither hammer (0) nor park a
+    client forever.  With no completions observed yet the hint is a
+    flat 500ms — the "come back soon, we just started" default."""
+
+    __slots__ = ("_done", "_mu")
+
+    def __init__(self):
+        self._done: "deque[float]" = deque(maxlen=64)
+        self._mu = threading.Lock()
+
+    def note_done(self):
+        with self._mu:
+            self._done.append(time.monotonic())
+
+    def rate(self) -> float:
+        """Completions per second over the window (0 when unknown)."""
+        with self._mu:
+            if len(self._done) < 2:
+                return 0.0
+            span = self._done[-1] - self._done[0]
+            n = len(self._done)
+        if span <= 0:
+            return 0.0
+        return (n - 1) / span
+
+    def retry_after_s(self, depth: int) -> float:
+        r = self.rate()
+        if r <= 0:
+            return 0.5
+        return min(max(max(depth, 1) / r, 0.05), 5.0)
+
+
+# -- control-plane lane ------------------------------------------------------
+
+#: statement kinds that bypass the admission queue: the operator's way
+#: back into a saturated cluster.  SHOW/KILL/DESCRIBE are pure
+#: introspection or cancellation; USE/UPDATE CONFIGS/GET CONFIGS are
+#: the levers that relieve the overload (a capacity bump must not
+#: itself queue behind the traffic it exists to drain).
+_CONTROL_PREFIXES = ("Show", "Kill", "Desc")
+_CONTROL_KINDS = frozenset({
+    "Use", "UpdateConfigs", "GetConfigs", "StopJob"})
+
+
+def is_control_stmt(kind: str) -> bool:
+    return kind.startswith(_CONTROL_PREFIXES) or kind in _CONTROL_KINDS
+
+
+# -- the controller ----------------------------------------------------------
+
+
+class _Waiter:
+    __slots__ = ("qid", "session", "kind", "event", "admitted",
+                 "cancelled", "t_enq", "tracker", "live")
+
+    def __init__(self, qid: int, session: int, kind: str, live, tracker):
+        self.qid = qid
+        self.session = session
+        self.kind = kind
+        self.event = threading.Event()
+        self.admitted = False
+        self.cancelled = False
+        self.t_enq = time.monotonic()
+        self.tracker = tracker
+        self.live = live
+
+
+class Ticket:
+    """What acquire() hands back; release() exactly once (engine's
+    finally).  mode: 'admitted' holds a slot, 'bypass' (control lane)
+    and 'off' (admission disabled) hold nothing."""
+
+    __slots__ = ("_ctl", "mode", "qid", "queue_wait_us", "_released")
+
+    def __init__(self, ctl: "AdmissionController", mode: str, qid: int,
+                 queue_wait_us: int = 0):
+        self._ctl = ctl
+        self.mode = mode
+        self.qid = qid
+        self.queue_wait_us = queue_wait_us
+        self._released = False
+
+    def release(self):
+        if self._released:
+            return
+        self._released = True
+        if self.mode == "admitted":
+            self._ctl._release_slot(self.qid)
+
+
+class AdmissionController:
+    """Process-wide admission queue in front of every engine's
+    scheduler (graphd and standalone share it, like the live workload
+    registry — the slots bound the PROCESS, which is what the memory
+    watermark and the device plane care about)."""
+
+    #: waiter poll slice: the KILL/deadline/watermark re-check cadence
+    #: while queued.  20ms keeps "KILL QUERY removes it immediately"
+    #: honest without measurable idle cost.
+    POLL_S = 0.02
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._running: Dict[int, _Waiter] = {}      # qid → admitted
+        self._queues: "OrderedDict[int, deque]" = OrderedDict()
+        self._rr: "deque[int]" = deque()            # session rotation
+        self._deficit: Dict[int, float] = {}
+        self._queued_n = 0
+        self._drain_est = DrainEstimator()
+        self._weights_raw = ""
+        self._weights: Dict[int, int] = {}
+        self._listener_installed = False
+
+    # -- flags ------------------------------------------------------------
+
+    @staticmethod
+    def _flag_int(name: str, dflt: int) -> int:
+        try:
+            return int(get_config().get(name))
+        except Exception:  # noqa: BLE001 — config not initialized
+            return dflt
+
+    def slots(self) -> int:
+        return self._flag_int("max_running_queries", 0)
+
+    def enabled(self) -> bool:
+        return self.slots() > 0
+
+    def capacity(self) -> int:
+        return self._flag_int("admission_queue_capacity", 64)
+
+    def watermark(self) -> int:
+        return self._flag_int("admission_memory_watermark_bytes", 0)
+
+    def _weight(self, sid: int) -> int:
+        try:
+            raw = str(get_config().get("admission_session_weights"))
+        except Exception:  # noqa: BLE001
+            raw = ""
+        if raw != self._weights_raw:
+            # parse once per distinct flag value; garbage entries are
+            # dropped (a half-typed UPDATE CONFIGS must not zero the
+            # whole map)
+            parsed: Dict[int, int] = {}
+            for part in raw.split(","):
+                part = part.strip()
+                if not part or ":" not in part:
+                    continue
+                k, _, v = part.partition(":")
+                try:
+                    parsed[int(k)] = max(int(v), 1)
+                except ValueError:
+                    continue
+            self._weights_raw, self._weights = raw, parsed
+        return self._weights.get(sid, 1)
+
+    def _ensure_listener(self):
+        """A capacity/watermark/weight bump via UPDATE CONFIGS or
+        PUT /flags must drain a waiting queue WITHOUT a restart — the
+        config layer's listener hook is exactly that kick."""
+        if self._listener_installed:
+            return
+        self._listener_installed = True
+
+        def on_flag(name, _value):
+            if name in ("max_running_queries", "admission_queue_capacity",
+                        "admission_memory_watermark_bytes",
+                        "admission_session_weights"):
+                self.kick()
+        get_config().listeners.append(on_flag)
+
+    # -- memory gate ------------------------------------------------------
+
+    def _mem_total_locked(self) -> int:
+        return sum(int(getattr(w.tracker, "used", 0) or 0)
+                   for w in self._running.values())
+
+    def _mem_ok_locked(self, wm: int) -> bool:
+        if wm <= 0:
+            return True
+        if not self._running:
+            return True      # always admit one: the gate must not wedge
+        return self._mem_total_locked() < wm
+
+    # -- metrics ----------------------------------------------------------
+
+    def _gauges_locked(self):
+        from .stats import stats
+        stats().gauge("admission_running", float(len(self._running)))
+        stats().gauge("admission_queue_depth", float(self._queued_n))
+
+    # -- acquire / release ------------------------------------------------
+
+    def acquire(self, qid: int, session: int, kind: str, live=None,
+                tracker=None) -> Optional[Ticket]:
+        """Block until the statement may run.  Returns a Ticket (or
+        None when admission is disabled — the zero-cost sentinel path).
+        Raises OverloadError (shed, queue full), DeadlineExceeded
+        (budget expired while queued — no slot consumed) or
+        QueryKilled (killed while queued)."""
+        slots = self.slots()
+        if slots <= 0:
+            return None
+        self._ensure_listener()
+        from .stats import stats
+        if is_control_stmt(kind):
+            # priority lane: the cluster stays operable at saturation
+            stats().inc_labeled("admission_bypass", {"kind": kind})
+            return Ticket(self, "bypass", qid)
+        w = _Waiter(qid, session, kind, live, tracker)
+        with self._mu:
+            if self._queued_n == 0 and len(self._running) < slots \
+                    and self._mem_ok_locked(self.watermark()):
+                # fast path: empty queue, free slot, memory headroom
+                self._running[qid] = w
+                w.admitted = True
+                self._gauges_locked()
+                return Ticket(self, "admitted", qid)
+            if self._queued_n >= max(self.capacity(), 0):
+                depth = self._queued_n
+                retry = self._drain_est.retry_after_s(depth)
+                stats().inc("admission_shed")
+                raise OverloadError(
+                    retry, "graphd:admission",
+                    f"admission queue full (depth={depth}, "
+                    f"capacity={self.capacity()}, "
+                    f"running={len(self._running)})")
+        # enqueue (outside the lock: the failpoint may sleep or raise —
+        # `admission:enqueue` armed with delay() holds a statement at
+        # the enqueue boundary, raise() rejects it)
+        from .failpoints import fail
+        fail.hit("admission:enqueue", key=kind)
+        with self._mu:
+            if self._queued_n >= max(self.capacity(), 0):
+                # re-check after the unlocked failpoint window: the
+                # capacity bound stays honest under concurrent arrivals
+                depth = self._queued_n
+                retry = self._drain_est.retry_after_s(depth)
+                stats().inc("admission_shed")
+                raise OverloadError(
+                    retry, "graphd:admission",
+                    f"admission queue full (depth={depth}, "
+                    f"capacity={self.capacity()}, "
+                    f"running={len(self._running)})")
+            q = self._queues.get(session)
+            if q is None:
+                q = self._queues[session] = deque()
+                self._rr.append(session)
+            q.append(w)
+            self._queued_n += 1
+            if live is not None:
+                live.queued = True
+            stats().inc("admission_enqueued")
+            self._gauges_locked()
+        # the enqueue raced a release: a drain may already owe us a slot
+        self._drain()
+        return self._wait(w)
+
+    def _wait(self, w: _Waiter) -> Ticket:
+        from .stats import stats
+        while True:
+            if w.event.wait(self.POLL_S):
+                break
+            kill = _cancel.current_kill()
+            if kill is not None and kill.is_set():
+                if self._evict(w):
+                    stats().inc("admission_kill_evictions")
+                    raise _cancel.QueryKilled(
+                        "query was killed while queued for admission")
+                break      # admitted in the race — scheduler kills it
+            rem = _cancel.remaining()
+            if rem is not None and rem <= 0:
+                if self._evict(w):
+                    # the ISSUE's contract: budget spent while QUEUED →
+                    # E_QUERY_TIMEOUT without ever consuming a slot
+                    stats().inc("admission_deadline_evictions")
+                    raise _cancel.DeadlineExceeded(
+                        "deadline exhausted while queued for admission")
+                break
+            # watermark may have dropped / flags may have changed with
+            # no release to kick the drain — re-check on the poll beat
+            self._drain()
+        waited_us = int((time.monotonic() - w.t_enq) * 1e6)
+        if w.live is not None:
+            w.live.queued = False
+            w.live.add("queue_us", waited_us)
+        stats().observe("admission_queue_wait_us", waited_us)
+        return Ticket(self, "admitted", w.qid, queue_wait_us=waited_us)
+
+    def _evict(self, w: _Waiter) -> bool:
+        """Remove a queued waiter (kill/deadline).  False when the
+        waiter won admission in the race — the caller then proceeds
+        with the slot and lets the scheduler's own cancel check fire."""
+        with self._mu:
+            if w.admitted:
+                return False
+            w.cancelled = True
+            q = self._queues.get(w.session)
+            if q is not None:
+                try:
+                    q.remove(w)
+                except ValueError:
+                    pass
+            self._queued_n = max(self._queued_n - 1, 0)
+            self._gauges_locked()
+            return True
+
+    def _release_slot(self, qid: int):
+        with self._mu:
+            if self._running.pop(qid, None) is None:
+                return
+            self._gauges_locked()
+        self._drain_est.note_done()
+        self._drain()
+
+    def kick(self):
+        """Re-drain on external state changes (config listener)."""
+        self._drain()
+
+    # -- the DWRR drain ---------------------------------------------------
+
+    def _drr_next_locked(self) -> Optional[_Waiter]:
+        """Next waiter by deficit-weighted round-robin.  Each visit of
+        the rotation pointer credits the session its weight; one
+        admission costs one credit — over time each backlogged session
+        is admitted in proportion to its weight, and an emptied
+        session's deficit dies with its queue (no banked bursts)."""
+        guard = 2 * len(self._rr) + 2
+        for _ in range(guard):
+            if not self._rr:
+                return None
+            sid = self._rr[0]
+            q = self._queues.get(sid)
+            if not q:
+                self._rr.popleft()
+                self._queues.pop(sid, None)
+                self._deficit.pop(sid, None)
+                continue
+            if self._deficit.get(sid, 0.0) >= 1.0:
+                self._deficit[sid] -= 1.0
+                w = q.popleft()
+                self._queued_n = max(self._queued_n - 1, 0)
+                return w
+            self._deficit[sid] = self._deficit.get(sid, 0.0) \
+                + self._weight(sid)
+            self._rr.rotate(-1)
+        return None
+
+    def _drain(self):
+        admitted = []
+        with self._mu:
+            slots = self.slots()
+            wm = self.watermark()
+            while self._queued_n > 0:
+                if slots > 0 and len(self._running) >= slots:
+                    break
+                if slots > 0 and not self._mem_ok_locked(wm):
+                    break
+                w = self._drr_next_locked()
+                if w is None:
+                    break
+                # slots<=0 → admission was disabled live: everyone goes
+                self._running[w.qid] = w
+                w.admitted = True
+                admitted.append(w)
+            if admitted:
+                self._gauges_locked()
+        for w in admitted:
+            w.event.set()
+
+    # -- introspection ----------------------------------------------------
+
+    def snapshot(self) -> Dict[str, object]:
+        with self._mu:
+            return {
+                "slots": self.slots(),
+                "running": len(self._running),
+                "queued": self._queued_n,
+                "queued_by_session": {sid: len(q) for sid, q
+                                      in self._queues.items() if q},
+                "memory_bytes": self._mem_total_locked(),
+                "drain_rate_per_s": round(self._drain_est.rate(), 3),
+            }
+
+    def reset(self):
+        """Test isolation: wake every waiter and drop all state."""
+        with self._mu:
+            waiters = [w for q in self._queues.values() for w in q]
+            self._queues.clear()
+            self._rr.clear()
+            self._deficit.clear()
+            self._queued_n = 0
+            self._running.clear()
+        for w in waiters:
+            w.admitted = True
+            w.event.set()
+
+
+_controller = AdmissionController()
+
+
+def admission() -> AdmissionController:
+    """The process-wide controller (engines acquire around
+    scheduler.run; GET /admission and the bench read snapshot())."""
+    return _controller

@@ -119,12 +119,23 @@ class Config:
         return value
 
     def set_dynamic(self, name: str, value: Any):
-        value = self.check(name, value)
+        self.set_dynamic_many({name: value})
+
+    def set_dynamic_many(self, updates: Dict[str, Any]):
+        """Atomic multi-key dynamic update: EVERY key is validated and
+        coerced before ANY is applied — a rejected update means nothing
+        changed (the PUT /flags and UPDATE CONFIGS contract; one bad
+        flag in a batch must not half-apply an overload-survival
+        tuning).  Listeners fire once per key, after the whole batch
+        is visible, so a listener reading a sibling key (the admission
+        drain kick) sees the NEW values."""
+        parsed = {k: self.check(k, v) for k, v in updates.items()}
         with self.lock:
-            self.dynamic_layer[name] = value
+            self.dynamic_layer.update(parsed)
             listeners = list(self.listeners)
         for fn in listeners:
-            fn(name, value)
+            for k, v in parsed.items():
+                fn(k, v)
 
     def all_values(self) -> Dict[str, Any]:
         return {n: self.get(n) for n in sorted(self.defs)}

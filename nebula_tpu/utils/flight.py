@@ -96,6 +96,8 @@ class FlightRecorder:
                 return "killed"           # engine.py emits exactly this
             if error.startswith("E_QUERY_TIMEOUT"):
                 return "timeout"
+            if error.startswith("E_OVERLOAD"):
+                return "shed"             # admission/inbox load shedding
             if "FailpointError:" in error:
                 return "failpoint"        # exception-class token
             return "error"

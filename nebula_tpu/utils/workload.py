@@ -76,7 +76,7 @@ class LiveQuery:
     __slots__ = ("qid", "session", "user", "stmt", "kind", "t0", "m0",
                  "deadline", "node_kind", "node_id", "nodes_done",
                  "rows", "queue_us", "device_us", "dispatches",
-                 "tracker", "killed", "_lock")
+                 "tracker", "killed", "queued", "_lock")
 
     def __init__(self, qid: int, session: int, user: str, stmt: str,
                  kind: str, deadline: Optional[float] = None,
@@ -98,6 +98,7 @@ class LiveQuery:
         self.dispatches = 0
         self.tracker = tracker            # MemoryTracker (bytes charged)
         self.killed = False
+        self.queued = False               # waiting in the admission queue
         self._lock = threading.Lock()
 
     # -- scheduler hooks (one per plan node) -----------------------------
@@ -130,7 +131,8 @@ class LiveQuery:
         return {
             "qid": self.qid, "session": self.session, "user": self.user,
             "stmt": self.stmt[:500], "kind": self.kind,
-            "status": "KILLED" if self.killed else "RUNNING",
+            "status": ("KILLED" if self.killed
+                       else "QUEUED" if self.queued else "RUNNING"),
             "start_ts": self.t0,
             "duration_us": elapsed_us,
             "operator": (f"{self.node_kind}#{self.node_id}"
@@ -301,6 +303,13 @@ class DispatchTable:
         with self._lock:
             toks = list(self._inflight.values())
         return [t.snapshot() for t in sorted(toks, key=lambda x: x.seq)]
+
+    def queued_depth(self) -> int:
+        """Dispatches waiting on the gate right now — the overload
+        signal `tpu_dispatch_queue_cap` (utils/admission.py) judges."""
+        with self._lock:
+            return sum(1 for t in self._inflight.values()
+                       if t.t_run is None)
 
     def __len__(self):
         with self._lock:

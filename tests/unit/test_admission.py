@@ -1,0 +1,641 @@
+"""Admission control + overload survival (ISSUE 10): bounded slots,
+DWRR fairness, deadline/kill eviction of queued statements, structured
+E_OVERLOAD shedding with retry-after, the bounded RPC-server inbox,
+client-side overload retry inside the deadline budget, the dispatch-
+queue cap, and runtime-updatable admission flags (atomic multi-key
+UPDATE CONFIGS draining a waiting queue without restart)."""
+import threading
+import time
+
+import pytest
+
+from nebula_tpu.cluster.rpc import (RpcClient, RpcError, RpcServer,
+                                    reset_breakers)
+from nebula_tpu.exec.engine import QueryEngine
+from nebula_tpu.utils import cancel as _cancel
+from nebula_tpu.utils.admission import (admission, is_overload,
+                                        overload_error,
+                                        parse_retry_after)
+from nebula_tpu.utils.config import get_config
+from nebula_tpu.utils.failpoints import fail
+from nebula_tpu.utils.flight import flight_recorder
+from nebula_tpu.utils.stats import stats
+
+_ADMISSION_FLAGS = (
+    "max_running_queries", "admission_queue_capacity",
+    "admission_memory_watermark_bytes", "admission_session_weights",
+    "rpc_server_inbox_capacity", "tpu_dispatch_queue_cap",
+    "query_timeout_secs",
+)
+
+
+@pytest.fixture()
+def clean():
+    fail.reset()
+    reset_breakers()
+    admission().reset()
+    yield
+    fail.reset()
+    reset_breakers()
+    admission().reset()
+    for k in _ADMISSION_FLAGS:
+        get_config().dynamic_layer.pop(k, None)
+
+
+def _delay_nodes(kind, secs):
+    """Delay only plan nodes of `kind` (YIELD plans carry Project;
+    SHOW / KILL / UPDATE CONFIGS statements don't), so control
+    statements run undelayed."""
+    fail.arm_callable(
+        "exec:node",
+        lambda i, key: ("delay", secs) if key == kind else None)
+
+
+def _run_async(eng, sess, stmt):
+    box = {}
+
+    def run():
+        box["rs"] = eng.execute(sess, stmt)
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    return t, box
+
+
+def _wait_for(pred, timeout=5.0, msg="condition"):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        got = pred()
+        if got:
+            return got
+        time.sleep(0.01)
+    pytest.fail(f"timed out waiting for {msg}")
+
+
+def _counter(name) -> float:
+    return stats().snapshot().get(name, 0)
+
+
+# -- disabled sentinel ------------------------------------------------------
+
+
+def test_disabled_sentinel_is_noop(clean):
+    """max_running_queries=0 (the default): no ticket is taken, nothing
+    queues, nothing sheds — today's behavior."""
+    eng = QueryEngine()
+    s = eng.new_session()
+    before = _counter("admission_enqueued"), _counter("admission_shed")
+    for _ in range(5):
+        assert eng.execute(s, "YIELD 1 AS x").ok
+    snap = admission().snapshot()
+    assert snap["running"] == 0 and snap["queued"] == 0
+    assert (_counter("admission_enqueued"),
+            _counter("admission_shed")) == before
+
+
+# -- queueing + shedding (engine level) -------------------------------------
+
+
+def test_queueing_drains_in_bounded_slots(clean):
+    cfg = get_config()
+    cfg.set_dynamic("max_running_queries", 1)
+    cfg.set_dynamic("admission_queue_capacity", 10)
+    eng = QueryEngine()
+    enq0 = _counter("admission_enqueued")
+    _delay_nodes("Project", 0.15)
+    runs = [_run_async(eng, eng.new_session(), f"YIELD {i} AS x")
+            for i in range(3)]
+    for t, box in runs:
+        t.join(10)
+        assert box["rs"].error is None, box["rs"].error
+    assert _counter("admission_enqueued") - enq0 >= 2
+
+
+def test_shed_is_structured_and_flight_captured(clean):
+    """Queue capacity 0: the second statement sheds immediately with a
+    parseable retry-after, a forced flight-recorder entry (status
+    `shed`), and the control lane (SHOW QUERIES) still answers."""
+    cfg = get_config()
+    cfg.set_dynamic("max_running_queries", 1)
+    cfg.set_dynamic("admission_queue_capacity", 0)
+    eng = QueryEngine()
+    s1, s2 = eng.new_session(), eng.new_session()
+    _delay_nodes("Project", 0.4)
+    t, box = _run_async(eng, s1, "YIELD 1 AS x")
+    _wait_for(lambda: admission().snapshot()["running"] == 1,
+              msg="slot holder running")
+    shed0 = _counter("admission_shed")
+    rs = eng.execute(s2, "YIELD 2 AS x")
+    assert rs.error is not None and is_overload(rs.error), rs.error
+    assert parse_retry_after(rs.error) is not None, rs.error
+    assert _counter("admission_shed") - shed0 == 1
+    # forced flight capture under status `shed`
+    ent = next(e for e in flight_recorder().list(limit=10)
+               if e["stmt"] == "YIELD 2 AS x")
+    assert ent["status"] == "shed"
+    # control lane: SHOW QUERIES bypasses the full queue
+    rs = eng.execute(s2, "SHOW QUERIES")
+    assert rs.error is None, rs.error
+    t.join(10)
+    assert box["rs"].error is None
+
+
+def test_queued_statement_visible_in_show_queries(clean):
+    cfg = get_config()
+    cfg.set_dynamic("max_running_queries", 1)
+    cfg.set_dynamic("admission_queue_capacity", 5)
+    eng = QueryEngine()
+    s1, s2 = eng.new_session(), eng.new_session()
+    _delay_nodes("Project", 0.5)
+    t1, b1 = _run_async(eng, s1, "YIELD 1 AS x")
+    _wait_for(lambda: admission().snapshot()["running"] == 1,
+              msg="holder running")
+    t2, b2 = _run_async(eng, s2, "YIELD 2 AS x")
+    row = _wait_for(
+        lambda: next((r for r in eng.list_running_queries()
+                      if r[4] == "QUEUED"), None),
+        msg="QUEUED row in SHOW QUERIES")
+    assert row[3] == "YIELD 2 AS x"
+    t1.join(10)
+    t2.join(10)
+    assert b1["rs"].ok and b2["rs"].ok
+    # the admission wait fed the statement's queue_us accounting
+    assert stats().snapshot().get("admission_queue_wait_us.count", 0) >= 1
+
+
+# -- eviction of queued statements ------------------------------------------
+
+
+def test_kill_query_removes_queued_statement(clean):
+    """ISSUE 10 satellite: KILL QUERY of a still-QUEUED statement
+    removes it from the admission queue immediately — clean killed
+    error, slot never consumed."""
+    cfg = get_config()
+    cfg.set_dynamic("max_running_queries", 1)
+    cfg.set_dynamic("admission_queue_capacity", 5)
+    eng = QueryEngine()
+    s1, s2, sc = eng.new_session(), eng.new_session(), eng.new_session()
+    _delay_nodes("Project", 0.8)
+    t1, b1 = _run_async(eng, s1, "YIELD 1 AS x")
+    _wait_for(lambda: admission().snapshot()["running"] == 1,
+              msg="holder running")
+    t2, b2 = _run_async(eng, s2, "YIELD 2 AS x")
+    row = _wait_for(
+        lambda: next((r for r in eng.list_running_queries()
+                      if r[4] == "QUEUED"), None),
+        msg="QUEUED victim")
+    qid = row[1]
+    ev0 = _counter("admission_kill_evictions")
+    t_kill = time.monotonic()
+    rs = eng.execute(sc, f"KILL QUERY (session={s2.id}, plan={qid})")
+    assert rs.error is None, rs.error
+    t2.join(5)
+    assert time.monotonic() - t_kill < 2.0, \
+        "queued kill must land immediately, not wait for a slot"
+    assert b2["rs"].error == "ExecutionError: query was killed"
+    assert _counter("admission_kill_evictions") - ev0 == 1
+    snap = admission().snapshot()
+    assert snap["queued"] == 0
+    assert snap["running"] == 1, "victim must never have taken a slot"
+    t1.join(10)
+    assert b1["rs"].ok
+
+
+def test_kill_session_evicts_queued_statement(clean):
+    cfg = get_config()
+    cfg.set_dynamic("max_running_queries", 1)
+    cfg.set_dynamic("admission_queue_capacity", 5)
+    eng = QueryEngine()
+    s1, s2 = eng.new_session(), eng.new_session()
+    _delay_nodes("Project", 0.8)
+    t1, b1 = _run_async(eng, s1, "YIELD 1 AS x")
+    _wait_for(lambda: admission().snapshot()["running"] == 1,
+              msg="holder running")
+    t2, b2 = _run_async(eng, s2, "YIELD 2 AS x")
+    _wait_for(lambda: admission().snapshot()["queued"] == 1,
+              msg="victim queued")
+    assert eng.kill_session(s2.id)
+    t2.join(5)
+    assert b2["rs"].error == "ExecutionError: query was killed"
+    assert admission().snapshot()["running"] == 1
+    t1.join(10)
+    assert b1["rs"].ok
+
+
+def test_deadline_expired_queued_statement_never_takes_slot(clean):
+    """Acceptance: a statement whose budget expires while QUEUED is
+    rejected with DeadlineExceeded (→ E_QUERY_TIMEOUT) without ever
+    consuming a concurrency slot."""
+    cfg = get_config()
+    cfg.set_dynamic("max_running_queries", 1)
+    cfg.set_dynamic("admission_queue_capacity", 5)
+    ctl = admission()
+    holder = ctl.acquire(qid=9001, session=1, kind="Go")
+    assert holder is not None and holder.mode == "admitted"
+    ev0 = _counter("admission_deadline_evictions")
+    box = {}
+
+    def waiter():
+        try:
+            with _cancel.use_cancel(
+                    deadline=time.monotonic() + 0.2):
+                ctl.acquire(qid=9002, session=2, kind="Go")
+            box["err"] = None
+        except _cancel.DeadlineExceeded as ex:
+            box["err"] = ex
+
+    t = threading.Thread(target=waiter, daemon=True)
+    t.start()
+    t.join(5)
+    assert isinstance(box["err"], _cancel.DeadlineExceeded)
+    assert _counter("admission_deadline_evictions") - ev0 == 1
+    snap = ctl.snapshot()
+    assert snap["running"] == 1 and snap["queued"] == 0
+    holder.release()
+
+
+def test_engine_deadline_in_queue_reports_query_timeout(clean):
+    """End-to-end: the queued statement surfaces E_QUERY_TIMEOUT at the
+    engine boundary, same as any other budget exhaustion."""
+    cfg = get_config()
+    cfg.set_dynamic("max_running_queries", 1)
+    cfg.set_dynamic("admission_queue_capacity", 5)
+    cfg.set_dynamic("query_timeout_secs", 0.25)
+    eng = QueryEngine()
+    s1, s2 = eng.new_session(), eng.new_session()
+    _delay_nodes("Project", 0.6)
+    t1, b1 = _run_async(eng, s1, "YIELD 1 AS x")
+    _wait_for(lambda: admission().snapshot()["running"] == 1,
+              msg="holder running")
+    t2, b2 = _run_async(eng, s2, "YIELD 2 AS x")
+    t2.join(5)
+    assert b2["rs"].error is not None \
+        and b2["rs"].error.startswith("E_QUERY_TIMEOUT"), b2["rs"].error
+    t1.join(10)
+
+
+# -- runtime-updatable flags (satellite) ------------------------------------
+
+
+def test_capacity_bump_drains_queue_without_restart(clean):
+    """UPDATE CONFIGS (multi-key, atomic, control lane) raising
+    max_running_queries drains the waiting queue live — the saturated
+    cluster stays recoverable."""
+    cfg = get_config()
+    cfg.set_dynamic("max_running_queries", 1)
+    cfg.set_dynamic("admission_queue_capacity", 5)
+    eng = QueryEngine()
+    sc = eng.new_session()
+    _delay_nodes("Project", 0.5)
+    runs = [_run_async(eng, eng.new_session(), f"YIELD {i} AS x")
+            for i in range(3)]
+    _wait_for(lambda: admission().snapshot()["queued"] == 2,
+              msg="two statements queued")
+    rs = eng.execute(sc, "UPDATE CONFIGS max_running_queries = 3, "
+                         "admission_queue_capacity = 16")
+    assert rs.error is None, rs.error
+    assert cfg.get("max_running_queries") == 3
+    assert cfg.get("admission_queue_capacity") == 16
+    _wait_for(lambda: admission().snapshot()["queued"] == 0,
+              msg="queue drained by the capacity bump")
+    assert admission().snapshot()["running"] >= 2
+    for t, box in runs:
+        t.join(10)
+        assert box["rs"].error is None, box["rs"].error
+
+
+def test_update_configs_multikey_is_atomic(clean):
+    """One bad key in the batch → NOTHING changes."""
+    cfg = get_config()
+    eng = QueryEngine()
+    s = eng.new_session()
+    rs = eng.execute(s, "UPDATE CONFIGS max_running_queries = 7, "
+                        "never_a_flag = 1")
+    assert rs.error is not None
+    assert cfg.get("max_running_queries") == 0, \
+        "a rejected multi-key batch must not half-apply"
+    rs = eng.execute(s, "UPDATE CONFIGS admission_session_weights = "
+                        "\"7:3,9:1\"")
+    assert rs.error is None, rs.error
+    assert cfg.get("admission_session_weights") == "7:3,9:1"
+
+
+# -- fairness (satellite) ---------------------------------------------------
+
+
+def _spawn_waiters(ctl, sessions, order, olock, hold_s=0.0):
+    """One thread per (session, count) waiter; each admitted ticket is
+    recorded and released, cascading the drain."""
+    threads = []
+    qid = [100]
+
+    def waiter(q, sid):
+        try:
+            tk = ctl.acquire(qid=q, session=sid, kind="Go")
+        except Exception as ex:  # noqa: BLE001 — recorded for asserts
+            with olock:
+                order.append((sid, repr(ex)))
+            return
+        with olock:
+            order.append(sid)
+        if hold_s:
+            time.sleep(hold_s)
+        tk.release()
+
+    for sid, n in sessions:
+        for _ in range(n):
+            qid[0] += 1
+            threads.append(threading.Thread(
+                target=waiter, args=(qid[0], sid), daemon=True))
+    return threads
+
+
+def test_dwrr_fairness_weighted_shares(clean):
+    """Three sessions with skewed offered load and weights 1:2:1 —
+    while every session stays backlogged, admitted shares track the
+    weights (no session starves)."""
+    cfg = get_config()
+    cfg.set_dynamic("max_running_queries", 1)
+    cfg.set_dynamic("admission_queue_capacity", 1000)
+    cfg.set_dynamic("admission_session_weights", "102:2")
+    ctl = admission()
+    holder = ctl.acquire(qid=1, session=999, kind="Go")
+    order, olock = [], threading.Lock()
+    threads = _spawn_waiters(
+        ctl, [(101, 20), (102, 20), (103, 20)], order, olock)
+    for t in threads:
+        t.start()
+    _wait_for(lambda: ctl.snapshot()["queued"] == 60,
+              msg="all 60 waiters queued")
+    holder.release()
+    for t in threads:
+        t.join(10)
+    assert len(order) == 60 and not any(
+        isinstance(x, tuple) for x in order), order[:5]
+    # first 16 admissions: all sessions still backlogged, so DWRR
+    # shares must track weights 1:2:1 (102 ≈ half, others ≈ quarter,
+    # ±rotation-boundary slack)
+    head = order[:16]
+    assert 6 <= head.count(102) <= 10, head
+    assert head.count(101) >= 2, head
+    assert head.count(103) >= 2, head
+
+
+def test_fairness_survives_concurrent_kill_session(clean):
+    """A KILL SESSION mid-drain evicts that session's queued waiters;
+    every other session's waiters are still admitted (no stall, no
+    starvation)."""
+    cfg = get_config()
+    cfg.set_dynamic("max_running_queries", 1)
+    cfg.set_dynamic("admission_queue_capacity", 1000)
+    ctl = admission()
+    holder = ctl.acquire(qid=1, session=999, kind="Go")
+    order, olock = [], threading.Lock()
+    killed = []
+    kill_ev = threading.Event()
+    threads = _spawn_waiters(
+        ctl, [(201, 15), (202, 15)], order, olock, hold_s=0.005)
+    qid = [500]
+
+    def doomed_waiter(q):
+        try:
+            with _cancel.use_cancel(kill=kill_ev):
+                tk = ctl.acquire(qid=q, session=204, kind="Go")
+                with olock:
+                    order.append(204)
+                tk.release()
+        except _cancel.QueryKilled:
+            with olock:
+                killed.append(q)
+
+    for _ in range(10):
+        qid[0] += 1
+        threads.append(threading.Thread(
+            target=doomed_waiter, args=(qid[0],), daemon=True))
+    for t in threads:
+        t.start()
+    _wait_for(lambda: ctl.snapshot()["queued"] == 40,
+              msg="all 40 waiters queued")
+    holder.release()
+    _wait_for(lambda: len(order) + len(killed) >= 6,
+              msg="drain started")
+    kill_ev.set()               # KILL SESSION lands mid-drain
+    for t in threads:
+        t.join(10)
+    with olock:
+        admitted_204 = order.count(204)
+    assert admitted_204 + len(killed) == 10
+    assert order.count(201) == 15 and order.count(202) == 15, \
+        "surviving sessions must fully drain"
+    assert ctl.snapshot()["queued"] == 0
+
+
+# -- memory watermark -------------------------------------------------------
+
+
+class _FakeTracker:
+    def __init__(self, used):
+        self.used = used
+
+
+def test_memory_watermark_gates_admission(clean):
+    cfg = get_config()
+    cfg.set_dynamic("max_running_queries", 4)
+    cfg.set_dynamic("admission_queue_capacity", 10)
+    cfg.set_dynamic("admission_memory_watermark_bytes", 1000)
+    ctl = admission()
+    # first statement admits even though it will exceed the watermark
+    # (nothing is running: the gate must never wedge the drain)
+    fat = ctl.acquire(qid=1, session=1, kind="Go",
+                      tracker=_FakeTracker(2000))
+    assert fat.mode == "admitted"
+    box = {}
+
+    def second():
+        box["t"] = ctl.acquire(qid=2, session=2, kind="Go",
+                               tracker=_FakeTracker(10))
+        box["at"] = time.monotonic()
+
+    t = threading.Thread(target=second, daemon=True)
+    t.start()
+    _wait_for(lambda: ctl.snapshot()["queued"] == 1,
+              msg="second statement gated by the watermark")
+    time.sleep(0.1)
+    assert ctl.snapshot()["queued"] == 1, \
+        "must stay queued while memory is above the watermark"
+    t_rel = time.monotonic()
+    fat.release()
+    t.join(5)
+    assert box["t"].mode == "admitted"
+    assert box["at"] >= t_rel
+    box["t"].release()
+
+
+# -- client-side E_OVERLOAD handling (satellite) ----------------------------
+
+
+def _graphd_stub(replies):
+    """RpcServer speaking just enough graph.* for GraphClient: each
+    execute pops the next scripted reply."""
+    srv = RpcServer()
+    calls = {"n": 0}
+
+    def auth(p):
+        return {"session_id": 1}
+
+    def execute(p):
+        calls["n"] += 1
+        return replies.pop(0)
+
+    srv.register("graph.authenticate", auth)
+    srv.register("graph.execute", execute)
+    srv.register("graph.signout", lambda p: True)
+    srv.start()
+    return srv, calls
+
+
+def _ok_reply(val=1):
+    return {"error": None, "space": None, "latency_us": 0,
+            "data": None, "plan_desc": None}
+
+
+def _overload_reply(retry_ms=50):
+    return {"error": overload_error(retry_ms / 1000.0,
+                                    "graphd:admission", "test shed"),
+            "space": None, "latency_us": 0, "data": None,
+            "plan_desc": None}
+
+
+def test_client_honors_retry_after_hint(clean):
+    from nebula_tpu.cluster.client import GraphClient
+    srv, calls = _graphd_stub(
+        [_overload_reply(50), _overload_reply(50), _ok_reply()])
+    try:
+        cl = GraphClient(srv.host, srv.port)
+        cl.authenticate()
+        t0 = time.monotonic()
+        rs = cl.execute("YIELD 1")
+        waited = time.monotonic() - t0
+        assert rs.error is None
+        assert calls["n"] == 3
+        # two 50ms hints, each jittered into [25ms, 75ms]
+        assert waited >= 0.05, "both hints must be honored"
+        cl.close()
+    finally:
+        srv.stop()
+
+
+def test_client_overload_budget_exhausted_is_structured(clean):
+    """When the deadline budget runs out the client stops retrying and
+    returns the STRUCTURED overload: error text + parsed
+    retry_after_ms, in bounded wall time."""
+    from nebula_tpu.cluster.client import GraphClient
+    get_config().set_dynamic("query_timeout_secs", 0.4)
+    srv, calls = _graphd_stub([_overload_reply(80) for _ in range(64)])
+    try:
+        cl = GraphClient(srv.host, srv.port)
+        cl.authenticate()
+        t0 = time.monotonic()
+        rs = cl.execute("YIELD 1")
+        waited = time.monotonic() - t0
+        assert rs.error is not None and is_overload(rs.error)
+        assert rs.retry_after_ms == 80
+        assert waited < 3.0, "retries must stay inside the budget"
+        # 80ms hints jittered into [40ms, 120ms] against a 0.4s budget
+        assert 1 <= calls["n"] < 15
+        cl.close()
+    finally:
+        srv.stop()
+
+
+# -- bounded RPC-server inbox -----------------------------------------------
+
+
+def test_rpc_inbox_sheds_with_retry_after(clean):
+    """Capacity-1 inbox + a slow handler: concurrent pipelined calls
+    are rejected with E_OVERLOAD (+hint); a retrying client rides the
+    hint to success; exempt methods are never shed."""
+    cfg = get_config()
+    cfg.set_dynamic("rpc_server_inbox_capacity", 1)
+    srv = RpcServer()
+    srv.service_role = "storaged"
+    srv.register("test.slow", lambda p: (time.sleep(0.3), "done")[1])
+    srv.register("meta.ping", lambda p: "pong")
+    srv.start()
+    try:
+        cl = RpcClient(srv.host, srv.port, retries=0)
+        results, errors = [], []
+
+        def call():
+            try:
+                results.append(cl.call("test.slow"))
+            except RpcError as ex:
+                errors.append(str(ex))
+
+        ths = [threading.Thread(target=call, daemon=True)
+               for _ in range(3)]
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join(10)
+        assert errors, "concurrent calls beyond capacity must shed"
+        for e in errors:
+            assert is_overload(e) and parse_retry_after(e) is not None, e
+        # exempt method answers even while the inbox is saturated
+        t_busy = threading.Thread(
+            target=lambda: cl.call("test.slow"), daemon=True)
+        t_busy.start()
+        time.sleep(0.05)
+        assert cl.call("meta.ping") == "pong"
+        t_busy.join(10)
+        # a client WITH retries honors the hint and lands the call
+        rcl = RpcClient(srv.host, srv.port, retries=4)
+        t_busy2 = threading.Thread(
+            target=lambda: cl.call("test.slow"), daemon=True)
+        t_busy2.start()
+        time.sleep(0.05)
+        assert rcl.call("test.slow") == "done"
+        t_busy2.join(10)
+        rcl.close()
+        cl.close()
+    finally:
+        srv.stop()
+
+
+def test_rpc_inbox_failpoint_force_shed(clean):
+    cfg = get_config()
+    cfg.set_dynamic("rpc_server_inbox_capacity", 100)
+    srv = RpcServer()
+    srv.register("test.fast", lambda p: "ok")
+    srv.start()
+    try:
+        fail.arm("rpc:server_inbox", "1*raise")
+        cl = RpcClient(srv.host, srv.port, retries=0)
+        with pytest.raises(RpcError) as ei:
+            cl.call("test.fast")
+        assert is_overload(str(ei.value))
+        assert cl.call("test.fast") == "ok"    # site disarmed
+        cl.close()
+    finally:
+        srv.stop()
+
+
+# -- device dispatch-queue cap ----------------------------------------------
+
+
+def test_dispatch_queue_cap_degrades_to_host(clean):
+    from nebula_tpu.tpu.pipeline import _dispatch_overloaded
+    from nebula_tpu.utils.workload import dispatch_table
+    cfg = get_config()
+    assert not _dispatch_overloaded(), "cap=0 must never shed"
+    cfg.set_dynamic("tpu_dispatch_queue_cap", 2)
+    assert not _dispatch_overloaded(), "empty queue under cap"
+    toks = [dispatch_table().enter(f"k{i}") for i in range(2)]
+    try:
+        shed0 = _counter("tpu_dispatch_queue_shed")
+        assert _dispatch_overloaded(), "queued depth at cap must shed"
+        assert _counter("tpu_dispatch_queue_shed") - shed0 == 1
+    finally:
+        for tok in toks:
+            dispatch_table().exit(tok)
+    assert not _dispatch_overloaded()
