@@ -1117,6 +1117,28 @@ def main():
         overload = {"error": repr(ex)}
     _save_partial(platform, configs)
 
+    # ---- read_scaleout block (ISSUE 11): goodput-vs-replica-count on
+    # a read-heavy mix.  1 storaged / rf=1 leader-only vs 3 storaged /
+    # rf=3 at follower consistency with the bounded storaged inbox
+    # armed — the acceptance number is qps_3r_vs_1r (bar: >= 2.0).
+    # Also: read QPS per consistency level, follower_read share,
+    # time-to-first-successful-read after a hard leader kill, and the
+    # result cache serving a hot repeated read with identical rows.
+    _mark("config read_scaleout: replica-count read sweep 1r vs 3r")
+    try:
+        from nebula_tpu.tools.overload_bench import (
+            read_scaleout_sweep as _read_sweep)
+        read_scaleout = _read_sweep(
+            persons=int(os.environ.get("NEBULA_BENCH_READS_PERSONS",
+                                       1000)),
+            threads=int(os.environ.get("NEBULA_BENCH_READS_THREADS", 12)),
+            duration_s=float(os.environ.get("NEBULA_BENCH_READS_SECS",
+                                            3.0)),
+            tpu_runtime=rt)
+    except Exception as ex:  # noqa: BLE001 — must not sink the run
+        read_scaleout = {"error": repr(ex)}
+    _save_partial(platform, configs)
+
     # VERDICT r3 item 2: the driver tails stdout into a small buffer, so
     # the headline must be COMPACT and LAST.  Full detail goes to
     # BENCH_DETAIL.json next to this script.
@@ -1277,6 +1299,7 @@ def main():
         "observability": observability,
         "concurrency": concurrency,
         "overload": overload,
+        "read_scaleout": read_scaleout,
         "configs": configs,
     }
     if tpu_partial is not None:

@@ -23,6 +23,7 @@ from ..core.wire import from_wire, to_wire
 from ..graphstore.schema import (SchemaError, apply_defaults,
                                   fill_row)
 from ..graphstore.store import stable_vid_hash
+from ..utils import consistency as _consistency
 from ..utils.failpoints import fail
 from .meta_client import MetaClient
 from .storage_client import StorageClient, StorageError
@@ -125,9 +126,49 @@ class DistributedStore:
         # recorded outcome instead of double-applying
         self.writer_id = uuid.uuid4().hex[:16]
         self._wseq = itertools.count(1)
+        # read-your-writes floors (ISSUE 11): per-(space, part) highest
+        # raft index any write THROUGH THIS STORE was acked at (the ack
+        # carries it — including dedup-retry acks, so the floor is
+        # right even when the reply that carried the original index was
+        # lost).  Follower/bounded_stale reads ship the floor as
+        # `min_applied`; a replica may only serve once its apply covers
+        # it.  Process-wide (all sessions of this graphd share the
+        # store) — a superset of per-session tracking, never weaker.
+        self._applied_floor: Dict[tuple, int] = {}
+        import threading
+        self._floor_lock = threading.Lock()
 
     def _token(self) -> List[Any]:
         return [self.writer_id, next(self._wseq)]
+
+    def _note_applied(self, space: str, pid: int, reply: Any):
+        """Record a write ack's applied index as the part's
+        read-your-writes floor."""
+        if not isinstance(reply, dict):
+            return
+        idx = int(reply.get("applied") or 0)
+        if idx <= 0:
+            return
+        key = (space, pid)
+        with self._floor_lock:
+            if idx > self._applied_floor.get(key, 0):
+                self._applied_floor[key] = idx
+
+    def _read_params(self, space: str, pid: int) -> Dict[str, Any]:
+        """Per-part consistency params for one read call: the effective
+        level (thread-local override, else the read_consistency flag)
+        plus this part's read-your-writes floor for the non-leader
+        levels.  Empty for `leader` — byte-identical wire frames to the
+        pre-ISSUE-11 client on the default path."""
+        lvl = _consistency.effective_consistency()
+        if lvl == _consistency.LEADER:
+            return {}
+        p: Dict[str, Any] = {"consistency": lvl}
+        with self._floor_lock:
+            floor = self._applied_floor.get((space, pid), 0)
+        if floor:
+            p["min_applied"] = floor
+        return p
 
     @property
     def catalog(self):
@@ -140,6 +181,13 @@ class DistributedStore:
 
     def drop_space(self, name: str, if_exists=False):
         self.meta.drop_space(name, if_exists=if_exists)
+        # floors are keyed by space NAME: a dropped-and-recreated space
+        # starts a fresh raft log, so stale floors would make its first
+        # follower/bounded_stale reads wait for (or reject against) an
+        # applied index the new group won't reach for a long time
+        with self._floor_lock:
+            for key in [k for k in self._applied_floor if k[0] == name]:
+                del self._applied_floor[key]
 
     def clear_space(self, name: str, if_exists=False):
         """CLEAR SPACE across the cluster: one raft-replicated
@@ -169,10 +217,11 @@ class DistributedStore:
         # the token is minted ONCE per logical request: replica-walk
         # retries re-send the same (writer_id, seq), which is what the
         # dedup window keys on
-        self.sc._call_part(space, pid, "storage.write",
-                           {"cmds": [to_wire(list(c)) for c in cmds],
-                            "cat_ver": self.meta.version,
-                            "token": self._token()})
+        r = self.sc._call_part(space, pid, "storage.write",
+                               {"cmds": [to_wire(list(c)) for c in cmds],
+                                "cat_ver": self.meta.version,
+                                "token": self._token()})
+        self._note_applied(space, pid, r)
 
     def _write_many(self, space: str, by_part: Dict[int, List[tuple]]):
         """One rpc_write per part — each part's command list becomes ONE
@@ -184,13 +233,14 @@ class DistributedStore:
             pid, cmds = next(iter(by_part.items()))
             self._write(space, pid, *cmds)
             return
-        self.sc.fanout(
-            space,
-            {pid: {"cmds": [to_wire(list(c)) for c in cmds],
-                   "cat_ver": self.meta.version,
-                   "token": self._token()}
-             for pid, cmds in by_part.items()},
-            "storage.write")
+        for pid, r in self.sc.fanout(
+                space,
+                {pid: {"cmds": [to_wire(list(c)) for c in cmds],
+                       "cat_ver": self.meta.version,
+                       "token": self._token()}
+                 for pid, cmds in by_part.items()},
+                "storage.write"):
+            self._note_applied(space, pid, r)
 
     def insert_vertex(self, space: str, vid: Any, tag: str,
                       props: Dict[str, Any],
@@ -378,8 +428,10 @@ class DistributedStore:
         return tags, edges
 
     def get_vertex(self, space: str, vid: Any):
-        r = self.sc._call_part(space, self.sc.part_of(space, vid),
-                               "storage.get_vertex", {"vid": to_wire(vid)})
+        pid = self.sc.part_of(space, vid)
+        r = self.sc._call_part(space, pid, "storage.get_vertex",
+                               {"vid": to_wire(vid),
+                                **self._read_params(space, pid)})
         if r is None:
             return None
         tag_svs, _ = self._sv_maps(space)
@@ -390,10 +442,11 @@ class DistributedStore:
 
     def get_edge(self, space: str, src: Any, etype: str, dst: Any,
                  rank: int = 0):
-        r = self.sc._call_part(space, self.sc.part_of(space, src),
-                               "storage.get_edge",
+        pid = self.sc.part_of(space, src)
+        r = self.sc._call_part(space, pid, "storage.get_edge",
                                {"src": to_wire(src), "etype": etype,
-                                "dst": to_wire(dst), "rank": rank})
+                                "dst": to_wire(dst), "rank": rank,
+                                **self._read_params(space, pid)})
         if r is None:
             return None
         try:
@@ -407,7 +460,8 @@ class DistributedStore:
         pids = list(parts) if parts is not None else self.sc.all_parts(space)
         tag_svs, _ = self._sv_maps(space)
         for pid, rows in self.sc.fanout(
-                space, {p: {"tag": tag} for p in pids},
+                space, {p: {"tag": tag, **self._read_params(space, p)}
+                        for p in pids},
                 "storage.scan_vertices"):
             for vid, t, row in rows:
                 sv = tag_svs.get(t)
@@ -421,7 +475,8 @@ class DistributedStore:
         pids = list(parts) if parts is not None else self.sc.all_parts(space)
         _, edge_svs = self._sv_maps(space)
         for pid, rows in self.sc.fanout(
-                space, {p: {"etype": etype} for p in pids},
+                space, {p: {"etype": etype, **self._read_params(space, p)}
+                        for p in pids},
                 "storage.scan_edges"):
             for src, et, rank, dst, row in rows:
                 sv = edge_svs.get(et)
@@ -446,7 +501,8 @@ class DistributedStore:
             space,
             {pid: {"vids": to_wire(pvids), "edge_types": edge_types,
                    "direction": direction, "filter": ftext,
-                   "limit_per_src": limit_per_src}
+                   "limit_per_src": limit_per_src,
+                   **self._read_params(space, pid)}
              for pid, pvids in by_part.items()},
             "storage.get_neighbors"))
         # merge preserving input vid order: index rows per (vid, dir)
@@ -497,7 +553,8 @@ class DistributedStore:
         out: List[Any] = []
         for pid, ents in self.sc.fanout(
                 space, {p: {"index": index_name, "eq": to_wire(eq_prefix),
-                            "range": rng} for p in pids},
+                            "range": rng,
+                            **self._read_params(space, p)} for p in pids},
                 "storage.index_scan"):
             for e in ents:
                 v = from_wire(e)
@@ -513,7 +570,8 @@ class DistributedStore:
         out: List[Any] = []
         for pid, ents in self.sc.fanout(
                 space, {p: {"index": index_name,
-                            "ranges": [list(r) for r in ranges]}
+                            "ranges": [list(r) for r in ranges],
+                            **self._read_params(space, p)}
                         for p in pids},
                 "storage.index_scan_geo"):
             for e in ents:
@@ -551,7 +609,8 @@ class DistributedStore:
         out: List[Any] = []
         for pid, ents in self.sc.fanout(
                 space, {p: {"index": index_name, "op": op,
-                            "pattern": pattern, "want_id": want}
+                            "pattern": pattern, "want_id": want,
+                            **self._read_params(space, p)}
                         for p in pids},
                 "storage.fulltext_search"):
             for e in ents:

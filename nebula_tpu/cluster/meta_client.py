@@ -41,6 +41,14 @@ class MetaClient:
         self.hb_interval = heartbeat_interval
         self.catalog = Catalog()
         self.part_map: Dict[str, List[List[str]]] = {}
+        # (space, pid) → last leader learned from a storaged's
+        # "part_leader_changed: <addr>" hint (ISSUE 11 satellite).  An
+        # overlay, not an edit of part_map: it survives refresh()
+        # overwriting the map (metad only reorders replicas on explicit
+        # BALANCE LEADER — an election-driven leader change never
+        # reaches the map, so without this every statement would re-walk
+        # until the next transfer)
+        self._part_hints: Dict[tuple, str] = {}
         self.version = -1
         from ..utils.racecheck import make_lock
         self.lock = make_lock("meta_client")
@@ -173,16 +181,38 @@ class MetaClient:
         self.call("meta.ddl", cmd64=_pk(cmd))
         self.refresh(force=True)
 
+    def note_part_leader(self, space: str, pid: int, addr: str):
+        """Write a learned leader back into the cached part map (as an
+        overlay): the walk that discovered a failover pays once, every
+        later statement routes straight to the new leader."""
+        if not addr or ":" not in addr:
+            return
+        with self.lock:
+            self._part_hints[(space, pid)] = addr
+
     def parts_of(self, space: str) -> List[List[str]]:
         with self.lock:
             pm = self.part_map.get(space)
+            hints = {p: a for (s, p), a in self._part_hints.items()
+                     if s == space} if self._part_hints else None
         if pm is None:
             self.refresh(force=True)
             with self.lock:
                 pm = self.part_map.get(space)
         if pm is None:
             raise MetaError(f"space `{space}' not found")
-        return pm
+        if not hints:
+            return pm
+        # hint overlay: front-load the learned leader per part.  A hint
+        # whose addr left the replica set (balance moved the part) is
+        # simply ignored — the map is the membership authority.
+        out = []
+        for pid, replicas in enumerate(pm):
+            a = hints.get(pid)
+            if a and a in replicas and replicas[0] != a:
+                replicas = [a] + [x for x in replicas if x != a]
+            out.append(replicas)
+        return out
 
     def create_session(self, user: str, graphd: str) -> int:
         return self.call("meta.create_session", user=user, graphd=graphd)

@@ -31,6 +31,14 @@ define_flag("raft_max_batch", 64,
             "unit of transfer_leadership catch-up); the group-commit "
             "replication batch ceiling")
 
+define_flag("raft_lease_margin_ms", 25.0,
+            "clock-skew safety margin subtracted from the minimum "
+            "election timeout when judging the leader lease: a lease "
+            "read is only served while a majority acked within "
+            "(min_election_timeout - margin).  A margin >= the "
+            "election timeout disables the lease fast path entirely "
+            "(every read-index falls back to a quorum round)")
+
 # raft_commit_latency_ms buckets (milliseconds — consensus rounds, not
 # the µs RPC scale of LATENCY_BUCKETS_US)
 COMMIT_LATENCY_BUCKETS_MS = (0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0,
@@ -147,6 +155,10 @@ class RaftPart:
         self.leader_id: Optional[str] = None
         self.commit_index = self.snap_index
         self.last_applied = self.snap_index
+        # when this replica last heard from a live leader (append_entries
+        # / snapshot install) — the staleness clock bounded_stale reads
+        # are judged against; 0.0 = never
+        self._leader_contact = 0.0
         self.next_index: Dict[str, int] = {}
         self.match_index: Dict[str, int] = {}
 
@@ -567,6 +579,14 @@ class RaftPart:
         with self.lock:
             return self.alive and self.state == LEADER
 
+    @staticmethod
+    def _lease_margin_s() -> float:
+        try:
+            return max(float(get_config().get("raft_lease_margin_ms")),
+                       0.0) / 1e3
+        except Exception:  # noqa: BLE001 — config not initialized
+            return 0.025
+
     def has_lease(self) -> bool:
         """Heartbeat-majority leader lease for linearizable-ish reads.
 
@@ -575,16 +595,155 @@ class RaftPart:
         only while a majority acked within the minimum election timeout
         bounds that stale window: no new leader can have been elected
         during an interval in which this leader held a quorum's
-        heartbeat acks."""
+        heartbeat acks.  A clock-skew margin (`raft_lease_margin_ms`,
+        ISSUE 11 satellite) is subtracted from that bound: a follower
+        whose clock runs slightly fast starts its election timer early,
+        so the raw minimum election timeout overstates how long the
+        no-vote promise is good for.  margin >= the election timeout
+        disables the lease fast path (window <= 0 → always False)."""
         with self.lock:
             if not (self.alive and self.state == LEADER):
                 return False
             if not self.peers:
                 return True
-            horizon = time.monotonic() - self.eto[0]
+            window = self.eto[0] - self._lease_margin_s()
+            if window <= 0:
+                return False
+            horizon = time.monotonic() - window
             acked = sum(1 for p in self.peers
                         if self._last_ack.get(p, 0.0) >= horizon)
             return (acked + 1) * 2 > len(self.peers) + 1
+
+    # -- read path (ISSUE 11): read-index / lease reads -------------------
+
+    def applied_index(self) -> int:
+        with self.lock:
+            return self.last_applied
+
+    def leader_contact_age(self) -> float:
+        """Seconds since this replica provably tracked a live leader —
+        the staleness clock for bounded_stale reads.  For a follower:
+        age of the last append_entries/snapshot from a leader.  For a
+        leader: age of the freshest heartbeat round a MAJORITY acked (a
+        deposed-but-unaware leader on the minority side goes stale here
+        exactly like a cut-off follower).  inf when never in contact."""
+        now = time.monotonic()
+        with self.lock:
+            if not self.alive:
+                return float("inf")
+            if self.state == LEADER:
+                if not self.peers:
+                    return 0.0
+                acks = sorted(self._last_ack.values(), reverse=True)
+                need = (len(self.peers) + 1) // 2   # peers for a quorum
+                if len(acks) < need:
+                    return float("inf")
+                return max(now - acks[need - 1], 0.0)
+            if self._leader_contact <= 0.0:
+                return float("inf")
+            return max(now - self._leader_contact, 0.0)
+
+    def read_index(self, timeout: float = 1.0) -> Optional[int]:
+        """Linearizable read barrier (raft §6.4): an index such that a
+        read observing every entry applied up to it sees everything
+        committed before this call started.  On the leader the lease
+        fast path answers from `commit_index` for free; a leader whose
+        lease lapsed confirms its leadership with one live quorum round
+        first (a deposed-but-unaware leader fails that round and
+        returns None).  On a follower the call forwards to the known
+        leader.  None = no leader reachable/confirmed — the caller
+        walks replicas like any leader-change."""
+        try:
+            fail.hit("raft:read_index", key=self.group)
+        except FailpointError:
+            return None
+        from ..utils.stats import stats as _metrics
+        with self.lock:
+            if not self.alive:
+                return None
+            leading = self.state == LEADER
+            target = self.leader_id
+            commit = self.commit_index
+        if leading:
+            if self.has_lease():
+                _metrics().inc_labeled("raft_read_index",
+                                       {"path": "lease"})
+                return commit
+            idx = self._quorum_confirm(timeout)
+            if idx is not None:
+                _metrics().inc_labeled("raft_read_index",
+                                       {"path": "quorum"})
+            return idx
+        if not target or target == self.node_id:
+            return None
+        r = self.transport.send(target, self.group, "read_index",
+                                {"_from": self.node_id})
+        if not r or not r.get("ok"):
+            return None
+        _metrics().inc_labeled("raft_read_index", {"path": "forward"})
+        return int(r["index"])
+
+    def _quorum_confirm(self, timeout: float) -> Optional[int]:
+        """Leadership confirmation for a lease-less read_index: one live
+        append_entries round to every peer; success = a majority
+        replied while our term survived.  Returns the commit index the
+        confirmation covers (taken BEFORE the round — any entry
+        committed before the call is <= it), or None."""
+        with self.lock:
+            if not (self.alive and self.state == LEADER):
+                return None
+            term = self.current_term
+            commit = self.commit_index
+            peers = list(self.peers)
+        if not peers:
+            return commit
+        acks = [1]
+        mu = threading.Lock()
+        done = threading.Event()
+
+        def ping(p):
+            if not self._replicate_one(p):
+                return
+            with self.lock:
+                if not (self.alive and self.state == LEADER
+                        and self.current_term == term):
+                    done.set()
+                    return
+            with mu:
+                acks[0] += 1
+                if acks[0] * 2 > len(peers) + 1:
+                    done.set()
+
+        for p in peers:
+            threading.Thread(target=ping, args=(p,), daemon=True,
+                             name=f"raft-readidx-{self.node_id}").start()
+        done.wait(timeout)
+        with self.lock:
+            if not (self.alive and self.state == LEADER
+                    and self.current_term == term):
+                return None
+        with mu:
+            if acks[0] * 2 > len(peers) + 1:
+                return commit
+        return None
+
+    def wait_applied(self, index: int, timeout: float = 5.0) -> bool:
+        """Block until the local state machine has applied `index`
+        (the follower half of a read-index read).  Drives apply itself
+        when commits are already known locally; otherwise waits for the
+        leader's next append_entries to advance commit_index."""
+        dl = time.monotonic() + timeout
+        while True:
+            self._apply_committed()
+            with self.lock:
+                if self.last_applied >= index:
+                    return True
+                if not self.alive:
+                    return False
+                left = dl - time.monotonic()
+                if left <= 0:
+                    return False
+                self.commit_cv.wait(min(left, 0.05))
 
     def propose(self, data: bytes, timeout: float = 5.0) -> Optional[int]:
         """Append + replicate + wait for commit.  Returns the entry's log
@@ -674,7 +833,21 @@ class RaftPart:
             return self._on_install_snapshot(p)
         if method == "timeout_now":
             return self._on_timeout_now(p)
+        if method == "read_index":
+            return self._on_read_index(p)
         raise ValueError(f"unknown raft method {method}")
+
+    def _on_read_index(self, p):
+        """A follower asked us (its view of the leader) for a read
+        barrier.  Only answered while actually leading — a fellow
+        follower must NOT forward onward (two stale leader_id hints
+        could otherwise chase each other in a cycle)."""
+        with self.lock:
+            if self.state != LEADER:
+                return {"term": self.current_term, "ok": False}
+        idx = self.read_index()
+        return {"term": self.current_term, "ok": idx is not None,
+                "index": idx}
 
     def _on_timeout_now(self, p):
         with self.lock:
@@ -706,6 +879,7 @@ class RaftPart:
             if p["term"] > self.current_term or self.state != FOLLOWER:
                 self._step_down(p["term"])
             self.leader_id = p["leader"]
+            self._leader_contact = time.monotonic()
             self._reset_election_deadline()
 
             prev_idx, prev_term = p["prev_index"], p["prev_term"]
@@ -754,6 +928,7 @@ class RaftPart:
                 return {"term": self.current_term, "ok": False}
             self._step_down(p["term"])
             self.leader_id = p["leader"]
+            self._leader_contact = time.monotonic()
             self._reset_election_deadline()
             data = _unb64(p["data"])
             if self.restore_cb:

@@ -1,8 +1,11 @@
 """Storage service — one storaged host.
 
 Owns the partitions the meta part map assigns to it, replicates writes
-through one Raft group per (space, part), serves reads from part
-leaders.  Analog of the reference's StorageServer + processors over
+through one Raft group per (space, part), serves reads at the caller's
+requested consistency level — lease-gated leader reads by default,
+read-index follower reads and bounded-staleness local reads on request
+(`_read_part`, ISSUE 11; the raftex lease/read-index lineage).  Analog
+of the reference's StorageServer + processors over
 NebulaStore/RaftPart (reference: src/storage + src/kvstore [UNVERIFIED —
 empty mount, SURVEY §0]); the storage op set mirrors storage.thrift
 (SURVEY §2 rows 6, 12, 13).
@@ -20,7 +23,10 @@ from typing import Any, Dict, List, Optional, Tuple
 from ..core import wire
 from ..core.wire import from_wire, to_wire
 from ..graphstore.store import GraphStore
+from ..utils import cancel as _cancel
+from ..utils import consistency as _consistency
 from ..utils import trace as _trace
+from ..utils.config import get_config
 from ..utils.failpoints import fail
 from .meta_client import MetaClient
 from .raft import RaftPart
@@ -85,6 +91,38 @@ def _validate_cmd(cmd) -> tuple:
     return decoded
 
 
+class _ReadBucket:
+    """Token bucket behind `storage_read_capacity_qps` (ISSUE 11): a
+    per-storaged read admission rate.  Over-rate reads shed with the
+    PR 8 structured E_OVERLOAD + a retry-after priced at the bucket's
+    refill — so a follower-readable client walks to a replica with
+    spare capacity NOW instead of waiting this one out."""
+
+    __slots__ = ("_tokens", "_t", "_mu")
+
+    def __init__(self):
+        self._tokens = 0.0
+        self._t = 0.0
+        self._mu = threading.Lock()
+
+    def take(self, rate: float) -> Optional[float]:
+        """None = admitted; else seconds until a token frees up."""
+        import time as _t
+        now = _t.monotonic()
+        burst = max(rate / 10.0, 8.0)
+        with self._mu:
+            if self._t == 0.0:
+                self._tokens, self._t = burst, now
+            else:
+                self._tokens = min(self._tokens
+                                   + (now - self._t) * rate, burst)
+                self._t = now
+            if self._tokens >= 1.0:
+                self._tokens -= 1.0
+                return None
+            return max((1.0 - self._tokens) / rate, 0.001)
+
+
 def _neighbors_columnar(raw) -> Optional[Dict[str, Any]]:
     """Columnar wire form of a get_neighbors reply (ISSUE 2): when the
     scan is single-edge-type with int vids and schema-uniform prop rows
@@ -139,6 +177,7 @@ class StorageService:
         # that did not actually land.  Bounded: a timed-out propose
         # never claims its error (see BoundedErrorMap).
         self._apply_errors = BoundedErrorMap()
+        self._read_bucket = _ReadBucket()
         self.transport = RpcRaftTransport()
         self.server = server
         server.service_role = "storaged"
@@ -436,8 +475,7 @@ class StorageService:
 
     # -- helpers ----------------------------------------------------------
 
-    def _leader_part(self, space: str, pid: int,
-                     lease: bool = True) -> RaftPart:
+    def _local_part(self, space: str, pid: int) -> RaftPart:
         sp = self.meta.catalog.spaces.get(space)
         if sp is None:
             self.meta.refresh(force=True)
@@ -450,6 +488,11 @@ class StorageService:
             part = self.parts.get((sp.space_id, pid))
         if part is None:
             raise RpcError(f"part {pid} of `{space}' not hosted here")
+        return part
+
+    def _leader_part(self, space: str, pid: int,
+                     lease: bool = True) -> RaftPart:
+        part = self._local_part(space, pid)
         if not part.is_leader():
             raise RpcError(f"part_leader_changed: {part.leader_id or ''}")
         if lease and not part.has_lease():
@@ -457,6 +500,90 @@ class StorageService:
             # must not serve stale reads; client retries elsewhere
             # (writes skip this: propose itself fails safely without quorum)
             raise RpcError(f"part_leader_changed: {part.leader_id or ''}")
+        return part
+
+    def _read_part(self, space: str, pid: int, p) -> RaftPart:
+        """Serve-or-reject gate for a read RPC at its requested
+        consistency level (ISSUE 11 tentpole).
+
+          leader        — today's lease-gated leader read (default).
+          follower      — read-index: obtain a read barrier from the
+                          leader (lease fast path / quorum confirm /
+                          follower forward) and wait for LOCAL apply to
+                          reach it, so the reply observes everything
+                          committed before the read started.
+          bounded_stale — serve purely locally while this replica heard
+                          from a live leader within read_max_stale_ms
+                          AND its applied index covers the caller's
+                          read-your-writes floor (`min_applied`); else
+                          reject with a structured E_STALE + lag hint
+                          and the client walks to a fresher replica.
+
+        Successful non-leader-consistency serves stamp the serving
+        replica + applied index into the statement's trace (the
+        `storage:follower_read` phase rides the reply envelope) and
+        count into the reply cost record (`follower_reads`)."""
+        from ..utils.stats import current_cost, stats
+        try:
+            cap = float(get_config().get("storage_read_capacity_qps"))
+        except Exception:  # noqa: BLE001 — config not initialized
+            cap = 0.0
+        if cap > 0:
+            retry = self._read_bucket.take(cap)
+            if retry is not None:
+                from ..utils.admission import overload_error
+                stats().inc_labeled("overload_server_rejections",
+                                    {"op": "storage.read_capacity",
+                                     "role": "storaged"})
+                raise RpcError(overload_error(
+                    retry, "storaged:read_capacity",
+                    f"read capacity {cap:g}/s exhausted"))
+        lvl = p.get("consistency") or _consistency.LEADER
+        if lvl == _consistency.LEADER:
+            return self._leader_part(space, pid)
+        if lvl not in _consistency.LEVELS:
+            raise RpcError(f"unknown consistency level {lvl!r}")
+        part = self._local_part(space, pid)
+        fail.hit("storage:follower_read", key=f"{part.group}|{lvl}")
+        min_applied = int(p.get("min_applied") or 0)
+        if lvl == _consistency.BOUNDED_STALE:
+            part._apply_committed()       # drain locally-known commits
+            lag_s = part.leader_contact_age()
+            try:
+                bound_ms = float(get_config().get("read_max_stale_ms"))
+            except Exception:  # noqa: BLE001 — config not initialized
+                bound_ms = 5000.0
+            lag_ms = int(min(lag_s * 1e3, 10 ** 9))
+            applied = part.applied_index()
+            if lag_ms > bound_ms or applied < min_applied:
+                stats().inc("stale_read_rejects")
+                raise RpcError(
+                    f"E_STALE: replica lag {lag_ms}ms over bound "
+                    f"{int(bound_ms)}ms (applied={applied}, "
+                    f"min_applied={min_applied}); lag_ms={lag_ms}")
+        else:                             # follower: read-index
+            idx = part.read_index()
+            if idx is None:
+                # no leader reachable/confirmed: same walk contract as
+                # a leader change — the client tries the next replica
+                raise RpcError(
+                    f"part_leader_changed: {part.leader_id or ''}")
+            target = max(idx, min_applied)
+            if part.applied_index() < target:
+                stats().inc("read_index_waits")
+                rem = _cancel.remaining()
+                timeout = min(rem, 5.0) if rem is not None else 5.0
+                if not part.wait_applied(target,
+                                         timeout=max(timeout, 0.001)):
+                    raise RpcError(
+                        f"part_leader_changed: {part.leader_id or ''}")
+        stats().inc_labeled("follower_read_total", {"consistency": lvl})
+        _trace.record_phase("storage:follower_read", 0.0, part=pid,
+                            addr=self.my_addr, consistency=lvl,
+                            applied=part.applied_index())
+        cc = current_cost()
+        if cc is not None:
+            cc.add("follower_reads", 1)
         return part
 
     # -- write RPCs: {"space", "part", "cmds": [wire-encoded tuples]} -----
@@ -506,7 +633,12 @@ class StorageService:
                     cc.add("dedup_hits", 1)
                 if rec.get("err"):
                     raise RpcError(f"write apply failed: {rec['err']}")
-                return rec.get("n", len(p["cmds"]))
+                # applied index rides the ack (ISSUE 11): the original
+                # proposal is applied locally (_apply_committed above),
+                # so last_applied covers it — the caller's per-part
+                # read-your-writes floor even on the dedup-retry path
+                return {"n": rec.get("n", len(p["cmds"])),
+                        "applied": part.applied_index()}
             stamped = [wire.dumps(
                 ("v", ver, ["dbatch", pid, writer, seq,
                             [list(_validate_cmd(c)) for c in p["cmds"]]]))]
@@ -532,9 +664,12 @@ class StorageService:
             raise RpcError(f"write apply failed: {errs[0]}"
                            + (f" (+{len(errs) - 1} more)"
                               if len(errs) > 1 else ""))
-        return len(p["cmds"])
+        # the ack carries the write's raft index (propose_batch applies
+        # before returning): clients record it as the part's
+        # read-your-writes floor for follower/bounded_stale reads
+        return {"n": len(p["cmds"]), "applied": idxs[-1]}
 
-    # -- read RPCs (leader reads) ----------------------------------------
+    # -- read RPCs (consistency-gated via _read_part) --------------------
 
     def rpc_get_neighbors(self, p):
         """The storage exec DAG's scan stage + pushed-down filter/limit
@@ -543,7 +678,7 @@ class StorageService:
         wire — the candidate set never ships."""
         from .pushdown import apply_edge_filter, filter_from_wire
         space, pid = p["space"], p["part"]
-        self._leader_part(space, pid)
+        self._read_part(space, pid, p)
         vids = from_wire(p["vids"])
         edge_filter = filter_from_wire(p.get("filter"))
         limit = p.get("limit_per_src")
@@ -581,7 +716,7 @@ class StorageService:
         return rows
 
     def rpc_get_vertex(self, p):
-        self._leader_part(p["space"], p["part"])
+        self._read_part(p["space"], p["part"], p)
         tv = self.store.get_vertex(p["space"], from_wire(p["vid"]))
         if tv is None:
             return None
@@ -589,7 +724,7 @@ class StorageService:
                 for t, row in tv.items()}
 
     def rpc_get_edge(self, p):
-        self._leader_part(p["space"], p["part"])
+        self._read_part(p["space"], p["part"], p)
         row = self.store.get_edge(p["space"], from_wire(p["src"]),
                                   p["etype"], from_wire(p["dst"]),
                                   p.get("rank", 0))
@@ -605,7 +740,7 @@ class StorageService:
             cc.add("rows", n)
 
     def rpc_scan_vertices(self, p):
-        self._leader_part(p["space"], p["part"])
+        self._read_part(p["space"], p["part"], p)
         out = []
         for vid, tag, row in self.store.scan_vertices(
                 p["space"], p.get("tag"), parts=[p["part"]]):
@@ -615,7 +750,7 @@ class StorageService:
         return out
 
     def rpc_scan_edges(self, p):
-        self._leader_part(p["space"], p["part"])
+        self._read_part(p["space"], p["part"], p)
         out = []
         for src, et, rank, dst, row in self.store.scan_edges(
                 p["space"], p.get("etype"), parts=[p["part"]]):
@@ -625,7 +760,7 @@ class StorageService:
         return out
 
     def rpc_index_scan(self, p):
-        self._leader_part(p["space"], p["part"])
+        self._read_part(p["space"], p["part"], p)
         rng = p.get("range")
         if rng is not None:
             from ..graphstore.index import MAX, MIN
@@ -641,7 +776,7 @@ class StorageService:
                 for e in ents]
 
     def rpc_index_scan_geo(self, p):
-        self._leader_part(p["space"], p["part"])
+        self._read_part(p["space"], p["part"], p)
         ents = self.store.index_scan_geo(
             p["space"], p["index"], [tuple(r) for r in p["ranges"]],
             parts=[p["part"]])
@@ -686,7 +821,7 @@ class StorageService:
     def rpc_fulltext_search(self, p):
         """Text-search one part's slice of the full-text sink (SURVEY
         §2 row 10 Listener; the ES-query hop of the reference)."""
-        self._leader_part(p["space"], p["part"])
+        self._read_part(p["space"], p["part"], p)
         self._ft_catalog_sync(p)
         ents = self.store.fulltext_search(p["space"], p["index"],
                                           p["op"], p["pattern"],
@@ -711,10 +846,11 @@ class StorageService:
     def rpc_part_stats(self, p):
         if p.get("detail"):
             # per-schema counts are served authoritatively by the
-            # leader (a lagging follower would under-count); the plain
-            # totals/epoch probe stays follower-readable so device
+            # leader by default (a lagging follower would under-count)
+            # but honor an explicit weaker consistency; the plain
+            # totals/epoch probe stays replica-readable so device
             # epoch checks survive a failover window
-            self._leader_part(p["space"], p["part"])
+            self._read_part(p["space"], p["part"], p)
         sd = self.store.space(p["space"])
         pid = p["part"]
         part = sd.parts[pid]

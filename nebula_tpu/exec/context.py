@@ -28,6 +28,18 @@ class QueryContext:
         self.max_match_hops = int(self.params.get(
             "max_match_hops", get_config().get("max_match_hops")))
         self.tpu_runtime = None     # set by nebula_tpu.tpu when pinned
+        # write epoch (ISSUE 11): bumped once per successful mutating
+        # statement this engine executed — the result cache's data-
+        # freshness key half (the catalog version covers DDL).  Local
+        # by design: it is what lets cached hot reads keep answering
+        # while storage is unreachable, at the documented cost that
+        # writes issued through a DIFFERENT coordinator are invisible
+        # to it (docs/ROBUSTNESS.md §8).  Bump through
+        # bump_write_epoch(): a racy `+= 1` from concurrent statement
+        # threads could move the epoch BACKWARD and re-expose a stale
+        # cached result.
+        self.write_epoch = 0
+        self._epoch_mu = threading.Lock()
         # per-thread device-plane breadcrumbs: graphd serves concurrent
         # sessions through ONE engine/qctx, so a shared slot would
         # cross-attribute PROFILE stats between queries
@@ -48,6 +60,11 @@ class QueryContext:
     @last_tpu_fallback.setter
     def last_tpu_fallback(self, v):
         self._tls.tpu_fallback = v
+
+    def bump_write_epoch(self) -> int:
+        with self._epoch_mu:
+            self.write_epoch += 1
+            return self.write_epoch
 
     @property
     def catalog(self):

@@ -36,6 +36,15 @@ _define_flag("slow_log_capacity", 256,
              "slow-log entries retained per engine (ring buffer; the "
              "old unbounded list leaked one dict per slow query for "
              "the life of the process)")
+_define_flag("result_cache_size", 0,
+             "result-cache LRU entries per engine (0 = disabled, the "
+             "default — byte-identical to the pre-cache engine); "
+             "read-only statements are keyed like the plan cache PLUS "
+             "the engine's write epoch, so any DDL or mutating "
+             "statement through this engine structurally invalidates "
+             "every cached result.  Hot repeated reads then serve "
+             "from graphd memory — surviving even total storage "
+             "unavailability within an epoch")
 
 # read-only statement kinds whose plans are reusable verbatim: planning
 # depends only on (text, space, catalog) for these.  DML/DDL/admin
@@ -44,6 +53,20 @@ _define_flag("slow_log_capacity", 256,
 _CACHEABLE_KINDS = frozenset({
     "Go", "Match", "Lookup", "FetchVertices", "FetchEdges", "Yield",
     "FindPath", "GetSubgraph", "GroupBy", "Unwind"})
+
+# statement kinds that can NOT change graph data: they never bump the
+# engine's write epoch (ISSUE 11 result cache).  Everything else —
+# DML, DDL, jobs, balance, restore — bumps it once per successful
+# statement; over-bumping is always safe (a lost cache hit, never a
+# stale one), so the set is deliberately small and explicit.
+_NON_MUTATING_KINDS = _CACHEABLE_KINDS | frozenset({
+    "Use", "Explain", "Describe", "DescribeUser", "DescZone",
+    "GetConfigs", "OrderBy", "Limit", "Sample"})
+
+
+def _bumps_write_epoch(kind: str) -> bool:
+    return kind not in _NON_MUTATING_KINDS \
+        and not kind.startswith(("Show", "Kill"))
 
 
 class PlanCache:
@@ -107,6 +130,84 @@ class PlanCache:
             return len(self._map)
 
 
+class ResultCache:
+    """LRU of (statement text, space, schema epoch, device flag, WRITE
+    epoch) → the statement's wire-encoded result rows (ISSUE 11
+    tentpole, part 4).
+
+    Entries hold `to_wire(rs.data)` — the exact form that ships to a
+    client — and hits decode it back with `from_wire`, so a cached
+    reply is byte-identical to uncached execution and never aliases
+    mutable row lists between consumers.  Invalidation is structural,
+    exactly like the plan cache: DDL bumps the catalog version half of
+    the key, and every mutating statement through this engine —
+    including failed ones, whose non-atomic fan-out may have committed
+    some parts — bumps the write epoch half
+    (`QueryContext.write_epoch`), so
+    a stale result can never be LOOKED UP — it just ages out of the
+    LRU.  The payoff: a hot repeated read keeps answering from graphd
+    memory even when every storage replica is unreachable, as long as
+    no local write has bumped the epoch."""
+
+    def __init__(self):
+        self._map: "OrderedDict[Tuple, Tuple[Any, Optional[str]]]" = \
+            OrderedDict()
+        self._lock = threading.Lock()
+
+    @staticmethod
+    def capacity() -> int:
+        from ..utils.config import get_config
+        try:
+            return int(get_config().get("result_cache_size"))
+        except Exception:  # noqa: BLE001 — config not initialized
+            return 0
+
+    def get(self, key: Tuple):
+        from ..utils.stats import stats
+        with self._lock:
+            ent = self._map.get(key)
+            if ent is not None:
+                self._map.move_to_end(key)
+        if ent is not None:
+            stats().inc("result_cache_hits")
+        return ent
+
+    def put(self, key: Tuple, wire_data: Any, space: Optional[str]):
+        cap = self.capacity()
+        if cap <= 0:
+            return
+        from ..utils.stats import stats
+        # a put IS the miss (same scoping rationale as PlanCache.put:
+        # only statements that COULD have hit count against the rate)
+        stats().inc("result_cache_misses")
+        with self._lock:
+            self._map[key] = (wire_data, space)
+            self._map.move_to_end(key)
+            while len(self._map) > cap:
+                self._map.popitem(last=False)
+            n = len(self._map)
+        stats().gauge("result_cache_entries", n)
+
+    def note_invalidated(self):
+        """A write-epoch bump made every current entry unreachable —
+        count it (the `result_cache_invalidations` metric; a
+        dedup-window-replayed write still acks as ONE statement, so it
+        bumps — and counts — exactly once)."""
+        from ..utils.stats import stats
+        with self._lock:
+            n = len(self._map)
+        if n:
+            stats().inc("result_cache_invalidations")
+
+    def clear(self):
+        with self._lock:
+            self._map.clear()
+
+    def __len__(self):
+        with self._lock:
+            return len(self._map)
+
+
 class Session:
     def __init__(self, user: str = "root"):
         self.id = next(_session_ids)
@@ -148,6 +249,10 @@ class QueryEngine:
         # parse/plan LRU (ISSUE 2): repeated statements skip
         # parse → validate → plan → optimize entirely
         self.plan_cache = PlanCache()
+        # read-only result LRU (ISSUE 11): hot repeated reads skip
+        # execution entirely, invalidated by the same schema epoch plus
+        # the engine's write epoch (0-capacity default = disabled)
+        self.result_cache = ResultCache()
         # stall watchdog (ISSUE 9): idempotent start of the process-wide
         # scan thread; gated by stall_watchdog_interval_secs
         from ..utils.workload import stall_watchdog
@@ -190,7 +295,8 @@ class QueryEngine:
         """RUNNING-query rows with live progress (ISSUE 9) — the one
         source for SHOW [LOCAL] QUERIES and the graphd fan-out RPC.
         Row shape: [sid, qid, user, text, status, operator, rows,
-        duration_us, queue_us, device_us, host_us, memory_bytes]."""
+        duration_us, queue_us, device_us, host_us, memory_bytes,
+        consistency]."""
         from ..utils.workload import live_registry
         rows = []
         for s in list(self.sessions.values()):
@@ -202,11 +308,12 @@ class QueryEngine:
                                  p["operator"], p["rows"],
                                  p["duration_us"], p["queue_us"],
                                  p["device_us"], p["host_us"],
-                                 p["memory_bytes"]])
+                                 p["memory_bytes"],
+                                 p.get("consistency", "")])
                 else:
                     # workload plane disabled: identity columns only
                     rows.append([s.id, qid, s.user, qtext, "RUNNING",
-                                 "", 0, 0, 0, 0, 0, 0])
+                                 "", 0, 0, 0, 0, 0, 0, ""])
         return rows
 
     def kill_running(self, sid=None, qid=None) -> bool:
@@ -240,11 +347,14 @@ class QueryEngine:
     def _cache_key(self, session: Session, text: str) -> Optional[tuple]:
         """Plan-cache key for this statement in this session's context,
         or None when caching cannot apply: $var state makes planning
-        session-dependent, and a zero-capacity cache is disabled.  The
+        session-dependent, and zero-capacity caches are disabled.  The
         schema epoch (catalog version — bumped by EVERY DDL, including
         ALTER/CREATE TAG and index DDL) and the live device flag are
-        part of the key, so invalidation is structural, not evented."""
-        if PlanCache.capacity() <= 0 or session.var_cols:
+        part of the key, so invalidation is structural, not evented.
+        (Shared by the plan cache and, extended with the write epoch,
+        the result cache — either being enabled keeps the key alive.)"""
+        if (PlanCache.capacity() <= 0 and ResultCache.capacity() <= 0) \
+                or session.var_cols:
             return None
         from ..utils.config import get_config
         tpu_on = self.qctx.tpu_runtime is not None and \
@@ -262,12 +372,27 @@ class QueryEngine:
         session.last_used = time.time()
         from ..utils.stats import stats
         key = self._cache_key(session, text)
+        # result cache first (ISSUE 11): a hit skips parse AND
+        # execution — the write epoch in the key guarantees no local
+        # write or DDL has landed since the entry was built.  The USER
+        # is part of the key: a hit never runs the per-execute
+        # permission check (there is no parsed stmt to check), so rows
+        # cached by a privileged session must be unreachable to anyone
+        # else; role changes are DDL, so the catalog-version half of
+        # the key covers grants/revokes for the same user.
+        rkey = None
+        if key is not None and ResultCache.capacity() > 0:
+            rkey = key + (session.user, self.qctx.write_epoch)
+            ent = self.result_cache.get(rkey)
+            if ent is not None:
+                return self._result_cache_hit(session, text, ent, t0)
         if key is not None:
             ent = self.plan_cache.get(key)
             if ent is not None:
                 stmt, plan = ent
                 return self._execute_parsed(session, stmt, text, t0,
-                                            cached_plan=plan)
+                                            cached_plan=plan,
+                                            result_key=rkey)
         try:
             stmt = parse(text)
         except ParseError as ex:
@@ -297,7 +422,32 @@ class QueryEngine:
                     return res
             return res
         return self._execute_parsed(session, stmt, text, t0,
-                                    cache_key=key)
+                                    cache_key=key, result_key=rkey)
+
+    def _result_cache_hit(self, session: Session, text: str, ent,
+                          t0: float) -> ResultSet:
+        """Serve a statement from the result cache: decode the stored
+        wire form (byte-identical to what uncached execution ships) and
+        keep the statement-level accounting honest — it still counts in
+        /stats and leaves a flight-recorder entry."""
+        from ..core.wire import from_wire
+        from ..utils.flight import flight_recorder
+        from ..utils.stats import stats
+        wire_data, space = ent
+        data = from_wire(wire_data) if wire_data is not None else None
+        us = int((time.perf_counter() - t0) * 1e6)
+        stats().inc("num_queries")
+        stats().add_value("query_latency_us", us)
+        stats().observe("query_latency_us_hist", us,
+                        {"kind": "CachedRead"})
+        flight_recorder().record(
+            stmt=text, kind="CachedRead", latency_us=us, error=None,
+            trace_id=None, session=session.id, operators=[],
+            slow_us=self.slow_query_us)
+        if space:
+            session.space = space
+        return ResultSet(data, space=space, latency_us=us,
+                         comment="served from result cache")
 
     @staticmethod
     def _stmt_kind(stmt: A.Sentence) -> str:
@@ -311,7 +461,8 @@ class QueryEngine:
 
     def _execute_parsed(self, session: Session, stmt: A.Sentence,
                         text: str, t0: float, cached_plan=None,
-                        cache_key: Optional[tuple] = None) -> ResultSet:
+                        cache_key: Optional[tuple] = None,
+                        result_key: Optional[tuple] = None) -> ResultSet:
         """Metrics + tracing wrapper: every statement outcome (incl.
         semantic and execution errors) is visible in /stats; every
         statement produces one trace in the trace store, queryable via
@@ -340,6 +491,23 @@ class QueryEngine:
         stats().inc("num_queries")
         stats().add_value("query_latency_us", us)
         stats().observe("query_latency_us_hist", us, {"kind": kind})
+        if _bumps_write_epoch(kind):
+            # one bump per mutating statement, SUCCESS OR FAILURE — a
+            # failed multi-part write may still have committed some
+            # parts (fan-out is not atomic), so only statements that
+            # provably touched nothing may skip the bump.  A PR 5
+            # dedup-replayed write still acks as one statement, so it
+            # bumps (and invalidates the result cache) exactly once.
+            self.qctx.bump_write_epoch()
+            self.result_cache.note_invalidated()
+        if res.ok and result_key is not None and res.plan_desc is None \
+                and not isinstance(stmt, A.ExplainSentence) \
+                and kind in _CACHEABLE_KINDS:
+            from ..core.wire import to_wire
+            self.result_cache.put(
+                result_key,
+                to_wire(res.data) if res.data is not None else None,
+                res.space)
         slow_us = self.slow_query_us
         if not res.ok:
             stats().inc("num_query_errors")
@@ -461,11 +629,13 @@ class QueryEngine:
         # in SHOW QUERIES / GET /queries with live per-operator progress
         # from HERE until the finally below; the deadline rides along so
         # the stall watchdog can derive this statement's stall threshold
+        from ..utils.consistency import effective_consistency
         from ..utils.workload import live_registry
         live = live_registry().register(
             qid=qid, session=session.id, user=session.user, stmt=text,
             kind=self._stmt_kind(stmt), deadline=dl,
-            tracker=stmt_ectx.tracker)
+            tracker=stmt_ectx.tracker,
+            consistency=effective_consistency())
         stmt_ectx.live = live
         # admission control (ISSUE 10): a bounded-slot gate in front of
         # the scheduler — control statements bypass (priority lane),

@@ -1782,7 +1782,8 @@ def _show(node, qctx, ectx, space):
         # come straight from the engine's WorkloadRegistry rows
         qcols = ["SessionId", "ExecutionPlanId", "User", "Query",
                  "Status", "Operator", "Rows", "DurationUs", "QueueUs",
-                 "DeviceUs", "HostUs", "MemoryBytes", "GraphAddr"]
+                 "DeviceUs", "HostUs", "MemoryBytes", "Consistency",
+                 "GraphAddr"]
         cluster = getattr(qctx, "cluster", None)
         if a.get("extra") == "local":
             cluster = None      # SHOW LOCAL QUERIES: this graphd only

@@ -128,6 +128,11 @@ class Scheduler:
         from ..utils.failpoints import fail as _fail
         from ..utils.workload import use_live
         live = getattr(ectx, "live", None)
+        # snapshot the statement's read-consistency override too
+        # (ISSUE 11): a parallel branch's storage reads must run at the
+        # same level the submitting thread's use_consistency() installed
+        from ..utils import consistency as _consistency
+        c_lvl = _consistency.current_override()
 
         def exec_one(node: PlanNode):
             kill = getattr(ectx, "kill_event", None)
@@ -154,6 +159,7 @@ class Scheduler:
                         use_work(getattr(ectx, "work", None)), \
                         use_cost(node_cost), \
                         use_live(live), \
+                        _consistency.use_consistency(c_lvl), \
                         trace.span(f"exec:{node.kind}", node=node.id) as rec:
                     # deadline check between plan nodes: a budget spent
                     # in an earlier node must not start the next one

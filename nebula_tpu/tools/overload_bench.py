@@ -26,9 +26,16 @@ proof).  Per level:
 Usage:
     python -m nebula_tpu.tools.overload_bench
     python -m nebula_tpu.tools.overload_bench --persons 4000 --duration 5
+    python -m nebula_tpu.tools.overload_bench --read-scaleout
 
 Emits one JSON object on stdout; bench.py folds the curve into its
 `overload` block (goodput_4x_vs_1x is the acceptance number: ≥ 0.7).
+
+`--read-scaleout` (ISSUE 11) runs the goodput-vs-replica-count sweep
+instead — 1 storaged / rf=1 leader-only vs 3 storaged / rf=3 at
+follower consistency under the same per-replica read capacity
+(`storage_read_capacity_qps`); bench.py folds it into `read_scaleout`
+(qps_3r_vs_1r is the acceptance number: ≥ 2.0).
 """
 from __future__ import annotations
 
@@ -304,6 +311,222 @@ def run_sweep(persons: int = 1200, degree: int = 5,
             shutil.rmtree(tmp, ignore_errors=True)
 
 
+# -- read scale-out sweep (ISSUE 11) ----------------------------------------
+
+
+def _read_level(cluster, space, stmt_of, threads: int,
+                duration_s: float) -> _LevelResult:
+    """One closed-loop read level: `threads` workers for `duration_s`."""
+    res = _LevelResult()
+    ths = [threading.Thread(target=_worker,
+                            args=(cluster, space, stmt_of, duration_s,
+                                  i, res))
+           for i in range(threads)]
+    t0 = time.perf_counter()
+    for t in ths:
+        t.start()
+    for t in ths:
+        t.join()
+    res.wall = time.perf_counter() - t0       # type: ignore[attr-defined]
+    return res
+
+
+def _seed_read_graph(cluster, space: str, persons: int, degree: int,
+                     replica_factor: int):
+    import numpy as np
+    cl = cluster.client()
+    assert cl.execute(
+        f"CREATE SPACE {space}(partition_num=8, "
+        f"replica_factor={replica_factor}, vid_type=INT64)").error is None
+    cluster.reconcile_storage()
+    for q in (f"USE {space}", "CREATE TAG Person(age int)",
+              "CREATE EDGE KNOWS(w int)"):
+        assert cl.execute(q).error is None, q
+    rng = np.random.default_rng(47)
+    B = 400
+    for lo in range(0, persons, B):
+        vals = ", ".join(f"{v}:({v % 90})"
+                         for v in range(lo, min(lo + B, persons)))
+        assert cl.execute(
+            f"INSERT VERTEX Person(age) VALUES {vals}").error is None
+    src = rng.integers(0, persons, persons * degree)
+    dst = rng.integers(0, persons, persons * degree)
+    keep = src != dst
+    src, dst = src[keep], dst[keep]
+    for lo in range(0, src.size, B):
+        vals = ", ".join(f"{s}->{d}:({int(s + d) % 100})"
+                         for s, d in zip(src[lo:lo + B].tolist(),
+                                         dst[lo:lo + B].tolist()))
+        assert cl.execute(
+            f"INSERT EDGE KNOWS(w) VALUES {vals}").error is None
+    cl.close()
+
+
+def read_scaleout_sweep(persons: int = 1000, degree: int = 5,
+                        threads: int = 12, duration_s: float = 3.0,
+                        read_capacity_qps: int = 120,
+                        tpu_runtime=None,
+                        data_dir: Optional[str] = None) -> dict:
+    """Goodput-vs-replica-count on a read-heavy mix (ROADMAP item 5 /
+    ISSUE 11 acceptance): the SAME offered read load and the SAME
+    per-replica read capacity (`storage_read_capacity_qps` — a token
+    bucket per storaged that sheds over-rate reads with the PR 8
+    E_OVERLOAD + retry-after contract) against
+
+      * a 1-storaged / replica_factor=1 cluster, leader-only reads —
+        one replica's capacity is ALL the read capacity, and a shed
+        client can only wait it out;
+      * a 3-storaged / replica_factor=3 cluster at `follower`
+        consistency — load-aware routing walks a shed read to a
+        sibling replica with spare tokens, aggregating 3 replicas'
+        capacity.
+
+    The capacity model is explicit and honest: an in-process cluster
+    shares one interpreter, so raw CPU throughput cannot scale with
+    replica count on a small host — what CAN and does scale is
+    admitted capacity, which is what replica scale-out buys a real
+    deployment.  The acceptance number is `qps_3r_vs_1r` (bar:
+    >= 2.0).  Also measured on the 3-replica cluster: read QPS per
+    consistency level (capacity off — the pure CPU view), the
+    follower-read share, time-to-first-successful-read after a hard
+    leader kill, and the result cache serving a hot repeated read
+    byte-identical to uncached execution."""
+    import shutil as _shutil
+
+    from nebula_tpu.cluster.launcher import LocalCluster
+    from nebula_tpu.utils.config import get_config
+    from nebula_tpu.utils.stats import stats
+
+    cfg = get_config()
+    tmp = data_dir or tempfile.mkdtemp(prefix="nebula_readscale_")
+    out: Dict[str, dict] = {}
+    dyn_keys = ("storage_read_capacity_qps", "read_consistency",
+                "result_cache_size", "query_timeout_secs")
+
+    def stmt_of(wid: int, j: int) -> str:
+        seed = (wid * 131 + j * 17) % persons
+        return f"GO FROM {seed} OVER KNOWS YIELD dst(edge) AS d"
+
+    try:
+        for label, (n_storage, rf, level) in {
+                "1r_leader": (1, 1, "leader"),
+                "3r_follower": (3, 3, "follower")}.items():
+            cluster = LocalCluster(n_meta=1, n_storage=n_storage,
+                                   n_graph=1, data_dir=f"{tmp}/{label}",
+                                   tpu_runtime=tpu_runtime)
+            try:
+                _seed_read_graph(cluster, "rs", persons, degree, rf)
+                warm = cluster.client()
+                warm.execute("USE rs")
+                warm.execute(stmt_of(0, 0))
+                warm.close()
+                cfg.set_dynamic_many({
+                    "storage_read_capacity_qps": read_capacity_qps,
+                    "read_consistency": level,
+                    "query_timeout_secs": max(duration_s * 4, 10.0),
+                })
+                fr0 = sum(v for k, v in stats().snapshot().items()
+                          if k.startswith("follower_read_total"))
+                res = _read_level(cluster, "rs", stmt_of, threads,
+                                  duration_s)
+                fr1 = sum(v for k, v in stats().snapshot().items()
+                          if k.startswith("follower_read_total"))
+                res.lats.sort()
+                wall = getattr(res, "wall", duration_s)
+                out[label] = {
+                    "storageds": n_storage,
+                    "replica_factor": rf,
+                    "consistency": level,
+                    "workers": threads,
+                    "goodput_qps": round(res.ok / wall, 1) if wall else 0,
+                    "ok": res.ok,
+                    "errors": len(res.errors),
+                    "error_sample": res.errors[:3],
+                    "p50_ms": round(_percentile(res.lats, 50) * 1e3, 2),
+                    "p99_ms": round(_percentile(res.lats, 99) * 1e3, 2),
+                    "follower_read_share": round(
+                        (fr1 - fr0) / max(res.ok, 1), 3),
+                }
+                if label != "3r_follower":
+                    continue
+                # -- per-consistency-level QPS on the 3-replica
+                # cluster, capacity model OFF (the pure CPU view)
+                with cfg.lock:
+                    cfg.dynamic_layer.pop("storage_read_capacity_qps",
+                                          None)
+                per_level = {}
+                for lvl in ("leader", "follower", "bounded_stale"):
+                    cfg.set_dynamic("read_consistency", lvl)
+                    r = _read_level(cluster, "rs", stmt_of,
+                                    max(threads // 2, 2),
+                                    max(duration_s / 2, 1.0))
+                    w = getattr(r, "wall", 1.0)
+                    per_level[lvl] = {
+                        "qps": round(r.ok / w, 1) if w else 0,
+                        "errors": len(r.errors)}
+                out["qps_by_consistency"] = per_level
+                # -- result cache: hot repeated read, byte-identical --
+                cfg.set_dynamic_many({"read_consistency": "follower",
+                                      "result_cache_size": 64})
+                cl = cluster.client()
+                cl.execute("USE rs")
+                hot = stmt_of(1, 1)
+                h0 = stats().snapshot().get("result_cache_hits", 0)
+                r1 = cl.execute(hot)
+                r2 = cl.execute(hot)
+                h1 = stats().snapshot().get("result_cache_hits", 0)
+                out["result_cache"] = {
+                    "hits": int(h1 - h0),
+                    "rows_identical": (
+                        r1.error is None and r2.error is None
+                        and sorted(map(tuple, r1.data.rows))
+                        == sorted(map(tuple, r2.data.rows))),
+                }
+                with cfg.lock:
+                    cfg.dynamic_layer.pop("result_cache_size", None)
+                # -- time-to-first-successful-read after leader kill --
+                lead = max(range(len(cluster.storageds)), key=lambda i: sum(
+                    1 for pp in cluster.storageds[i].parts.values()
+                    if pp.is_leader()))
+                cl2 = cluster.client()
+                cl2.execute("USE rs")
+                cluster.stop_storaged(lead)
+                t0 = time.perf_counter()
+                ttfr = None
+                deadline = time.perf_counter() + 30
+                j = 0
+                while time.perf_counter() < deadline:
+                    r = cl2.execute(stmt_of(3, j))
+                    j += 1
+                    if r.error is None:
+                        ttfr = time.perf_counter() - t0
+                        break
+                out["leader_kill"] = {
+                    "time_to_first_read_ms": round(ttfr * 1e3, 1)
+                    if ttfr is not None else None,
+                }
+                cl.close()
+                cl2.close()
+            finally:
+                with cfg.lock:
+                    for k in dyn_keys:
+                        cfg.dynamic_layer.pop(k, None)
+                cluster.stop()
+        g1 = out["1r_leader"]["goodput_qps"]
+        g3 = out["3r_follower"]["goodput_qps"]
+        out["qps_3r_vs_1r"] = round(g3 / g1, 3) if g1 else None
+        out["persons"] = persons
+        out["degree"] = degree
+        out["read_capacity_qps_per_replica"] = read_capacity_qps
+        out["duration_per_level_s"] = duration_s
+        return out
+    finally:
+        from nebula_tpu.utils.admission import admission
+        admission().reset()
+        if data_dir is None:
+            _shutil.rmtree(tmp, ignore_errors=True)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--persons", type=int, default=1200)
@@ -316,7 +539,16 @@ def main(argv=None) -> int:
                     help="max_running_queries for the sweep")
     ap.add_argument("--queue-capacity", type=int, default=None)
     ap.add_argument("--inbox-capacity", type=int, default=0)
+    ap.add_argument("--read-scaleout", action="store_true",
+                    help="run the replica-count read sweep instead of "
+                         "the offered-load sweep")
     args = ap.parse_args(argv)
+    if args.read_scaleout:
+        print(json.dumps(read_scaleout_sweep(
+            persons=args.persons, degree=args.degree,
+            threads=max(args.threads * 2, 8),
+            duration_s=args.duration), indent=1))
+        return 0
     print(json.dumps(run_sweep(
         persons=args.persons, degree=args.degree,
         cal_threads=args.threads, duration_s=args.duration,

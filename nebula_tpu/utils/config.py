@@ -193,6 +193,15 @@ define_flag("enable_query_tracing", True,
 define_flag("tpu_profiler_dir", "",
             "when set, wrap every device kernel run in a jax.profiler "
             "trace written under this directory (SURVEY §5 tracing)")
+define_flag("storage_read_capacity_qps", 0,
+            "per-storaged read admission rate (reads/s, token bucket; "
+            "0 = unlimited).  Reads beyond the rate are shed with the "
+            "structured E_OVERLOAD + retry-after contract (PR 8), so "
+            "follower-readable clients walk to a replica with spare "
+            "capacity instead of waiting.  Production use: cap a "
+            "replica's read load during backfill/compaction; bench "
+            "use: model per-replica capacity for the read scale-out "
+            "sweep on hosts whose cores can't isolate replicas")
 define_flag("snapshot_dir", "./nebula_snapshots",
             "where CREATE SNAPSHOT checkpoints land")
 define_flag("backup_dir", "./nebula_backups",

@@ -76,11 +76,11 @@ class LiveQuery:
     __slots__ = ("qid", "session", "user", "stmt", "kind", "t0", "m0",
                  "deadline", "node_kind", "node_id", "nodes_done",
                  "rows", "queue_us", "device_us", "dispatches",
-                 "tracker", "killed", "queued", "_lock")
+                 "tracker", "killed", "queued", "consistency", "_lock")
 
     def __init__(self, qid: int, session: int, user: str, stmt: str,
                  kind: str, deadline: Optional[float] = None,
-                 tracker=None):
+                 tracker=None, consistency: str = "leader"):
         self.qid = qid
         self.session = session
         self.user = user
@@ -99,6 +99,10 @@ class LiveQuery:
         self.tracker = tracker            # MemoryTracker (bytes charged)
         self.killed = False
         self.queued = False               # waiting in the admission queue
+        # the statement's effective read-consistency level (ISSUE 11):
+        # surfaced in SHOW QUERIES so an operator can see which reads
+        # are leader-bound vs replica-spread at a glance
+        self.consistency = consistency
         self._lock = threading.Lock()
 
     # -- scheduler hooks (one per plan node) -----------------------------
@@ -144,6 +148,7 @@ class LiveQuery:
             "host_us": host_us,
             "dispatches": self.dispatches,
             "memory_bytes": int(getattr(self.tracker, "used", 0) or 0),
+            "consistency": self.consistency,
         }
 
 

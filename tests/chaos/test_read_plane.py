@@ -1,0 +1,178 @@
+"""Read-plane chaos (ISSUE 11): a mixed-consistency read storm rides
+through a leader kill and a replication partition/heal while writes
+run concurrently.  Asserts:
+
+  * follower + bounded_stale reads keep succeeding (>= 99%) during the
+    election the leader kill forces — with ZERO wrong rows vs the
+    static oracle;
+  * `leader`-consistency reads are never stale vs a sequential oracle
+    (a monotonic counter: a read started after the k-th ack must
+    observe >= k);
+  * PR 5 acked-exactly-once still holds for the concurrent writes.
+
+Marked `chaos` + `slow`: NOT part of the tier-1 gate.  Reproduce with
+the seed in the test (the storm's vid choices and the fault schedule
+draw from it).
+"""
+import random
+import threading
+import time
+
+import pytest
+
+from nebula_tpu.utils.consistency import use_consistency
+from nebula_tpu.utils.failpoints import FaultSchedule, fail
+from nebula_tpu.utils.stats import stats
+
+from harness import (ChaosCluster, assert_acked_exactly_once,
+                     counter_value, mixed_workload)
+
+pytestmark = [pytest.mark.chaos, pytest.mark.slow]
+
+SEED = 707
+
+
+class _ReadStorm:
+    """Reader threads at one consistency level against the graphd's
+    DistributedStore (the thread-local override scopes the level to
+    each thread)."""
+
+    def __init__(self, ds, space, level, oracle, stop):
+        self.ds = ds
+        self.space = space
+        self.level = level
+        self.oracle = oracle            # vid → age (static during storm)
+        self.stop = stop
+        self.ok = 0
+        self.failed = 0
+        self.wrong = []
+        self.thread = threading.Thread(target=self._run, daemon=True)
+
+    def _run(self):
+        rng = random.Random(f"{SEED}:{self.level}")
+        vids = sorted(self.oracle)
+        with use_consistency(self.level):
+            while not self.stop.is_set():
+                vid = rng.choice(vids)
+                try:
+                    tv = self.ds.get_vertex(self.space, vid)
+                except Exception:  # noqa: BLE001 — counted, not raised
+                    self.failed += 1
+                    continue
+                age = (tv or {}).get("Person", {}).get("age")
+                if age == self.oracle[vid]:
+                    self.ok += 1
+                else:
+                    self.wrong.append((vid, age))
+
+
+def test_read_storm_survives_leader_kill_and_partition(tmp_path):
+    cc = ChaosCluster(data_dir=str(tmp_path / "c"), n_storage=3,
+                      parts=4, replica_factor=3)
+    try:
+        # static oracle rows: never touched during the storm
+        oracle = {}
+        vals = []
+        for k in range(48):
+            vid = 100 + k
+            age = (k * 13) % 97 + 1
+            oracle[vid] = age
+            vals.append(f'{vid}:("p{vid}",{age})')
+        cc.ok("INSERT VERTEX Person(name, age) VALUES " + ", ".join(vals))
+        cc.ok("INSERT VERTEX Counter(n) VALUES 900:(0)")
+        cc.wait_replicas_converged(require=3)
+
+        ds = cc.cluster.graphds[0].store
+        stop = threading.Event()
+        storms = [_ReadStorm(ds, cc.space, lvl, oracle, stop)
+                  for lvl in ("follower", "bounded_stale")]
+        for st in storms:
+            st.thread.start()
+
+        # sequential oracle: a leader read started after the k-th acked
+        # increment must observe >= k (never stale)
+        seq = {"acked": 0, "viol": [], "reads": 0, "werrs": 0}
+        wstop = threading.Event()
+
+        def writer():
+            while not wstop.is_set():
+                r = cc.run("UPDATE VERTEX ON Counter 900 SET n = n + 1")
+                if r.error is None:
+                    seq["acked"] += 1
+                else:
+                    seq["werrs"] += 1
+
+        def leader_reader():
+            while not wstop.is_set():
+                floor = seq["acked"]        # acked BEFORE the read began
+                r = cc.run("FETCH PROP ON Counter 900 "
+                           "YIELD Counter.n AS n")
+                if r.error is None and r.data.rows:
+                    seq["reads"] += 1
+                    n = int(r.data.rows[0][0])
+                    if n < floor:
+                        seq["viol"].append((n, floor))
+                time.sleep(0.01)
+
+        wt = threading.Thread(target=writer, daemon=True)
+        lt = threading.Thread(target=leader_reader, daemon=True)
+        wt.start()
+        lt.start()
+
+        # concurrent PR 5 ledgered writes for the exactly-once check
+        led_box = {}
+
+        def ledger_writes():
+            led_box["led"] = mixed_workload(cc, seed=SEED, n_writes=40,
+                                            vid_base=4000)
+        mt = threading.Thread(target=ledger_writes, daemon=True)
+        mt.start()
+
+        time.sleep(1.0)                 # storm reaches steady state
+        # -- fault 1: kill the storaged leading the most parts --------
+        victim = cc.leader_of_most_parts()
+        cc.kill_storaged(victim)
+        time.sleep(2.0)                 # election + walk window
+        # -- fault 2: replication partition, then heal ----------------
+        sched = FaultSchedule(SEED, [
+            {"fp": "raft:replicate", "action": "raise", "p": 0.4,
+             "key": "p", "max": 40},
+        ]).arm(fail)
+        time.sleep(1.5)
+        sched.disarm(fail)              # heal
+        time.sleep(1.5)
+
+        stop.set()
+        wstop.set()
+        for st in storms:
+            st.thread.join(10)
+        wt.join(20)
+        lt.join(10)
+        mt.join(30)
+
+        # -- invariants ----------------------------------------------
+        for st in storms:
+            total = st.ok + st.failed + len(st.wrong)
+            assert total >= 20, f"{st.level}: storm too weak ({total})"
+            assert not st.wrong, f"{st.level}: WRONG rows: {st.wrong[:5]}"
+            rate = st.ok / total
+            assert rate >= 0.99, \
+                f"{st.level}: success {st.ok}/{total} = {rate:.3f} < 99%"
+        assert seq["reads"] >= 10, "leader-read oracle starved"
+        assert not seq["viol"], \
+            f"leader reads served STALE values: {seq['viol'][:5]}"
+        # follower machinery demonstrably engaged
+        snap = stats().snapshot()
+        fr = sum(v for k, v in snap.items()
+                 if k.startswith("follower_read_total"))
+        assert fr >= 20, f"follower read path barely used ({fr})"
+        # exactly-once for the concurrent ledgered writes
+        assert_acked_exactly_once(cc, led_box["led"])
+        # the sequential counter converged to its acked count exactly
+        # (failed UPDATEs may or may not have landed — bound both ways)
+        n = counter_value(cc, 900)
+        assert seq["acked"] <= n <= seq["acked"] + seq["werrs"], \
+            (n, seq["acked"], seq["werrs"])
+        cc.wait_replicas_converged(require=2)
+    finally:
+        cc.stop()

@@ -1,0 +1,391 @@
+"""Read-path fault tolerance (ISSUE 11): read-index / lease follower
+reads, the lease clock-skew margin, load-aware replica routing, and the
+leader-hint write-back into the cached part map."""
+import time
+
+import pytest
+
+from nebula_tpu.cluster.launcher import LocalCluster
+from nebula_tpu.cluster.raft import LoopbackTransport, RaftPart
+from nebula_tpu.cluster.rpc import RpcClient, RpcError, reset_breakers
+from nebula_tpu.cluster.storage_client import (
+    note_peer_latency, note_peer_overload, peer_score, reset_peer_stats)
+from nebula_tpu.utils.config import get_config
+from nebula_tpu.utils.consistency import use_consistency
+from nebula_tpu.utils.failpoints import fail
+from nebula_tpu.utils.stats import stats
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    fail.reset()
+    reset_breakers()
+    reset_peer_stats()
+    yield
+    fail.reset()
+    reset_breakers()
+    reset_peer_stats()
+    for k in ("read_consistency", "read_max_stale_ms",
+              "raft_lease_margin_ms", "result_cache_size"):
+        get_config().dynamic_layer.pop(k, None)
+
+
+# -- raft-level read_index ---------------------------------------------------
+
+
+def _loopback_group(tmp_path, n=3, group="ri"):
+    tr = LoopbackTransport()
+    nodes = {}
+    ids = ["a", "b", "c", "d", "e"][:n]
+    for nid in ids:
+        nodes[nid] = RaftPart(group, nid, list(ids), tr,
+                              str(tmp_path / nid),
+                              apply_cb=lambda i, d: None, wal_sync=False)
+    for node in nodes.values():
+        node.start()
+    deadline = time.time() + 5
+    leader = None
+    while time.time() < deadline and leader is None:
+        leader = next((x for x in nodes.values() if x.is_leader()), None)
+        time.sleep(0.05)
+    assert leader is not None, "no leader elected"
+    return tr, nodes, leader
+
+
+def test_read_index_leader_lease_fast_path(tmp_path):
+    tr, nodes, leader = _loopback_group(tmp_path)
+    try:
+        time.sleep(0.3)                      # settle heartbeat acks
+        assert leader.propose(b"x") is not None
+        before = stats().snapshot().get(
+            'raft_read_index{path=lease}', 0)
+        idx = leader.read_index()
+        assert idx is not None and idx >= leader.commit_index - 1
+        # the barrier covers everything committed before the call
+        assert idx >= 1
+        after = stats().snapshot().get('raft_read_index{path=lease}', 0)
+        assert after == before + 1, "lease fast path not taken"
+    finally:
+        for n in nodes.values():
+            n.stop()
+
+
+def test_read_index_follower_forwards_and_waits(tmp_path):
+    applied = {nid: [] for nid in ("a", "b", "c")}
+    tr = LoopbackTransport()
+    nodes = {}
+    for nid in ("a", "b", "c"):
+        nodes[nid] = RaftPart(
+            "rif", nid, ["a", "b", "c"], tr, str(tmp_path / nid),
+            apply_cb=(lambda i, d, _n=nid: applied[_n].append(d)),
+            wal_sync=False)
+    for n in nodes.values():
+        n.start()
+    deadline = time.time() + 5
+    leader = None
+    while time.time() < deadline and leader is None:
+        leader = next((x for x in nodes.values() if x.is_leader()), None)
+        time.sleep(0.05)
+    assert leader is not None
+    try:
+        assert leader.propose(b"w1") is not None
+        follower = next(n for n in nodes.values() if n is not leader)
+        idx = follower.read_index()
+        assert idx is not None and idx >= 1
+        # a follower read observes everything committed before it began
+        assert follower.wait_applied(idx, timeout=5.0)
+        assert b"w1" in applied[follower.node_id]
+    finally:
+        for n in nodes.values():
+            n.stop()
+
+
+def test_read_index_quorum_fallback_without_lease(tmp_path):
+    """With the lease margin >= the election timeout the lease fast
+    path is disabled — read_index must still answer via a live quorum
+    round."""
+    tr, nodes, leader = _loopback_group(tmp_path, group="riq")
+    try:
+        assert leader.propose(b"q1") is not None
+        get_config().set_dynamic("raft_lease_margin_ms", 10_000.0)
+        assert not leader.has_lease(), \
+            "margin >= election timeout must kill the lease"
+        before = stats().snapshot().get(
+            'raft_read_index{path=quorum}', 0)
+        idx = leader.read_index()
+        assert idx is not None and idx >= 1
+        after = stats().snapshot().get(
+            'raft_read_index{path=quorum}', 0)
+        assert after == before + 1, "quorum confirm path not taken"
+    finally:
+        get_config().dynamic_layer.pop("raft_lease_margin_ms", None)
+        for n in nodes.values():
+            n.stop()
+
+
+def test_deposed_leader_rejects_lease_and_read_index(tmp_path):
+    """ISSUE 11 satellite: a minority-side ex-leader must refuse lease
+    reads within the margined window AND fail read_index (its quorum
+    confirm cannot complete), while the majority side elects a leader
+    that serves."""
+    tr, nodes, leader = _loopback_group(tmp_path, group="rid")
+    try:
+        others = [n for n in nodes.values() if n is not leader]
+        tr.partition(leader.node_id, others[0].node_id)
+        tr.partition(leader.node_id, others[1].node_id)
+        # the margined lease window is eto_min - margin: the ex-leader
+        # must stop serving lease reads no later than that
+        margin_s = leader._lease_margin_s()
+        deadline = time.time() + 5
+        while time.time() < deadline and leader.has_lease():
+            time.sleep(0.01)
+        assert not leader.has_lease()
+        # ... and read_index on the deposed side must NOT answer (no
+        # lease, no quorum)
+        assert leader.read_index(timeout=0.5) is None
+        # the majority side elects a new leader that serves read_index
+        deadline = time.time() + 5
+        new_leader = None
+        while time.time() < deadline and new_leader is None:
+            new_leader = next((n for n in others if n.is_leader()), None)
+            time.sleep(0.05)
+        assert new_leader is not None, "majority never re-elected"
+        assert new_leader.read_index() is not None
+        assert margin_s > 0, "default lease margin must be non-zero"
+    finally:
+        for n in nodes.values():
+            n.stop()
+
+
+def test_read_index_failpoint_site(tmp_path):
+    tr, nodes, leader = _loopback_group(tmp_path, group="rfp")
+    try:
+        fail.arm("raft:read_index", "raise(down)")
+        assert leader.read_index() is None
+        fail.disarm("raft:read_index")
+        time.sleep(0.2)
+        assert leader.read_index() is not None
+    finally:
+        for n in nodes.values():
+            n.stop()
+
+
+# -- replica routing scores --------------------------------------------------
+
+
+def test_peer_scores_steer_away_from_overload_and_latency():
+    note_peer_latency("h1:1", 0.002)
+    note_peer_latency("h2:1", 0.200)
+    assert peer_score("h1:1") < peer_score("h2:1")
+    # an E_OVERLOAD hint penalizes the peer for its retry-after window
+    note_peer_overload("h1:1", 2.0)
+    assert peer_score("h1:1") > peer_score("h2:1")
+    # the penalty decays with the window
+    note_peer_overload("h3:1", 0.0)
+    time.sleep(0.01)
+    assert peer_score("h3:1") < peer_score("h1:1")
+
+
+def test_route_orders_follower_reads_by_score():
+    from nebula_tpu.cluster.storage_client import StorageClient
+
+    class _Meta:
+        pass
+    sc = StorageClient.__new__(StorageClient)
+    note_peer_latency("r1:1", 0.5)
+    note_peer_latency("r2:1", 0.001)
+    replicas = ["r1:1", "r2:1", "r3:1"]
+    assert sc._route(replicas, follower_ok=False) == replicas
+    ranked = sc._route(replicas, follower_ok=True)
+    assert ranked[0] in ("r2:1", "r3:1") and ranked[-1] == "r1:1"
+
+
+# -- leader-hint write-back --------------------------------------------------
+
+
+def test_parts_of_applies_leader_hint_overlay():
+    from nebula_tpu.cluster.meta_client import MetaClient
+    mc = MetaClient(["never:1"], heartbeat_interval=999.0)
+    mc.part_map = {"sp": [["a:1", "b:1", "c:1"], ["a:1", "b:1", "c:1"]]}
+    mc.note_part_leader("sp", 1, "c:1")
+    pm = mc.parts_of("sp")
+    assert pm[0] == ["a:1", "b:1", "c:1"]
+    assert pm[1] == ["c:1", "a:1", "b:1"]
+    # a hint whose addr left the replica set is ignored
+    mc.note_part_leader("sp", 0, "gone:9")
+    mc.part_map["sp"][0] = ["a:1", "b:1"]
+    assert mc.parts_of("sp")[0] == ["a:1", "b:1"]
+    # garbage hints never land
+    mc2 = MetaClient(["never:1"], heartbeat_interval=999.0)
+    mc2.note_part_leader("sp", 0, "")
+    mc2.note_part_leader("sp", 0, "noport")
+    assert ("sp", 0) not in mc2._part_hints
+
+
+def _part_and_leader(cluster, space, pid):
+    sid = cluster.storageds[0].meta.catalog.get_space(space).space_id
+    for ss in cluster.storageds:
+        part = ss.parts.get((sid, pid))
+        if part is not None and part.is_leader():
+            return ss, part
+    return None, None
+
+
+@pytest.mark.slow
+def test_leader_hint_write_back_one_walk_per_failover(tmp_path):
+    """The regression the satellite names: after a leadership move the
+    FIRST statement pays the replica walk and writes the hint back;
+    the next statement routes straight to the new leader — one walk
+    total, not one per call."""
+    c = LocalCluster(n_meta=1, n_storage=3, n_graph=1,
+                     data_dir=str(tmp_path))
+    try:
+        cl = c.client()
+        assert cl.execute("CREATE SPACE hint(partition_num=1, "
+                          "replica_factor=3, vid_type=INT64)").error is None
+        c.reconcile_storage()
+        for q in ("USE hint", "CREATE TAG P(x int)",
+                  "INSERT VERTEX P(x) VALUES 1:(7)"):
+            r = cl.execute(q)
+            assert r.error is None, (q, r.error)
+
+        meta = c.graphds[0].meta
+        deadline = time.time() + 10
+        ss = part = None
+        while time.time() < deadline and part is None:
+            ss, part = _part_and_leader(c, "hint", 0)
+            if part is None:
+                time.sleep(0.05)
+        assert part is not None, "part 0 never elected a leader"
+        # move leadership to a replica that is neither the current
+        # leader nor the address the client would try FIRST (the hint
+        # overlay / map front) — so the next read must walk exactly once
+        first_tried = meta.parts_of("hint")[0][0]
+        candidates = [a for a in meta.parts_of("hint")[0]
+                      if a not in (first_tried, ss.my_addr)]
+        assert candidates, "need a third replica to transfer to"
+        target = candidates[0]
+        assert part.transfer_leadership(target), "transfer failed"
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            ss2, p2 = _part_and_leader(c, "hint", 0)
+            if ss2 is not None and ss2.my_addr == target:
+                break
+            time.sleep(0.05)
+        assert ss2 is not None and ss2.my_addr == target
+
+        def walks():
+            return sum(v for k, v in stats().snapshot().items()
+                       if k.startswith("storage_replica_walk_retries"))
+
+        q = "FETCH PROP ON P 1 YIELD P.x AS x"
+        w0 = walks()
+        r = cl.execute(q)
+        assert r.error is None and r.data.rows == [[7]]
+        w1 = walks()
+        assert w1 > w0, "failover read should have walked once"
+        # the hint is written back: the NEXT statement goes straight
+        r = cl.execute(q)
+        assert r.error is None and r.data.rows == [[7]]
+        w2 = walks()
+        assert w2 == w1, \
+            f"second statement re-walked ({w2 - w1} extra walks) — " \
+            f"leader hint was not written back"
+        assert meta.parts_of("hint")[0][0] == target
+    finally:
+        c.stop()
+
+
+# -- storaged consistency levels over a live cluster -------------------------
+
+
+@pytest.fixture(scope="module")
+def rcluster(tmp_path_factory):
+    fail.reset()
+    reset_breakers()
+    c = LocalCluster(n_meta=1, n_storage=3, n_graph=1,
+                     data_dir=str(tmp_path_factory.mktemp("rp")))
+    cl = c.client()
+    assert cl.execute("CREATE SPACE rp(partition_num=2, "
+                      "replica_factor=3, vid_type=INT64)").error is None
+    c.reconcile_storage()
+    for q in ("USE rp", "CREATE TAG Person(age int)",
+              "INSERT VERTEX Person(age) VALUES 1:(11), 2:(22), 3:(33)"):
+        r = cl.execute(q)
+        assert r.error is None, (q, r.error)
+    yield c, cl
+    c.stop()
+
+
+def test_follower_reads_serve_and_count(rcluster):
+    c, cl = rcluster
+    ds = c.graphds[0].store
+    before = sum(v for k, v in stats().snapshot().items()
+                 if k.startswith("follower_read_total"))
+    with use_consistency("follower"):
+        tv = ds.get_vertex("rp", 1)
+    assert tv == {"Person": {"age": 11}}
+    after = sum(v for k, v in stats().snapshot().items()
+                if k.startswith("follower_read_total"))
+    assert after > before, "follower read did not take the read path"
+    # read-your-writes floors recorded from write acks
+    assert ds._applied_floor, "write acks did not record applied floors"
+    with use_consistency("bounded_stale"):
+        tv = ds.get_vertex("rp", 2)
+    assert tv == {"Person": {"age": 22}}
+
+
+def test_bounded_stale_rejects_with_structured_lag(rcluster):
+    """A replica over the staleness bound rejects with E_STALE + a
+    machine-readable lag hint (bound forced impossible so EVERY
+    replica, leader included, must reject)."""
+    c, cl = rcluster
+    get_config().set_dynamic("read_max_stale_ms", -1.0)
+    try:
+        addr = c.storage_servers[0].addr
+        rc = RpcClient.from_addr(addr, timeout=5.0, retries=0)
+        before = stats().snapshot().get("stale_read_rejects", 0)
+        with pytest.raises(RpcError, match=r"E_STALE.*lag_ms=\d+"):
+            rc.call("storage.get_vertex", space="rp", part=0, vid=1,
+                    consistency="bounded_stale")
+        rc.close()
+        assert stats().snapshot().get("stale_read_rejects", 0) > before
+    finally:
+        get_config().dynamic_layer.pop("read_max_stale_ms", None)
+
+
+def test_bounded_stale_min_applied_gate(rcluster):
+    """A bounded_stale read whose read-your-writes floor outruns the
+    replica's apply must reject (the client walks to a fresher one)."""
+    c, cl = rcluster
+    addr = c.storage_servers[1].addr
+    rc = RpcClient.from_addr(addr, timeout=5.0, retries=0)
+    with pytest.raises(RpcError, match="E_STALE"):
+        rc.call("storage.get_vertex", space="rp", part=0, vid=1,
+                consistency="bounded_stale", min_applied=10 ** 9)
+    rc.close()
+
+
+def test_unknown_consistency_rejected(rcluster):
+    c, cl = rcluster
+    addr = c.storage_servers[0].addr
+    rc = RpcClient.from_addr(addr, timeout=5.0, retries=0)
+    with pytest.raises(RpcError, match="unknown consistency"):
+        rc.call("storage.get_vertex", space="rp", part=0, vid=1,
+                consistency="snapshot")
+    rc.close()
+
+
+def test_follower_reads_through_flag_and_nqgl(rcluster):
+    """The read_consistency flag routes whole statements; SHOW QUERIES
+    grows a Consistency column."""
+    c, cl = rcluster
+    get_config().set_dynamic("read_consistency", "follower")
+    try:
+        r = cl.execute("FETCH PROP ON Person 3 YIELD Person.age AS a")
+        assert r.error is None and r.data.rows == [[33]]
+    finally:
+        get_config().dynamic_layer.pop("read_consistency", None)
+    r = cl.execute("SHOW QUERIES")
+    assert r.error is None
+    assert "Consistency" in r.data.column_names

@@ -1,0 +1,291 @@
+"""ISSUE 11 result cache: read-only statements keyed like the plan
+cache plus the engine's write epoch — DDL and mutating statements
+invalidate structurally, a dedup-window-replayed write (PR 5 retry)
+bumps exactly once, and cached rows are byte-identical to uncached
+execution (the entry IS the wire form)."""
+import json
+
+import pytest
+
+from nebula_tpu.exec.engine import quick_engine
+from nebula_tpu.utils.config import get_config
+from nebula_tpu.utils.stats import stats
+
+
+def _counts():
+    snap = stats().snapshot()
+    return (snap.get("result_cache_hits", 0),
+            snap.get("result_cache_misses", 0),
+            snap.get("result_cache_invalidations", 0))
+
+
+def _wire_bytes(data) -> bytes:
+    """Canonical byte form of a result for identity checks (buffers
+    hex-encoded so columnar blobs compare by content)."""
+    from nebula_tpu.core.wire import to_wire
+
+    def default(o):
+        if isinstance(o, (bytes, bytearray, memoryview)):
+            return bytes(o).hex()
+        raise TypeError(type(o).__name__)
+    return json.dumps(to_wire(data), sort_keys=True,
+                      default=default).encode()
+
+
+@pytest.fixture()
+def eng_sess():
+    get_config().set_dynamic("result_cache_size", 64)
+    eng, s = quick_engine()
+    for q in ("CREATE SPACE rc(partition_num=2, vid_type=INT64)",
+              "USE rc", "CREATE TAG Person(age int)",
+              "CREATE EDGE KNOWS(w int)"):
+        r = eng.execute(s, q)
+        assert r.error is None, (q, r.error)
+    r = eng.execute(s, "INSERT VERTEX Person(age) VALUES "
+                       "1:(30), 2:(25), 3:(41)")
+    assert r.error is None, r.error
+    r = eng.execute(s, "INSERT EDGE KNOWS(w) VALUES 1->2:(5), 2->3:(50)")
+    assert r.error is None, r.error
+    yield eng, s
+    get_config().dynamic_layer.pop("result_cache_size", None)
+
+
+def test_hit_skips_execution_entirely(eng_sess, monkeypatch):
+    eng, s = eng_sess
+    q = "GO FROM 1 OVER KNOWS YIELD dst(edge) AS d, KNOWS.w AS w"
+    r1 = eng.execute(s, q)
+    assert r1.error is None
+    h0, m0, _ = _counts()
+
+    # a result-cache hit must not parse, plan OR schedule anything
+    import nebula_tpu.exec.engine as E
+
+    def bomb(*a, **kw):
+        raise AssertionError("executed on a result-cache hit")
+
+    monkeypatch.setattr(E, "parse", bomb)
+    monkeypatch.setattr(eng.scheduler, "run", bomb)
+    r2 = eng.execute(s, q)
+    h1, m1, _ = _counts()
+    assert r2.error is None
+    assert h1 == h0 + 1 and m1 == m0
+    assert r2.data.rows == r1.data.rows
+    assert r2.data.column_names == r1.data.column_names
+
+
+def test_rows_byte_identical_to_uncached(eng_sess):
+    eng, s = eng_sess
+    q = "GO FROM 1 OVER KNOWS YIELD dst(edge) AS d, KNOWS.w AS w"
+    r1 = eng.execute(s, q)        # uncached execution (the put)
+    r2 = eng.execute(s, q)        # cache hit
+    assert r2.comment == "served from result cache"
+    assert _wire_bytes(r2.data) == _wire_bytes(r1.data)
+
+
+def test_write_invalidates(eng_sess):
+    eng, s = eng_sess
+    q = "GO FROM 1 OVER KNOWS YIELD dst(edge) AS d"
+    assert eng.execute(s, q).error is None
+    h0, _, inv0 = _counts()
+    ep0 = eng.qctx.write_epoch
+    r = eng.execute(s, "INSERT EDGE KNOWS(w) VALUES 1->3:(9)")
+    assert r.error is None
+    assert eng.qctx.write_epoch == ep0 + 1
+    _, _, inv1 = _counts()
+    assert inv1 == inv0 + 1, "write did not invalidate the cache"
+    r = eng.execute(s, q)          # must MISS and see the new edge
+    h1, _, _ = _counts()
+    assert h1 == h0
+    assert [3] in r.data.rows
+    # and the fresh entry hits again
+    eng.execute(s, q)
+    h2, _, _ = _counts()
+    assert h2 == h1 + 1
+
+
+def test_ddl_invalidates(eng_sess):
+    eng, s = eng_sess
+    q = "FETCH PROP ON Person 1 YIELD Person.age AS a"
+    eng.execute(s, q)
+    eng.execute(s, q)
+    h0, _, _ = _counts()
+    r = eng.execute(s, "ALTER TAG Person ADD (name string)")
+    assert r.error is None
+    eng.execute(s, q)              # stale result unreachable: replan+rerun
+    h1, _, _ = _counts()
+    assert h1 == h0, "stale result served after DDL"
+
+
+def test_reads_and_control_statements_do_not_bump(eng_sess):
+    eng, s = eng_sess
+    ep0 = eng.qctx.write_epoch
+    for q in ("GO FROM 1 OVER KNOWS YIELD dst(edge) AS d",
+              "SHOW TAGS", "DESCRIBE TAG Person", "YIELD 1 AS x"):
+        r = eng.execute(s, q)
+        assert r.error is None, (q, r.error)
+    assert eng.qctx.write_epoch == ep0, \
+        "read/control statements must not bump the write epoch"
+
+
+def test_cache_is_per_user(eng_sess):
+    """A hit never re-runs the permission check (there is no parsed
+    stmt to check), so entries must be unreachable across users — the
+    user is part of the key."""
+    eng, s = eng_sess
+    q = "GO FROM 1 OVER KNOWS YIELD dst(edge) AS d"
+    eng.execute(s, q)
+    h0, m0, _ = _counts()
+    s2 = eng.new_session(user="carol")
+    s2.space = s.space
+    r = eng.execute(s2, q)
+    assert r.error is None
+    h1, m1, _ = _counts()
+    assert h1 == h0, "another user's cached rows were served"
+    assert m1 == m0 + 1
+    # same user, same text: now a hit
+    eng.execute(s2, q)
+    h2, _, _ = _counts()
+    assert h2 == h1 + 1
+
+
+def test_failed_mutating_statement_still_invalidates(eng_sess):
+    """A failed multi-part write may have committed SOME parts (the
+    fan-out is not atomic) — the epoch bumps on any mutating attempt,
+    success or failure."""
+    eng, s = eng_sess
+    ep0 = eng.qctx.write_epoch
+    r = eng.execute(s, "INSERT VERTEX Nope(x) VALUES 1:(1)")
+    assert r.error is not None
+    assert eng.qctx.write_epoch == ep0 + 1
+
+
+def test_disabled_by_default_flag(eng_sess):
+    eng, s = eng_sess
+    get_config().set_dynamic("result_cache_size", 0)
+    try:
+        q = "GO FROM 2 OVER KNOWS YIELD dst(edge) AS d"
+        h0, _, _ = _counts()
+        eng.execute(s, q)
+        eng.execute(s, q)
+        h1, _, _ = _counts()
+        assert h1 == h0
+        assert len(eng.result_cache) == 0
+    finally:
+        get_config().set_dynamic("result_cache_size", 64)
+
+
+def test_profile_and_vars_never_cached(eng_sess):
+    eng, s = eng_sess
+    n0 = len(eng.result_cache)
+    assert eng.execute(
+        s, "PROFILE GO FROM 1 OVER KNOWS YIELD dst(edge)").error is None
+    assert eng.execute(
+        s, "EXPLAIN GO FROM 1 OVER KNOWS YIELD dst(edge)").error is None
+    assert len(eng.result_cache) == n0
+    # $var session state bypasses both caches
+    r = eng.execute(s, "$v = GO FROM 1 OVER KNOWS YIELD dst(edge) AS d; "
+                       "GO FROM $v.d OVER KNOWS YIELD dst(edge) AS d2")
+    assert r.error is None
+    h0, _, _ = _counts()
+    q = "GO FROM 2 OVER KNOWS YIELD dst(edge) AS d"
+    eng.execute(s, q)
+    eng.execute(s, q)
+    h1, _, _ = _counts()
+    assert h1 == h0, "cached despite live $var session state"
+
+
+# -- cluster: dedup-replayed write bumps exactly once; outage survival ------
+
+
+@pytest.mark.slow
+def test_dedup_replayed_write_bumps_epoch_once(tmp_path):
+    """A PR 5 reply-loss retry acks ONE statement through the dedup
+    window — the result cache must see exactly one invalidation, not
+    one per internal re-send."""
+    from nebula_tpu.cluster.launcher import LocalCluster
+    from nebula_tpu.cluster.rpc import reset_breakers
+    from nebula_tpu.utils.failpoints import fail
+    fail.reset()
+    reset_breakers()
+    c = LocalCluster(n_meta=1, n_storage=3, n_graph=1,
+                     data_dir=str(tmp_path))
+    get_config().set_dynamic("result_cache_size", 32)
+    try:
+        cl = c.client()
+        assert cl.execute("CREATE SPACE dz(partition_num=1, "
+                          "replica_factor=3, vid_type=INT64)").error is None
+        c.reconcile_storage()
+        for q in ("USE dz", "CREATE TAG P(x int)",
+                  "INSERT VERTEX P(x) VALUES 1:(1)"):
+            r = cl.execute(q)
+            assert r.error is None, (q, r.error)
+        eng = c.graphds[0].engine
+        # populate the cache so the invalidation counter can move
+        q = "FETCH PROP ON P 1 YIELD P.x AS x"
+        assert cl.execute(q).error is None
+
+        state = {"fired": False}
+
+        def decide(idx, k):
+            if state["fired"] or k != "storage.write|ok":
+                return None
+            state["fired"] = True
+            return ("raise", "reply dropped")
+        fail.arm_callable("rpc:server_reply", decide)
+        ep0 = eng.qctx.write_epoch
+        inv0 = stats().snapshot().get("result_cache_invalidations", 0)
+        r = cl.execute("INSERT VERTEX P(x) VALUES 2:(2)")
+        fail.disarm("rpc:server_reply")
+        assert r.error is None, r.error
+        assert state["fired"], "reply-loss failpoint never fired"
+        snap = stats().snapshot()
+        dedup = snap.get("storage_write_dedup_hits", 0) + \
+            snap.get("storage_write_dedup_apply_skips", 0)
+        assert dedup >= 1, "re-send was not deduplicated"
+        assert eng.qctx.write_epoch == ep0 + 1, \
+            "dedup-replayed write must bump the epoch exactly once"
+        inv1 = stats().snapshot().get("result_cache_invalidations", 0)
+        assert inv1 == inv0 + 1
+    finally:
+        fail.reset()
+        get_config().dynamic_layer.pop("result_cache_size", None)
+        c.stop()
+
+
+@pytest.mark.slow
+def test_cached_hot_read_survives_storage_outage(tmp_path):
+    """The headline scenario: within an epoch, a hot repeated read
+    keeps answering from graphd memory even with EVERY storaged down."""
+    from nebula_tpu.cluster.launcher import LocalCluster
+    from nebula_tpu.cluster.rpc import reset_breakers
+    from nebula_tpu.utils.failpoints import fail
+    fail.reset()
+    reset_breakers()
+    c = LocalCluster(n_meta=1, n_storage=1, n_graph=1,
+                     data_dir=str(tmp_path))
+    get_config().set_dynamic("result_cache_size", 32)
+    try:
+        cl = c.client()
+        assert cl.execute("CREATE SPACE oz(partition_num=1, "
+                          "vid_type=INT64)").error is None
+        c.reconcile_storage()
+        for q in ("USE oz", "CREATE TAG P(x int)",
+                  "INSERT VERTEX P(x) VALUES 1:(42)"):
+            r = cl.execute(q)
+            assert r.error is None, (q, r.error)
+        q = "FETCH PROP ON P 1 YIELD P.x AS x"
+        r1 = cl.execute(q)
+        assert r1.error is None and r1.data.rows == [[42]]
+        c.stop_storaged(0)             # total storage unavailability
+        r2 = cl.execute(q)
+        assert r2.error is None and r2.data.rows == [[42]], \
+            f"hot read died with storage: {r2.error}"
+        # a DIFFERENT read (cache miss) must fail — the cache serves
+        # exactly what it holds, it is not a stale-data oracle
+        r3 = cl.execute("FETCH PROP ON P 9 YIELD P.x AS x")
+        assert r3.error is not None
+    finally:
+        fail.reset()
+        reset_breakers()
+        get_config().dynamic_layer.pop("result_cache_size", None)
+        c.stop()
