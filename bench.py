@@ -1139,6 +1139,32 @@ def main():
         read_scaleout = {"error": repr(ex)}
     _save_partial(platform, configs)
 
+    # ---- algo block (ISSUE 13): device vs numpy-host oracle A/B per
+    # CALL algo.* algorithm (pagerank / wcc / sssp) on a north-star-
+    # shaped social array graph, with per-iteration device timing.
+    # Rows are asserted against the oracles (exact for wcc/sssp,
+    # max |Δrank| ≤ 1e-8 for pagerank); overall_speedup = summed host
+    # time / summed device time is the acceptance number.
+    _mark("config algo: CALL algo.* device vs host oracle A/B")
+    try:
+        from nebula_tpu.tools.algo_bench import run_suite as _algo_suite
+        algo_block = _algo_suite(
+            persons=int(os.environ.get("NEBULA_BENCH_ALGO_PERSONS",
+                                       min(n_persons, 300_000))),
+            degree=int(os.environ.get("NEBULA_BENCH_ALGO_DEGREE",
+                                      degree)),
+            parts=parts, tpu_runtime=rt,
+            repeats=int(os.environ.get("NEBULA_BENCH_ALGO_REPEATS", 3)))
+        _algs = [v for k, v in algo_block.items() if k != "graph"]
+        algo_block["overall_speedup"] = round(
+            sum(a["host_s"] for a in _algs)
+            / max(sum(a["device_s"] for a in _algs), 1e-9), 3)
+        algo_block["rows_match_all"] = all(a["rows_match"]
+                                          for a in _algs)
+    except Exception as ex:  # noqa: BLE001 — must not sink the run
+        algo_block = {"error": repr(ex)}
+    _save_partial(platform, configs)
+
     # VERDICT r3 item 2: the driver tails stdout into a small buffer, so
     # the headline must be COMPACT and LAST.  Full detail goes to
     # BENCH_DETAIL.json next to this script.
@@ -1300,6 +1326,7 @@ def main():
         "concurrency": concurrency,
         "overload": overload,
         "read_scaleout": read_scaleout,
+        "algo": algo_block,
         "configs": configs,
     }
     if tpu_partial is not None:
@@ -1328,6 +1355,10 @@ def main():
     }
     if tpu_partial is not None:
         hl["tpu_partial"] = len(tpu_partial["configs"])
+    if isinstance(algo_block, dict) and "overall_speedup" in algo_block:
+        # ISSUE 13: CALL algo.* device-vs-oracle aggregate (detail has
+        # the per-algorithm split + per-iteration timings)
+        hl["algo_x"] = algo_block["overall_speedup"]
     headline = json.dumps(hl)
     # full run recorded in detail — the checkpoint file has served its
     # purpose either way (salvaged or superseded)

@@ -1,0 +1,119 @@
+"""Device graph-analytics plane (ISSUE 13): `CALL algo.*`.
+
+A second workload class next to OLTP traversal: whole-graph iterative
+algorithms (PageRank, WCC, SSSP) on a shared vertex-program engine —
+frontier set + dense per-vertex state arrays + an edge-propagate/
+combine/apply step compiled as ONE jitted kernel per iteration, with
+convergence/max-iteration termination driven from the host so the
+statement reports per-iteration progress in SHOW QUERIES and is
+killable between iterations (the PR 7/PR 8 long-running-statement
+machinery was built for exactly this shape).
+
+Package layout (this module stays import-light — the query validator
+reads the registry without pulling jax):
+
+  * `__init__.py` — the algorithm REGISTRY: names, parameters,
+    defaults, yield columns.  Pure python.
+  * `frontier.py`  — the shared frontier-expansion step (ONE
+    frontier-iteration code path: tpu/bfs.py composes its level
+    bodies from these helpers, and frontier-style vertex programs
+    use the same step when they go sharded).
+  * `graph.py`     — flat edge-array preparation from a CsrSnapshot
+    (the SpMV/segment-sum form of PAPERS.md: BLEST, Sparse GNNs on
+    Dense Hardware).
+  * `kernels.py`   — the per-iteration jitted step kernels.
+  * `oracles.py`   — independent numpy host oracles (power iteration,
+    union-find, Dijkstra) — the parity contract.
+  * `engine.py`    — the `CALL algo.*` executor driver: device loop
+    with live progress, cancel checks between iterations, `algo:iter`
+    failpoint, `tpu:algo_iter` spans and `algo_*` metrics; host-oracle
+    execution when no device runtime serves the space.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Tuple
+
+#: sentinel default marking a parameter the caller MUST supply
+REQUIRED = object()
+
+
+@dataclass(frozen=True)
+class AlgoSpec:
+    """One algorithm's statement surface: its parameter schema and the
+    columns its YIELD may project."""
+    name: str
+    yield_cols: Tuple[str, ...]
+    params: Dict[str, Any] = field(default_factory=dict)   # name → default
+    description: str = ""
+
+
+#: parameters every algorithm accepts
+_COMMON = {
+    # edge types to traverse; None = every edge type in the space.
+    # A string names one type; a list of strings names several.
+    "edge_types": None,
+    # execution mode: auto (device when a runtime serves the space,
+    # host oracle otherwise), device (error when unavailable), host
+    "mode": "auto",
+    # iteration cap; 0 = the algorithm's own default
+    "max_iter": 0,
+}
+
+ALGORITHMS: Dict[str, AlgoSpec] = {
+    "pagerank": AlgoSpec(
+        name="pagerank",
+        yield_cols=("vid", "rank"),
+        params={**_COMMON, "damping": 0.85, "tol": 1e-6},
+        description="dense SpMV-style rank push over out-edges with "
+                    "dangling-mass correction; rows (vid, rank) "
+                    "ordered by vid"),
+    "wcc": AlgoSpec(
+        name="wcc",
+        yield_cols=("vid", "component"),
+        params=dict(_COMMON),
+        description="weakly connected components by min-label "
+                    "hooking / label propagation over both edge "
+                    "directions; component = vid of the smallest "
+                    "dense id in the component"),
+    "sssp": AlgoSpec(
+        name="sssp",
+        yield_cols=("vid", "distance"),
+        params={**_COMMON, "src": REQUIRED, "weight": None,
+                "direction": "out"},
+        description="single-source shortest paths by weighted frontier "
+                    "relaxation; weight names a numeric edge prop "
+                    "(NULL weighs 1.0), absent = hop count; rows only "
+                    "for reached vertices"),
+}
+
+#: iteration caps applied when max_iter=0 (the statement default)
+DEFAULT_MAX_ITER = {"pagerank": 20, "wcc": 10_000, "sssp": 100_000}
+
+_MODES = ("auto", "device", "host")
+_DIRECTIONS = ("out", "in", "both")
+
+
+def validate_call(func: str, param_names, yield_names) -> None:
+    """Static checks shared by the validator and the engine: known
+    algorithm, known parameter names, required parameters present,
+    known yield columns.  Raises ValueError with a user-facing
+    message."""
+    spec = ALGORITHMS.get(func)
+    if spec is None:
+        known = ", ".join(sorted(ALGORITHMS))
+        raise ValueError(f"unknown algorithm `algo.{func}' "
+                         f"(known: {known})")
+    for p in param_names:
+        if p not in spec.params:
+            known = ", ".join(sorted(spec.params))
+            raise ValueError(f"unknown parameter `{p}' for "
+                             f"algo.{func} (known: {known})")
+    for p, dflt in spec.params.items():
+        if dflt is REQUIRED and p not in param_names:
+            raise ValueError(f"algo.{func} requires parameter `{p}'")
+    for y in yield_names:
+        if y not in spec.yield_cols:
+            known = ", ".join(spec.yield_cols)
+            raise ValueError(f"algo.{func} cannot YIELD `{y}' "
+                             f"(columns: {known})")

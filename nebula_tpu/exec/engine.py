@@ -61,7 +61,11 @@ _CACHEABLE_KINDS = frozenset({
 # stale one), so the set is deliberately small and explicit.
 _NON_MUTATING_KINDS = _CACHEABLE_KINDS | frozenset({
     "Use", "Explain", "Describe", "DescribeUser", "DescZone",
-    "GetConfigs", "OrderBy", "Limit", "Sample"})
+    "GetConfigs", "OrderBy", "Limit", "Sample",
+    # CALL algo.* reads the graph; it is deliberately NOT result/plan
+    # cacheable (long-running, parameterized) but must not bump the
+    # write epoch either (ISSUE 13)
+    "CallAlgo"})
 
 
 def _bumps_write_epoch(kind: str) -> bool:

@@ -1177,6 +1177,18 @@ def _subgraph(node, qctx, ectx, space):
     return subgraph_host(node, qctx, ectx)
 
 
+@executor("CallAlgo")
+def _call_algo(node, qctx, ectx, space):
+    """CALL algo.* (ISSUE 13): the vertex-program engine — device
+    iterations with live per-iteration progress and kill/deadline
+    checks BETWEEN iterations, numpy host oracle otherwise."""
+    from ..algo.engine import AlgoError, run_call_algo
+    try:
+        return run_call_algo(node, qctx, ectx)
+    except AlgoError as ex:
+        raise ExecError(str(ex)) from None
+
+
 # ---------------------------------------------------------------------------
 # mutate
 # ---------------------------------------------------------------------------

@@ -573,6 +573,18 @@ class SubgraphSentence(Sentence):
 
 
 @dataclass
+class CallAlgoSentence(Sentence):
+    """CALL algo.<func>(name=value, ...) [YIELD col [AS alias], ...]
+    — the graph-analytics plane statement (ISSUE 13).  Parameter
+    values are constant expressions (literals), evaluated at plan
+    time."""
+    module: str
+    func: str
+    params: Dict[str, Expr] = field(default_factory=dict)
+    yield_: Optional[YieldClause] = None
+
+
+@dataclass
 class YieldSentence(Sentence):
     yield_: YieldClause
     where: Optional[WhereClause] = None

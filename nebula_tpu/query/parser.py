@@ -194,6 +194,7 @@ class Parser:
             "BALANCE": self.p_balance,
             "DOWNLOAD": self.p_download, "INGEST": self.p_ingest,
             "RETURN": self.p_match, "WITH": self.p_match,
+            "CALL": self.p_call_algo,
         }.get(kw)
         if fn is None:
             raise ParseError(f"unsupported statement `{kw}' at pos {t.pos}")
@@ -1099,6 +1100,34 @@ class Parser:
         name = self.ident()
         where = self.p_opt_where()
         return A.LookupSentence(name, where, self.p_opt_yield())
+
+    # ---- CALL algo.* (graph-analytics plane, ISSUE 13) ----
+    def p_call_algo(self) -> A.CallAlgoSentence:
+        """CALL algo.<func>(name=value, ...) [YIELD col [AS a], ...].
+
+        Parameters are NAMED (never positional) and their values are
+        constant expressions — `CALL algo.sssp(src=42, weight="w")`.
+        The yield columns are the algorithm's output column names."""
+        self.expect_kw("CALL")
+        module = self.ident()
+        self.expect(".")
+        func = self.ident()
+        self.expect("(")
+        params: Dict[str, Any] = {}
+        if not self.at(")"):
+            while True:
+                t = self.peek()
+                name = self.ident()
+                if name in params:
+                    raise ParseError(
+                        f"duplicate parameter `{name}' at pos {t.pos}")
+                self.expect("=")
+                params[name] = self.parse_expr()
+                if not self.accept(","):
+                    break
+        self.expect(")")
+        return A.CallAlgoSentence(module, func, params,
+                                  self.p_opt_yield())
 
     # ---- FIND PATH / SUBGRAPH ----
     def p_find_path(self) -> A.FindPathSentence:

@@ -1508,6 +1508,24 @@ def _plan_find_path(pctx, s: A.FindPathSentence) -> PlanNode:
     })
 
 
+def _plan_call_algo(pctx, s: A.CallAlgoSentence) -> PlanNode:
+    """CALL algo.<func>(...) → one CallAlgo node (ISSUE 13).  The
+    validator vetted names/required/yields; parameter values are
+    constant expressions evaluated HERE so the executor sees plain
+    python values (the plan is the wire/cache form)."""
+    from ..algo import ALGORITHMS
+    space = pctx.need_space()
+    params = {k: _const_eval(v) for k, v in s.params.items()}
+    spec = ALGORITHMS[s.func]
+    if s.yield_ is not None and s.yield_.columns:
+        ycols = [(c.expr.name, _col_name(c)) for c in s.yield_.columns]
+    else:
+        ycols = [(c, c) for c in spec.yield_cols]
+    return PlanNode("CallAlgo", col_names=[al for _, al in ycols],
+                    args={"space": space, "algo": s.func,
+                          "params": params, "yield": ycols})
+
+
 def _plan_subgraph(pctx, s: A.SubgraphSentence) -> PlanNode:
     space = pctx.need_space()
     cat = pctx.catalog
@@ -1680,6 +1698,7 @@ def _register_dispatch():
         A.MatchSentence: _plan_match,
         A.FindPathSentence: _plan_find_path,
         A.SubgraphSentence: _plan_subgraph,
+        A.CallAlgoSentence: _plan_call_algo,
         A.InsertVerticesSentence: _plan_insert_vertices,
         A.InsertEdgesSentence: _plan_insert_edges,
         A.DeleteVerticesSentence: _plan_delete_vertices,

@@ -318,6 +318,47 @@ def _register_sentence_validators():
                 if not _has_edge(pctx, et):
                     raise ValidationError(f"edge `{et}' not found")
 
+    @_svalidator(A.CallAlgoSentence)
+    def v_call_algo(stmt, pctx):
+        # the registry is import-light on purpose (no jax): the
+        # validator statically vets module/func/params/yields before
+        # any engine machinery is touched
+        from ..algo import validate_call
+        if stmt.module != "algo":
+            raise ValidationError(
+                f"unknown procedure module `{stmt.module}' "
+                f"(only `algo' is served)")
+        ynames = []
+        if stmt.yield_ is not None:
+            for c in stmt.yield_.columns:
+                if c.expr.kind != "label":
+                    raise ValidationError(
+                        "CALL ... YIELD takes bare output column "
+                        "names (optionally aliased with AS)")
+                ynames.append(c.expr.name)
+        try:
+            validate_call(stmt.func, list(stmt.params), ynames)
+        except ValueError as ex:
+            raise ValidationError(str(ex)) from None
+        for name, e in stmt.params.items():
+            try:
+                e.eval(E.DictContext())
+            except Exception:  # noqa: BLE001 — non-constant param
+                raise ValidationError(
+                    f"parameter `{name}' must be a constant "
+                    f"expression") from None
+        et = stmt.params.get("edge_types")
+        if et is not None and pctx.space:
+            try:
+                v = et.eval(E.DictContext())
+            except Exception:  # noqa: BLE001 — reported above
+                v = None
+            names = [v] if isinstance(v, str) else \
+                (v if isinstance(v, list) else [])
+            for n in names:
+                if isinstance(n, str) and not _has_edge(pctx, n):
+                    raise ValidationError(f"edge `{n}' not found")
+
     @_svalidator(A.SubgraphSentence)
     def v_subgraph(stmt, pctx):
         if stmt.steps is not None and stmt.steps < 0:

@@ -1,0 +1,142 @@
+"""algo-bench — device vs numpy-host A/B per algorithm on the
+north-star social array graph (ISSUE 13; the analytics mirror of
+write_bench/overload_bench).
+
+The headline question of the algo plane: does the vertex-program
+engine's one-jitted-kernel-per-iteration form beat the numpy host
+oracles on the same graph?  Per algorithm:
+
+  device_s    median end-to-end device run (prep cached, kernels warm)
+  host_s      median numpy-oracle run (power iteration / union-find /
+              Dijkstra — genuinely different algorithm families)
+  speedup     host_s / device_s (the acceptance number: > 1.0)
+  iterations  device iterations to convergence/cap
+  iter_ms     per-iteration device wall ms (p50 over the timed runs)
+  rows_match  device rows == oracle rows (exact for wcc/sssp;
+              pagerank max |Δrank| reported, bar 1e-9)
+
+PageRank runs a FIXED iteration count on both sides (tol=0) so the
+A/B compares identical work.  WCC/SSSP run to convergence.
+
+Usage:
+    python -m nebula_tpu.tools.algo_bench
+    python -m nebula_tpu.tools.algo_bench --persons 300000 --degree 12
+
+Emits one JSON object on stdout; bench.py folds it into its `algo`
+block (speedups + rows_match are the acceptance evidence).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import time
+from typing import Dict, List, Optional
+
+PAGERANK_TOL = 1e-8        # documented rank parity bar (abs, per vid)
+
+
+def _median(xs: List[float]) -> float:
+    s = sorted(xs)
+    return s[len(s) // 2] if s else 0.0
+
+
+def _build_graph(persons: int, degree: int, parts: int, seed: int):
+    from nebula_tpu.bench.datagen import (SnapshotStore,
+                                          make_social_arrays,
+                                          snapshot_from_arrays)
+    arrs = make_social_arrays(persons, degree, seed=seed)
+    snap = snapshot_from_arrays(arrs, parts=parts, space="algo_ns")
+    snap.space = "algo_ns"
+    return SnapshotStore(snap), snap
+
+
+def run_suite(persons: int = 120_000, degree: int = 12,
+              parts: int = 8, seed: int = 7, repeats: int = 3,
+              tpu_runtime=None,
+              algos=("pagerank", "wcc", "sssp")) -> Dict:
+    """Device-vs-host A/B per algorithm on one social array graph."""
+    from nebula_tpu.algo.engine import run_algorithm
+    store, snap = _build_graph(persons, degree, parts, seed)
+    sd = store.space("algo_ns")
+    rt = tpu_runtime
+    if rt is None:
+        from nebula_tpu.tpu import TpuRuntime, make_mesh
+        rt = TpuRuntime(make_mesh(1))
+
+    base_params: Dict[str, Dict] = {
+        # fixed work on both sides: tol=0 never converges early
+        "pagerank": {"max_iter": 20, "tol": 0.0},
+        "wcc": {},
+        "sssp": {"src": 0, "weight": "w"},
+    }
+    out: Dict = {"graph": {"persons": persons, "degree": degree,
+                           "parts": parts,
+                           "edges": int(snap.block("KNOWS", "out")
+                                        .indptr[:, -1].sum())}}
+    for func in algos:
+        params = dict(base_params[func])
+        # warmup: kernel compile + edge-array upload settle
+        run_algorithm(func, {**params, "mode": "device"}, snap, sd,
+                      rt=rt)
+        dev_lat, host_lat, iter_all = [], [], []
+        dev_rows = host_rows = None
+        iters = 0
+        for _ in range(repeats):
+            iter_us: List[int] = []
+            t0 = time.perf_counter()
+            dev_rows, info = run_algorithm(
+                func, {**params, "mode": "device"}, snap, sd, rt=rt,
+                iter_us=iter_us)
+            dev_lat.append(time.perf_counter() - t0)
+            iters = info["iterations"]
+            iter_all.extend(iter_us)
+            t0 = time.perf_counter()
+            host_rows, _ = run_algorithm(
+                func, {**params, "mode": "host"}, snap, sd)
+            host_lat.append(time.perf_counter() - t0)
+        if func == "pagerank":
+            dv = {r[0]: r[1] for r in dev_rows}
+            hv = {r[0]: r[1] for r in host_rows}
+            same_vids = set(dv) == set(hv)
+            # diff over the intersection so a vid-domain parity bug
+            # reports rows_match=False with the diff intact instead of
+            # blowing up the whole suite with a KeyError
+            max_diff = max((abs(dv[k] - hv[k]) for k in dv
+                            if k in hv), default=0.0)
+            rows_match = same_vids and max_diff <= PAGERANK_TOL
+        else:
+            max_diff = 0.0
+            rows_match = dev_rows == host_rows
+        dev_s, host_s = _median(dev_lat), _median(host_lat)
+        out[func] = {
+            "device_s": round(dev_s, 6),
+            "host_s": round(host_s, 6),
+            "speedup": round(host_s / dev_s, 3) if dev_s > 0 else 0.0,
+            "iterations": iters,
+            "iter_ms_p50": round(_median(iter_all) / 1000.0, 3)
+            if iter_all else 0.0,
+            "rows": len(dev_rows),
+            "rows_match": bool(rows_match),
+            "pagerank_max_abs_diff": max_diff
+            if func == "pagerank" else None,
+        }
+    return out
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--persons", type=int, default=120_000)
+    ap.add_argument("--degree", type=int, default=12)
+    ap.add_argument("--parts", type=int, default=8)
+    ap.add_argument("--repeats", type=int, default=3)
+    ap.add_argument("--seed", type=int, default=7)
+    args = ap.parse_args(argv)
+    res = run_suite(persons=args.persons, degree=args.degree,
+                    parts=args.parts, seed=args.seed,
+                    repeats=args.repeats)
+    print(json.dumps(res, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -16,6 +16,13 @@ plus a scatter-based visit pass) — all gone; the frontier bitmap IS the
 visited-set currency, so BFS is now expand → mark → exchange →
 `new = cand & (dist < 0)` with no sorts and no frontier/route overflow.
 
+ISSUE 13 refactor: the per-level expansion bodies (top-down expand +
+mark, bottom-up reverse scan, the sharded expand + mark) moved to
+nebula_tpu/algo/frontier.py — ONE frontier-iteration code path shared
+with the graph-analytics vertex-program plane.  This module now only
+composes those steps with the BFS-specific state update (dist/level
+bookkeeping and the direction-optimizing switch).
+
 Reference analog: BFSShortestPathExecutor's per-hop storage fan-out +
 host hash-set frontiers (src/graph/executor/algo [UNVERIFIED — empty
 mount, SURVEY §0]), replaced by on-device expansion.
@@ -25,8 +32,10 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from .hop import (_exchange_marks, _expand_block, _extend_fbm_local,
-                  _extend_fbm_sharded, _hub_consts, _mark, _norm_ebs)
+from ..algo.frontier import (bottom_up_step, sharded_level_step,
+                             top_down_step)
+from .hop import (_exchange_marks, _extend_fbm_local,
+                  _extend_fbm_sharded, _hub_consts, _norm_ebs)
 
 
 def build_bfs_fn(mesh, P: int, EB, max_steps: int,
@@ -52,26 +61,12 @@ def build_bfs_fn(mesh, P: int, EB, max_steps: int,
 
         for level in range(1, max_steps + 1):
             EBl = ebs[level - 1]
-            marks = None
-            edges = jnp.zeros((), jnp.int32)
             efbm = fbm if hubs_c is None else _extend_fbm_sharded(
                 fbm, pid, hub_owner, hub_local)
-            for bi in range(n_blocks):
-                b = blocks_data[bi]
-                src, dst, rk, eidx, ve, total, ovf = _expand_block(
-                    b["indptr"][0], b["nbr"][0], b["rank"][0], efbm, EBl,
-                    P, pid, vmax_local=vmax, hub_dense=hubs_c)
-                ovf_e = ovf_e | ovf
-                edges = edges + total
-                if pred is not None:
-                    cols = {"_rank": rk, "_src": src, "_dst": dst}
-                    for name in pred_cols:
-                        if not name.startswith("_"):
-                            cols[name] = b["props"][name][0][eidx]
-                    keep = pred(cols) & ve
-                else:
-                    keep = ve
-                marks = _mark(dst, keep, P, vmax, marks)
+            marks, edges, ovf = sharded_level_step(
+                blocks_data, efbm, EBl, P, pid, vmax,
+                pred=pred, pred_cols=pred_cols, hub_dense=hubs_c)
+            ovf_e = ovf_e | ovf
             hop_edges.append(edges)
             cand = _exchange_marks(marks, P, vmax)
             new = cand & (dist < 0)
@@ -118,74 +113,21 @@ def build_bfs_fn_local(P: int, EB, max_steps: int,
             return x
         return _extend_fbm_local(x, hub_owner, hub_local, P)
 
-    def one_part(block, fbm, pid, EBl, swap_ends=False):
-        src, dst, rk, eidx, ve, total, ovf = _expand_block(
-            block["indptr"], block["nbr"], block["rank"], fbm, EBl, P,
-            pid, vmax_local=vmax, hub_dense=hubs_c)
-        if pred is not None:
-            # $^/$$ are TRAVERSAL source/destination.  Bottom-up
-            # expands the REVERSE adjacency, so the expansion source is
-            # the traversal DESTINATION (the newly reached vertex) and
-            # the neighbor is the frontier side — swap the endpoint
-            # columns the predicate sees.
-            ps, pd = (dst, src) if swap_ends else (src, dst)
-            cols = {"_rank": rk, "_src": ps, "_dst": pd}
-            for name in pred_cols:
-                if not name.startswith("_"):
-                    cols[name] = block["props"][name][eidx]
-            keep = pred(cols) & ve
-        else:
-            keep = ve
-        return src, dst, keep, total, ovf
-
-    def top_down(blocks_data, fbm, EBl):
-        marks = None
-        edges = jnp.zeros((P,), jnp.int32)
-        ovf = jnp.zeros((P,), bool)
-        for bi in range(n_blocks):
-            b = blocks_data[bi]
-            _s, dst, keep, total, ov = jax.vmap(
-                lambda ip, nb, rkk, prp, f, pd: one_part(
-                    {"indptr": ip, "nbr": nb, "rank": rkk,
-                     "props": prp}, f, pd, EBl)
-            )(b["indptr"], b["nbr"], b["rank"],
-              b.get("props", {}), ext(fbm), pids)
-            ovf = ovf | ov
-            edges = edges + total
-            blk_marks = jax.vmap(
-                lambda d, k: _mark(d, k, P, vmax))(dst, keep)
-            marks = blk_marks if marks is None else marks | blk_marks
-        return marks.any(axis=0), edges, ovf
-
-    def bottom_up(blocks_data, fbm, unvis, EBl):
-        # expand the REVERSE adjacency of unvisited vertices; a vertex
-        # joins the frontier if any in-neighbor is currently in it
-        cand = jnp.zeros((P, vmax), bool)
-        edges = jnp.zeros((P,), jnp.int32)
-        ovf = jnp.zeros((P,), bool)
-        for bi in range(n_blocks):
-            b = blocks_data[bi]
-            src, nb, keep, total, ov = jax.vmap(
-                lambda ip, nbr, rkk, prp, f, pd: one_part(
-                    {"indptr": ip, "nbr": nbr, "rank": rkk,
-                     "props": prp}, f, pd, EBl, swap_ends=True)
-            )(b["rev_indptr"], b["rev_nbr"], b["rev_rank"],
-              b.get("rev_props", {}), ext(unvis), pids)
-            ovf = ovf | ov
-            edges = edges + total
-            member = fbm[nb % P, nb // P] & keep       # (P, EB)
-            # route the reached vertex to its OWNER row (a degree-split
-            # hub row's src belongs to another part, so the plain
-            # local-index scatter would mis-home it)
-            blk = jax.vmap(lambda s, m: _mark(s, m, P, vmax))(src, member)
-            cand = cand | blk.any(axis=0)
-        return cand, edges, ovf
-
     def fn(blocks_data, frontier):
         fbm = frontier                          # (P, vmax) bool seeds
         dist = jnp.where(fbm, 0, -1).astype(jnp.int32)   # (P, vmax)
         ovf_e = jnp.zeros((P,), bool)
         hop_edges = []
+
+        def top_down(blocks, f, EBl):
+            return top_down_step(blocks, ext(f), EBl, P, vmax, pids,
+                                 pred=pred, pred_cols=pred_cols,
+                                 hub_dense=hubs_c)
+
+        def bottom_up(blocks, f, unvis, EBl):
+            return bottom_up_step(blocks, f, ext(unvis), EBl, P, vmax,
+                                  pids, pred=pred, pred_cols=pred_cols,
+                                  hub_dense=hubs_c)
 
         for level in range(1, max_steps + 1):
             EBl = ebs[level - 1]

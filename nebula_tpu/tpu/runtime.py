@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import threading
 import time
+from contextlib import contextmanager
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -656,6 +657,24 @@ class TpuRuntime:
         (ROADMAP item 2) will be specified against.  The failpoint
         site `tpu:dispatch_gate` stalls a dispatch while it is still
         QUEUED (stall-watchdog and queue-accounting tests)."""
+        with self._gated_dispatch(kernel) as wait_us:
+            stats.queue_s = wait_us / 1e6
+            return self._escalate_locked(
+                dev, dense, key_fn, build_fn, inputs_fn, stats,
+                n_hops=n_hops, uniform=uniform, min_eb=min_eb,
+                fetch_keys=fetch_keys, kernel=kernel)
+
+    @contextmanager
+    def _gated_dispatch(self, kernel: str):
+        """The dispatch-gate prologue/epilogue shared by EVERY device
+        program (the escalation driver and the algo plane's
+        single-shot iterations): register in the live DispatchTable
+        (queued → running → done), hit the `tpu:dispatch_gate`
+        failpoint, wait on the READ side of the dispatch-vs-repin
+        gate, and land the wait in `tpu_dispatch_queue_us{kernel}`,
+        the statement's cost sink and its live-registry row.  Yields
+        the queue wait in µs.  Defined ONCE so a change to dispatch
+        accounting cannot drift between the two paths."""
         from ..utils.failpoints import fail as _fail
         from ..utils.stats import current_cost
         from ..utils.stats import stats as _metrics
@@ -670,7 +689,6 @@ class TpuRuntime:
             self._gate.acquire_read()
             acquired = True
             wait_us = dispatch_table().mark_running(tok)
-            stats.queue_s = wait_us / 1e6
             _metrics().observe("tpu_dispatch_queue_us", wait_us,
                                {"kernel": kernel})
             cc = current_cost()
@@ -679,14 +697,41 @@ class TpuRuntime:
             lv = current_live()
             if lv is not None:
                 lv.add("queue_us", wait_us)
-            return self._escalate_locked(
-                dev, dense, key_fn, build_fn, inputs_fn, stats,
-                n_hops=n_hops, uniform=uniform, min_eb=min_eb,
-                fetch_keys=fetch_keys, kernel=kernel)
+            yield wait_us
         finally:
             if acquired:
                 self._gate.release_read()
             dispatch_table().exit(tok)
+
+    def algo_dispatch(self, kernel: str, fn, *args):
+        """One gated single-shot device dispatch for the algo plane
+        (ISSUE 13): a vertex-program ITERATION kernel has static
+        full-graph shapes — no bucket escalation, no capture fetch —
+        but it rides the same gate/accounting as every other device
+        program (_gated_dispatch) and additionally lands its run time
+        in `tpu_dispatch_us{kernel}`, `device_us` and the SHOW QUERIES
+        decomposition.  Returns (result, dispatch_us)."""
+        from ..utils.stats import current_cost, current_work
+        from ..utils.stats import stats as _metrics
+        from ..utils.workload import current_live
+        with self._gated_dispatch(kernel):
+            t0 = time.perf_counter()
+            res = fn(*args)
+            jax.block_until_ready(res)
+            us = int((time.perf_counter() - t0) * 1e6)
+            _metrics().observe("tpu_dispatch_us", us, {"kernel": kernel})
+            cc = current_cost()
+            if cc is not None:
+                cc.add("device_us", us)
+                cc.add("device_dispatches", 1)
+            lv = current_live()
+            if lv is not None:
+                lv.add("device_us", us)
+                lv.add("dispatches", 1)
+            wc = current_work()
+            if wc is not None:
+                wc.add("device_dispatches")
+            return res, us
 
     def _escalate_locked(self, dev: DeviceSnapshot, dense: Sequence[int],
                          key_fn, build_fn, inputs_fn,

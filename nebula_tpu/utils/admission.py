@@ -175,12 +175,29 @@ def is_control_stmt(kind: str) -> bool:
     return kind.startswith(_CONTROL_PREFIXES) or kind in _CONTROL_KINDS
 
 
+# -- analytics lane (ISSUE 13) ----------------------------------------------
+
+#: statement kinds that run BELOW interactive traffic: long-running
+#: whole-graph analytics (`CALL algo.*`).  They queue in a separate
+#: FIFO band that drains only when no interactive statement is
+#: waiting — strict priority, so a burst of PageRank runs can never
+#: add queueing delay to point reads.  Deadline eviction, KILL
+#: eviction and the capacity bound apply to the band exactly as to
+#: the DWRR queues (an analytic statement whose budget expires while
+#: parked fails E_QUERY_TIMEOUT without ever taking a slot).
+_ANALYTIC_KINDS = frozenset({"CallAlgo"})
+
+
+def is_analytic_stmt(kind: str) -> bool:
+    return kind in _ANALYTIC_KINDS
+
+
 # -- the controller ----------------------------------------------------------
 
 
 class _Waiter:
     __slots__ = ("qid", "session", "kind", "event", "admitted",
-                 "cancelled", "t_enq", "tracker", "live")
+                 "cancelled", "t_enq", "tracker", "live", "analytic")
 
     def __init__(self, qid: int, session: int, kind: str, live, tracker):
         self.qid = qid
@@ -192,6 +209,7 @@ class _Waiter:
         self.t_enq = time.monotonic()
         self.tracker = tracker
         self.live = live
+        self.analytic = is_analytic_stmt(kind)
 
 
 class Ticket:
@@ -234,6 +252,9 @@ class AdmissionController:
         self._queues: "OrderedDict[int, deque]" = OrderedDict()
         self._rr: "deque[int]" = deque()            # session rotation
         self._deficit: Dict[int, float] = {}
+        # below-interactive band (ISSUE 13): analytics FIFO, drained
+        # only when every DWRR session queue is empty
+        self._analytic: "deque[_Waiter]" = deque()
         self._queued_n = 0
         self._drain_est = DrainEstimator()
         self._weights_raw = ""
@@ -338,6 +359,9 @@ class AdmissionController:
             return Ticket(self, "bypass", qid)
         w = _Waiter(qid, session, kind, live, tracker)
         with self._mu:
+            # the fast path requires an EMPTY queue (total, both
+            # bands): an analytic arrival must not jump a queued
+            # interactive statement, and vice versa FIFO order holds
             if self._queued_n == 0 and len(self._running) < slots \
                     and self._mem_ok_locked(self.watermark()):
                 # fast path: empty queue, free slot, memory headroom
@@ -371,11 +395,16 @@ class AdmissionController:
                     f"admission queue full (depth={depth}, "
                     f"capacity={self.capacity()}, "
                     f"running={len(self._running)})")
-            q = self._queues.get(session)
-            if q is None:
-                q = self._queues[session] = deque()
-                self._rr.append(session)
-            q.append(w)
+            if w.analytic:
+                # below-interactive band: FIFO, drained only when the
+                # DWRR rotation is empty
+                self._analytic.append(w)
+            else:
+                q = self._queues.get(session)
+                if q is None:
+                    q = self._queues[session] = deque()
+                    self._rr.append(session)
+                q.append(w)
             self._queued_n += 1
             if live is not None:
                 live.queued = True
@@ -424,7 +453,8 @@ class AdmissionController:
             if w.admitted:
                 return False
             w.cancelled = True
-            q = self._queues.get(w.session)
+            q = self._analytic if w.analytic \
+                else self._queues.get(w.session)
             if q is not None:
                 try:
                     q.remove(w)
@@ -475,6 +505,16 @@ class AdmissionController:
             self._rr.rotate(-1)
         return None
 
+    def _next_locked(self) -> Optional[_Waiter]:
+        """DWRR first; the analytics band drains ONLY when no
+        interactive statement waits (strict below-interactive
+        priority, ISSUE 13)."""
+        w = self._drr_next_locked()
+        if w is None and self._analytic:
+            w = self._analytic.popleft()
+            self._queued_n = max(self._queued_n - 1, 0)
+        return w
+
     def _drain(self):
         admitted = []
         with self._mu:
@@ -485,7 +525,7 @@ class AdmissionController:
                     break
                 if slots > 0 and not self._mem_ok_locked(wm):
                     break
-                w = self._drr_next_locked()
+                w = self._next_locked()
                 if w is None:
                     break
                 # slots<=0 → admission was disabled live: everyone goes
@@ -507,6 +547,7 @@ class AdmissionController:
                 "queued": self._queued_n,
                 "queued_by_session": {sid: len(q) for sid, q
                                       in self._queues.items() if q},
+                "analytic_queued": len(self._analytic),
                 "memory_bytes": self._mem_total_locked(),
                 "drain_rate_per_s": round(self._drain_est.rate(), 3),
             }
@@ -515,9 +556,11 @@ class AdmissionController:
         """Test isolation: wake every waiter and drop all state."""
         with self._mu:
             waiters = [w for q in self._queues.values() for w in q]
+            waiters.extend(self._analytic)
             self._queues.clear()
             self._rr.clear()
             self._deficit.clear()
+            self._analytic.clear()
             self._queued_n = 0
             self._running.clear()
         for w in waiters:
