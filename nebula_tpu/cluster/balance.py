@@ -4,16 +4,23 @@ The reference runs balance as a metad job executing a plan of
 BalanceTasks (add learner → catch up → member change → remove;
 reference: src/meta/processors/job/BalancePlan+BalanceTask [UNVERIFIED —
 empty mount, SURVEY §2 row 17]).  Same protocol here, driven from the
-graphd job manager through meta + storage RPCs:
+graphd job manager through meta + storage RPCs — and since ISSUE 14 the
+per-part mechanics live in the SHARED resumable membership task engine
+(cluster/repair.py), the same one the metad PartSupervisor drives for
+automatic replica repair:
 
-  BALANCE DATA, per part:
-    phase A (add):    part map gains the new replica → storageds
-                      reconcile → the new member joins the raft group and
-                      catches up from the leader (snapshot install)
-    phase B (lead):   if the leader is being removed, transfer
-                      leadership to a surviving replica (TimeoutNow)
-    phase C (remove): part map drops the old replica → its storaged
-                      stops the raft member and releases the part state
+  BALANCE DATA, per part (run_membership_change):
+    add_learner:  the target joins as a non-voting learner (or as a
+                  voter when the part already lost its quorum — the
+                  only way to restore electability), storageds
+                  reconcile, and the new member catches up from the
+                  leader (snapshot install)
+    catchup:      poll until its applied index reaches the leader's
+                  commit index (`balance_catchup_timeout_secs`)
+    promote:      learner → voter (one meta propose)
+    remove:       drop the old replica (leadership handed off first
+                  when the leader is the one leaving); its storaged
+                  stops the raft member and releases the part state
 
   Every map change is serialized through the metad raft group, and each
   step adds OR removes (never both), so consecutive raft configurations
@@ -24,11 +31,11 @@ graphd job manager through meta + storage RPCs:
 """
 from __future__ import annotations
 
-import time
 from collections import Counter
 from typing import Any, Dict, List, Optional
 
-CATCHUP_TIMEOUT_S = 30.0
+from .repair import (ClientPartOps, MembershipError, find_leader,
+                     run_membership_change, transfer_leader_away)
 
 
 class BalanceError(Exception):
@@ -38,100 +45,6 @@ class BalanceError(Exception):
 def _alive_storage(meta) -> List[str]:
     return sorted(h["addr"] for h in meta.list_hosts()
                   if h["role"] == "storage" and h["alive"])
-
-
-def _reconcile(sc, hosts: List[str]):
-    for h in hosts:
-        try:
-            sc._client(h).call("storage.reconcile")
-        except Exception:  # noqa: BLE001 — host may be mid-death
-            pass
-
-
-def _raft_info(sc, host: str, space: str, pid: int) -> Optional[Dict]:
-    try:
-        return sc._client(host).call("storage.part_raft_info",
-                                     space=space, part=pid)
-    except Exception:  # noqa: BLE001
-        return None
-
-
-def _find_leader(sc, hosts: List[str], space: str, pid: int
-                 ) -> Optional[str]:
-    for h in hosts:
-        info = _raft_info(sc, h, space, pid)
-        if info and info["is_leader"]:
-            return h
-    return None
-
-
-def _wait_caught_up(sc, host: str, leader: str, space: str, pid: int,
-                    timeout: float = CATCHUP_TIMEOUT_S,
-                    hosts: Optional[List[str]] = None):
-    """Poll the new replica until its applied index reaches the leader's
-    commit index as of entry.  The leader's index MUST be known — a
-    transient RPC failure must not degrade the target to 0, or an empty
-    replica reads as caught up and the shrink phase drops the only full
-    copy.
-
-    The leader may DIE mid-catchup (ISSUE 5 satellite): instead of
-    aborting the data move, re-discover the new leader among `hosts`
-    and resume — a freshly elected leader's commit index covers every
-    entry the dead one had committed, so re-anchoring the target on it
-    never lowers the bar below already-committed state."""
-    dl = time.monotonic() + timeout
-    # the catch-up target itself stays a candidate: raft log-
-    # completeness can make the NEW replica win the post-crash
-    # election, and anchoring on its own commit index is equally safe
-    cands = list(hosts or []) or [leader]
-    cur: Optional[str] = leader
-    target = None
-    while target is None and time.monotonic() < dl:
-        li = _raft_info(sc, cur, space, pid) if cur else None
-        if li is not None and li.get("is_leader", True):
-            target = li["commit_index"]
-            break
-        # named leader dead/deposed: walk the replica set for its
-        # successor (an election in flight keeps returning None — poll)
-        cur = _find_leader(sc, cands, space, pid)
-        if cur is None:
-            time.sleep(0.05)
-    if target is None:
-        raise BalanceError(
-            f"no reachable leader for {space}/{pid} (last tried "
-            f"{cur or leader}); cannot establish a catch-up target")
-    while time.monotonic() < dl:
-        info = _raft_info(sc, host, space, pid)
-        if info and info["last_applied"] >= target:
-            return
-        time.sleep(0.05)
-    raise BalanceError(
-        f"replica {host} of {space}/{pid} did not catch up to {target}")
-
-
-def _transfer_leader(meta, sc, space: str, pid: int, hosts: List[str],
-                     to: str, timeout: float = 10.0) -> bool:
-    cur = _find_leader(sc, hosts, space, pid)
-    if cur == to:
-        meta.transfer_leader(space, pid, to)
-        return True
-    if cur is None:
-        return False
-    try:
-        r = sc._client(cur).call("storage.transfer_part_leader",
-                                 space=space, part=pid, to=to)
-    except Exception:  # noqa: BLE001
-        return False
-    if not (isinstance(r, dict) and r.get("ok")):
-        return False        # definitive refusal — don't poll the timeout
-    dl = time.monotonic() + timeout
-    while time.monotonic() < dl:
-        info = _raft_info(sc, to, space, pid)
-        if info and info["is_leader"]:
-            meta.transfer_leader(space, pid, to)
-            return True
-        time.sleep(0.05)
-    return False
 
 
 def _zone_map(meta, alive: List[str]) -> Dict[str, str]:
@@ -156,6 +69,25 @@ def _spaces(meta, space: Optional[str]) -> List[str]:
     return sorted(n for n in meta.catalog.spaces)
 
 
+def _ensure_replica(ops, space: str, pid: int, tgt: str,
+                    alive: List[str]):
+    """Grow the part onto `tgt` via the shared engine (learner →
+    catch-up → promote); wraps engine errors in BalanceError so the
+    job surface stays stable."""
+    try:
+        run_membership_change(ops, space, pid, add=tgt, alive=alive)
+    except MembershipError as ex:
+        raise BalanceError(str(ex)) from None
+
+
+def _drop_replica(ops, space: str, pid: int, drop: str,
+                  alive: List[str]):
+    try:
+        run_membership_change(ops, space, pid, remove=drop, alive=alive)
+    except MembershipError as ex:
+        raise BalanceError(str(ex)) from None
+
+
 def balance_data(store, space: Optional[str] = None,
                  exclude: Optional[List[str]] = None) -> Dict[str, Any]:
     """Heal under-replication (dead hosts), spread parts over new hosts,
@@ -166,6 +98,7 @@ def balance_data(store, space: Optional[str] = None,
     hosts and the drained copies are dropped; afterwards DROP HOSTS can
     remove them from the cluster."""
     meta, sc = store.meta, store.sc
+    ops = ClientPartOps(meta, sc)
     alive = [h for h in _alive_storage(meta)
              if not exclude or h not in exclude]
     if not alive:
@@ -198,7 +131,7 @@ def balance_data(store, space: Optional[str] = None,
                 if not cands:
                     break
                 tgt = min(cands, key=lambda h: load[h])
-                _add_replica(meta, sc, sp_name, pid, replicas, tgt, alive)
+                _ensure_replica(ops, sp_name, pid, tgt, alive)
                 keep.append(tgt)
                 replicas.append(tgt)
                 load[tgt] += 1
@@ -222,7 +155,7 @@ def balance_data(store, space: Optional[str] = None,
                 if not cands:
                     continue
                 tgt = min(cands, key=lambda h: load[h])
-                _add_replica(meta, sc, sp_name, pid, replicas, tgt, alive)
+                _ensure_replica(ops, sp_name, pid, tgt, alive)
                 replicas.append(tgt)
                 keep = [h for h in keep if h != src] + [tgt]
                 load[tgt] += 1
@@ -233,45 +166,19 @@ def balance_data(store, space: Optional[str] = None,
             # the raft safety argument (update_peers) needs every pair of
             # consecutive configurations to share a quorum, which single
             # removals guarantee and batch removals do not
-            current = list(replicas)
             for drop in [r for r in replicas if r not in keep]:
-                leader = _find_leader(sc, keep, sp_name, pid)
-                if leader is None:
-                    # leader is being removed (or died): hand off first
-                    if not _transfer_leader(meta, sc, sp_name, pid,
-                                            current, keep[0]):
-                        raise BalanceError(
-                            f"cannot move leadership of {sp_name}/{pid} "
-                            f"into the surviving set {keep}")
-                    leader = keep[0]
-                current = [h for h in current if h != drop]
-                ordered = [leader] + [h for h in current if h != leader]
-                meta.set_part_replicas(sp_name, pid, ordered)
-                _reconcile(sc, sorted(set(alive + [drop])))
-                current = ordered
-                plan.append({"space": sp_name, "part": pid, "op": "shrink",
-                             "dropped": drop, "replicas": ordered})
+                _drop_replica(ops, sp_name, pid, drop, alive)
+                plan.append({"space": sp_name, "part": pid,
+                             "op": "shrink", "dropped": drop,
+                             "replicas":
+                             list(meta.parts_of(sp_name)[pid])})
     return {"plan": plan, "alive_hosts": alive}
-
-
-def _add_replica(meta, sc, space: str, pid: int, replicas: List[str],
-                 tgt: str, alive: List[str]):
-    meta.set_part_replicas(space, pid, list(replicas) + [tgt])
-    _reconcile(sc, alive)
-    live = [r for r in replicas if r in alive] + [tgt]
-    leader = _find_leader(sc, live, space, pid)
-    dl = time.monotonic() + CATCHUP_TIMEOUT_S
-    while leader is None and time.monotonic() < dl:
-        time.sleep(0.05)            # election in flight
-        leader = _find_leader(sc, live, space, pid)
-    if leader is None:
-        raise BalanceError(f"no leader for {space}/{pid} during add")
-    _wait_caught_up(sc, tgt, leader, space, pid, hosts=live)
 
 
 def balance_leader(store, space: Optional[str] = None) -> Dict[str, Any]:
     """Spread raft leadership evenly over alive hosts."""
     meta, sc = store.meta, store.sc
+    ops = ClientPartOps(meta, sc)
     alive = set(_alive_storage(meta))
     if not alive:
         raise BalanceError("no alive storage hosts")
@@ -282,7 +189,7 @@ def balance_leader(store, space: Optional[str] = None) -> Dict[str, Any]:
         leaders: Dict[int, Optional[str]] = {}
         for pid, replicas in enumerate(pm):
             cands = [r for r in replicas if r in alive]
-            ld = _find_leader(sc, cands, sp_name, pid)
+            ld = find_leader(ops, cands, sp_name, pid)
             leaders[pid] = ld
             if ld:
                 lead_count[ld] += 1
@@ -301,7 +208,7 @@ def balance_leader(store, space: Optional[str] = None) -> Dict[str, Any]:
             if not under:
                 continue
             tgt = min(under, key=lambda h: lead_count[h])
-            if _transfer_leader(meta, sc, sp_name, pid, cands, tgt):
+            if transfer_leader_away(ops, sp_name, pid, cands, tgt):
                 if ld:
                     lead_count[ld] -= 1
                 lead_count[tgt] += 1

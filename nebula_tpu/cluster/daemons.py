@@ -102,6 +102,11 @@ def main(argv=None):
             web.providers["/cluster_queries"] = lambda q: (
                 200, _json.dumps(fed.cluster_queries(), default=str),
                 "application/json")
+            # auto-repair plans (ISSUE 14): the raft-persisted
+            # RepairPlan table (metrics_dump --repairs scrapes this)
+            web.providers["/repairs"] = lambda q: (
+                200, _json.dumps(svc.rpc_list_repairs({}), default=str),
+                "application/json")
         else:
             # tell metad where to scrape us (rides the heartbeat) —
             # set BEFORE svc.start() so the first heartbeat carries it

@@ -126,6 +126,20 @@ class LocalCluster:
         self.storageds[i].stop()
         self.storage_servers[i].stop()
 
+    def stop_metad(self, i: int):
+        """Hard-stop one metad (leader-kill injection for the repair /
+        failover tests — the surviving quorum elects a successor)."""
+        self.metads[i].stop()
+        self.meta_servers[i].stop()
+
+    def meta_leader_index(self) -> int:
+        """Index of the metad currently leading the meta group (-1 when
+        the group is mid-election)."""
+        for i, m in enumerate(self.metads):
+            if m.raft.is_leader():
+                return i
+        return -1
+
     def reconcile_storage(self):
         """Force every storaged to (re)create raft groups for its parts —
         tests call this right after CREATE SPACE instead of waiting a

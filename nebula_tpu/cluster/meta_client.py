@@ -41,6 +41,10 @@ class MetaClient:
         self.hb_interval = heartbeat_interval
         self.catalog = Catalog()
         self.part_map: Dict[str, List[List[str]]] = {}
+        # space → per-part learner lists (ISSUE 14): cached alongside
+        # the part map but NEVER consulted by routing — a catching-up
+        # learner serves no reads and takes no writes until promoted
+        self.learner_map: Dict[str, List[List[str]]] = {}
         # (space, pid) → last leader learned from a storaged's
         # "part_leader_changed: <addr>" hint (ISSUE 11 satellite).  An
         # overlay, not an edit of part_map: it survives refresh()
@@ -132,6 +136,7 @@ class MetaClient:
             if changed:
                 self.catalog = _unpk(r["catalog"])
                 self.part_map = r["part_map"]
+                self.learner_map = r.get("learner_map") or {}
             self.version = r["version"]
         if changed and self.on_refresh is not None:
             self.on_refresh()
@@ -272,12 +277,33 @@ class MetaClient:
         """Cluster-unique monotonic id range; returns the range start."""
         return self.call("meta.allocate_ids", count=count)["start"]
 
-    # -- balance plane (BALANCE DATA / BALANCE LEADER) --
+    # -- balance / repair plane (BALANCE DATA / auto-repair, ISSUE 14) --
 
     def set_part_replicas(self, space: str, part: int, replicas):
         self.call("meta.set_part_replicas", space=space, part=part,
                   replicas=list(replicas))
         self.refresh(force=True)
+
+    def learners_of(self, space: str) -> List[List[str]]:
+        """Per-part learner lists (cached; padded to the part count)."""
+        pm = self.parts_of(space)
+        with self.lock:
+            lm = self.learner_map.get(space) or []
+        return [list(lm[pid]) if pid < len(lm) else []
+                for pid in range(len(pm))]
+
+    def set_part_learners(self, space: str, part: int, learners):
+        self.call("meta.set_part_learners", space=space, part=part,
+                  learners=list(learners))
+        self.refresh(force=True)
+
+    def promote_learner(self, space: str, part: int, host: str):
+        self.call("meta.promote_learner", space=space, part=part,
+                  host=host)
+        self.refresh(force=True)
+
+    def list_repairs(self):
+        return self.call("meta.list_repairs")
 
     def transfer_leader(self, space: str, part: int, to: str):
         self.call("meta.transfer_leader", space=space, part=part, to=to)

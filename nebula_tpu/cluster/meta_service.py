@@ -28,6 +28,7 @@ from typing import Any, Dict, List, Optional
 from ..graphstore import schema_wire
 from ..graphstore.schema import Catalog, SchemaError
 from .raft import RaftPart, RaftTransport
+from .repair import PartSupervisor
 from .rpc import RpcError, RpcServer
 
 HB_EXPIRE_S = 10.0
@@ -95,6 +96,16 @@ class MetaState:
         self.catalog = Catalog()
         # space name → [ [replica addrs...] per part ]; [0] is the leader
         self.part_map: Dict[str, List[List[str]]] = {}
+        # space name → [ [learner addrs...] per part ] — catching-up
+        # replicas (ISSUE 14): they ride replication but are invisible
+        # to routing (parts_of serves voters only) and to quorum until
+        # promote_learner moves them into the part map
+        self.learner_map: Dict[str, List[List[str]]] = {}
+        # rid → repair plan row (the raft-persisted RepairPlan of the
+        # PartSupervisor: phase/status survive metad restarts and
+        # leader failovers, so a half-driven repair resumes)
+        self.repairs: Dict[int, Dict[str, Any]] = {}
+        self.next_repair = 1
         self.sessions: Dict[int, Dict[str, Any]] = {}
         self.next_session = 1
         self.configs: Dict[str, Any] = {}
@@ -137,6 +148,7 @@ class MetaState:
     def _ap_drop_space(self, c):
         self.catalog.drop_space(c["name"], if_exists=c["if_exists"])
         self.part_map.pop(c["name"], None)
+        self.learner_map.pop(c["name"], None)
 
     def _ap_create_session(self, c):
         sid = self.next_session
@@ -253,6 +265,12 @@ class MetaState:
                         raise RpcError(
                             f"host {h} still holds {sp}/part {pid}; "
                             f"run BALANCE DATA REMOVE first")
+            for sp, lm in self.learner_map.items():
+                for pid, ls in enumerate(lm):
+                    if h in ls:
+                        raise RpcError(
+                            f"host {h} is still a learner of {sp}/part "
+                            f"{pid}; wait for the repair to finish")
         for h in c["hosts"]:
             for hs in self.zones.values():
                 if h in hs:
@@ -271,6 +289,66 @@ class MetaState:
         if pm is None or not (0 <= c["part"] < len(pm)):
             raise RpcError(f"no part {c['space']}/{c['part']}")
         pm[c["part"]] = list(c["replicas"])
+        # a host that became a voter can never linger as a learner
+        lm = self.learner_map.get(c["space"])
+        if lm and 0 <= c["part"] < len(lm):
+            lm[c["part"]] = [l for l in lm[c["part"]]
+                             if l not in c["replicas"]]
+
+    def learners_of(self, space: str) -> List[List[str]]:
+        """Per-part learner lists, padded to the part count (spaces
+        created before the learner plane existed have no entry)."""
+        pm = self.part_map.get(space)
+        if pm is None:
+            return []
+        lm = self.learner_map.setdefault(space, [])
+        while len(lm) < len(pm):
+            lm.append([])
+        return lm
+
+    def _ap_set_part_learners(self, c):
+        """Membership-change step (ISSUE 14): adopt a new learner list
+        for one part.  Learners never affect quorum, so this step is
+        always safe to (re)propose — the idempotency anchor of the
+        resumable task engine's add phase."""
+        pm = self.part_map.get(c["space"])
+        if pm is None or not (0 <= c["part"] < len(pm)):
+            raise RpcError(f"no part {c['space']}/{c['part']}")
+        lm = self.learners_of(c["space"])
+        lm[c["part"]] = [l for l in c["learners"]
+                         if l not in pm[c["part"]]]
+
+    def _ap_promote_learner(self, c):
+        """Promote a caught-up learner to voter as ONE deterministic
+        state change: leave the learner list, join the replica list.
+        The voter set grows by a member that already holds the log, so
+        the old and new configurations share a quorum."""
+        pm = self.part_map.get(c["space"])
+        if pm is None or not (0 <= c["part"] < len(pm)):
+            raise RpcError(f"no part {c['space']}/{c['part']}")
+        lm = self.learners_of(c["space"])
+        host = c["host"]
+        if host not in lm[c["part"]] and host not in pm[c["part"]]:
+            raise RpcError(
+                f"{host} is not a learner of {c['space']}/{c['part']}")
+        lm[c["part"]] = [l for l in lm[c["part"]] if l != host]
+        if host not in pm[c["part"]]:
+            pm[c["part"]].append(host)
+
+    def _ap_add_repair(self, c):
+        rid = self.next_repair
+        self.next_repair += 1
+        self.repairs[rid] = {
+            "space": c["space"], "part": c["part"], "dead": c["dead"],
+            "target": c["target"], "phase": c.get("phase", "add_learner"),
+            "status": "RUNNING", "created": c["ts"], "updated": c["ts"],
+            "error": None}
+        return rid
+
+    def _ap_update_repair(self, c):
+        r = self.repairs.get(c["rid"])
+        if r:
+            r.update(c["fields"])
 
 
 class MetaService:
@@ -286,6 +364,13 @@ class MetaService:
         self.state_lock = make_lock("meta_state")
         # addr → {"role", "last_hb" (monotonic), "parts": {space: [pids]}}
         self.active_hosts: Dict[str, Dict[str, Any]] = {}
+        # post-election liveness grace (ISSUE 14 satellite): liveness is
+        # leader-local, so a FRESH metad leader knows no heartbeats —
+        # every host would read dead until they re-arrive.  Until one
+        # full heartbeat interval of CONTINUOUS leadership has elapsed,
+        # silent hosts are UNKNOWN (not OFFLINE): never declared dead,
+        # never repaired against.  (term, leader-since monotonic).
+        self._leader_streak: Optional[tuple] = None
 
         if transport is None:
             from .rpc import RpcRaftTransport
@@ -300,6 +385,10 @@ class MetaService:
         if server is not None:
             server.service_role = "metad"
             server.register_service(self, prefix="meta.")
+
+        # automatic replica repair (ISSUE 14): scans liveness × part map
+        # on the leader, drives raft-persisted RepairPlans
+        self.supervisor = PartSupervisor(self)
 
     # -- raft plumbing ----------------------------------------------------
 
@@ -324,8 +413,10 @@ class MetaService:
 
     def start(self):
         self.raft.start()
+        self.supervisor.start()
 
     def stop(self):
+        self.supervisor.stop()
         self.raft.stop()
 
     def _propose(self, cmd: Dict[str, Any]):
@@ -364,13 +455,86 @@ class MetaService:
             return {"version": self.state.version,
                     "leader": self.raft.is_leader()}
 
-    def rpc_list_hosts(self, p):
+    def _grace_window_s(self) -> float:
+        """How long a fresh leader withholds OFFLINE verdicts: one full
+        heartbeat interval — every live host has beaten by then."""
+        try:
+            from ..utils.config import get_config
+            return max(float(get_config().get("heartbeat_interval_secs")),
+                       0.05)
+        except Exception:  # noqa: BLE001 — config not initialized
+            return 1.0
+
+    def _liveness_anchor(self) -> Optional[float]:
+        """Monotonic instant this metad's liveness view became
+        authoritative: leadership start + one grace window.  None while
+        not leading.  Before the anchor, a silent host is UNKNOWN; a
+        host's dead-clock can never start earlier than the anchor."""
+        if not self.raft.is_leader():
+            self._leader_streak = None
+            return None
+        term = self.raft.current_term
+        streak = self._leader_streak
+        if streak is None or streak[0] != term:
+            streak = self._leader_streak = (term, time.monotonic())
+        return streak[1] + self._grace_window_s()
+
+    def host_liveness(self) -> Dict[str, Dict[str, Any]]:
+        """addr → {role, status ONLINE|UNKNOWN|OFFLINE, parts, ws,
+        dead_for}: the union of heartbeating hosts and every host the
+        part/learner/zone maps reference — a fresh leader must LIST the
+        hosts it has never heard from (as UNKNOWN), not forget them."""
         now = time.monotonic()
         exp = _hb_expire_s()
+        anchor = self._liveness_anchor()
+        out: Dict[str, Dict[str, Any]] = {}
+        # snapshot: concurrent rpc_heartbeat handlers insert keys while
+        # the supervisor iterates (dict-changed-size RuntimeError)
+        for a, h in list(self.active_hosts.items()):
+            out[a] = {"role": h["role"], "parts": h["parts"],
+                      "ws": h.get("ws", ""), "last_hb": h["last_hb"]}
+        with self.state_lock:
+            placed = {r for pm in self.state.part_map.values()
+                      for reps in pm for r in reps}
+            placed |= {l for lm in self.state.learner_map.values()
+                       for ls in lm for l in ls}
+            placed |= {h for hs in self.state.zones.values() for h in hs}
+        for a in placed:
+            out.setdefault(a, {"role": "storage", "parts": {},
+                               "ws": "", "last_hb": None})
+        for a, h in out.items():
+            hb = h.pop("last_hb")
+            if hb is not None and now - hb < exp:
+                h["status"], h["dead_for"] = "ONLINE", 0.0
+                continue
+            # silent.  Its dead-clock starts when the heartbeat horizon
+            # passed — but never before the liveness anchor (a fresh
+            # leader's grace): continuity of death, not of suspicion.
+            dead_since = (hb + exp) if hb is not None else None
+            if anchor is None:
+                # not leading: no authority to call anyone dead
+                h["status"], h["dead_for"] = "UNKNOWN", 0.0
+                continue
+            dead_since = max(dead_since if dead_since is not None
+                             else anchor, anchor)
+            if now < dead_since:
+                h["status"], h["dead_for"] = "UNKNOWN", 0.0
+            else:
+                h["status"] = "OFFLINE"
+                h["dead_for"] = now - dead_since
+        return out
+
+    def rpc_list_hosts(self, p):
+        # liveness is leader-local: a follower's view is empty/stale,
+        # so it redirects the client to the leader like rpc_heartbeat
+        # (a fresh leader reports silent hosts as UNKNOWN, never DEAD,
+        # until one heartbeat interval of leadership passed — ISSUE 14)
+        self._require_leader()
         return [{"addr": a, "role": h["role"],
-                 "alive": now - h["last_hb"] < exp,
+                 "alive": h["status"] == "ONLINE",
+                 "status": h["status"],
                  "parts": h["parts"], "ws": h.get("ws", "")}
-                for a, h in sorted(self.active_hosts.items())]
+                for a, h in sorted(self.host_liveness().items())]
 
     def storage_hosts(self) -> List[str]:
         now = time.monotonic()
@@ -451,7 +615,8 @@ class MetaService:
                         "part_map": None}
             return {"version": self.state.version,
                     "catalog": _pk(self.state.catalog),
-                    "part_map": self.state.part_map}
+                    "part_map": self.state.part_map,
+                    "learner_map": self.state.learner_map}
 
     def rpc_part_map(self, p):
         with self.state_lock:
@@ -568,3 +733,27 @@ class MetaService:
         return self._propose({"op": "set_part_replicas",
                               "space": p["space"], "part": p["part"],
                               "replicas": p["replicas"]})
+
+    # -- repair plane (ISSUE 14): learners + raft-persisted plans ---------
+
+    def rpc_set_part_learners(self, p):
+        return self._propose({"op": "set_part_learners",
+                              "space": p["space"], "part": p["part"],
+                              "learners": p["learners"]})
+
+    def rpc_promote_learner(self, p):
+        return self._propose({"op": "promote_learner",
+                              "space": p["space"], "part": p["part"],
+                              "host": p["host"]})
+
+    def rpc_part_learners(self, p):
+        with self.state_lock:
+            if p["space"] not in self.state.part_map:
+                raise RpcError(f"space `{p['space']}' not found")
+            return [list(ls) for ls in
+                    self.state.learners_of(p["space"])]
+
+    def rpc_list_repairs(self, p):
+        with self.state_lock:
+            return [{"rid": k, **v}
+                    for k, v in sorted(self.state.repairs.items())]

@@ -20,6 +20,7 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from ..utils import trace as _trace
 from ..utils.config import define_flag, get_config
 from ..utils.failpoints import FailpointError, fail
 from .wal import Wal
@@ -122,10 +123,19 @@ class RaftPart:
                  election_timeout: Tuple[float, float] = (0.15, 0.30),
                  heartbeat_interval: float = 0.05,
                  snapshot_threshold: int = 10_000,
-                 wal_sync: bool = True):
+                 wal_sync: bool = True,
+                 learners: Optional[List[str]] = None):
         self.group = group
         self.node_id = node_id
+        # voting members ONLY — quorum math (elections, commit advance,
+        # lease) runs over `peers`; learners ride replication but never
+        # count (ISSUE 14: repair can never wedge a live group)
         self.peers = [p for p in peers if p != node_id]
+        # learner (non-voting) replicas: receive append_entries and
+        # snapshot install like followers, but are invisible to every
+        # quorum computation and never campaign or grant votes until
+        # promoted (update_peers moves them into the voter set)
+        self.learners = [l for l in (learners or []) if l not in self.peers]
         self.transport = transport
         self.apply_cb = apply_cb
         self.snapshot_cb = snapshot_cb
@@ -250,6 +260,12 @@ class RaftPart:
 
     def _start_election(self):
         with self.lock:
+            if self.node_id in self.learners:
+                # a learner NEVER campaigns: it holds no vote, and a
+                # catching-up replica's (complete-looking) log must not
+                # be able to take leadership from the live voters
+                self._reset_election_deadline()
+                return
             if len(self.peers) == 0:
                 # single-node group: become leader immediately
                 self.current_term += 1
@@ -306,8 +322,8 @@ class RaftPart:
         # previous terms' entries after a full-group restart
         self.wal.append(self.wal.last_index() + 1, self.current_term, b"")
         nxt = self.wal.last_index() + 1
-        self.next_index = {p: nxt - 1 for p in self.peers}
-        self.match_index = {p: 0 for p in self.peers}
+        self.next_index = {p: nxt - 1 for p in self._repl_targets()}
+        self.match_index = {p: 0 for p in self._repl_targets()}
         self._last_hb = 0.0
         if not self.peers:
             self.commit_index = self.wal.last_index()
@@ -329,6 +345,13 @@ class RaftPart:
 
     # -- replication ------------------------------------------------------
 
+    def _repl_targets(self) -> List[str]:
+        """Everything the leader ships entries to: voting peers plus
+        learner replicas (which receive appends/snapshot install but
+        never count toward the quorum _advance_commit computes)."""
+        return self.peers + [l for l in self.learners
+                             if l != self.node_id and l not in self.peers]
+
     def _replicate_all(self):
         """Kick the per-peer replicator threads.
 
@@ -342,7 +365,7 @@ class RaftPart:
             if self.state != LEADER:
                 return
             self._last_hb = time.monotonic()
-            for p in self.peers:
+            for p in self._repl_targets():
                 t = self._repl_threads.get(p)
                 if t is None or not t.is_alive():
                     t = threading.Thread(
@@ -359,7 +382,7 @@ class RaftPart:
         while True:
             with self.lock:
                 if not self.alive or self.state != LEADER \
-                        or peer not in self.peers:
+                        or peer not in self._repl_targets():
                     return
             ok = self._replicate_one(peer)
             self._advance_commit()
@@ -508,30 +531,51 @@ class RaftPart:
 
     # -- membership / leadership (BALANCE DATA / BALANCE LEADER) ----------
 
-    def update_peers(self, replicas: List[str]):
-        """Adopt a new replica set (the balance plan's membership change;
-        reference raftex addPeer/removePeer).
+    def update_peers(self, replicas: List[str],
+                     learners: Optional[List[str]] = None):
+        """Adopt a new replica configuration (the balance/repair plan's
+        membership change; reference raftex addPeer/removePeer).
+        `learners=None` keeps the current learner set (legacy callers).
 
         Not joint consensus: the change is instantaneous on each member.
         Safety comes from the orchestration protocol — the part map is
-        itself serialized through the metad raft group, and BALANCE
-        applies changes add-THEN-remove (never both in one step), so any
-        two consecutive configurations share a quorum."""
+        itself serialized through the metad raft group, and the shared
+        membership engine (cluster/repair.py) applies changes with one
+        side per step (add XOR remove; a learner→voter promotion only
+        GROWS the voter set by an already-caught-up member), so any two
+        consecutive configurations share a quorum."""
+        promoted: List[str] = []
         with self.lock:
             new = [p for p in replicas if p != self.node_id]
-            if new == self.peers:
+            # a node named in `replicas` is a voter, full stop — it can
+            # never linger in the learner set (promotion removes it)
+            new_learners = [l for l in (self.learners if learners is None
+                                        else learners)
+                            if l not in replicas]
+            if new == self.peers and new_learners == self.learners:
                 return
+            was_learner = set(self.learners)
+            promoted = [p for p in replicas
+                        if p in was_learner and p not in new_learners]
             self.peers = new
+            self.learners = new_learners
             if self.state == LEADER:
                 nxt = self.wal.last_index() + 1
-                for p in new:
+                targets = self._repl_targets()
+                for p in targets:
                     self.next_index.setdefault(p, max(1, nxt - 1))
                     self.match_index.setdefault(p, 0)
                 for p in list(self.next_index):
-                    if p not in new:
+                    if p not in targets:
                         self.next_index.pop(p, None)
                         self.match_index.pop(p, None)
             self._repl_cv.notify_all()
+        if promoted:
+            # a caught-up learner became a voter: from here its acks
+            # count toward quorum and it may campaign / grant votes
+            fail.hit("raft:promote_learner", key=self.group)
+            _trace.record_phase("raft:promote_learner", 0.0,
+                                group=self.group, peers=promoted)
         if self.is_leader():
             self._replicate_all()   # new follower gets snapshot/catch-up
 
@@ -634,7 +678,12 @@ class RaftPart:
             if self.state == LEADER:
                 if not self.peers:
                     return 0.0
-                acks = sorted(self._last_ack.values(), reverse=True)
+                # VOTER acks only: learner replication also lands in
+                # _last_ack, but a learner's ack proves nothing about
+                # quorum freshness (a deposed leader kept fresh by its
+                # learner must still go stale here)
+                acks = sorted((v for p, v in self._last_ack.items()
+                               if p in self.peers), reverse=True)
                 need = (len(self.peers) + 1) // 2   # peers for a quorum
                 if len(acks) < need:
                     return float("inf")
@@ -860,6 +909,11 @@ class RaftPart:
         with self.lock:
             if p["term"] > self.current_term:
                 self._step_down(p["term"])
+            if self.node_id in self.learners:
+                # a learner holds NO vote: even a candidate with a stale
+                # config that asks must not be able to count us toward
+                # its majority (unit-asserted, ISSUE 14)
+                return {"term": self.current_term, "granted": False}
             granted = False
             if p["term"] == self.current_term and \
                     self.voted_for in (None, p["candidate"]):

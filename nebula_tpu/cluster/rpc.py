@@ -462,6 +462,13 @@ class RpcServer:
         self._inbox = 0
         self._inbox_mu = threading.Lock()
         self._inbox_drain = DrainEstimator()
+        # a stopped server must stop SERVING, not just accepting:
+        # shutdown() only ends the accept loop, while established
+        # (pooled-client) connections would keep answering from their
+        # handler threads — a "killed" daemon zombie-serving stale
+        # state (ISSUE 14: a dead metad kept reporting liveness, a
+        # dead storaged kept claiming part leadership)
+        self._stopped = threading.Event()
         outer = self
 
         class Handler(socketserver.BaseRequestHandler):
@@ -473,6 +480,8 @@ class RpcServer:
                 try:
                     while True:
                         req, _, rid = _recv_frame(sock)
+                        if outer._stopped.is_set():
+                            break       # drop the connection, no reply
                         if rid is None:
                             outer._serve_one(sock, wlock, None, req)
                             continue
@@ -711,6 +720,7 @@ class RpcServer:
         self._thread.start()
 
     def stop(self):
+        self._stopped.set()
         self._server.shutdown()
         self._server.server_close()
 

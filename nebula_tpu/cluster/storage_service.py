@@ -230,20 +230,29 @@ class StorageService:
         self.store.catalog = self.meta.catalog
         with self.meta.lock:
             pm = dict(self.meta.part_map)
+            lm = {sp: [list(ls) for ls in lss]
+                  for sp, lss in self.meta.learner_map.items()}
         sid_to_name = {sp.space_id: n
                        for n, sp in self.meta.catalog.spaces.items()}
         for space_name, parts in pm.items():
             sp = self.meta.catalog.spaces.get(space_name)
             if sp is None:
                 continue
+            sp_learners = lm.get(space_name, [])
             for pid, replicas in enumerate(parts):
-                if self.my_addr not in replicas:
+                learners = list(sp_learners[pid]) \
+                    if pid < len(sp_learners) else []
+                if self.my_addr not in replicas \
+                        and self.my_addr not in learners:
                     continue
                 key = (sp.space_id, pid)
                 with self.parts_lock:
                     existing = self.parts.get(key)
                     if existing is not None:
-                        existing.update_peers(list(replicas))
+                        # adopting the new config may PROMOTE a learner
+                        # (ISSUE 14): from here its acks count toward
+                        # quorum and it may vote
+                        existing.update_peers(list(replicas), learners)
                         continue
                     gname = self._group_name(sp.space_id, pid)
                     part = RaftPart(
@@ -254,7 +263,8 @@ class StorageService:
                         # replay on restart + serves laggard catch-up
                         snapshot_cb=self._make_snapshot(space_name, pid),
                         restore_cb=self._make_restore(space_name, pid),
-                        snapshot_threshold=2000)
+                        snapshot_threshold=2000,
+                        learners=learners)
                     self.parts[key] = part
                 part.start()
         # drop parts this host no longer replicates — pop under the lock,
@@ -269,7 +279,10 @@ class StorageService:
                 space_parts = pm.get(name, []) if name else []
                 replicas = space_parts[pid] if pid < len(space_parts) \
                     else None
-                if replicas is None or self.my_addr not in replicas:
+                sp_l = lm.get(name, []) if name else []
+                learners = sp_l[pid] if pid < len(sp_l) else []
+                if replicas is None or (self.my_addr not in replicas
+                                        and self.my_addr not in learners):
                     dropped.append((self.parts.pop(key), name, pid))
         for part, name, pid in dropped:
             part.stop()
@@ -544,6 +557,11 @@ class StorageService:
         if lvl not in _consistency.LEVELS:
             raise RpcError(f"unknown consistency level {lvl!r}")
         part = self._local_part(space, pid)
+        if part.node_id in part.learners:
+            # a catching-up learner (ISSUE 14) serves NOTHING — not even
+            # bounded_stale: its applied index is mid-install and the
+            # part map never routes here, so any arrival is a stale map
+            raise RpcError(f"part_leader_changed: {part.leader_id or ''}")
         fail.hit("storage:follower_read", key=f"{part.group}|{lvl}")
         min_applied = int(p.get("min_applied") or 0)
         if lvl == _consistency.BOUNDED_STALE:
@@ -867,10 +885,17 @@ class StorageService:
         before removing the old one."""
         sp = self.meta.catalog.spaces.get(p["space"])
         part = self.parts.get((sp.space_id, p["part"])) if sp else None
-        if part is None:
+        if part is None or not part.alive:
+            # a STOPPED part must answer like a missing one: its state
+            # fields freeze at stop time (`state` can still read
+            # "leader"), and a membership engine that believed a
+            # zombie's leadership would anchor catch-up on a commit
+            # index nobody serves anymore (ISSUE 14)
             raise RpcError(f"part {p['space']}/{p['part']} not here")
         with part.lock:
             return {"is_leader": part.state == "leader",
+                    "is_learner": part.node_id in part.learners,
+                    "learners": list(part.learners),
                     "term": part.current_term,
                     "commit_index": part.commit_index,
                     "last_applied": part.last_applied,

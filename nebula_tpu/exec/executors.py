@@ -1680,9 +1680,12 @@ def _show(node, qctx, ectx, space):
                               for reps in parts if reps[:1] == [h["addr"]])
                 dist = ", ".join(f"{sp}:{len(pids)}" for sp, pids in
                                  sorted(h["parts"].items())) or "No valid partition"
-                rows.append([host, int(port),
-                             "ONLINE" if h["alive"] else "OFFLINE",
-                             leaders, dist])
+                # a fresh metad leader reports UNKNOWN (not OFFLINE)
+                # for hosts it has not heard from yet (ISSUE 14: the
+                # post-election liveness grace — never declared dead)
+                status = h.get("status") or \
+                    ("ONLINE" if h["alive"] else "OFFLINE")
+                rows.append([host, int(port), status, leaders, dist])
             return DataSet(["Host", "Port", "Status", "Leader count",
                             "Partition distribution"], rows)
         return DataSet(["Host", "Port", "Status", "Leader count",
@@ -1782,6 +1785,20 @@ def _show(node, qctx, ectx, space):
                  int(s.last_used), len(s.queries), "in-process"]
                 for s in (list(eng.sessions.values()) if eng else ())]
         return DataSet(scols, sorted(rows))
+    if kind == "repairs":
+        # auto-repair plans (ISSUE 14): the metad leader's raft-
+        # persisted RepairPlan table — visible from every graphd, like
+        # SHOW JOBS.  Standalone stores have no repair plane.
+        rcols = ["Repair Id", "Space", "Part", "Dead Host", "Target",
+                 "Phase", "Status", "Created", "Updated", "Error"]
+        cluster = getattr(qctx, "cluster", None)
+        if cluster is None:
+            return DataSet(rcols, [])
+        return DataSet(rcols, [
+            [r["rid"], r["space"], r["part"], r["dead"], r["target"],
+             r["phase"], r["status"], int(r.get("created") or 0),
+             int(r.get("updated") or 0), r.get("error")]
+            for r in cluster.list_repairs()])
     if kind == "snapshots":
         from .jobs import list_snapshots
         return list_snapshots()

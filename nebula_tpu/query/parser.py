@@ -803,7 +803,7 @@ class Parser:
                                       scope if scope == "local" else None)
             if kw in ("SPACES", "PARTS", "STATS", "JOBS", "SESSIONS",
                       "SNAPSHOTS", "BACKUPS", "QUERIES", "CONFIGS",
-                      "TRACES", "STALLS"):
+                      "TRACES", "STALLS", "REPAIRS"):
                 self.next()
                 if kw == "JOBS":
                     return A.ShowJobsSentence()

@@ -36,7 +36,7 @@ KEYWORDS = {
     "STATUS",
     "META", "GRAPH", "STORAGE", "DOWNLOAD", "HDFS",
     "BACKUP", "BACKUPS", "RESTORE", "NEW", "LOCAL", "TRACES",
-    "FLIGHT", "RECORDER", "SLO", "STALLS", "CALL",
+    "FLIGHT", "RECORDER", "SLO", "STALLS", "CALL", "REPAIRS",
     # types
     "INT", "INT64", "INT32", "INT16", "INT8", "FLOAT", "DOUBLE", "STRING",
     "FIXED_STRING", "BOOL", "TIMESTAMP", "DATE", "TIME", "DATETIME",

@@ -23,6 +23,9 @@ indented span tree, and diff counters over time.
     python -m nebula_tpu.tools.metrics_dump --addr ... --queries
     python -m nebula_tpu.tools.metrics_dump --addr ... --stalls
 
+    # auto-repair plans from a metad (ISSUE 14)
+    python -m nebula_tpu.tools.metrics_dump --addr <metad-ws> --repairs
+
     # Perfetto: every trace tree (+ stall captures) as Chrome
     # trace-event JSON, one track per daemon/service, device spans
     # included — open the file at https://ui.perfetto.dev
@@ -187,6 +190,22 @@ def dump_queries(addr: str) -> int:
     return len(qs)
 
 
+def dump_repairs(addr: str) -> int:
+    """Auto-repair plans (GET /repairs on a metad, ISSUE 14): the
+    raft-persisted RepairPlan table the PartSupervisor drives — one
+    line per plan with its phase/status, newest last."""
+    entries = json.loads(_fetch(addr, "/repairs"))
+    for r in entries:
+        err = f"  err={r['error']}" if r.get("error") else ""
+        # target is None for remove-only plans (live members already
+        # satisfy rf; only the dead replica needs dropping)
+        tgt = r["target"] if r.get("target") else "-"
+        print(f"#{r['rid']:<4} {r['space']}/{r['part']:<3} "
+              f"dead={r['dead']:<22} target={tgt:<22} "
+              f"{r['phase']:<12} {r['status']:<8}{err}")
+    return len(entries)
+
+
 def dump_stalls(addr: str, entry_id: str = "") -> int:
     if entry_id:
         print(_fetch(addr, f"/stalls?id={entry_id}"))
@@ -339,6 +358,9 @@ def main(argv=None) -> int:
                          "dispatch table (GET /queries)")
     ap.add_argument("--stalls", action="store_true",
                     help="stall-watchdog captures (GET /stalls)")
+    ap.add_argument("--repairs", action="store_true",
+                    help="auto-repair plans from a metad "
+                         "(GET /repairs): phase/status per plan")
     ap.add_argument("--stall-id", default="",
                     help="print one stall capture in full (thread "
                          "stacks, dispatch table, kernel ledger)")
@@ -363,7 +385,8 @@ def main(argv=None) -> int:
     one = addrs[0]
     if len(addrs) > 1 and (args.trace or args.traces or args.flight
                            or args.flight_id or args.queries
-                           or args.stalls or args.stall_id):
+                           or args.stalls or args.stall_id
+                           or args.repairs):
         # traces/flight/workload entries are per-process state, not
         # mergeable samples — be explicit about which host answers
         print(f"note: --traces/--trace/--flight/--queries/--stalls "
@@ -373,6 +396,8 @@ def main(argv=None) -> int:
             dump_perfetto(addrs, args.perfetto)
         elif args.queries:
             dump_queries(one)
+        elif args.repairs:
+            dump_repairs(one)
         elif args.stalls or args.stall_id:
             dump_stalls(one, args.stall_id)
         elif args.trace:

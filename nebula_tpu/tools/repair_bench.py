@@ -1,0 +1,197 @@
+"""repair-bench — time-to-full-redundancy under a permanent host kill
+(ISSUE 14; the self-healing mirror of overload_bench.py).
+
+The headline question of auto-repair: when one of a part's three
+replicas dies for good under live read/write load, how long until the
+cluster is back at FULL redundancy (every part rf-replicated on live
+hosts, `under_replicated_parts` == 0) with NO operator action — and
+how deep does goodput dip while the repair plane snapshot-installs the
+replacement replicas?
+
+Method: stand up a LocalCluster (1 metad / 4 storaged / 1 graphd),
+create an rf=3 space (each part: three replicas, one spare host), run
+closed-loop mixed INSERT/FETCH workers, hard-kill one storaged
+mid-run, and poll the meta part map + repair table until every part is
+healed.  Reported:
+
+  time_to_full_redundancy_s   kill → part map fully rf=3 on live hosts
+                              (includes the liveness horizon + grace —
+                              the honest operator-visible number)
+  goodput_before/during/after statements/s in each phase
+  goodput_dip_ratio           worst during-repair rate vs before-kill
+  acked_lost / wrong_rows     acked writes missing / wrong after heal
+                              (must both be 0)
+  repairs_done / failed       plan outcomes from the repair table
+
+Usage:
+    python -m nebula_tpu.tools.repair_bench
+    python -m nebula_tpu.tools.repair_bench --rows 400 --duration 6
+
+Emits one JSON object on stdout; bench.py folds it into the
+`self_heal` block (acceptance: acked_lost == wrong_rows == 0 and the
+part map reaches full redundancy unattended).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import shutil
+import tempfile
+import threading
+import time
+from typing import Any, Dict, List
+
+
+def run_self_heal(rows: int = 300, parts: int = 4, duration_s: float = 8.0,
+                  workers: int = 4, heal_timeout_s: float = 60.0,
+                  data_dir: str = "") -> Dict[str, Any]:
+    from nebula_tpu.cluster.launcher import LocalCluster
+    from nebula_tpu.utils.config import get_config
+    from nebula_tpu.utils.stats import stats
+
+    cfg = get_config()
+    saved = {k: cfg.get(k) for k in
+             ("host_hb_expire_secs", "repair_grace_secs",
+              "repair_scan_interval_secs")}
+    cfg.set_dynamic_many({"host_hb_expire_secs": 0.6,
+                          "repair_grace_secs": 0.8,
+                          "repair_scan_interval_secs": 0.1})
+    tmp = data_dir or tempfile.mkdtemp(prefix="repair_bench_")
+    cluster = LocalCluster(n_meta=1, n_storage=4, n_graph=1,
+                           data_dir=tmp)
+    acked: Dict[int, int] = {}
+    acked_mu = threading.Lock()
+    ok_times: List[float] = []
+    stop = threading.Event()
+    try:
+        cl = cluster.client()
+        for q in (f"CREATE SPACE heal(partition_num={parts}, "
+                  f"replica_factor=3, vid_type=INT64)",):
+            r = cl.execute(q)
+            assert r.error is None, r.error
+        cluster.reconcile_storage()
+        cl.execute("USE heal")
+        r = cl.execute("CREATE TAG item(x int)")
+        assert r.error is None, r.error
+        vals = ", ".join(f"{i}:({i})" for i in range(rows))
+        r = cl.execute(f"INSERT VERTEX item(x) VALUES {vals}")
+        assert r.error is None, r.error
+
+        def worker(wid: int):
+            c = cluster.client()
+            c.execute("USE heal")
+            j = 0
+            while not stop.is_set():
+                vid = 10_000 + wid * 100_000 + j
+                r = c.execute(f"INSERT VERTEX item(x) VALUES "
+                              f"{vid}:({vid % 997})")
+                now = time.monotonic()
+                if r.error is None:
+                    with acked_mu:
+                        acked[vid] = vid % 997
+                        ok_times.append(now)
+                r = c.execute(f"FETCH PROP ON item {j % rows} "
+                              f"YIELD item.x AS x")
+                if r.error is None:
+                    with acked_mu:
+                        ok_times.append(time.monotonic())
+                j += 1
+            c.close()
+
+        threads = [threading.Thread(target=worker, args=(w,), daemon=True)
+                   for w in range(workers)]
+        for t in threads:
+            t.start()
+        warm_s = max(duration_s / 4.0, 1.0)
+        time.sleep(warm_s)
+        dead = cluster.storage_servers[0].addr
+        t_kill = time.monotonic()
+        cluster.stop_storaged(0)
+
+        meta = cluster.graphds[0].meta
+        healed_at = None
+        deadline = time.monotonic() + heal_timeout_s
+        while time.monotonic() < deadline:
+            meta.refresh(force=True)
+            pm = meta.parts_of("heal")
+            if all(dead not in reps and len(reps) == 3 for reps in pm):
+                healed_at = time.monotonic()
+                break
+            time.sleep(0.2)
+        time.sleep(max(duration_s - warm_s, 1.0))
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+
+        # verify every acked write against the healed replica set
+        lost = wrong = 0
+        with acked_mu:
+            sample = sorted(acked.items())
+        for i in range(0, len(sample), 64):
+            chunk = sample[i:i + 64]
+            r = cl.execute("FETCH PROP ON item " +
+                           ", ".join(str(v) for v, _ in chunk) +
+                           " YIELD id(vertex) AS v, item.x AS x")
+            assert r.error is None, r.error
+            got = {int(v): int(x) for v, x in r.data.rows}
+            for vid, want in chunk:
+                if vid not in got:
+                    lost += 1
+                elif got[vid] != want:
+                    wrong += 1
+
+        def rate(lo: float, hi: float) -> float:
+            n = sum(1 for t in ok_times if lo <= t < hi)
+            return round(n / max(hi - lo, 1e-9), 1)
+
+        t_end = max(ok_times) if ok_times else t_kill
+        before = rate(t_kill - warm_s, t_kill)
+        during_hi = healed_at if healed_at is not None else t_end
+        during = rate(t_kill, max(during_hi, t_kill + 1e-3))
+        after = rate(during_hi, max(t_end, during_hi + 1e-3))
+        repairs = meta.list_repairs()
+        snap = stats().snapshot()
+        return {
+            "rows_seeded": rows, "workers": workers,
+            "dead_host": dead,
+            "healed": healed_at is not None,
+            "time_to_full_redundancy_s":
+                round(healed_at - t_kill, 2) if healed_at else None,
+            "goodput_before_qps": before,
+            "goodput_during_repair_qps": during,
+            "goodput_after_qps": after,
+            "goodput_dip_ratio":
+                round(during / before, 3) if before else None,
+            "acked_writes": len(sample),
+            "acked_lost": lost, "wrong_rows": wrong,
+            "repairs_done": sum(1 for r in repairs
+                                if r["status"] == "DONE"),
+            "repairs_failed": sum(1 for r in repairs
+                                  if r["status"] == "FAILED"),
+            "under_replicated_parts_final":
+                snap.get("under_replicated_parts"),
+        }
+    finally:
+        stop.set()
+        cfg.set_dynamic_many(saved)
+        cluster.stop()
+        if not data_dir:
+            shutil.rmtree(tmp, ignore_errors=True)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="repair-bench")
+    ap.add_argument("--rows", type=int, default=300)
+    ap.add_argument("--parts", type=int, default=4)
+    ap.add_argument("--duration", type=float, default=8.0)
+    ap.add_argument("--workers", type=int, default=4)
+    args = ap.parse_args(argv)
+    out = run_self_heal(rows=args.rows, parts=args.parts,
+                        duration_s=args.duration, workers=args.workers)
+    print(json.dumps(out, indent=2))
+    return 0 if out["healed"] and not out["acked_lost"] \
+        and not out["wrong_rows"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

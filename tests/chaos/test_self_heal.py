@@ -1,0 +1,258 @@
+"""Cluster self-healing chaos proof (ISSUE 14 tentpole).
+
+A storaged dies PERMANENTLY under mixed read/write load and the
+cluster restores full replication with NO operator action:
+
+  * every acked write survives exactly once, zero wrong rows;
+  * `under_replicated_parts` returns to 0 unattended;
+  * the NEW replica set (repair targets included) converges
+    byte-identically;
+  * a repair plan survives a metad leader kill mid-plan (the
+    raft-persisted phase resumes on the successor);
+  * a flapping host (heartbeats pause < grace, then resume) triggers
+    NO repair — the hysteresis against data-move thrash;
+  * `UPDATE CONFIGS repair_enabled=false` is an effective kill switch.
+
+Marked `chaos` + `slow`: NOT part of the tier-1 gate.
+"""
+import threading
+import time
+
+import pytest
+
+from nebula_tpu.utils.config import get_config
+from nebula_tpu.utils.failpoints import fail
+from nebula_tpu.utils.stats import stats
+
+from harness import (ChaosCluster, assert_acked_exactly_once,
+                     mixed_workload)
+
+pytestmark = [pytest.mark.chaos, pytest.mark.slow]
+
+_FAST_REPAIR = {"host_hb_expire_secs": 0.6,
+                "repair_grace_secs": 0.8,
+                "repair_scan_interval_secs": 0.1}
+_DEFAULTS = {"host_hb_expire_secs": 10.0,
+             "repair_grace_secs": 60.0,
+             "repair_scan_interval_secs": 0.5,
+             "repair_enabled": True}
+
+
+def _set_flags(d):
+    get_config().set_dynamic_many(d)
+
+
+def _meta(cc: ChaosCluster):
+    return cc.cluster.meta_clients[0]
+
+
+def _wait_healed(cc: ChaosCluster, dead_addr: str, rf: int = 3,
+                 timeout: float = 60.0):
+    """Poll until every part's replica set is rf live hosts with the
+    dead one gone, and the supervisor's gauge agrees."""
+    meta = _meta(cc)
+    dl = time.monotonic() + timeout
+    while time.monotonic() < dl:
+        meta.refresh(force=True)
+        pm = meta.parts_of(cc.space)
+        if all(dead_addr not in reps and len(reps) == rf
+               for reps in pm):
+            snap = stats().snapshot()
+            if snap.get("under_replicated_parts") == 0:
+                return
+        time.sleep(0.3)
+    raise AssertionError(
+        f"never healed: part map {meta.parts_of(cc.space)}, "
+        f"repairs {meta.list_repairs()}")
+
+
+def test_permanent_storaged_kill_self_heals_under_load(tmp_path):
+    """The acceptance scenario: 4 storageds, rf=3, one killed for good
+    under mixed load.  Acked-exactly-once holds throughout, the part
+    map returns to full redundancy with zero operator statements, and
+    the promoted replica set converges byte-identically."""
+    _set_flags(_FAST_REPAIR)
+    cc = ChaosCluster(n_storage=4, replica_factor=3,
+                      data_dir=str(tmp_path))
+    try:
+        leds = []
+
+        def load(seed, base):
+            leds.append(mixed_workload(cc, seed, n_writes=120,
+                                       vid_base=base))
+
+        threads = [threading.Thread(target=load, args=(7 + i,
+                                                       1000 + 1000 * i),
+                                    daemon=True) for i in range(2)]
+        for t in threads:
+            t.start()
+        time.sleep(0.5)                 # writes in flight
+        victim = cc.leader_of_most_parts()
+        dead_addr = cc.cluster.storage_servers[victim].addr
+        cc.kill_storaged(victim)
+        for t in threads:
+            t.join(timeout=120)
+        assert not any(t.is_alive() for t in threads)
+
+        _wait_healed(cc, dead_addr)
+        # acked exactly once, zero wrong rows — against the healed set
+        for led in leds:
+            assert led.acked, "workload acked nothing"
+            assert_acked_exactly_once(cc, led)
+        # the NEW replica set (repair targets included) converges
+        # byte-identically: 3 live hosts now hold every part
+        cc.wait_replicas_converged(require=3)
+        # the plans that did it are visible and DONE
+        repairs = _meta(cc).list_repairs()
+        done = [r for r in repairs if r["status"] == "DONE"]
+        assert done, repairs
+        assert all(r["dead"] == dead_addr for r in repairs), repairs
+        snap = stats().snapshot()
+        assert snap.get("repair_tasks_done", 0) >= len(done)
+    finally:
+        _set_flags(_DEFAULTS)
+        cc.stop()
+
+
+def test_repair_resumes_across_metad_leader_kill_mid_plan(tmp_path):
+    """A RepairPlan is raft state: kill the metad leader while its
+    supervisor is mid-plan (held at a meta:repair_step failpoint) and
+    the successor's supervisor re-drives it from the recorded phase to
+    completion."""
+    _set_flags(_FAST_REPAIR)
+    cc = ChaosCluster(n_meta=3, n_storage=4, replica_factor=3,
+                      data_dir=str(tmp_path))
+    try:
+        led = mixed_workload(cc, seed=42, n_writes=60)
+        # hold the FIRST repair phases long enough to kill the leader
+        # mid-plan (every plan's first few steps stall 1.5s)
+        fail.arm("meta:repair_step", "4*delay(1.5)")
+        victim = cc.leader_of_most_parts()
+        dead_addr = cc.cluster.storage_servers[victim].addr
+        cc.kill_storaged(victim)
+        # wait for a plan row to exist (raft-persisted, still RUNNING)
+        dl = time.monotonic() + 30
+        while time.monotonic() < dl:
+            reps = _meta(cc).list_repairs()
+            if any(r["status"] == "RUNNING" for r in reps):
+                break
+            time.sleep(0.1)
+        else:
+            raise AssertionError("no repair plan materialized")
+        old = cc.cluster.meta_leader_index()
+        assert old >= 0
+        cc.cluster.stop_metad(old)
+        fail.disarm("meta:repair_step")
+        _wait_healed(cc, dead_addr)
+        assert_acked_exactly_once(cc, led)
+        cc.wait_replicas_converged(require=3)
+        repairs = _meta(cc).list_repairs()
+        assert any(r["status"] == "DONE" for r in repairs), repairs
+        assert not any(r["status"] == "RUNNING" for r in repairs), repairs
+    finally:
+        fail.disarm("meta:repair_step")
+        _set_flags(_DEFAULTS)
+        cc.stop()
+
+
+def test_flapping_host_triggers_no_repair(tmp_path):
+    """Hysteresis: a host whose heartbeats pause for less than
+    `repair_grace_secs` (twice) never becomes a repair target — the
+    dead-clock requires CONTINUOUS death, so a flapping host cannot
+    thrash part moves."""
+    get_config().set_dynamic_many({"host_hb_expire_secs": 0.4,
+                                   "repair_grace_secs": 1.2,
+                                   "repair_scan_interval_secs": 0.05})
+    cc = ChaosCluster(n_storage=3, replica_factor=3,
+                      data_dir=str(tmp_path))
+    try:
+        cc.ok('INSERT VERTEX Person(name, age) VALUES 1:("p1",11)')
+        mc = cc.cluster.meta_clients[2]      # storaged #2's heartbeat
+        for _ in range(2):
+            mc.stop_heartbeat()
+            time.sleep(0.9)     # dead ~0.5s — inside the grace
+            mc.start_heartbeat(parts_fn=mc._hb_parts_fn)
+            time.sleep(0.6)     # recovers, clock resets
+        time.sleep(1.0)
+        assert _meta(cc).list_repairs() == []
+        snap = stats().snapshot()
+        assert snap.get("repair_tasks_done", 0) == 0
+        assert snap.get("repair_tasks_failed", 0) == 0
+        # and the cluster is back to fully healthy in the gauge
+        dl = time.monotonic() + 10
+        while time.monotonic() < dl:
+            if stats().snapshot().get("under_replicated_parts") == 0:
+                break
+            time.sleep(0.1)
+        assert stats().snapshot().get("under_replicated_parts") == 0
+    finally:
+        _set_flags(_DEFAULTS)
+        cc.stop()
+
+
+def test_kill_switch_pauses_a_mid_flight_plan(tmp_path):
+    """Flipping `repair_enabled=false` while a plan is MID-FLIGHT stops
+    it at the next phase boundary; the plan stays RUNNING (not FAILED)
+    and resumes from its recorded phase when re-enabled."""
+    _set_flags(_FAST_REPAIR)
+    cc = ChaosCluster(n_storage=4, replica_factor=3,
+                      data_dir=str(tmp_path))
+    try:
+        cc.ok('INSERT VERTEX Person(name, age) VALUES 1:("p1",11)')
+        # hold every phase so the disable lands mid-plan
+        fail.arm("meta:repair_step", "-1*delay(0.4)")
+        victim = cc.leader_of_most_parts()
+        dead_addr = cc.cluster.storage_servers[victim].addr
+        cc.kill_storaged(victim)
+        dl = time.monotonic() + 30
+        while time.monotonic() < dl:
+            if any(r["status"] == "RUNNING"
+                   for r in _meta(cc).list_repairs()):
+                break
+            time.sleep(0.1)
+        else:
+            raise AssertionError("no repair plan materialized")
+        get_config().set_dynamic("repair_enabled", False)
+        fail.disarm("meta:repair_step")
+        # drivers die at the next phase boundary; nothing re-spawns
+        time.sleep(2.0)
+        before = {r["rid"]: (r["phase"], r["status"])
+                  for r in _meta(cc).list_repairs()}
+        assert any(st == "RUNNING" for _, st in before.values()), before
+        assert not any(st == "FAILED" for _, st in before.values()), \
+            before
+        time.sleep(2.0)
+        after = {r["rid"]: (r["phase"], r["status"])
+                 for r in _meta(cc).list_repairs()}
+        assert after == before, (before, after)   # frozen, not driven
+        get_config().set_dynamic("repair_enabled", True)
+        _wait_healed(cc, dead_addr)
+    finally:
+        fail.disarm("meta:repair_step")
+        _set_flags(_DEFAULTS)
+        cc.stop()
+
+
+def test_repair_enabled_false_is_a_kill_switch(tmp_path):
+    """`UPDATE CONFIGS repair_enabled=false`: a permanently dead host
+    past the grace creates NO plan; re-enabling heals unattended."""
+    get_config().set_dynamic_many({**_FAST_REPAIR,
+                                   "repair_enabled": False})
+    cc = ChaosCluster(n_storage=4, replica_factor=3,
+                      data_dir=str(tmp_path))
+    try:
+        cc.ok('INSERT VERTEX Person(name, age) VALUES 1:("p1",11)')
+        victim = cc.leader_of_most_parts()
+        dead_addr = cc.cluster.storage_servers[victim].addr
+        cc.kill_storaged(victim)
+        time.sleep(3.0)                 # way past expire + grace
+        assert _meta(cc).list_repairs() == []
+        # the degradation IS visible while repair is off
+        assert stats().snapshot().get("under_replicated_parts", 0) > 0
+        # flip the switch back on — the same dynamic path UPDATE
+        # CONFIGS uses — and the cluster heals
+        get_config().set_dynamic("repair_enabled", True)
+        _wait_healed(cc, dead_addr)
+    finally:
+        _set_flags(_DEFAULTS)
+        cc.stop()
