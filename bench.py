@@ -1117,6 +1117,28 @@ def main():
         overload = {"error": repr(ex)}
     _save_partial(platform, configs)
 
+    # ---- batching block (ISSUE 15): multi-lane batched dispatch A/B —
+    # the same small-GO offered-load sweep with batch_max_lanes off vs
+    # on.  Headlines: dispatches_per_stmt_on (< 0.5 = statements share
+    # launches), queue_wait_share_off_over_on (≥ 2 = the dispatch gate
+    # stops being the bottleneck), goodput rising (not falling) with
+    # offered load, rows byte-identical on vs off.
+    _mark("config batching: multi-lane batched dispatch A/B sweep")
+    try:
+        from nebula_tpu.tools.overload_bench import (
+            batch_sweep as _batch_sweep)
+        batching = _batch_sweep(
+            persons=int(os.environ.get("NEBULA_BENCH_BATCH_PERSONS",
+                                       1200)),
+            threads=int(os.environ.get("NEBULA_BENCH_BATCH_THREADS", 8)),
+            duration_s=float(os.environ.get("NEBULA_BENCH_BATCH_SECS",
+                                            3.0)),
+            lanes=int(os.environ.get("NEBULA_BENCH_BATCH_LANES", 16)),
+            tpu_runtime=rt)
+    except Exception as ex:  # noqa: BLE001 — must not sink the run
+        batching = {"error": repr(ex)}
+    _save_partial(platform, configs)
+
     # ---- read_scaleout block (ISSUE 11): goodput-vs-replica-count on
     # a read-heavy mix.  1 storaged / rf=1 leader-only vs 3 storaged /
     # rf=3 at follower consistency with the bounded storaged inbox
@@ -1343,6 +1365,7 @@ def main():
         "observability": observability,
         "concurrency": concurrency,
         "overload": overload,
+        "batching": batching,
         "read_scaleout": read_scaleout,
         "self_heal": self_heal,
         "algo": algo_block,
@@ -1378,6 +1401,12 @@ def main():
         # ISSUE 13: CALL algo.* device-vs-oracle aggregate (detail has
         # the per-algorithm split + per-iteration timings)
         hl["algo_x"] = algo_block["overall_speedup"]
+    if isinstance(batching, dict) and \
+            batching.get("dispatches_per_stmt_on") is not None:
+        # ISSUE 15: shared multi-lane launches — mean device launches
+        # per statement with batching on (detail has the full A/B:
+        # queue_wait_share off/on, goodput curve, lanes per batch)
+        hl["batch_disp_per_stmt"] = batching["dispatches_per_stmt_on"]
     if isinstance(self_heal, dict) and self_heal.get("healed"):
         # ISSUE 14: kill-one-of-three auto-repair — seconds from the
         # kill to full redundancy with zero acked-write loss (detail

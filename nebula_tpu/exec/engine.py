@@ -300,7 +300,7 @@ class QueryEngine:
         source for SHOW [LOCAL] QUERIES and the graphd fan-out RPC.
         Row shape: [sid, qid, user, text, status, operator, rows,
         duration_us, queue_us, device_us, host_us, memory_bytes,
-        consistency]."""
+        consistency, batch]."""
         from ..utils.workload import live_registry
         rows = []
         for s in list(self.sessions.values()):
@@ -313,11 +313,12 @@ class QueryEngine:
                                  p["duration_us"], p["queue_us"],
                                  p["device_us"], p["host_us"],
                                  p["memory_bytes"],
-                                 p.get("consistency", "")])
+                                 p.get("consistency", ""),
+                                 p.get("batch", "")])
                 else:
                     # workload plane disabled: identity columns only
                     rows.append([s.id, qid, s.user, qtext, "RUNNING",
-                                 "", 0, 0, 0, 0, 0, 0, ""])
+                                 "", 0, 0, 0, 0, 0, 0, "", ""])
         return rows
 
     def kill_running(self, sid=None, qid=None) -> bool:

@@ -1809,10 +1809,12 @@ def _show(node, qctx, ectx, space):
         # live workload rows (ISSUE 9): current plan node, rows so far,
         # queue-wait vs device vs host µs, memory charged — the columns
         # come straight from the engine's WorkloadRegistry rows
+        # Batch (ISSUE 15): "bid/lane" while the statement is enrolled
+        # in a multi-lane device batch (forming or in flight), else ""
         qcols = ["SessionId", "ExecutionPlanId", "User", "Query",
                  "Status", "Operator", "Rows", "DurationUs", "QueueUs",
                  "DeviceUs", "HostUs", "MemoryBytes", "Consistency",
-                 "GraphAddr"]
+                 "Batch", "GraphAddr"]
         cluster = getattr(qctx, "cluster", None)
         if a.get("extra") == "local":
             cluster = None      # SHOW LOCAL QUERIES: this graphd only

@@ -70,6 +70,41 @@ def _stat_totals(prefixes) -> Dict[str, float]:
 _SHED_COUNTERS = ("admission_shed", "overload_server_rejections")
 
 
+def _seed_graph(cluster, space: str, persons: int, degree: int,
+                replica_factor: int, rng_seed: int):
+    """Shared sweep fixture: create a Person/KNOWS space and load the
+    seeded random small-GO graph (one copy of the chunked-INSERT
+    recipe for the offered-load, read-scaleout and batching sweeps —
+    each keeps its own rng seed so historical bench shapes hold)."""
+    import numpy as np
+    cl = cluster.client()
+    assert cl.execute(
+        f"CREATE SPACE {space}(partition_num=8, "
+        f"replica_factor={replica_factor}, vid_type=INT64)").error is None
+    cluster.reconcile_storage()
+    for q in (f"USE {space}", "CREATE TAG Person(age int)",
+              "CREATE EDGE KNOWS(w int)"):
+        assert cl.execute(q).error is None, q
+    rng = np.random.default_rng(rng_seed)
+    B = 400
+    for lo in range(0, persons, B):
+        vals = ", ".join(f"{v}:({v % 90})"
+                         for v in range(lo, min(lo + B, persons)))
+        assert cl.execute(
+            f"INSERT VERTEX Person(age) VALUES {vals}").error is None
+    src = rng.integers(0, persons, persons * degree)
+    dst = rng.integers(0, persons, persons * degree)
+    keep = src != dst
+    src, dst = src[keep], dst[keep]
+    for lo in range(0, src.size, B):
+        vals = ", ".join(f"{s}->{d}:({int(s + d) % 100})"
+                         for s, d in zip(src[lo:lo + B].tolist(),
+                                         dst[lo:lo + B].tolist()))
+        assert cl.execute(
+            f"INSERT EDGE KNOWS(w) VALUES {vals}").error is None
+    cl.close()
+
+
 class _LevelResult:
     def __init__(self):
         self.lats: List[float] = []
@@ -155,8 +190,6 @@ def run_sweep(persons: int = 1200, degree: int = 5,
               queue_capacity: Optional[int] = None,
               inbox_capacity: int = 0,
               tpu_runtime=None, data_dir: Optional[str] = None) -> dict:
-    import numpy as np
-
     from nebula_tpu.cluster.launcher import LocalCluster
     from nebula_tpu.utils.admission import admission
     from nebula_tpu.utils.config import get_config
@@ -169,32 +202,10 @@ def run_sweep(persons: int = 1200, degree: int = 5,
     dyn_keys = ("max_running_queries", "admission_queue_capacity",
                 "rpc_server_inbox_capacity", "query_timeout_secs")
     try:
+        _seed_graph(cluster, space, persons, degree,
+                    replica_factor=3, rng_seed=31)
         cl = cluster.client()
-        assert cl.execute(
-            f"CREATE SPACE {space}(partition_num=8, replica_factor=3, "
-            f"vid_type=INT64)").error is None
-        cluster.reconcile_storage()
-        for q in (f"USE {space}", "CREATE TAG Person(age int)",
-                  "CREATE EDGE KNOWS(w int)"):
-            assert cl.execute(q).error is None, q
-        rng = np.random.default_rng(31)
-        B = 400
-        for lo in range(0, persons, B):
-            vals = ", ".join(f"{v}:({v % 90})"
-                             for v in range(lo, min(lo + B, persons)))
-            r = cl.execute(f"INSERT VERTEX Person(age) VALUES {vals}")
-            assert r.error is None, r.error
-        src = rng.integers(0, persons, persons * degree)
-        dst = rng.integers(0, persons, persons * degree)
-        keep = src != dst
-        src, dst = src[keep], dst[keep]
-        for lo in range(0, src.size, B):
-            vals = ", ".join(
-                f"{s}->{d}:({int(s + d) % 100})"
-                for s, d in zip(src[lo:lo + B].tolist(),
-                                dst[lo:lo + B].tolist()))
-            r = cl.execute(f"INSERT EDGE KNOWS(w) VALUES {vals}")
-            assert r.error is None, r.error
+        cl.execute(f"USE {space}")
 
         def stmt_of(wid: int, j: int) -> str:
             seed = (wid * 131 + j * 17) % persons
@@ -311,6 +322,225 @@ def run_sweep(persons: int = 1200, degree: int = 5,
             shutil.rmtree(tmp, ignore_errors=True)
 
 
+# -- batched-dispatch A/B sweep (ISSUE 15) ----------------------------------
+
+
+def _hist_sum(snap: Dict[str, float], name: str) -> float:
+    return sum(v for k, v in snap.items()
+               if k.startswith(name) and k.endswith(".sum"))
+
+
+def _hist_count(snap: Dict[str, float], name: str) -> float:
+    return sum(v for k, v in snap.items()
+               if k.startswith(name) and k.endswith(".count"))
+
+
+def batch_sweep(persons: int = 1200, degree: int = 5,
+                threads: int = 8, duration_s: float = 3.0,
+                levels=(1, 2, 4), lanes: int = 16,
+                wait_us: int = 8000, tpu_runtime=None,
+                data_dir: Optional[str] = None) -> dict:
+    """Multi-lane batched dispatch A/B (ISSUE 15 acceptance): the SAME
+    small-GO closed-loop offered-load sweep with batching OFF
+    (`batch_max_lanes=0`, the byte-identical off switch) and ON, on a
+    live 3-replica cluster whose graphd runs the device plane.  Per
+    (mode, level):
+
+      goodput_qps           statements that returned rows, per second
+      dispatches_per_stmt   Δ tpu_kernel_runs / ok — the sharing proof
+                            (< 1 means statements shared launches)
+      queue_wait_share      Δ tpu_dispatch_queue_us.sum / Σ statement
+                            latency — the PR 7 number batching exists
+                            to shrink
+      batches / mean_lanes  Δ tpu_batches_formed, mean lanes per batch
+      form_wait_p_mean_us   mean batch-forming wait per batched stmt
+
+    Plus a rows-identity probe: a seed sample's rows with batching ON
+    under concurrent company must equal the batching-OFF sequential
+    truth byte-for-byte.  The headline `queue_wait_share_off_over_on`
+    (≥ 2.0 target) and `dispatches_per_stmt_on` (< 0.5 target at the
+    top level) land in bench.py's `batching` block."""
+    import numpy as np
+
+    from nebula_tpu.cluster.launcher import LocalCluster
+    from nebula_tpu.utils.admission import admission
+    from nebula_tpu.utils.config import get_config
+    from nebula_tpu.utils.stats import stats
+
+    if tpu_runtime is None:
+        try:
+            from nebula_tpu.tpu import TpuRuntime, make_mesh
+            tpu_runtime = TpuRuntime(make_mesh(1))
+        except Exception as ex:  # noqa: BLE001 — no jax/device
+            return {"error": f"no device runtime: {ex!r}"}
+
+    space = "batch"
+    tmp = data_dir or tempfile.mkdtemp(prefix="nebula_batch_")
+    cluster = LocalCluster(n_meta=1, n_storage=3, n_graph=1,
+                           data_dir=tmp, tpu_runtime=tpu_runtime)
+    cfg = get_config()
+    dyn_keys = ("batch_max_lanes", "batch_wait_us", "query_timeout_secs",
+                "max_running_queries", "admission_queue_capacity")
+    try:
+        _seed_graph(cluster, space, persons, degree,
+                    replica_factor=3, rng_seed=43)
+
+        def stmt_of(wid: int, j: int) -> str:
+            seed = (wid * 131 + j * 17) % persons
+            return f"GO FROM {seed} OVER KNOWS YIELD dst(edge) AS d"
+
+        warm = cluster.client()
+        warm.execute(f"USE {space}")
+        warm.execute(stmt_of(0, 0))
+        warm.close()
+        # admission armed for BOTH arms (fair A/B): its drain releases
+        # queued statements in bursts — exactly the arrival bunching
+        # the batch former converts into lanes (the ISSUE 15 hand-off)
+        cfg.set_dynamic_many({
+            "query_timeout_secs": max(duration_s * 8, 20.0),
+            "max_running_queries": threads * 2,
+            "admission_queue_capacity": threads * 16,
+        })
+
+        modes = {"off": {"batch_max_lanes": 0},
+                 "on": {"batch_max_lanes": lanes,
+                        "batch_wait_us": wait_us}}
+        out_modes: Dict[str, dict] = {}
+        for mode, flags in modes.items():
+            cfg.set_dynamic_many(flags)
+            out_levels: Dict[str, dict] = {}
+            for level in levels:
+                res = _LevelResult()
+                s0 = stats().snapshot()
+                n_workers = threads * level
+                ths = [threading.Thread(target=_worker,
+                                        args=(cluster, space, stmt_of,
+                                              duration_s, i, res))
+                       for i in range(n_workers)]
+                t0 = time.perf_counter()
+                for t in ths:
+                    t.start()
+                for t in ths:
+                    t.join()
+                wall = time.perf_counter() - t0
+                s1 = stats().snapshot()
+                res.lats.sort()
+                runs = s1.get("tpu_kernel_runs", 0) \
+                    - s0.get("tpu_kernel_runs", 0)
+                qwait = _hist_sum(s1, "tpu_dispatch_queue_us") \
+                    - _hist_sum(s0, "tpu_dispatch_queue_us")
+                batches = s1.get("tpu_batches_formed", 0) \
+                    - s0.get("tpu_batches_formed", 0)
+                lanes_sum = _hist_sum(s1, "tpu_batch_lanes") \
+                    - _hist_sum(s0, "tpu_batch_lanes")
+                form_sum = _hist_sum(s1, "tpu_batch_form_wait_us") \
+                    - _hist_sum(s0, "tpu_batch_form_wait_us")
+                form_n = _hist_count(s1, "tpu_batch_form_wait_us") \
+                    - _hist_count(s0, "tpu_batch_form_wait_us")
+                total_us = sum(res.lats) * 1e6
+                out_levels[f"{level}x"] = {
+                    "workers": n_workers,
+                    "wall_s": round(wall, 2),
+                    "ok": res.ok,
+                    "goodput_qps": round(res.ok / wall, 1)
+                    if wall else 0,
+                    "other_errors": len(res.errors),
+                    "error_sample": res.errors[:3],
+                    "p50_ms": round(_percentile(res.lats, 50) * 1e3, 2),
+                    "p99_ms": round(_percentile(res.lats, 99) * 1e3, 2),
+                    "device_launches": int(runs),
+                    "dispatches_per_stmt": round(
+                        runs / res.ok, 3) if res.ok else None,
+                    "queue_wait_share": round(qwait / total_us, 4)
+                    if total_us else 0.0,
+                    "batches_formed": int(batches),
+                    "mean_lanes": round(lanes_sum / batches, 2)
+                    if batches else 0.0,
+                    "form_wait_mean_us": round(form_sum / form_n, 1)
+                    if form_n else 0.0,
+                }
+            out_modes[mode] = out_levels
+
+        # -- rows-identity probe: ON under concurrency == OFF truth ---
+        probe_seeds = [3, 7, 11, 13, 17]
+        cfg.set_dynamic("batch_max_lanes", 0)
+        pcl = cluster.client()
+        pcl.execute(f"USE {space}")
+        truth = {}
+        for sd in probe_seeds:
+            r = pcl.execute(f"GO FROM {sd} OVER KNOWS "
+                            f"YIELD dst(edge) AS d")
+            assert r.error is None, r.error
+            truth[sd] = sorted(map(repr, r.data.rows))
+        cfg.set_dynamic_many({"batch_max_lanes": lanes,
+                              "batch_wait_us": max(wait_us, 20000)})
+        got: Dict[int, list] = {}
+        errs: List[str] = []
+
+        def probe(sd):
+            try:
+                c2 = cluster.client()
+                c2.execute(f"USE {space}")
+                r = c2.execute(f"GO FROM {sd} OVER KNOWS "
+                               f"YIELD dst(edge) AS d")
+                if r.error is not None:
+                    errs.append(r.error)
+                else:
+                    got[sd] = sorted(map(repr, r.data.rows))
+                c2.close()
+            except Exception as ex:  # noqa: BLE001
+                errs.append(repr(ex))
+
+        ths = [threading.Thread(target=probe, args=(sd,))
+               for sd in probe_seeds]
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join()
+        rows_identical = (not errs
+                          and all(got.get(sd) == truth[sd]
+                                  for sd in probe_seeds))
+        top = f"{levels[-1]}x"
+        q_off = out_modes["off"][top]["queue_wait_share"]
+        q_on = out_modes["on"][top]["queue_wait_share"]
+        g_on = {lv: out_modes["on"][f"{lv}x"]["goodput_qps"]
+                for lv in levels}
+        return {
+            "persons": persons,
+            "degree": degree,
+            "statement": "1-hop GO (small-query device shape)",
+            "threads_1x": threads,
+            "duration_per_level_s": duration_s,
+            "batch_max_lanes": lanes,
+            "batch_wait_us": wait_us,
+            "modes": out_modes,
+            "rows_identical": rows_identical,
+            "rows_probe_errors": errs[:3],
+            # headlines: launches shared + queue wait collapsed +
+            # goodput rising with offered load
+            "dispatches_per_stmt_on":
+                out_modes["on"][top]["dispatches_per_stmt"],
+            "queue_wait_share_off_over_on": round(q_off / q_on, 2)
+            if q_on else None,
+            "goodput_rises_with_load": all(
+                g_on[levels[i]] <= g_on[levels[i + 1]] * 1.05
+                for i in range(len(levels) - 1)),
+        }
+    finally:
+        with cfg.lock:
+            for k in dyn_keys:
+                cfg.dynamic_layer.pop(k, None)
+        admission().reset()
+        try:
+            from nebula_tpu.tpu.batch import batch_former
+            batch_former().reset()
+        except Exception:  # noqa: BLE001 — no jax
+            pass
+        cluster.stop()
+        if data_dir is None:
+            shutil.rmtree(tmp, ignore_errors=True)
+
+
 # -- read scale-out sweep (ISSUE 11) ----------------------------------------
 
 
@@ -333,33 +563,8 @@ def _read_level(cluster, space, stmt_of, threads: int,
 
 def _seed_read_graph(cluster, space: str, persons: int, degree: int,
                      replica_factor: int):
-    import numpy as np
-    cl = cluster.client()
-    assert cl.execute(
-        f"CREATE SPACE {space}(partition_num=8, "
-        f"replica_factor={replica_factor}, vid_type=INT64)").error is None
-    cluster.reconcile_storage()
-    for q in (f"USE {space}", "CREATE TAG Person(age int)",
-              "CREATE EDGE KNOWS(w int)"):
-        assert cl.execute(q).error is None, q
-    rng = np.random.default_rng(47)
-    B = 400
-    for lo in range(0, persons, B):
-        vals = ", ".join(f"{v}:({v % 90})"
-                         for v in range(lo, min(lo + B, persons)))
-        assert cl.execute(
-            f"INSERT VERTEX Person(age) VALUES {vals}").error is None
-    src = rng.integers(0, persons, persons * degree)
-    dst = rng.integers(0, persons, persons * degree)
-    keep = src != dst
-    src, dst = src[keep], dst[keep]
-    for lo in range(0, src.size, B):
-        vals = ", ".join(f"{s}->{d}:({int(s + d) % 100})"
-                         for s, d in zip(src[lo:lo + B].tolist(),
-                                         dst[lo:lo + B].tolist()))
-        assert cl.execute(
-            f"INSERT EDGE KNOWS(w) VALUES {vals}").error is None
-    cl.close()
+    _seed_graph(cluster, space, persons, degree, replica_factor,
+                rng_seed=47)
 
 
 def read_scaleout_sweep(persons: int = 1000, degree: int = 5,
@@ -542,7 +747,21 @@ def main(argv=None) -> int:
     ap.add_argument("--read-scaleout", action="store_true",
                     help="run the replica-count read sweep instead of "
                          "the offered-load sweep")
+    ap.add_argument("--batch", action="store_true",
+                    help="run the batched-dispatch A/B sweep "
+                         "(batching off vs on) instead of the "
+                         "offered-load sweep")
+    ap.add_argument("--lanes", type=int, default=16,
+                    help="batch_max_lanes for the --batch ON arm")
+    ap.add_argument("--batch-wait-us", type=int, default=3000,
+                    help="batch_wait_us forming window for --batch")
     args = ap.parse_args(argv)
+    if args.batch:
+        print(json.dumps(batch_sweep(
+            persons=args.persons, degree=args.degree,
+            threads=args.threads, duration_s=args.duration,
+            lanes=args.lanes, wait_us=args.batch_wait_us), indent=1))
+        return 0
     if args.read_scaleout:
         print(json.dumps(read_scaleout_sweep(
             persons=args.persons, degree=args.degree,

@@ -27,6 +27,7 @@ import jax
 jax.config.update("jax_enable_x64", True)
 
 from .device import DeviceSnapshot, make_mesh, pin_snapshot          # noqa: E402
+from . import batch                                                  # noqa: E402  (defines the batch_* flags)
 from .runtime import TpuRuntime                                      # noqa: E402
 from . import traverse                                               # noqa: E402  (registers executor+rule)
 from . import match_agg                                              # noqa: E402  (registers executor+rule)

@@ -361,22 +361,17 @@ def build_traverse_fn(mesh, P: int, EB, steps: int,
     return jax.jit(smapped)
 
 
-def build_traverse_fn_local(P: int, EB, steps: int,
-                            n_blocks: int,
-                            pred: Optional[Callable[[Dict[str, Any]], Any]] = None,
-                            pred_cols: Sequence[str] = (),
-                            capture: bool = True,
-                            capture_hops: bool = False,
-                            yield_cols: Sequence[str] = (),
-                            hub_dense=None):
-    """Single-chip variant: all P partitions resident on one device, the
-    per-part kernel vmapped over the part axis, and the frontier exchange
-    an OR-reduce over the mark matrices (the degenerate all_to_all).
-    This is the program that runs on one real chip (the bench config) —
-    identical semantics to the sharded build, no ICI.  capture_hops
-    follows the sharded contract (MATCH mode: per-hop pred + per-hop
-    frames, cap arrays (P, steps, n_blocks, EB)).
-    """
+def _build_local_fn(P: int, EB, steps: int,
+                    n_blocks: int,
+                    pred: Optional[Callable[[Dict[str, Any]], Any]] = None,
+                    pred_cols: Sequence[str] = (),
+                    capture: bool = True,
+                    capture_hops: bool = False,
+                    yield_cols: Sequence[str] = (),
+                    hub_dense=None):
+    """The UNJITTED single-chip traversal program — shared by
+    build_traverse_fn_local (jit) and build_traverse_fn_lanes (jit of a
+    vmap over a leading query-lane axis; ISSUE 15)."""
     pids = jnp.arange(P, dtype=jnp.int32)
     ebs = _norm_ebs(EB, steps, capture_hops)
     hubs_c, hub_owner, hub_local = _hub_consts(hub_dense, P)
@@ -482,4 +477,60 @@ def build_traverse_fn_local(P: int, EB, steps: int,
             res["kcount"] = kcount_out   # small: fetched with the meta
         return res
 
-    return jax.jit(fn)
+    return fn
+
+
+def build_traverse_fn_local(P: int, EB, steps: int,
+                            n_blocks: int,
+                            pred: Optional[Callable[[Dict[str, Any]], Any]] = None,
+                            pred_cols: Sequence[str] = (),
+                            capture: bool = True,
+                            capture_hops: bool = False,
+                            yield_cols: Sequence[str] = (),
+                            hub_dense=None):
+    """Single-chip variant: all P partitions resident on one device, the
+    per-part kernel vmapped over the part axis, and the frontier exchange
+    an OR-reduce over the mark matrices (the degenerate all_to_all).
+    This is the program that runs on one real chip (the bench config) —
+    identical semantics to the sharded build, no ICI.  capture_hops
+    follows the sharded contract (MATCH mode: per-hop pred + per-hop
+    frames, cap arrays (P, steps, n_blocks, EB)).
+    """
+    return jax.jit(_build_local_fn(
+        P, EB, steps, n_blocks, pred=pred, pred_cols=pred_cols,
+        capture=capture, capture_hops=capture_hops,
+        yield_cols=yield_cols, hub_dense=hub_dense))
+
+
+def build_traverse_fn_lanes(P: int, EB, steps: int,
+                            n_blocks: int,
+                            pred: Optional[Callable[[Dict[str, Any]], Any]] = None,
+                            pred_cols: Sequence[str] = (),
+                            capture: bool = True,
+                            capture_hops: bool = False,
+                            yield_cols: Sequence[str] = (),
+                            hub_dense=None):
+    """Query-lane-batched single-chip program (ISSUE 15 tentpole).
+
+    The same traversal program with a leading QUERY-ID LANE axis vmapped
+    over the frontier: L compatible statements (same kernel family, same
+    shape bucket, same predicate/yield program) share ONE device put,
+    ONE dispatch and ONE fetch — the CSR blocks are closed over once and
+    broadcast across lanes (`in_axes=(None, 0)`), so the marginal cost
+    of a lane is its own expansion work, not a full kernel launch.
+
+    Inputs/outputs match the local builder's contract with a leading L
+    axis added: frontier (L, P, vmax) bool; every result leaf —
+    hop_edges, frontier_sizes, ovf_expand, kcount and the cap arrays —
+    gains the lane axis, and the runtime de-muxes lane l back to its
+    statement by slicing `[l]`.  Lanes are INDEPENDENT computations
+    (no cross-lane reduction anywhere), so each lane's captured edge
+    set is bit-identical to the same statement's solo dispatch at the
+    same edge budget; padding lanes (all-false frontier) expand zero
+    edges and only cost their share of the dense kernel shape.
+    """
+    fn = _build_local_fn(
+        P, EB, steps, n_blocks, pred=pred, pred_cols=pred_cols,
+        capture=capture, capture_hops=capture_hops,
+        yield_cols=yield_cols, hub_dense=hub_dense)
+    return jax.jit(jax.vmap(fn, in_axes=(None, 0)))

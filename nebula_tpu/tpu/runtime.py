@@ -30,7 +30,8 @@ from ..graphstore.store import GraphStore
 from .device import DeviceSnapshot, TpuUnavailable, make_mesh, pin_snapshot
 from .exprjit import (CannotCompile, compile_predicate, eval_yield_column,
                       eval_yield_column_np)
-from .hop import build_traverse_fn, build_traverse_fn_local
+from .hop import (build_traverse_fn, build_traverse_fn_lanes,
+                  build_traverse_fn_local)
 
 
 def _pow2(n: int) -> int:
@@ -573,6 +574,55 @@ class TpuRuntime:
 
     # -- traversal --------------------------------------------------------
 
+    @staticmethod
+    def _seed_sorted(dense_ids: Sequence[int], P: int,
+                     vmax: int) -> List[int]:
+        """Normalized seed list with the range check both preps share.
+        The old host-side numpy build crashed loudly on an id from a
+        stale/foreign snapshot; JAX scatter would DROP it."""
+        d = sorted(set(int(x) for x in dense_ids if x >= 0))
+        if d and d[-1] >= P * vmax:
+            raise ValueError(
+                f"dense seed id {d[-1]} out of range for snapshot "
+                f"(P={P}, vmax={vmax})")
+        return d
+
+    def _seed_builder(self, target, P: int, vmax: int, lanes: bool):
+        """The jitted seed-bitmap scatter builder, cached and bounded —
+        ONE copy of the build closure, sharding resolution and eviction
+        policy for the solo and lane-batched preps.  `lanes` vmaps the
+        same build over a leading lane axis ((L, cap) ids →
+        (L, P, vmax) bitmap stack).  Returns (cache key, fn)."""
+        key = ("seedfr_lanes" if lanes else "seedfr", target, P, vmax)
+        fn = self._seed_fns.get(key)
+        if fn is not None:
+            return key, fn
+        import jax.numpy as jnp
+        if not isinstance(target, jax.sharding.Sharding):
+            sh = jax.sharding.SingleDeviceSharding(target)
+        else:
+            sh = target
+
+        def build(dpad):
+            valid = dpad >= 0
+            rows = jnp.where(valid, dpad % P, 0)
+            cols = jnp.where(valid, dpad // P, 0)
+            fr = jnp.zeros((P, vmax), bool)
+            return fr.at[rows, cols].max(valid)
+
+        fn = jax.jit(jax.vmap(build) if lanes else build,
+                     out_shardings=sh)
+        self._seed_fns[key] = fn
+        # bounded: the key embeds the sharding target and snapshot
+        # vmax, so a long-lived server re-pinning growing snapshots
+        # must not accumulate executables for the process lifetime
+        while len(self._seed_fns) > 32:
+            old = next(iter(self._seed_fns))
+            self._seed_fns.pop(old)
+            self._seed_warm = {w for w in self._seed_warm
+                               if w[0] != old}
+        return key, fn
+
     def _seed_frontier_prep(self, dev: DeviceSnapshot,
                             dense_ids: Sequence[int], target):
         """Prep for the on-device seed-bitmap build: pad the dense-id
@@ -586,43 +636,13 @@ class TpuRuntime:
         transfer shrinks from the graph-sized zeros bitmap (8 MB at
         north-star scale) to the seed ids — on a tunneled chip that is
         the dominant fixed cost of a small query."""
-        import jax.numpy as jnp
         P, vmax = dev.num_parts, dev.vmax
-        d = sorted(set(int(x) for x in dense_ids if x >= 0))
-        if d and d[-1] >= P * vmax:
-            # the old host-side numpy build crashed loudly on an id from
-            # a stale/foreign snapshot; JAX scatter would DROP it
-            raise ValueError(
-                f"dense seed id {d[-1]} out of range for snapshot "
-                f"(P={P}, vmax={vmax})")
+        d = self._seed_sorted(dense_ids, P, vmax)
         cap = _pow2(max(len(d), 1))
         pad = np.full(cap, -1, np.int64)
         if d:
             pad[:len(d)] = d
-        key = ("seedfr", target, P, vmax)
-        fn = self._seed_fns.get(key)
-        if fn is None:
-            if not isinstance(target, jax.sharding.Sharding):
-                sh = jax.sharding.SingleDeviceSharding(target)
-            else:
-                sh = target
-
-            def build(dpad):
-                valid = dpad >= 0
-                rows = jnp.where(valid, dpad % P, 0)
-                cols = jnp.where(valid, dpad // P, 0)
-                fr = jnp.zeros((P, vmax), bool)
-                return fr.at[rows, cols].max(valid)
-
-            fn = self._seed_fns[key] = jax.jit(build, out_shardings=sh)
-            # bounded: the key embeds the sharding target and snapshot
-            # vmax, so a long-lived server re-pinning growing snapshots
-            # must not accumulate executables for the process lifetime
-            while len(self._seed_fns) > 32:
-                old = next(iter(self._seed_fns))
-                self._seed_fns.pop(old)
-                self._seed_warm = {w for w in self._seed_warm
-                                   if w[0] != old}
+        key, fn = self._seed_builder(target, P, vmax, lanes=False)
         wk = (key, cap)
         if wk not in self._seed_warm:
             jax.block_until_ready(fn(pad))   # compile outside the timer
@@ -732,6 +752,273 @@ class TpuRuntime:
             if wc is not None:
                 wc.add("device_dispatches")
             return res, us
+
+    # -- multi-lane batched dispatch (ISSUE 15 tentpole) -----------------
+
+    def _seed_frontier_prep_lanes(self, dev: DeviceSnapshot,
+                                  lane_dense: Sequence[Sequence[int]],
+                                  target):
+        """Lane-batched variant of _seed_frontier_prep: every lane's
+        dense seed ids padded to one (L, cap) block, built into a
+        (L, P, vmax) bool frontier stack by the vmapped on-device
+        scatter (same builder closure — _seed_builder).  L is
+        pow2-padded so the compile count stays logarithmic in batch
+        size; padding lanes (all -1) scatter nothing and expand
+        nothing."""
+        P, vmax = dev.num_parts, dev.vmax
+        lanes = [self._seed_sorted(dense_ids, P, vmax)
+                 for dense_ids in lane_dense]
+        cap = _pow2(max((len(d) for d in lanes), default=1) or 1)
+        L = _pow2(max(len(lanes), 1))
+        pad = np.full((L, cap), -1, np.int64)
+        for i, d in enumerate(lanes):
+            if d:
+                pad[i, :len(d)] = d
+        key, fn = self._seed_builder(target, P, vmax, lanes=True)
+        wk = (key, L, cap)
+        if wk not in self._seed_warm:
+            jax.block_until_ready(fn(pad))   # compile outside the timer
+            self._seed_warm.add(wk)
+        return pad, fn, L
+
+    def _escalate_lanes(self, dev: DeviceSnapshot,
+                        lane_dense: Sequence[Sequence[int]],
+                        key_fn, build_fn, inputs_fn,
+                        n_hops: int = 1, uniform: bool = False,
+                        fetch_keys: Optional[set] = None,
+                        kernel: str = "traverse"):
+        """The lane-batched escalation driver: ONE gated dispatch, ONE
+        put, ONE fetch for every lane of a formed batch (the launcher
+        member runs this on its own thread; batch.py fans the result
+        out).  Returns (res, info): res carries lane-major arrays —
+        hop_edges/frontier_sizes (L, P, steps), cap arrays with a
+        leading L — and info the launch-level facts each lane's
+        de-mux attribution needs (rungs, budgets, phase timings, gate
+        wait).
+
+        Per-statement TLS attribution (work/cost/live) is SUPPRESSED
+        here — the lane-aware de-mux (_lane_attribution) charges each
+        statement its own lane on its own thread, so rows,
+        WorkCounters, cost sinks and flight entries stay exactly
+        per-statement (the PR 7 concurrent-attribution contract).
+        Launch-level truth still lands where it belongs: the kernel
+        ledger, tpu_kernel_runs and the dispatch-table slot record ONE
+        real launch, which is precisely how the ledger proves the
+        sharing is real.  A batched launch consumes ONE
+        `tpu_dispatch_queue_cap` slot (the single _gated_dispatch
+        below), never K."""
+        from ..utils.stats import stats as _metrics
+        from ..utils.stats import use_cost, use_work
+        from ..utils.workload import use_live
+        base = self.init_eb
+        EBs = [base] * n_hops
+        L_real = len(lane_dense)
+        bkey = (key_fn(()) + ("lanes",), _pow2(max(L_real, 1)))
+        prev = self._buckets.get(bkey)
+        if prev is not None:
+            pe = prev[-1]
+            pe = [pe] * n_hops if isinstance(pe, int) else list(pe)
+            if len(pe) == n_hops:
+                EBs = [max(a, int(b)) for a, b in zip(EBs, pe)]
+        if uniform:
+            EBs = [max(EBs)] * n_hops
+        target = self.mesh.devices.reshape(-1)[0]   # local mode only
+        seed_pad, seed_fn, L = self._seed_frontier_prep_lanes(
+            dev, lane_dense, target)
+        info: Dict[str, Any] = {
+            "lanes": L_real, "rungs": [], "compiles": 0, "retries": 0,
+            "put_s": 0.0, "fetch_s": 0.0, "device_s": 0.0,
+            "gate_wait_us": 0, "ebs": list(EBs), "hbm_bytes": 0}
+        with use_work(None), use_cost(None), use_live(None), \
+                self._gated_dispatch(kernel) as wait_us:
+            info["gate_wait_us"] = wait_us
+            tp = time.perf_counter()
+            frontier = seed_fn(seed_pad)
+            info["put_s"] = time.perf_counter() - tp
+            for attempt in range(max(self.max_retries, n_hops + 3)):
+                ebs = tuple(EBs)
+                # lane suffix (not prefix): pin/unpin prune _fns by
+                # key[0]==space / key[1]==epoch — lane programs must
+                # age out with their snapshot like solo programs do
+                key = key_fn(ebs) + ("lanes", L)
+                fn = self._fns.get(key)
+                compiled = fn is None
+                if compiled:
+                    fn = self._fns[key] = build_fn(ebs)
+                    info["compiles"] += 1
+                t0 = time.perf_counter()
+                from ..utils.config import get_config as _gc
+                prof_dir = _gc().get("tpu_profiler_dir")
+                if prof_dir:
+                    # same xplane tracing contract as the solo path: a
+                    # profiled deployment must capture the SHARED
+                    # launches too — they are the ones worth profiling
+                    self._prof_seq = getattr(self, "_prof_seq", 0) + 1
+                    import os as _os
+                    run_dir = _os.path.join(str(prof_dir),
+                                            f"run{self._prof_seq:06d}")
+                    with jax.profiler.trace(run_dir):
+                        res = fn(*inputs_fn(ebs), frontier)
+                        jax.block_until_ready(res)
+                else:
+                    res = fn(*inputs_fn(ebs), frontier)
+                    jax.block_until_ready(res)
+                t1 = time.perf_counter()
+                info["rungs"].append((int((t1 - t0) * 1e6), compiled))
+                info["device_s"] = t1 - t0
+                cap_dev = res.pop("cap", None) if isinstance(res, dict) \
+                    else None
+                res = jax.device_get(res)
+                info["fetch_s"] += time.perf_counter() - t1
+                if res["ovf_expand"].any():
+                    # per-hop true expansion max over (lane, part):
+                    # jump every overflowed hop straight to its bucket
+                    need = np.asarray(res["hop_edges"]).max(axis=(0, 1))
+                    EBs = [e if need[h] <= e else
+                           min(max(2 * e, _pow2(int(need[h]))),
+                               self.max_cap)
+                           for h, e in enumerate(EBs)]
+                    if uniform:
+                        EBs = [max(EBs)] * n_hops
+                    continue
+                info["ebs"] = list(EBs)
+                info["retries"] = attempt
+                if self._buckets.get(bkey) != (0, ebs):
+                    self._buckets[bkey] = (0, ebs)
+                    while len(self._buckets) > 512:
+                        self._buckets.pop(next(iter(self._buckets)))
+                    self._save_buckets()
+                if cap_dev is not None:
+                    tf = time.perf_counter()
+                    kc = np.asarray(res["kcount"])
+                    kmax = int(kc.max()) if kc.size else 0
+                    K = min(max(EBs), _pow2(max(kmax, 1)))
+                    res["cap"] = {k: np.asarray(
+                        jax.device_get(v[..., :K]))
+                        for k, v in cap_dev.items()
+                        if fetch_keys is None or k in fetch_keys}
+                    res["cap"]["kcount"] = kc
+                    info["fetch_s"] += time.perf_counter() - tf
+                # launch-level metrics/ledger: ONE real launch shared
+                # by L_real statements — the sharing proof
+                _metrics().inc("tpu_kernel_runs")
+                _metrics().inc("tpu_edges_traversed",
+                               int(np.asarray(res["hop_edges"]).sum()))
+                _metrics().add_value("tpu_kernel_s", info["device_s"])
+                for r_us, r_compiled in info["rungs"]:
+                    _metrics().observe("tpu_dispatch_us", r_us,
+                                       {"kernel": kernel})
+                    if r_compiled:
+                        _metrics().inc_labeled("tpu_kernel_compiles",
+                                               {"kernel": kernel})
+                    else:
+                        _metrics().inc_labeled("tpu_kernel_cache_hits",
+                                               {"kernel": kernel})
+                hbm = self.hbm_bytes()
+                info["hbm_bytes"] = hbm
+                self._hbm_high_water = max(
+                    getattr(self, "_hbm_high_water", 0), hbm)
+                _metrics().gauge("tpu_hbm_high_water_bytes",
+                                 float(self._hbm_high_water))
+                from ..utils.flight import kernel_ledger
+                kernel_ledger().record(
+                    kernel=kernel, shape=[L] + list(EBs), steps=n_hops,
+                    compiled=bool(info["compiles"]),
+                    dispatch_us=int(info["device_s"] * 1e6),
+                    hbm_bytes=hbm, retries=attempt)
+                from ..utils import trace as _t
+                _t.record_phase("tpu:batch", info["device_s"],
+                                lanes=L_real, kernel=kernel,
+                                eb=list(EBs))
+                return res, info
+        raise TpuUnavailable(
+            "lane-batched bucket escalation did not converge")
+
+    def _lane_attribution(self, tk, stats: "TraverseStats"):
+        """De-mux one lane of a shared launch: fill this statement's
+        TraverseStats and charge ITS thread-local work/cost/live sinks
+        with its own lane's deterministic counts (edges, frontier
+        sizes) plus the shared launch's timings — exactly what a solo
+        dispatch of the same statement would have recorded.  Returns
+        the lane's slice of the capture arrays (the lane-aware epilogue
+        of the gated dispatch)."""
+        info, res, lane = tk.info, tk.res, tk.lane
+        he = np.asarray(res["hop_edges"])[lane]          # (P, steps)
+        stats.hop_edges = [int(x) for x in he.sum(axis=0)]
+        if "frontier_sizes" in res:
+            stats.frontier_sizes = [
+                int(x) for x in
+                np.asarray(res["frontier_sizes"])[lane].sum(axis=0)]
+        stats.retries = info["retries"]
+        stats.compiles = info["compiles"]
+        stats.device_s = info["device_s"]
+        stats.put_s = info["put_s"]
+        stats.fetch_s = info["fetch_s"]
+        stats.queue_s = (info["gate_wait_us"] + tk.form_wait_us) / 1e6
+        stats.f_cap, stats.e_cap = 0, list(info["ebs"])
+        stats.hbm_bytes = info["hbm_bytes"]
+        n_rungs = len(info["rungs"])
+        rung_us = sum(r for r, _ in info["rungs"])
+        from ..utils.stats import current_cost, current_work
+        from ..utils.workload import current_live
+        wc = current_work()
+        if wc is not None:
+            wc.add("device_dispatches", n_rungs)
+            wc.add("edges_traversed", stats.edges_traversed())
+            wc.extend_frontier(stats.frontier_sizes)
+        cc = current_cost()
+        if cc is not None:
+            cc.add("device_us", rung_us)
+            cc.add("device_dispatches", n_rungs)
+            cc.add("queue_us", int(stats.queue_s * 1e6))
+            if info["compiles"]:
+                cc.add("device_compiles", info["compiles"])
+        lv = current_live()
+        if lv is not None:
+            lv.add("device_us", rung_us)
+            lv.add("dispatches", n_rungs)
+            lv.add("queue_us", int(stats.queue_s * 1e6))
+        from ..utils import trace as _t
+        _t.record_phase("device:put", stats.put_s)
+        _t.record_phase("device:dispatch", stats.device_s,
+                        eb=list(info["ebs"]), retries=stats.retries)
+        _t.record_phase("device:fetch", stats.fetch_s)
+        return {k: v[lane] for k, v in res["cap"].items()}
+
+    def _try_batched(self, dense: Sequence[int], dev: DeviceSnapshot,
+                     key_fn, build_lanes, inputs_fn, n_hops: int,
+                     uniform: bool, fetch_keys: Optional[set],
+                     kernel: str, stats: "TraverseStats"):
+        """Submit this dispatch to the batch former; returns the
+        statement's solo-shaped {"cap": ...} after a shared launch, or
+        None when the dispatch should run solo (batching off, no
+        concurrent company, multi-chip mesh — the lane axis is a
+        single-chip program — or the `tpu:batch_form` failpoint
+        rejected enrollment)."""
+        if not self.local_mode:
+            return None
+        from ..utils.failpoints import FailpointError
+        from .batch import batch_former
+        former = batch_former()
+        if not former.enabled():
+            return None
+        base_key = (kernel, key_fn(()),
+                    frozenset(fetch_keys) if fetch_keys is not None
+                    else None)
+
+        def launch(lane_dense):
+            return self._escalate_lanes(
+                dev, lane_dense, key_fn=key_fn, build_fn=build_lanes,
+                inputs_fn=inputs_fn, n_hops=n_hops, uniform=uniform,
+                fetch_keys=fetch_keys, kernel=kernel)
+
+        try:
+            tk = former.submit(base_key, dense, launch, kernel=kernel)
+        except FailpointError:
+            return None          # batch forming rejected → solo dispatch
+        if tk is None:
+            return None
+        return {"cap": self._lane_attribution(tk, stats)}
 
     def _escalate_locked(self, dev: DeviceSnapshot, dense: Sequence[int],
                          key_fn, build_fn, inputs_fn,
@@ -1072,15 +1359,33 @@ class TpuRuntime:
                 pred=pred, pred_cols=pred_cols, capture=capture,
                 yield_cols=yield_cols, hub_dense=hub_dense)
 
-        res = self._escalate(
-            dev, dense,
-            key_fn=lambda ebs: (space, dev.epoch, tuple(block_keys),
-                                steps, ebs, pred_key, capture,
-                                tuple(pred_cols), yield_cols, hub_n),
-            build_fn=build,
-            inputs_fn=lambda ebs: (blocks_data,),
-            stats=stats, n_hops=steps, fetch_keys=fetch_keys,
-            kernel="traverse")
+        def key_fn(ebs):
+            return (space, dev.epoch, tuple(block_keys), steps, ebs,
+                    pred_key, capture, tuple(pred_cols), yield_cols,
+                    hub_n)
+
+        # multi-lane batched dispatch (ISSUE 15): concurrent compatible
+        # statements share ONE launch; None falls through to the solo
+        # path (batching off / no company / capture-less program)
+        res = None
+        if capture:
+            res = self._try_batched(
+                dense, dev, key_fn,
+                build_lanes=lambda ebs: build_traverse_fn_lanes(
+                    P, ebs, steps, len(block_keys), pred=pred,
+                    pred_cols=pred_cols, capture=True,
+                    yield_cols=yield_cols, hub_dense=hub_dense),
+                inputs_fn=lambda ebs: (blocks_data,),
+                n_hops=steps, uniform=False, fetch_keys=fetch_keys,
+                kernel="traverse", stats=stats)
+        if res is None:
+            res = self._escalate(
+                dev, dense,
+                key_fn=key_fn,
+                build_fn=build,
+                inputs_fn=lambda ebs: (blocks_data,),
+                stats=stats, n_hops=steps, fetch_keys=fetch_keys,
+                kernel="traverse")
         if not capture:
             stats.total_s = time.perf_counter() - t_start
             return [], stats
@@ -1168,14 +1473,29 @@ class TpuRuntime:
                 pred=pred, pred_cols=pred_cols, capture=True,
                 capture_hops=True, hub_dense=hub_dense)
 
-        res = self._escalate(
-            dev, dense,
-            key_fn=lambda ebs: (space, dev.epoch, "hops",
-                                tuple(block_keys), max_hop, ebs,
-                                pred_key, tuple(pred_cols), hub_n),
-            build_fn=build,
+        def key_fn(ebs):
+            return (space, dev.epoch, "hops", tuple(block_keys),
+                    max_hop, ebs, pred_key, tuple(pred_cols), hub_n)
+
+        # multi-lane batched dispatch (ISSUE 15): concurrent MATCH
+        # expansions of the same program share ONE launch
+        res = self._try_batched(
+            dense, dev, key_fn,
+            build_lanes=lambda ebs: build_traverse_fn_lanes(
+                P, ebs, max_hop, len(block_keys), pred=pred,
+                pred_cols=pred_cols, capture=True, capture_hops=True,
+                hub_dense=hub_dense),
             inputs_fn=lambda ebs: (blocks_data,),
-            stats=stats, n_hops=max_hop, uniform=True, kernel="hops")
+            n_hops=max_hop, uniform=True, fetch_keys=None,
+            kernel="hops", stats=stats)
+        if res is None:
+            res = self._escalate(
+                dev, dense,
+                key_fn=key_fn,
+                build_fn=build,
+                inputs_fn=lambda ebs: (blocks_data,),
+                stats=stats, n_hops=max_hop, uniform=True,
+                kernel="hops")
 
         t_mat = time.perf_counter()
         frames = self._build_frames(store, space, dev, block_keys,

@@ -46,7 +46,7 @@ import re
 import threading
 import time
 from collections import OrderedDict, deque
-from typing import Dict, Optional
+from typing import Dict, Optional, Tuple
 
 from . import cancel as _cancel
 from .config import define_flag, get_config
@@ -260,6 +260,11 @@ class AdmissionController:
         self._weights_raw = ""
         self._weights: Dict[int, int] = {}
         self._listener_installed = False
+        # last multi-statement drain burst (size, monotonic ts): the
+        # admission→batch-former hand-off (ISSUE 15) — a drain that
+        # releases K statements at once is exactly the moment a
+        # multi-lane device launch is worth forming
+        self._last_burst: Tuple[int, float] = (0, 0.0)
 
     # -- flags ------------------------------------------------------------
 
@@ -534,8 +539,23 @@ class AdmissionController:
                 admitted.append(w)
             if admitted:
                 self._gauges_locked()
+            if len(admitted) > 1:
+                # hand the burst to the device batch former (ISSUE 15):
+                # K statements released together are K candidate lanes
+                self._last_burst = (len(admitted), time.monotonic())
         for w in admitted:
             w.event.set()
+
+    def concurrency_hint(self) -> bool:
+        """Is concurrent statement traffic in evidence right now?  The
+        device batch former (tpu/batch.py) consults this before paying
+        the forming window: queued or multiply-running statements, or a
+        drain burst within the last quarter second, mean batchmates are
+        plausibly en route.  Plain int reads — GIL-atomic, no lock."""
+        if self._queued_n > 0 or len(self._running) > 1:
+            return True
+        n, ts = self._last_burst
+        return n >= 2 and (time.monotonic() - ts) < 0.25
 
     # -- introspection ----------------------------------------------------
 

@@ -76,7 +76,8 @@ class LiveQuery:
     __slots__ = ("qid", "session", "user", "stmt", "kind", "t0", "m0",
                  "deadline", "node_kind", "node_id", "nodes_done",
                  "rows", "queue_us", "device_us", "dispatches",
-                 "tracker", "killed", "queued", "consistency", "_lock")
+                 "tracker", "killed", "queued", "consistency",
+                 "batch_id", "lane", "_lock")
 
     def __init__(self, qid: int, session: int, user: str, stmt: str,
                  kind: str, deadline: Optional[float] = None,
@@ -103,6 +104,11 @@ class LiveQuery:
         # surfaced in SHOW QUERIES so an operator can see which reads
         # are leader-bound vs replica-spread at a glance
         self.consistency = consistency
+        # multi-lane batched dispatch (ISSUE 15): while this statement
+        # is enrolled in a forming/in-flight device batch, the group id
+        # and this statement's lane — SHOW QUERIES renders "bid/lane"
+        self.batch_id: Optional[int] = None
+        self.lane: Optional[int] = None
         self._lock = threading.Lock()
 
     # -- scheduler hooks (one per plan node) -----------------------------
@@ -149,6 +155,8 @@ class LiveQuery:
             "dispatches": self.dispatches,
             "memory_bytes": int(getattr(self.tracker, "used", 0) or 0),
             "consistency": self.consistency,
+            "batch": (f"{self.batch_id}/{self.lane}"
+                      if self.batch_id is not None else ""),
         }
 
 

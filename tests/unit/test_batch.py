@@ -1,0 +1,412 @@
+"""Multi-lane batched device execution (ISSUE 15): batch forming,
+per-lane de-mux parity, the one-dispatch-slot contract with the PR 8
+shed plane, KILL/deadline lane detach (mid-form and mid-flight), the
+SHOW QUERIES Batch column, and UPDATE CONFIGS-updatable flags."""
+import random
+import threading
+import time
+
+import pytest
+
+from nebula_tpu.exec.engine import QueryEngine
+from nebula_tpu.graphstore.schema import PropDef, PropType
+from nebula_tpu.graphstore.store import GraphStore
+from nebula_tpu.utils.config import get_config
+from nebula_tpu.utils.failpoints import fail
+from nebula_tpu.utils.stats import WorkCounters, stats, use_work
+from nebula_tpu.utils.workload import dispatch_table, live_registry
+
+tpu = pytest.importorskip("nebula_tpu.tpu")
+from nebula_tpu.tpu import TpuRuntime, make_mesh          # noqa: E402
+from nebula_tpu.tpu.batch import batch_former             # noqa: E402
+
+GO_TMPL = "GO 2 STEPS FROM {seed} OVER E YIELD dst(edge) AS d"
+
+
+def batched_store(n=60, deg=4):
+    rng = random.Random(11)
+    st = GraphStore()
+    st.create_space("bt", partition_num=4, vid_type="INT64")
+    st.catalog.create_tag("bt", "P", [PropDef("x", PropType.INT64)])
+    st.catalog.create_edge("bt", "E", [PropDef("w", PropType.INT64)])
+    for v in range(n):
+        st.insert_vertex("bt", v, "P", {"x": v})
+    for v in range(n):
+        for _ in range(deg):
+            st.insert_edge("bt", v, "E", rng.randrange(n), 0, {"w": v})
+    return st
+
+
+@pytest.fixture(scope="module")
+def rt():
+    # single-chip mesh: the lane axis is a local_mode program
+    return TpuRuntime(make_mesh(1))
+
+
+@pytest.fixture()
+def clean():
+    fail.reset()
+    batch_former().reset()
+    yield
+    fail.reset()
+    batch_former().reset()
+    cfg = get_config()
+    with cfg.lock:
+        for k in ("batch_max_lanes", "batch_wait_us",
+                  "query_timeout_secs", "flight_sample_rate"):
+            cfg.dynamic_layer.pop(k, None)
+
+
+def device_engine(rt, **kw):
+    eng = QueryEngine(batched_store(**kw), tpu_runtime=rt)
+    s = eng.new_session()
+    assert eng.execute(s, "USE bt").error is None
+    return eng
+
+
+@pytest.fixture()
+def company():
+    """Two dummy live registrations so the batch former's concurrency
+    hint is deterministically TRUE regardless of thread arrival order
+    (in production the hint comes from real concurrent statements or
+    the admission drain burst)."""
+    a = live_registry().register(qid=-101, session=0, user="t",
+                                 stmt="dummy", kind="Go")
+    b = live_registry().register(qid=-102, session=0, user="t",
+                                 stmt="dummy", kind="Go")
+    yield
+    if a is not None:
+        live_registry().deregister(-101)
+    if b is not None:
+        live_registry().deregister(-102)
+
+
+def _run_stmt(eng, stmt, out, key, errs):
+    try:
+        s = eng.new_session()
+        eng.execute(s, "USE bt")
+        wc = WorkCounters()
+        with use_work(wc):
+            rs = eng.execute(s, stmt)
+        out[key] = (rs, wc.as_dict())
+    except Exception as ex:  # noqa: BLE001
+        errs.append(repr(ex))
+
+
+def _concurrent(eng, stmts):
+    out, errs = {}, []
+    ths = [threading.Thread(target=_run_stmt,
+                            args=(eng, stmt, out, key, errs),
+                            daemon=True)
+           for key, stmt in stmts.items()]
+    for t in ths:
+        t.start()
+    for t in ths:
+        t.join(60)
+    assert not errs, errs[:3]
+    return out
+
+
+def _wait_for(pred, timeout=10.0, msg="condition"):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        got = pred()
+        if got:
+            return got
+        time.sleep(0.01)
+    pytest.fail(f"timed out waiting for {msg}")
+
+
+# -- forming + de-mux parity ------------------------------------------------
+
+
+def test_batched_launch_shares_and_demuxes(rt, clean, company):
+    """K compatible concurrent GO statements form ONE multi-lane
+    launch; each statement's rows and deterministic WorkCounters equal
+    its own solo run (per-lane de-mux through the per-statement
+    attribution machinery)."""
+    eng = device_engine(rt)
+    seeds = [1, 2, 3, 5]
+    truth = {}
+    for sd in seeds:
+        out = {}
+        _run_stmt(eng, GO_TMPL.format(seed=sd), out, sd, [])
+        rs, wc = out[sd]
+        assert rs.error is None, rs.error
+        truth[sd] = (sorted(map(repr, rs.data.rows)), wc)
+    get_config().set_dynamic_many({"batch_max_lanes": 8,
+                                   "batch_wait_us": 300_000})
+    s0 = stats().snapshot()
+    out = _concurrent(eng, {sd: GO_TMPL.format(seed=sd)
+                            for sd in seeds})
+    s1 = stats().snapshot()
+    for sd in seeds:
+        rs, wc = out[sd]
+        assert rs.error is None, rs.error
+        assert sorted(map(repr, rs.data.rows)) == truth[sd][0], \
+            f"seed {sd}: batched rows differ from solo truth"
+        assert wc == truth[sd][1], \
+            f"seed {sd}: batched work counters differ from solo truth"
+    formed = s1.get("tpu_batches_formed", 0) \
+        - s0.get("tpu_batches_formed", 0)
+    runs = s1.get("tpu_kernel_runs", 0) - s0.get("tpu_kernel_runs", 0)
+    assert formed >= 1, "no batch formed under concurrent load"
+    # sharing is real: fewer launches than statements (ledger proof)
+    assert runs < len(seeds), (runs, len(seeds))
+
+
+def test_solo_statement_skips_the_window(rt, clean):
+    """Batching ON with no concurrent company: the statement takes the
+    solo dispatch path — no group, no forming wait, no batch metrics
+    (single-query latency unchanged)."""
+    eng = device_engine(rt)
+    get_config().set_dynamic_many({"batch_max_lanes": 8,
+                                   "batch_wait_us": 500_000})
+    s0 = stats().snapshot()
+    out = {}
+    _run_stmt(eng, GO_TMPL.format(seed=7), out, 7, [])
+    rs, _ = out[7]
+    assert rs.error is None, rs.error
+    s1 = stats().snapshot()
+    assert s1.get("tpu_batches_formed", 0) == \
+        s0.get("tpu_batches_formed", 0)
+    assert not batch_former().forming()
+
+
+def test_batch_form_failpoint_raise_dispatches_solo(rt, clean, company):
+    """`tpu:batch_form` armed with raise: enrollment is rejected and
+    the statement dispatches SOLO (rows still correct — never host
+    fallback, never an error)."""
+    eng = device_engine(rt)
+    out = {}
+    _run_stmt(eng, GO_TMPL.format(seed=9), out, "truth", [])
+    truth = sorted(map(repr, out["truth"][0].data.rows))
+    get_config().set_dynamic_many({"batch_max_lanes": 8,
+                                   "batch_wait_us": 300_000})
+    fail.arm("tpu:batch_form", "raise")
+    s0 = stats().snapshot()
+    out = {}
+    _run_stmt(eng, GO_TMPL.format(seed=9), out, 9, [])
+    rs, _ = out[9]
+    assert rs.error is None, rs.error
+    assert sorted(map(repr, rs.data.rows)) == truth
+    s1 = stats().snapshot()
+    assert s1.get("tpu_batches_formed", 0) == \
+        s0.get("tpu_batches_formed", 0)
+
+
+# -- PR 8 shed interaction: one dispatch-queue slot per batch ---------------
+
+
+def test_batch_consumes_one_dispatch_slot(rt, clean, company):
+    """ISSUE 15 satellite: a batched launch enters the dispatch table
+    ONCE — with the dispatch gate write-held, K batched statements
+    show queue depth 1 (batching off shows K), so turning batching on
+    can never increase the `tpu_dispatch_queue_cap` shed rate."""
+    eng = device_engine(rt)
+    seeds = [1, 2, 3]
+    # warm: pin + compile outside the gate-held window
+    out = {}
+    for sd in seeds:
+        _run_stmt(eng, GO_TMPL.format(seed=sd), out, sd, [])
+        assert out[sd][0].error is None
+
+    def run_held(batching: bool):
+        if batching:
+            get_config().set_dynamic_many({"batch_max_lanes": 8,
+                                           "batch_wait_us": 150_000})
+        else:
+            get_config().set_dynamic("batch_max_lanes", 0)
+        rt._gate.acquire_write()
+        depth = None
+        try:
+            res, errs = {}, []
+            ths = [threading.Thread(
+                target=_run_stmt,
+                args=(eng, GO_TMPL.format(seed=sd), res, sd, errs),
+                daemon=True) for sd in seeds]
+            for t in ths:
+                t.start()
+            want = 1 if batching else len(seeds)
+            _wait_for(lambda: dispatch_table().queued_depth() >= want,
+                      msg=f"queued depth {want}")
+            # settle: ALL statements are past forming/enqueue before
+            # the depth is judged (the batched case must stay at 1)
+            time.sleep(0.4)
+            depth = dispatch_table().queued_depth()
+        finally:
+            rt._gate.release_write()
+        for t in ths:
+            t.join(30)
+        assert not errs, errs
+        for sd in seeds:
+            assert res[sd][0].error is None, res[sd][0].error
+        return depth
+
+    assert run_held(batching=False) == len(seeds)
+    assert run_held(batching=True) == 1
+
+
+# -- cancellation detaches one lane -----------------------------------------
+
+
+def test_kill_mid_form_detaches_lane(rt, clean, company):
+    """KILL QUERY of a statement waiting in a forming group evicts
+    only that lane: the victim dies promptly (well before the window
+    closes), the batchmate completes with correct rows."""
+    eng = device_engine(rt)
+    out = {}
+    _run_stmt(eng, GO_TMPL.format(seed=2), out, "truth", [])
+    truth = sorted(map(repr, out["truth"][0].data.rows))
+    get_config().set_dynamic_many({"batch_max_lanes": 8,
+                                   "batch_wait_us": 3_000_000})
+    res, errs = {}, []
+    t_victim = threading.Thread(
+        target=_run_stmt,
+        args=(eng, GO_TMPL.format(seed=1), res, "victim", errs),
+        daemon=True)
+    t_mate = threading.Thread(
+        target=_run_stmt,
+        args=(eng, GO_TMPL.format(seed=2), res, "mate", errs),
+        daemon=True)
+    t_victim.start()
+    t_mate.start()
+    row = _wait_for(
+        lambda: next((r for r in eng.list_running_queries()
+                      if r[3] == GO_TMPL.format(seed=1)), None),
+        msg="victim visible")
+    _wait_for(lambda: batch_former().forming(), msg="group forming")
+    t0 = time.monotonic()
+    assert eng.kill_running(sid=row[0], qid=row[1])
+    t_victim.join(30)
+    killed_after = time.monotonic() - t0
+    assert res["victim"][0].error == "ExecutionError: query was killed"
+    # the victim left the group long before the 3 s window closed
+    assert killed_after < 1.5, killed_after
+    t_mate.join(30)
+    assert not errs, errs
+    assert res["mate"][0].error is None, res["mate"][0].error
+    assert sorted(map(repr, res["mate"][0].data.rows)) == truth
+
+
+def test_kill_mid_flight_discards_only_that_lane(rt, clean, company):
+    """KILL QUERY after the batch launched: the victim's lane result
+    is discarded at de-mux, the batchmate's rows are exact."""
+    eng = device_engine(rt)
+    out = {}
+    _run_stmt(eng, GO_TMPL.format(seed=3), out, "truth", [])
+    truth = sorted(map(repr, out["truth"][0].data.rows))
+    get_config().set_dynamic_many({"batch_max_lanes": 2,
+                                   "batch_wait_us": 400_000})
+    # hold the LAUNCH at the dispatch gate so the kill lands mid-flight
+    fail.arm("tpu:dispatch_gate", "delay(0.6)")
+    s0 = stats().snapshot()
+    res, errs = {}, []
+    t_victim = threading.Thread(
+        target=_run_stmt,
+        args=(eng, GO_TMPL.format(seed=5), res, "victim", errs),
+        daemon=True)
+    t_mate = threading.Thread(
+        target=_run_stmt,
+        args=(eng, GO_TMPL.format(seed=3), res, "mate", errs),
+        daemon=True)
+    t_victim.start()
+    t_mate.start()
+    row = _wait_for(
+        lambda: next((r for r in eng.list_running_queries()
+                      if r[3] == GO_TMPL.format(seed=5)), None),
+        msg="victim visible")
+    # a 2-lane group fills and claims its launch immediately; the gate
+    # failpoint then holds the LAUNCHED batch queued in the dispatch
+    # table — the kill below provably lands mid-flight
+    _wait_for(lambda: dispatch_table().queued_depth() >= 1,
+              msg="batched launch queued at the gate")
+    assert eng.kill_running(sid=row[0], qid=row[1])
+    t_victim.join(30)
+    t_mate.join(30)
+    fail.reset()
+    assert not errs, errs
+    assert res["victim"][0].error == "ExecutionError: query was killed"
+    assert res["mate"][0].error is None, res["mate"][0].error
+    assert sorted(map(repr, res["mate"][0].data.rows)) == truth
+    s1 = stats().snapshot()
+    assert s1.get("tpu_batches_formed", 0) \
+        - s0.get("tpu_batches_formed", 0) == 1
+
+
+def test_deadline_mid_form_evicts_lane(rt, clean, company):
+    """A statement whose deadline budget expires while batch-forming
+    fails E_QUERY_TIMEOUT without a launch (the lane withdrew)."""
+    eng = device_engine(rt)
+    get_config().set_dynamic_many({"batch_max_lanes": 8,
+                                   "batch_wait_us": 5_000_000,
+                                   "query_timeout_secs": 0.4})
+    s0 = stats().snapshot()
+    out = {}
+    _run_stmt(eng, GO_TMPL.format(seed=4), out, 4, [])
+    rs, _ = out[4]
+    assert rs.error is not None and "E_QUERY_TIMEOUT" in rs.error, rs
+    s1 = stats().snapshot()
+    assert s1.get("tpu_batches_formed", 0) == \
+        s0.get("tpu_batches_formed", 0)
+    # the all-withdrawn group was REMOVED from the forming map — a
+    # later compatible statement opens a fresh group instead of
+    # joining an expired husk (code-review regression)
+    assert not batch_former().forming()
+
+
+# -- SHOW QUERIES surface ---------------------------------------------------
+
+
+def test_show_queries_batch_column(rt, clean, company):
+    """An enrolled statement shows BatchId/lane in SHOW QUERIES while
+    forming/in flight; the column clears after completion."""
+    eng = device_engine(rt)
+    get_config().set_dynamic_many({"batch_max_lanes": 8,
+                                   "batch_wait_us": 1_500_000})
+    res, errs = {}, []
+    t = threading.Thread(
+        target=_run_stmt,
+        args=(eng, GO_TMPL.format(seed=6), res, 6, errs), daemon=True)
+    t.start()
+
+    def batched_row():
+        r = next((r for r in eng.list_running_queries()
+                  if r[3] == GO_TMPL.format(seed=6)), None)
+        return r if r is not None and r[13] else None
+
+    row = _wait_for(batched_row, msg="Batch column populated")
+    bid, lane = row[13].split("/")
+    assert int(bid) >= 1 and int(lane) >= 0
+    # the statement surface carries the same column
+    s2 = eng.new_session()
+    rs = eng.execute(s2, "SHOW QUERIES")
+    assert rs.ok
+    assert rs.data.column_names[-2:] == ["Batch", "GraphAddr"]
+    srow = next(r for r in rs.data.rows
+                if r[3] == GO_TMPL.format(seed=6))
+    assert srow[13] == row[13]
+    t.join(30)
+    assert not errs, errs
+    assert res[6][0].error is None, res[6][0].error
+    assert not any(r[13] for r in eng.list_running_queries())
+
+
+# -- flags ------------------------------------------------------------------
+
+
+def test_batch_flags_update_configs(rt, clean):
+    """batch_max_lanes / batch_wait_us are runtime-updatable via the
+    UPDATE CONFIGS multi-key path and read LIVE by the former."""
+    eng = device_engine(rt)
+    s = eng.new_session()
+    rs = eng.execute(s, "UPDATE CONFIGS batch_max_lanes=4, "
+                        "batch_wait_us=123")
+    assert rs.error is None, rs.error
+    assert get_config().get("batch_max_lanes") == 4
+    assert get_config().get("batch_wait_us") == 123
+    assert batch_former().max_lanes() == 4
+    assert batch_former().enabled()
+    rs = eng.execute(s, "UPDATE CONFIGS batch_max_lanes=0")
+    assert rs.error is None, rs.error
+    assert not batch_former().enabled()

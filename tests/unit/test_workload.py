@@ -435,6 +435,100 @@ def test_cluster_show_queries_live_and_kill(clean, tmp_path):
         c.stop()
 
 
+# -- batched attribution (ISSUE 15) -----------------------------------------
+
+
+def test_batched_attribution_mixed_go_match(clean):
+    """N concurrent mixed GO/MATCH statements with multi-lane batching
+    ON produce rows byte-identical to batching OFF and to sequential
+    truth, with exact per-statement WorkCounters and per-statement
+    flight entries (the PR 7 attribution contract survives shared
+    launches)."""
+    pytest.importorskip("nebula_tpu.tpu")
+    import random
+
+    from nebula_tpu.graphstore.schema import PropDef, PropType
+    from nebula_tpu.graphstore.store import GraphStore
+    from nebula_tpu.tpu import TpuRuntime, make_mesh
+    from nebula_tpu.tpu.batch import batch_former
+
+    rng = random.Random(5)
+    st = GraphStore()
+    st.create_space("bw", partition_num=4, vid_type="INT64")
+    st.catalog.create_tag("bw", "P", [PropDef("x", PropType.INT64)])
+    st.catalog.create_edge("bw", "E", [PropDef("w", PropType.INT64)])
+    for v in range(50):
+        st.insert_vertex("bw", v, "P", {"x": v})
+    for v in range(50):
+        for _ in range(4):
+            st.insert_edge("bw", v, "E", rng.randrange(50), 0, {"w": v})
+    rt = TpuRuntime(make_mesh(1))
+    eng = QueryEngine(st, tpu_runtime=rt)
+    s0 = eng.new_session()
+    assert eng.execute(s0, "USE bw").error is None
+
+    def stmt_of(seed):
+        if seed % 2:
+            return (f"MATCH (a:P)-[e:E]->(b) WHERE id(a) == {seed} "
+                    f"RETURN id(b)")
+        return f"GO 2 STEPS FROM {seed} OVER E YIELD dst(edge) AS d"
+
+    seeds = [1, 2, 3, 4, 5, 6]
+
+    def run_set(concurrent: bool):
+        results = {}
+
+        def one(seed):
+            s = eng.new_session()
+            eng.execute(s, "USE bw")
+            wc = WorkCounters()
+            with use_work(wc):
+                rs = eng.execute(s, stmt_of(seed))
+            results[seed] = (rs, wc.as_dict())
+
+        if concurrent:
+            ts = [threading.Thread(target=one, args=(sd,), daemon=True)
+                  for sd in seeds]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(60)
+        else:
+            for sd in seeds:
+                one(sd)
+        for sd in seeds:
+            assert results[sd][0].error is None, results[sd][0].error
+        return {sd: (sorted(map(repr, results[sd][0].data.rows)),
+                     results[sd][1]) for sd in seeds}
+
+    truth = run_set(concurrent=False)            # sequential, batching off
+    off = run_set(concurrent=True)               # concurrent, batching off
+    get_config().set_dynamic_many({"batch_max_lanes": 8,
+                                   "batch_wait_us": 300_000})
+    flight_recorder().clear()
+    get_config().set_dynamic("flight_sample_rate", 1.0)
+    try:
+        on = run_set(concurrent=True)            # concurrent, batching ON
+    finally:
+        for k in ("batch_max_lanes", "batch_wait_us"):
+            get_config().dynamic_layer.pop(k, None)
+        batch_former().reset()
+    for sd in seeds:
+        assert on[sd][0] == truth[sd][0] == off[sd][0], \
+            f"seed {sd}: rows differ across batching modes"
+        assert on[sd][1] == truth[sd][1] == off[sd][1], \
+            f"seed {sd}: work counters differ across batching modes"
+    # every statement kept its OWN flight entry with its own work
+    ents = flight_recorder().list(limit=100)
+    for sd in seeds:
+        stmt = stmt_of(sd)
+        ent = next(e for e in ents if e["stmt"] == stmt[:120])
+        full = flight_recorder().get(ent["id"])
+        assert full["work"]["edges_traversed"] == \
+            truth[sd][1]["edges_traversed"], \
+            f"seed {sd}: flight work attribution bled across lanes"
+
+
 # -- HTTP surfaces ----------------------------------------------------------
 
 
