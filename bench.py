@@ -1095,6 +1095,31 @@ def main():
     concurrency["workload_overhead_pct"] = round(
         max((wl_on - wl_off) / wl_off, 0.0) * 100.0, 2) \
         if wl_off > 0 else 0.0
+
+    # insights-plane overhead A/B on the same query (ISSUE 16):
+    # insights_enabled off = no fingerprint / no registry update; the
+    # acceptance bar is the same <= 2%
+    def _ins_p50(enabled: bool) -> float:
+        _wl_cfg().set_dynamic("insights_enabled", enabled)
+        wl_eng.execute(wl_sess, wl_q)             # warm
+        ol = []
+        for _ in range(40):
+            t0 = time.perf_counter()
+            r = wl_eng.execute(wl_sess, wl_q)
+            ol.append(time.perf_counter() - t0)
+            assert r.error is None, r.error
+        return _median(ol)
+
+    try:
+        ins_off = _ins_p50(False)
+        ins_on = _ins_p50(True)
+    finally:
+        _wl_cfg().dynamic_layer.pop("insights_enabled", None)
+    concurrency["insights_off_p50_ms"] = round(ins_off * 1e3, 3)
+    concurrency["insights_on_p50_ms"] = round(ins_on * 1e3, 3)
+    concurrency["insights_overhead_pct"] = round(
+        max((ins_on - ins_off) / ins_off, 0.0) * 100.0, 2) \
+        if ins_off > 0 else 0.0
     _save_partial(platform, configs)
 
     # ---- overload block (ISSUE 10): goodput-vs-offered-load curve at

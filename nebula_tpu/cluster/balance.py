@@ -96,7 +96,14 @@ def balance_data(store, space: Optional[str] = None,
     `exclude` (BALANCE DATA REMOVE "host"): drain — the listed hosts are
     treated as gone, so their replicas re-home onto the remaining alive
     hosts and the drained copies are dropped; afterwards DROP HOSTS can
-    remove them from the cluster."""
+    remove them from the cluster.
+
+    Placement is replica-COUNT balanced today.  A load-aware variant
+    has its signal ready: per-part heat (read/write QPS EWMAs) from
+    `utils.insights.PartHeatTable.heat_of` rides every storaged
+    heartbeat and is merged/ranked at metad (`meta.hotspots`, SHOW
+    HOTSPOTS) — weigh `load` by part heat instead of part count to
+    split hot parts from each other (ISSUE 16)."""
     meta, sc = store.meta, store.sc
     ops = ClientPartOps(meta, sc)
     alive = [h for h in _alive_storage(meta)

@@ -107,10 +107,35 @@ def main(argv=None):
             web.providers["/repairs"] = lambda q: (
                 200, _json.dumps(svc.rpc_list_repairs({}), default=str),
                 "application/json")
+            # workload insights federation (ISSUE 16): every graphd's
+            # fingerprint table, per-host + exactly merged
+            web.providers["/cluster_statements"] = lambda q: (
+                200, _json.dumps(fed.cluster_statements(), default=str),
+                "application/json")
+            # heat rides the heartbeats, so metad answers hotspots from
+            # its own host table — no extra scrape round
+            web.providers["/hotspots"] = lambda q: (
+                200, _json.dumps(svc.rpc_hotspots({}), default=str),
+                "application/json")
         else:
             # tell metad where to scrape us (rides the heartbeat) —
             # set BEFORE svc.start() so the first heartbeat carries it
             mc.ws_addr = web.addr
+            import json as _json
+            if args.role == "graphd":
+                # this graphd's statement fingerprint table (ISSUE 16)
+                # — the target of metad's /cluster_statements fan-out
+                web.providers["/statements"] = lambda q: (
+                    200, _json.dumps(svc.engine.insights.snapshot(),
+                                     default=str),
+                    "application/json")
+            else:
+                # this storaged's per-part heat rows (local, unmerged;
+                # the cluster-ranked view lives on metad)
+                web.providers["/hotspots"] = lambda q: (
+                    200, _json.dumps(svc.part_heat.snapshot(),
+                                     default=str),
+                    "application/json")
         web.start()
     svc.start()
     if fed is not None:

@@ -194,6 +194,33 @@ class MetricFederator:
             out[addr] = {"role": role, "ok": False, "error": str(body)}
         return out
 
+    def cluster_statements(self) -> Dict:
+        """Workload insights federation (ISSUE 16): fan /statements out
+        over every alive graphd and return both the per-instance
+        fingerprint tables and ONE exactly-merged view (the fixed
+        shared latency buckets make the cross-host histogram sum
+        lossless) — served at metad's GET /cluster_statements."""
+        import json as _json
+
+        from ..utils.insights import merge_statement_snapshots
+        hosts: Dict[str, Dict] = {}
+        snaps = []
+        for (addr, role, ws), body, _dt in self._fan_out("/statements"):
+            if role != "graphd":
+                continue
+            if not isinstance(body, OSError):
+                try:
+                    rows = _json.loads(body)
+                    hosts[addr] = {"ok": True, "statements": rows}
+                    snaps.append(rows)
+                    continue
+                except ValueError as ex:
+                    body = ex
+            stats().inc("federation_scrape_errors")
+            hosts[addr] = {"ok": False, "error": str(body)}
+        return {"hosts": hosts,
+                "merged": merge_statement_snapshots(snaps)}
+
     def render(self) -> str:
         """The merged view, re-scraped on demand when stale (covers the
         interval=0 / no-background-loop configuration)."""

@@ -157,6 +157,13 @@ class GraphService:
         QueryEngine.list_running_queries."""
         return self.engine.list_running_queries()
 
+    def rpc_list_statements(self, p):
+        """This graphd's insights registry snapshot (ISSUE 16): per-
+        fingerprint mergeable aggregate dicts — SHOW STATEMENTS fans
+        out over every registered graph host and sums them exactly
+        (shared fixed latency buckets)."""
+        return self.engine.insights.snapshot()
+
     def rpc_session_live(self, p):
         """The live half of SHOW SESSIONS (ISSUE 9): metad's replicated
         table knows user/space/created, but last-used time and the

@@ -61,6 +61,7 @@ class MetaClient:
         self._hb_thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self._hb_parts_fn = None          # set by storaged: () -> {space: [pid]}
+        self._hb_heat_fn = None           # set by storaged: () -> PartHeat rows
         self.on_refresh = None            # hook: called after a cache refresh
 
     # -- leader discovery -------------------------------------------------
@@ -143,14 +144,19 @@ class MetaClient:
 
     def heartbeat_once(self) -> Dict[str, Any]:
         parts = self._hb_parts_fn() if self._hb_parts_fn else {}
+        # per-partition heat rides the heartbeat (ISSUE 16): snapshot()
+        # folds the QPS EWMAs forward, so metad's view decays with the
+        # heartbeat cadence; an empty/None payload costs nothing
+        heat = self._hb_heat_fn() if self._hb_heat_fn else None
         r = self.call("meta.heartbeat", host=self.my_addr, role=self.role,
-                      parts=parts, ws=self.ws_addr)
+                      parts=parts, ws=self.ws_addr, heat=heat)
         if r["version"] != self.version:
             self.refresh(force=True)
         return r
 
-    def start_heartbeat(self, parts_fn=None):
+    def start_heartbeat(self, parts_fn=None, heat_fn=None):
         self._hb_parts_fn = parts_fn
+        self._hb_heat_fn = heat_fn
         self._stop.clear()
 
         def loop():

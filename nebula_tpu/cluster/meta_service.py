@@ -450,7 +450,10 @@ class MetaService:
             "role": role, "last_hb": time.monotonic(),
             "parts": p.get("parts", {}),
             # webservice addr for metric federation scrapes (ISSUE 8)
-            "ws": p.get("ws", "")}
+            "ws": p.get("ws", ""),
+            # per-partition heat rows (ISSUE 16): storaged's PartHeat
+            # snapshot rides every heartbeat; rpc_hotspots merges them
+            "heat": p.get("heat") or []}
         with self.state_lock:
             return {"version": self.state.version,
                     "leader": self.raft.is_leader()}
@@ -535,6 +538,28 @@ class MetaService:
                  "status": h["status"],
                  "parts": h["parts"], "ws": h.get("ws", "")}
                 for a, h in sorted(self.host_liveness().items())]
+
+    def rpc_hotspots(self, p):
+        """Cluster-wide per-partition heat map (ISSUE 16): merge the
+        PartHeat rows the storaged heartbeats carry, rank by load and
+        annotate each part with its placement (leader = replicas[0] of
+        the part map) — the SHOW HOTSPOTS backend and the read side of
+        heat-driven balancing."""
+        self._require_leader()
+        from ..utils.insights import merge_heat_snapshots
+        per_host = {a: h.get("heat") or []
+                    for a, h in self.active_hosts.items()
+                    if h["role"] == "storage"}
+        rows = merge_heat_snapshots(per_host)
+        with self.state_lock:
+            pm = {sp: [list(r) for r in parts]
+                  for sp, parts in self.state.part_map.items()}
+        for r in rows:
+            reps = pm.get(r["space"], [])
+            pid = r["part"]
+            r["replicas"] = reps[pid] if pid < len(reps) else []
+            r["leader"] = r["replicas"][0] if r["replicas"] else ""
+        return rows
 
     def storage_hosts(self) -> List[str]:
         now = time.monotonic()

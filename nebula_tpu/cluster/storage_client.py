@@ -98,7 +98,13 @@ def peer_score(addr: str) -> float:
     `addr` — lower is better.  Seconds-shaped: latency EWMA, plus the
     remaining E_OVERLOAD penalty window, plus a large constant for an
     open circuit breaker (peer recently unreachable) and a small one
-    for half-open (unproven)."""
+    for half-open (unproven).
+
+    Per-PART load is deliberately not folded in here (this score is
+    per-peer); the documented part-granular signal is
+    `utils.insights.PartHeatTable.heat_of(space, part)` (ISSUE 16) —
+    each storaged's heat rides its heartbeat, so a heat-aware router
+    or BALANCE planner reads it from metad's merged hotspot view."""
     st = _peer_stat(addr)
     score = st.ewma_s
     rem = st.penalty_until - time.monotonic()

@@ -178,6 +178,12 @@ class StorageService:
         # never claims its error (see BoundedErrorMap).
         self._apply_errors = BoundedErrorMap()
         self._read_bucket = _ReadBucket()
+        # per-partition heat map (ISSUE 16): read/write QPS + latency
+        # EWMAs per (space, part), snapshotted onto the heartbeat so
+        # metad can rank hotspots cluster-wide (SHOW HOTSPOTS) and the
+        # replica router / BALANCE planner can consult heat_of()
+        from ..utils.insights import PartHeatTable
+        self.part_heat = PartHeatTable()
         self.transport = RpcRaftTransport()
         self.server = server
         server.service_role = "storaged"
@@ -417,7 +423,8 @@ class StorageService:
                                         if len(errs) > 1 else ""))
 
     def start(self):
-        self.meta.start_heartbeat(parts_fn=self.owned_parts)
+        self.meta.start_heartbeat(parts_fn=self.owned_parts,
+                                  heat_fn=self.part_heat.snapshot)
         self._resume_alive = True
         self._resume_thread = threading.Thread(
             target=self._chain_resume_loop, daemon=True,
@@ -553,7 +560,9 @@ class StorageService:
                     f"read capacity {cap:g}/s exhausted"))
         lvl = p.get("consistency") or _consistency.LEADER
         if lvl == _consistency.LEADER:
-            return self._leader_part(space, pid)
+            part = self._leader_part(space, pid)
+            self._heat_read(space, pid)
+            return part
         if lvl not in _consistency.LEVELS:
             raise RpcError(f"unknown consistency level {lvl!r}")
         part = self._local_part(space, pid)
@@ -602,7 +611,16 @@ class StorageService:
         cc = current_cost()
         if cc is not None:
             cc.add("follower_reads", 1)
+        self._heat_read(space, pid)
         return part
+
+    def _heat_read(self, space: str, pid: int):
+        """Heat is SERVED load: bumped only when the gate admits — a
+        client walking replicas for the leader must not triple-count
+        one logical read across the part's hosts."""
+        from ..utils.insights import StatementRegistry
+        if StatementRegistry.enabled():
+            self.part_heat.record_read(space, pid)
 
     # -- write RPCs: {"space", "part", "cmds": [wire-encoded tuples]} -----
 
@@ -668,11 +686,18 @@ class StorageService:
         fail.hit("storage:pre_propose", key=part.group)
         # ONE batched proposal for the request: one WAL sync + one
         # replication wake for N commands (group commit, ISSUE 3)
+        import time as _t
+        t0 = _t.monotonic()
         with _trace.span("raft:propose_batch", group=part.group,
                          entries=len(stamped)):
             idxs = part.propose_batch(stamped)
         if idxs is None:
             raise RpcError("part_leader_changed: write not committed")
+        from ..utils.insights import StatementRegistry
+        if StatementRegistry.enabled():
+            self.part_heat.record_write(
+                space, pid, rows=len(p["cmds"]),
+                latency_us=(_t.monotonic() - t0) * 1e6)
         # per-entry apply semantics are unchanged: any command whose
         # apply failed fails the request — a client is never acked for
         # a write that did not actually land

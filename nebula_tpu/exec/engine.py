@@ -257,6 +257,12 @@ class QueryEngine:
         # execution entirely, invalidated by the same schema epoch plus
         # the engine's write epoch (0-capacity default = disabled)
         self.result_cache = ResultCache()
+        # workload insights (ISSUE 16): per-fingerprint aggregates
+        # behind SHOW STATEMENTS.  Per ENGINE, not process-wide: a
+        # LocalCluster runs several graphds in one process and the
+        # cluster fan-out sums per-graphd registries
+        from ..utils.insights import StatementRegistry
+        self.insights = StatementRegistry()
         # stall watchdog (ISSUE 9): idempotent start of the process-wide
         # scan thread; gated by stall_watchdog_interval_secs
         from ..utils.workload import stall_watchdog
@@ -300,7 +306,7 @@ class QueryEngine:
         source for SHOW [LOCAL] QUERIES and the graphd fan-out RPC.
         Row shape: [sid, qid, user, text, status, operator, rows,
         duration_us, queue_us, device_us, host_us, memory_bytes,
-        consistency, batch]."""
+        consistency, batch, fingerprint]."""
         from ..utils.workload import live_registry
         rows = []
         for s in list(self.sessions.values()):
@@ -314,11 +320,12 @@ class QueryEngine:
                                  p["device_us"], p["host_us"],
                                  p["memory_bytes"],
                                  p.get("consistency", ""),
-                                 p.get("batch", "")])
+                                 p.get("batch", ""),
+                                 p.get("fingerprint", "")])
                 else:
                     # workload plane disabled: identity columns only
                     rows.append([s.id, qid, s.user, qtext, "RUNNING",
-                                 "", 0, 0, 0, 0, 0, 0, "", ""])
+                                 "", 0, 0, 0, 0, 0, 0, "", "", ""])
         return rows
 
     def kill_running(self, sid=None, qid=None) -> bool:
@@ -348,6 +355,30 @@ class QueryEngine:
             return int(self._slow_override)
         from ..utils.config import get_config
         return int(get_config().get("slow_query_threshold_us"))
+
+    def _fingerprint(self, stmt: A.Sentence, text: str,
+                     space: Optional[str],
+                     memo: bool = True) -> Optional[str]:
+        """Literal-normalized statement fingerprint (ISSUE 16), memoized
+        by (text, space) alongside the plan-cache key so the steady-
+        state cost is one bounded-LRU lookup.  None when the insights
+        plane is off — every downstream consumer treats None as
+        'record nothing'."""
+        if not self.insights.enabled():
+            return None
+        sp = space or ""
+        if memo:
+            fp = self.insights.fingerprints.get(text, sp)
+            if fp is not None:
+                return fp
+        from ..utils.insights import fingerprint_of
+        try:
+            fp = fingerprint_of(stmt, sp)
+        except Exception:  # noqa: BLE001 — insights must never throw
+            return None
+        if memo:
+            self.insights.fingerprints.put(text, sp, fp)
+        return fp
 
     def _cache_key(self, session: Session, text: str) -> Optional[tuple]:
         """Plan-cache key for this statement in this session's context,
@@ -404,15 +435,25 @@ class QueryEngine:
             stats().inc("num_queries")
             stats().inc("num_query_errors")
             err = f"SyntaxError: {ex}"
+            us = int((time.perf_counter() - t0) * 1e6)
+            # unparseable text still aggregates (ISSUE 16): repeated
+            # garbage lands under one raw-text digest in SHOW STATEMENTS
+            fp = None
+            if self.insights.enabled():
+                from ..utils.insights import parse_error_fingerprint
+                fp = parse_error_fingerprint(text, session.space or "")
+                self.insights.record(
+                    fp=fp, text=text, kind="Parse",
+                    space=session.space or "", latency_us=us, error=err)
             # forced capture covers parse errors too (ISSUE 8): a flood
             # of malformed statements burns SLO availability budget and
             # must leave flight-recorder evidence, not just counters
             from ..utils.flight import flight_recorder
             flight_recorder().record(
-                stmt=text, kind="Parse",
-                latency_us=int((time.perf_counter() - t0) * 1e6),
+                stmt=text, kind="Parse", latency_us=us,
                 error=err, trace_id=None, session=session.id,
-                operators=[], slow_us=self.slow_query_us)
+                operators=[], slow_us=self.slow_query_us,
+                fingerprint=fp)
             return ResultSet(error=err)
         if isinstance(stmt, A.SeqSentence):
             # `a; b; c` executes sequentially — each statement plans only
@@ -421,8 +462,11 @@ class QueryEngine:
             # (reference semantics for compound execute())
             res = ResultSet()
             for sub in stmt.stmts:
+                # memo_fp off: the (text, space) memo key would alias
+                # every sub-statement of the compound to one fingerprint
                 res = self._execute_parsed(session, sub, text,
-                                           time.perf_counter())
+                                           time.perf_counter(),
+                                           memo_fp=False)
                 if not res.ok:
                     return res
             return res
@@ -445,10 +489,21 @@ class QueryEngine:
         stats().add_value("query_latency_us", us)
         stats().observe("query_latency_us_hist", us,
                         {"kind": "CachedRead"})
+        # the hit skipped parse, so the fingerprint is only available
+        # from the memo — a miss there (evicted) just skips aggregation
+        fp = None
+        if self.insights.enabled():
+            fp = self.insights.fingerprints.get(text, session.space or "")
+            if fp is not None:
+                self.insights.record(
+                    fp=fp, text=text, kind="CachedRead",
+                    space=session.space or "", latency_us=us,
+                    rows=(len(data.rows) if data is not None else 0),
+                    result_cache_hit=True)
         flight_recorder().record(
             stmt=text, kind="CachedRead", latency_us=us, error=None,
             trace_id=None, session=session.id, operators=[],
-            slow_us=self.slow_query_us)
+            slow_us=self.slow_query_us, fingerprint=fp)
         if space:
             session.space = space
         return ResultSet(data, space=space, latency_us=us,
@@ -467,7 +522,8 @@ class QueryEngine:
     def _execute_parsed(self, session: Session, stmt: A.Sentence,
                         text: str, t0: float, cached_plan=None,
                         cache_key: Optional[tuple] = None,
-                        result_key: Optional[tuple] = None) -> ResultSet:
+                        result_key: Optional[tuple] = None,
+                        memo_fp: bool = True) -> ResultSet:
         """Metrics + tracing wrapper: every statement outcome (incl.
         semantic and execution errors) is visible in /stats; every
         statement produces one trace in the trace store, queryable via
@@ -477,6 +533,11 @@ class QueryEngine:
         from ..utils.config import get_config
         from ..utils.stats import stats
         kind = self._stmt_kind(stmt)
+        # statement fingerprint (ISSUE 16): computed once here (memoized
+        # next to the plan-cache key), stamped onto the live row, the
+        # slow log and the flight entry, and aggregated on completion
+        space0 = session.space or ""
+        fp = self._fingerprint(stmt, text, space0, memo=memo_fp)
         tg = None
         if get_config().get("enable_query_tracing"):
             tg = trace.start_trace(f"query:{kind}", service="graphd",
@@ -488,10 +549,12 @@ class QueryEngine:
         if tg is not None:
             with tg:
                 res = self._execute_inner(session, stmt, text, t0,
-                                          cached_plan, cache_key, obs)
+                                          cached_plan, cache_key, obs,
+                                          fp=fp)
         else:
             res = self._execute_inner(session, stmt, text, t0,
-                                      cached_plan, cache_key, obs)
+                                      cached_plan, cache_key, obs,
+                                      fp=fp)
         us = int((time.perf_counter() - t0) * 1e6)
         stats().inc("num_queries")
         stats().add_value("query_latency_us", us)
@@ -521,7 +584,24 @@ class QueryEngine:
             self.slow_log.append({"stmt": text, "latency_us": us,
                                   "ts": time.time(),
                                   "trace_id": tg.trace_id
-                                  if tg is not None else None})
+                                  if tg is not None else None,
+                                  "fingerprint": fp or ""})
+        if fp is not None:
+            # the one aggregate update per statement (ISSUE 16): the
+            # live row was deregistered in _execute_inner's finally but
+            # stays readable — its queue/device/lane attribution folds
+            # into the per-fingerprint totals here
+            lv = getattr(obs, "live", None)
+            self.insights.record(
+                fp=fp, text=text, kind=kind, space=space0,
+                latency_us=us, error=res.error,
+                rows=(len(res.data.rows) if res.data is not None else 0),
+                queue_us=(lv.queue_us if lv is not None else 0),
+                device_us=(lv.device_us if lv is not None else 0),
+                dispatches=(lv.dispatches if lv is not None else 0),
+                plan_hash=getattr(obs, "plan_hash", None),
+                plan_cache_hit=cached_plan is not None,
+                lanes=(lv.batch_lanes if lv is not None else 0))
         from ..utils.flight import flight_recorder
         flight_recorder().record(
             stmt=text, kind=kind, latency_us=us, error=res.error,
@@ -529,13 +609,14 @@ class QueryEngine:
             session=session.id,
             operators=obs.operators,
             work=(obs.work.as_dict if obs.work is not None else None),
-            slow_us=slow_us)
+            slow_us=slow_us, fingerprint=fp)
         return res
 
     def _execute_inner(self, session: Session, stmt: A.Sentence,
                        text: str, t0: float, cached_plan=None,
                        cache_key: Optional[tuple] = None,
-                       obs: Optional[ProfileStats] = None) -> ResultSet:
+                       obs: Optional[ProfileStats] = None,
+                       fp: Optional[str] = None) -> ResultSet:
         from ..utils.config import get_config
         if get_config().get("enable_authorize"):
             from .permissions import check as _perm_check
@@ -605,6 +686,19 @@ class QueryEngine:
             return ResultSet(DataSet(["plan"], [[desc]]),
                              space=plan.space, latency_us=us,
                              plan_desc=desc)
+        if fp is not None:
+            # plan shape hash for the regression sentinel (ISSUE 16):
+            # memoized on the (immutable post-optimize) plan object, so
+            # a plan-cache hit pays one getattr
+            ph = getattr(plan, "shape_hash", None)
+            if ph is None:
+                from ..utils.insights import plan_shape_hash
+                ph = plan_shape_hash(plan)
+                try:
+                    plan.shape_hash = ph
+                except Exception:  # noqa: BLE001 — slotted plan class
+                    pass
+            profile_stats.plan_hash = ph
         # Per-statement ExecutionContext seeded with the session's $vars —
         # intermediates die with the statement; only $var results persist.
         stmt_ectx = ExecutionContext()
@@ -640,7 +734,8 @@ class QueryEngine:
             qid=qid, session=session.id, user=session.user, stmt=text,
             kind=self._stmt_kind(stmt), deadline=dl,
             tracker=stmt_ectx.tracker,
-            consistency=effective_consistency())
+            consistency=effective_consistency(),
+            fingerprint=fp)
         stmt_ectx.live = live
         # admission control (ISSUE 10): a bounded-slot gate in front of
         # the scheduler — control statements bypass (priority lane),
@@ -688,6 +783,10 @@ class QueryEngine:
             session.running_kill.pop(qid, None)
             if live is not None:
                 live_registry().deregister(qid)
+                # the deregistered row stays readable: _execute_parsed
+                # folds its queue/device/lane attribution into the
+                # insights registry (ISSUE 16)
+                profile_stats.live = live
             # the flight recorder reads the statement's work counts off
             # the observer (even for failed statements, which return
             # from the except arms above)

@@ -1814,7 +1814,7 @@ def _show(node, qctx, ectx, space):
         qcols = ["SessionId", "ExecutionPlanId", "User", "Query",
                  "Status", "Operator", "Rows", "DurationUs", "QueueUs",
                  "DeviceUs", "HostUs", "MemoryBytes", "Consistency",
-                 "Batch", "GraphAddr"]
+                 "Batch", "Fingerprint", "GraphAddr"]
         cluster = getattr(qctx, "cluster", None)
         if a.get("extra") == "local":
             cluster = None      # SHOW LOCAL QUERIES: this graphd only
@@ -1838,6 +1838,60 @@ def _show(node, qctx, ectx, space):
         rows = [r + ["in-process"]
                 for r in (eng.list_running_queries() if eng else ())]
         return DataSet(qcols, rows)
+    if kind == "statements":
+        # aggregate workload digest (ISSUE 16): per-fingerprint calls,
+        # triage, mergeable latency quantiles, device share and plan
+        # history — the column contract lives in docs/OBSERVABILITY.md
+        # §10.  Cluster-wide by default (per-graphd registries merged
+        # exactly: fixed shared buckets); SHOW LOCAL STATEMENTS reads
+        # only this graphd's registry.
+        from ..utils.insights import (merge_statement_snapshots,
+                                      statement_columns)
+        stcols = ["Fingerprint", "Sample", "Calls", "Errors", "P50 Us",
+                  "P95 Us", "Rows", "DeviceShare", "PlanHash",
+                  "PlanChanged", "Regressed"]
+        cluster = getattr(qctx, "cluster", None)
+        if a.get("extra") == "local":
+            cluster = None      # SHOW LOCAL STATEMENTS: this graphd only
+        eng = getattr(qctx, "engine", None)
+        if cluster is not None:
+            # fan out over every registered graph host (idle graphds
+            # still hold history, unlike the SHOW QUERIES session set);
+            # a dead graphd's registry died with it (skip)
+            snaps = []
+            for h in cluster.list_hosts():
+                if h.get("role") != "graph" or not h.get("addr"):
+                    continue
+                try:
+                    snaps.append(_graphd_call(h["addr"],
+                                              "graph.list_statements"))
+                except Exception:  # noqa: BLE001 — graphd down
+                    continue
+            if not snaps and eng is not None:
+                snaps = [eng.insights.snapshot()]
+            return DataSet(stcols,
+                           statement_columns(
+                               merge_statement_snapshots(snaps)))
+        snap = eng.insights.snapshot() if eng is not None else []
+        return DataSet(stcols, statement_columns(snap))
+    if kind == "hotspots":
+        # per-partition heat map (ISSUE 16): metad merges the PartHeat
+        # tables ridden up on every storaged heartbeat and ranks parts
+        # by load, with replica placement for balancing context
+        hcols = ["Space", "Part", "Score", "ReadQps", "WriteQps",
+                 "Reads", "Writes", "ReadRows", "WriteRows",
+                 "ReadLatUs", "WriteLatUs", "Leader", "Replicas"]
+        cluster = getattr(qctx, "cluster", None)
+        if cluster is None:
+            # standalone engines have no storaged partition plane
+            return DataSet(hcols, [])
+        rows = [[r["space"], r["part"], r["score"], r["read_qps"],
+                 r["write_qps"], r["reads"], r["writes"],
+                 r["read_rows"], r["write_rows"], r["read_lat_us"],
+                 r["write_lat_us"], r.get("leader", ""),
+                 list(r.get("replicas", []))]
+                for r in cluster.call("meta.hotspots")]
+        return DataSet(hcols, rows)
     if kind == "configs":
         return DataSet(["Module", "Name", "Type", "Mode", "Value"],
                        _config_rows(qctx))

@@ -794,16 +794,18 @@ class Parser:
                     "hosts", role.value.lower() if role else None)
             if kw in ("LOCAL", "ALL") \
                     and self.peek(1).kind == "KEYWORD" \
-                    and self.peek(1).value in ("SESSIONS", "QUERIES"):
-                # SHOW LOCAL SESSIONS/QUERIES: this graphd only;
-                # SHOW ALL ...: cluster-wide (the default)
+                    and self.peek(1).value in ("SESSIONS", "QUERIES",
+                                               "STATEMENTS"):
+                # SHOW LOCAL SESSIONS/QUERIES/STATEMENTS: this graphd
+                # only; SHOW ALL ...: cluster-wide (the default)
                 scope = self.next().value.lower()
                 which = self.next().value.lower()
                 return A.ShowSentence(which,
                                       scope if scope == "local" else None)
             if kw in ("SPACES", "PARTS", "STATS", "JOBS", "SESSIONS",
                       "SNAPSHOTS", "BACKUPS", "QUERIES", "CONFIGS",
-                      "TRACES", "STALLS", "REPAIRS"):
+                      "TRACES", "STALLS", "REPAIRS", "STATEMENTS",
+                      "HOTSPOTS"):
                 self.next()
                 if kw == "JOBS":
                     return A.ShowJobsSentence()

@@ -26,6 +26,11 @@ indented span tree, and diff counters over time.
     # auto-repair plans from a metad (ISSUE 14)
     python -m nebula_tpu.tools.metrics_dump --addr <metad-ws> --repairs
 
+    # workload insights (ISSUE 16): fingerprint tables + partition heat
+    python -m nebula_tpu.tools.metrics_dump --addrs <graphd-ws>,... \
+        --statements [--watch 5]
+    python -m nebula_tpu.tools.metrics_dump --addr <metad-ws> --hotspots
+
     # Perfetto: every trace tree (+ stall captures) as Chrome
     # trace-event JSON, one track per daemon/service, device spans
     # included — open the file at https://ui.perfetto.dev
@@ -122,11 +127,15 @@ def dump_cluster(addrs: List[str], grep: str = "",
 
 
 def watch_cluster(addrs: List[str], interval: float, grep: str = "",
-                  iterations: int = 0, path: str = "/metrics") -> int:
+                  iterations: int = 0, path: str = "/metrics",
+                  scrape_fn=None) -> int:
     """Delta mode: print only samples whose MERGED value changed since
     the previous scrape (plus the first full baseline count).
-    iterations=0 runs until interrupted."""
-    _, prev = scrape_cluster(addrs, path)
+    iterations=0 runs until interrupted.  scrape_fn overrides the
+    default /metrics scrape (the --statements/--hotspots views plug in
+    here) — it must return scrape_cluster's (per_host, merged) shape."""
+    scrape = scrape_fn or (lambda: scrape_cluster(addrs, path))
+    _, prev = scrape()
     print(f"baseline: {len(prev)} samples from {len(addrs)} target(s)")
     i = 0
     while iterations <= 0 or i < iterations:
@@ -135,7 +144,7 @@ def watch_cluster(addrs: List[str], interval: float, grep: str = "",
             time.sleep(interval)
         except KeyboardInterrupt:
             break
-        _, cur = scrape_cluster(addrs, path)
+        _, cur = scrape()
         changed = [(k, prev.get(k, 0.0), v) for k, v in sorted(cur.items())
                    if v != prev.get(k, 0.0) and (not grep or grep in k)]
         stamp = time.strftime("%H:%M:%S")
@@ -146,6 +155,141 @@ def watch_cluster(addrs: List[str], interval: float, grep: str = "",
                   f"(+{_fmt_val(new - old)})")
         prev = cur
     return 0
+
+
+def _fetch_json(addr: str, path: str):
+    return json.loads(_fetch(addr, path))
+
+
+# -- workload insights views (ISSUE 16) -------------------------------------
+
+
+def _insights():
+    """utils.insights, importable BOTH ways this tool is launched:
+    `python -m nebula_tpu.tools.metrics_dump` (package-relative) and
+    `tools/metrics_dump.py` as a plain script (repo root on sys.path)."""
+    try:
+        from ..utils import insights
+    except ImportError:
+        import os
+        sys.path.insert(0, os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__)))))
+        from nebula_tpu.utils import insights
+    return insights
+
+
+def _print_statement_rows(rows: List[dict]):
+    statement_columns = _insights().statement_columns
+    for (fp, sample, calls, errs, p50, p95, nrows, dev, plan, chg,
+         reg) in statement_columns(rows):
+        flag = "  REGRESSED" if reg else ""
+        print(f"  {fp}  calls={calls:<7} errs={errs:<5} "
+              f"p50={p50:<9} p95={p95:<9} rows={nrows:<8} "
+              f"dev={dev:<5} plan={(plan or '-'):<12} chg={chg}{flag}  "
+              f"{str(sample)[:48]}")
+
+
+def dump_statements(addrs: List[str]) -> int:
+    """Statement fingerprint tables (GET /statements on each graphd):
+    per-host sections plus ONE exactly-merged view (shared fixed
+    latency buckets sum losslessly).  A metad serves the already-merged
+    cluster view at /cluster_statements (scrape with --path)."""
+    merge_statement_snapshots = _insights().merge_statement_snapshots
+    snaps = []
+    for addr in addrs:
+        try:
+            rows = _fetch_json(addr, "/statements")
+        except (OSError, ValueError) as ex:
+            print(f"scrape of {addr} failed: {ex}", file=sys.stderr)
+            continue
+        snaps.append(rows)
+        print(f"== {addr} ({len(rows)} fingerprints)")
+        _print_statement_rows(rows)
+    if len(snaps) > 1:
+        merged = merge_statement_snapshots(snaps)
+        print(f"== merged ({len(snaps)}/{len(addrs)} hosts)")
+        _print_statement_rows(merged)
+    return sum(len(s) for s in snaps)
+
+
+def _print_heat_rows(rows: List[dict]):
+    for r in rows:
+        where = ""
+        if r.get("leader"):
+            where = f"  leader={r['leader']}"
+        elif r.get("hosts"):
+            where = f"  hosts={','.join(r['hosts'])}"
+        print(f"  {r['space']}/{r['part']:<4} score={r['score']:<10} "
+              f"rqps={r['read_qps']:<8} wqps={r['write_qps']:<8} "
+              f"reads={r['reads']:<8} writes={r['writes']:<8} "
+              f"rlat={r['read_lat_us']}us wlat={r['write_lat_us']}us"
+              f"{where}")
+
+
+def dump_hotspots(addrs: List[str]) -> int:
+    """Per-partition heat rows (GET /hotspots): a storaged answers
+    with its local parts, a metad with the heartbeat-merged cluster
+    ranking (leader/replicas attached).  Multiple storaged addrs are
+    merged locally the same way metad merges heartbeats."""
+    merge_heat_snapshots = _insights().merge_heat_snapshots
+    per_host: Dict[str, List[dict]] = {}
+    for addr in addrs:
+        try:
+            rows = _fetch_json(addr, "/hotspots")
+        except (OSError, ValueError) as ex:
+            print(f"scrape of {addr} failed: {ex}", file=sys.stderr)
+            continue
+        per_host[addr] = rows
+        print(f"== {addr} ({len(rows)} parts)")
+        _print_heat_rows(rows)
+    if len(per_host) > 1:
+        merged = merge_heat_snapshots(per_host)
+        print(f"== merged ({len(per_host)}/{len(addrs)} hosts)")
+        _print_heat_rows(merged)
+    return sum(len(r) for r in per_host.values())
+
+
+def _statement_samples(rows: List[dict]) -> Dict[str, float]:
+    """Flatten fingerprint rows into the sample-dict shape the watch
+    loop diffs — counters only (monotone, so deltas read cleanly)."""
+    out: Dict[str, float] = {}
+    for r in rows:
+        fp = r.get("fingerprint", "?")
+        for k in ("calls", "errors", "kills", "sheds", "rows",
+                  "plan_changed", "plan_cache_hits",
+                  "result_cache_hits"):
+            out[f'statement_{k}{{fp="{fp}"}}'] = float(r.get(k, 0))
+    return out
+
+
+def _heat_samples(rows: List[dict]) -> Dict[str, float]:
+    out: Dict[str, float] = {}
+    for r in rows:
+        key = f'space="{r["space"]}",part="{r["part"]}"'
+        for k in ("reads", "writes", "read_rows", "write_rows",
+                  "read_bytes", "write_bytes"):
+            out[f"part_{k}{{{key}}}"] = float(r.get(k, 0))
+    return out
+
+
+def scrape_cluster_view(addrs: List[str], path: str, flatten
+                        ) -> Tuple[Dict[str, Dict[str, float]],
+                                   Dict[str, float]]:
+    """scrape_cluster's shape for a JSON view: per-host flattened
+    samples + the counter-summed merge — this is what lets --watch
+    reuse the ONE snapshot-diff loop for statements and hotspots."""
+    per_host: Dict[str, Dict[str, float]] = {}
+    merged: Dict[str, float] = {}
+    for addr in addrs:
+        try:
+            samples = flatten(_fetch_json(addr, path))
+        except (OSError, ValueError) as ex:
+            print(f"scrape of {addr} failed: {ex}", file=sys.stderr)
+            continue
+        per_host[addr] = samples
+        for k, v in samples.items():
+            merged[k] = merged.get(k, 0.0) + v
+    return per_host, merged
 
 
 def dump_trace_list(addr: str) -> int:
@@ -361,6 +505,16 @@ def main(argv=None) -> int:
     ap.add_argument("--repairs", action="store_true",
                     help="auto-repair plans from a metad "
                          "(GET /repairs): phase/status per plan")
+    ap.add_argument("--statements", action="store_true",
+                    help="statement fingerprint tables "
+                         "(GET /statements on graphds): per-host + "
+                         "exactly-merged; combine with --watch for "
+                         "call/error deltas")
+    ap.add_argument("--hotspots", action="store_true",
+                    help="per-partition heat rows (GET /hotspots on "
+                         "storageds, or a metad for the cluster-ranked "
+                         "view); combine with --watch for read/write "
+                         "deltas")
     ap.add_argument("--stall-id", default="",
                     help="print one stall capture in full (thread "
                          "stacks, dispatch table, kernel ledger)")
@@ -394,6 +548,23 @@ def main(argv=None) -> int:
     try:
         if args.perfetto:
             dump_perfetto(addrs, args.perfetto)
+        elif args.statements:
+            if args.watch > 0:
+                watch_cluster(addrs, args.watch, args.grep,
+                              args.iterations,
+                              scrape_fn=lambda: scrape_cluster_view(
+                                  addrs, "/statements",
+                                  _statement_samples))
+            else:
+                dump_statements(addrs)
+        elif args.hotspots:
+            if args.watch > 0:
+                watch_cluster(addrs, args.watch, args.grep,
+                              args.iterations,
+                              scrape_fn=lambda: scrape_cluster_view(
+                                  addrs, "/hotspots", _heat_samples))
+            else:
+                dump_hotspots(addrs)
         elif args.queries:
             dump_queries(one)
         elif args.repairs:

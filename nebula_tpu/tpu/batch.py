@@ -307,6 +307,11 @@ class BatchFormer:
                 mm.lane = i
                 if mm.live is not None:
                     mm.live.lane = i
+                    if len(lanes) > 1:
+                        # lane share for the insights registry (ISSUE
+                        # 16): how many statements this launch was
+                        # amortized across
+                        mm.live.batch_lanes = len(lanes)
         g.t_launch = time.monotonic()
         try:
             if len(lanes) > 1:

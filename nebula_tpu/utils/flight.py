@@ -110,7 +110,8 @@ class FlightRecorder:
                session: Optional[int], operators,
                work: Optional[Dict[str, Any]] = None,
                slow_us: int = 0,
-               force: Optional[str] = None) -> Optional[dict]:
+               force: Optional[str] = None,
+               fingerprint: Optional[str] = None) -> Optional[dict]:
         """Retain one completed statement if forced or sampled.
         Returns the stored entry (or None when dropped).  `operators`
         (and `work`) may be zero-arg callables — they are only invoked
@@ -139,6 +140,9 @@ class FlightRecorder:
             "trace_id": trace_id,
             "session": session,
             "operators": operators,
+            # statement fingerprint (ISSUE 16): joins this point-in-time
+            # capture against the aggregate SHOW STATEMENTS table
+            "fingerprint": fingerprint or "",
         }
         if work:
             entry["work"] = work
@@ -169,7 +173,8 @@ class FlightRecorder:
                  "kind": e["kind"], "status": e["status"],
                  "latency_us": e["latency_us"],
                  "operators": len(e["operators"]),
-                 "trace_id": e["trace_id"]}
+                 "trace_id": e["trace_id"],
+                 "fingerprint": e.get("fingerprint", "")}
                 for e in reversed(entries[-limit:])]
 
     def clear(self):

@@ -77,11 +77,13 @@ class LiveQuery:
                  "deadline", "node_kind", "node_id", "nodes_done",
                  "rows", "queue_us", "device_us", "dispatches",
                  "tracker", "killed", "queued", "consistency",
-                 "batch_id", "lane", "_lock")
+                 "batch_id", "lane", "batch_lanes", "fingerprint",
+                 "_lock")
 
     def __init__(self, qid: int, session: int, user: str, stmt: str,
                  kind: str, deadline: Optional[float] = None,
-                 tracker=None, consistency: str = "leader"):
+                 tracker=None, consistency: str = "leader",
+                 fingerprint: Optional[str] = None):
         self.qid = qid
         self.session = session
         self.user = user
@@ -109,6 +111,13 @@ class LiveQuery:
         # and this statement's lane — SHOW QUERIES renders "bid/lane"
         self.batch_id: Optional[int] = None
         self.lane: Optional[int] = None
+        # lanes the statement actually shared a launch with (ISSUE 16:
+        # the insights registry's batching-share column reads this at
+        # completion; stays 0 for solo dispatches)
+        self.batch_lanes: int = 0
+        # statement fingerprint (ISSUE 16): joins this in-flight row
+        # against the aggregate SHOW STATEMENTS table
+        self.fingerprint = fingerprint or ""
         self._lock = threading.Lock()
 
     # -- scheduler hooks (one per plan node) -----------------------------
@@ -157,6 +166,7 @@ class LiveQuery:
             "consistency": self.consistency,
             "batch": (f"{self.batch_id}/{self.lane}"
                       if self.batch_id is not None else ""),
+            "fingerprint": self.fingerprint,
         }
 
 
@@ -480,7 +490,8 @@ class StallWatchdog:
                 stmt=lq.stmt, kind=lq.kind,
                 latency_us=int(elapsed * 1e6), error=None,
                 trace_id=None, session=lq.session,
-                operators=[lq.snapshot()], force="stalled")
+                operators=[lq.snapshot()], force="stalled",
+                fingerprint=lq.fingerprint)
         except Exception:  # noqa: BLE001 — watchdog must never throw
             pass
 

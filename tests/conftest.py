@@ -26,3 +26,7 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "chaos: seeded fault-schedule tests over a live "
         "cluster (tests/chaos/; always also marked slow)")
+    config.addinivalue_line(
+        "markers", "lint: fast drift checks (catalogue lints, "
+        "fingerprint goldens) — tools/ci_lint.sh runs `-m lint` as a "
+        "pre-merge gate without the full suite")

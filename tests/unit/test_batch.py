@@ -382,7 +382,8 @@ def test_show_queries_batch_column(rt, clean, company):
     s2 = eng.new_session()
     rs = eng.execute(s2, "SHOW QUERIES")
     assert rs.ok
-    assert rs.data.column_names[-2:] == ["Batch", "GraphAddr"]
+    assert rs.data.column_names[-3:] == ["Batch", "Fingerprint",
+                                         "GraphAddr"]
     srow = next(r for r in rs.data.rows
                 if r[3] == GO_TMPL.format(seed=6))
     assert srow[13] == row[13]

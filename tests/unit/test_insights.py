@@ -1,0 +1,485 @@
+"""Workload insights plane (ISSUE 16): statement fingerprints (golden
+digests — a wire contract), the per-graphd StatementRegistry (triage,
+exact merge, concurrent aggregation vs sequential truth), the
+plan-history regression sentinel (forced plan flip), the fingerprint
+join across flight recorder / slow log / SHOW QUERIES, the
+insights_enabled off switch, and the cluster surfaces (SHOW STATEMENTS
+federation without double counting, SHOW HOTSPOTS from heartbeat-ridden
+partition heat)."""
+import threading
+import time
+
+import pytest
+
+from nebula_tpu.exec.engine import QueryEngine
+from nebula_tpu.query.parser import parse
+from nebula_tpu.utils.config import get_config
+from nebula_tpu.utils.failpoints import fail
+from nebula_tpu.utils.flight import flight_recorder
+from nebula_tpu.utils.insights import (PartHeatTable, StatementRegistry,
+                                       bucket_quantile, fingerprint_of,
+                                       merge_heat_snapshots,
+                                       merge_statement_snapshots,
+                                       normalize_statement,
+                                       statement_columns)
+from nebula_tpu.utils.stats import stats
+
+
+@pytest.fixture()
+def clean():
+    fail.reset()
+    yield
+    fail.reset()
+    for k in ("insights_enabled", "plan_regression_min_calls",
+              "plan_regression_ratio", "slow_query_threshold_us",
+              "insights_max_fingerprints"):
+        get_config().dynamic_layer.pop(k, None)
+
+
+def small_engine(n=30, deg=3, space="ins"):
+    eng = QueryEngine()
+    s = eng.new_session()
+    for q in (f"CREATE SPACE {space}(partition_num=2, vid_type=INT64)",
+              f"USE {space}", "CREATE TAG P(x int)",
+              "CREATE EDGE E(w int)"):
+        r = eng.execute(s, q)
+        assert r.error is None, f"{q} -> {r.error}"
+    vals = ", ".join(f"{v}:({v})" for v in range(n))
+    assert eng.execute(s, f"INSERT VERTEX P(x) VALUES {vals}").ok
+    edges = ", ".join(f"{v}->{(v * k + 1) % n}:({v + k})"
+                      for v in range(n) for k in range(1, deg + 1))
+    assert eng.execute(s, f"INSERT EDGE E(w) VALUES {edges}").ok
+    return eng, s
+
+
+def _wait_for(pred, timeout=5.0, msg="condition"):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        got = pred()
+        if got:
+            return got
+        time.sleep(0.01)
+    pytest.fail(f"timed out waiting for {msg}")
+
+
+# -- fingerprint goldens (lint marker: tools/ci_lint.sh runs these) ---------
+
+
+@pytest.mark.lint
+def test_fingerprint_literals_collapse():
+    """Same shape, different literals — and different literal COUNTS in
+    homogeneous lists — share one fingerprint."""
+    a = parse("GO 2 STEPS FROM 1 OVER E YIELD dst(edge) AS d")
+    b = parse("GO 2 STEPS FROM 7, 8, 9 OVER E YIELD dst(edge) AS d")
+    assert fingerprint_of(a, "g") == fingerprint_of(b, "g")
+    m1 = parse("MATCH (a:P)-[:E]->(b) WHERE a.P.x > 5 RETURN b")
+    m2 = parse("MATCH (a:P)-[:E]->(b) WHERE a.P.x > 99 RETURN b")
+    assert fingerprint_of(m1, "g") == fingerprint_of(m2, "g")
+    i1 = parse("INSERT VERTEX P(x) VALUES 1:(1), 2:(2)")
+    i2 = parse("INSERT VERTEX P(x) VALUES 9:(9)")
+    assert fingerprint_of(i1, "g") == fingerprint_of(i2, "g")
+
+
+@pytest.mark.lint
+def test_fingerprint_structure_distinguishes():
+    """Structure is preserved: step counts, yields, tags, kinds, and
+    the session space all key distinct fingerprints."""
+    base = parse("GO 2 STEPS FROM 1 OVER E YIELD dst(edge) AS d")
+    assert fingerprint_of(base, "g") != fingerprint_of(
+        parse("GO 3 STEPS FROM 1 OVER E YIELD dst(edge) AS d"), "g")
+    assert fingerprint_of(base, "g") != fingerprint_of(
+        parse("GO 2 STEPS FROM 1 OVER E YIELD src(edge) AS d"), "g")
+    assert fingerprint_of(base, "g") != fingerprint_of(base, "h")
+    assert fingerprint_of(
+        parse("MATCH (a:P) RETURN a"), "g") != fingerprint_of(
+        parse("MATCH (a:Q) RETURN a"), "g")
+
+
+@pytest.mark.lint
+def test_fingerprint_golden_digests():
+    """The digest is a WIRE CONTRACT: dashboards and the federation
+    merge key on it, so a normalizer change that silently re-keys
+    every fingerprint must fail here, not in production.  If a change
+    is intentional, update these goldens in the same PR and say so."""
+    cases = {
+        "GO 2 STEPS FROM 1 OVER E YIELD dst(edge) AS d": "bae38f2d4c1d",
+        "MATCH (a:P)-[:E]->(b) WHERE a.P.x > 5 RETURN b": "c737f903645c",
+        "INSERT VERTEX P(x) VALUES 1:(1), 2:(2)": "cbc3fbfef00d",
+    }
+    for text, want in cases.items():
+        got = fingerprint_of(parse(text), "g")
+        assert got == want, (
+            f"fingerprint of {text!r} drifted: {got} != golden {want}\n"
+            f"preimage: {normalize_statement(parse(text), 'g')}")
+
+
+@pytest.mark.lint
+def test_fingerprint_stable_across_parses():
+    """Two independent parses of the same text normalize identically —
+    no id()/ordering leakage into the preimage."""
+    text = "GO 2 STEPS FROM 3 OVER E WHERE E.w > 1 YIELD dst(edge) AS d"
+    assert normalize_statement(parse(text), "g") == \
+        normalize_statement(parse(text), "g")
+    assert fingerprint_of(parse(text), "g") == \
+        fingerprint_of(parse(text), "g")
+
+
+# -- registry: triage, columns, exact merge ---------------------------------
+
+
+def test_registry_triage_and_columns(clean):
+    reg = StatementRegistry()
+    fp = "aaaaaaaaaaaa"
+    common = dict(fp=fp, text="GO ...", kind="Go", space="g")
+    reg.record(latency_us=90, **common)
+    reg.record(latency_us=90, error="SemanticError: boom", **common)
+    reg.record(latency_us=90,
+               error="ExecutionError: query was killed", **common)
+    reg.record(latency_us=40_000, error="E_OVERLOAD: retry_after_ms=5 "
+               "site=graphd full", **common)
+    row = reg.get(fp)
+    assert row["calls"] == 4
+    assert (row["errors"], row["kills"], row["sheds"]) == (1, 1, 1)
+    cols = statement_columns([row])[0]
+    # [fp, sample, calls, errors, p50, p95, rows, share, plan, chg, reg]
+    assert cols[0] == fp and cols[2] == 4
+    assert cols[3] == 3, "Errors column is the triage total"
+    assert cols[4] == 100 and cols[5] == 50_000  # bucket upper bounds
+
+
+def test_registry_eviction_bounded(clean):
+    get_config().set_dynamic("insights_max_fingerprints", 4)
+    reg = StatementRegistry()
+    for i in range(10):
+        reg.record(fp=f"fp{i:010d}", text=f"q{i}", kind="Go", space="g",
+                   latency_us=100)
+    assert len(reg) == 4
+    assert reg.get("fp0000000009") is not None   # newest survives
+    assert reg.get("fp0000000000") is None       # oldest evicted
+
+
+def test_merge_statement_snapshots_exact(clean):
+    """Cross-host merge is an exact fold: counters and bucket counts
+    sum, quantiles of the merged buckets equal quantiles of the union,
+    regressed ORs."""
+    a, b = StatementRegistry(), StatementRegistry()
+    fp = "feedfacef00d"
+    for us in (100, 400, 900):
+        a.record(fp=fp, text="GO ...", kind="Go", space="g",
+                 latency_us=us, rows=2)
+    for us in (4000, 9000, 40_000):
+        b.record(fp=fp, text="GO ...", kind="Go", space="g",
+                 latency_us=us, rows=3, error="x")
+    merged = merge_statement_snapshots([a.snapshot(), b.snapshot()])
+    assert len(merged) == 1
+    m = merged[0]
+    assert m["calls"] == 6 and m["rows"] == 15 and m["errors"] == 3
+    union = [0] * len(m["lat_buckets"])
+    for snap in (a.snapshot(), b.snapshot()):
+        for i, c in enumerate(snap[0]["lat_buckets"]):
+            union[i] += c
+    assert m["lat_buckets"] == union
+    assert bucket_quantile(m["lat_buckets"], 0.5) == 1000
+
+
+def test_concurrent_aggregation_matches_sequential_truth(clean):
+    """N threads hammering one statement shape aggregate to exactly
+    the sequential truth — same calls, same rows, same bucket total
+    (the acceptance bar: correct under concurrent mixed load)."""
+    eng, s = small_engine(n=40, deg=4)
+    seeds = list(range(12))
+
+    def stmt(v):
+        return f"GO 2 STEPS FROM {v} OVER E YIELD dst(edge) AS d"
+
+    # sequential truth
+    eng.insights.clear()
+    rows_expected = 0
+    for v in seeds:
+        rs = eng.execute(s, stmt(v))
+        assert rs.error is None, rs.error
+        rows_expected += len(rs.data.rows)
+    fp = eng.insights.fingerprints.get(stmt(seeds[0]), "ins")
+    assert fp, "fingerprint memo must be warm after execution"
+    seq = eng.insights.get(fp)
+    assert seq["calls"] == len(seeds)
+
+    # concurrent re-run, one session per thread, mixed with MATCHes
+    eng.insights.clear()
+    errs = []
+
+    def run(vs):
+        try:
+            sess = eng.new_session()
+            assert eng.execute(sess, "USE ins").ok
+            for v in vs:
+                r = eng.execute(sess, stmt(v))
+                if r.error is not None:
+                    errs.append(r.error)
+                r = eng.execute(
+                    sess, f"MATCH (a:P) WHERE a.P.x == {v} RETURN a")
+                if r.error is not None:
+                    errs.append(r.error)
+        except Exception as ex:  # noqa: BLE001
+            errs.append(repr(ex))
+
+    chunks = [seeds[i::4] for i in range(4)]
+    ths = [threading.Thread(target=run, args=(c,)) for c in chunks]
+    for t in ths:
+        t.start()
+    for t in ths:
+        t.join(30)
+    assert not errs, errs[:3]
+    conc = eng.insights.get(fp)
+    assert conc["calls"] == seq["calls"] == len(seeds)
+    assert conc["rows"] == seq["rows"] == rows_expected
+    assert sum(conc["lat_buckets"]) == len(seeds)
+    # the MATCH shape aggregated separately (no cross-shape bleed)
+    mfp = eng.insights.fingerprints.get(
+        "MATCH (a:P) WHERE a.P.x == 0 RETURN a", "ins")
+    assert mfp and mfp != fp
+    assert eng.insights.get(mfp)["calls"] == len(seeds)
+
+
+# -- plan history + regression sentinel -------------------------------------
+
+
+def test_regression_sentinel_synthetic(clean):
+    """Registry-level: a plan flip whose new p50 degrades past the
+    ratio flags the row and fires plan_regressed once; a flip to a
+    FASTER plan never flags."""
+    get_config().set_dynamic("plan_regression_min_calls", 4)
+    reg = StatementRegistry()
+    fp = "deadbeef0000"
+
+    def rec(plan, us, n):
+        for _ in range(n):
+            reg.record(fp=fp, text="GO ...", kind="Go", space="g",
+                       latency_us=us, plan_hash=plan)
+
+    before = sum(stats().labeled.get("plan_regressed", {}).values())
+    rec("planA", 400, 6)             # old plan: p50 bucket 500
+    rec("planB", 40_000, 6)          # new plan: p50 bucket 50000
+    row = reg.get(fp)
+    assert row["plan_changed"] == 1
+    assert row["prev_plan"] == "planA" and row["active_plan"] == "planB"
+    assert row["regressed"] is True
+    after = sum(stats().labeled.get("plan_regressed", {}).values())
+    assert after == before + 1, "sentinel fires once per transition"
+
+    # a faster new plan is a win, not a regression
+    reg2 = StatementRegistry()
+    for plan, us in (("planA", 40_000), ("planB", 400)):
+        for _ in range(6):
+            reg2.record(fp=fp, text="GO ...", kind="Go", space="g",
+                        latency_us=us, plan_hash=plan)
+    assert reg2.get(fp)["regressed"] is False
+
+
+def test_regression_sentinel_on_forced_engine_plan_flip(clean):
+    """The acceptance shape: force a real plan flip (optimizer toggle +
+    plan-cache clear) and slow the new plan down — the registry keeps
+    both plans side by side and flags the regression."""
+    get_config().set_dynamic("plan_regression_min_calls", 3)
+    eng, s = small_engine()
+    # the WHERE matters: filter pushdown is what the optimizer changes
+    # about this shape, so toggling it off really flips the kind tree
+    q = "GO 2 STEPS FROM 1 OVER E WHERE E.w > 0 YIELD dst(edge) AS d"
+    for _ in range(3):
+        assert eng.execute(s, q).error is None
+    fp = eng.insights.fingerprints.get(q, "ins")
+    row = eng.insights.get(fp)
+    old_plan = row["active_plan"]
+    assert old_plan and row["plan_changed"] == 0
+
+    eng.enable_optimizer = False
+    eng.plan_cache.clear()
+    fail.arm_callable(
+        "exec:node",
+        lambda i, key: ("delay", 0.05) if key == "ExpandAll" else None)
+    try:
+        for _ in range(3):
+            assert eng.execute(s, q).error is None
+    finally:
+        fail.reset()
+    row = eng.insights.get(fp)
+    assert row["plan_changed"] == 1
+    assert row["prev_plan"] == old_plan
+    assert row["active_plan"] != old_plan
+    assert set(row["plans"]) == {old_plan, row["active_plan"]}
+    assert row["regressed"] is True
+
+
+# -- the fingerprint join: SHOW QUERIES / flight / slow log -----------------
+
+
+def test_kill_query_fingerprint_joins_flight_and_registry(clean):
+    """Kill an in-flight query and follow ONE fingerprint from its
+    live SHOW QUERIES row to the flight-recorder post-mortem to the
+    registry's kill triage."""
+    eng, s = small_engine()
+    fail.arm_callable(
+        "exec:node",
+        lambda i, key: ("delay", 0.1) if key == "ExpandAll" else None)
+    box = {}
+    q = "GO 3 STEPS FROM 2 OVER E YIELD dst(edge) AS d"
+    t = threading.Thread(
+        target=lambda: box.update(rs=eng.execute(s, q)), daemon=True)
+    t.start()
+    row = _wait_for(
+        lambda: next((r for r in eng.list_running_queries()
+                      if r[3].startswith("GO 3 STEPS")), None),
+        msg="victim in SHOW QUERIES")
+    # row: [..., consistency, batch, fingerprint]
+    live_fp = row[14]
+    assert live_fp, "live row must carry the fingerprint"
+    s2 = eng.new_session()
+    rs = eng.execute(s2, f"KILL QUERY (session={s.id}, plan={row[1]})")
+    assert rs.error is None, rs.error
+    t.join(10)
+    fail.reset()
+    assert box["rs"].error == "ExecutionError: query was killed"
+    ent = next(e for e in flight_recorder().list(limit=20)
+               if e["stmt"].startswith("GO 3 STEPS"))
+    assert ent["status"] == "killed"
+    assert ent["fingerprint"] == live_fp
+    reg_row = eng.insights.get(live_fp)
+    assert reg_row is not None and reg_row["kills"] >= 1
+    assert live_fp == eng.insights.fingerprints.get(q, "ins")
+
+
+def test_slow_log_carries_fingerprint(clean):
+    eng, s = small_engine()
+    get_config().set_dynamic("slow_query_threshold_us", 1)
+    q = "GO 2 STEPS FROM 5 OVER E YIELD dst(edge) AS d"
+    assert eng.execute(s, q).error is None
+    ent = next(e for e in eng.slow_log if e["stmt"] == q)
+    assert ent["fingerprint"] == eng.insights.fingerprints.get(q, "ins")
+
+
+def test_insights_disabled_reproduces_pre_plane_behavior(clean):
+    """insights_enabled=false: statements run identically but nothing
+    is fingerprinted and nothing is recorded."""
+    eng, s = small_engine()
+    eng.insights.clear()
+    get_config().set_dynamic("insights_enabled", False)
+    q = "GO 2 STEPS FROM 1 OVER E YIELD dst(edge) AS d"
+    rs = eng.execute(s, q)
+    assert rs.error is None and len(rs.data.rows) > 0
+    assert len(eng.insights) == 0
+    assert eng.insights.fingerprints.get(q, "ins") is None
+    rs = eng.execute(s, "SHOW STATEMENTS")
+    assert rs.error is None and len(rs.data.rows) == 0
+    get_config().dynamic_layer.pop("insights_enabled", None)
+    assert eng.execute(s, q).error is None
+    assert eng.insights.get(
+        eng.insights.fingerprints.get(q, "ins"))["calls"] == 1
+
+
+# -- partition heat ---------------------------------------------------------
+
+
+def test_part_heat_table_scores_and_merge(clean):
+    heat = PartHeatTable()
+    for _ in range(10):
+        heat.record_read("g", 0, rows=5, latency_us=100.0)
+    for _ in range(3):
+        heat.record_write("g", 1, rows=2, latency_us=500.0)
+    snap = heat.snapshot()
+    by_part = {r["part"]: r for r in snap}
+    assert by_part[0]["reads"] == 10 and by_part[0]["read_rows"] == 50
+    assert by_part[1]["writes"] == 3 and by_part[1]["write_rows"] == 6
+    assert by_part[0]["read_qps"] > 0
+    assert heat.heat_of("g", 0) > 0.0
+    assert heat.heat_of("g", 99) == 0.0       # unknown part = cold
+    # writes are double-weighted in the score
+    w = PartHeatTable()
+    r = PartHeatTable()
+    for _ in range(10):
+        w.record_write("g", 0)
+        r.record_read("g", 0)
+    w.snapshot(), r.snapshot()
+    assert w.heat_of("g", 0) > r.heat_of("g", 0)
+    merged = merge_heat_snapshots({"h1": snap, "h2": snap})
+    m0 = next(m for m in merged if m["part"] == 0)
+    assert m0["reads"] == 20 and m0["hosts"] == ["h1", "h2"]
+
+
+# -- cluster surfaces -------------------------------------------------------
+
+
+def test_cluster_statements_and_hotspots(clean, tmp_path):
+    """Two graphds, one storaged: SHOW STATEMENTS merges both
+    registries exactly (calls sum, no double counting), SHOW LOCAL
+    STATEMENTS answers per graphd, and SHOW HOTSPOTS ranks the parts
+    whose heat rode the storaged heartbeat."""
+    from nebula_tpu.cluster.client import GraphClient
+    from nebula_tpu.cluster.launcher import LocalCluster
+
+    c = LocalCluster(n_meta=1, n_storage=1, n_graph=2,
+                     data_dir=str(tmp_path))
+    try:
+        cl1 = c.client()
+        assert cl1.execute("CREATE SPACE cw(partition_num=2, "
+                           "vid_type=INT64)").error is None
+        c.reconcile_storage()
+        for q in ("USE cw", "CREATE TAG P(x int)",
+                  "CREATE EDGE E(w int)"):
+            assert cl1.execute(q).error is None, q
+        verts = ", ".join(f"{v}:({v})" for v in range(20))
+        assert cl1.execute(
+            f"INSERT VERTEX P(x) VALUES {verts}").error is None
+        edges = ", ".join(f"{v}->{(v + 1) % 20}:({v})"
+                          for v in range(20))
+        assert cl1.execute(
+            f"INSERT EDGE E(w) VALUES {edges}").error is None
+
+        host2, port2 = c.graph_servers[1].addr.rsplit(":", 1)
+        cl2 = GraphClient(host2, int(port2))
+        cl2.authenticate("root", "nebula")
+        assert cl2.execute("USE cw").error is None
+
+        def stmt(v):
+            return f"GO 2 STEPS FROM {v} OVER E YIELD dst(edge) AS d"
+
+        for v in range(4):
+            assert cl1.execute(stmt(v)).error is None
+        for v in range(3):
+            assert cl2.execute(stmt(v)).error is None
+        fp = fingerprint_of(parse(stmt(0)), "cw")
+
+        rs = cl1.execute("SHOW STATEMENTS")
+        assert rs.error is None, rs.error
+        assert rs.data.column_names == [
+            "Fingerprint", "Sample", "Calls", "Errors", "P50 Us",
+            "P95 Us", "Rows", "DeviceShare", "PlanHash", "PlanChanged",
+            "Regressed"]
+        row = next(r for r in rs.data.rows if r[0] == fp)
+        assert row[2] == 7, "cluster view must sum, never double count"
+
+        rs = cl2.execute("SHOW LOCAL STATEMENTS")
+        assert rs.error is None, rs.error
+        row = next(r for r in rs.data.rows if r[0] == fp)
+        assert row[2] == 3
+
+        # heat rides the 0.2s heartbeat; counters are cumulative, so
+        # wait for a beat that POSTDATES the reads above (the first
+        # rows metad serves may still be from an inserts-era snapshot)
+        def hotspots():
+            rs = cl1.execute("SHOW HOTSPOTS")
+            assert rs.error is None, rs.error
+            rows = rs.data.rows
+            if rows and sum(r[5] for r in rows) > 0:
+                return rows
+            return None
+
+        rows = _wait_for(hotspots, timeout=5.0,
+                         msg="read heat to ride a heartbeat to metad")
+        assert all(r[0] == "cw" for r in rows)
+        assert {r[1] for r in rows} <= {0, 1}
+        assert sum(r[6] for r in rows) > 0, "writes recorded"
+        for r in rows:
+            assert r[11], "leader annotated from the part map"
+            assert r[12], "replica set annotated"
+    finally:
+        c.stop()

@@ -420,6 +420,7 @@ def _catalogued_metric_names():
                           re.MULTILINE))
 
 
+@pytest.mark.lint
 def test_metric_catalogue_lint():
     """Every metric the registries emit is documented, and every
     documented metric is emitted — the catalogue cannot drift."""
@@ -462,6 +463,7 @@ def _catalogued_span_names():
                           re.MULTILINE))
 
 
+@pytest.mark.lint
 def test_span_catalogue_lint():
     """Every span/phase name the source emits is documented and every
     documented span name is emitted — so a renamed span cannot

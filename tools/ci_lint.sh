@@ -1,0 +1,15 @@
+#!/bin/sh
+# ci_lint.sh — the fast pre-merge drift gate (ISSUE 16 satellite).
+#
+# Runs ONLY the tests marked `lint`: the metric/span catalogue lints
+# (docs/OBSERVABILITY.md must bidirectionally match what the code
+# emits) and the statement-fingerprint goldens (the digest is a wire
+# contract — SHOW STATEMENTS federation and dashboards key on it).
+# Seconds, not minutes: suitable as a commit hook or the first CI
+# stage before the tier-1 suite.
+#
+#   tools/ci_lint.sh [extra pytest args...]
+set -e
+cd "$(dirname "$0")/.."
+exec env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+    python -m pytest tests/ -q -m lint -p no:cacheprovider "$@"
