@@ -161,35 +161,33 @@ def _ensure_live_backend():
     subprocess with a deadline; on hang/failure re-exec ourselves on the
     virtual-CPU platform so the driver always gets its JSON line —
     with the fallback recorded — instead of a hung round."""
-    import subprocess
     if os.environ.get("_NEBULA_BENCH_CHILD") == "1":
         return
-    probe = ("import jax; d = jax.devices(); "
-             "print('PLATFORM=' + d[0].platform)")
-    try:
-        out = subprocess.run(
-            [sys.executable, "-c", probe], capture_output=True, text=True,
-            timeout=int(os.environ.get("NEBULA_BENCH_PROBE_TIMEOUT", 150)))
-        _PROBE_RECORD.update(rc=out.returncode,
-                             stdout=out.stdout.strip()[-400:],
-                             stderr=out.stderr.strip()[-400:])
-        if out.returncode == 0 and "PLATFORM=" in out.stdout:
-            _mark(f"backend probe ok: "
-                  f"{out.stdout.strip().split('PLATFORM=')[-1]}")
+    # ISSUE 17: the probe implementation moved to
+    # nebula_tpu.tools.probe_device (ONE bounded subprocess probe,
+    # shared with tools_probe_tpu.sh and the multichip block); its
+    # structured verdict lands verbatim in BENCH_DETAIL.json
+    from nebula_tpu.tools.probe_device import probe as _device_probe
+    verdict = _device_probe()
+    _PROBE_RECORD.update(verdict)
+    status = verdict["probe_status"]
+    if status == "ok":
+        _mark(f"backend probe ok: {verdict['platform']} "
+              f"x{verdict['n_devices']}")
+        return
+    if status == "no_devices":
+        # the child ran fine but only found host CPU — with no tunnel
+        # configured this IS the expected platform; continue on it
+        # (the run's platform field records cpu, not a fallback)
+        if not os.environ.get("PALLAS_AXON_POOL_IPS"):
+            _mark("backend probe: cpu only (no tunnel configured)")
             return
-        _mark(f"backend probe failed rc={out.returncode}: "
-              f"{out.stderr.strip()[-200:]}")
-    except subprocess.TimeoutExpired as ex:
-        def _txt(v):
-            if isinstance(v, bytes):
-                v = v.decode(errors="replace")
-            return (v or "").strip()[-400:]
-        _PROBE_RECORD.update(rc=-1, timed_out=True,
-                             stdout=_txt(ex.stdout),
-                             stderr=_txt(ex.stderr)
-                             or "probe exceeded deadline "
-                                "(wedged device tunnel)")
+        _mark("backend probe: tunnel configured but resolves to cpu")
+    elif status == "timeout":
         _mark("backend probe TIMED OUT (wedged device tunnel?)")
+    else:
+        _mark(f"backend probe failed rc={verdict['rc']}: "
+              f"{verdict['detail'][-200:]}")
     _reexec_cpu_fallback("device backend unreachable")
 
 
@@ -1204,6 +1202,30 @@ def main():
         self_heal = {"error": repr(ex)}
     _save_partial(platform, configs)
 
+    # ---- multichip block (ISSUE 17): mesh-native sharded execution
+    # A/B — structured probe verdict (probe_status: ok/no_devices/
+    # timeout), HBM scale-out proof (graph 4x the per-device budget:
+    # single-chip pin refuses, 8-shard pin accepts, per-shard gauges
+    # sum to the pinned total), GO-3-step rows byte-identical 1-shard
+    # vs N-shard vs numpy oracle, goodput + all_to_all bytes/hop.
+    # Both arms run in bounded-deadline subprocesses (wedge-contained);
+    # the virtual 8-device arm always lands, the real-device arm runs
+    # when the probe lands ok.
+    _mark("config multichip: 1-vs-8-shard mesh execution A/B")
+    try:
+        from nebula_tpu.tools.multichip_bench import (
+            multichip_sweep as _mc_sweep)
+        multichip = _mc_sweep(
+            persons=int(os.environ.get("NEBULA_BENCH_MULTICHIP_PERSONS",
+                                       120_000)),
+            repeats=int(os.environ.get("NEBULA_BENCH_MULTICHIP_REPEATS",
+                                       5)),
+            timeout_s=float(os.environ.get(
+                "NEBULA_BENCH_MULTICHIP_TIMEOUT", 600)))
+    except Exception as ex:  # noqa: BLE001 — must not sink the run
+        multichip = {"error": repr(ex)}
+    _save_partial(platform, configs)
+
     # ---- algo block (ISSUE 13): device vs numpy-host oracle A/B per
     # CALL algo.* algorithm (pagerank / wcc / sssp) on a north-star-
     # shaped social array graph, with per-iteration device timing.
@@ -1394,6 +1416,7 @@ def main():
         "read_scaleout": read_scaleout,
         "self_heal": self_heal,
         "algo": algo_block,
+        "multichip": multichip,
         "configs": configs,
     }
     if tpu_partial is not None:
@@ -1432,6 +1455,13 @@ def main():
         # per statement with batching on (detail has the full A/B:
         # queue_wait_share off/on, goodput curve, lanes per batch)
         hl["batch_disp_per_stmt"] = batching["dispatches_per_stmt_on"]
+    if isinstance(multichip, dict) and \
+            multichip.get("speedup_Nshard_vs_1") is not None:
+        # ISSUE 17: mesh-native sharded execution — N-shard vs 1-shard
+        # goodput on the virtual mesh (detail has the HBM scale-out
+        # proof, parity verdicts, exchange bytes/hop and probe_status)
+        hl["multichip_x"] = multichip["speedup_Nshard_vs_1"]
+        hl["probe_status"] = multichip.get("probe_status")
     if isinstance(self_heal, dict) and self_heal.get("healed"):
         # ISSUE 14: kill-one-of-three auto-repair — seconds from the
         # kill to full redundancy with zero acked-write loss (detail

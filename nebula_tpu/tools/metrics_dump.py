@@ -31,6 +31,11 @@ indented span tree, and diff counters over time.
         --statements [--watch 5]
     python -m nebula_tpu.tools.metrics_dump --addr <metad-ws> --hotspots
 
+    # sharded mesh execution (ISSUE 17): per-device HBM residency +
+    # frontier-exchange bytes, per host and cluster-merged
+    python -m nebula_tpu.tools.metrics_dump --addrs <graphd-ws>,... \
+        --shards [--watch 5]
+
     # Perfetto: every trace tree (+ stall captures) as Chrome
     # trace-event JSON, one track per daemon/service, device spans
     # included — open the file at https://ui.perfetto.dev
@@ -44,6 +49,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import re
 import sys
 import time
 import urllib.request
@@ -292,6 +298,67 @@ def scrape_cluster_view(addrs: List[str], path: str, flatten
     return per_host, merged
 
 
+# -- sharded-execution view (ISSUE 17) --------------------------------------
+
+_SHARD_HBM_PAT = re.compile(r'^tpu_shard_hbm_bytes\{shard="?(\d+)"?\}$')
+_SHARD_KEYS = ("tpu_shards", "tpu_hbm_bytes_pinned",
+               "tpu_all_to_all_bytes")
+
+
+def _is_shard_sample(name: str) -> bool:
+    return name in _SHARD_KEYS or bool(_SHARD_HBM_PAT.match(name))
+
+
+def _shard_filter(samples: Dict[str, float]) -> Dict[str, float]:
+    return {k: v for k, v in samples.items() if _is_shard_sample(k)}
+
+
+def _print_shard_rows(samples: Dict[str, float]):
+    per_shard = {int(m.group(1)): v for k, v in samples.items()
+                 for m in [_SHARD_HBM_PAT.match(k)] if m}
+    width = samples.get("tpu_shards")
+    pinned = samples.get("tpu_hbm_bytes_pinned", 0.0)
+    a2a = samples.get("tpu_all_to_all_bytes", 0.0)
+    print(f"  mesh width: {int(width) if width else '?'} shard(s)")
+    for pn in sorted(per_shard):
+        share = per_shard[pn] / pinned if pinned else 0.0
+        print(f"  shard {pn:<3} hbm={int(per_shard[pn]):<12} "
+              f"({share:.1%} of pinned)")
+    ledger = sum(per_shard.values())
+    ok = "OK" if ledger == pinned else "MISMATCH"
+    print(f"  ledger sum={int(ledger)} vs tpu_hbm_bytes_pinned="
+          f"{int(pinned)} -> {ok}")
+    print(f"  all_to_all exchanged: {int(a2a)} bytes")
+
+
+def dump_shards(addrs: List[str], path: str = "/metrics") -> int:
+    """Sharded-mesh residency view (ISSUE 17): each host's per-device
+    HBM ledger (`tpu_shard_hbm_bytes{shard}`), its sum checked against
+    `tpu_hbm_bytes_pinned`, the mesh width and the cumulative frontier
+    all_to_all bytes — plus one cluster-merged section.  Combine with
+    --watch for exchange-byte deltas per interval."""
+    per_host, merged = scrape_cluster(addrs, path)
+    n = 0
+    for addr in sorted(per_host):
+        samples = _shard_filter(per_host[addr])
+        print(f"== {addr} ({len(samples)} shard samples)")
+        if samples:
+            _print_shard_rows(samples)
+            n += len(samples)
+    if len(per_host) > 1:
+        print(f"== merged ({len(per_host)}/{len(addrs)} hosts)")
+        _print_shard_rows(_shard_filter(merged))
+    return n
+
+
+def _scrape_shard_view(addrs: List[str], path: str = "/metrics"
+                       ) -> Tuple[Dict[str, Dict[str, float]],
+                                  Dict[str, float]]:
+    per_host, merged = scrape_cluster(addrs, path)
+    return ({a: _shard_filter(s) for a, s in per_host.items()},
+            _shard_filter(merged))
+
+
 def dump_trace_list(addr: str) -> int:
     traces = json.loads(_fetch(addr, "/traces"))
     for t in traces:
@@ -515,6 +582,11 @@ def main(argv=None) -> int:
                          "storageds, or a metad for the cluster-ranked "
                          "view); combine with --watch for read/write "
                          "deltas")
+    ap.add_argument("--shards", action="store_true",
+                    help="sharded mesh execution view (ISSUE 17): "
+                         "per-device HBM ledger + frontier-exchange "
+                         "bytes per host and merged; combine with "
+                         "--watch for exchange deltas")
     ap.add_argument("--stall-id", default="",
                     help="print one stall capture in full (thread "
                          "stacks, dispatch table, kernel ledger)")
@@ -557,6 +629,14 @@ def main(argv=None) -> int:
                                   _statement_samples))
             else:
                 dump_statements(addrs)
+        elif args.shards:
+            if args.watch > 0:
+                watch_cluster(addrs, args.watch, args.grep,
+                              args.iterations,
+                              scrape_fn=lambda: _scrape_shard_view(
+                                  addrs, args.path))
+            else:
+                dump_shards(addrs, args.path)
         elif args.hotspots:
             if args.watch > 0:
                 watch_cluster(addrs, args.watch, args.grep,

@@ -1,0 +1,128 @@
+"""Bounded-timeout device probe with a STRUCTURED verdict (ISSUE 17).
+
+The axon TPU tunnel can wedge: a hard-killed client leaves its chip
+claim held and the next `jax.devices()` blocks forever inside backend
+registration.  Every prior probe call-site (bench.py's startup guard,
+tools_probe_tpu.sh's watch loop) re-implemented the same subprocess +
+timeout + stdout-grep dance and each graded the outcome differently —
+the watch loop once looped forever because its grep could never match
+the tunnel's platform string.
+
+This module is the ONE probe implementation.  It runs `jax.devices()`
+in a THROWAWAY subprocess (the parent never imports jax, so a wedged
+tunnel can hang only the child) under a hard deadline and returns a
+machine-readable verdict:
+
+    {"probe_status": "ok" | "timeout" | "no_devices" | "error",
+     "platform":  "tpu" | "cpu" | ... | None,
+     "n_devices": int,
+     "rc":        child exit code (-1 on timeout),
+     "detail":    last stderr/stdout fragment for the log line}
+
+`probe_status` semantics — the bench `multichip` block embeds this
+verdict verbatim, so a missing real-device A/B is always attributable:
+
+  ok          a non-cpu accelerator platform answered within deadline
+  no_devices  the child ran fine but only found host CPU devices
+              (no tunnel configured, or tunnel resolves to cpu)
+  timeout     the child exceeded the deadline — wedged tunnel
+  error       the child exited non-zero (import error, claim refused)
+
+CLI: ``python -m nebula_tpu.tools.probe_device [--timeout S] [--expect
+tpu]`` prints the verdict as one JSON line and exits 0 on "ok",
+2 on "no_devices", 3 on "timeout", 4 on "error" — script-friendly
+(tools_probe_tpu.sh branches on the exit code, not on grep).
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+from typing import Optional
+
+# one parsable line; the sentinel prefix survives jax/absl WARNING noise
+_SENTINEL = "NEBULA_PROBE:"
+_CHILD = ("import jax, json; d = jax.devices(); "
+          "print('" + _SENTINEL + "' + json.dumps("
+          "{'platform': d[0].platform, 'n': len(d)}))")
+
+DEFAULT_TIMEOUT_S = 150
+
+
+def probe(timeout_s: Optional[float] = None,
+          python: Optional[str] = None) -> dict:
+    """Run the subprocess probe; never raises, never hangs past the
+    deadline.  `timeout_s` defaults to $NEBULA_BENCH_PROBE_TIMEOUT or
+    150 s (the bench startup guard's historical deadline)."""
+    if timeout_s is None:
+        timeout_s = float(os.environ.get("NEBULA_BENCH_PROBE_TIMEOUT",
+                                         DEFAULT_TIMEOUT_S))
+    res = {"probe_status": "error", "platform": None, "n_devices": 0,
+           "rc": -1, "detail": "", "timeout_s": timeout_s}
+
+    def _txt(v) -> str:
+        if isinstance(v, bytes):
+            v = v.decode(errors="replace")
+        return (v or "").strip()[-400:]
+
+    try:
+        out = subprocess.run(
+            [python or sys.executable, "-c", _CHILD],
+            capture_output=True, text=True, timeout=timeout_s)
+    except subprocess.TimeoutExpired as ex:
+        res.update(probe_status="timeout",
+                   detail=_txt(ex.stderr)
+                   or "probe exceeded deadline (wedged device tunnel)")
+        return res
+    except OSError as ex:  # interpreter itself unrunnable
+        res.update(probe_status="error", detail=repr(ex)[-400:])
+        return res
+
+    res["rc"] = out.returncode
+    if out.returncode != 0:
+        res.update(probe_status="error", detail=_txt(out.stderr))
+        return res
+    payload = None
+    for line in out.stdout.splitlines():
+        if line.startswith(_SENTINEL):
+            try:
+                payload = json.loads(line[len(_SENTINEL):])
+            except ValueError:
+                pass
+    if payload is None:
+        res.update(probe_status="error",
+                   detail="no probe sentinel in child stdout: "
+                          + _txt(out.stdout))
+        return res
+    res["platform"] = str(payload.get("platform"))
+    res["n_devices"] = int(payload.get("n", 0))
+    # any non-cpu platform counts as a live accelerator (the axon
+    # tunnel reports "axon", real chips report "tpu" — the r4 probe
+    # regression was grepping for one exact string)
+    if res["platform"] and res["platform"] != "cpu":
+        res["probe_status"] = "ok"
+    else:
+        res["probe_status"] = "no_devices"
+    res["detail"] = _txt(out.stdout)
+    return res
+
+
+_EXIT = {"ok": 0, "no_devices": 2, "timeout": 3, "error": 4}
+
+
+def main(argv=None) -> int:
+    import argparse
+    ap = argparse.ArgumentParser(
+        description="bounded-timeout accelerator probe (JSON verdict)")
+    ap.add_argument("--timeout", type=float, default=None,
+                    help="probe deadline in seconds (default: "
+                         "$NEBULA_BENCH_PROBE_TIMEOUT or 150)")
+    args = ap.parse_args(argv)
+    res = probe(timeout_s=args.timeout)
+    print(json.dumps(res))
+    return _EXIT.get(res["probe_status"], 4)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -26,11 +26,13 @@ import jax
 
 jax.config.update("jax_enable_x64", True)
 
-from .device import DeviceSnapshot, make_mesh, pin_snapshot          # noqa: E402
+from .device import (DeviceSnapshot, make_mesh, make_mesh2,          # noqa: E402
+                     mesh_lanes, mesh_parts, pin_snapshot)
 from . import batch                                                  # noqa: E402  (defines the batch_* flags)
 from .runtime import TpuRuntime                                      # noqa: E402
 from . import traverse                                               # noqa: E402  (registers executor+rule)
 from . import match_agg                                              # noqa: E402  (registers executor+rule)
 from . import pipeline                                               # noqa: E402  (registers executor+rule; MUST follow match_agg — rule order)
 
-__all__ = ["DeviceSnapshot", "make_mesh", "pin_snapshot", "TpuRuntime"]
+__all__ = ["DeviceSnapshot", "make_mesh", "make_mesh2", "mesh_lanes",
+           "mesh_parts", "pin_snapshot", "TpuRuntime"]

@@ -11,11 +11,23 @@ device program (kernel family + shape bucket + predicate/yield program
 reach the dispatch boundary together, they enroll in a forming GROUP;
 after a bounded `batch_wait_us` window (or as soon as the group fills
 to `batch_max_lanes`) ONE member launches a single lane-batched kernel
-(`hop.build_traverse_fn_lanes`: a query-id lane axis vmapped over the
-frontier) for everyone, and each member de-muxes its own lane back out
-through the per-statement attribution machinery (rows, WorkCounters,
+(`hop.build_traverse_fn_lanes` on a single chip, or the lanes × shards
+`hop.build_traverse_fn_lanes_sharded` grid program on a multi-device
+mesh — PR 17) for everyone, and each member de-muxes its own lane back
+out through the per-statement attribution machinery (rows, WorkCounters,
 cost sinks, flight entries stay exactly per-statement — the PR 7
 concurrent-attribution contract).
+
+Mesh composition (PR 17): the compatibility key the runtime submits
+INCLUDES the mesh identity — (lanes, parts, mesh epoch) via
+`TpuRuntime._mesh_key()` — so a `set_mesh` re-shard mid-form can never
+merge lanes compiled for different launch grids: members enrolled
+against the old grid keep their group (its key names the old epoch)
+while post-re-shard arrivals form a NEW group under the bumped epoch.
+If the old group's launch runs after the re-shard donated its
+snapshot's buffers, the runtime's retired-snapshot check surfaces
+TpuUnavailable to every member, which take their usual re-pin/host
+fallback — never a silently merged cross-grid launch.
 
 Design points:
 

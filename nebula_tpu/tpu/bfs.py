@@ -35,7 +35,20 @@ import jax.numpy as jnp
 from ..algo.frontier import (bottom_up_step, sharded_level_step,
                              top_down_step)
 from .hop import (_exchange_marks, _extend_fbm_local,
-                  _extend_fbm_sharded, _hub_consts, _norm_ebs)
+                  _extend_fbm_sharded, _hub_consts, _norm_ebs,
+                  a2a_payload_bytes)
+
+
+def bfs_exchange_bytes(P: int, vmax: int, max_steps: int,
+                       lanes: int = 1) -> int:
+    """Total bit-packed all_to_all payload of one sharded BFS run: BFS
+    exchanges EVERY level (the final level's received candidates still
+    update dist), unlike the traverse kernels which skip the last hop's
+    exchange.  This is the number `tpu_all_to_all_bytes` grows by per
+    run — the runtime accounts it analytically because the exchange is
+    fused inside the jitted program (no host-visible boundary to
+    measure).  Zero on a 1-part mesh."""
+    return max_steps * a2a_payload_bytes(P, vmax, lanes)
 
 
 def build_bfs_fn(mesh, P: int, EB, max_steps: int,
@@ -47,7 +60,12 @@ def build_bfs_fn(mesh, P: int, EB, max_steps: int,
     frontier: (P, vmax) bool seed bitmap.  pred/pred_cols: optional
     compiled edge predicate (exprjit) — a filtered FIND SHORTEST PATH
     only traverses mask-passing edges, matching the host oracle's
-    per-expansion filter."""
+    per-expansion filter.
+
+    Mesh contract (PR 17): in_specs name only the 'part' axis, so the
+    same program runs on the legacy 1-D ('part',) mesh and on the
+    2-axis ('lane', 'part') grid (CSR + dist replicated over the lane
+    rows); the per-level exchange payload is bfs_exchange_bytes."""
 
     ebs = _norm_ebs(EB, max_steps, False)
     hubs_c, hub_owner, hub_local = _hub_consts(hub_dense, P)

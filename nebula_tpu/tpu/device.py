@@ -104,6 +104,56 @@ def make_mesh(n_devices: Optional[int] = None, devices=None) -> Mesh:
     return Mesh(np.asarray(devices[:n_devices]), ("part",))
 
 
+def make_mesh2(lanes: int = 1, parts: Optional[int] = None,
+               devices=None) -> Mesh:
+    """A 2-axis ``("lane", "part")`` mesh: the part axis owns one graph
+    partition per column of devices, the lane axis spreads concurrent
+    query lanes over rows (CSR blocks are replicated along it).
+
+    Degrades gracefully instead of refusing: if ``lanes × parts`` devices
+    are not available the lane axis collapses first (lanes → 1, the
+    batched program still runs with every lane on the part row), then the
+    part axis (parts → 1, single-chip local mode). A host with one device
+    always yields the (1, 1) mesh.
+    """
+    explicit = devices is not None
+    if devices is None:
+        init_multihost()
+        devices = jax.devices()
+        try:
+            cpu = jax.devices("cpu")
+        except RuntimeError:
+            cpu = []
+        if len(cpu) > len(devices):
+            devices = cpu
+    devices = list(devices)
+    if parts is None:
+        parts = max(len(devices) // max(lanes, 1), 1)
+    lanes = max(int(lanes), 1)
+    parts = max(int(parts), 1)
+    if lanes * parts > len(devices):
+        if explicit:
+            raise ValueError(
+                f"need {lanes}x{parts} devices, have {len(devices)}")
+        # degrade: lane axis first, then part axis
+        lanes = max(len(devices) // parts, 1)
+        if lanes * parts > len(devices):
+            lanes, parts = 1, max(len(devices), 1)
+        if parts > len(devices):
+            parts = 1
+    grid = np.asarray(devices[:lanes * parts]).reshape(lanes, parts)
+    return Mesh(grid, ("lane", "part"))
+
+
+def mesh_lanes(mesh: Mesh) -> int:
+    """Lane-axis size of a mesh; 1 for legacy 1-D 'part' meshes."""
+    return int(dict(mesh.shape).get("lane", 1))
+
+
+def mesh_parts(mesh: Mesh) -> int:
+    return int(dict(mesh.shape).get("part", 1))
+
+
 @dataclass
 class DeviceBlock:
     """One (edge type, direction) CSR block resident on the mesh."""
@@ -142,26 +192,72 @@ class DeviceSnapshot:
     # guards the runtime's per-space cache across distinct stores
     space_uid: Optional[int] = None
 
+    # set by runtime.pin when a newer epoch replaced this snapshot and its
+    # device buffers were donated (deleted); dispatch paths check it under
+    # the read gate and fall back instead of touching dead buffers
+    retired: bool = False
+
     def block(self, etype: str, direction: str = "out") -> DeviceBlock:
         return self.blocks[(etype, direction)]
 
-    def hbm_bytes(self) -> int:
-        total = self.num_vertices.nbytes
+    def _leaves(self):
+        yield self.num_vertices
         for b in self.blocks.values():
-            total += b.indptr.nbytes + b.nbr.nbytes + b.rank.nbytes
-            total += sum(a.nbytes for a in b.props.values())
+            yield b.indptr
+            yield b.nbr
+            yield b.rank
+            yield from b.props.values()
         for t in self.tags.values():
-            total += t.present.nbytes + sum(a.nbytes for a in t.props.values())
-        return total
+            yield t.present
+            yield from t.props.values()
+
+    def hbm_bytes(self) -> int:
+        return sum(a.nbytes for a in self._leaves())
+
+    def shard_hbm_bytes(self) -> Dict[int, int]:
+        """Per-shard HBM ledger: bytes resident on each part-axis shard.
+
+        Every snapshot leaf is (P, ...) with axis 0 sharded (or, in
+        single-chip mode, wholly resident on the one device), so each
+        part's share is exactly nbytes / P per leaf — lane-axis replicas
+        are not double counted (they are copies of the same partition).
+        """
+        P = max(int(self.num_parts), 1)
+        if mesh_parts(self.mesh) == 1:
+            return {0: self.hbm_bytes()}
+        per = {p: 0 for p in range(P)}
+        for a in self._leaves():
+            share = a.nbytes // P
+            for p in range(P):
+                per[p] += share
+        return per
+
+    def delete_buffers(self) -> None:
+        """Donate this snapshot's device buffers back to the allocator
+        (re-pin path: the old epoch is freed BEFORE the new epoch is
+        placed, so peak HBM stays ~1x instead of 2x). Idempotent."""
+        self.retired = True
+        for a in self._leaves():
+            try:
+                a.delete()
+            except Exception:
+                pass
 
 
 def pin_snapshot(snap: CsrSnapshot, mesh: Mesh) -> DeviceSnapshot:
     """device_put every snapshot array, sharded over the 'part' axis.
 
-    The snapshot's partition count must equal the mesh size — the 1:1
-    partition↔chip contract (SURVEY §2b, partition parallelism row).
+    The snapshot's partition count must equal the mesh part-axis size —
+    the 1:1 partition↔chip contract (SURVEY §2b, partition parallelism
+    row). Multi-part placement is per-device: partition p's row is put
+    directly onto the column-p device(s) and assembled with
+    `make_array_from_single_device_arrays`, so no host-side concat and
+    no all-device broadcast copy ever materialises. On a 2-axis
+    ("lane", "part") mesh the CSR rows are replicated down each lane-axis
+    column (each lane row sees its own resident copy of partition p).
     """
-    P = mesh.shape["part"]
+    P = mesh_parts(mesh)
+    L = mesh_lanes(mesh)
     if P == 1:
         # single-chip mode: every partition resident on the one device;
         # the local (vmap) kernel runs the same program without ICI
@@ -171,9 +267,15 @@ def pin_snapshot(snap: CsrSnapshot, mesh: Mesh) -> DeviceSnapshot:
             return jax.device_put(a, dev0)
     elif snap.num_parts == P:
         part0 = NamedSharding(mesh, PartitionSpec("part"))
+        grid = mesh.devices.reshape(L, P)
 
         def put(a: np.ndarray):
-            return jax.device_put(a, part0)
+            shards = []
+            for row in grid:                     # lane replicas
+                for p, d in enumerate(row):      # one partition per column
+                    shards.append(jax.device_put(a[p:p + 1], d))
+            return jax.make_array_from_single_device_arrays(
+                a.shape, part0, shards)
     else:
         raise TpuUnavailable(
             f"snapshot has {snap.num_parts} parts but mesh has {P} devices; "

@@ -161,6 +161,30 @@ def _exchange_marks(marks, P: int, vmax: int):
     return _unpack_or(recv.reshape(P, -1), vmax)
 
 
+def _exchange_marks_lanes(marks, P: int, vmax: int):
+    """Lane-batched frontier exchange: `marks` is (Ll, P, vmax) — one
+    mark matrix per resident query lane.  Still ONE `all_to_all` per hop:
+    the packed payload carries the lanes × parts grid in a single
+    (Ll, P, W) tensor split/concatenated over the part axis (axis 1), so
+    L compatible queries share the ICI transfer instead of paying one
+    collective each.  Returns (Ll, vmax) bool — this part's next
+    frontier per lane."""
+    packed = jax.vmap(_pack_bits)(marks)              # (Ll, P, W)
+    recv = jax.lax.all_to_all(packed, "part", 1, 1, tiled=False)
+    return jax.vmap(lambda r: _unpack_or(r, vmax))(recv)
+
+
+def a2a_payload_bytes(P: int, vmax: int, lanes: int = 1) -> int:
+    """Total bytes moved through ONE bit-packed frontier all_to_all
+    across the whole mesh (sum of every device's send payload): each of
+    the P parts ships P rows of ceil(vmax/32) uint32 words per lane.
+    Zero when P == 1 — local mode has no exchange."""
+    if P <= 1:
+        return 0
+    W = -(-vmax // 32)
+    return int(lanes) * P * P * W * 4
+
+
 def _compact_cap(src, dst, rk, eidx, keep, EB: int):
     """Stable-partition the kept edge slots to the FRONT of each capture
     row (cumsum scatter, O(EB)) and return the kept count.
@@ -213,6 +237,17 @@ def _extend_fbm_sharded(fbm, pid, hub_owner, hub_local):
     vals = jnp.where(mine, fbm[hub_local], False)
     bits = jax.lax.psum(vals.astype(jnp.int32), "part") > 0
     return jnp.concatenate([fbm, bits])
+
+
+def _extend_fbm_sharded_lanes(fbm, pid, hub_owner, hub_local):
+    """Lane-batched hub extension: fbm is (Ll, vmax) — gather each
+    lane's owned hub bits and psum over the part axis in ONE collective
+    for all resident lanes (the collective sits OUTSIDE any vmap: the
+    lane axis is just a leading data axis of the psum operand)."""
+    mine = hub_owner == pid                               # (H,)
+    vals = jnp.where(mine[None, :], fbm[:, hub_local], False)
+    bits = jax.lax.psum(vals.astype(jnp.int32), "part") > 0
+    return jnp.concatenate([fbm, bits], axis=1)           # (Ll, vmax+H)
 
 
 def _extend_fbm_local(fbm, hub_owner, hub_local, P: int):
@@ -534,3 +569,143 @@ def build_traverse_fn_lanes(P: int, EB, steps: int,
         capture=capture, capture_hops=capture_hops,
         yield_cols=yield_cols, hub_dense=hub_dense)
     return jax.jit(jax.vmap(fn, in_axes=(None, 0)))
+
+
+def build_traverse_fn_lanes_sharded(mesh, P: int, EB, steps: int,
+                                    n_blocks: int,
+                                    pred: Optional[Callable[[Dict[str, Any]], Any]] = None,
+                                    pred_cols: Sequence[str] = (),
+                                    capture: bool = True,
+                                    capture_hops: bool = False,
+                                    yield_cols: Sequence[str] = (),
+                                    hub_dense=None):
+    """The lanes × shards launch grid: ONE shard_map program over the
+    2-axis ("lane", "part") mesh that fuses PR 12's query-id lane axis
+    with the partition axis.
+
+    Unlike `build_traverse_fn_lanes` (single chip: CSR broadcast to every
+    lane via `in_axes=(None, 0)`), the CSR blocks here are MESH-RESIDENT:
+    their in_specs name the part axis, so device (l, p) reads partition
+    p's adjacency out of its own HBM and never sees the other P-1 shards.
+    The frontier is (L, P, vmax) sharded over BOTH axes — each device
+    owns L/lanes query lanes of its partition's bitmap — and the per-hop
+    bit-packed exchange is ONE `all_to_all` whose payload carries the
+    full lanes × parts grid (`_exchange_marks_lanes`).
+
+    The global result contract is IDENTICAL to `build_traverse_fn_lanes`:
+    every leaf carries leading (L, P) axes (hop_edges (L, P, steps),
+    cap arrays (L, P, nb, EB) / (L, P, steps, nb, EB), ...), so the
+    runtime's `_escalate_lanes` / `_lane_attribution` de-mux paths work
+    unchanged on either program.
+
+    Degrade semantics: a (1, 1) mesh never reaches this builder (the
+    runtime's local mode uses the vmap program), and a (1, P) mesh runs
+    it with every lane resident on the part row — same program, lane
+    axis unsplit.
+    """
+    ebs = _norm_ebs(EB, steps, capture_hops)
+    hubs_c, hub_owner, hub_local = _hub_consts(hub_dense, P)
+
+    def kernel(blocks_data, frontier):
+        fbm = frontier[:, 0]                   # (Ll, vmax) bool
+        Ll = fbm.shape[0]
+        vmax = fbm.shape[1]
+        pid = jax.lax.axis_index("part").astype(jnp.int32)
+        hop_edges: List[Any] = []
+        frontier_sizes: List[Any] = []
+        ovf_e = jnp.zeros((Ll,), bool)
+        cap_out = None
+        hop_caps: List[Dict[str, Any]] = []
+
+        for hop in range(steps):
+            frontier_sizes.append(jnp.sum(fbm, axis=1, dtype=jnp.int32))
+            last = hop == steps - 1
+            EBh = ebs[hop]
+            marks = None                       # (Ll, P, vmax) bool
+            edges_this_hop = jnp.zeros((Ll,), jnp.int32)
+            caps = {"src": [], "dst": [], "rank": [], "eidx": [],
+                    "kcount": []}
+            efbm = fbm if hubs_c is None else _extend_fbm_sharded_lanes(
+                fbm, pid, hub_owner, hub_local)
+            for bi in range(n_blocks):
+                b = blocks_data[bi]
+                src, dst, rk, eidx, ve, total, ovf = jax.vmap(
+                    lambda f: _expand_block(
+                        b["indptr"][0], b["nbr"][0], b["rank"][0], f, EBh,
+                        P, pid, vmax_local=vmax, hub_dense=hubs_c))(efbm)
+                ovf_e = ovf_e | ovf
+                edges_this_hop = edges_this_hop + total
+                if pred is not None and (last or capture_hops):
+                    cols = {"_rank": rk, "_src": src, "_dst": dst}
+                    for name in pred_cols:
+                        if not name.startswith("_"):
+                            cols[name] = b["props"][name][0][eidx]
+                    keep = pred(cols) & ve
+                else:
+                    keep = ve
+                if capture and (last or capture_hops):
+                    cs, cd, cr, ce, kc = jax.vmap(
+                        lambda s, d, r, e, k: _compact_cap(
+                            s, d, r, e, k, EBh))(src, dst, rk, eidx, keep)
+                    caps["src"].append(cs)
+                    caps["dst"].append(cd)
+                    caps["rank"].append(cr)
+                    caps["eidx"].append(ce)
+                    caps["kcount"].append(kc)
+                    if last and not capture_hops:
+                        for name in yield_cols:
+                            caps.setdefault("prop:" + name, []).append(
+                                b["props"][name][0][ce])
+                if not last:
+                    marks_b = jax.vmap(
+                        lambda d, k: _mark(d, k, P, vmax))(dst, keep)
+                    marks = marks_b if marks is None else marks | marks_b
+            hop_edges.append(edges_this_hop)
+            if capture and (last or capture_hops):
+                # arrays (Ll, nb, EB); kcount (Ll, nb)
+                hop_caps.append({k: jnp.stack(v, axis=1)
+                                 for k, v in caps.items()})
+
+            if last:
+                if capture:
+                    if capture_hops:
+                        arr_keys = ("src", "dst", "rank", "eidx")
+                        # local (Ll, 1, steps, nb, EB)
+                        cap_out = {k: jnp.stack(
+                            [hc[k] for hc in hop_caps], axis=1)[:, None]
+                            for k in arr_keys}
+                        kcount_out = jnp.stack(
+                            [hc["kcount"] for hc in hop_caps],
+                            axis=1)[:, None]
+                    else:
+                        cap_out = {k: v[:, None]
+                                   for k, v in hop_caps[-1].items()
+                                   if k != "kcount"}
+                        kcount_out = hop_caps[-1]["kcount"][:, None]
+                fbm = jnp.zeros((Ll, vmax), bool)
+            else:
+                fbm = _exchange_marks_lanes(marks, P, vmax)
+
+        res = {
+            "frontier": fbm[:, None],                       # (Ll, 1, vmax)
+            "fcount": jnp.sum(fbm, axis=1, dtype=jnp.int32)[:, None],
+            "hop_edges": jnp.stack(hop_edges, axis=1)[:, None],
+            "frontier_sizes": jnp.stack(frontier_sizes, axis=1)[:, None],
+            "ovf_expand": ovf_e[:, None],
+        }
+        if capture:
+            res["cap"] = cap_out
+            res["kcount"] = kcount_out
+        return res
+
+    from jax.sharding import PartitionSpec
+    csr_spec = PartitionSpec("part")
+    # legacy 1-D ('part',) meshes carry no lane axis: the global lane
+    # dimension stays unsharded (every device holds all lanes) and the
+    # same kernel runs with Ll == L
+    lane_ax = "lane" if "lane" in mesh.axis_names else None
+    lane_spec = PartitionSpec(lane_ax, "part")
+    smapped = _shard_map(kernel, mesh=mesh,
+                         in_specs=(csr_spec, lane_spec),
+                         out_specs=lane_spec)
+    return jax.jit(smapped)

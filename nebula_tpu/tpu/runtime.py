@@ -27,10 +27,12 @@ from ..core.value import ColumnarDataSet, Edge
 from ..graphstore.csr import (build_snapshot, decode_prop_column,
                               decode_prop_column_np)
 from ..graphstore.store import GraphStore
-from .device import DeviceSnapshot, TpuUnavailable, make_mesh, pin_snapshot
+from .device import (DeviceSnapshot, TpuUnavailable, make_mesh,
+                     mesh_lanes, mesh_parts, pin_snapshot)
 from .exprjit import (CannotCompile, compile_predicate, eval_yield_column,
                       eval_yield_column_np)
-from .hop import (build_traverse_fn, build_traverse_fn_lanes,
+from .hop import (a2a_payload_bytes, build_traverse_fn,
+                  build_traverse_fn_lanes, build_traverse_fn_lanes_sharded,
                   build_traverse_fn_local)
 
 
@@ -200,7 +202,8 @@ class TraverseStats:
     __slots__ = ("hop_edges", "frontier_sizes", "result_edges", "f_cap",
                  "e_cap", "retries", "device_s", "steps",
                  "pin_s", "put_s", "fetch_s", "mat_s", "total_s",
-                 "compiles", "hbm_bytes", "segments", "queue_s")
+                 "compiles", "hbm_bytes", "segments", "queue_s",
+                 "shards", "exchange_bytes")
 
     def __init__(self):
         self.hop_edges: List[int] = []
@@ -227,6 +230,11 @@ class TraverseStats:
         # dispatch-gate wait before the kernel could run (ISSUE 9):
         # the queue-wait half of the wait-vs-run decomposition
         self.queue_s = 0.0
+        # mesh facts (PR 17): part-axis shards this dispatch spanned and
+        # the bit-packed frontier all_to_all payload it moved (0 in
+        # single-chip local mode — there is no exchange)
+        self.shards = 1
+        self.exchange_bytes = 0
 
     def edges_traversed(self) -> int:
         return int(sum(self.hop_edges))
@@ -388,8 +396,13 @@ class TpuRuntime:
 
     def __init__(self, mesh=None, n_devices: Optional[int] = None):
         self.mesh = mesh if mesh is not None else make_mesh(n_devices)
-        self.mesh_size = self.mesh.shape["part"]
+        self.mesh_size = mesh_parts(self.mesh)
+        self.mesh_lanes = mesh_lanes(self.mesh)
         self.local_mode = self.mesh_size == 1
+        # bumped by set_mesh: part of every lane-batch compatibility key
+        # so lanes compiled for different launch grids never merge
+        # (PR 12 composition fix)
+        self._mesh_epoch = 0
         self.snapshots: Dict[str, DeviceSnapshot] = {}
         self._fns: Dict[Tuple, Any] = {}
         # program key → last kept-prefix fetch size: arms the
@@ -429,6 +442,13 @@ class TpuRuntime:
         # dispatch-vs-repin gate (ISSUE 9): dispatches share, re-pins
         # exclude — see _DispatchGate
         self._gate = _DispatchGate()
+        # collective-launch mutex (PR 17): a sharded program carries
+        # all_to_all/psum rendezvous over the mesh; two such programs
+        # running CONCURRENTLY on overlapping devices interleave their
+        # rendezvous and deadlock (observed on the CPU virtual mesh,
+        # same hazard on real ICI).  Local-mode programs are
+        # collective-free and keep full dispatch concurrency.
+        self._launch_mutex = threading.Lock()
         from ..utils.config import get_config
         # the bitmap frontier (round-4 redesign) has no size bucket;
         # the only escalating budget left is the per-block edge budget
@@ -436,6 +456,65 @@ class TpuRuntime:
         self.max_cap = 1 << 24          # escalation sanity bound
 
     # -- pinning ----------------------------------------------------------
+
+    def _mesh_key(self) -> Tuple[int, int, int]:
+        """(lanes, parts, epoch): the launch-grid identity every
+        lane-batch compatibility key and bench A/B must carry."""
+        return (self.mesh_lanes, self.mesh_size, self._mesh_epoch)
+
+    def set_mesh(self, mesh) -> None:
+        """Swap the runtime onto a different mesh (bench A/B, elastic
+        re-shard).  Runs under the WRITE side of the dispatch gate:
+        in-flight dispatches drain, every pinned snapshot's buffers are
+        donated back (they are laid out for the OLD grid), the jit and
+        seed caches drop, and the mesh epoch bumps so any batch group
+        still forming against the old grid can never merge with lanes
+        compiled for the new one."""
+        self._gate.acquire_write()
+        try:
+            for dev in self.snapshots.values():
+                dev.delete_buffers()
+            self.snapshots.clear()
+            self._fns.clear()
+            self._kmax.clear()
+            self._seed_fns.clear()
+            self._seed_warm.clear()
+            self.mesh = mesh
+            self.mesh_size = mesh_parts(mesh)
+            self.mesh_lanes = mesh_lanes(mesh)
+            self.local_mode = self.mesh_size == 1
+            self._mesh_epoch += 1
+        finally:
+            self._gate.release_write()
+        self._emit_hbm_gauges()
+
+    def _emit_hbm_gauges(self) -> None:
+        """Re-state the HBM residency gauges: the total plus the
+        per-shard ledger (`tpu_shard_hbm_bytes{shard}` summed over every
+        pinned space) and the mesh width (`tpu_shards`).  Stale shard
+        slots from a wider previous mesh are zeroed, not dropped —
+        last-write-wins gauges would otherwise report a ghost shard."""
+        from ..utils.stats import stats
+        per: Dict[int, int] = {}
+        for dev in self.snapshots.values():
+            for p, b in dev.shard_hbm_bytes().items():
+                per[p] = per.get(p, 0) + b
+        st = stats()
+        st.gauge("tpu_hbm_bytes_pinned", float(sum(per.values())))
+        st.gauge("tpu_shards", float(self.mesh_size))
+        known = st.labeled_gauges.get("tpu_shard_hbm_bytes", {})
+        for p in range(self.mesh_size):
+            st.gauge_labeled("tpu_shard_hbm_bytes", {"shard": p},
+                             float(per.get(p, 0)))
+        for key in list(known):
+            shard = dict(key).get("shard")
+            try:
+                shard_i = int(shard)
+            except (TypeError, ValueError):
+                continue
+            if shard_i >= self.mesh_size and shard_i not in per:
+                st.gauge_labeled("tpu_shard_hbm_bytes",
+                                 {"shard": shard_i}, 0.0)
 
     def pin(self, store: GraphStore, space: str,
             force: bool = False) -> DeviceSnapshot:
@@ -463,19 +542,7 @@ class TpuRuntime:
         else:
             snap = build_snapshot(store, space)
         snap = self._maybe_degree_split(snap)
-        # HBM budget (SURVEY §2 row 5: device memory is the scarce
-        # resource): refuse to pin past the limit; caller falls back to
-        # the host path instead of OOMing the chip
-        from ..utils.memtracker import get_config as _gc  # flag is defined there
-        limit = int(_gc().get("tpu_hbm_limit_bytes"))
-        if limit:
-            est = snap.hbm_bytes()
-            others = sum(s.hbm_bytes() for sp_, s in self.snapshots.items()
-                         if sp_ != space)
-            if est + others > limit:
-                raise TpuUnavailable(
-                    f"snapshot needs {est:,}B HBM; {others:,}B already "
-                    f"pinned, limit {limit:,} (flag tpu_hbm_limit_bytes)")
+        self._check_hbm_budget(snap, space)
         # the device_put runs under the WRITE side of the dispatch
         # gate: in-flight dispatches drain first, new ones wait — the
         # jaxlib serve-while-repin race window is closed, and the
@@ -484,6 +551,22 @@ class TpuRuntime:
         from ..utils.stats import stats
         wait_s = self._gate.acquire_write()
         try:
+            # donate the replaced epoch's buffers BEFORE the new put so
+            # peak HBM through a re-pin stays ~1x the snapshot, not 2x;
+            # no dispatch can hold them (readers drained), and any
+            # thread still carrying the old DeviceSnapshot object sees
+            # `retired` under its next read gate and re-pins
+            old = self.snapshots.get(space)
+            if old is not None and not force and not old.retired \
+                    and old.epoch == sd.epoch \
+                    and getattr(old, "space_uid", None) == getattr(
+                        sd, "uid", None):
+                # a concurrent first-touch pin of the same space won the
+                # gate first — adopt its snapshot instead of retiring it
+                # (retiring here would fail that thread's dispatch)
+                return old
+            if old is not None:
+                old.delete_buffers()
             dev = pin_snapshot(snap, self.mesh)
             dev.space_uid = getattr(sd, "uid", None)
             self.snapshots[space] = dev
@@ -494,8 +577,35 @@ class TpuRuntime:
             self._gate.release_write()
         stats().observe("tpu_repin_wait_us", int(wait_s * 1e6))
         stats().inc("tpu_pins")
-        stats().gauge("tpu_hbm_bytes_pinned", float(self.hbm_bytes()))
+        self._emit_hbm_gauges()
         return dev
+
+    def _check_hbm_budget(self, snap, space: str) -> None:
+        """HBM budget (SURVEY §2 row 5: device memory is the scarce
+        resource): refuse to pin past the PER-DEVICE limit; the caller
+        falls back to the host path instead of OOMing the chip.
+
+        The limit is per device — that is the scale-out contract: a
+        snapshot sharded P ways parks hbm_bytes/P on each chip, so an
+        8-way mesh accepts a graph 8× the single-chip budget (ROADMAP
+        item 1's "fills a pod, not a chip")."""
+        from ..utils.memtracker import get_config as _gc  # flag defined there
+        limit = int(_gc().get("tpu_hbm_limit_bytes"))
+        if not limit:
+            return
+        P = self.mesh_size if (not self.local_mode
+                               and snap.num_parts == self.mesh_size) else 1
+        est = -(-snap.hbm_bytes() // P)
+        others = 0
+        for sp_, s in self.snapshots.items():
+            if sp_ == space:
+                continue
+            others += max(s.shard_hbm_bytes().values(), default=0)
+        if est + others > limit:
+            raise TpuUnavailable(
+                f"snapshot needs {est:,}B HBM per device "
+                f"({P} shard(s)); {others:,}B already pinned per device, "
+                f"limit {limit:,} (flag tpu_hbm_limit_bytes)")
 
     @staticmethod
     def _maybe_degree_split(snap):
@@ -516,8 +626,12 @@ class TpuRuntime:
         """Pin an externally-built CsrSnapshot (bulk-ingest / bench path
         — no dict store behind it)."""
         snap = self._maybe_degree_split(snap)
+        self._check_hbm_budget(snap, snap.space)
         wait_s = self._gate.acquire_write()
         try:
+            old = self.snapshots.get(snap.space)
+            if old is not None:
+                old.delete_buffers()
             dev = pin_snapshot(snap, self.mesh)
             self.snapshots[snap.space] = dev
         finally:
@@ -525,13 +639,15 @@ class TpuRuntime:
         from ..utils.stats import stats
         stats().observe("tpu_repin_wait_us", int(wait_s * 1e6))
         stats().inc("tpu_pins")
-        stats().gauge("tpu_hbm_bytes_pinned", float(self.hbm_bytes()))
+        self._emit_hbm_gauges()
         return dev
 
     def unpin(self, space: str):
         self._gate.acquire_write()
         try:
-            self.snapshots.pop(space, None)
+            old = self.snapshots.pop(space, None)
+            if old is not None:
+                old.delete_buffers()
             self._fns = {k: v for k, v in self._fns.items()
                          if k[0] != space}
             self._kmax = {k: v for k, v in self._kmax.items()
@@ -540,6 +656,7 @@ class TpuRuntime:
                              if k[0][0] != space}
         finally:
             self._gate.release_write()
+        self._emit_hbm_gauges()
 
     def hbm_bytes(self) -> int:
         return sum(s.hbm_bytes() for s in self.snapshots.values())
@@ -645,7 +762,8 @@ class TpuRuntime:
         key, fn = self._seed_builder(target, P, vmax, lanes=False)
         wk = (key, cap)
         if wk not in self._seed_warm:
-            jax.block_until_ready(fn(pad))   # compile outside the timer
+            with self._collective_launch():
+                jax.block_until_ready(fn(pad))   # compile outside timer
             self._seed_warm.add(wk)
         return pad, fn
 
@@ -723,6 +841,21 @@ class TpuRuntime:
                 self._gate.release_read()
             dispatch_table().exit(tok)
 
+    @contextmanager
+    def _collective_launch(self):
+        """Serialize device programs that contain mesh collectives.
+        On a multi-part mesh every launch (kernel run, seed warm-up,
+        seed put) holds the mutex for the duration of the execution:
+        concurrent collective programs on overlapping devices
+        interleave their all_to_all rendezvous and deadlock.  A no-op
+        in local mode — the vmapped single-chip programs have no
+        collectives and dispatch concurrently as before."""
+        if self.local_mode:
+            yield
+            return
+        with self._launch_mutex:
+            yield
+
     def algo_dispatch(self, kernel: str, fn, *args):
         """One gated single-shot device dispatch for the algo plane
         (ISSUE 13): a vertex-program ITERATION kernel has static
@@ -736,8 +869,9 @@ class TpuRuntime:
         from ..utils.workload import current_live
         with self._gated_dispatch(kernel):
             t0 = time.perf_counter()
-            res = fn(*args)
-            jax.block_until_ready(res)
+            with self._collective_launch():
+                res = fn(*args)
+                jax.block_until_ready(res)
             us = int((time.perf_counter() - t0) * 1e6)
             _metrics().observe("tpu_dispatch_us", us, {"kernel": kernel})
             cc = current_cost()
@@ -769,7 +903,11 @@ class TpuRuntime:
         lanes = [self._seed_sorted(dense_ids, P, vmax)
                  for dense_ids in lane_dense]
         cap = _pow2(max((len(d) for d in lanes), default=1) or 1)
-        L = _pow2(max(len(lanes), 1))
+        # on a (lanes, parts) mesh the global lane axis must divide
+        # evenly over the lane-axis rows: pad to Lm × pow2 lanes (Lm=1
+        # in local mode reduces to the plain pow2 bucket)
+        Lm = max(self.mesh_lanes, 1)
+        L = Lm * _pow2(max(-(-len(lanes) // Lm), 1))
         pad = np.full((L, cap), -1, np.int64)
         for i, d in enumerate(lanes):
             if d:
@@ -777,7 +915,8 @@ class TpuRuntime:
         key, fn = self._seed_builder(target, P, vmax, lanes=True)
         wk = (key, L, cap)
         if wk not in self._seed_warm:
-            jax.block_until_ready(fn(pad))   # compile outside the timer
+            with self._collective_launch():
+                jax.block_until_ready(fn(pad))   # compile outside timer
             self._seed_warm.add(wk)
         return pad, fn, L
 
@@ -810,10 +949,17 @@ class TpuRuntime:
         from ..utils.stats import stats as _metrics
         from ..utils.stats import use_cost, use_work
         from ..utils.workload import use_live
+        if getattr(dev, "retired", False):
+            raise TpuUnavailable(
+                "device snapshot retired by a concurrent re-pin")
         base = self.init_eb
         EBs = [base] * n_hops
         L_real = len(lane_dense)
-        bkey = (key_fn(()) + ("lanes",), _pow2(max(L_real, 1)))
+        # mesh identity in the bucket key: a 1-shard and an 8-shard run
+        # of the same program have different overflow profiles (per-part
+        # expansion vs whole-graph expansion)
+        bkey = (key_fn(()) + ("lanes", self._mesh_key()),
+                _pow2(max(L_real, 1)))
         prev = self._buckets.get(bkey)
         if prev is not None:
             pe = prev[-1]
@@ -822,25 +968,37 @@ class TpuRuntime:
                 EBs = [max(a, int(b)) for a, b in zip(EBs, pe)]
         if uniform:
             EBs = [max(EBs)] * n_hops
-        target = self.mesh.devices.reshape(-1)[0]   # local mode only
+        if self.local_mode:
+            target = self.mesh.devices.reshape(-1)[0]
+        else:
+            # lanes × shards grid: the frontier stack is sharded over
+            # BOTH mesh axes — each device owns its lane rows of its
+            # partition's bitmap.  On a legacy 1-D ('part',) mesh the
+            # lane dimension stays unsharded (replicated lanes).
+            lane_ax = "lane" if "lane" in self.mesh.axis_names else None
+            target = NamedSharding(self.mesh,
+                                   PartitionSpec(lane_ax, "part"))
         seed_pad, seed_fn, L = self._seed_frontier_prep_lanes(
             dev, lane_dense, target)
         info: Dict[str, Any] = {
             "lanes": L_real, "rungs": [], "compiles": 0, "retries": 0,
             "put_s": 0.0, "fetch_s": 0.0, "device_s": 0.0,
-            "gate_wait_us": 0, "ebs": list(EBs), "hbm_bytes": 0}
+            "gate_wait_us": 0, "ebs": list(EBs), "hbm_bytes": 0,
+            "shards": self.mesh_size, "exchange_bytes": 0}
         with use_work(None), use_cost(None), use_live(None), \
                 self._gated_dispatch(kernel) as wait_us:
             info["gate_wait_us"] = wait_us
             tp = time.perf_counter()
-            frontier = seed_fn(seed_pad)
+            with self._collective_launch():
+                frontier = seed_fn(seed_pad)
             info["put_s"] = time.perf_counter() - tp
             for attempt in range(max(self.max_retries, n_hops + 3)):
                 ebs = tuple(EBs)
                 # lane suffix (not prefix): pin/unpin prune _fns by
                 # key[0]==space / key[1]==epoch — lane programs must
-                # age out with their snapshot like solo programs do
-                key = key_fn(ebs) + ("lanes", L)
+                # age out with their snapshot like solo programs do;
+                # the mesh key separates per-grid compilations
+                key = key_fn(ebs) + ("lanes", L, self._mesh_key())
                 fn = self._fns.get(key)
                 compiled = fn is None
                 if compiled:
@@ -857,12 +1015,14 @@ class TpuRuntime:
                     import os as _os
                     run_dir = _os.path.join(str(prof_dir),
                                             f"run{self._prof_seq:06d}")
-                    with jax.profiler.trace(run_dir):
+                    with jax.profiler.trace(run_dir), \
+                            self._collective_launch():
                         res = fn(*inputs_fn(ebs), frontier)
                         jax.block_until_ready(res)
                 else:
-                    res = fn(*inputs_fn(ebs), frontier)
-                    jax.block_until_ready(res)
+                    with self._collective_launch():
+                        res = fn(*inputs_fn(ebs), frontier)
+                        jax.block_until_ready(res)
                 t1 = time.perf_counter()
                 info["rungs"].append((int((t1 - t0) * 1e6), compiled))
                 info["device_s"] = t1 - t0
@@ -920,16 +1080,32 @@ class TpuRuntime:
                     getattr(self, "_hbm_high_water", 0), hbm)
                 _metrics().gauge("tpu_hbm_high_water_bytes",
                                  float(self._hbm_high_water))
+                # lanes × shards exchange accounting (PR 17): the
+                # shared launch's single per-hop all_to_all carries the
+                # whole L-lane payload
+                xhops = n_hops if kernel == "bfs" else max(n_hops - 1, 0)
+                xbytes = (0 if self.local_mode else
+                          xhops * a2a_payload_bytes(
+                              self.mesh_size, dev.vmax, lanes=L))
+                info["shards"] = self.mesh_size
+                info["exchange_bytes"] = xbytes
+                _metrics().gauge("tpu_shards", float(self.mesh_size))
                 from ..utils.flight import kernel_ledger
                 kernel_ledger().record(
                     kernel=kernel, shape=[L] + list(EBs), steps=n_hops,
                     compiled=bool(info["compiles"]),
                     dispatch_us=int(info["device_s"] * 1e6),
-                    hbm_bytes=hbm, retries=attempt)
+                    hbm_bytes=hbm, retries=attempt,
+                    shards=self.mesh_size, exchange_bytes=xbytes)
                 from ..utils import trace as _t
                 _t.record_phase("tpu:batch", info["device_s"],
                                 lanes=L_real, kernel=kernel,
                                 eb=list(EBs))
+                if xbytes:
+                    _metrics().inc("tpu_all_to_all_bytes", xbytes)
+                    _t.record_phase("tpu:shard_exchange", 0.0,
+                                    bytes=xbytes, hops=xhops,
+                                    shards=self.mesh_size, lanes=L)
                 return res, info
         raise TpuUnavailable(
             "lane-batched bucket escalation did not converge")
@@ -957,6 +1133,8 @@ class TpuRuntime:
         stats.queue_s = (info["gate_wait_us"] + tk.form_wait_us) / 1e6
         stats.f_cap, stats.e_cap = 0, list(info["ebs"])
         stats.hbm_bytes = info["hbm_bytes"]
+        stats.shards = info.get("shards", 1)
+        stats.exchange_bytes = info.get("exchange_bytes", 0)
         n_rungs = len(info["rungs"])
         rung_us = sum(r for r, _ in info["rungs"])
         from ..utils.stats import current_cost, current_work
@@ -985,6 +1163,19 @@ class TpuRuntime:
         _t.record_phase("device:fetch", stats.fetch_s)
         return {k: v[lane] for k, v in res["cap"].items()}
 
+    def _lanes_builder(self, P: int, steps: int, n_blocks: int, **kw):
+        """Grid-aware lanes program factory: the single-chip vmap
+        program in local mode, the lanes × shards shard_map program on
+        a multi-device mesh (CSR blocks mesh-resident, ONE all_to_all
+        per hop carrying every lane)."""
+        def build_lanes(ebs):
+            if self.local_mode:
+                return build_traverse_fn_lanes(
+                    P, ebs, steps, n_blocks, **kw)
+            return build_traverse_fn_lanes_sharded(
+                self.mesh, P, ebs, steps, n_blocks, **kw)
+        return build_lanes
+
     def _try_batched(self, dense: Sequence[int], dev: DeviceSnapshot,
                      key_fn, build_lanes, inputs_fn, n_hops: int,
                      uniform: bool, fetch_keys: Optional[set],
@@ -992,10 +1183,15 @@ class TpuRuntime:
         """Submit this dispatch to the batch former; returns the
         statement's solo-shaped {"cap": ...} after a shared launch, or
         None when the dispatch should run solo (batching off, no
-        concurrent company, multi-chip mesh — the lane axis is a
-        single-chip program — or the `tpu:batch_form` failpoint
-        rejected enrollment)."""
-        if not self.local_mode:
+        concurrent company, a mesh the snapshot is not sharded for, or
+        the `tpu:batch_form` failpoint rejected enrollment).
+
+        Sharded meshes batch too (PR 17): the lanes builder the caller
+        hands us is grid-aware (lanes × shards shard_map when
+        local_mode is off), and the compatibility key carries the mesh
+        shape + epoch so a re-pin to a different shard count can never
+        merge lanes compiled for different launch grids."""
+        if not self.local_mode and dev.num_parts != self.mesh_size:
             return None
         from ..utils.failpoints import FailpointError
         from .batch import batch_former
@@ -1004,7 +1200,7 @@ class TpuRuntime:
             return None
         base_key = (kernel, key_fn(()),
                     frozenset(fetch_keys) if fetch_keys is not None
-                    else None)
+                    else None, ("mesh",) + self._mesh_key())
 
         def launch(lane_dense):
             return self._escalate_lanes(
@@ -1044,6 +1240,11 @@ class TpuRuntime:
         (capture_hops stacks frames along a hop axis; BFS compiles one
         per-level body).
         """
+        if getattr(dev, "retired", False):
+            # a concurrent re-pin donated this snapshot's buffers while
+            # we were queued at the gate; the caller re-pins / falls back
+            raise TpuUnavailable(
+                "device snapshot retired by a concurrent re-pin")
         base = self.init_eb
         if min_eb is not None:
             # caller knows a static bound (e.g. BFS: one hop's expansion
@@ -1072,7 +1273,8 @@ class TpuRuntime:
 
         seed_pad, seed_fn = self._seed_frontier_prep(dev, dense, target)
         tp = time.perf_counter()
-        frontier = seed_fn(seed_pad)
+        with self._collective_launch():
+            frontier = seed_fn(seed_pad)
         stats.put_s = time.perf_counter() - tp
 
         # a post-overflow hop's reported count is a LOWER bound (its
@@ -1111,12 +1313,14 @@ class TpuRuntime:
                 import os as _os
                 run_dir = _os.path.join(str(prof_dir),
                                         f"run{self._prof_seq:06d}")
-                with jax.profiler.trace(run_dir):
+                with jax.profiler.trace(run_dir), \
+                        self._collective_launch():
                     res = fn(*inputs_fn(ebs), frontier)
                     jax.block_until_ready(res)
             else:
-                res = fn(*inputs_fn(ebs), frontier)
-                jax.block_until_ready(res)
+                with self._collective_launch():
+                    res = fn(*inputs_fn(ebs), frontier)
+                    jax.block_until_ready(res)
             t1 = time.perf_counter()
             stats.device_s = t1 - t0
             rungs.append((int((t1 - t0) * 1e6), compiled))
@@ -1248,12 +1452,23 @@ class TpuRuntime:
                     getattr(self, "_hbm_high_water", 0), hbm)
                 _metrics().gauge("tpu_hbm_high_water_bytes",
                                  float(self._hbm_high_water))
+                # per-shard dispatch/exchange facts (PR 17): the
+                # bit-packed frontier all_to_all payload this converged
+                # run moved over ICI — BFS exchanges every level, the
+                # traverse kernels skip the final hop's exchange
+                stats.shards = self.mesh_size
+                xhops = n_hops if kernel == "bfs" else max(n_hops - 1, 0)
+                stats.exchange_bytes = (
+                    0 if self.local_mode else
+                    xhops * a2a_payload_bytes(self.mesh_size, dev.vmax))
+                _metrics().gauge("tpu_shards", float(self.mesh_size))
                 from ..utils.flight import kernel_ledger
                 kernel_ledger().record(
                     kernel=kernel, shape=list(EBs), steps=n_hops,
                     compiled=bool(stats.compiles),
                     dispatch_us=dispatch_us, hbm_bytes=hbm,
-                    retries=stats.retries)
+                    retries=stats.retries, shards=self.mesh_size,
+                    exchange_bytes=stats.exchange_bytes)
                 # device-plane trace phases (ISSUE 1): the runtime
                 # timed them itself — emit as leaf spans of whatever
                 # executor span is driving this kernel
@@ -1262,6 +1477,14 @@ class TpuRuntime:
                 _t.record_phase("device:dispatch", stats.device_s,
                                 eb=list(EBs), retries=stats.retries)
                 _t.record_phase("device:fetch", stats.fetch_s)
+                if stats.exchange_bytes:
+                    _metrics().inc("tpu_all_to_all_bytes",
+                                   stats.exchange_bytes)
+                    # the exchange runs inside the fused program — its
+                    # span carries payload facts, not a separate timing
+                    _t.record_phase("tpu:shard_exchange", 0.0,
+                                    bytes=stats.exchange_bytes,
+                                    hops=xhops, shards=self.mesh_size)
                 return res
         raise TpuUnavailable("bucket escalation did not converge")
 
@@ -1371,8 +1594,8 @@ class TpuRuntime:
         if capture:
             res = self._try_batched(
                 dense, dev, key_fn,
-                build_lanes=lambda ebs: build_traverse_fn_lanes(
-                    P, ebs, steps, len(block_keys), pred=pred,
+                build_lanes=self._lanes_builder(
+                    P, steps, len(block_keys), pred=pred,
                     pred_cols=pred_cols, capture=True,
                     yield_cols=yield_cols, hub_dense=hub_dense),
                 inputs_fn=lambda ebs: (blocks_data,),
@@ -1481,8 +1704,8 @@ class TpuRuntime:
         # expansions of the same program share ONE launch
         res = self._try_batched(
             dense, dev, key_fn,
-            build_lanes=lambda ebs: build_traverse_fn_lanes(
-                P, ebs, max_hop, len(block_keys), pred=pred,
+            build_lanes=self._lanes_builder(
+                P, max_hop, len(block_keys), pred=pred,
                 pred_cols=pred_cols, capture=True, capture_hops=True,
                 hub_dense=hub_dense),
             inputs_fn=lambda ebs: (blocks_data,),

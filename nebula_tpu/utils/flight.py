@@ -193,7 +193,8 @@ class KernelLedger:
 
     def record(self, *, kernel: str, shape: List[int], steps: int,
                compiled: bool, dispatch_us: int, hbm_bytes: int,
-               retries: int = 0):
+               retries: int = 0, shards: int = 1,
+               exchange_bytes: int = 0):
         try:
             cap = int(get_config().get("kernel_ledger_capacity"))
         except Exception:  # noqa: BLE001
@@ -207,7 +208,13 @@ class KernelLedger:
                 "shape": list(int(x) for x in shape), "steps": int(steps),
                 "compiled": bool(compiled),
                 "dispatch_us": int(dispatch_us),
-                "hbm_bytes": int(hbm_bytes), "retries": int(retries)})
+                "hbm_bytes": int(hbm_bytes), "retries": int(retries),
+                # mesh facts (PR 17): how many part-axis shards the
+                # launch spanned and the bit-packed frontier exchange
+                # payload it moved — the "mesh is used, not assumed"
+                # proof per dispatch
+                "shards": int(shards),
+                "exchange_bytes": int(exchange_bytes)})
             while len(self._ring) > cap:
                 self._ring.popleft()
 

@@ -147,6 +147,7 @@ class StatsManager:
         self.gauges: Dict[str, float] = {}
         self.series: Dict[str, _Series] = {}
         self.labeled: Dict[str, Dict[_LabelKey, float]] = {}
+        self.labeled_gauges: Dict[str, Dict[_LabelKey, float]] = {}
         self.histograms: Dict[str, _Histogram] = {}
         self.lock = threading.Lock()
 
@@ -164,6 +165,17 @@ class StatsManager:
     def gauge(self, name: str, value: float):
         with self.lock:
             self.gauges[name] = value
+
+    def gauge_labeled(self, name: str, labels: Dict[str, Any],
+                      value: float):
+        """SET a per-label-set gauge (last write wins — unlike
+        inc_labeled's accumulate): the per-shard HBM ledger
+        (`tpu_shard_hbm_bytes{shard}`) re-states each shard's residency
+        at every pin/unpin instead of summing deltas."""
+        key = _label_key(labels)
+        with self.lock:
+            series = self.labeled_gauges.setdefault(name, {})
+            series[key] = value
 
     def add_value(self, name: str, value: float):
         s = self.series.get(name)
@@ -190,6 +202,8 @@ class StatsManager:
             out.update(self.gauges)
             series = dict(self.series)
             labeled = {n: dict(v) for n, v in self.labeled.items()}
+            for n, v in self.labeled_gauges.items():
+                labeled.setdefault(n, {}).update(v)
             hists = dict(self.histograms)
         for name, s in series.items():
             for k, v in s.snapshot().items():
@@ -219,6 +233,8 @@ class StatsManager:
             gauges = dict(self.gauges)
             series = dict(self.series)
             labeled = {n: dict(v) for n, v in self.labeled.items()}
+            labeled_g = {n: dict(v)
+                         for n, v in self.labeled_gauges.items()}
             hists = dict(self.histograms)
         lines: List[str] = []
         for name in sorted(counters):
@@ -235,6 +251,12 @@ class StatsManager:
             pn = _prom_name(name)
             lines.append(f"# TYPE {pn} gauge")
             lines.append(f"{pn} {_prom_num(gauges[name])}")
+        for name in sorted(labeled_g):
+            pn = _prom_name(name)
+            lines.append(f"# TYPE {pn} gauge")
+            for key in sorted(labeled_g[name]):
+                lines.append(f"{pn}{_prom_labels(key)} "
+                             f"{_prom_num(labeled_g[name][key])}")
         # rolling series export as gauges of their window aggregates
         for name in sorted(series):
             snap = series[name].snapshot()
@@ -292,6 +314,7 @@ class StatsManager:
             self.gauges.clear()
             self.series.clear()
             self.labeled.clear()
+            self.labeled_gauges.clear()
             self.histograms.clear()
 
 

@@ -411,3 +411,68 @@ def test_batch_flags_update_configs(rt, clean):
     rs = eng.execute(s, "UPDATE CONFIGS batch_max_lanes=0")
     assert rs.error is None, rs.error
     assert not batch_former().enabled()
+
+# -- mesh composition (ISSUE 17) --------------------------------------------
+
+
+def test_repin_to_wider_mesh_mid_form_splits_group(clean, company):
+    """The compatibility key carries the mesh shape + epoch: statements
+    enrolled BEFORE a set_mesh re-shard and statements enrolled AFTER
+    it land in DIFFERENT groups (two 2-lane launches, never one merged
+    4-lane launch spanning two launch grids), and the pre-repin group —
+    whose snapshot the re-pin retired — still yields correct rows via
+    the TpuUnavailable host fallback."""
+    from nebula_tpu.tpu import make_mesh2
+
+    rt = TpuRuntime(make_mesh(1))        # private runtime: set_mesh below
+    eng = device_engine(rt)
+    seeds = [1, 2, 3, 5]
+    truth = {}
+    for sd in seeds:
+        out = {}
+        _run_stmt(eng, GO_TMPL.format(seed=sd), out, sd, [])
+        rs, _ = out[sd]
+        assert rs.error is None, rs.error
+        truth[sd] = sorted(map(repr, rs.data.rows))
+
+    # max_lanes=3: a pair never fills a group, so the pre-repin pair
+    # keeps FORMING for the whole window while set_mesh runs
+    get_config().set_dynamic_many({"batch_max_lanes": 3,
+                                   "batch_wait_us": 500_000})
+    s0 = stats().snapshot()
+    out, errs = {}, []
+    pre = [threading.Thread(target=_run_stmt,
+                            args=(eng, GO_TMPL.format(seed=sd), out, sd,
+                                  errs), daemon=True)
+           for sd in seeds[:2]]
+    for t in pre:
+        t.start()
+    # wait until both pre-repin statements are enrolled in one group
+    _wait_for(lambda: any(len(g.members) == 2
+                          for g in batch_former()._groups.values()),
+              msg="pre-repin group of 2")
+    # re-shard 1 -> 4 parts mid-form: the enrolled group's snapshot is
+    # retired (donated buffers) and the mesh epoch bumps
+    rt.set_mesh(make_mesh(4))
+    post = [threading.Thread(target=_run_stmt,
+                             args=(eng, GO_TMPL.format(seed=sd), out, sd,
+                                   errs), daemon=True)
+            for sd in seeds[2:]]
+    for t in post:
+        t.start()
+    for t in pre + post:
+        t.join(60)
+    assert not errs, errs[:3]
+    s1 = stats().snapshot()
+    for sd in seeds:
+        rs, _ = out[sd]
+        assert rs.error is None, rs.error
+        assert sorted(map(repr, rs.data.rows)) == truth[sd], \
+            f"seed {sd}: rows wrong across the mid-form re-shard"
+    formed = s1.get("tpu_batches_formed", 0) \
+        - s0.get("tpu_batches_formed", 0)
+    # without the mesh-shape/epoch key the post pair would JOIN the
+    # still-forming pre group (3rd member fills it -> one merged
+    # 3-lane launch, formed == 1); the epoch key keeps the grids apart
+    # as two 2-lane groups
+    assert formed == 2, f"expected two 2-lane groups, saw {formed}"

@@ -1,0 +1,302 @@
+"""Mesh-native sharded execution (ISSUE 17): per-device CSR residency,
+1/2/4-part parity against the single-chip oracle for GO / MATCH
+traverse / BFS, the (1,1) degrade path, the per-shard HBM ledger, the
+per-DEVICE budget scale-out proof, and batched lanes on a sharded mesh.
+
+Everything here runs on the 8-device virtual CPU mesh the conftest
+forces — the same programs (shard_map, all_to_all) that run on a real
+multi-chip mesh, minus the ICI."""
+import random
+import threading
+
+import numpy as np
+import pytest
+
+from nebula_tpu.core.value import NULL
+from nebula_tpu.exec.engine import QueryEngine
+from nebula_tpu.graphstore.csr import build_snapshot
+from nebula_tpu.graphstore.schema import PropDef, PropType
+from nebula_tpu.graphstore.store import GraphStore
+from nebula_tpu.utils.config import get_config
+from nebula_tpu.utils.stats import stats
+
+tpu = pytest.importorskip("nebula_tpu.tpu")
+from nebula_tpu.tpu import (TpuRuntime, make_mesh, make_mesh2,  # noqa: E402
+                            mesh_lanes, mesh_parts)
+from nebula_tpu.tpu.device import TpuUnavailable           # noqa: E402
+
+from test_tpu import norm_edge                             # noqa: E402
+
+
+def store_p(parts: int, seed=3, n=90, avg_deg=4, spacename="g"):
+    """random_store with a configurable partition count — a sharded
+    pin requires partition_num == mesh parts."""
+    rng = random.Random(seed)
+    st = GraphStore()
+    st.create_space(spacename, partition_num=parts, vid_type="INT64")
+    st.catalog.create_tag(spacename, "person", [
+        PropDef("age", PropType.INT64)])
+    st.catalog.create_edge(spacename, "knows", [
+        PropDef("w", PropType.INT64), PropDef("f", PropType.DOUBLE)])
+    for v in range(n):
+        st.insert_vertex(spacename, v, "person", {"age": rng.randint(0, 80)})
+    for v in range(n):
+        for _ in range(rng.randint(0, avg_deg * 2)):
+            props = {"w": rng.randint(-5, 100) if rng.random() > .1
+                     else NULL, "f": rng.uniform(0, 1)}
+            st.insert_edge(spacename, v, "knows", rng.randrange(n),
+                           rng.randint(0, 2), props)
+    return st
+
+
+def go_key(rows):
+    return sorted(norm_edge(e) for (_, e, _) in rows)
+
+
+# -- GO / MATCH / BFS parity: sharded mesh vs single-chip oracle ------------
+
+
+@pytest.mark.parametrize("parts", [1, 2, 4])
+def test_go_parity_sharded_vs_single_chip(parts):
+    """GO-3-step rows on a P-part mesh are byte-identical to the
+    make_mesh(1) single-chip oracle AND to the host engine."""
+    st = store_p(parts, seed=10 + parts)
+    rt_shard = TpuRuntime(make_mesh(parts))
+    rt_solo = TpuRuntime(make_mesh(1))
+    assert rt_shard.mesh_size == parts
+    seeds = [1, 5, 9, 23]
+    r_sh, s_sh = rt_shard.traverse(st, "g", seeds, ["knows"], "out", 3)
+    r_so, s_so = rt_solo.traverse(st, "g", seeds, ["knows"], "out", 3)
+    assert go_key(r_sh) == go_key(r_so)
+    assert s_sh.shards == parts
+    assert s_so.shards == 1
+    if parts > 1:
+        # 2 exchanges for a 3-hop traverse (the last hop ships no
+        # frontier), each a bit-packed (P, P, W) uint32 all_to_all
+        from nebula_tpu.tpu.hop import a2a_payload_bytes
+        dev = rt_shard.snapshots["g"]
+        assert s_sh.exchange_bytes == 2 * a2a_payload_bytes(
+            parts, dev.vmax)
+    else:
+        assert s_sh.exchange_bytes == 0
+    # engine-level rows: device plane vs pure-host execution
+    q = ("GO 3 STEPS FROM 1, 5, 9, 23 OVER knows "
+         "YIELD src(edge), rank(edge), dst(edge)")
+    eng_host = QueryEngine(st)
+    eng_dev = QueryEngine(st, tpu_runtime=rt_shard)
+    sh = eng_host.new_session()
+    sdv = eng_dev.new_session()
+    eng_host.execute(sh, "USE g")
+    eng_dev.execute(sdv, "USE g")
+    rs_h = eng_host.execute(sh, q)
+    rs_d = eng_dev.execute(sdv, q)
+    assert rs_h.error is None and rs_d.error is None
+    assert sorted(map(repr, rs_h.data.rows)) == \
+        sorted(map(repr, rs_d.data.rows))
+
+
+@pytest.mark.parametrize("parts", [2, 4])
+def test_match_traverse_hops_parity(parts):
+    """MATCH's layered expansion (traverse_hops) on a sharded mesh
+    yields the same per-hop edge frames as the single-chip program."""
+    st = store_p(parts, seed=20 + parts)
+    rt_shard = TpuRuntime(make_mesh(parts))
+    rt_solo = TpuRuntime(make_mesh(1))
+    fr_sh, s_sh = rt_shard.traverse_hops(st, "g", [1, 2, 7], ["knows"],
+                                         "out", 3)
+    fr_so, _ = rt_solo.traverse_hops(st, "g", [1, 2, 7], ["knows"],
+                                     "out", 3)
+    assert len(fr_sh) == len(fr_so) == 3
+    for hs, ho in zip(fr_sh, fr_so):
+        assert sorted(norm_edge(e) for e in hs.edges) == \
+            sorted(norm_edge(e) for e in ho.edges)
+    assert s_sh.shards == parts
+
+
+@pytest.mark.parametrize("parts", [2, 4])
+def test_bfs_parity_sharded(parts):
+    """Sharded BFS dist == single-chip dist == numpy oracle; BFS
+    exchanges EVERY level (traverse skips the last hop's)."""
+    from nebula_tpu.bench.datagen import host_bfs
+    from nebula_tpu.tpu.bfs import bfs_exchange_bytes
+
+    st = store_p(parts, seed=30 + parts, n=120, avg_deg=5)
+    rt_shard = TpuRuntime(make_mesh(parts))
+    rt_solo = TpuRuntime(make_mesh(1))
+    snap = build_snapshot(st, "g")
+    sd = st.space("g")
+    srcs = [1, 4, 11]
+    dist_sh, s_sh = rt_shard.bfs(st, "g", srcs, ["knows"], "out", 5)
+    dist_so, _ = rt_solo.bfs(st, "g", srcs, ["knows"], "out", 5)
+    assert np.array_equal(np.asarray(dist_sh), np.asarray(dist_so))
+    dense = [sd.dense_id(v) for v in srcs]
+    want = host_bfs(snap, dense, 5, etype="knows")
+    got = np.asarray(dist_sh, np.int32)
+    vv = np.arange(want.shape[0])
+    assert np.array_equal(got[vv % parts, vv // parts], want)
+    dev = rt_shard.snapshots["g"]
+    assert s_sh.exchange_bytes == bfs_exchange_bytes(parts, dev.vmax, 5)
+
+
+# -- mesh construction + degrade --------------------------------------------
+
+
+def test_mesh2_grid_and_degrade():
+    """make_mesh2 builds the ('lane', 'part') grid; oversubscription
+    degrades (lane axis first) instead of refusing; one device always
+    yields the (1, 1) mesh and the runtime serves in local mode."""
+    m = make_mesh2(2, 4)
+    assert mesh_lanes(m) == 2 and mesh_parts(m) == 4
+    # degrade: 4x16 > 8 devices -> lane axis collapses first
+    m2 = make_mesh2(4, 8)
+    assert mesh_lanes(m2) == 1 and mesh_parts(m2) == 8
+    # explicit devices + insufficient is a hard error (no silent grid)
+    import jax
+    with pytest.raises(ValueError):
+        make_mesh2(2, 8, devices=jax.devices()[:4])
+    # (1, 1): the single-device degrade still serves correct rows
+    m11 = make_mesh2(1, 1, devices=jax.devices()[:1])
+    assert mesh_lanes(m11) == 1 and mesh_parts(m11) == 1
+    rt11 = TpuRuntime(m11)
+    assert rt11.local_mode
+    st = store_p(4, seed=44)
+    rt_solo = TpuRuntime(make_mesh(1))
+    r11, s11 = rt11.traverse(st, "g", [1, 5], ["knows"], "out", 2)
+    rso, _ = rt_solo.traverse(st, "g", [1, 5], ["knows"], "out", 2)
+    assert go_key(r11) == go_key(rso)
+    assert s11.shards == 1 and s11.exchange_bytes == 0
+
+
+def test_runtime_on_two_axis_mesh_parity():
+    """A TpuRuntime on the full 2-axis (2 lanes x 4 parts) grid serves
+    the same rows as the single-chip oracle — the lane rows replicate
+    the CSR, the part columns shard it."""
+    st = store_p(4, seed=55)
+    rt_grid = TpuRuntime(make_mesh2(2, 4))
+    assert rt_grid.mesh_lanes == 2 and rt_grid.mesh_size == 4
+    rt_solo = TpuRuntime(make_mesh(1))
+    rg, sg = rt_grid.traverse(st, "g", [2, 3, 8], ["knows"], "out", 3)
+    rs, _ = rt_solo.traverse(st, "g", [2, 3, 8], ["knows"], "out", 3)
+    assert go_key(rg) == go_key(rs)
+    assert sg.shards == 4
+
+
+# -- per-shard HBM ledger + budget scale-out --------------------------------
+
+
+def test_shard_hbm_ledger_accounting():
+    """The per-shard ledger: shard_hbm_bytes() sums to hbm_bytes(), and
+    the tpu_shard_hbm_bytes{shard} gauges the pin emitted sum to the
+    tpu_hbm_bytes_pinned total with tpu_shards == mesh width."""
+    st = store_p(4, seed=66)
+    rt = TpuRuntime(make_mesh(4))
+    dev = rt.pin(st, "g")
+    per = dev.shard_hbm_bytes()
+    assert set(per) == {0, 1, 2, 3}
+    assert sum(per.values()) == dev.hbm_bytes()
+    snap = stats().snapshot()
+    assert snap.get("tpu_shards") == 4.0
+    gauges = [snap.get(f"tpu_shard_hbm_bytes{{shard={p}}}")
+              for p in range(4)]
+    assert all(g is not None for g in gauges)
+    assert sum(gauges) == float(snap.get("tpu_hbm_bytes_pinned"))
+    rt.unpin("g")
+
+
+def test_hbm_budget_is_per_device():
+    """The scale-out contract: with the per-DEVICE budget below the
+    snapshot total, the single-chip pin REFUSES while a 4-way sharded
+    pin accepts (each shard parks ~1/4 of the bytes) and serves rows
+    byte-identical to the host engine — a mesh provably holds a graph
+    the single chip cannot."""
+    st = store_p(4, seed=77, n=150, avg_deg=5)
+    rt_solo = TpuRuntime(make_mesh(1))
+    rt4 = TpuRuntime(make_mesh(4))
+    total = build_snapshot(st, "g").hbm_bytes()
+    get_config().set_dynamic("tpu_hbm_limit_bytes", total // 2)
+    try:
+        with pytest.raises(TpuUnavailable):
+            rt_solo.pin(st, "g")
+        dev = rt4.pin(st, "g")              # total/4 per device: fits
+        assert max(dev.shard_hbm_bytes().values()) <= total // 2
+        r4, s4 = rt4.traverse(st, "g", [1, 5, 9], ["knows"], "out", 3)
+        host = QueryEngine(st)
+        s = host.new_session()
+        host.execute(s, "USE g")
+        rs = host.execute(
+            s, "GO 3 STEPS FROM 1, 5, 9 OVER knows "
+               "YIELD src(edge), rank(edge), dst(edge)")
+        assert rs.error is None
+        assert len(r4) == len(rs.data.rows)
+        assert s4.shards == 4
+    finally:
+        get_config().set_dynamic("tpu_hbm_limit_bytes", 0)
+        rt4.unpin("g")
+
+
+def test_partition_mesh_mismatch_is_unavailable():
+    """A snapshot whose partition count differs from the mesh width
+    cannot be sharded across it: pin raises TpuUnavailable (the
+    executor host-falls-back) instead of mis-sharding."""
+    st = store_p(8, seed=88)
+    rt4 = TpuRuntime(make_mesh(4))
+    with pytest.raises(TpuUnavailable):
+        rt4.pin(st, "g")
+
+
+# -- batched lanes on a sharded mesh ----------------------------------------
+
+
+def test_sharded_batched_lanes_parity():
+    """Concurrent GO statements on a 4-part mesh form ONE lanes x
+    shards launch and every statement's rows equal its solo run —
+    PR 12's lane axis composed with the part axis."""
+    from nebula_tpu.tpu.batch import batch_former
+    from nebula_tpu.utils.workload import live_registry
+
+    st = store_p(4, seed=99, n=60)
+    rt = TpuRuntime(make_mesh(4))
+    eng = QueryEngine(st, tpu_runtime=rt)
+    q = "GO 2 STEPS FROM {seed} OVER knows YIELD dst(edge) AS d"
+
+    def run(seed, out):
+        s = eng.new_session()
+        eng.execute(s, "USE g")
+        rs = eng.execute(s, q.format(seed=seed))
+        out[seed] = rs
+
+    seeds = [1, 2, 3, 5]
+    truth = {}
+    for sd in seeds:
+        run(sd, truth)
+        assert truth[sd].error is None
+        truth[sd] = sorted(map(repr, truth[sd].data.rows))
+    batch_former().reset()
+    regs = [live_registry().register(qid=-(200 + i), session=0, user="t",
+                                     stmt="d", kind="Go")
+            for i in range(2)]
+    get_config().set_dynamic_many({"batch_max_lanes": 8,
+                                   "batch_wait_us": 300_000})
+    s0 = stats().snapshot()
+    try:
+        out, ths = {}, []
+        for sd in seeds:
+            t = threading.Thread(target=run, args=(sd, out), daemon=True)
+            t.start()
+            ths.append(t)
+        for t in ths:
+            t.join(60)
+        s1 = stats().snapshot()
+        for sd in seeds:
+            assert out[sd].error is None, out[sd].error
+            assert sorted(map(repr, out[sd].data.rows)) == truth[sd]
+        assert s1.get("tpu_batches_formed", 0) \
+            - s0.get("tpu_batches_formed", 0) >= 1
+        assert s1.get("tpu_all_to_all_bytes", 0) \
+            > s0.get("tpu_all_to_all_bytes", 0)
+    finally:
+        get_config().set_dynamic_many({"batch_max_lanes": 0,
+                                       "batch_wait_us": 1500})
+        for i in range(2):
+            live_registry().deregister(-(200 + i))
+        batch_former().reset()

@@ -392,7 +392,7 @@ def test_federation_label_injection_grammar():
 
 def _emitted_metric_names():
     call_pat = re.compile(
-        r'\.(?:inc|inc_labeled|observe|gauge|add_value)\(\s*'
+        r'\.(?:inc|inc_labeled|observe|gauge_labeled|gauge|add_value)\(\s*'
         r'["\']([A-Za-z_][A-Za-z0-9_.]*)["\']')
     slo_pat = re.compile(r'["\'](slo_burn_[a-z0-9_]+)["\']')
     names = set()

@@ -127,6 +127,49 @@ def test_metrics_dump_watch_deltas(capsys):
         ws.stop()
 
 
+def test_metrics_dump_shards_view(capsys):
+    """--shards (ISSUE 17): per-device HBM ledger rows, the
+    ledger-vs-pinned sum check and exchange bytes, scraped from the
+    prometheus exposition (quoted label values)."""
+    from nebula_tpu.cluster.webservice import WebService
+    from nebula_tpu.tools import metrics_dump
+    from nebula_tpu.utils.stats import stats
+
+    st = stats()
+    with st.lock:
+        # earlier sharded-runtime tests leave their own ledger rows in
+        # the process-global registry — start from a clean ledger
+        st.labeled_gauges.pop("tpu_shard_hbm_bytes", None)
+    st.gauge("tpu_shards", 4.0)
+    for p in range(4):
+        st.gauge_labeled("tpu_shard_hbm_bytes", {"shard": p},
+                         float(1000 + p))
+    st.gauge("tpu_hbm_bytes_pinned", float(sum(
+        1000 + p for p in range(4))))
+    st.inc("tpu_all_to_all_bytes", 2048)
+    a2a_total = int(st.snapshot().get("tpu_all_to_all_bytes", 0))
+    ws = WebService(role="graphd")
+    ws.start()
+    try:
+        rc = metrics_dump.main(["--addr", ws.addr, "--shards"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "mesh width: 4 shard(s)" in out
+        assert "shard 0" in out and "shard 3" in out
+        assert "hbm=1003" in out
+        assert "-> OK" in out and "MISMATCH" not in out
+        assert f"all_to_all exchanged: {a2a_total} bytes" in out
+
+        # a stale pinned total is called out, not silently summed over
+        st.gauge("tpu_hbm_bytes_pinned", 1.0)
+        rc = metrics_dump.main(["--addr", ws.addr, "--shards"])
+        assert rc == 0
+        assert "MISMATCH" in capsys.readouterr().out
+    finally:
+        ws.stop()
+        st.gauge("tpu_hbm_bytes_pinned", 0.0)
+
+
 def test_metrics_dump_perfetto_export(tmp_path, capsys):
     """--perfetto exports scraped trace trees + stall captures as
     Chrome trace-event JSON (ISSUE 9 satellite): one process track per

@@ -1,15 +1,25 @@
 #!/bin/sh
 # ci_lint.sh — the fast pre-merge drift gate (ISSUE 16 satellite).
 #
-# Runs ONLY the tests marked `lint`: the metric/span catalogue lints
-# (docs/OBSERVABILITY.md must bidirectionally match what the code
-# emits) and the statement-fingerprint goldens (the digest is a wire
-# contract — SHOW STATEMENTS federation and dashboards key on it).
-# Seconds, not minutes: suitable as a commit hook or the first CI
-# stage before the tier-1 suite.
+# Two stages, seconds not minutes — suitable as a commit hook or the
+# first CI stage before the tier-1 suite:
+#
+#   1. the tests marked `lint`: metric/span catalogue lints
+#      (docs/OBSERVABILITY.md must bidirectionally match what the code
+#      emits) and the statement-fingerprint goldens (the digest is a
+#      wire contract — SHOW STATEMENTS federation and dashboards key
+#      on it).
+#   2. the fast sharding-parity subset (ISSUE 17): a 2-part sharded GO
+#      must stay byte-identical to the single-chip runtime, and the
+#      two-axis mesh constructor must keep its degrade ladder — the
+#      two invariants every sharded-plane change can silently break.
 #
 #   tools/ci_lint.sh [extra pytest args...]
 set -e
 cd "$(dirname "$0")/.."
-exec env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
     python -m pytest tests/ -q -m lint -p no:cacheprovider "$@"
+exec env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+    python -m pytest -q -p no:cacheprovider \
+    "tests/unit/test_sharded.py::test_go_parity_sharded_vs_single_chip[2]" \
+    tests/unit/test_sharded.py::test_mesh2_grid_and_degrade "$@"

@@ -5,44 +5,49 @@
 #
 # r4 fixes: the r3 loop grepped for PLATFORM=tpu, which can never match
 # the axon tunnel's platform string ("axon") — successful probes were
-# logged as anonymous rc=0 lines and the loop never exited.  Now any
-# non-cpu platform counts as OK, the FULL probe stdout is logged, the
-# probe's own exit status is captured (not the log pipeline's), and a
-# lockfile (.tpu_in_use, created by bench.py around device runs) skips
-# probing while a bench run holds the chip (concurrent clients contend
-# for the single chip claim and can wedge the tunnel).
+# logged as anonymous rc=0 lines and the loop never exited.
 #
-# r4 continuation: auto-launch.  When a probe lands OK and the
-# .auto_bench flag file exists, the flag is consumed and a full-scale
-# bench.py launches immediately — a tunnel recovery is never wasted
-# waiting for a turn of the build loop (VERDICT r3 item 1: "the moment
-# a probe lands, run bench.py at full scale").
+# ISSUE 17 rewrite: the probe itself moved into
+# nebula_tpu/tools/probe_device.py — ONE bounded-timeout subprocess
+# probe shared with bench.py, emitting a structured JSON verdict
+# ({"probe_status": ok|no_devices|timeout|error, ...}) and a
+# script-friendly exit code (0=ok 2=no_devices 3=timeout 4=error).
+# This loop now branches on the EXIT CODE, not on stdout greps — the
+# class of "platform string never matches" wedges is gone, and the
+# same verdict lands verbatim in the bench multichip block.
+#
+# A lockfile (.tpu_in_use, created by bench.py around device runs)
+# skips probing while a bench run holds the chip; when a probe lands
+# OK and the .auto_bench flag file exists, the flag is consumed and a
+# full-scale bench.py launches immediately (r4 continuation: a tunnel
+# recovery is never wasted waiting for a turn of the build loop).
 LOG=/root/repo/.tpu_probe.log
 LOCK=/root/repo/.tpu_in_use
 FLAG=/root/repo/.auto_bench
-TMP=$(mktemp)
-trap 'rm -f "$TMP"' EXIT
 while true; do
   TS=$(date -u +"%Y-%m-%dT%H:%M:%SZ")
   if [ -e "$LOCK" ]; then
     echo "$TS probe SKIPPED (chip held by $(cat "$LOCK" 2>/dev/null))" >> "$LOG"
   else
-    timeout 150 python -c "import jax; d=jax.devices(); print('PLATFORM='+d[0].platform+' N='+str(len(d)))" > "$TMP" 2>&1
+    OUT=$(cd /root/repo && python -m nebula_tpu.tools.probe_device --timeout 150 2>/dev/null)
     RC=$?
-    OUT=$(grep -v "^WARNING" "$TMP" | tail -2 | tr '\n' ' ')
-    if [ $RC -eq 124 ] || [ $RC -eq 143 ]; then
-      echo "$TS probe TIMEOUT (150s) — tunnel wedged" >> "$LOG"
-    elif echo "$OUT" | grep -qE "PLATFORM=(tpu|axon)"; then
-      echo "$TS probe OK: $OUT" >> "$LOG"
-      if [ -e "$FLAG" ]; then
-        rm -f "$FLAG"
-        echo "$TS AUTO-LAUNCH full-scale bench.py" >> "$LOG"
-        (cd /root/repo && nohup python bench.py > bench_r5_tpu_auto.log 2>&1 &)
-        sleep 120   # let the bench take the chip lock before re-probing
-      fi
-    else
-      echo "$TS probe rc=$RC: $OUT" >> "$LOG"
-    fi
+    case $RC in
+      0)
+        echo "$TS probe OK: $OUT" >> "$LOG"
+        if [ -e "$FLAG" ]; then
+          rm -f "$FLAG"
+          echo "$TS AUTO-LAUNCH full-scale bench.py" >> "$LOG"
+          (cd /root/repo && nohup python bench.py > bench_r5_tpu_auto.log 2>&1 &)
+          sleep 120   # let the bench take the chip lock before re-probing
+        fi
+        ;;
+      3)
+        echo "$TS probe TIMEOUT (150s) — tunnel wedged: $OUT" >> "$LOG"
+        ;;
+      *)
+        echo "$TS probe rc=$RC: $OUT" >> "$LOG"
+        ;;
+    esac
   fi
   sleep 600
 done
