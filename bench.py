@@ -1184,6 +1184,30 @@ def main():
         read_scaleout = {"error": repr(ex)}
     _save_partial(platform, configs)
 
+    # ---- htap block (ISSUE 19): write storm + read storm A/B — the
+    # same sustained-write workload with the device-resident delta-CSR
+    # off (every fresh read pays a graph-sized re-export + re-pin) and
+    # on (commit groups append into the padded delta; reads merge
+    # base + delta each hop).  Headlines: read_goodput_on_over_off
+    # (>= 2.0 at comparable staleness — or comparable goodput at
+    # >= 5x lower fresh_read_lag_ms) and repin_avoided_share (> 0:
+    # the storm rode the delta, not the re-pin path).
+    _mark("config htap: write-storm + read-storm delta-CSR A/B")
+    try:
+        from nebula_tpu.tools.overload_bench import (
+            htap_sweep as _htap_sweep)
+        htap = _htap_sweep(
+            persons=int(os.environ.get("NEBULA_BENCH_HTAP_PERSONS",
+                                       900)),
+            writers=int(os.environ.get("NEBULA_BENCH_HTAP_WRITERS", 2)),
+            readers=int(os.environ.get("NEBULA_BENCH_HTAP_READERS", 6)),
+            duration_s=float(os.environ.get("NEBULA_BENCH_HTAP_SECS",
+                                            3.0)),
+            tpu_runtime=rt)
+    except Exception as ex:  # noqa: BLE001 — must not sink the run
+        htap = {"error": repr(ex)}
+    _save_partial(platform, configs)
+
     # ---- self_heal block (ISSUE 14): kill one of a part's three
     # replicas under live mixed load and measure the repair plane —
     # time_to_full_redundancy (kill → part map fully rf=3 on live
@@ -1414,6 +1438,7 @@ def main():
         "overload": overload,
         "batching": batching,
         "read_scaleout": read_scaleout,
+        "htap": htap,
         "self_heal": self_heal,
         "algo": algo_block,
         "multichip": multichip,
@@ -1455,6 +1480,15 @@ def main():
         # per statement with batching on (detail has the full A/B:
         # queue_wait_share off/on, goodput curve, lanes per batch)
         hl["batch_disp_per_stmt"] = batching["dispatches_per_stmt_on"]
+    if isinstance(htap, dict) and \
+            htap.get("read_goodput_on_over_off") is not None:
+        # ISSUE 19: device-resident delta-CSR — fresh-read goodput
+        # under a sustained write storm, delta on vs off (detail has
+        # the full A/B: staleness p50/p99, repin_avoided_share,
+        # compactions)
+        hl["htap_goodput_x"] = htap["read_goodput_on_over_off"]
+        hl["fresh_read_lag_ms"] = htap["fresh_read_lag_ms"]
+        hl["repin_avoided_share"] = htap["repin_avoided_share"]
     if isinstance(multichip, dict) and \
             multichip.get("speedup_Nshard_vs_1") is not None:
         # ISSUE 17: mesh-native sharded execution — N-shard vs 1-shard

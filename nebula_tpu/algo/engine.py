@@ -91,7 +91,14 @@ def _host_snapshot(qctx, space: str):
             "is not yet served") from None
     rt = getattr(qctx, "tpu_runtime", None)
     if rt is not None:
-        return rt.pin(store, space).host, sd
+        dev = rt.pin(store, space)
+        hd = dev.delta.host if dev.delta is not None else None
+        if hd is not None and (hd.total_edges() or hd.total_tombs()):
+            # algorithms read the BASE host CSR directly — pending delta
+            # edges live only in the mirror, so fold them in with a full
+            # re-pin before handing the adjacency out (ISSUE 19)
+            dev = rt.pin(store, space, force=True)
+        return dev.host, sd
     key = (space, getattr(sd, "uid", None) or id(sd))
     ent = _lru_get(_snap_cache, key)
     if ent is not None and ent[0] == sd.epoch:

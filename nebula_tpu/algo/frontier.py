@@ -30,7 +30,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from ..tpu.hop import _expand_block, _mark
+from ..tpu.hop import _expand_block, _mark, _merge_delta
 
 __all__ = ["expand_part", "top_down_step", "bottom_up_step",
            "sharded_level_step"]
@@ -53,12 +53,26 @@ def expand_part(block, fbm, pid, EB: int, P: int, vmax: int,
     src, dst, rk, eidx, ve, total, ovf = _expand_block(
         block["indptr"], block["nbr"], block["rank"], fbm, EB, P,
         pid, vmax_local=vmax, hub_dense=hub_dense)
+    dcap = 0
+    if not swap_ends and "d_src" in block:
+        # ISSUE 19: merge the device-resident delta plane (tombstone
+        # base slots, append live delta edges) before the predicate so
+        # fresh writes flow through the same filter.  Bottom-up never
+        # takes this path — the runtime disables direction-optimizing
+        # while a delta is live (the reverse adjacency has no delta).
+        dcap = block["d_src"].shape[-1]
+        src, dst, rk, eidx, ve, total = _merge_delta(
+            block, fbm, src, dst, rk, eidx, ve, total, P, pid,
+            block["nbr"].shape[-1])
     if pred is not None:
         ps, pd = (dst, src) if swap_ends else (src, dst)
         cols = {"_rank": rk, "_src": ps, "_dst": pd}
         for name in pred_cols:
             if not name.startswith("_"):
-                cols[name] = block["props"][name][eidx]
+                c = block["props"][name]
+                if dcap:
+                    c = jnp.concatenate([c, block["d_props"][name]])
+                cols[name] = c[eidx]
         keep = pred(cols) & ve
     else:
         keep = ve
@@ -78,13 +92,13 @@ def top_down_step(blocks_data, efbm, EB: int, P: int, vmax: int, pids,
     ovf = jnp.zeros((P,), bool)
     for bi in range(len(blocks_data)):
         b = blocks_data[bi]
+        # vmap the whole block dict: every leaf (indptr/nbr/rank/props
+        # and the d_* delta plane when present) has a leading part axis
         _s, dst, keep, total, ov = jax.vmap(
-            lambda ip, nb, rkk, prp, f, pd: expand_part(
-                {"indptr": ip, "nbr": nb, "rank": rkk,
-                 "props": prp}, f, pd, EB, P, vmax,
+            lambda blk, f, pd: expand_part(
+                blk, f, pd, EB, P, vmax,
                 pred=pred, pred_cols=pred_cols, hub_dense=hub_dense)
-        )(b["indptr"], b["nbr"], b["rank"],
-          b.get("props", {}), efbm, pids)
+        )(b, efbm, pids)
         ovf = ovf | ov
         edges = edges + total
         blk_marks = jax.vmap(
@@ -146,6 +160,11 @@ def sharded_level_step(blocks_data, efbm, EB: int, P: int, pid,
                "rank": b["rank"][0],
                "props": {n: v[0]
                          for n, v in b.get("props", {}).items()}}
+        if "d_src" in b:
+            for k in ("d_src", "d_dst", "d_rank", "d_valid", "d_tomb"):
+                blk[k] = b[k][0]
+            blk["d_props"] = {n: v[0]
+                              for n, v in b.get("d_props", {}).items()}
         _s, dst, keep, total, ov = expand_part(
             blk, efbm, pid, EB, P, vmax,
             pred=pred, pred_cols=pred_cols, hub_dense=hub_dense)

@@ -137,15 +137,46 @@ class DistributedStore:
         self._applied_floor: Dict[tuple, int] = {}
         import threading
         self._floor_lock = threading.Lock()
+        # device delta feed (ISSUE 19): dirty-key log per watched space.
+        # Keys are noted BEFORE the writes ship (a crash mid-send leaves
+        # a superset — harmless, apply re-reads per key); coverage
+        # against OTHER writers is proven at delta_records time by the
+        # storaged write census (writes_total vs writes_from this
+        # writer_id since the watch baseline)
+        self._delta_logs: Dict[str, Any] = {}
+        self._delta_baseline: Dict[str, Dict[int, tuple]] = {}
+        self._delta_lock = threading.Lock()
 
     def _token(self) -> List[Any]:
         return [self.writer_id, next(self._wseq)]
 
+    def _dnote(self, space: str, *keys) -> None:
+        """Record dirty identity keys on the space's delta log (no-op
+        unless a device snapshot is watching)."""
+        log = self._delta_logs.get(space)
+        if log is None:
+            return
+        with self._delta_lock:
+            for k in keys:
+                log.note(k)
+
+    def _dbreak(self, space: str) -> None:
+        log = self._delta_logs.get(space)
+        if log is not None:
+            log.note_break()
+
     def _note_applied(self, space: str, pid: int, reply: Any):
         """Record a write ack's applied index as the part's
-        read-your-writes floor."""
+        read-your-writes floor (and its post-apply store epoch on the
+        delta log, when one is watching — the group-commit ack path
+        that keeps the device delta plane's freshness accounting
+        current without extra RPCs)."""
         if not isinstance(reply, dict):
             return
+        log = self._delta_logs.get(space)
+        if log is not None and reply.get("epoch"):
+            with self._delta_lock:
+                log.note_epoch(pid, int(reply["epoch"]))
         idx = int(reply.get("applied") or 0)
         if idx <= 0:
             return
@@ -180,6 +211,7 @@ class DistributedStore:
         return self.catalog.get_space(name)
 
     def drop_space(self, name: str, if_exists=False):
+        self._dbreak(name)
         self.meta.drop_space(name, if_exists=if_exists)
         # floors are keyed by space NAME: a dropped-and-recreated space
         # starts a fresh raft log, so stale floors would make its first
@@ -200,6 +232,7 @@ class DistributedStore:
             if if_exists:
                 return
             raise
+        self._dbreak(name)
         for pid in range(len(self.meta.parts_of(name))):
             self._write(name, pid, ("clear_part", pid))
 
@@ -264,6 +297,7 @@ class DistributedStore:
             row = apply_defaults(sv, props, insert_names)
             by_part.setdefault(self.sc.part_of(space, vid), []).append(
                 ("vertex", vid, tag, sv.version, row))
+        self._dnote(space, *(("v", r[0]) for r in rows))
         self._write_many(space, by_part)
 
     def _chain_write(self, space: str, src: Any, dst: Any,
@@ -349,6 +383,8 @@ class DistributedStore:
             by_dst.setdefault(dst_pid, []).append(tuple(in_cmd))
             dones.setdefault(src_pid, []).append(
                 ("chain_done", src_pid, cid))
+        self._dnote(space, *(("e", etype, src, dst, rank)
+                             for src, dst, rank, _props in rows))
         # out-halves (with journals) first — the source of truth — then
         # the in-halves, then the retirements.  The failpoints bracket
         # the two crash windows a batched TOSS chain has: after the
@@ -367,20 +403,25 @@ class DistributedStore:
             for (s, et, rank, other, _props, sd) in self.get_neighbors(
                     space, [vid], None, "both"):
                 if sd > 0:      # out-edge vid→other; mirror in-half at other
+                    self._dnote(space, ("e", et, vid, other, rank))
                     self._write(space, self.sc.part_of(space, other),
                                 ("del_edge_half", vid, et, other, rank, "in"))
                 else:           # in-edge other→vid; mirror out-half at other
+                    self._dnote(space, ("e", et, other, vid, rank))
                     self._write(space, self.sc.part_of(space, other),
                                 ("del_edge_half", other, et, vid, rank,
                                  "out"))
+        self._dnote(space, ("v", vid))
         self._write(space, self.sc.part_of(space, vid), ("del_vertex", vid))
 
     def delete_tag(self, space: str, vid: Any, tags: List[str]):
+        self._dnote(space, ("v", vid))
         self._write(space, self.sc.part_of(space, vid),
                     ("del_tag", vid, tags))
 
     def delete_edge(self, space: str, src: Any, etype: str, dst: Any,
                     rank: int):
+        self._dnote(space, ("e", etype, src, dst, rank))
         self._chain_write(space, src, dst,
                           ("del_edge_half", src, etype, dst, rank, "out"),
                           ["del_edge_half", src, etype, dst, rank, "in"])
@@ -394,6 +435,7 @@ class DistributedStore:
         tv = self.get_vertex(space, vid)
         if tv is None or tag not in tv:
             return False
+        self._dnote(space, ("v", vid))
         self._write(space, self.sc.part_of(space, vid),
                     ("upd_vertex", vid, tag, updates))
         return True
@@ -406,6 +448,7 @@ class DistributedStore:
                 raise SchemaError(f"unknown prop `{k}'")
         if self.get_edge(space, src, etype, dst, rank) is None:
             return False
+        self._dnote(space, ("e", etype, src, dst, rank))
         self._chain_write(
             space, src, dst,
             ("upd_edge_half", src, etype, dst, rank, updates, "out"),
@@ -627,6 +670,85 @@ class DistributedStore:
                     for p in pids},
             "storage.rebuild_fulltext"))
 
+    # ---- device delta feed (ISSUE 19): dirty-key log over the write
+    # census.  The log alone can only vouch for writes THROUGH THIS
+    # STORE; coverage against other writers is proven per part by the
+    # storaged census — (writes_total − baseline) must equal
+    # (writes_from_me − baseline), else the keys are incomplete and
+    # the runtime full-rebuilds. ----
+
+    def _census_probe(self, space: str) -> Dict[int, tuple]:
+        """Per-part (epoch, writes_total, writes_from_me) fan-out."""
+        pids = self.sc.all_parts(space)
+        per = dict(self.sc.fanout(
+            space, {p: {"writer": self.writer_id} for p in pids},
+            "storage.part_stats"))
+        return {pid: (int(r.get("epoch", 0)),
+                      int(r.get("writes_total", 0)),
+                      int(r.get("writes_from", 0)))
+                for pid, r in per.items()}
+
+    def delta_watch(self, space: str, cap: int = 65536) -> int:
+        from ..graphstore.delta import DeltaLog
+        probe = self._census_probe(space)
+        epoch = max((e for e, _t, _m in probe.values()), default=0)
+        with self._delta_lock:
+            log = self._delta_logs.get(space)
+            if log is None or log.broken:
+                # an unbroken log keeps watching across re-watches
+                # (compaction rebuilds must not reset the floor or the
+                # census baseline out from under the serving snapshot)
+                self._delta_logs[space] = DeltaLog(floor_epoch=epoch,
+                                                  cap=cap)
+                self._delta_baseline[space] = {
+                    pid: (t, m) for pid, (_e, t, m) in probe.items()}
+        return epoch
+
+    def delta_records(self, space: str):
+        """-> (keys, target_epoch, floor_epoch), or None when the log
+        cannot vouch for completeness (never watched / broken / census
+        shows a foreign writer) — the caller full-rebuilds."""
+        log = self._delta_logs.get(space)
+        if log is None:
+            return None
+        try:
+            probe = self._census_probe(space)
+        except Exception:  # noqa: BLE001 — RPC trouble: rebuild decides
+            return None
+        base = self._delta_baseline.get(space) or {}
+        covered = set(probe) == set(base)
+        if covered:
+            for pid, (_e, t, m) in probe.items():
+                t0, m0 = base[pid]
+                if t < t0 or m < m0 or (t - t0) != (m - m0):
+                    covered = False     # foreign writes (or failover
+                    break               # census reset): keys incomplete
+        with self._delta_lock:
+            if log.broken:
+                return None
+            if not covered:
+                log.note_break()
+                return None
+            # keys snapshot AFTER the census probe: a write of ours
+            # landing in between adds a key (superset-safe) but not its
+            # epoch — applied_epoch lands below sd.epoch and the next
+            # pin probe catches up; a FOREIGN write in the window bumps
+            # the epoch past target, so the next probe re-runs this
+            # census and breaks.  Either way no stale read is served.
+            keys = list(log.keys)
+            floor = log.floor_epoch
+        target = max((e for e, _t, _m in probe.values()), default=0)
+        return keys, target, floor
+
+    def delta_trim(self, space: str, keys) -> None:
+        with self._delta_lock:
+            log = self._delta_logs.get(space)
+            if log is not None:
+                log.trim(keys)
+
+    def delta_reader(self, space: str):
+        return _ClusterDeltaReader(self, space)
+
     # ---- device plane: bulk CSR export (the north-star storage
     # addition; SURVEY §2 row 12 + BASELINE.json) ----
 
@@ -673,11 +795,17 @@ class DistributedStore:
             def space(self, _name):
                 return self._sd
 
-        snap = build_snapshot(_Shim(self.meta.catalog, sd), space)
+        from ..utils.config import get_config
+        dflag = int(get_config().get("tpu_delta_max_edges") or 0)
+        snap = build_snapshot(
+            _Shim(self.meta.catalog, sd), space,
+            vmax_extra=(int(get_config().get("tpu_delta_vmax_slack"))
+                        if dflag > 0 else 0))
         # the space view serves dense-id lookups from this export (the
-        # device data plane's vid dictionary)
+        # device data plane's vid dictionary); part_counts ride along so
+        # the delta reader can mint dense ids for post-export vids
         self._dense_cache[space] = (sd.epoch, sd.vid_to_dense,
-                                    sd.dense_to_vid)
+                                    sd.dense_to_vid, sd.part_counts)
         return snap
 
     def stats_detail(self, space: str) -> Dict[str, Dict[str, int]]:
@@ -750,3 +878,59 @@ class _SpaceView:
         if 0 <= dense < len(d2v):
             return d2v[dense]
         return None
+
+
+class _ClusterDeltaReader:
+    """Re-read adapter over the cluster for HostDelta.apply: identity
+    keys resolve through leader-consistency point reads (get_vertex /
+    get_edge RPCs), so the mirror folds in exactly the committed state.
+
+    Dense ids come from the last CSR export's dictionary; a vid minted
+    since then gets the next local row of its part — self-consistent
+    within the pinned snapshot, which is all the mirror needs (the next
+    full rebuild re-derives the authoritative mapping).  A mint for a
+    phantom key (edge inserted and deleted between applies) wastes one
+    vmax-slack row at worst; overflow degrades to a rebuild."""
+
+    def __init__(self, ds: DistributedStore, space: str):
+        cache = ds._dense_cache.get(space)
+        if cache is None or len(cache) < 4:
+            from ..graphstore.delta import DeltaUnsupported
+            raise DeltaUnsupported("no CSR export to map dense ids from")
+        self.ds = ds
+        self.space = space
+        self._v2d = cache[1]
+        self._d2v = cache[2]
+        self._counts = cache[3]
+        self._P = len(ds.meta.parts_of(space))
+
+    def dense_of(self, vid) -> Optional[int]:
+        d = self._v2d.get(vid)
+        if d is not None:
+            return int(d)
+        p = stable_vid_hash(vid) % self._P
+        d = self._counts[p] * self._P + p
+        self._counts[p] += 1
+        self._v2d[vid] = d
+        need = d + 1 - len(self._d2v)
+        if need > 0:
+            self._d2v.extend([None] * need)
+        self._d2v[d] = vid
+        return d
+
+    def edge_row(self, etype, src, dst, rank):
+        try:
+            sv = self.ds.catalog.get_edge(self.space, etype).latest
+        except SchemaError:
+            return None, None           # dropped edge type: invisible
+        row = self.ds.get_edge(self.space, src, etype, dst, rank)
+        return row, sv
+
+    def vertex_rows(self, vid) -> Dict[str, Dict[str, Any]]:
+        return self.ds.get_vertex(self.space, vid) or {}
+
+    def tag_schema(self, tag):
+        try:
+            return self.ds.catalog.get_tag(self.space, tag).latest
+        except SchemaError:
+            return None

@@ -177,6 +177,16 @@ class StorageService:
         # that did not actually land.  Bounded: a timed-out propose
         # never claims its error (see BoundedErrorMap).
         self._apply_errors = BoundedErrorMap()
+        # per-part write census (device delta feed): applied raft
+        # entries counted per writer token.  A graphd's delta log can
+        # only trust its dirty keys if EVERY write since its watch came
+        # through it — rpc_part_stats ships (total, from-you) counts so
+        # the client proves exactly that before skipping a re-pin.
+        # Counts are apply-side (replayed on restart, replica-local);
+        # a snapshot-install or failover skews them only toward
+        # MISmatch, which degrades to a full rebuild — never staleness.
+        self._write_census: Dict[Tuple[str, int], Dict[Any, int]] = {}
+        self._census_lock = threading.Lock()
         self._read_bucket = _ReadBucket()
         # per-partition heat map (ISSUE 16): read/write QPS + latency
         # EWMAs per (space, part), snapshotted onto the heartbeat so
@@ -264,7 +274,7 @@ class StorageService:
                     part = RaftPart(
                         gname, self.my_addr, list(replicas), self.transport,
                         os.path.join(self.data_dir, "wal"),
-                        apply_cb=self._make_apply(space_name, gname),
+                        apply_cb=self._make_apply(space_name, pid, gname),
                         # part state IS the raft snapshot: bounds WAL
                         # replay on restart + serves laggard catch-up
                         snapshot_cb=self._make_snapshot(space_name, pid),
@@ -309,7 +319,7 @@ class StorageService:
                 self.store.install_part_state(space_name, pid, data)
         return restore
 
-    def _make_apply(self, space_name: str, group: str):
+    def _make_apply(self, space_name: str, pid: int, group: str):
         def apply(idx: int, data: bytes):
             # entries are wire-JSON (peers can inject raft traffic; an
             # unpickler here would be remote code execution).  A bad
@@ -318,6 +328,7 @@ class StorageService:
             # leader's rpc_write can refuse to ack it.  Commands are
             # deterministic, so replicas fail identically — no
             # divergence from skipping.
+            writer = None
             try:
                 cmd = tuple(wire.loads(data))
                 if cmd and cmd[0] == "v":
@@ -330,11 +341,25 @@ class StorageService:
                         except Exception:  # noqa: BLE001
                             pass
                     cmd = tuple(cmd[2])
+                if cmd and cmd[0] == "dbatch":
+                    writer = cmd[2]
                 self._apply_cmd(space_name, cmd)
             except Exception as ex:      # noqa: BLE001
                 from ..utils.stats import stats
                 stats().inc("storage_apply_errors")
                 self._apply_errors.record((group, idx), str(ex))
+            finally:
+                # census counts EVERY entry, applied or failed, dedup-
+                # skipped or not — symmetry is what matters: the client
+                # compares (total - baseline) against (mine - baseline),
+                # so any uniform counting rule works, and over-breaking
+                # only costs a rebuild
+                with self._census_lock:
+                    c = self._write_census.setdefault(
+                        (space_name, pid), {"total": 0})
+                    c["total"] += 1
+                    if writer is not None:
+                        c[writer] = c.get(writer, 0) + 1
         return apply
 
     def _apply_cmd(self, space: str, cmd: Tuple):
@@ -674,7 +699,8 @@ class StorageService:
                 # so last_applied covers it — the caller's per-part
                 # read-your-writes floor even on the dedup-retry path
                 return {"n": rec.get("n", len(p["cmds"])),
-                        "applied": part.applied_index()}
+                        "applied": part.applied_index(),
+                        "epoch": self.store.space(space).epoch}
             stamped = [wire.dumps(
                 ("v", ver, ["dbatch", pid, writer, seq,
                             [list(_validate_cmd(c)) for c in p["cmds"]]]))]
@@ -709,8 +735,11 @@ class StorageService:
                               if len(errs) > 1 else ""))
         # the ack carries the write's raft index (propose_batch applies
         # before returning): clients record it as the part's
-        # read-your-writes floor for follower/bounded_stale reads
-        return {"n": len(p["cmds"]), "applied": idxs[-1]}
+        # read-your-writes floor for follower/bounded_stale reads —
+        # plus the post-apply store epoch, the group-commit ack path
+        # that feeds the device delta plane's freshness accounting
+        return {"n": len(p["cmds"]), "applied": idxs[-1],
+                "epoch": self.store.space(space).epoch}
 
     # -- read RPCs (consistency-gated via _read_part) --------------------
 
@@ -899,6 +928,16 @@ class StorageService:
         part = sd.parts[pid]
         out = {"vertices": len(part.vertices),
                "edges": part.edge_count(), "epoch": sd.epoch}
+        if "writer" in p:
+            # delta-feed coverage probe: how many raft entries has this
+            # part applied in total, and how many carried the asking
+            # writer's token — equality of the two deltas since a
+            # baseline proves no foreign writes slipped past the
+            # asker's dirty-key log
+            with self._census_lock:
+                c = self._write_census.get((p["space"], pid)) or {}
+                out["writes_total"] = c.get("total", 0)
+                out["writes_from"] = c.get(p["writer"], 0)
         if p.get("detail"):
             out["detail"] = self.store.stats_detail(p["space"],
                                                     parts=[pid])

@@ -298,17 +298,20 @@ def build_snapshot(store: GraphStore, space: str,
                    tags: Optional[List[str]] = None,
                    directions: Tuple[str, ...] = ("out", "in"),
                    edge_props: Optional[Dict[str, List[str]]] = None,
-                   tag_props: Optional[Dict[str, List[str]]] = None) -> CsrSnapshot:
+                   tag_props: Optional[Dict[str, List[str]]] = None,
+                   vmax_extra: int = 0) -> CsrSnapshot:
     """Export a space into a CsrSnapshot (numpy; device transfer in tpu/).
 
     edge_props / tag_props restrict which property columns are exported
-    (None = all): the HBM-budget knob.
+    (None = all): the HBM-budget knob.  vmax_extra reserves extra padded
+    local rows (ISSUE 19: the delta plane places freshly inserted
+    vertices into the slack instead of forcing a full re-pin).
     """
     sd: SpaceData = store.space(space)
     with sd.lock:
         P = sd.num_parts
         vmax = max(sd.part_counts) if sd.part_counts else 0
-        vmax = max(vmax, 1)
+        vmax = max(vmax, 1) + max(int(vmax_extra), 0)
         snap = CsrSnapshot(space=space, epoch=sd.epoch, num_parts=P, vmax=vmax,
                            num_vertices=np.asarray(sd.part_counts, np.int32),
                            dense_to_vid=list(sd.dense_to_vid))

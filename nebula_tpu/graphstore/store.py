@@ -160,6 +160,24 @@ class SpaceData:
             self.dense_to_vid[d] = v
 
 
+def _dnote(sd: "SpaceData", key: tuple) -> None:
+    """Record a dirty key on the space's device delta log, when one is
+    watching (ISSUE 19).  Keys carry identity only — the apply step
+    re-reads authoritative rows — so every write path's hook is one
+    line beside its epoch bump, under the same sd.lock."""
+    log = getattr(sd, "delta_log", None)
+    if log is not None:
+        log.note(key)
+
+
+def _dbreak(sd: "SpaceData") -> None:
+    """Mark the delta log broken: dense-id layout changed (REPARTITION,
+    part install/clear) — the next device pin must full-rebuild."""
+    log = getattr(sd, "delta_log", None)
+    if log is not None:
+        log.note_break()
+
+
 class StoreError(Exception):
     pass
 
@@ -319,6 +337,7 @@ class GraphStore:
             sd.index_data = {}
             sd.ft_data = {}
             sd.epoch += 1
+            _dbreak(sd)
         # derived state: rebuild every index against the new layout
         for d in self.catalog.indexes(name):
             self.rebuild_index(name, d.name)
@@ -350,6 +369,46 @@ class GraphStore:
         if sd is None:
             sd = self.data[sp.space_id] = SpaceData(sp)
         return sd
+
+    # ---- device delta feed (ISSUE 19) ----
+    # The TpuRuntime attaches a dirty-key log BEFORE exporting a
+    # snapshot; every write path notes its key under sd.lock, so a key
+    # recorded after the watch but before the export is merely re-read
+    # at apply time (idempotent) — no lost-write window.
+
+    def delta_watch(self, space: str, cap: int = 65536) -> int:
+        from .delta import DeltaLog
+        sd = self.space(space)
+        with sd.lock:
+            log = getattr(sd, "delta_log", None)
+            if log is None or log.broken:
+                # an unbroken log keeps watching across re-watches: a
+                # compaction build must not reset the floor (or drop
+                # keys) out from under the still-serving snapshot —
+                # stale keys are harmless, apply re-reads per key
+                sd.delta_log = DeltaLog(floor_epoch=sd.epoch, cap=cap)
+            return sd.epoch
+
+    def delta_records(self, space: str):
+        """-> (dirty keys, target epoch, log floor epoch), or None when
+        no log is watching / the log broke (caller full-rebuilds)."""
+        sd = self.space(space)
+        with sd.lock:
+            log = getattr(sd, "delta_log", None)
+            if log is None or log.broken:
+                return None
+            return list(log.keys), sd.epoch, log.floor_epoch
+
+    def delta_trim(self, space: str, keys) -> None:
+        sd = self.space(space)
+        with sd.lock:
+            log = getattr(sd, "delta_log", None)
+            if log is not None:
+                log.trim(keys)
+
+    def delta_reader(self, space: str):
+        from .delta import LocalStoreReader
+        return LocalStoreReader(self, space)
 
     # ---- secondary index maintenance (SURVEY §2 row 15) ----
     # Hooks called from every write path (rich and raw-apply) so cluster
@@ -648,6 +707,7 @@ class GraphStore:
             self._index_vertex(sd, space, vid, tag,
                                old[1] if old else None, row)
             sd.epoch += 1
+            _dnote(sd, ("v", vid))
             self._log("vertex", space, vid, tag, sv.version, row)
 
     def insert_edge(self, space: str, src: Any, etype: str, dst: Any,
@@ -670,6 +730,7 @@ class GraphStore:
             pi.in_edges.setdefault(dst, {}).setdefault(etype, {})[(rank, src)] = row
             self._index_edge(sd, space, src, etype, dst, rank, old, row)
             sd.epoch += 1
+            _dnote(sd, ("e", etype, src, dst, rank))
             self._log("edge_pair", space, src, etype, dst, rank, row)
 
     def delete_vertex(self, space: str, vid: Any, with_edges: bool = True):
@@ -688,6 +749,7 @@ class GraphStore:
                         pd.in_edges.get(dst, {}).get(etype, {}).pop((rank, vid), None)
                         self._index_edge(sd, space, vid, etype, dst, rank,
                                          row, None)
+                        _dnote(sd, ("e", etype, vid, dst, rank))
                 inn = p.in_edges.pop(vid, {})
                 for etype, em in inn.items():
                     for (rank, src) in list(em):
@@ -697,7 +759,9 @@ class GraphStore:
                         if row is not None:
                             self._index_edge(sd, space, src, etype, vid,
                                              rank, row, None)
+                        _dnote(sd, ("e", etype, src, vid, rank))
             sd.epoch += 1
+            _dnote(sd, ("v", vid))
             self._log("del_vertex_rich", space, vid, with_edges)
 
     def delete_tag(self, space: str, vid: Any, tags: List[str]):
@@ -713,6 +777,7 @@ class GraphStore:
                 if not tv:
                     p.vertices.pop(vid, None)
             sd.epoch += 1
+            _dnote(sd, ("v", vid))
             self._log("del_tag", space, vid, tags)
 
     def delete_edge(self, space: str, src: Any, etype: str, dst: Any, rank: int):
@@ -725,6 +790,7 @@ class GraphStore:
             if old is not None:
                 self._index_edge(sd, space, src, etype, dst, rank, old, None)
             sd.epoch += 1
+            _dnote(sd, ("e", etype, src, dst, rank))
             self._log("del_edge", space, src, etype, dst, rank)
 
     def update_vertex(self, space: str, vid: Any, tag: str,
@@ -744,6 +810,7 @@ class GraphStore:
             row.update(updates)
             self._index_vertex(sd, space, vid, tag, old, row)
             sd.epoch += 1
+            _dnote(sd, ("v", vid))
             self._log("upd_vertex", space, vid, tag, updates)
             return True
 
@@ -767,6 +834,7 @@ class GraphStore:
             if irow is not None:
                 irow.update({k: row[k] for k in updates})
             sd.epoch += 1
+            _dnote(sd, ("e", etype, src, dst, rank))
             self._log("upd_edge_pair", space, src, etype, dst, rank,
                       updates)
             return True
@@ -788,6 +856,7 @@ class GraphStore:
             self._index_vertex(sd, space, vid, tag,
                                old[1] if old else None, row)
             sd.epoch += 1
+            _dnote(sd, ("v", vid))
 
     def apply_edge_half(self, space: str, src: Any, etype: str, dst: Any,
                         rank: int, row: Dict[str, Any], which: str):
@@ -806,6 +875,7 @@ class GraphStore:
                 p.in_edges.setdefault(dst, {}).setdefault(etype, {})[
                     (rank, src)] = dict(row)
             sd.epoch += 1
+            _dnote(sd, ("e", etype, src, dst, rank))
 
     def apply_delete_vertex(self, space: str, vid: Any):
         """Remove the vertex row + its own adjacency planes (the caller
@@ -823,8 +893,14 @@ class GraphStore:
                     for (rank, dst), row in em.items():
                         self._index_edge(sd, space, vid, etype, dst, rank,
                                          row, None)
-            p.in_edges.pop(vid, None)
+                        _dnote(sd, ("e", etype, vid, dst, rank))
+            inn = p.in_edges.pop(vid, None)
+            if inn:
+                for etype, em in inn.items():
+                    for (rank, src) in em:
+                        _dnote(sd, ("e", etype, src, vid, rank))
             sd.epoch += 1
+            _dnote(sd, ("v", vid))
 
     def apply_delete_edge_half(self, space: str, src: Any, etype: str,
                                dst: Any, rank: int, which: str):
@@ -841,6 +917,7 @@ class GraphStore:
                 p = sd.parts[sd.part_of(dst)]
                 p.in_edges.get(dst, {}).get(etype, {}).pop((rank, src), None)
             sd.epoch += 1
+            _dnote(sd, ("e", etype, src, dst, rank))
 
     def apply_update_vertex(self, space: str, vid: Any, tag: str,
                             updates: Dict[str, Any]) -> bool:
@@ -853,6 +930,7 @@ class GraphStore:
             tv[1].update(updates)
             self._index_vertex(sd, space, vid, tag, old, tv[1])
             sd.epoch += 1
+            _dnote(sd, ("v", vid))
             return True
 
     def apply_update_edge_half(self, space: str, src: Any, etype: str,
@@ -873,6 +951,7 @@ class GraphStore:
             if which == "out":
                 self._index_edge(sd, space, src, etype, dst, rank, old, row)
             sd.epoch += 1
+            _dnote(sd, ("e", etype, src, dst, rank))
             return True
 
     def apply_chain_mark(self, space: str, pid: int, chain_id: str,
@@ -969,6 +1048,7 @@ class GraphStore:
             sd.part_counts[pid] = st["part_count"]
             sd.install_dense(st["dense"])
             sd.epoch += 1
+            _dbreak(sd)
         # indexes are derived state: rebuild this part's slices
         for d in self.catalog.indexes(space):
             self.rebuild_index(space, d.name, parts=[pid])
@@ -996,6 +1076,7 @@ class GraphStore:
                     del sd.vid_to_dense[v]
                     sd.dense_to_vid[d] = None
             sd.epoch += 1
+            _dbreak(sd)
         for d in self.catalog.indexes(space):
             self.rebuild_index(space, d.name, parts=[pid])
         for d in self.catalog.fulltext_indexes(space):

@@ -36,6 +36,11 @@ indented span tree, and diff counters over time.
     python -m nebula_tpu.tools.metrics_dump --addrs <graphd-ws>,... \
         --shards [--watch 5]
 
+    # delta-CSR plane (ISSUE 19): per-shard delta fill, repin-avoided
+    # share and recent compaction swaps, per host and cluster-merged
+    python -m nebula_tpu.tools.metrics_dump --addrs <graphd-ws>,... \
+        --deltas [--watch 5]
+
     # Perfetto: every trace tree (+ stall captures) as Chrome
     # trace-event JSON, one track per daemon/service, device spans
     # included — open the file at https://ui.perfetto.dev
@@ -359,6 +364,94 @@ def _scrape_shard_view(addrs: List[str], path: str = "/metrics"
             _shard_filter(merged))
 
 
+# -- delta-CSR view (ISSUE 19) ----------------------------------------------
+
+_DELTA_SHARD_PAT = re.compile(r'^tpu_shard_delta_edges\{shard="?(\d+)"?\}$')
+_DELTA_KEYS = ("tpu_delta_edges", "tpu_delta_bytes", "tpu_compactions",
+               "tpu_repin_avoided", "tpu_pins", "tpu_batch_gate_rearms")
+
+
+def _is_delta_sample(name: str) -> bool:
+    return name in _DELTA_KEYS or bool(_DELTA_SHARD_PAT.match(name))
+
+
+def _delta_filter(samples: Dict[str, float]) -> Dict[str, float]:
+    return {k: v for k, v in samples.items() if _is_delta_sample(k)}
+
+
+def _print_delta_rows(samples: Dict[str, float]):
+    per_shard = {int(m.group(1)): v for k, v in samples.items()
+                 for m in [_DELTA_SHARD_PAT.match(k)] if m}
+    edges = samples.get("tpu_delta_edges", 0.0)
+    nbytes = samples.get("tpu_delta_bytes", 0.0)
+    avoided = samples.get("tpu_repin_avoided", 0.0)
+    pins = samples.get("tpu_pins", 0.0)
+    comps = samples.get("tpu_compactions", 0.0)
+    rearms = samples.get("tpu_batch_gate_rearms", 0.0)
+    print(f"  delta plane: {int(edges)} rows, {int(nbytes)} bytes "
+          f"resident")
+    worst = max(per_shard.values()) if per_shard else 0.0
+    for pn in sorted(per_shard):
+        bar = "#" * int(30 * per_shard[pn] / worst) if worst else ""
+        print(f"  shard {pn:<3} delta_rows={int(per_shard[pn]):<8} "
+              f"{bar}")
+    share = avoided / (avoided + pins) if (avoided + pins) else 0.0
+    print(f"  repins avoided: {int(avoided)} vs pins {int(pins)} "
+          f"({share:.1%} of epoch advances rode the delta)")
+    print(f"  compactions: {int(comps)}   "
+          f"forming-window gate re-arms: {int(rearms)}")
+
+
+def _compaction_history(addr: str) -> List[str]:
+    """tpu:compaction spans from the host's trace ring — the recent
+    swap history (space + duration), newest first."""
+    rows: List[str] = []
+    try:
+        for t in _collect_traces(addr):
+            for sp in t.get("spans", []):
+                if sp.get("name") != "tpu:compaction":
+                    continue
+                attrs = sp.get("attrs") or {}
+                rows.append(f"    space={attrs.get('space', '?')} "
+                            f"dur={int(sp.get('dur_us', 0))}us")
+    except Exception:  # noqa: BLE001 — tracing may be disabled
+        pass
+    return rows[:10]
+
+
+def dump_deltas(addrs: List[str], path: str = "/metrics") -> int:
+    """Delta-CSR residency view (ISSUE 19): per-shard delta fill
+    (`tpu_shard_delta_edges{shard}`), total delta rows/bytes, the
+    repin-avoided share, compaction count and recent `tpu:compaction`
+    swap history — per host plus one cluster-merged section.  Combine
+    with --watch for apply/compaction deltas per interval."""
+    per_host, merged = scrape_cluster(addrs, path)
+    n = 0
+    for addr in sorted(per_host):
+        samples = _delta_filter(per_host[addr])
+        print(f"== {addr} ({len(samples)} delta samples)")
+        if samples:
+            _print_delta_rows(samples)
+            n += len(samples)
+        hist = _compaction_history(addr)
+        if hist:
+            print("  recent compactions:")
+            for row in hist:
+                print(row)
+    if len(per_host) > 1:
+        print(f"== merged ({len(per_host)}/{len(addrs)} hosts)")
+        _print_delta_rows(_delta_filter(merged))
+    return n
+
+
+def _scrape_delta_view(addrs: List[str], path: str = "/metrics"
+                       ) -> Tuple[Dict[str, Dict[str, float]],
+                                  Dict[str, float]]:
+    per_host, merged = scrape_cluster(addrs, path)
+    return ({a: _delta_filter(s) for a, s in per_host.items()},
+            _delta_filter(merged))
+
+
 def dump_trace_list(addr: str) -> int:
     traces = json.loads(_fetch(addr, "/traces"))
     for t in traces:
@@ -587,6 +680,12 @@ def main(argv=None) -> int:
                          "per-device HBM ledger + frontier-exchange "
                          "bytes per host and merged; combine with "
                          "--watch for exchange deltas")
+    ap.add_argument("--deltas", action="store_true",
+                    help="delta-CSR view (ISSUE 19): per-shard delta "
+                         "fill, repin-avoided share and recent "
+                         "compaction swaps per host and merged; "
+                         "combine with --watch for apply/compaction "
+                         "deltas")
     ap.add_argument("--stall-id", default="",
                     help="print one stall capture in full (thread "
                          "stacks, dispatch table, kernel ledger)")
@@ -637,6 +736,14 @@ def main(argv=None) -> int:
                                   addrs, args.path))
             else:
                 dump_shards(addrs, args.path)
+        elif args.deltas:
+            if args.watch > 0:
+                watch_cluster(addrs, args.watch, args.grep,
+                              args.iterations,
+                              scrape_fn=lambda: _scrape_delta_view(
+                                  addrs, args.path))
+            else:
+                dump_deltas(addrs, args.path)
         elif args.hotspots:
             if args.watch > 0:
                 watch_cluster(addrs, args.watch, args.grep,

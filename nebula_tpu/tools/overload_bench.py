@@ -732,6 +732,237 @@ def read_scaleout_sweep(persons: int = 1000, degree: int = 5,
             _shutil.rmtree(tmp, ignore_errors=True)
 
 
+# -- HTAP sweep (ISSUE 19): write storm + read storm A/B --------------------
+
+
+def _htap_write_worker(cluster, space: str, stop: threading.Event,
+                       wid: int, persons: int, res: _LevelResult):
+    """Closed-loop writer: a stream of NEW edges (fresh ranks) through
+    the graphd's group-commit path — the sustained write storm the
+    delta plane exists to absorb."""
+    cl = cluster.client()
+    cl.execute(f"USE {space}")
+    j = 0
+    try:
+        while not stop.is_set():
+            s = (wid * 577 + j * 31) % persons
+            d = (s + 7 + j) % persons
+            r = cl.execute(f"INSERT EDGE KNOWS(w) VALUES "
+                           f"{s}->{d}@{10_000 + wid * 100_000 + j}:"
+                           f"({j % 100})")
+            with res.lock:
+                if r.error is None:
+                    res.ok += 1
+                else:
+                    res.errors.append(r.error)
+            j += 1
+    finally:
+        cl.close()
+
+
+def _htap_read_worker(cluster, space: str, stop: threading.Event,
+                      wid: int, persons: int, res: _LevelResult):
+    """Closed-loop reader under the write storm: small device-shaped
+    GOs; latency lands in res.lats (its p99 is the equal-staleness
+    goodput comparison's denominator)."""
+    cl = cluster.client()
+    cl.execute(f"USE {space}")
+    j = 0
+    try:
+        while not stop.is_set():
+            seed = (wid * 131 + j * 17) % persons
+            t0 = time.perf_counter()
+            r = cl.execute(f"GO FROM {seed} OVER KNOWS "
+                           f"YIELD dst(edge) AS d")
+            dt = time.perf_counter() - t0
+            with res.lock:
+                if r.error is None:
+                    res.ok += 1
+                    res.lats.append(dt)
+                else:
+                    res.errors.append(r.error)
+            j += 1
+    finally:
+        cl.close()
+
+
+def _htap_staleness_probe(cluster, space: str, stop: threading.Event,
+                          persons: int, lags: List[float],
+                          errors: List[str]):
+    """Ack-to-visible staleness: insert a marker edge to a brand-new
+    dst vid, then poll a 1-hop GO from its src until the marker shows.
+    The lag is ack -> first read that RETURNS the row — exactly the
+    read-your-writes floor a fresh-read client experiences."""
+    cl = cluster.client()
+    cl.execute(f"USE {space}")
+    k = 0
+    try:
+        while not stop.is_set():
+            src = (37 * k) % persons
+            marker = persons + 100_000 + k     # vid no other writer uses
+            r = cl.execute(f"INSERT EDGE KNOWS(w) VALUES "
+                           f"{src}->{marker}:(1)")
+            if r.error is not None:
+                errors.append(r.error)
+                time.sleep(0.05)
+                continue
+            t_ack = time.perf_counter()
+            while not stop.is_set():
+                rr = cl.execute(f"GO FROM {src} OVER KNOWS "
+                                f"YIELD dst(edge) AS d")
+                if rr.error is None and any(
+                        row[0] == marker for row in rr.data.rows):
+                    lags.append(time.perf_counter() - t_ack)
+                    break
+            k += 1
+            time.sleep(0.02)
+    finally:
+        cl.close()
+
+
+def htap_sweep(persons: int = 900, degree: int = 4, writers: int = 2,
+               readers: int = 6, duration_s: float = 3.0,
+               delta_cap: int = 2048, tpu_runtime=None,
+               data_dir: Optional[str] = None) -> dict:
+    """Mixed write-storm + read-storm A/B (ISSUE 19 acceptance): the
+    SAME sustained-write workload against the device plane with the
+    delta-CSR OFF (`tpu_delta_max_edges=0` — every fresh read pays a
+    graph-sized re-export + re-pin) and ON (write batches append into
+    the device-resident delta; reads merge base + delta each hop).
+    Per mode:
+
+      read_goodput_qps   fresh reads served per second under the storm
+      fresh_read_lag_ms  ack-to-visible staleness p50/p99 — insert a
+                         marker edge, poll until a GO returns it
+      write_qps          acked write statements per second
+      repins / repin_avoided / compactions   Δ device-plane counters
+
+    Headlines for bench.py's `htap` block: `read_goodput_on_over_off`
+    (bar: >= 2.0 at comparable staleness — or comparable goodput at
+    >= 5x lower `fresh_read_lag_ms`), and `repin_avoided_share` (> 0
+    proves the storm rode the delta, not the re-pin path)."""
+    from nebula_tpu.cluster.launcher import LocalCluster
+    from nebula_tpu.utils.config import get_config
+    from nebula_tpu.utils.stats import stats
+
+    if tpu_runtime is None:
+        try:
+            from nebula_tpu.tpu import TpuRuntime, make_mesh
+            tpu_runtime = TpuRuntime(make_mesh(1))
+        except Exception as ex:  # noqa: BLE001 — no jax/device
+            return {"error": f"no device runtime: {ex!r}"}
+
+    cfg = get_config()
+    tmp = data_dir or tempfile.mkdtemp(prefix="nebula_htap_")
+    dyn_keys = ("tpu_delta_max_edges", "query_timeout_secs")
+    out_modes: Dict[str, dict] = {}
+    modes = {"rebuild": 0, "delta": delta_cap}
+    try:
+        cfg.set_dynamic("query_timeout_secs", max(duration_s * 8, 20.0))
+        for mode, cap in modes.items():
+            cfg.set_dynamic("tpu_delta_max_edges", cap)
+            # one cluster per mode: both arms start from an identical
+            # seeded space (the storm grows the graph, so sharing one
+            # space would bias the second arm)
+            cluster = LocalCluster(n_meta=1, n_storage=2, n_graph=1,
+                                   data_dir=f"{tmp}/{mode}",
+                                   tpu_runtime=tpu_runtime)
+            try:
+                _seed_graph(cluster, "htap", persons, degree,
+                            replica_factor=1, rng_seed=53)
+                warm = cluster.client()
+                warm.execute("USE htap")
+                warm.execute("GO FROM 1 OVER KNOWS YIELD dst(edge) AS d")
+                warm.close()
+                s0 = stats().snapshot()
+                stop = threading.Event()
+                wres, rres = _LevelResult(), _LevelResult()
+                lags: List[float] = []
+                perrs: List[str] = []
+                ths = [threading.Thread(
+                    target=_htap_write_worker,
+                    args=(cluster, "htap", stop, i, persons, wres),
+                    daemon=True) for i in range(writers)]
+                ths += [threading.Thread(
+                    target=_htap_read_worker,
+                    args=(cluster, "htap", stop, i, persons, rres),
+                    daemon=True) for i in range(readers)]
+                ths += [threading.Thread(
+                    target=_htap_staleness_probe,
+                    args=(cluster, "htap", stop, persons, lags, perrs),
+                    daemon=True)]
+                t0 = time.perf_counter()
+                for t in ths:
+                    t.start()
+                time.sleep(duration_s)
+                stop.set()
+                for t in ths:
+                    t.join(30)
+                wall = time.perf_counter() - t0
+                s1 = stats().snapshot()
+                rres.lats.sort()
+                lags.sort()
+                out_modes[mode] = {
+                    "wall_s": round(wall, 2),
+                    "writes_ok": wres.ok,
+                    "write_qps": round(wres.ok / wall, 1) if wall else 0,
+                    "reads_ok": rres.ok,
+                    "read_goodput_qps": round(rres.ok / wall, 1)
+                    if wall else 0,
+                    "read_p50_ms": round(
+                        _percentile(rres.lats, 50) * 1e3, 2),
+                    "read_p99_ms": round(
+                        _percentile(rres.lats, 99) * 1e3, 2),
+                    "staleness_probes": len(lags),
+                    "fresh_read_lag_p50_ms": round(
+                        _percentile(lags, 50) * 1e3, 2),
+                    "fresh_read_lag_p99_ms": round(
+                        _percentile(lags, 99) * 1e3, 2),
+                    "errors": len(wres.errors) + len(rres.errors)
+                    + len(perrs),
+                    "error_sample": (wres.errors + rres.errors
+                                     + perrs)[:3],
+                    "pins": s1.get("tpu_pins", 0) - s0.get("tpu_pins", 0),
+                    "repin_avoided": s1.get("tpu_repin_avoided", 0)
+                    - s0.get("tpu_repin_avoided", 0),
+                    "compactions": s1.get("tpu_compactions", 0)
+                    - s0.get("tpu_compactions", 0),
+                }
+            finally:
+                cluster.stop()
+        off, on = out_modes["rebuild"], out_modes["delta"]
+        avoided = on["repin_avoided"]
+        share = round(avoided / (avoided + on["pins"]), 4) \
+            if (avoided + on["pins"]) else 0.0
+        g_ratio = round(on["read_goodput_qps"]
+                        / off["read_goodput_qps"], 2) \
+            if off["read_goodput_qps"] else None
+        lag_ratio = round(off["fresh_read_lag_p50_ms"]
+                          / on["fresh_read_lag_p50_ms"], 2) \
+            if on["fresh_read_lag_p50_ms"] else None
+        return {
+            "persons": persons,
+            "degree": degree,
+            "writers": writers,
+            "readers": readers,
+            "duration_per_mode_s": duration_s,
+            "delta_cap": delta_cap,
+            "modes": out_modes,
+            # headlines (ISSUE 19 acceptance)
+            "read_goodput_on_over_off": g_ratio,
+            "fresh_read_lag_ms": on["fresh_read_lag_p50_ms"],
+            "fresh_read_lag_off_over_on": lag_ratio,
+            "repin_avoided_share": share,
+            "tpu_repin_avoided": avoided,
+        }
+    finally:
+        with cfg.lock:
+            for k in dyn_keys:
+                cfg.dynamic_layer.pop(k, None)
+        if data_dir is None:
+            shutil.rmtree(tmp, ignore_errors=True)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--persons", type=int, default=1200)
@@ -755,7 +986,22 @@ def main(argv=None) -> int:
                     help="batch_max_lanes for the --batch ON arm")
     ap.add_argument("--batch-wait-us", type=int, default=3000,
                     help="batch_wait_us forming window for --batch")
+    ap.add_argument("--htap", action="store_true",
+                    help="run the write-storm + read-storm delta-CSR "
+                         "A/B (delta off vs on) instead of the "
+                         "offered-load sweep")
+    ap.add_argument("--writers", type=int, default=2,
+                    help="closed-loop write workers for --htap")
+    ap.add_argument("--delta-cap", type=int, default=2048,
+                    help="tpu_delta_max_edges for the --htap ON arm")
     args = ap.parse_args(argv)
+    if args.htap:
+        print(json.dumps(htap_sweep(
+            persons=args.persons, degree=args.degree,
+            writers=args.writers, readers=args.threads,
+            duration_s=args.duration,
+            delta_cap=args.delta_cap), indent=1))
+        return 0
     if args.batch:
         print(json.dumps(batch_sweep(
             persons=args.persons, degree=args.degree,

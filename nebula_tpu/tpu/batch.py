@@ -182,7 +182,9 @@ class BatchFormer:
 
     def submit(self, key, dense: Sequence[int],
                launch: Callable[[List[List[int]]], Any],
-               kernel: str = "traverse") -> Optional[LaneResult]:
+               kernel: str = "traverse",
+               gate_busy: Optional[Callable[[], bool]] = None
+               ) -> Optional[LaneResult]:
         """Enroll one dispatch under `key`.  Returns the statement's
         LaneResult after the shared launch, or None when the caller
         should dispatch solo (batching off / no concurrency evidence /
@@ -192,7 +194,15 @@ class BatchFormer:
         DeadlineExceeded when THIS statement is cancelled (mid-form:
         its lane withdraws before launch; mid-flight: its lane's
         result is discarded) and re-raises the launch error to every
-        member when the shared launch fails."""
+        member when the shared launch fails.
+
+        `gate_busy` (optional) probes the runtime's dispatch gate: a
+        group whose forming window expires while a writer holds the
+        gate (re-pin / delta apply / compaction swap) RE-ARMS the
+        window instead of launching — launching would only queue the
+        fully-formed batch behind the hold with `batch_wait_us`
+        already spent, while statements arriving during the hold piled
+        into fresh groups (ISSUE 19 satellite)."""
         max_lanes = self.max_lanes()
         if max_lanes <= 1:
             return None
@@ -223,18 +233,33 @@ class BatchFormer:
             # satellite); the launch claim re-stamps the final lane
             lv.batch_id, lv.lane = g.bid, lane_provisional
         try:
-            return self._wait_and_demux(key, g, m, launch, kernel)
+            return self._wait_and_demux(key, g, m, launch, kernel,
+                                        gate_busy)
         finally:
             if lv is not None:
                 lv.batch_id, lv.lane = None, None
 
     def _wait_and_demux(self, key, g: _Group, m: _Member, launch,
-                        kernel: str) -> Optional[LaneResult]:
+                        kernel: str, gate_busy=None
+                        ) -> Optional[LaneResult]:
         launcher = False
         with g.cond:
             while g.state != _DONE:
                 if g.state == _FORMING and (
                         g.ready or time.monotonic() >= g.deadline):
+                    if not g.ready and gate_busy is not None \
+                            and gate_busy():
+                        # window expired under a write-gate hold: re-arm
+                        # so the group keeps forming through the hold
+                        # and gets a FRESH window once the gate frees
+                        # (a full group skips this — it cannot grow, so
+                        # it may as well queue at the gate).  One waiter
+                        # moves the deadline per expiry: the loop holds
+                        # g.cond, so re-arms are serialized.
+                        g.deadline = time.monotonic() + self.wait_s()
+                        from ..utils.stats import stats
+                        stats().inc("tpu_batch_gate_rearms")
+                        continue
                     g.state = _LAUNCHING
                     launcher = True
                     break

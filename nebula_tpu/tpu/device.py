@@ -175,6 +175,39 @@ class DeviceTag:
 
 
 @dataclass
+class DeviceDelta:
+    """Device-resident delta-CSR buffers for one pinned snapshot
+    (ISSUE 19): per (etype, direction) block, padded insert rows +
+    sorted tombstoned base edge indices, re-put whole per commit group
+    (small: (P, Dcap)/(P, Tcap)).  `host` is the numpy mirror
+    (graphstore.delta.HostDelta) the arrays are rebuilt from."""
+    host: Any
+    # bk → {"d_src","d_dst","d_rank","d_valid","d_tomb": device arrays,
+    #        "d_props": {name: device array},
+    #        "np": the numpy block_arrays dict these were put from}
+    blocks: Dict[Tuple[str, str], Dict[str, Any]] = field(
+        default_factory=dict)
+    applied_epoch: int = 0            # store epoch the delta covers
+    epoch: int = 0                    # bumped per device apply (jit/batch
+    #                                   compatibility keys carry it; the
+    #                                   BASE epoch stays fixed, so XLA
+    #                                   programs and caches survive)
+    # (epoch, blocks) published as ONE tuple: dispatch assembly runs
+    # outside the gate, so it must grab a mutually-consistent pair —
+    # an apply REPLACES the blocks dict (copy-on-write) and then swaps
+    # this tuple in one atomic attribute write
+    view: Tuple[int, Dict[Tuple[str, str], Dict[str, Any]]] = (0, None)
+
+    def _leaves(self):
+        for arrs in self.blocks.values():
+            for k, v in arrs.items():
+                if k == "d_props":
+                    yield from v.values()
+                elif k != "np":
+                    yield v
+
+
+@dataclass
 class DeviceSnapshot:
     """Epoch-tagged device-resident copy of one space."""
     space: str
@@ -191,6 +224,9 @@ class DeviceSnapshot:
     # accessor has no uid — cluster views, prebuilt bench snapshots);
     # guards the runtime's per-space cache across distinct stores
     space_uid: Optional[int] = None
+
+    # device delta-CSR (ISSUE 19); None = delta plane off for this pin
+    delta: Optional[DeviceDelta] = None
 
     # set by runtime.pin when a newer epoch replaced this snapshot and its
     # device buffers were donated (deleted); dispatch paths check it under
@@ -210,6 +246,8 @@ class DeviceSnapshot:
         for t in self.tags.values():
             yield t.present
             yield from t.props.values()
+        if self.delta is not None:
+            yield from self.delta._leaves()
 
     def hbm_bytes(self) -> int:
         return sum(a.nbytes for a in self._leaves())
@@ -244,6 +282,74 @@ class DeviceSnapshot:
                 pass
 
 
+def make_putter(mesh: Mesh, num_parts: int):
+    """The placement closure shared by full pins and delta applies:
+    single-chip mode puts whole arrays on the one device; multi-part
+    mode puts partition p's row directly onto column-p device(s) and
+    assembles with make_array_from_single_device_arrays (no host-side
+    concat, no all-device broadcast copy), replicated down the lane
+    axis."""
+    P = mesh_parts(mesh)
+    L = mesh_lanes(mesh)
+    if P == 1:
+        # single-chip mode: every partition resident on the one device;
+        # the local (vmap) kernel runs the same program without ICI
+        dev0 = mesh.devices.reshape(-1)[0]
+
+        def put(a: np.ndarray):
+            return jax.device_put(a, dev0)
+        return put
+    if num_parts == P:
+        part0 = NamedSharding(mesh, PartitionSpec("part"))
+        grid = mesh.devices.reshape(L, P)
+
+        def put(a: np.ndarray):
+            shards = []
+            for row in grid:                     # lane replicas
+                for p, d in enumerate(row):      # one partition per column
+                    shards.append(jax.device_put(a[p:p + 1], d))
+            return jax.make_array_from_single_device_arrays(
+                a.shape, part0, shards)
+        return put
+    raise TpuUnavailable(
+        f"snapshot has {num_parts} parts but mesh has {P} devices; "
+        f"create the space with partition_num == mesh size to pin it")
+
+
+def put_delta_blocks(dev: DeviceSnapshot, host_delta,
+                     block_keys=None) -> int:
+    """(Re-)place delta buffers for `block_keys` (None = all blocks) of
+    a pinned snapshot; returns bytes transferred.  Replaced buffers are
+    NOT force-deleted: a batch group formed just before this apply may
+    still hold references to them in its launch closure (there is no
+    `retired` divert for an in-place delta apply, unlike a full
+    re-pin), so the old copies are released by refcount instead —
+    they are commit-group-sized, not graph-sized."""
+    put = make_putter(dev.mesh, dev.num_parts)
+    if dev.delta is None:
+        dev.delta = DeviceDelta(host=host_delta,
+                                applied_epoch=dev.epoch)
+    dd = dev.delta
+    keys = list(dev.blocks if block_keys is None else block_keys)
+    new_blocks = dict(dd.blocks)       # copy-on-write: see DeviceDelta.view
+    moved = 0
+    for bk in keys:
+        arrs = host_delta.block_arrays(bk)
+        placed: Dict[str, Any] = {"np": arrs}
+        for k, v in arrs.items():
+            if k == "d_props":
+                placed[k] = {n: put(a) for n, a in v.items()}
+                moved += sum(a.nbytes for a in v.values())
+            else:
+                placed[k] = put(v)
+                moved += v.nbytes
+        new_blocks[bk] = placed
+    dd.epoch += 1
+    dd.blocks = new_blocks
+    dd.view = (dd.epoch, new_blocks)
+    return moved
+
+
 def pin_snapshot(snap: CsrSnapshot, mesh: Mesh) -> DeviceSnapshot:
     """device_put every snapshot array, sharded over the 'part' axis.
 
@@ -256,31 +362,7 @@ def pin_snapshot(snap: CsrSnapshot, mesh: Mesh) -> DeviceSnapshot:
     ("lane", "part") mesh the CSR rows are replicated down each lane-axis
     column (each lane row sees its own resident copy of partition p).
     """
-    P = mesh_parts(mesh)
-    L = mesh_lanes(mesh)
-    if P == 1:
-        # single-chip mode: every partition resident on the one device;
-        # the local (vmap) kernel runs the same program without ICI
-        dev0 = mesh.devices.reshape(-1)[0]
-
-        def put(a: np.ndarray):
-            return jax.device_put(a, dev0)
-    elif snap.num_parts == P:
-        part0 = NamedSharding(mesh, PartitionSpec("part"))
-        grid = mesh.devices.reshape(L, P)
-
-        def put(a: np.ndarray):
-            shards = []
-            for row in grid:                     # lane replicas
-                for p, d in enumerate(row):      # one partition per column
-                    shards.append(jax.device_put(a[p:p + 1], d))
-            return jax.make_array_from_single_device_arrays(
-                a.shape, part0, shards)
-    else:
-        raise TpuUnavailable(
-            f"snapshot has {snap.num_parts} parts but mesh has {P} devices; "
-            f"create the space with partition_num == mesh size to pin it")
-
+    put = make_putter(mesh, snap.num_parts)
     dev = DeviceSnapshot(space=snap.space, epoch=snap.epoch,
                          num_parts=snap.num_parts, vmax=snap.vmax, mesh=mesh,
                          num_vertices=put(snap.num_vertices),

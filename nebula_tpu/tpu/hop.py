@@ -115,6 +115,56 @@ def _expand_block(indptr, nbr, rank, fbm, EB: int, P: int, pid,
     return src, dst, rk, eidx, ve, total, total > EB
 
 
+def _merge_delta(dl, fbm, src, dst, rk, eidx, ve, total, P: int, pid,
+                 emax: int):
+    """Merge the device-resident delta plane into one block's expansion
+    (ISSUE 19).
+
+    dl: dict with the block's delta leaves for THIS part —
+      d_src (Dcap,) int32 LOCAL source index, d_dst (Dcap,) dense dst,
+      d_rank (Dcap,), d_valid (Dcap,) bool slot-live,
+      d_tomb (Tcap,) SORTED int32 base-edge indices masked out
+      (MAXI-padded).
+    fbm: (vmax,) bool — this part's frontier bitmap (delta snapshots are
+    never degree-split, so no hub extension applies).
+
+    Two halves, in order:
+      1. tombstones: a searchsorted membership test drops base slots
+         whose eidx was deleted/overwritten since the pin;
+      2. inserts: delta rows whose source vertex is on the frontier are
+         APPENDED to the capture arrays — delta row j takes the virtual
+         edge index emax + j, so downstream prop gathers read from
+         columns extended with the delta prop columns and the host can
+         split captured rows back into base (< emax) and delta halves.
+
+    The appended slots keep the ascending-eidx tail position, so the
+    (part, src)-contiguous prefix invariant of the BASE slots survives;
+    the host re-sorts the merged union per part into canonical CSR
+    order (runtime._block_columns) before materializing rows.
+    """
+    tomb = dl["d_tomb"]
+    if tomb.shape[0]:
+        pos = jnp.clip(jnp.searchsorted(tomb, eidx), 0, tomb.shape[0] - 1)
+        ve = ve & ~(tomb[pos] == eidx)
+    dsrc = dl["d_src"]
+    Dcap = dsrc.shape[0]
+    if Dcap:
+        active = dl["d_valid"] & fbm[jnp.clip(dsrc, 0, fbm.shape[0] - 1)]
+        src = jnp.concatenate([src, jnp.where(active, dsrc * P + pid, -1)])
+        dst = jnp.concatenate([dst, jnp.where(active, dl["d_dst"], -1)])
+        rk = jnp.concatenate([rk, jnp.where(active, dl["d_rank"], 0)])
+        eidx = jnp.concatenate(
+            [eidx, emax + jnp.arange(Dcap, dtype=jnp.int32)])
+        ve = jnp.concatenate([ve, active])
+        total = total + jnp.sum(active, dtype=jnp.int32)
+    return src, dst, rk, eidx, ve, total
+
+
+def _delta_cap(b) -> int:
+    """Extra capture width a block's delta plane adds (0 = no delta)."""
+    return int(b["d_src"].shape[-1]) if "d_src" in b else 0
+
+
 def _mark(dst, keep, P: int, vmax: int, acc=None):
     """Scatter keep-passing dense dst ids into a (P, vmax) ownership
     bitmap: row d = the candidate set destined for part d.  This is the
@@ -329,18 +379,32 @@ def build_traverse_fn(mesh, P: int, EB, steps: int,
                     b["indptr"][0], b["nbr"][0], b["rank"][0], efbm, EBh,
                     P, pid, vmax_local=vmax, hub_dense=hubs_c)
                 ovf_e = ovf_e | ovf
+                dcap = _delta_cap(b)
+                if dcap:
+                    dl = {k: b[k][0] for k in
+                          ("d_src", "d_dst", "d_rank", "d_valid", "d_tomb")}
+                    src, dst, rk, eidx, ve, total = _merge_delta(
+                        dl, fbm, src, dst, rk, eidx, ve, total, P, pid,
+                        b["nbr"].shape[-1])
                 edges_this_hop = edges_this_hop + total
+
+                def _col(name):
+                    c = b["props"][name][0]
+                    if dcap:
+                        c = jnp.concatenate([c, b["d_props"][name][0]])
+                    return c
+
                 if pred is not None and (last or capture_hops):
                     cols = {"_rank": rk, "_src": src, "_dst": dst}
                     for name in pred_cols:
                         if not name.startswith("_"):
-                            cols[name] = b["props"][name][0][eidx]
+                            cols[name] = _col(name)[eidx]
                     keep = pred(cols) & ve
                 else:
                     keep = ve
                 if capture and (last or capture_hops):
                     cs, cd, cr, ce, kc = _compact_cap(src, dst, rk, eidx,
-                                                      keep, EBh)
+                                                      keep, EBh + dcap)
                     caps["src"].append(cs)
                     caps["dst"].append(cd)
                     caps["rank"].append(cr)
@@ -349,7 +413,7 @@ def build_traverse_fn(mesh, P: int, EB, steps: int,
                     if last and not capture_hops:
                         for name in yield_cols:
                             caps.setdefault("prop:" + name, []).append(
-                                b["props"][name][0][ce])
+                                _col(name)[ce])
                 if not last:
                     marks = _mark(dst, keep, P, vmax, marks)
             hop_edges.append(edges_this_hop)
@@ -415,11 +479,20 @@ def _build_local_fn(P: int, EB, steps: int,
         src, dst, rk, eidx, ve, total, ovf = _expand_block(
             block["indptr"], block["nbr"], block["rank"], fbm, EBh, P,
             pid, vmax_local=vmax_local, hub_dense=hubs_c)
+        if "d_src" in block:
+            # delta snapshots are never hub-extended, so fbm here is the
+            # plain (vmax,) membership row
+            src, dst, rk, eidx, ve, total = _merge_delta(
+                block, fbm, src, dst, rk, eidx, ve, total, P, pid,
+                block["nbr"].shape[-1])
         if want_pred:
             cols = {"_rank": rk, "_src": src, "_dst": dst}
             for name in pred_cols:
                 if not name.startswith("_"):
-                    cols[name] = block["props"][name][eidx]
+                    c = block["props"][name]
+                    if "d_src" in block:
+                        c = jnp.concatenate([c, block["d_props"][name]])
+                    cols[name] = c[eidx]
             keep = pred(cols) & ve
         else:
             keep = ve
@@ -447,17 +520,20 @@ def _build_local_fn(P: int, EB, steps: int,
             for bi in range(n_blocks):
                 b = blocks_data[bi]
                 want_pred = pred is not None and (last or capture_hops)
+                dcap = _delta_cap(b)
+                # the whole block dict is the vmap operand: every leaf
+                # (indptr/nbr/rank/props AND the d_* delta plane) carries
+                # a leading part axis
                 src, dst, rk, eidx, ve, keep, total, ovf = jax.vmap(
-                    lambda ip, nb, rkk, prp, f, pd: one_part_expand(
-                        {"indptr": ip, "nbr": nb, "rank": rkk, "props": prp},
-                        f, pd, want_pred, EBh, vmax)
-                )(b["indptr"], b["nbr"], b["rank"], b["props"], efbm, pids)
+                    lambda blk, f, pd: one_part_expand(
+                        blk, f, pd, want_pred, EBh, vmax)
+                )(b, efbm, pids)
                 ovf_e = ovf_e | ovf
                 edges = edges + total
                 if capture and (last or capture_hops):
                     cs, cd, cr, ce, kc = jax.vmap(
                         lambda s, d, r, e, k: _compact_cap(s, d, r, e, k,
-                                                           EBh)
+                                                           EBh + dcap)
                     )(src, dst, rk, eidx, keep)
                     caps["src"].append(cs)
                     caps["dst"].append(cd)
@@ -466,9 +542,12 @@ def _build_local_fn(P: int, EB, steps: int,
                     caps["kcount"].append(kc)
                     if last and not capture_hops:
                         for name in yield_cols:
+                            col = b["props"][name]
+                            if dcap:
+                                col = jnp.concatenate(
+                                    [col, b["d_props"][name]], axis=1)
                             caps.setdefault("prop:" + name, []).append(
-                                jax.vmap(lambda c, e: c[e])(
-                                    b["props"][name], ce))
+                                jax.vmap(lambda c, e: c[e])(col, ce))
                 if not last:
                     blk_marks = jax.vmap(
                         lambda d, k: _mark(d, k, P, vmax))(dst, keep)
@@ -629,24 +708,48 @@ def build_traverse_fn_lanes_sharded(mesh, P: int, EB, steps: int,
                 fbm, pid, hub_owner, hub_local)
             for bi in range(n_blocks):
                 b = blocks_data[bi]
-                src, dst, rk, eidx, ve, total, ovf = jax.vmap(
-                    lambda f: _expand_block(
+                dcap = _delta_cap(b)
+                dl = ({k: b[k][0] for k in
+                       ("d_src", "d_dst", "d_rank", "d_valid", "d_tomb")}
+                      if dcap else None)
+                emax = b["nbr"].shape[-1]
+
+                def lane_expand(f):
+                    out = _expand_block(
                         b["indptr"][0], b["nbr"][0], b["rank"][0], f, EBh,
-                        P, pid, vmax_local=vmax, hub_dense=hubs_c))(efbm)
+                        P, pid, vmax_local=vmax, hub_dense=hubs_c)
+                    s, d, r, e, v, t, o = out
+                    if dl is not None:
+                        # per-lane merge: delta-row activity depends on
+                        # THIS lane's frontier bitmap
+                        s, d, r, e, v, t = _merge_delta(
+                            dl, f, s, d, r, e, v, t, P, pid, emax)
+                    return s, d, r, e, v, t, o
+
+                src, dst, rk, eidx, ve, total, ovf = jax.vmap(
+                    lane_expand)(efbm)
                 ovf_e = ovf_e | ovf
                 edges_this_hop = edges_this_hop + total
+
+                def _col(name):
+                    c = b["props"][name][0]
+                    if dcap:
+                        c = jnp.concatenate([c, b["d_props"][name][0]])
+                    return c
+
                 if pred is not None and (last or capture_hops):
                     cols = {"_rank": rk, "_src": src, "_dst": dst}
                     for name in pred_cols:
                         if not name.startswith("_"):
-                            cols[name] = b["props"][name][0][eidx]
+                            cols[name] = _col(name)[eidx]
                     keep = pred(cols) & ve
                 else:
                     keep = ve
                 if capture and (last or capture_hops):
                     cs, cd, cr, ce, kc = jax.vmap(
                         lambda s, d, r, e, k: _compact_cap(
-                            s, d, r, e, k, EBh))(src, dst, rk, eidx, keep)
+                            s, d, r, e, k,
+                            EBh + dcap))(src, dst, rk, eidx, keep)
                     caps["src"].append(cs)
                     caps["dst"].append(cd)
                     caps["rank"].append(cr)
@@ -655,7 +758,7 @@ def build_traverse_fn_lanes_sharded(mesh, P: int, EB, steps: int,
                     if last and not capture_hops:
                         for name in yield_cols:
                             caps.setdefault("prop:" + name, []).append(
-                                b["props"][name][0][ce])
+                                _col(name)[ce])
                 if not last:
                     marks_b = jax.vmap(
                         lambda d, k: _mark(d, k, P, vmax))(dst, keep)

@@ -202,6 +202,23 @@ define_flag("storage_read_capacity_qps", 0,
             "replica's read load during backfill/compaction; bench "
             "use: model per-replica capacity for the read scale-out "
             "sweep on hosts whose cores can't isolate replicas")
+define_flag("tpu_delta_max_edges", 0,
+            "device delta-CSR capacity per (block, part) in edges "
+            "(rounded up to a power of two; 0 = delta plane off, "
+            "every epoch bump re-pins the full snapshot).  With the "
+            "delta on, group-committed writes land as a small "
+            "device_put into a padded delta buffer that every "
+            "traversal kernel merges with the base CSR each hop")
+define_flag("tpu_delta_compact_watermark", 0.75,
+            "delta fill ratio (of tpu_delta_max_edges, insert or "
+            "tombstone side) above which the background compaction "
+            "job rebuilds the base CSR off the gate and swaps it "
+            "under a short write-side hold")
+define_flag("tpu_delta_vmax_slack", 64,
+            "extra padded local-vertex rows reserved at snapshot "
+            "build when the delta plane is on, so freshly inserted "
+            "vertices fit the pinned frontier/bitmap shapes without "
+            "forcing a full re-pin")
 define_flag("snapshot_dir", "./nebula_snapshots",
             "where CREATE SNAPSHOT checkpoints land")
 define_flag("backup_dir", "./nebula_backups",

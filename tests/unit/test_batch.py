@@ -199,10 +199,12 @@ def test_batch_form_failpoint_raise_dispatches_solo(rt, clean, company):
 
 
 def test_batch_consumes_one_dispatch_slot(rt, clean, company):
-    """ISSUE 15 satellite: a batched launch enters the dispatch table
-    ONCE — with the dispatch gate write-held, K batched statements
-    show queue depth 1 (batching off shows K), so turning batching on
-    can never increase the `tpu_dispatch_queue_cap` shed rate."""
+    """ISSUE 15 satellite, amended by the ISSUE 19 re-arm fix: with
+    the dispatch gate write-held, K batched statements occupy ZERO
+    dispatch slots — the non-full forming group keeps re-arming its
+    window instead of queueing behind the hold (batching off shows
+    depth K), so turning batching on can never increase the
+    `tpu_dispatch_queue_cap` shed rate."""
     eng = device_engine(rt)
     seeds = [1, 2, 3]
     # warm: pin + compile outside the gate-held window
@@ -225,13 +227,22 @@ def test_batch_consumes_one_dispatch_slot(rt, clean, company):
                 target=_run_stmt,
                 args=(eng, GO_TMPL.format(seed=sd), res, sd, errs),
                 daemon=True) for sd in seeds]
+            r0 = stats().snapshot().get("tpu_batch_gate_rearms", 0)
             for t in ths:
                 t.start()
-            want = 1 if batching else len(seeds)
-            _wait_for(lambda: dispatch_table().queued_depth() >= want,
-                      msg=f"queued depth {want}")
+            if batching:
+                # the group's window must EXPIRE under the hold at
+                # least twice (proof all three enrolled and are
+                # re-arming rather than sitting in the dispatch queue)
+                _wait_for(lambda: stats().snapshot().get(
+                    "tpu_batch_gate_rearms", 0) >= r0 + 2,
+                    msg="forming window re-arms behind held gate")
+            else:
+                _wait_for(lambda: dispatch_table().queued_depth()
+                          >= len(seeds),
+                          msg=f"queued depth {len(seeds)}")
             # settle: ALL statements are past forming/enqueue before
-            # the depth is judged (the batched case must stay at 1)
+            # the depth is judged (the batched case must stay at 0)
             time.sleep(0.4)
             depth = dispatch_table().queued_depth()
         finally:
@@ -244,7 +255,7 @@ def test_batch_consumes_one_dispatch_slot(rt, clean, company):
         return depth
 
     assert run_held(batching=False) == len(seeds)
-    assert run_held(batching=True) == 1
+    assert run_held(batching=True) == 0
 
 
 # -- cancellation detaches one lane -----------------------------------------
@@ -476,3 +487,47 @@ def test_repin_to_wider_mesh_mid_form_splits_group(clean, company):
     # 3-lane launch, formed == 1); the epoch key keeps the grids apart
     # as two 2-lane groups
     assert formed == 2, f"expected two 2-lane groups, saw {formed}"
+
+
+def test_forming_window_rearms_behind_write_gate(rt, clean, company):
+    """ISSUE 19 satellite: with the dispatch gate write-held (a repin
+    or compaction swap in flight), a partially-formed group whose
+    forming window expires RE-ARMS the window instead of sealing and
+    queueing a fully-FORMED batch behind the gate with its
+    batch_wait_us already spent.  While the hold lasts the group keeps
+    re-arming (`tpu_batch_gate_rearms` grows, `tpu_batches_formed`
+    stays flat); on release the group launches once, fully formed."""
+    eng = device_engine(rt)
+    out = {}
+    for sd in (1, 2):       # warm: pin + compile outside the hold
+        _run_stmt(eng, GO_TMPL.format(seed=sd), out, sd, [])
+        assert out[sd][0].error is None
+    get_config().set_dynamic_many({"batch_max_lanes": 8,
+                                   "batch_wait_us": 20_000})
+    r0 = stats().snapshot().get("tpu_batch_gate_rearms", 0)
+    f0 = stats().snapshot().get("tpu_batches_formed", 0)
+    res, errs = {}, []
+    ths = [threading.Thread(target=_run_stmt,
+                            args=(eng, GO_TMPL.format(seed=sd),
+                                  res, sd, errs),
+                            daemon=True) for sd in (1, 2)]
+    rt._gate.acquire_write()
+    try:
+        for t in ths:
+            t.start()
+        # several expiries come and go under the hold — each one
+        # re-arms instead of sealing the 2-lane group
+        _wait_for(lambda: stats().snapshot().get(
+            "tpu_batch_gate_rearms", 0) >= r0 + 3,
+            msg="forming window re-arms behind the write gate")
+        assert stats().snapshot().get("tpu_batches_formed", 0) == f0, \
+            "group sealed while the dispatch gate was write-held"
+    finally:
+        rt._gate.release_write()
+    for t in ths:
+        t.join(30)
+    assert not errs, errs[:3]
+    for sd in (1, 2):
+        assert res[sd][0].error is None, res[sd][0].error
+    # the held statements still launched as ONE shared batch
+    assert stats().snapshot().get("tpu_batches_formed", 0) == f0 + 1

@@ -1,0 +1,410 @@
+"""Device-resident delta-CSR (ISSUE 19): merged base+delta traversal
+vs full-rebuild vs the host oracle at every fill level, across
+insert/delete/tombstone-resurrect interleavings and 1/2/4-part meshes;
+compaction swap under concurrent traversal; KILL-during-compaction;
+the `tpu_delta_max_edges=0` off switch; the group-commit ack →
+read-your-writes floor; and the batch-former gate re-arm."""
+import random
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from nebula_tpu.core.value import NULL
+from nebula_tpu.exec.engine import QueryEngine
+from nebula_tpu.graphstore.schema import PropDef, PropType
+from nebula_tpu.graphstore.store import GraphStore
+from nebula_tpu.utils.config import get_config
+from nebula_tpu.utils.failpoints import fail
+from nebula_tpu.utils.stats import stats
+
+tpu = pytest.importorskip("nebula_tpu.tpu")
+from nebula_tpu.tpu import TpuRuntime, make_mesh           # noqa: E402
+
+from test_tpu import norm_edge                             # noqa: E402
+
+DELTA_KEYS = ("tpu_delta_max_edges", "tpu_delta_compact_watermark",
+              "tpu_delta_vmax_slack")
+
+
+@pytest.fixture()
+def delta_cfg():
+    """Delta plane ON with compaction parked (watermark 2.0 — tests
+    that want compaction lower it themselves); restores every flag."""
+    fail.reset()
+    get_config().set_dynamic_many({"tpu_delta_max_edges": 64,
+                                   "tpu_delta_compact_watermark": 2.0})
+    yield get_config()
+    fail.reset()
+    cfg = get_config()
+    with cfg.lock:
+        for k in DELTA_KEYS:
+            cfg.dynamic_layer.pop(k, None)
+
+
+def store_p(parts, seed=3, n=90, avg_deg=4, spacename="g"):
+    rng = random.Random(seed)
+    st = GraphStore()
+    st.create_space(spacename, partition_num=parts, vid_type="INT64")
+    st.catalog.create_tag(spacename, "person", [
+        PropDef("age", PropType.INT64), PropDef("name", PropType.STRING)])
+    st.catalog.create_edge(spacename, "knows", [
+        PropDef("w", PropType.INT64), PropDef("f", PropType.DOUBLE),
+        PropDef("tag", PropType.STRING)])
+    names = ["ann", "bob", "cid", "dee"]
+    for v in range(n):
+        st.insert_vertex(spacename, v, "person",
+                         {"age": rng.randint(0, 80),
+                          "name": rng.choice(names)})
+    for v in range(n):
+        for _ in range(rng.randint(0, avg_deg * 2)):
+            props = {"w": rng.randint(-5, 100) if rng.random() > .1
+                     else NULL,
+                     "f": rng.uniform(0, 1), "tag": rng.choice(names)}
+            st.insert_edge(spacename, v, "knows", rng.randrange(n),
+                           rng.randint(0, 2), props)
+    return st
+
+
+def host_rows(st, space, vids, steps=2, direction="out"):
+    """Numpy/host oracle: the engine's pure-host GO rows."""
+    eng = QueryEngine(st)
+    s = eng.new_session()
+    eng.execute(s, f"USE {space}")
+    q = (f"GO {steps} STEPS FROM {', '.join(map(str, vids))} OVER knows"
+         + (" REVERSELY" if direction == "in" else
+            " BIDIRECT" if direction == "both" else "")
+         + " YIELD src(edge), type(edge), rank(edge), dst(edge)")
+    rs = eng.execute(s, q)
+    assert rs.error is None, f"{q} -> {rs.error}"
+    return sorted(map(repr, rs.data.rows))
+
+
+def dev_rows(rt, st, vids, steps=2, direction="out"):
+    rows, _ = rt.traverse(st, "g", list(vids), ["knows"], direction,
+                          steps)
+    return sorted(norm_edge(e) for (_, e, _) in rows)
+
+
+def rebuild_rows(parts, st, vids, steps=2, direction="out"):
+    """Full-rebuild oracle: a FRESH runtime with the delta off pins a
+    from-scratch snapshot of the current store state."""
+    cfg = get_config()
+    with cfg.lock:
+        saved = cfg.dynamic_layer.get("tpu_delta_max_edges")
+    cfg.set_dynamic("tpu_delta_max_edges", 0)
+    try:
+        rt = TpuRuntime(make_mesh(parts))
+        return dev_rows(rt, st, vids, steps, direction)
+    finally:
+        cfg.set_dynamic("tpu_delta_max_edges",
+                        saved if saved is not None else 0)
+
+
+def three_way(rt, st, parts, vids, tag, steps=2, direction="out"):
+    got = dev_rows(rt, st, vids, steps, direction)
+    want_rebuild = rebuild_rows(parts, st, vids, steps, direction)
+    want_host = host_rows(st, "g", vids, steps, direction)
+    assert got == want_rebuild, \
+        f"[{tag}] merged kernel != full rebuild ({len(got)} vs " \
+        f"{len(want_rebuild)} rows)"
+    assert got == want_host, f"[{tag}] merged kernel != host oracle"
+    return got
+
+
+def live_edges(st, limit=None):
+    out = [(s, r, d) for (s, _et, r, d, _p) in st.scan_edges("g", "knows")]
+    return out if limit is None else out[:limit]
+
+
+# -- parity across interleavings, fill levels, mesh widths ------------------
+
+
+@pytest.mark.parametrize("parts", [1, 2, 4])
+def test_interleaved_writes_parity(delta_cfg, parts):
+    """Insert / delete / tombstone-resurrect interleavings on a P-part
+    mesh: the merged kernel's rows equal a full rebuild AND the host
+    oracle after every phase, without a single re-pin."""
+    st = store_p(parts, seed=20 + parts)
+    rt = TpuRuntime(make_mesh(parts))
+    seeds = [1, 5, 9]
+    dev = rt.pin(st, "g")
+    assert dev.delta is not None, "delta plane not armed"
+    three_way(rt, st, parts, seeds, "empty fill")      # fill level 0
+
+    # phase 1: fresh inserts (new rank space so they never collide)
+    for i in range(12):
+        st.insert_edge("g", seeds[i % 3], "knows", (7 * i) % 90, 50 + i,
+                       {"w": 1000 + i, "f": .5, "tag": "zz"})
+    three_way(rt, st, parts, seeds, "inserts")
+    assert rt.pin(st, "g") is dev, "insert burst forced a re-pin"
+
+    # phase 2: delete a mix of base edges and fresh delta edges
+    for s, r, d in live_edges(st, 8):
+        st.delete_edge("g", s, "knows", d, r)
+    st.delete_edge("g", seeds[0], "knows", 0, 50)      # delta-resident
+    three_way(rt, st, parts, seeds, "deletes")
+
+    # phase 3: tombstone-resurrect — identical re-insert unmasks the
+    # base row; changed re-insert overrides it
+    resurrect = live_edges(st, 2)
+    for s, r, d in resurrect:
+        st.delete_edge("g", s, "knows", d, r)
+    for s, r, d in resurrect:
+        st.insert_edge("g", s, "knows", d, r,
+                       {"w": 77, "f": .25, "tag": "rz"})
+    three_way(rt, st, parts, seeds, "resurrect")
+
+    # phase 4: endpoints with no prior vertex row + a brand-new vertex
+    st.insert_vertex("g", 5000, "person", {"age": 1, "name": "new"})
+    st.insert_edge("g", seeds[0], "knows", 5000, 0,
+                   {"w": 5, "f": .1, "tag": "nv"})
+    st.insert_edge("g", 5000, "knows", seeds[1], 0,
+                   {"w": 6, "f": .2, "tag": "nv"})
+    three_way(rt, st, parts, [seeds[0], 5000], "new vertex")
+
+    # phase 5: vertex tag update rides the delta too
+    st.update_vertex("g", seeds[1], "person", {"age": 99})
+    assert rt.pin(st, "g") is dev
+    three_way(rt, st, parts, seeds, "tag update")
+
+    three_way(rt, st, parts, seeds, "reverse", direction="in")
+    three_way(rt, st, parts, seeds, "bidirect", direction="both")
+    assert rt.pin(st, "g") is dev, \
+        "the whole interleaving should ride one pinned snapshot"
+    assert stats().snapshot().get("tpu_repin_avoided", 0) > 0
+
+
+def test_full_fill_and_overflow_fall_back(delta_cfg):
+    """Fill one (block, part) row to the padded cap — parity holds at
+    fill_ratio 1.0 — then overflow it: the runtime falls back to a
+    full rebuild (fresh snapshot object) and rows stay correct."""
+    get_config().set_dynamic("tpu_delta_max_edges", 8)
+    st = store_p(1, seed=31, n=40, avg_deg=2)
+    rt = TpuRuntime(make_mesh(1))
+    dev = rt.pin(st, "g")
+    dcap = dev.delta.host.dcap
+    for i in range(dcap):
+        st.insert_edge("g", 1, "knows", (i * 3) % 40, 60 + i,
+                       {"w": i, "f": .5, "tag": "x"})
+    three_way(rt, st, 1, [1], "full fill")
+    assert rt.pin(st, "g") is dev
+    assert dev.delta.host.fill_ratio() == 1.0
+    # one more insert into the same (block, part): DeltaOverflow →
+    # rebuild path (new snapshot, delta drained into the base)
+    st.insert_edge("g", 1, "knows", 39, 999, {"w": -1, "f": 0, "tag": "o"})
+    dev2 = rt.pin(st, "g")
+    assert dev2 is not dev, "overflow must force a full rebuild"
+    assert dev2.delta is not None and \
+        dev2.delta.host.total_edges() == 0, "rebuild drains the delta"
+    three_way(rt, st, 1, [1], "post overflow")
+
+
+def test_off_switch_is_byte_identical(delta_cfg):
+    """`tpu_delta_max_edges=0`: no delta plane is armed, every epoch
+    bump re-pins (the pre-delta behavior), and rows match the delta-on
+    runtime exactly."""
+    get_config().set_dynamic("tpu_delta_max_edges", 0)
+    st = store_p(2, seed=40)
+    rt = TpuRuntime(make_mesh(2))
+    dev = rt.pin(st, "g")
+    assert dev.delta is None
+    st.insert_edge("g", 1, "knows", 2, 77, {"w": 1, "f": .1, "tag": "t"})
+    dev2 = rt.pin(st, "g")
+    assert dev2 is not dev, "off switch must re-pin on every write"
+    assert dev2.delta is None
+    # steps=1 so the fresh edge is IN the row set (GO N STEPS yields
+    # only the edges at step N)
+    off = dev_rows(rt, st, [1, 5, 9], steps=1)
+    get_config().set_dynamic("tpu_delta_max_edges", 64)
+    rt_on = TpuRuntime(make_mesh(2))
+    rt_on.pin(st, "g")
+    st.insert_edge("g", 1, "knows", 3, 78, {"w": 2, "f": .2, "tag": "t"})
+    on = dev_rows(rt_on, st, [1, 5, 9], steps=1)
+    get_config().set_dynamic("tpu_delta_max_edges", 0)
+    off2 = dev_rows(rt, st, [1, 5, 9], steps=1)
+    assert on == off2 and off != on  # the new edge is visible both ways
+    assert host_rows(st, "g", [1, 5, 9], steps=1) == on
+
+
+# -- compaction -------------------------------------------------------------
+
+
+def wait_for(pred, timeout=20.0, msg="condition"):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        if pred():
+            return
+        time.sleep(0.02)
+    pytest.fail(f"timed out waiting for {msg}")
+
+
+def test_compaction_swap_under_concurrent_traversal(delta_cfg):
+    """Past the watermark the background job rebuilds the base OFF the
+    gate and swaps it under a short hold while traversals keep
+    running; afterwards the new snapshot serves a drained delta and
+    parity holds."""
+    get_config().set_dynamic_many({"tpu_delta_max_edges": 8,
+                                   "tpu_delta_compact_watermark": 0.5})
+    st = store_p(2, seed=50)
+    rt = TpuRuntime(make_mesh(2))
+    dev = rt.pin(st, "g")
+    c0 = stats().snapshot().get("tpu_compactions", 0)
+
+    stop = threading.Event()
+    errs = []
+
+    from nebula_tpu.tpu.device import TpuUnavailable
+
+    def churn():
+        while not stop.is_set():
+            try:
+                rows, _ = rt.traverse(st, "g", [1, 5], ["knows"],
+                                      "out", 2)
+            except TpuUnavailable:
+                # the swap retired our snapshot mid-flight — the
+                # engine-level contract is "caller re-pins / falls
+                # back"; the next loop iteration re-pins
+                continue
+            except Exception as ex:  # noqa: BLE001
+                errs.append(repr(ex))
+                return
+
+    ths = [threading.Thread(target=churn, daemon=True) for _ in range(2)]
+    for t in ths:
+        t.start()
+    try:
+        for i in range(6):      # past 0.5 * dcap(8) in one part row
+            st.insert_edge("g", 1, "knows", (i * 7) % 90, 60 + i,
+                           {"w": i, "f": .5, "tag": "c"})
+        rt.pin(st, "g")          # apply → watermark check → kick job
+        wait_for(lambda: stats().snapshot().get("tpu_compactions", 0)
+                 > c0, msg="background compaction")
+    finally:
+        stop.set()
+        for t in ths:
+            t.join(30)
+    assert not errs, errs[:3]
+    new = rt.snapshots["g"]
+    assert new is not dev, "compaction must swap in a fresh base"
+    assert new.delta is not None and new.delta.host.total_edges() == 0, \
+        "compaction folds the delta into the base"
+    three_way(rt, st, 2, [1, 5], "post compaction")
+    # the swap re-armed the watch: writes keep riding the delta
+    st.insert_edge("g", 5, "knows", 9, 61, {"w": 7, "f": .7, "tag": "c"})
+    assert rt.pin(st, "g") is new
+
+
+def test_kill_during_compaction_aborts_cleanly(delta_cfg):
+    """The `tpu:compact_swap` failpoint fires between the off-gate
+    build and the swap: the job aborts, the serving snapshot and its
+    delta stay intact, reads stay correct, and the NEXT compaction
+    (failpoint disarmed) succeeds."""
+    get_config().set_dynamic_many({"tpu_delta_max_edges": 8,
+                                   "tpu_delta_compact_watermark": 0.5})
+    st = store_p(1, seed=60, n=50)
+    rt = TpuRuntime(make_mesh(1))
+    dev = rt.pin(st, "g")
+    c0 = stats().snapshot().get("tpu_compactions", 0)
+    fail.arm("tpu:compact_swap", "raise")
+    for i in range(6):
+        st.insert_edge("g", 1, "knows", (i * 3) % 50, 70 + i,
+                       {"w": i, "f": .5, "tag": "k"})
+    rt.pin(st, "g")
+    wait_for(lambda: not getattr(dev, "_compacting", False),
+             msg="aborted compaction thread")
+    assert stats().snapshot().get("tpu_compactions", 0) == c0, \
+        "killed compaction must not count as one"
+    assert rt.snapshots["g"] is dev, "killed compaction must not swap"
+    assert dev.delta.host.total_edges() > 0, \
+        "killed compaction must leave the delta intact"
+    three_way(rt, st, 1, [1], "after killed compaction")
+    # disarm and write again: the retry compacts for real
+    fail.reset()
+    st.insert_edge("g", 1, "knows", 2, 90, {"w": 1, "f": .1, "tag": "k"})
+    rt.pin(st, "g")
+    wait_for(lambda: stats().snapshot().get("tpu_compactions", 0) > c0,
+             msg="retry compaction")
+    wait_for(lambda: rt.snapshots["g"] is not dev, msg="swap")
+    three_way(rt, st, 1, [1], "after retry compaction")
+
+
+# -- freshness: the group-commit ack path -----------------------------------
+
+
+def test_read_your_writes_through_engine(delta_cfg):
+    """Engine-level INSERT → GO on the device plane: the ack'd write is
+    visible to the next statement via the delta fast path (no re-pin),
+    holding the PR 9 read-your-writes floor."""
+    st = store_p(2, seed=70)
+    rt = TpuRuntime(make_mesh(2))
+    eng = QueryEngine(st, tpu_runtime=rt)
+    s = eng.new_session()
+    assert eng.execute(s, "USE g").error is None
+    rs = eng.execute(s, "GO FROM 1 OVER knows YIELD dst(edge) AS d")
+    assert rs.error is None
+    dev = rt.snapshots["g"]
+    assert dev.delta is not None
+    r0 = stats().snapshot().get("tpu_repin_avoided", 0)
+    assert eng.execute(
+        s, 'INSERT EDGE knows(w, f, tag) VALUES 1->77@55:(9, 0.5, "x")'
+    ).error is None
+    rs = eng.execute(s, "GO FROM 1 OVER knows YIELD dst(edge) AS d")
+    assert rs.error is None
+    assert [77] == sorted(x[0] for x in rs.data.rows
+                          if x[0] == 77), "ack'd write not visible"
+    assert rt.snapshots["g"] is dev, "fresh read must not re-pin"
+    assert stats().snapshot().get("tpu_repin_avoided", 0) > r0
+    # gauges follow the plane
+    snap = stats().snapshot()
+    assert snap.get("tpu_delta_edges", 0) >= 1
+    assert snap.get("tpu_delta_bytes", 0) > 0
+
+
+# -- cluster feed -----------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_cluster_delta_fast_path(tmp_path, delta_cfg):
+    """DistributedStore feeds the delta: a write through the graphd's
+    own store rides the dirty-key log (census-covered) into the pinned
+    snapshot without a re-export; rows match the host path."""
+    from nebula_tpu.cluster.launcher import LocalCluster
+
+    rt = TpuRuntime(make_mesh())
+    c = LocalCluster(n_meta=1, n_storage=2, n_graph=1,
+                     data_dir=str(tmp_path), tpu_runtime=rt)
+    try:
+        cl = c.client()
+        r = cl.execute("CREATE SPACE dd(partition_num=8, "
+                       "replica_factor=1, vid_type=INT64)")
+        assert r.error is None, r.error
+        c.reconcile_storage()
+        for q in ["USE dd", "CREATE TAG T()", "CREATE EDGE E(w int)",
+                  "INSERT VERTEX T() VALUES 1:(), 2:(), 3:(), 4:()",
+                  "INSERT EDGE E(w) VALUES 1->2:(1), 2->3:(2)"]:
+            assert cl.execute(q).error is None, q
+        r = cl.execute("GO FROM 1 OVER E YIELD dst(edge) AS d")
+        assert r.error is None
+        assert sorted(x[0] for x in r.data.rows) == [2]
+        dev = rt.snapshots.get("dd")
+        assert dev is not None and dev.delta is not None, \
+            "cluster pin did not arm the delta plane"
+        r0 = stats().snapshot().get("tpu_repin_avoided", 0)
+        assert cl.execute("INSERT EDGE E(w) VALUES 1->3:(3), 1->4:(4)"
+                          ).error is None
+        r = cl.execute("GO FROM 1 OVER E YIELD dst(edge) AS d")
+        assert r.error is None
+        assert sorted(x[0] for x in r.data.rows) == [2, 3, 4]
+        assert rt.snapshots["dd"] is dev, \
+            "cluster write should ride the delta, not re-export"
+        assert stats().snapshot().get("tpu_repin_avoided", 0) > r0
+        # delete through the cluster write path → tombstone
+        assert cl.execute("DELETE EDGE E 1->2@0").error is None
+        r = cl.execute("GO FROM 1 OVER E YIELD dst(edge) AS d")
+        assert r.error is None
+        assert sorted(x[0] for x in r.data.rows) == [3, 4]
+        assert rt.snapshots["dd"] is dev
+    finally:
+        c.stop()

@@ -315,3 +315,42 @@ def test_meta_dump_live_cluster(tmp_path, capsys):
             and "part 0:" in out
     finally:
         c.stop()
+
+
+def test_metrics_dump_deltas_view(capsys):
+    """--deltas (ISSUE 19): per-shard delta fill rows, the
+    repin-avoided share and compaction count, scraped from the
+    prometheus exposition."""
+    from nebula_tpu.cluster.webservice import WebService
+    from nebula_tpu.tools import metrics_dump
+    from nebula_tpu.utils.stats import stats
+
+    st = stats()
+    with st.lock:
+        st.labeled_gauges.pop("tpu_shard_delta_edges", None)
+    st.gauge("tpu_delta_edges", 30.0)
+    st.gauge("tpu_delta_bytes", 4096.0)
+    for p in range(4):
+        st.gauge_labeled("tpu_shard_delta_edges", {"shard": p},
+                         float(10 - p))
+    pins0 = st.snapshot().get("tpu_pins", 0)
+    avoided0 = st.snapshot().get("tpu_repin_avoided", 0)
+    comps0 = st.snapshot().get("tpu_compactions", 0)
+    st.inc("tpu_repin_avoided", 3)
+    st.inc("tpu_compactions", 1)
+    ws = WebService(role="graphd")
+    ws.start()
+    try:
+        rc = metrics_dump.main(["--addr", ws.addr, "--deltas"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "delta plane: 30 rows, 4096 bytes" in out
+        assert "shard 0" in out and "shard 3" in out
+        assert "delta_rows=10" in out
+        assert f"repins avoided: {int(avoided0) + 3} " \
+               f"vs pins {int(pins0)}" in out
+        assert f"compactions: {int(comps0) + 1}" in out
+    finally:
+        ws.stop()
+        st.gauge("tpu_delta_edges", 0.0)
+        st.gauge("tpu_delta_bytes", 0.0)

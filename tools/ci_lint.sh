@@ -13,13 +13,22 @@
 #      must stay byte-identical to the single-chip runtime, and the
 #      two-axis mesh constructor must keep its degrade ladder — the
 #      two invariants every sharded-plane change can silently break.
+#   3. the fast delta-parity subset (ISSUE 19): a 2-part merged
+#      base+delta traversal across an insert/delete/resurrect
+#      interleaving must stay byte-identical to a full rebuild and
+#      the host oracle — the invariant every delta-plane change can
+#      silently break.
 #
 #   tools/ci_lint.sh [extra pytest args...]
 set -e
 cd "$(dirname "$0")/.."
 env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
     python -m pytest tests/ -q -m lint -p no:cacheprovider "$@"
-exec env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
     python -m pytest -q -p no:cacheprovider \
     "tests/unit/test_sharded.py::test_go_parity_sharded_vs_single_chip[2]" \
     tests/unit/test_sharded.py::test_mesh2_grid_and_degrade "$@"
+exec env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+    python -m pytest -q -p no:cacheprovider \
+    "tests/unit/test_delta.py::test_interleaved_writes_parity[2]" \
+    tests/unit/test_delta.py::test_off_switch_is_byte_identical "$@"
