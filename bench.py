@@ -1208,6 +1208,32 @@ def main():
         htap = {"error": repr(ex)}
     _save_partial(platform, configs)
 
+    # ---- fleet block (ISSUE 20): coordinator scale-out + fleet QoS —
+    # a 10k-session storm over 3 graphds, then the same mixed GO/MATCH
+    # offered load against 1 coordinator vs the fleet of 3 under the
+    # same per-coordinator statement capacity
+    # (graph_statement_capacity_qps, calibrated below the host's raw
+    # throughput), then a scarce-slot DWRR phase with an aggressor
+    # tenant.  Headlines: fleet_goodput_x (>= 2.5) and dwrr_share_held
+    # (vip admitted share within 0.15 of its 3:1 weight under a 2x
+    # aggressor).
+    _mark("config fleet: 3-graphd scale-out + session storm + DWRR")
+    try:
+        from nebula_tpu.tools.overload_bench import (
+            fleet_sweep as _fleet_sweep)
+        fleet = _fleet_sweep(
+            persons=int(os.environ.get("NEBULA_BENCH_FLEET_PERSONS",
+                                       1200)),
+            workers=int(os.environ.get("NEBULA_BENCH_FLEET_THREADS", 18)),
+            duration_s=float(os.environ.get("NEBULA_BENCH_FLEET_SECS",
+                                            3.0)),
+            n_sessions=int(os.environ.get("NEBULA_BENCH_FLEET_SESSIONS",
+                                          10_000)),
+            tpu_runtime=rt)
+    except Exception as ex:  # noqa: BLE001 — must not sink the run
+        fleet = {"error": repr(ex)}
+    _save_partial(platform, configs)
+
     # ---- self_heal block (ISSUE 14): kill one of a part's three
     # replicas under live mixed load and measure the repair plane —
     # time_to_full_redundancy (kill → part map fully rf=3 on live
@@ -1439,6 +1465,7 @@ def main():
         "batching": batching,
         "read_scaleout": read_scaleout,
         "htap": htap,
+        "fleet": fleet,
         "self_heal": self_heal,
         "algo": algo_block,
         "multichip": multichip,
@@ -1496,6 +1523,13 @@ def main():
         # proof, parity verdicts, exchange bytes/hop and probe_status)
         hl["multichip_x"] = multichip["speedup_Nshard_vs_1"]
         hl["probe_status"] = multichip.get("probe_status")
+    if isinstance(fleet, dict) and \
+            fleet.get("fleet_goodput_x") is not None:
+        # ISSUE 20: 3-coordinator goodput vs one under the same
+        # per-coordinator capacity, plus the DWRR share-hold verdict
+        # (detail has the session storm, both arms, the tenant split)
+        hl["fleet_goodput_x"] = fleet["fleet_goodput_x"]
+        hl["dwrr_held"] = bool(fleet.get("dwrr_share_held"))
     if isinstance(self_heal, dict) and self_heal.get("healed"):
         # ISSUE 14: kill-one-of-three auto-repair — seconds from the
         # kill to full redundancy with zero acked-write loss (detail

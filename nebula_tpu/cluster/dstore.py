@@ -137,6 +137,10 @@ class DistributedStore:
         self._applied_floor: Dict[tuple, int] = {}
         import threading
         self._floor_lock = threading.Lock()
+        # cluster cache epochs (ISSUE 20): set by GraphService to fold
+        # write-ack store epochs into the engine's ClusterEpochs —
+        # (space, epoch) -> None
+        self.on_epoch_ack = None
         # device delta feed (ISSUE 19): dirty-key log per watched space.
         # Keys are noted BEFORE the writes ship (a crash mid-send leaves
         # a superset — harmless, apply re-reads per key); coverage
@@ -177,6 +181,12 @@ class DistributedStore:
         if log is not None and reply.get("epoch"):
             with self._delta_lock:
                 log.note_epoch(pid, int(reply["epoch"]))
+        if self.on_epoch_ack is not None and reply.get("epoch"):
+            # cluster cache epochs (ISSUE 20): the ack's store epoch
+            # folds into the engine's epoch vector immediately — the
+            # WRITING coordinator's caches turn over at ack latency,
+            # not heartbeat latency
+            self.on_epoch_ack(space, reply["epoch"])
         idx = int(reply.get("applied") or 0)
         if idx <= 0:
             return

@@ -93,12 +93,40 @@ class LocalCluster:
     def graph_addr(self) -> str:
         return self.graph_servers[0].addr
 
-    def client(self, user: str = "root", password: str = "nebula"
-               ) -> GraphClient:
-        host, port = self.graph_addr.rsplit(":", 1)
+    @property
+    def graph_addrs(self) -> List[str]:
+        return [s.addr for s in self.graph_servers]
+
+    def client(self, user: str = "root", password: str = "nebula",
+               graphd: int = 0) -> GraphClient:
+        host, port = self.graph_servers[graphd].addr.rsplit(":", 1)
         c = GraphClient(host, int(port))
         c.authenticate(user, password)
         return c
+
+    def fleet_client(self, user: str = "root", password: str = "nebula"
+                     ) -> GraphClient:
+        """A failover-capable client holding EVERY graphd endpoint
+        (ISSUE 20): coordinator selection + transparent E_SESSION_MOVED
+        / crash failover per the GraphClient fleet contract."""
+        c = GraphClient(self.graph_addrs)
+        c.authenticate(user, password)
+        return c
+
+    def stop_graphd(self, i: int):
+        """Hard-stop one graphd (coordinator-crash injection): raw
+        connection resets for its clients, sessions adoptable by
+        siblings from the metad-replicated table."""
+        self.graphds[i].stop()
+        self.graph_servers[i].stop()
+
+    def drain_graphd(self, i: int, timeout_s: Optional[float] = None) -> int:
+        """Graceful stop of one graphd (planned restart): refuse new
+        statements with E_SESSION_MOVED + sibling hint, let in-flight
+        ones finish, then stop.  Returns sessions handed off."""
+        n = self.graphds[i].drain(timeout_s)
+        self.stop_graphd(i)
+        return n
 
     def add_storaged(self) -> StorageService:
         """Join a new storage host to the running cluster (the balance

@@ -62,6 +62,8 @@ class MetaClient:
         self._stop = threading.Event()
         self._hb_parts_fn = None          # set by storaged: () -> {space: [pid]}
         self._hb_heat_fn = None           # set by storaged: () -> PartHeat rows
+        self._hb_epochs_fn = None         # set by storaged: () -> epoch vector
+        self.on_epochs = None             # set by graphd: merged table fold
         self.on_refresh = None            # hook: called after a cache refresh
 
     # -- leader discovery -------------------------------------------------
@@ -148,15 +150,32 @@ class MetaClient:
         # folds the QPS EWMAs forward, so metad's view decays with the
         # heartbeat cadence; an empty/None payload costs nothing
         heat = self._hb_heat_fn() if self._hb_heat_fn else None
+        # per-space store epochs ride up (storaged) and the merged
+        # cluster table rides every reply down (ISSUE 20) — the fleet
+        # cache-coherence plane needs no RPC of its own
+        epochs = self._hb_epochs_fn() if self._hb_epochs_fn else None
         r = self.call("meta.heartbeat", host=self.my_addr, role=self.role,
-                      parts=parts, ws=self.ws_addr, heat=heat)
+                      parts=parts, ws=self.ws_addr, heat=heat,
+                      epochs=epochs)
         if r["version"] != self.version:
             self.refresh(force=True)
+        if self.on_epochs is not None and r.get("epochs"):
+            try:
+                self.on_epochs(r["epochs"])
+            except Exception:  # noqa: BLE001 — fold must never kill the hb
+                pass
         return r
 
-    def start_heartbeat(self, parts_fn=None, heat_fn=None):
+    def cluster_epochs(self) -> Dict[str, Any]:
+        """Pull metad's merged epoch table on demand — the strict
+        check-at-admission leg (ISSUE 20): one round-trip buys leader
+        reads exactness instead of the heartbeat-bounded window."""
+        return self.call("meta.cluster_epochs").get("epochs") or {}
+
+    def start_heartbeat(self, parts_fn=None, heat_fn=None, epochs_fn=None):
         self._hb_parts_fn = parts_fn
         self._hb_heat_fn = heat_fn
+        self._hb_epochs_fn = epochs_fn
         self._stop.clear()
 
         def loop():
@@ -236,6 +255,12 @@ class MetaClient:
 
     def list_sessions(self):
         return self.call("meta.list_sessions")
+
+    def session_gone(self, sid: int) -> bool:
+        try:
+            return bool(self.call("meta.session_gone", sid=sid).get("gone"))
+        except Exception:  # noqa: BLE001 — old metad: no tombstones
+            return False
 
     def list_hosts(self):
         return self.call("meta.list_hosts")

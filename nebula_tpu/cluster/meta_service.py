@@ -108,6 +108,12 @@ class MetaState:
         self.next_repair = 1
         self.sessions: Dict[int, Dict[str, Any]] = {}
         self.next_session = 1
+        # bounded tombstones of removed sids (ISSUE 20): KILL SESSION
+        # from any coordinator must be idempotent — the second kill
+        # (or a kill racing the owner's death/signout) finds the row
+        # gone and needs to distinguish "already killed" (quiet
+        # success) from "never existed" (error)
+        self.removed_sessions: List[int] = []
         self.configs: Dict[str, Any] = {}
         self.jobs: Dict[int, Dict[str, Any]] = {}
         self.next_job = 1
@@ -163,7 +169,9 @@ class MetaState:
             s.update(c["fields"])
 
     def _ap_remove_session(self, c):
-        self.sessions.pop(c["sid"], None)
+        if self.sessions.pop(c["sid"], None) is not None:
+            self.removed_sessions.append(c["sid"])
+            del self.removed_sessions[:-512]
 
     def _ap_set_config(self, c):
         self.configs[c["name"]] = c["value"]
@@ -364,6 +372,14 @@ class MetaService:
         self.state_lock = make_lock("meta_state")
         # addr → {"role", "last_hb" (monotonic), "parts": {space: [pids]}}
         self.active_hosts: Dict[str, Dict[str, Any]] = {}
+        # merged cluster epoch vector (ISSUE 20): space → {storaged:
+        # [boot, epoch, bump_ts]}.  Leader-local like liveness/heat —
+        # deliberately NOT raft-replicated; a fresh leader rebuilds it
+        # from the next storaged heartbeat wave, and the graphd-side
+        # fold is per-host-boot monotonic so the brief hole can only
+        # delay invalidations, never resurrect a retired cache key.
+        self.cluster_epochs_tbl: Dict[str, Dict[str, list]] = {}
+        self._epochs_lock = threading.Lock()
         # post-election liveness grace (ISSUE 14 satellite): liveness is
         # leader-local, so a FRESH metad leader knows no heartbeats —
         # every host would read dead until they re-arrive.  Until one
@@ -454,9 +470,34 @@ class MetaService:
             # per-partition heat rows (ISSUE 16): storaged's PartHeat
             # snapshot rides every heartbeat; rpc_hotspots merges them
             "heat": p.get("heat") or []}
+        # fold the host's per-space store epochs into the merged table
+        # (ISSUE 20): same boot → max-merge, new boot → replace.  The
+        # merged table rides EVERY heartbeat reply (graphd and storaged
+        # alike), so cache coherence needs no RPC of its own.
+        with self._epochs_lock:
+            for space, ent in (p.get("epochs") or {}).items():
+                try:
+                    boot, epoch = ent[0], int(ent[1])
+                except (TypeError, ValueError, IndexError):
+                    continue
+                hosts = self.cluster_epochs_tbl.setdefault(space, {})
+                cur = hosts.get(host)
+                if cur is None or cur[0] != boot or epoch > int(cur[1]):
+                    hosts[host] = [boot, epoch,
+                                   ent[2] if len(ent) > 2 else None]
+            merged = {sp: dict(hosts)
+                      for sp, hosts in self.cluster_epochs_tbl.items()}
         with self.state_lock:
             return {"version": self.state.version,
-                    "leader": self.raft.is_leader()}
+                    "leader": self.raft.is_leader(),
+                    "epochs": merged}
+
+    def rpc_cluster_epochs(self, p):
+        """On-demand merged epoch table — the strict check-at-admission
+        leg of ISSUE 20 (leader-consistency cached reads) and tooling."""
+        with self._epochs_lock:
+            return {"epochs": {sp: dict(hosts) for sp, hosts
+                               in self.cluster_epochs_tbl.items()}}
 
     def _grace_window_s(self) -> float:
         """How long a fresh leader withholds OFFLINE verdicts: one full
@@ -665,6 +706,13 @@ class MetaService:
         with self.state_lock:
             return [{"sid": k, **v}
                     for k, v in sorted(self.state.sessions.items())]
+
+    def rpc_session_gone(self, p):
+        """True iff `sid` WAS a session and has been removed — the
+        idempotent-kill predicate (double KILL SESSION from any
+        coordinator succeeds quietly; a garbage sid still errors)."""
+        with self.state_lock:
+            return {"gone": p["sid"] in self.state.removed_sessions}
 
     def rpc_set_config(self, p):
         return self._propose({"op": "set_config", "name": p["name"],

@@ -194,6 +194,15 @@ class StorageService:
         # replica router / BALANCE planner can consult heat_of()
         from ..utils.insights import PartHeatTable
         self.part_heat = PartHeatTable()
+        # cluster-coherent cache epochs (ISSUE 20): per-space store
+        # epochs ride the heartbeat as (boot, epoch, bump_ts).  boot_id
+        # distinguishes this process incarnation — store epochs reset on
+        # restart, and the graphd-side fold must treat a restarted
+        # host's low epoch as news, not as a regression.
+        import uuid
+        self.boot_id = uuid.uuid4().hex[:12]
+        from ..utils.epochs import EpochClock
+        self._epoch_clock = EpochClock()
         self.transport = RpcRaftTransport()
         self.server = server
         server.service_role = "storaged"
@@ -349,6 +358,16 @@ class StorageService:
                 stats().inc("storage_apply_errors")
                 self._apply_errors.record((group, idx), str(ex))
             finally:
+                # epoch bump-timestamp (ISSUE 20): every applied entry
+                # may have advanced the space epoch — stamp the advance
+                # so the heartbeat can ship a true bump ts and graphds
+                # measure propagation lag, not heartbeat cadence.
+                # Apply-side, so followers stamp their own applies too.
+                try:
+                    self._epoch_clock.note(
+                        space_name, self.store.space(space_name).epoch)
+                except Exception:  # noqa: BLE001 — space dropped mid-apply
+                    pass
                 # census counts EVERY entry, applied or failed, dedup-
                 # skipped or not — symmetry is what matters: the client
                 # compares (total - baseline) against (mine - baseline),
@@ -447,9 +466,24 @@ class StorageService:
             raise ValueError(errs[0] + (f" (+{len(errs) - 1} more)"
                                         if len(errs) > 1 else ""))
 
+    def epochs_for_heartbeat(self) -> Dict[str, list]:
+        """{space: [boot_id, epoch, bump_ts]} for every space with local
+        data — the per-host leg of the cluster epoch vector (ISSUE 20).
+        bump_ts is None for an epoch that advanced outside the apply
+        path's clock (no lag sample for it, never a wrong one)."""
+        out: Dict[str, list] = {}
+        for sd in list(self.store.data.values()):
+            ep = sd.epoch
+            if ep <= 0:
+                continue
+            name = sd.desc.name
+            out[name] = [self.boot_id, ep, self._epoch_clock.ts_for(name, ep)]
+        return out
+
     def start(self):
         self.meta.start_heartbeat(parts_fn=self.owned_parts,
-                                  heat_fn=self.part_heat.snapshot)
+                                  heat_fn=self.part_heat.snapshot,
+                                  epochs_fn=self.epochs_for_heartbeat)
         self._resume_alive = True
         self._resume_thread = threading.Thread(
             target=self._chain_resume_loop, daemon=True,

@@ -17,6 +17,8 @@ mount, SURVEY §0]):
     GET /admission       overload plane (ISSUE 10): admission slots,
                          queue depth by session, watermark memory,
                          observed drain rate
+    GET /tenants         tenant QoS plane (ISSUE 20): per-tenant DWRR
+                         weight / running / queued / admitted share
     GET /stalls          stall-watchdog captures (`?id=<n>` for one
                          capture's full thread stacks / dispatch table
                          / kernel-ledger tail)
@@ -165,6 +167,15 @@ class WebService:
                     from ..utils.admission import admission
                     self._send(200, json.dumps(admission().snapshot(),
                                                default=str),
+                               "application/json")
+                elif u.path == "/tenants":
+                    # tenant QoS plane (ISSUE 20): per-tenant DWRR
+                    # weight / running / queued / admitted share on
+                    # THIS coordinator (SHOW TENANTS merges the fleet)
+                    from ..utils.admission import admission
+                    self._send(200,
+                               json.dumps(admission().tenant_snapshot(),
+                                          default=str),
                                "application/json")
                 elif u.path == "/stalls":
                     from ..utils.workload import stall_watchdog

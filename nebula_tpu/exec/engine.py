@@ -45,6 +45,12 @@ _define_flag("result_cache_size", 0,
              "every cached result.  Hot repeated reads then serve "
              "from graphd memory — surviving even total storage "
              "unavailability within an epoch")
+_define_flag("result_cache_strict_epoch", False,
+             "leader-consistency cached reads pull metad's merged "
+             "cluster epoch table at admission (one RPC) before the "
+             "cache key is formed — closes even the heartbeat window "
+             "for cross-coordinator invalidation (ISSUE 20); weaker "
+             "consistency levels keep the bounded heartbeat window")
 
 # read-only statement kinds whose plans are reusable verbatim: planning
 # depends only on (text, space, catalog) for these.  DML/DDL/admin
@@ -250,6 +256,11 @@ class QueryEngine:
             _cap = 256
         self.slow_log: "deque" = deque(maxlen=max(_cap, 1))
         self.sessions: Dict[int, Session] = {}
+        # recently-killed qids (ISSUE 20): double KILL QUERY is
+        # idempotent — the second kill of a qid that already matched
+        # (and may have since drained away) succeeds instead of raising
+        # "no running query matches"
+        self._recent_kills: "deque" = deque(maxlen=256)
         # parse/plan LRU (ISSUE 2): repeated statements skip
         # parse → validate → plan → optimize entirely
         self.plan_cache = PlanCache()
@@ -257,6 +268,19 @@ class QueryEngine:
         # execution entirely, invalidated by the same schema epoch plus
         # the engine's write epoch (0-capacity default = disabled)
         self.result_cache = ResultCache()
+        # cluster-coherent cache epochs (ISSUE 20): peers' per-space
+        # write epochs, folded from metad heartbeat replies and from
+        # this graphd's own storaged write acks.  gen(space) is part of
+        # every cache key — a write through ANY coordinator retires
+        # this engine's cached entries within the heartbeat window.
+        # Standalone engines never fold, so gen stays 0 and keys are
+        # byte-identical to the pre-fleet engine.
+        from ..utils.epochs import ClusterEpochs
+        self.cluster_epochs = ClusterEpochs()
+        # strict-mode hook (set by GraphService): pull + fold metad's
+        # merged epoch table on demand, for leader-consistency cached
+        # reads under `result_cache_strict_epoch`
+        self.epoch_sync = None
         # workload insights (ISSUE 16): per-fingerprint aggregates
         # behind SHOW STATEMENTS.  Per ENGINE, not process-wide: a
         # LocalCluster runs several graphds in one process and the
@@ -345,6 +369,13 @@ class QueryEngine:
                         # drains toward its next cancellation check
                         lq.killed = True
                     hit = True
+                    if q not in self._recent_kills:
+                        self._recent_kills.append(q)
+        if not hit and qid is not None and qid in self._recent_kills:
+            # double-kill idempotency (ISSUE 20): the first kill
+            # matched and the victim has since drained — killing an
+            # already-killed query is a quiet no-op success
+            hit = True
         return hit
 
     @property
@@ -398,6 +429,20 @@ class QueryEngine:
         epoch = getattr(self.qctx.catalog, "version", 0)
         return (text, session.space, epoch, tpu_on)
 
+    def _strict_epoch_check(self) -> bool:
+        """True when this cached read must consult metad's merged epoch
+        table first: `result_cache_strict_epoch` is on AND the read
+        asked for leader consistency (weaker levels accepted bounded
+        staleness by contract — the heartbeat window is within it)."""
+        from ..utils.config import get_config
+        try:
+            if not bool(get_config().get("result_cache_strict_epoch")):
+                return False
+        except Exception:  # noqa: BLE001 — config not initialized
+            return False
+        from ..utils.consistency import LEADER, effective_consistency
+        return effective_consistency() == LEADER
+
     def execute(self, session: Session, text: str,
                 params: Optional[Dict[str, Any]] = None) -> ResultSet:
         t0 = time.perf_counter()
@@ -418,7 +463,23 @@ class QueryEngine:
         # the key covers grants/revokes for the same user.
         rkey = None
         if key is not None and ResultCache.capacity() > 0:
-            rkey = key + (session.user, self.qctx.write_epoch)
+            # strict check-at-admission (ISSUE 20): a leader-consistency
+            # read under `result_cache_strict_epoch` pulls metad's
+            # merged epoch table BEFORE the key is formed — a write
+            # acked through any coordinator that reached metad retires
+            # the entry before this read can hit it.  Best-effort: a
+            # metad hiccup degrades to the heartbeat-bounded window,
+            # never blocks the read.
+            if self.epoch_sync is not None and self._strict_epoch_check():
+                try:
+                    self.epoch_sync()
+                except Exception:  # noqa: BLE001
+                    pass
+            # the cluster generation joins the coordinator-local write
+            # epoch in the key: local writes invalidate at statement
+            # granularity, peers' writes at fold granularity
+            rkey = key + (session.user, self.qctx.write_epoch,
+                          self.cluster_epochs.gen(session.space))
             ent = self.result_cache.get(rkey)
             if ent is not None:
                 return self._result_cache_hit(session, text, ent, t0)
@@ -751,7 +812,7 @@ class QueryEngine:
                 ticket = _adm.admission().acquire(
                     qid=qid, session=session.id,
                     kind=self._stmt_kind(stmt), live=live,
-                    tracker=stmt_ectx.tracker)
+                    tracker=stmt_ectx.tracker, user=session.user)
                 if ticket is not None and ticket.queue_wait_us:
                     # pseudo-operator: the admission wait reaches the
                     # flight recorder next to the real plan nodes

@@ -1874,6 +1874,49 @@ def _show(node, qctx, ectx, space):
                                merge_statement_snapshots(snaps)))
         snap = eng.insights.snapshot() if eng is not None else []
         return DataSet(stcols, statement_columns(snap))
+    if kind == "tenants":
+        # fleet tenant QoS view (ISSUE 20): per-tenant DWRR weight,
+        # live running/queued and lifetime admission share, summed
+        # across every graph host's admission controller.  SHOW LOCAL
+        # TENANTS reads only this process's controller.
+        tcols = ["Tenant", "Weight", "Running", "Queued", "Admitted",
+                 "Share", "Graphds"]
+        from ..utils.admission import admission
+        cluster = getattr(qctx, "cluster", None)
+        if a.get("extra") == "local":
+            cluster = None
+        snaps = []
+        if cluster is not None:
+            for h in cluster.list_hosts():
+                if h.get("role") != "graph" or not h.get("addr"):
+                    continue
+                try:
+                    snaps.append(_graphd_call(h["addr"],
+                                              "graph.tenant_snapshot"))
+                except Exception:  # noqa: BLE001 — graphd down
+                    continue
+        if not snaps:
+            snaps = [admission().tenant_snapshot()]
+        merged: Dict[str, list] = {}
+        for snap in snaps:
+            for r in snap or []:
+                m = merged.get(r["tenant"])
+                if m is None:
+                    merged[r["tenant"]] = [r["tenant"], r["weight"],
+                                           r["running"], r["queued"],
+                                           r["admitted"], 0.0, 1]
+                else:
+                    m[1] = max(m[1], r["weight"])
+                    m[2] += r["running"]
+                    m[3] += r["queued"]
+                    m[4] += r["admitted"]
+                    m[6] += 1
+        total = sum(m[4] for m in merged.values()) or 1
+        rows = []
+        for m in sorted(merged.values()):
+            m[5] = round(m[4] / total, 4)
+            rows.append(m)
+        return DataSet(tcols, rows)
     if kind == "hotspots":
         # per-partition heat map (ISSUE 16): metad merges the PartHeat
         # tables ridden up on every storaged heartbeat and ranks parts
@@ -2054,6 +2097,13 @@ def _kill_session(node, qctx, ectx, space):
         sess = next((s for s in cluster.list_sessions()
                      if s["sid"] == sid), None)
         if sess is None:
+            # Double-kill idempotency (ISSUE 20): metad keeps a bounded
+            # tombstone list of removed sids.  A sid that existed and
+            # was killed means the goal state already holds — quiet
+            # success.  A sid that never existed still errors.
+            if getattr(cluster, "session_gone", None) and \
+                    cluster.session_gone(sid):
+                return DataSet()
             raise ExecError(f"session {sid} not found")
         try:
             from ..cluster.rpc import RpcClient
@@ -2264,12 +2314,23 @@ def _kill_query(node, qctx, ectx, space):
             addrs = sorted({s["graphd"] for s in sessions
                             if s.get("graphd")})
         hit = False
+        owner_dead = False
         for addr in addrs:
             try:
                 hit |= bool(_graphd_call(addr, "graph.kill_query",
                                          session_id=sid, plan_id=qid))
             except Exception:  # noqa: BLE001 — owner down: nothing runs
+                owner_dead = True
                 continue
+        if not hit and owner_dead:
+            # the race KILL exists to win, closed idempotently
+            # (ISSUE 20): the owning graphd died between the session
+            # lookup and the kill — its queries died with it, so the
+            # kill's goal state already holds.  Quiet success, never
+            # "no running query matches" for a provably-dead victim.
+            from ..utils.stats import stats
+            stats().inc("kill_owner_dead")
+            return DataSet()
         if not hit and (sid is not None or qid is not None):
             raise ExecError(f"no running query matches "
                             f"(session={sid}, plan={qid})")

@@ -795,7 +795,7 @@ class Parser:
             if kw in ("LOCAL", "ALL") \
                     and self.peek(1).kind == "KEYWORD" \
                     and self.peek(1).value in ("SESSIONS", "QUERIES",
-                                               "STATEMENTS"):
+                                               "STATEMENTS", "TENANTS"):
                 # SHOW LOCAL SESSIONS/QUERIES/STATEMENTS: this graphd
                 # only; SHOW ALL ...: cluster-wide (the default)
                 scope = self.next().value.lower()
@@ -805,7 +805,7 @@ class Parser:
             if kw in ("SPACES", "PARTS", "STATS", "JOBS", "SESSIONS",
                       "SNAPSHOTS", "BACKUPS", "QUERIES", "CONFIGS",
                       "TRACES", "STALLS", "REPAIRS", "STATEMENTS",
-                      "HOTSPOTS"):
+                      "HOTSPOTS", "TENANTS"):
                 self.next()
                 if kw == "JOBS":
                     return A.ShowJobsSentence()

@@ -37,7 +37,7 @@ KEYWORDS = {
     "META", "GRAPH", "STORAGE", "DOWNLOAD", "HDFS",
     "BACKUP", "BACKUPS", "RESTORE", "NEW", "LOCAL", "TRACES",
     "FLIGHT", "RECORDER", "SLO", "STALLS", "CALL", "REPAIRS",
-    "STATEMENTS", "HOTSPOTS",
+    "STATEMENTS", "HOTSPOTS", "TENANTS",
     # types
     "INT", "INT64", "INT32", "INT16", "INT8", "FLOAT", "DOUBLE", "STRING",
     "FIXED_STRING", "BOOL", "TIMESTAMP", "DATE", "TIME", "DATETIME",

@@ -41,6 +41,12 @@ indented span tree, and diff counters over time.
     python -m nebula_tpu.tools.metrics_dump --addrs <graphd-ws>,... \
         --deltas [--watch 5]
 
+    # fleet plane (ISSUE 20): per-coordinator sessions / statement
+    # goodput / epoch-propagation lag / failover counters, per host
+    # and cluster-merged; --watch shows per-interval deltas
+    python -m nebula_tpu.tools.metrics_dump --addrs <graphd-ws>,... \
+        --fleet [--watch 5]
+
     # Perfetto: every trace tree (+ stall captures) as Chrome
     # trace-event JSON, one track per daemon/service, device spans
     # included — open the file at https://ui.perfetto.dev
@@ -452,6 +458,95 @@ def _scrape_delta_view(addrs: List[str], path: str = "/metrics"
             _delta_filter(merged))
 
 
+# -- fleet view (ISSUE 20) --------------------------------------------------
+
+_FLEET_STMT_PAT = re.compile(
+    r'^query_latency_us_hist_(count|sum)\{[^}]*kind="?([^"},]+)"?[^}]*\}$')
+_FLEET_EPOCH_PAT = re.compile(
+    r'^epoch_propagation_lag_ms_(count|sum)(\{[^}]*\})?$')
+_FLEET_SHED_PAT = re.compile(
+    r'^overload_server_rejections\{[^}]*graph\.statement_capacity[^}]*\}$')
+_FLEET_KEYS = ("graph_sessions", "cluster_epoch_folds", "session_moves",
+               "coordinator_failovers", "graphd_drains", "kill_owner_dead")
+
+
+def _is_fleet_sample(name: str) -> bool:
+    return (name in _FLEET_KEYS
+            or bool(_FLEET_STMT_PAT.match(name))
+            or bool(_FLEET_EPOCH_PAT.match(name))
+            or bool(_FLEET_SHED_PAT.match(name)))
+
+
+def _fleet_filter(samples: Dict[str, float]) -> Dict[str, float]:
+    return {k: v for k, v in samples.items() if _is_fleet_sample(k)}
+
+
+def _print_fleet_rows(samples: Dict[str, float]):
+    per_kind: Dict[str, float] = {}
+    lag_sum = lag_n = 0.0
+    for k, v in samples.items():
+        m = _FLEET_STMT_PAT.match(k)
+        if m and m.group(1) == "count":
+            per_kind[m.group(2)] = per_kind.get(m.group(2), 0.0) + v
+        m = _FLEET_EPOCH_PAT.match(k)
+        if m:
+            if m.group(1) == "sum":
+                lag_sum += v
+            else:
+                lag_n += v
+    total = sum(per_kind.values())
+    kinds = ", ".join(f"{kk}={int(per_kind[kk])}"
+                      for kk in sorted(per_kind, key=per_kind.get,
+                                       reverse=True)[:4])
+    print(f"  sessions: {int(samples.get('graph_sessions', 0))}")
+    print(f"  statements served: {int(total)}"
+          + (f"  ({kinds})" if kinds else ""))
+    lag = f"{lag_sum / lag_n:.2f}ms mean of {int(lag_n)}" if lag_n \
+        else "none observed"
+    print(f"  epoch folds: "
+          f"{int(samples.get('cluster_epoch_folds', 0))}   "
+          f"propagation lag: {lag}")
+    sheds = sum(v for k, v in samples.items()
+                if _FLEET_SHED_PAT.match(k))
+    print(f"  session moves: {int(samples.get('session_moves', 0))}   "
+          f"failovers: "
+          f"{int(samples.get('coordinator_failovers', 0))}   "
+          f"drains: {int(samples.get('graphd_drains', 0))}   "
+          f"kill owner-dead: "
+          f"{int(samples.get('kill_owner_dead', 0))}")
+    print(f"  capacity sheds: {int(sheds)}")
+
+
+def dump_fleet(addrs: List[str], path: str = "/metrics") -> int:
+    """Fleet coordination view (ISSUE 20): each graphd's live session
+    count (`graph_sessions`), statements served by kind (the goodput
+    ledger — `query_latency_us_hist_count{kind}`), epoch-propagation
+    lag mean, and the failover-plane counters (session moves,
+    coordinator failovers, drains, owner-dead kills, capacity sheds)
+    — per host plus one cluster-merged section.  Combine with --watch
+    for per-interval goodput/lag deltas per coordinator."""
+    per_host, merged = scrape_cluster(addrs, path)
+    n = 0
+    for addr in sorted(per_host):
+        samples = _fleet_filter(per_host[addr])
+        print(f"== {addr} ({len(samples)} fleet samples)")
+        if samples:
+            _print_fleet_rows(samples)
+            n += len(samples)
+    if len(per_host) > 1:
+        print(f"== merged ({len(per_host)}/{len(addrs)} hosts)")
+        _print_fleet_rows(_fleet_filter(merged))
+    return n
+
+
+def _scrape_fleet_view(addrs: List[str], path: str = "/metrics"
+                       ) -> Tuple[Dict[str, Dict[str, float]],
+                                  Dict[str, float]]:
+    per_host, merged = scrape_cluster(addrs, path)
+    return ({a: _fleet_filter(s) for a, s in per_host.items()},
+            _fleet_filter(merged))
+
+
 def dump_trace_list(addr: str) -> int:
     traces = json.loads(_fetch(addr, "/traces"))
     for t in traces:
@@ -686,6 +781,12 @@ def main(argv=None) -> int:
                          "compaction swaps per host and merged; "
                          "combine with --watch for apply/compaction "
                          "deltas")
+    ap.add_argument("--fleet", action="store_true",
+                    help="fleet coordination view (ISSUE 20): "
+                         "per-coordinator sessions / statements by "
+                         "kind / epoch-propagation lag / failover "
+                         "counters per host and merged; combine with "
+                         "--watch for goodput deltas")
     ap.add_argument("--stall-id", default="",
                     help="print one stall capture in full (thread "
                          "stacks, dispatch table, kernel ledger)")
@@ -744,6 +845,14 @@ def main(argv=None) -> int:
                                   addrs, args.path))
             else:
                 dump_deltas(addrs, args.path)
+        elif args.fleet:
+            if args.watch > 0:
+                watch_cluster(addrs, args.watch, args.grep,
+                              args.iterations,
+                              scrape_fn=lambda: _scrape_fleet_view(
+                                  addrs, args.path))
+            else:
+                dump_fleet(addrs, args.path)
         elif args.hotspots:
             if args.watch > 0:
                 watch_cluster(addrs, args.watch, args.grep,

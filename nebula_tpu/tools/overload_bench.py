@@ -36,6 +36,14 @@ instead — 1 storaged / rf=1 leader-only vs 3 storaged / rf=3 at
 follower consistency under the same per-replica read capacity
 (`storage_read_capacity_qps`); bench.py folds it into `read_scaleout`
 (qps_3r_vs_1r is the acceptance number: ≥ 2.0).
+
+`--fleet` (ISSUE 20) runs the coordinator scale-out + fleet QoS sweep
+instead — a 10k-session storm over 3 graphds, then the same mixed
+GO/MATCH offered load against 1 coordinator vs the fleet of 3 under
+the same per-coordinator statement capacity
+(`graph_statement_capacity_qps`), then a scarce-slot DWRR phase with
+an aggressor tenant; bench.py folds it into `fleet` (fleet_goodput_x
+is the acceptance number: ≥ 2.5, plus dwrr_share_held).
 """
 from __future__ import annotations
 
@@ -963,6 +971,342 @@ def htap_sweep(persons: int = 900, degree: int = 4, writers: int = 2,
             shutil.rmtree(tmp, ignore_errors=True)
 
 
+# -- fleet scale-out sweep (ISSUE 20) ---------------------------------------
+
+
+def _fleet_worker(make_client, space: str, stmt_of, duration_s: float,
+                  wid: int, res: _LevelResult):
+    """Closed-loop worker over a caller-built client (single-endpoint
+    or fleet) — the _worker body with the client factory lifted out."""
+    from nebula_tpu.utils.admission import is_overload, parse_retry_after
+    try:
+        cl = make_client(wid)
+        cl.execute(f"USE {space}")
+    except Exception as ex:  # noqa: BLE001 — saturation may refuse conns
+        with res.lock:
+            res.errors.append(f"connect: {ex!r}")
+        return
+    end = time.monotonic() + duration_s
+    j = 0
+    while time.monotonic() < end:
+        t0 = time.perf_counter()
+        try:
+            r = cl.execute(stmt_of(wid, j))
+        except Exception as ex:  # noqa: BLE001
+            with res.lock:
+                res.errors.append(repr(ex))
+            break
+        dt = time.perf_counter() - t0
+        with res.lock:
+            if r.error is None:
+                res.ok += 1
+                res.lats.append(dt)
+            elif is_overload(r.error):
+                res.shed_results += 1
+                if parse_retry_after(r.error) is None:
+                    res.hints_missing += 1
+            else:
+                res.errors.append(r.error)
+        j += 1
+    try:
+        cl.close()
+    except Exception:  # noqa: BLE001
+        pass
+
+
+def _run_arm(make_client, space, stmt_of, n_workers, duration_s):
+    res = _LevelResult()
+    ths = [threading.Thread(target=_fleet_worker,
+                            args=(make_client, space, stmt_of,
+                                  duration_s, i, res))
+           for i in range(n_workers)]
+    t0 = time.perf_counter()
+    for t in ths:
+        t.start()
+    for t in ths:
+        t.join()
+    res.wall = time.perf_counter() - t0        # type: ignore[attr-defined]
+    return res
+
+
+def fleet_sweep(persons: int = 1200, degree: int = 5, workers: int = 18,
+                duration_s: float = 3.0,
+                capacity_qps: Optional[int] = None,
+                n_sessions: int = 10_000, session_workers: int = 48,
+                qos_workers: int = 6, tpu_runtime=None,
+                data_dir: Optional[str] = None) -> dict:
+    """Coordinator scale-out + fleet QoS sweep (ISSUE 20 acceptance) on
+    a 1 metad / 3 storaged / 3 graphd cluster:
+
+      1. SESSION STORM — `n_sessions` (default 10k+) short sessions
+         spread over the 3 graphds, each authenticating, running one
+         mixed GO/MATCH statement and signing out: the session-scale
+         proof (sessions_per_s, zero errors).
+      2. CAPACITY ARMS — the SAME closed-loop mixed GO/MATCH offered
+         load against ONE coordinator vs the FLEET of 3, under the
+         same per-coordinator statement capacity
+         (`graph_statement_capacity_qps` — a token bucket per graphd
+         that sheds over-rate statements with the PR 8 E_OVERLOAD +
+         retry-after contract; a fleet client walks a shed statement
+         to a sibling with spare tokens).  The capacity model is
+         explicit and honest, exactly as the ISSUE 11 read sweep: an
+         in-process cluster shares one interpreter, so raw CPU
+         throughput cannot scale with coordinator count on a small
+         host — what CAN and does scale is admitted per-coordinator
+         capacity, which is what graphd scale-out buys a real
+         deployment.  The capacity level is CALIBRATED below the
+         host's raw throughput (an uncapped closed-loop probe, then
+         cap = raw/5) so the fleet arm measures the capacity model,
+         not the calibration host's cores.  Headline
+         `fleet_goodput_x` (bar: >= 2.5).
+      3. QOS PHASE — capacity off, admission slots scarce, two-level
+         DWRR armed (`admission_tenant_weights` vip:3,agg:1) with an
+         AGGRESSOR: `agg` offers 2x the closed-loop workers of `vip`.
+         The admitted share must still track the weights —
+         `dwrr_share_held`: |vip_share - 0.75| <= 0.15.
+    """
+    from nebula_tpu.cluster.client import GraphClient
+    from nebula_tpu.cluster.launcher import LocalCluster
+    from nebula_tpu.utils.admission import admission
+    from nebula_tpu.utils.config import get_config
+
+    space = "fleet"
+    tmp = data_dir or tempfile.mkdtemp(prefix="nebula_fleet_")
+    cluster = LocalCluster(n_meta=1, n_storage=3, n_graph=3,
+                           data_dir=tmp, tpu_runtime=tpu_runtime)
+    cfg = get_config()
+    dyn_keys = ("graph_statement_capacity_qps", "query_timeout_secs",
+                "max_running_queries", "admission_queue_capacity",
+                "admission_tenant_weights")
+    try:
+        _seed_graph(cluster, space, persons, degree,
+                    replica_factor=3, rng_seed=61)
+
+        def stmt_of(wid: int, j: int) -> str:
+            seed = (wid * 131 + j * 17) % persons
+            if j % 4 == 3:
+                return (f"MATCH (a:Person)-[e:KNOWS]->(b) "
+                        f"WHERE id(a) == {seed} RETURN id(b)")
+            return f"GO FROM {seed} OVER KNOWS YIELD dst(edge) AS d"
+
+        # warm EVERY coordinator (catalog propagation + plan cache):
+        # the arms must measure capacity, not first-touch compilation
+        dl = time.monotonic() + 20.0
+        for g in range(len(cluster.graph_servers)):
+            while True:
+                w = cluster.client(graphd=g)
+                r = w.execute(f"USE {space}")
+                if r.error is None:
+                    r = w.execute(stmt_of(0, 3))
+                if r.error is None:
+                    r = w.execute(stmt_of(0, 0))
+                w.close()
+                if r.error is None:
+                    break
+                if time.monotonic() > dl:
+                    raise AssertionError(
+                        f"graphd {g} never warmed: {r.error}")
+                time.sleep(0.1)
+
+        addrs = cluster.graph_addrs
+
+        def _fleet(wid):
+            rot = addrs[wid % len(addrs):] + addrs[:wid % len(addrs)]
+            c = GraphClient(rot)
+            c.authenticate()
+            return c
+
+        # ---- calibrate raw mixed-load throughput (capacity OFF):
+        # the capacity level must sit BELOW what the host can execute,
+        # or the fleet arm measures cores, not the capacity model
+        cal = _run_arm(_fleet, space, stmt_of, workers,
+                       min(duration_s, 2.0))
+        cal_wall = getattr(cal, "wall", 1.0)
+        raw_qps = cal.ok / cal_wall if cal_wall else 0.0
+        # raw/5: the fleet arm's 3x cap lands at ~60% of raw, far
+        # enough below the CPU ceiling that walk overhead and GIL
+        # contention don't eat the scale-out ratio
+        cap = capacity_qps if capacity_qps is not None \
+            else max(int(raw_qps / 5), 15)
+
+        # ---- 1. session storm (capacity DISARMED) -------------------
+        # each session is fully created and destroyed SERVER-SIDE
+        # (metad-replicated row, graphd + engine registries, reaped
+        # gauge) — but over kept-alive connections, the way a real
+        # driver multiplexes sessions; per-session TCP setup is not
+        # the thing being proven
+        from nebula_tpu.cluster.rpc import RpcClient
+        storm = _LevelResult()
+        counter = {"n": 0}
+        clock = threading.Lock()
+
+        def _storm_worker(wid: int):
+            conns: Dict[int, RpcClient] = {}
+
+            def conn(g: int) -> RpcClient:
+                c = conns.get(g)
+                if c is None:
+                    host, port = addrs[g].rsplit(":", 1)
+                    c = conns[g] = RpcClient(host, int(port), retries=0)
+                return c
+            try:
+                while True:
+                    with clock:
+                        k = counter["n"]
+                        if k >= n_sessions:
+                            return
+                        counter["n"] = k + 1
+                    try:
+                        rc = conn(k % len(addrs))
+                        sid = rc.call("graph.authenticate", user="root",
+                                      password="nebula")["session_id"]
+                        r1 = rc.call("graph.execute", session_id=sid,
+                                     stmt=f"USE {space}")
+                        # the cheap GO shape: the storm proves SESSION
+                        # lifecycle scale; the mixed GO/MATCH load is
+                        # the capacity arms' job
+                        r2 = rc.call("graph.execute", session_id=sid,
+                                     stmt=stmt_of(wid, 4 * k))
+                        rc.call("graph.signout", session_id=sid)
+                        err = r1["error"] or r2["error"]
+                        with storm.lock:
+                            if err is None:
+                                storm.ok += 1
+                            else:
+                                storm.errors.append(err)
+                    except Exception as ex:  # noqa: BLE001
+                        with storm.lock:
+                            storm.errors.append(repr(ex))
+            finally:
+                for c in conns.values():
+                    c.close()
+
+        ths = [threading.Thread(target=_storm_worker, args=(i,))
+               for i in range(session_workers)]
+        t0 = time.perf_counter()
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join()
+        storm_wall = time.perf_counter() - t0
+        session_storm = {
+            "sessions": n_sessions,
+            "workers": session_workers,
+            "wall_s": round(storm_wall, 2),
+            "sessions_per_s": round(storm.ok / storm_wall, 1)
+            if storm_wall else 0,
+            "ok": storm.ok,
+            "errors": len(storm.errors),
+            "error_sample": storm.errors[:3],
+        }
+
+        # ---- 2. capacity arms: 1 coordinator vs the fleet of 3 ------
+        cfg.set_dynamic_many({
+            "graph_statement_capacity_qps": cap,
+            "query_timeout_secs": max(duration_s * 2, 8.0),
+        })
+        shed0 = _stat_totals(_SHED_COUNTERS)
+
+        def _single(wid):
+            return cluster.client(graphd=0)
+
+        arms = {}
+        for label, mk in (("single", _single), ("fleet", _fleet)):
+            res = _run_arm(mk, space, stmt_of, workers, duration_s)
+            res.lats.sort()
+            wall = getattr(res, "wall", duration_s)
+            arms[label] = {
+                "coordinators": 1 if label == "single" else len(addrs),
+                "workers": workers,
+                "wall_s": round(wall, 2),
+                "goodput_qps": round(res.ok / wall, 1) if wall else 0,
+                "ok": res.ok,
+                "shed_results": res.shed_results,
+                "other_errors": len(res.errors),
+                "error_sample": res.errors[:3],
+                "p50_ms": round(_percentile(res.lats, 50) * 1e3, 2),
+                "p99_ms": round(_percentile(res.lats, 99) * 1e3, 2),
+                "hints_ok": res.hints_missing == 0,
+            }
+        shed1 = _stat_totals(_SHED_COUNTERS)
+        with cfg.lock:
+            cfg.dynamic_layer.pop("graph_statement_capacity_qps", None)
+
+        # ---- 3. QoS: DWRR shares hold under an aggressor tenant -----
+        cfg.set_dynamic_many({
+            "max_running_queries": 2,
+            "admission_queue_capacity": 256,
+            "admission_tenant_weights": "vip:3,agg:1",
+            "query_timeout_secs": max(duration_s * 4, 15.0),
+        })
+        tenants = {"vip": _LevelResult(), "agg": _LevelResult()}
+
+        def _tenant(user, wid):
+            rot = addrs[wid % len(addrs):] + addrs[:wid % len(addrs)]
+            c = GraphClient(rot)
+            c.authenticate(user, "x")
+            return c
+
+        ths = []
+        for user, n in (("vip", qos_workers), ("agg", qos_workers * 2)):
+            ths += [threading.Thread(
+                target=_fleet_worker,
+                args=(lambda w, u=user: _tenant(u, w), space, stmt_of,
+                      duration_s, i, tenants[user]))
+                for i in range(n)]
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join()
+        vip_ok, agg_ok = tenants["vip"].ok, tenants["agg"].ok
+        vip_share = vip_ok / (vip_ok + agg_ok) if vip_ok + agg_ok else 0.0
+        qos = {
+            "weights": "vip:3,agg:1",
+            "vip_workers": qos_workers,
+            "agg_workers": qos_workers * 2,
+            "vip_ok": vip_ok,
+            "agg_ok": agg_ok,
+            "errors": len(tenants["vip"].errors)
+            + len(tenants["agg"].errors),
+            "error_sample": (tenants["vip"].errors
+                             + tenants["agg"].errors)[:3],
+            "vip_share": round(vip_share, 3),
+            "expected_share": 0.75,
+            "bound": 0.15,
+            "dwrr_share_held": abs(vip_share - 0.75) <= 0.15,
+            "tenants": admission().tenant_snapshot(),
+        }
+
+        g1 = arms["single"]["goodput_qps"]
+        g3 = arms["fleet"]["goodput_qps"]
+        return {
+            "persons": persons,
+            "degree": degree,
+            "graphds": len(addrs),
+            "statement": "mixed 1-hop GO / 1-hop MATCH (3:1)",
+            "calibration": {"workers": workers,
+                            "raw_qps": round(raw_qps, 1)},
+            "capacity_qps_per_graphd": cap,
+            "duration_per_arm_s": duration_s,
+            "session_storm": session_storm,
+            "arms": arms,
+            "shed_counters": {k: int(shed1[k] - shed0[k])
+                              for k in shed1},
+            "qos": qos,
+            # the acceptance numbers (ISSUE 20)
+            "fleet_goodput_x": round(g3 / g1, 3) if g1 else None,
+            "dwrr_share_held": qos["dwrr_share_held"],
+        }
+    finally:
+        with cfg.lock:
+            for k in dyn_keys:
+                cfg.dynamic_layer.pop(k, None)
+        admission().reset()
+        cluster.stop()
+        if data_dir is None:
+            shutil.rmtree(tmp, ignore_errors=True)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--persons", type=int, default=1200)
@@ -986,6 +1330,18 @@ def main(argv=None) -> int:
                     help="batch_max_lanes for the --batch ON arm")
     ap.add_argument("--batch-wait-us", type=int, default=3000,
                     help="batch_wait_us forming window for --batch")
+    ap.add_argument("--fleet", action="store_true",
+                    help="run the coordinator scale-out + fleet QoS "
+                         "sweep (10k-session storm, 1-vs-3 graphd "
+                         "goodput under per-coordinator capacity, "
+                         "DWRR aggressor shares) instead of the "
+                         "offered-load sweep")
+    ap.add_argument("--capacity-qps", type=int, default=None,
+                    help="graph_statement_capacity_qps per graphd for "
+                         "the --fleet capacity arms (default: "
+                         "calibrated to raw_qps/5)")
+    ap.add_argument("--sessions", type=int, default=10_000,
+                    help="session-storm size for --fleet")
     ap.add_argument("--htap", action="store_true",
                     help="run the write-storm + read-storm delta-CSR "
                          "A/B (delta off vs on) instead of the "
@@ -995,6 +1351,13 @@ def main(argv=None) -> int:
     ap.add_argument("--delta-cap", type=int, default=2048,
                     help="tpu_delta_max_edges for the --htap ON arm")
     args = ap.parse_args(argv)
+    if args.fleet:
+        print(json.dumps(fleet_sweep(
+            persons=args.persons, degree=args.degree,
+            workers=args.threads * 3, duration_s=args.duration,
+            capacity_qps=args.capacity_qps,
+            n_sessions=args.sessions), indent=1))
+        return 0
     if args.htap:
         print(json.dumps(htap_sweep(
             persons=args.persons, degree=args.degree,

@@ -70,6 +70,14 @@ define_flag("admission_session_weights", "",
             "(unlisted sessions weigh 1); runtime-updatable via "
             "UPDATE CONFIGS so an operator can deprioritize a noisy "
             "tenant without a restart")
+define_flag("admission_tenant_weights", "",
+            "per-tenant (user) DWRR quotas as `user:weight[,...]` "
+            "(unlisted tenants weigh 1): the OUTER rotation of the "
+            "two-level drain — tenants split slots by these weights, "
+            "each tenant's sessions split its share by the session "
+            "weights.  Enforced at every graphd, so an aggressor "
+            "tenant cannot starve others anywhere in the fleet "
+            "(ISSUE 20); runtime-updatable via UPDATE CONFIGS")
 define_flag("rpc_server_inbox_capacity", 0,
             "bounded RPC-server dispatch inbox: pipelined requests "
             "beyond this many in flight per server are rejected with "
@@ -197,9 +205,11 @@ def is_analytic_stmt(kind: str) -> bool:
 
 class _Waiter:
     __slots__ = ("qid", "session", "kind", "event", "admitted",
-                 "cancelled", "t_enq", "tracker", "live", "analytic")
+                 "cancelled", "t_enq", "tracker", "live", "analytic",
+                 "user")
 
-    def __init__(self, qid: int, session: int, kind: str, live, tracker):
+    def __init__(self, qid: int, session: int, kind: str, live, tracker,
+                 user: str = ""):
         self.qid = qid
         self.session = session
         self.kind = kind
@@ -210,6 +220,7 @@ class _Waiter:
         self.tracker = tracker
         self.live = live
         self.analytic = is_analytic_stmt(kind)
+        self.user = user
 
 
 class Ticket:
@@ -249,9 +260,22 @@ class AdmissionController:
     def __init__(self):
         self._mu = threading.Lock()
         self._running: Dict[int, _Waiter] = {}      # qid → admitted
-        self._queues: "OrderedDict[int, deque]" = OrderedDict()
-        self._rr: "deque[int]" = deque()            # session rotation
-        self._deficit: Dict[int, float] = {}
+        # two-level DWRR (ISSUE 20): the OUTER rotation is per tenant
+        # (user), weighted by `admission_tenant_weights`; each tenant
+        # holds its own session rotation weighted by
+        # `admission_session_weights`.  Single-tenant workloads (every
+        # pre-fleet test and default deployment) collapse to the old
+        # per-session DWRR exactly — one tenant, inner rotation only.
+        # tenant → {"queues": OrderedDict[sid, deque],
+        #           "rr": deque[sid], "deficit": {sid: float}}
+        self._tenants: "OrderedDict[str, dict]" = OrderedDict()
+        self._trr: "deque[str]" = deque()           # tenant rotation
+        self._tdeficit: Dict[str, float] = {}
+        # lifetime per-tenant admissions (SHOW TENANTS + the
+        # tenant_dwrr_share gauge): under sustained contention the
+        # shares converge to the configured weights
+        self._tenant_admits: Dict[str, int] = {}
+        self._admit_total = 0
         # below-interactive band (ISSUE 13): analytics FIFO, drained
         # only when every DWRR session queue is empty
         self._analytic: "deque[_Waiter]" = deque()
@@ -259,6 +283,8 @@ class AdmissionController:
         self._drain_est = DrainEstimator()
         self._weights_raw = ""
         self._weights: Dict[int, int] = {}
+        self._tweights_raw = ""
+        self._tweights: Dict[str, int] = {}
         self._listener_installed = False
         # last multi-statement drain burst (size, monotonic ts): the
         # admission→batch-former hand-off (ISSUE 15) — a drain that
@@ -309,6 +335,25 @@ class AdmissionController:
             self._weights_raw, self._weights = raw, parsed
         return self._weights.get(sid, 1)
 
+    def _tenant_weight(self, user: str) -> int:
+        try:
+            raw = str(get_config().get("admission_tenant_weights"))
+        except Exception:  # noqa: BLE001
+            raw = ""
+        if raw != self._tweights_raw:
+            parsed: Dict[str, int] = {}
+            for part in raw.split(","):
+                part = part.strip()
+                if not part or ":" not in part:
+                    continue
+                k, _, v = part.partition(":")
+                try:
+                    parsed[k.strip()] = max(int(v), 1)
+                except ValueError:
+                    continue
+            self._tweights_raw, self._tweights = raw, parsed
+        return self._tweights.get(user, 1)
+
     def _ensure_listener(self):
         """A capacity/watermark/weight bump via UPDATE CONFIGS or
         PUT /flags must drain a waiting queue WITHOUT a restart — the
@@ -320,7 +365,8 @@ class AdmissionController:
         def on_flag(name, _value):
             if name in ("max_running_queries", "admission_queue_capacity",
                         "admission_memory_watermark_bytes",
-                        "admission_session_weights"):
+                        "admission_session_weights",
+                        "admission_tenant_weights"):
                 self.kick()
         get_config().listeners.append(on_flag)
 
@@ -344,15 +390,29 @@ class AdmissionController:
         stats().gauge("admission_running", float(len(self._running)))
         stats().gauge("admission_queue_depth", float(self._queued_n))
 
+    def _note_admit_locked(self, w: _Waiter):
+        """Per-tenant admission accounting: the `tenant_dwrr_share`
+        gauge is this tenant's lifetime share of admissions — under
+        sustained contention it converges to the weight ratio (the
+        fleet QoS proof reads it)."""
+        u = w.user or "-"
+        self._tenant_admits[u] = self._tenant_admits.get(u, 0) + 1
+        self._admit_total += 1
+        from .stats import stats
+        stats().gauge_labeled(
+            "tenant_dwrr_share", {"tenant": u},
+            round(self._tenant_admits[u] / self._admit_total, 4))
+
     # -- acquire / release ------------------------------------------------
 
     def acquire(self, qid: int, session: int, kind: str, live=None,
-                tracker=None) -> Optional[Ticket]:
+                tracker=None, user: str = "") -> Optional[Ticket]:
         """Block until the statement may run.  Returns a Ticket (or
         None when admission is disabled — the zero-cost sentinel path).
         Raises OverloadError (shed, queue full), DeadlineExceeded
         (budget expired while queued — no slot consumed) or
-        QueryKilled (killed while queued)."""
+        QueryKilled (killed while queued).  `user` is the tenant
+        identity for the outer DWRR rotation (ISSUE 20)."""
         slots = self.slots()
         if slots <= 0:
             return None
@@ -362,7 +422,7 @@ class AdmissionController:
             # priority lane: the cluster stays operable at saturation
             stats().inc_labeled("admission_bypass", {"kind": kind})
             return Ticket(self, "bypass", qid)
-        w = _Waiter(qid, session, kind, live, tracker)
+        w = _Waiter(qid, session, kind, live, tracker, user=user)
         with self._mu:
             # the fast path requires an EMPTY queue (total, both
             # bands): an analytic arrival must not jump a queued
@@ -372,6 +432,7 @@ class AdmissionController:
                 # fast path: empty queue, free slot, memory headroom
                 self._running[qid] = w
                 w.admitted = True
+                self._note_admit_locked(w)
                 self._gauges_locked()
                 return Ticket(self, "admitted", qid)
             if self._queued_n >= max(self.capacity(), 0):
@@ -405,10 +466,16 @@ class AdmissionController:
                 # DWRR rotation is empty
                 self._analytic.append(w)
             else:
-                q = self._queues.get(session)
+                t = self._tenants.get(user)
+                if t is None:
+                    t = self._tenants[user] = {
+                        "queues": OrderedDict(), "rr": deque(),
+                        "deficit": {}}
+                    self._trr.append(user)
+                q = t["queues"].get(session)
                 if q is None:
-                    q = self._queues[session] = deque()
-                    self._rr.append(session)
+                    q = t["queues"][session] = deque()
+                    t["rr"].append(session)
                 q.append(w)
             self._queued_n += 1
             if live is not None:
@@ -458,8 +525,11 @@ class AdmissionController:
             if w.admitted:
                 return False
             w.cancelled = True
-            q = self._analytic if w.analytic \
-                else self._queues.get(w.session)
+            if w.analytic:
+                q = self._analytic
+            else:
+                t = self._tenants.get(w.user)
+                q = t["queues"].get(w.session) if t else None
             if q is not None:
                 try:
                     q.remove(w)
@@ -483,31 +553,61 @@ class AdmissionController:
 
     # -- the DWRR drain ---------------------------------------------------
 
-    def _drr_next_locked(self) -> Optional[_Waiter]:
-        """Next waiter by deficit-weighted round-robin.  Each visit of
-        the rotation pointer credits the session its weight; one
-        admission costs one credit — over time each backlogged session
-        is admitted in proportion to its weight, and an emptied
-        session's deficit dies with its queue (no banked bursts)."""
-        guard = 2 * len(self._rr) + 2
+    def _session_next_locked(self, t: dict) -> Optional[_Waiter]:
+        """Inner rotation: next waiter of ONE tenant by session-weighted
+        DWRR (the pre-fleet algorithm, verbatim, scoped to the
+        tenant)."""
+        rr, queues, deficit = t["rr"], t["queues"], t["deficit"]
+        guard = 2 * len(rr) + 2
         for _ in range(guard):
-            if not self._rr:
+            if not rr:
                 return None
-            sid = self._rr[0]
-            q = self._queues.get(sid)
+            sid = rr[0]
+            q = queues.get(sid)
             if not q:
-                self._rr.popleft()
-                self._queues.pop(sid, None)
-                self._deficit.pop(sid, None)
+                rr.popleft()
+                queues.pop(sid, None)
+                deficit.pop(sid, None)
                 continue
-            if self._deficit.get(sid, 0.0) >= 1.0:
-                self._deficit[sid] -= 1.0
-                w = q.popleft()
+            if deficit.get(sid, 0.0) >= 1.0:
+                deficit[sid] -= 1.0
+                return q.popleft()
+            deficit[sid] = deficit.get(sid, 0.0) + self._weight(sid)
+            rr.rotate(-1)
+        return None
+
+    def _drr_next_locked(self) -> Optional[_Waiter]:
+        """Next waiter by TWO-LEVEL deficit-weighted round-robin
+        (ISSUE 20): the outer rotation credits each backlogged tenant
+        its `admission_tenant_weights` weight per visit, one admission
+        costs one credit — so tenants split admissions in proportion
+        to their quotas no matter how many sessions an aggressor
+        opens; within a tenant the session rotation splits its share
+        by the session weights.  An emptied tenant's deficit dies with
+        its queues (no banked bursts)."""
+        tguard = 2 * len(self._trr) + 2
+        for _ in range(tguard):
+            if not self._trr:
+                return None
+            u = self._trr[0]
+            t = self._tenants.get(u)
+            if t is None or not any(t["queues"].values()):
+                self._trr.popleft()
+                self._tenants.pop(u, None)
+                self._tdeficit.pop(u, None)
+                continue
+            if self._tdeficit.get(u, 0.0) >= 1.0:
+                w = self._session_next_locked(t)
+                if w is None:
+                    # queues raced empty between the check and the pick
+                    self._trr.rotate(-1)
+                    continue
+                self._tdeficit[u] -= 1.0
                 self._queued_n = max(self._queued_n - 1, 0)
                 return w
-            self._deficit[sid] = self._deficit.get(sid, 0.0) \
-                + self._weight(sid)
-            self._rr.rotate(-1)
+            self._tdeficit[u] = self._tdeficit.get(u, 0.0) \
+                + self._tenant_weight(u)
+            self._trr.rotate(-1)
         return None
 
     def _next_locked(self) -> Optional[_Waiter]:
@@ -536,6 +636,7 @@ class AdmissionController:
                 # slots<=0 → admission was disabled live: everyone goes
                 self._running[w.qid] = w
                 w.admitted = True
+                self._note_admit_locked(w)
                 admitted.append(w)
             if admitted:
                 self._gauges_locked()
@@ -565,21 +666,56 @@ class AdmissionController:
                 "slots": self.slots(),
                 "running": len(self._running),
                 "queued": self._queued_n,
-                "queued_by_session": {sid: len(q) for sid, q
-                                      in self._queues.items() if q},
+                "queued_by_session": {
+                    sid: len(q)
+                    for t in self._tenants.values()
+                    for sid, q in t["queues"].items() if q},
+                "queued_by_tenant": {
+                    u or "-": sum(len(q) for q in t["queues"].values())
+                    for u, t in self._tenants.items()
+                    if any(t["queues"].values())},
                 "analytic_queued": len(self._analytic),
                 "memory_bytes": self._mem_total_locked(),
                 "drain_rate_per_s": round(self._drain_est.rate(), 3),
             }
 
+    def tenant_snapshot(self) -> list:
+        """Per-tenant admission rows (SHOW TENANTS / GET /tenants):
+        weight, live running/queued counts, lifetime admissions and
+        admission share on THIS graphd — the cluster view sums rows
+        across the fleet."""
+        with self._mu:
+            users = set(self._tenant_admits)
+            users.update(u or "-" for u in self._tenants)
+            users.update((w.user or "-") for w in self._running.values())
+            tot = max(self._admit_total, 1)
+            rows = []
+            for u in sorted(users):
+                t = self._tenants.get("" if u == "-" else u)
+                rows.append({
+                    "tenant": u,
+                    "weight": self._tenant_weight("" if u == "-" else u),
+                    "running": sum(1 for w in self._running.values()
+                                   if (w.user or "-") == u),
+                    "queued": sum(len(q) for q in t["queues"].values())
+                    if t else 0,
+                    "admitted": self._tenant_admits.get(u, 0),
+                    "share": round(
+                        self._tenant_admits.get(u, 0) / tot, 4),
+                })
+            return rows
+
     def reset(self):
         """Test isolation: wake every waiter and drop all state."""
         with self._mu:
-            waiters = [w for q in self._queues.values() for w in q]
+            waiters = [w for t in self._tenants.values()
+                       for q in t["queues"].values() for w in q]
             waiters.extend(self._analytic)
-            self._queues.clear()
-            self._rr.clear()
-            self._deficit.clear()
+            self._tenants.clear()
+            self._trr.clear()
+            self._tdeficit.clear()
+            self._tenant_admits.clear()
+            self._admit_total = 0
             self._analytic.clear()
             self._queued_n = 0
             self._running.clear()

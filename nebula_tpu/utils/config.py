@@ -202,6 +202,19 @@ define_flag("storage_read_capacity_qps", 0,
             "replica's read load during backfill/compaction; bench "
             "use: model per-replica capacity for the read scale-out "
             "sweep on hosts whose cores can't isolate replicas")
+define_flag("graph_statement_capacity_qps", 0,
+            "per-COORDINATOR data-statement admission rate "
+            "(statements/s, token bucket per graphd; 0 = unlimited).  "
+            "Statements beyond the rate are shed with the structured "
+            "E_OVERLOAD + retry-after contract (PR 8), so a fleet "
+            "client walks to a sibling coordinator with spare "
+            "capacity instead of waiting.  Control statements "
+            "(SHOW/KILL/DESC/USE) bypass the bucket — the diagnosis "
+            "lane must survive the overload being diagnosed.  "
+            "Production use: cap one coordinator during canary or "
+            "drain warm-up; bench use: model per-coordinator "
+            "capacity for the fleet scale-out sweep on hosts whose "
+            "cores can't isolate graphds (ISSUE 20)")
 define_flag("tpu_delta_max_edges", 0,
             "device delta-CSR capacity per (block, part) in edges "
             "(rounded up to a power of two; 0 = delta plane off, "

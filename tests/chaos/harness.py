@@ -42,6 +42,7 @@ class ChaosCluster:
                                     tpu_runtime=tpu_runtime)
         self.client = self.cluster.client()
         self.dead: set = set()          # indexes of killed storageds
+        self.dead_graphds: set = set()  # indexes of killed graphds
         r = self.client.execute(
             f"CREATE SPACE {space}(partition_num={parts}, "
             f"replica_factor={replica_factor}, vid_type=INT64)")
@@ -81,6 +82,17 @@ class ChaosCluster:
     def kill_storaged(self, i: int):
         self.dead.add(i)
         self.cluster.stop_storaged(i)
+
+    def kill_graphd(self, i: int):
+        """Hard-kill coordinator `i` — no drain, in-flight statements
+        die with it (ISSUE 20 failover chaos)."""
+        self.dead_graphds.add(i)
+        self.cluster.stop_graphd(i)
+
+    def fleet_client(self):
+        """A client holding EVERY graphd endpoint — the failover-aware
+        session the ISSUE 20 invariants drive."""
+        return self.cluster.fleet_client()
 
     def leader_of_most_parts(self) -> int:
         """Index of the live storaged leading the most parts of the

@@ -1,0 +1,683 @@
+"""Graphd fleet fault tolerance (ISSUE 20): cluster-coherent cache
+epochs (write through ANY coordinator invalidates every coordinator's
+cached results), client-side coordinator selection + transparent
+failover with a strict retry-safety taxonomy, graceful drain that
+sheds zero acked statements, fleet-wide KILL idempotency, and
+per-tenant DWRR QoS with the cluster SHOW TENANTS view."""
+import threading
+import time
+
+import pytest
+
+from nebula_tpu.cluster.client import (GraphClient, _stmt_retryable)
+from nebula_tpu.cluster.launcher import LocalCluster
+from nebula_tpu.cluster.rpc import (RpcClient, RpcConnError, RpcError,
+                                    RpcNeverSentError, reset_breakers)
+from nebula_tpu.exec.engine import QueryEngine
+from nebula_tpu.utils.admission import admission
+from nebula_tpu.utils.config import get_config
+from nebula_tpu.utils.epochs import ClusterEpochs, EpochClock
+from nebula_tpu.utils.stats import stats
+
+_FLEET_FLAGS = (
+    "result_cache_size", "result_cache_strict_epoch", "read_consistency",
+    "max_running_queries", "admission_queue_capacity",
+    "admission_tenant_weights",
+)
+
+
+def _pop_flags():
+    for k in _FLEET_FLAGS:
+        get_config().dynamic_layer.pop(k, None)
+
+
+def _poll(pred, timeout=6.0, msg="condition"):
+    dl = time.monotonic() + timeout
+    while time.monotonic() < dl:
+        got = pred()
+        if got:
+            return got
+        time.sleep(0.02)
+    pytest.fail(f"timed out waiting for {msg}")
+
+
+def _counter(name) -> float:
+    return stats().snapshot().get(name, 0)
+
+
+# -- ClusterEpochs / EpochClock (pure) --------------------------------------
+
+
+def test_epoch_fold_monotonic_and_boot_change():
+    ce = ClusterEpochs()
+    assert ce.gen("s") == 0 and ce.gen(None) == 0
+    assert ce.fold("s", "h1", "bootA", 3)
+    g1 = ce.gen("s")
+    assert g1 == 1
+    # same boot, lower epoch: a stale out-of-order heartbeat must NOT
+    # regress the vector or mint new keys
+    assert not ce.fold("s", "h1", "bootA", 2)
+    assert ce.gen("s") == g1
+    # same boot, higher epoch: advance
+    assert ce.fold("s", "h1", "bootA", 4)
+    assert ce.gen("s") == g1 + 1
+    # NEW boot with a LOWER epoch: a restart is always news — a plain
+    # max() would mask the fresh host's low-but-advancing counter
+    assert ce.fold("s", "h1", "bootB", 1)
+    assert ce.gen("s") == g1 + 2
+    # another host folds independently
+    assert ce.fold("s", "h2", "bootX", 1)
+    assert ce.gen("s") == g1 + 3
+
+
+def test_epoch_fold_table_and_ack():
+    ce = ClusterEpochs()
+    n = ce.fold_table({"s": {"h1": ["b", 2, None], "h2": ["b", 1, None]},
+                       "t": {"h1": ["b", 5, None]}})
+    assert n == 3
+    assert ce.gen("s") == 2 and ce.gen("t") == 1
+    # replay of the same table: nothing advances
+    assert ce.fold_table({"s": {"h1": ["b", 2, None]}}) == 0
+    # malformed entries are skipped, not fatal
+    assert ce.fold_table({"s": {"h3": "garbage", "h4": ["b"]}}) == 0
+    assert ce.fold_table(None) == 0
+    # write-ack leg: monotonic per space, bumps the generation so the
+    # WRITING coordinator's caches turn over at ack time
+    g = ce.gen("s")
+    assert ce.note_ack("s", 7)
+    assert ce.gen("s") == g + 1
+    assert not ce.note_ack("s", 7)      # replayed ack: no new keys
+    assert not ce.note_ack("s", 3)      # stale ack: no regression
+    assert ce.gen("s") == g + 1
+    assert not ce.note_ack("", 9) and not ce.note_ack("s", "x")
+
+
+def test_epoch_clock_ts():
+    ec = EpochClock()
+    assert ec.ts_for("s", 1) is None
+    ec.note("s", 3)
+    ts = ec.ts_for("s", 3)
+    assert ts is not None and ts <= time.time()
+    # a different epoch carries no ts (fold without a lag sample)
+    assert ec.ts_for("s", 4) is None
+    ec.note("s", 2)                     # stale note: ignored
+    assert ec.ts_for("s", 3) == ts
+
+
+# -- client-side retry-safety taxonomy (pure) -------------------------------
+
+
+def test_stmt_retry_taxonomy():
+    for s in ("GO FROM 1 OVER e", "  MATCH (n) RETURN n",
+              "FETCH PROP ON T 1 YIELD T.n", "LOOKUP ON T WHERE T.n > 1",
+              "SHOW HOSTS", "DESCRIBE TAG T", "DESC TAG T", "USE s",
+              "YIELD 1 AS x", "(GO FROM 1 OVER e)"):
+        assert _stmt_retryable(s), s
+    for s in ("INSERT VERTEX T(n) VALUES 1:(1)", "UPDATE VERTEX ON T 1 SET n=2",
+              "DELETE VERTEX 1", "UPSERT VERTEX ON T 1 SET n=2",
+              "CREATE TAG T(n int)", "DROP SPACE s",
+              # EXPLAIN/PROFILE deliberately excluded: they EXECUTE
+              "EXPLAIN INSERT VERTEX T(n) VALUES 1:(1)",
+              "PROFILE GO FROM 1 OVER e", ""):
+        assert not _stmt_retryable(s), s
+
+
+def test_client_endpoint_forms():
+    c = GraphClient(["a:1", "b:2"])
+    assert c.endpoints == ["a:1", "b:2"] and c.addr == "a:1"
+    assert GraphClient("a:1,b:2, c:3").endpoints == ["a:1", "b:2", "c:3"]
+    assert GraphClient("h", 9669).endpoints == ["h:9669"]  # legacy pair
+    with pytest.raises(ValueError):
+        GraphClient([])
+
+
+class _FakeRpc:
+    """Scripted RpcClient stand-in: raises `err` for graph.execute, or
+    answers with a canned success; records every method called."""
+
+    def __init__(self, err=None):
+        self.err = err
+        self.calls = []
+
+    def call(self, method, **kw):
+        self.calls.append(method)
+        if method == "graph.execute" and self.err is not None:
+            raise self.err
+        if method == "graph.adopt_session":
+            return {"session_id": kw["session_id"], "space": None}
+        return {"error": None, "space": None, "latency_us": 1,
+                "data": None, "plan_desc": None}
+
+    def close(self):
+        pass
+
+
+def _fleet_pair(err):
+    """Client homed on a rigged coordinator `a:1` with healthy `b:2`."""
+    c = GraphClient(["a:1", "b:2"])
+    c.session_id = 1
+    dead, good = _FakeRpc(err=err), _FakeRpc()
+    c._rpcs = {"a:1": dead, "b:2": good}
+    return c, dead, good
+
+
+def test_failover_taxonomy_unknown_outcome_write_not_resent():
+    """Mid-statement connection death: the outcome is UNKNOWN.  A write
+    must come back as a structured E_COORDINATOR_LOST — never silently
+    re-sent — while the session still re-homes for the next statement."""
+    c, dead, good = _fleet_pair(RpcConnError("connection reset"))
+    rs = c.execute("INSERT VERTEX T(n) VALUES 1:(1)")
+    assert rs.error and "E_COORDINATOR_LOST" in rs.error
+    assert "graph.execute" not in good.calls          # never re-sent
+    assert "graph.adopt_session" in good.calls        # but re-homed
+    assert c.addr == "b:2"
+    rs = c.execute("INSERT VERTEX T(n) VALUES 2:(2)")
+    assert rs.error is None                           # next stmt flows
+
+
+def test_failover_taxonomy_read_retries():
+    c, dead, good = _fleet_pair(RpcConnError("connection reset"))
+    rs = c.execute("GO FROM 1 OVER e YIELD 1")
+    assert rs.error is None
+    assert good.calls.count("graph.execute") == 1 and c.addr == "b:2"
+
+
+def test_failover_taxonomy_never_sent_retries_writes():
+    """RpcNeverSentError is provably side-effect free — even a write
+    retries safely on the sibling."""
+    c, dead, good = _fleet_pair(RpcNeverSentError("connect refused"))
+    rs = c.execute("INSERT VERTEX T(n) VALUES 1:(1)")
+    assert rs.error is None
+    assert good.calls.count("graph.execute") == 1 and c.addr == "b:2"
+
+
+def test_failover_taxonomy_session_moved_retries_writes():
+    """A drain refusal happens BEFORE execution: any statement —
+    including a write — retries on the named sibling."""
+    c, dead, good = _fleet_pair(
+        RpcError("E_SESSION_MOVED: graphd a:1 draining; sibling=b:2"))
+    rs = c.execute("INSERT VERTEX T(n) VALUES 1:(1)")
+    assert rs.error is None
+    assert good.calls.count("graph.execute") == 1 and c.addr == "b:2"
+
+
+def test_single_endpoint_conn_death_still_raises():
+    """Legacy single-endpoint clients keep the old contract: transport
+    death surfaces as the raw exception, no failover machinery."""
+    c = GraphClient("a:1")
+    c.session_id = 1
+    c._rpcs = {"a:1": _FakeRpc(err=RpcConnError("connection reset"))}
+    with pytest.raises(RpcConnError):
+        c.execute("GO FROM 1 OVER e")
+
+
+# -- fleet cluster (module-scoped: non-destructive tests only) --------------
+
+
+@pytest.fixture(scope="module")
+def fleet():
+    reset_breakers()
+    c = LocalCluster(n_meta=1, n_storage=1, n_graph=3)
+    ca = c.client(graphd=0)
+
+    def ok(client, q):
+        r = client.execute(q)
+        assert r.error is None, f"{q} -> {r.error}"
+        return r
+
+    ok(ca, "CREATE SPACE fs(partition_num=2, replica_factor=1, "
+           "vid_type=INT64)")
+    c.reconcile_storage()
+    ok(ca, "USE fs")
+    ok(ca, "CREATE TAG Person(name string, age int)")
+    ok(ca, 'INSERT VERTEX Person(name, age) VALUES '
+           '1:("ann",30), 2:("bob",25)')
+    yield c, ca
+    _pop_flags()
+    ca.close()
+    c.stop()
+
+
+def _peer_client(fleet, graphd=1):
+    c, _ = fleet
+    cb = c.client(graphd=graphd)
+    # catalog propagation is pull-through via metad; poll until this
+    # graphd can resolve the space+tag before the test proper
+    _poll(lambda: cb.execute("USE fs").error is None, msg="USE fs on peer")
+    _poll(lambda: cb.execute(
+        "FETCH PROP ON Person 1 YIELD Person.age AS a").error is None,
+        msg="catalog on peer")
+    return cb
+
+
+def test_fleet_epochs_reach_metad_and_peers(fleet):
+    c, ca = fleet
+    # the storaged write epochs ride its heartbeat into metad's merged
+    # table...
+    meta = c.graphds[0].meta
+
+    def table_has_fs():
+        t = meta.cluster_epochs()
+        return "fs" in t and t["fs"]
+    _poll(table_has_fs, msg="metad cluster_epochs table")
+    # ...and every heartbeat REPLY folds it into every graphd,
+    # including ones that never served a statement for the space
+    for i in range(3):
+        _poll(lambda i=i: c.graphds[i].engine.cluster_epochs.gen("fs") > 0,
+              msg=f"graphd {i} epoch fold")
+
+
+def test_cross_coordinator_cache_invalidation(fleet):
+    """The tentpole hole (PR 9): write through coordinator A, cached
+    read through coordinator B.  Without cluster epochs B's cached rows
+    would be stale FOREVER (its local write_epoch never moved); with
+    them the fold mints a new key within the propagation window."""
+    c, ca = fleet
+    cb = _peer_client(fleet)
+    get_config().set_dynamic("result_cache_size", 64)
+    try:
+        q = "FETCH PROP ON Person 1 YIELD Person.age AS a"
+        hits0 = _counter("result_cache_hits")
+        assert cb.execute(q).data.rows == [[30]]
+        assert cb.execute(q).data.rows == [[30]]          # cached
+        assert _counter("result_cache_hits") > hits0
+        r = ca.execute("UPDATE VERTEX ON Person 1 SET age = 31")
+        assert r.error is None, r.error
+        folds0 = _counter("cluster_epoch_folds")
+        _poll(lambda: cb.execute(q).data.rows == [[31]],
+              msg="peer cache invalidation")
+        # the fold that did it was measured: propagation lag samples
+        # and the fold counter both moved
+        snap = stats().snapshot()
+        assert snap.get("cluster_epoch_folds", 0) >= folds0
+        assert snap.get("epoch_propagation_lag_ms.count", 0) > 0
+    finally:
+        get_config().dynamic_layer.pop("result_cache_size", None)
+        cb.close()
+
+
+def test_write_coordinator_read_your_writes(fleet):
+    """On the WRITE coordinator freshness is ack-latency, not
+    heartbeat-latency: the storaged ack folds immediately (plus the
+    PR 9 local write_epoch) — no poll needed."""
+    c, ca = fleet
+    get_config().set_dynamic("result_cache_size", 64)
+    try:
+        q = "FETCH PROP ON Person 2 YIELD Person.age AS a"
+        assert ca.execute(q).data.rows == [[25]]
+        assert ca.execute(q).data.rows == [[25]]          # cached
+        assert ca.execute("UPDATE VERTEX ON Person 2 SET age = 26"
+                          ).error is None
+        assert ca.execute(q).data.rows == [[26]]          # immediately
+    finally:
+        get_config().dynamic_layer.pop("result_cache_size", None)
+
+
+def test_strict_epoch_sync_hook(fleet):
+    """`result_cache_strict_epoch`: a leader-consistency cached read
+    pulls metad's merged table BEFORE forming the cache key — the
+    engine calls the graphd's epoch_sync hook exactly when the flag is
+    on."""
+    c, ca = fleet
+    cb = _peer_client(fleet)
+    eng = c.graphds[1].engine
+    calls = []
+    orig = eng.epoch_sync
+    eng.epoch_sync = lambda: (calls.append(1), orig())
+    get_config().set_dynamic("result_cache_size", 64)
+    try:
+        q = "FETCH PROP ON Person 1 YIELD Person.age AS a"
+        assert cb.execute(q).error is None
+        assert not calls                                   # flag off
+        get_config().set_dynamic("result_cache_strict_epoch", True)
+        assert cb.execute(q).error is None
+        assert calls                                       # flag on
+    finally:
+        eng.epoch_sync = orig
+        get_config().dynamic_layer.pop("result_cache_strict_epoch", None)
+        get_config().dynamic_layer.pop("result_cache_size", None)
+        cb.close()
+
+
+def test_cross_coordinator_read_your_writes_levels(fleet):
+    """Write via A, read via B at every consistency level, cached and
+    uncached, under a concurrent epoch-bumping writer: reads converge
+    to the written value within the propagation window and never after
+    serve the old value again (no cache resurrection)."""
+    c, ca = fleet
+    cb = _peer_client(fleet)
+    assert ca.execute('INSERT VERTEX Person(name, age) VALUES '
+                      '50:("rw",1)').error is None
+    stop = threading.Event()
+
+    def churn():
+        # concurrent epoch bumps on an UNRELATED vertex: folds must
+        # invalidate by space generation without corrupting results
+        k = 0
+        while not stop.is_set():
+            ca.execute(f'INSERT VERTEX Person(name, age) VALUES '
+                       f'60:("churn",{k % 90})')
+            k += 1
+            time.sleep(0.01)
+
+    t = threading.Thread(target=churn, daemon=True)
+    t.start()
+    try:
+        val = 1
+        for level in ("leader", "follower", "bounded_stale"):
+            for cached in (False, True):
+                get_config().set_dynamic("read_consistency", level)
+                if cached:
+                    get_config().set_dynamic("result_cache_size", 64)
+                q = "FETCH PROP ON Person 50 YIELD Person.age AS a"
+                cb.execute(q)                      # warm/cache
+                val += 1
+                r = ca.execute(f"UPDATE VERTEX ON Person 50 "
+                               f"SET age = {val}")
+                assert r.error is None, (level, cached, r.error)
+                _poll(lambda: cb.execute(q).data.rows == [[val]],
+                      msg=f"read-your-writes {level} cached={cached}")
+                # once seen, the old value must never resurface
+                assert cb.execute(q).data.rows == [[val]]
+                get_config().dynamic_layer.pop("result_cache_size", None)
+                get_config().dynamic_layer.pop("read_consistency", None)
+    finally:
+        stop.set()
+        t.join(5)
+        _pop_flags()
+        cb.close()
+
+
+def test_show_tenants_cluster_view(fleet):
+    c, ca = fleet
+    cb = _peer_client(fleet)
+    cfg = get_config()
+    cfg.set_dynamic("max_running_queries", 8)
+    cfg.set_dynamic("admission_queue_capacity", 32)
+    cfg.set_dynamic("admission_tenant_weights", "root:4")
+    try:
+        for _ in range(3):
+            assert ca.execute("YIELD 1 AS x").error is None
+            assert cb.execute("YIELD 1 AS x").error is None
+        rs = ca.execute("SHOW TENANTS")
+        assert rs.error is None, rs.error
+        assert rs.data.column_names == ["Tenant", "Weight", "Running",
+                                        "Queued", "Admitted", "Share",
+                                        "Graphds"]
+        row = next(r for r in rs.data.rows if r[0] == "root")
+        assert row[1] == 4                       # weight from the flag
+        assert row[4] >= 6                       # admissions summed
+        assert row[6] >= 2                       # merged across graphds
+        # LOCAL view: this coordinator's controller only (in-process
+        # LocalCluster shares one controller, so the row still merges
+        # to a single-graphd count)
+        rs = ca.execute("SHOW LOCAL TENANTS")
+        assert rs.error is None, rs.error
+        row = next(r for r in rs.data.rows if r[0] == "root")
+        assert row[6] == 1
+    finally:
+        _pop_flags()
+        admission().reset()
+        cb.close()
+
+
+def test_kill_session_double_kill_idempotent(fleet):
+    c, ca = fleet
+    victim = c.client(graphd=1)
+    sid = victim.session_id
+    assert ca.execute(f"KILL SESSION {sid}").error is None
+    # second kill: the sid is a metad TOMBSTONE — quiet success, the
+    # goal state already holds (operator scripts re-run safely)
+    assert ca.execute(f"KILL SESSION {sid}").error is None
+    # a sid that NEVER existed still errors (typo protection)
+    rs = ca.execute("KILL SESSION 987654321")
+    assert rs.error is not None
+
+
+def test_adopt_session_guards(fleet):
+    """A sid alone must never be enough to steal a session: credentials
+    and the session's recorded user are re-checked; unknown sids are
+    refused."""
+    c, ca = fleet
+    addr_b = c.graph_addrs[1]
+    rpc = RpcClient.from_addr(addr_b, timeout=3.0, retries=0)
+    try:
+        with pytest.raises(RpcError, match="E_SESSION_UNKNOWN"):
+            rpc.call("graph.adopt_session", session_id=123456789,
+                     user="root", password="nebula")
+        with pytest.raises(RpcError, match="user mismatch"):
+            rpc.call("graph.adopt_session", session_id=ca.session_id,
+                     user="mallory", password="whatever")
+        # the legitimate owner re-homes fine
+        r = rpc.call("graph.adopt_session", session_id=ca.session_id,
+                     user="root", password="nebula")
+        assert r["session_id"] == ca.session_id
+    finally:
+        rpc.close()
+        # re-home back so later tests keep using graphd 0
+        ca.rpc.call("graph.adopt_session", session_id=ca.session_id,
+                    user="root", password="nebula")
+
+
+# -- KILL QUERY idempotency (engine level) ----------------------------------
+
+
+def test_kill_query_double_kill_engine():
+    eng = QueryEngine()
+    s = eng.new_session()
+    ev = threading.Event()
+    s.queries[4242] = "stalled"
+    s.running_kill[4242] = ev
+    assert eng.kill_running(s.id, 4242)
+    assert ev.is_set()
+    # victim drained: registry empty now
+    s.queries.pop(4242)
+    s.running_kill.pop(4242)
+    # second kill of the SAME qid: quiet success via the recent-kills
+    # ledger, not "no running query matches"
+    assert eng.kill_running(s.id, 4242)
+    assert eng.kill_running(None, 4242)
+    # a qid never killed and not running still misses
+    assert not eng.kill_running(s.id, 999999)
+
+
+# -- tenant DWRR (controller level) -----------------------------------------
+
+
+def test_tenant_dwrr_shares_and_snapshot():
+    """Outer DWRR rotation is per TENANT: with weights vip:3 / agg:1
+    and both backlogged on one slot, admissions interleave ~3:1 — an
+    aggressor tenant cannot starve the others no matter how many
+    sessions or statements it piles on."""
+    admission().reset()
+    cfg = get_config()
+    cfg.set_dynamic("max_running_queries", 1)
+    cfg.set_dynamic("admission_queue_capacity", 100)
+    cfg.set_dynamic("admission_tenant_weights", "vip:3,agg:1")
+    order = []
+    threads = []
+    try:
+        ctl = admission()
+        seed = ctl.acquire(qid=1, session=1, kind="GO", user="vip")
+        assert seed is not None and seed.mode == "admitted"
+
+        def waiter(qid, user):
+            t = ctl.acquire(qid=qid, session=qid, kind="GO", user=user)
+            order.append(user)
+            t.release()
+
+        # aggressor enqueues FIRST and 2× as much — FIFO would give it
+        # the whole head of the line
+        qid = 100
+        for u in ["agg"] * 12 + ["vip"] * 6:
+            th = threading.Thread(target=waiter, args=(qid, u),
+                                  daemon=True)
+            th.start()
+            threads.append(th)
+            qid += 1
+            _poll(lambda n=qid - 100: admission().snapshot()["queued"]
+                  >= n, msg="waiter queued")
+        seed.release()                      # open the floodgate
+        for th in threads:
+            th.join(10)
+            assert not th.is_alive()
+        head = order[:8]
+        assert head.count("vip") >= 5, order
+        assert head.count("agg") >= 1, order    # weighted, not starved
+        rows = {r["tenant"]: r for r in ctl.tenant_snapshot()}
+        assert rows["vip"]["weight"] == 3 and rows["agg"]["weight"] == 1
+        assert rows["vip"]["admitted"] == 7 and rows["agg"]["admitted"] == 12
+        assert abs(sum(r["share"] for r in rows.values()) - 1.0) < 0.01
+    finally:
+        _pop_flags()
+        admission().reset()
+
+
+def test_single_tenant_collapses_to_session_dwrr():
+    """With ONE tenant the two-level scheme must reduce exactly to the
+    PR 8 per-session DWRR — weights still honored inside the tenant."""
+    admission().reset()
+    cfg = get_config()
+    cfg.set_dynamic("max_running_queries", 1)
+    cfg.set_dynamic("admission_queue_capacity", 100)
+    order = []
+    threads = []
+    try:
+        ctl = admission()
+        seed = ctl.acquire(qid=1, session=77, kind="GO")
+        qid = 200
+        for sess in [10, 10, 10, 20, 20, 20]:
+            th = threading.Thread(
+                target=lambda q=qid, s=sess: (
+                    (t := ctl.acquire(qid=q, session=s, kind="GO")),
+                    order.append(s), t.release()),
+                daemon=True)
+            th.start()
+            threads.append(th)
+            qid += 1
+            _poll(lambda n=qid - 200: admission().snapshot()["queued"]
+                  >= n, msg="waiter queued")
+        seed.release()
+        for th in threads:
+            th.join(10)
+        # equal weights: sessions alternate, neither side runs 3 deep
+        # while the other waits
+        assert order[:2].count(10) == 1 and order[:2].count(20) == 1, order
+    finally:
+        _pop_flags()
+        admission().reset()
+
+
+# -- drain / crash failover (own clusters: destructive) ---------------------
+
+
+def test_drain_sheds_zero_acked_statements(tmp_path):
+    """The satellite regression: a PLANNED restart through drain sheds
+    ZERO statements — every refusal is an E_SESSION_MOVED the client
+    transparently retries (writes included: refusal precedes
+    execution), and every acked write survives."""
+    reset_breakers()
+    c = LocalCluster(n_meta=1, n_storage=1, n_graph=2,
+                     data_dir=str(tmp_path))
+    try:
+        fc = c.fleet_client()
+        assert fc.execute("CREATE SPACE dr(partition_num=2, "
+                          "replica_factor=1, vid_type=INT64)").error is None
+        c.reconcile_storage()
+        assert fc.execute("USE dr").error is None
+        assert fc.execute("CREATE TAG T(n int)").error is None
+        home = fc.addr
+        idx = c.graph_addrs.index(home)
+        sib = c.client(graphd=1 - idx)
+        _poll(lambda: sib.execute("USE dr").error is None, msg="peer USE")
+        _poll(lambda: sib.execute("DESCRIBE TAG T").error is None,
+              msg="peer catalog")
+        drains0 = _counter("graphd_drains")
+        results = []
+
+        def writer():
+            for k in range(40):
+                results.append(
+                    fc.execute(f"INSERT VERTEX T(n) VALUES {k}:({k})"))
+
+        t = threading.Thread(target=writer, daemon=True)
+        t.start()
+        _poll(lambda: len(results) >= 5, msg="writer warm")
+        c.drain_graphd(idx)
+        t.join(30)
+        assert not t.is_alive()
+        errs = [r.error for r in results if r.error is not None]
+        assert not errs, errs                      # ZERO shed statements
+        assert fc.addr != home                     # re-homed
+        assert _counter("graphd_drains") > drains0
+        # every acked write is readable exactly where it should be
+        for k in range(40):
+            r = sib.execute(f"FETCH PROP ON T {k} YIELD T.n AS n")
+            assert r.error is None and r.data.rows == [[k]], (k, r.error)
+        sib.close()
+        fc.close()
+    finally:
+        c.stop()
+
+
+def test_crash_failover_and_owner_dead_kill(tmp_path):
+    """Hard coordinator death: reads fail over transparently; an
+    unknown-outcome write is either safely retried (provably never
+    sent) or reported as structured E_COORDINATOR_LOST — NEVER silently
+    re-sent; KILL of the dead coordinator's session/query succeeds
+    idempotently (the victim provably isn't running)."""
+    reset_breakers()
+    c = LocalCluster(n_meta=1, n_storage=1, n_graph=2,
+                     data_dir=str(tmp_path))
+    try:
+        fc = c.fleet_client()
+        assert fc.execute("CREATE SPACE cr(partition_num=2, "
+                          "replica_factor=1, vid_type=INT64)").error is None
+        c.reconcile_storage()
+        assert fc.execute("USE cr").error is None
+        assert fc.execute("CREATE TAG T(n int)").error is None
+        home = fc.addr
+        idx = c.graph_addrs.index(home)
+        surv = c.client(graphd=1 - idx)
+        _poll(lambda: surv.execute("USE cr").error is None, msg="peer USE")
+        _poll(lambda: surv.execute("DESCRIBE TAG T").error is None,
+              msg="peer catalog")
+        assert fc.execute("INSERT VERTEX T(n) VALUES 1:(1)").error is None
+        # a session owned by the soon-dead coordinator, for the KILLs
+        doomed = c.client(graphd=idx)
+        doomed_sid = doomed.session_id
+
+        fails0 = _counter("coordinator_failovers")
+        c.stop_graphd(idx)
+
+        # write DURING the crash: exactly-once either way — retried
+        # only when provably never sent, else structured + not applied
+        rs = fc.execute("INSERT VERTEX T(n) VALUES 2:(2)")
+        if rs.error is not None:
+            assert "E_COORDINATOR_LOST" in rs.error, rs.error
+            r2 = fc.execute("FETCH PROP ON T 2 YIELD T.n AS n")
+            assert r2.error is None
+            if not r2.data.rows:           # provably not applied: redo
+                assert fc.execute(
+                    "INSERT VERTEX T(n) VALUES 2:(2)").error is None
+        assert fc.addr != home
+        assert _counter("coordinator_failovers") > fails0
+
+        # reads + writes flow on the survivor; acked-exactly-once holds
+        r = fc.execute("FETCH PROP ON T 1 YIELD T.n AS n")
+        assert r.error is None and r.data.rows == [[1]]
+        r = fc.execute("FETCH PROP ON T 2 YIELD T.n AS n")
+        assert r.error is None and r.data.rows == [[2]]
+
+        # owner-dead KILL race: the owning graphd is gone — the query
+        # provably isn't running, so KILL succeeds instead of erroring
+        rs = surv.execute(f"KILL QUERY (session={doomed_sid}, plan=1)")
+        assert rs.error is None, rs.error
+        rs = surv.execute(f"KILL SESSION {doomed_sid}")
+        assert rs.error is None, rs.error
+        rs = surv.execute(f"KILL SESSION {doomed_sid}")   # double-kill
+        assert rs.error is None, rs.error
+        surv.close()
+        fc.close()
+    finally:
+        c.stop()

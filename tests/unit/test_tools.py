@@ -170,6 +170,54 @@ def test_metrics_dump_shards_view(capsys):
         st.gauge("tpu_hbm_bytes_pinned", 0.0)
 
 
+def test_metrics_dump_fleet_view(capsys):
+    """--fleet (ISSUE 20): per-coordinator session gauge, goodput
+    ledger by statement kind, epoch-propagation lag mean and the
+    failover-plane counters, scraped from the prometheus exposition."""
+    from nebula_tpu.cluster.webservice import WebService
+    from nebula_tpu.tools import metrics_dump
+    from nebula_tpu.utils.stats import stats
+
+    st = stats()
+    with st.lock:
+        # earlier engine/epoch tests leave observations in the
+        # process-global registry — start from known totals
+        st.histograms.pop("query_latency_us_hist", None)
+        st.histograms.pop("epoch_propagation_lag_ms", None)
+        st.labeled.pop("overload_server_rejections", None)
+        st.counters["cluster_epoch_folds"] = 3
+        st.counters["session_moves"] = 2
+        st.counters["coordinator_failovers"] = 1
+        st.counters["graphd_drains"] = 0
+        st.counters["kill_owner_dead"] = 0
+    st.gauge("graph_sessions", 7.0)
+    for _ in range(3):
+        st.observe("query_latency_us_hist", 900.0, {"kind": "go"})
+    st.observe("query_latency_us_hist", 4000.0, {"kind": "match"})
+    st.observe("epoch_propagation_lag_ms", 4.0)
+    st.observe("epoch_propagation_lag_ms", 8.0)
+    st.inc_labeled("overload_server_rejections",
+                   {"op": "graph.statement_capacity", "role": "graphd"},
+                   4)
+    ws = WebService(role="graphd")
+    ws.start()
+    try:
+        rc = metrics_dump.main(["--addr", ws.addr, "--fleet"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "fleet samples" in out
+        assert "sessions: 7" in out
+        assert "statements served: 4" in out
+        assert "go=3" in out and "match=1" in out
+        assert "epoch folds: 3" in out
+        assert "propagation lag: 6.00ms mean of 2" in out
+        assert "session moves: 2" in out and "failovers: 1" in out
+        assert "capacity sheds: 4" in out
+    finally:
+        ws.stop()
+        st.gauge("graph_sessions", 0.0)
+
+
 def test_metrics_dump_perfetto_export(tmp_path, capsys):
     """--perfetto exports scraped trace trees + stall captures as
     Chrome trace-event JSON (ISSUE 9 satellite): one process track per

@@ -1,7 +1,7 @@
 #!/bin/sh
 # ci_lint.sh — the fast pre-merge drift gate (ISSUE 16 satellite).
 #
-# Two stages, seconds not minutes — suitable as a commit hook or the
+# Four stages, seconds not minutes — suitable as a commit hook or the
 # first CI stage before the tier-1 suite:
 #
 #   1. the tests marked `lint`: metric/span catalogue lints
@@ -18,6 +18,11 @@
 #      interleaving must stay byte-identical to a full rebuild and
 #      the host oracle — the invariant every delta-plane change can
 #      silently break.
+#   4. the fast fleet-parity subset (ISSUE 20): the epoch-fold
+#      monotonicity/boot-change rules and the client retry-safety
+#      taxonomy (a write must NEVER be silently re-sent on an
+#      unknown-outcome loss) — the two invariants every fleet-plane
+#      change can silently break, checked without spinning a cluster.
 #
 #   tools/ci_lint.sh [extra pytest args...]
 set -e
@@ -28,7 +33,15 @@ env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
     python -m pytest -q -p no:cacheprovider \
     "tests/unit/test_sharded.py::test_go_parity_sharded_vs_single_chip[2]" \
     tests/unit/test_sharded.py::test_mesh2_grid_and_degrade "$@"
-exec env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
     python -m pytest -q -p no:cacheprovider \
     "tests/unit/test_delta.py::test_interleaved_writes_parity[2]" \
     tests/unit/test_delta.py::test_off_switch_is_byte_identical "$@"
+exec env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+    python -m pytest -q -p no:cacheprovider \
+    tests/unit/test_fleet.py::test_epoch_fold_monotonic_and_boot_change \
+    tests/unit/test_fleet.py::test_epoch_fold_table_and_ack \
+    tests/unit/test_fleet.py::test_stmt_retry_taxonomy \
+    "tests/unit/test_fleet.py::test_failover_taxonomy_unknown_outcome_write_not_resent" \
+    tests/unit/test_fleet.py::test_failover_taxonomy_never_sent_retries_writes \
+    tests/unit/test_fleet.py::test_failover_taxonomy_session_moved_retries_writes "$@"
